@@ -1,0 +1,90 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas.
+
+Top-level namespace mirrors `paddle` (reference: python/paddle/__init__.py):
+tensor creation/math, paddle.nn, paddle.optimizer, paddle.io, paddle.amp,
+paddle.distributed, paddle.vision, paddle.Model, ...
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    Tensor, no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+    seed, get_rng_state, set_rng_state,
+    set_device, get_device, device_count,
+    is_compiled_with_tpu, is_compiled_with_cuda, is_compiled_with_xpu,
+    is_compiled_with_npu,
+    CPUPlace, TPUPlace,
+    set_default_dtype, get_default_dtype,
+    float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128,
+)
+from .core.tensor import to_tensor, Parameter  # noqa: F401
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import static  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
+from . import sparse  # noqa: F401
+from . import linalg  # noqa: F401
+from . import fft  # noqa: F401
+from . import distribution  # noqa: F401
+from . import text  # noqa: F401
+from . import device  # noqa: F401
+from . import version  # noqa: F401
+
+from .framework.io import save, load  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
+from .jit import to_static  # noqa: F401
+
+# paddle.disable_static/enable_static compatibility: we are always "dygraph"
+_static_mode = False
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    from .autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs, retain_graph, create_graph,
+                 only_inputs, allow_unused, no_grad_vars)
+
+
+def get_flags(flags=None):
+    from .framework import flags as _f
+    return _f.get_flags(flags)
+
+
+def set_flags(flags):
+    from .framework import flags as _f
+    return _f.set_flags(flags)
+
+
+def set_printoptions(**kw):
+    import numpy as np
+    np.set_printoptions(**{k: v for k, v in kw.items()
+                           if k in ("precision", "threshold", "edgeitems", "linewidth")})

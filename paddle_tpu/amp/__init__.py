@@ -1,0 +1,148 @@
+"""paddle.amp equivalent (reference: python/paddle/amp/auto_cast.py,
+grad_scaler.py; C++ lists imperative/amp_auto_cast.cc).
+
+TPU-native: "AMP" = bfloat16 compute. bf16 has fp32's exponent range, so
+dynamic loss scaling is unnecessary — GradScaler is API-compatible but a
+near-no-op by default (it still implements the dynamic-scale algorithm for
+float16 parity, used when level='O2' with dtype float16).
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor
+
+# ops that should run in low precision when autocast is on (mirrors the
+# reference's white list: matmul/conv family)
+WHITE_LIST = {"matmul", "conv2d", "conv1d", "conv3d", "einsum", "linear", "bmm", "mm"}
+BLACK_LIST = {"exp", "log", "mean", "sum", "softmax", "cross_entropy",
+              "layer_norm", "batch_norm", "reduce"}
+
+_amp_state = {"enable": False, "dtype": _dt.bfloat16, "level": "O1"}
+
+
+def amp_state():
+    return dict(_amp_state)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = dict(_amp_state)
+    _amp_state.update(enable=enable, dtype=_dt.convert_dtype(dtype), level=level)
+    try:
+        yield
+    finally:
+        _amp_state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the AMP dtype (master weights stay fp32 in
+    the optimizer's fp32 accumulators — our optimizers always compute in f32)."""
+    d = _dt.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if _dt.is_floating(p.dtype):
+                    p._data = p._data.astype(d)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: fluid/dygraph/amp/loss_scaler.py:40 +
+    check_finite_and_unscale / update_loss_scaling ops)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameters:
+            if p._grad_data is not None:
+                g = p._grad_data.astype(jnp.float32) * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found_inf = True
+                p._grad_data = g.astype(p._grad_data.dtype)
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

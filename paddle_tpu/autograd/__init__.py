@@ -1,0 +1,105 @@
+"""paddle.autograd equivalent (reference: python/paddle/autograd).
+
+backward()/grad() over the tape engine; PyLayer for user-defined VJPs.
+"""
+import jax.numpy as jnp
+
+from ..core import autograd as _engine
+from ..core.autograd import no_grad, enable_grad, is_grad_enabled  # noqa: F401
+from ..core.tensor import Tensor, apply_op
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        _engine.backward(t, g, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad — computes grads of outputs w.r.t. inputs without touching
+    .grad. Implemented by running the tape backward into a side dict."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+
+    # save/restore leaf .grad state so paddle.grad is side-effect free
+    saved = [(p, p._grad_data) for p in inputs]
+    for p in inputs:
+        p._grad_data = None
+    retain = True if retain_graph is None else retain_graph
+    for out, go in zip(outputs, grad_outputs):
+        _engine.backward(out, go, retain_graph=retain)
+    results = []
+    for p, old in saved:
+        g = p._grad_data
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(p._data)
+        results.append(Tensor(g) if g is not None else None)
+        p._grad_data = old
+    return results
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined autograd function (reference: autograd/py_layer.py).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x): ...
+        @staticmethod
+        def backward(ctx, dy): ...
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.autograd import Node, is_grad_enabled
+
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need = is_grad_enabled() and any(not t.stop_gradient for t in tensor_inputs)
+        if not need:
+            return out
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        for o in outs:
+            o.stop_gradient = False
+
+        def vjp_fn(cts):
+            ct_list = list(cts) if multi else [cts]
+            with no_grad():
+                gins = cls.backward(ctx, *[Tensor(c) for c in ct_list])
+            gins = gins if isinstance(gins, (tuple, list)) else (gins,)
+            return tuple(g._data if isinstance(g, Tensor) else g for g in gins)
+
+        # align vjp outputs with ALL tensor inputs; the engine skips the
+        # stop_gradient ones when accumulating
+        node = Node(vjp_fn, tensor_inputs, outs, multi, name=cls.__name__)
+        for o in outs:
+            o._node = node
+        return out
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError

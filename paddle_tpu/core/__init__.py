@@ -1,0 +1,21 @@
+"""Core runtime: Tensor, autograd tape, dtype/device/random machinery.
+
+TPU-native re-design of the reference's phi/core + eager runtime
+(reference: paddle/phi/core/dense_tensor.h:37, paddle/fluid/eager/backward.cc:105).
+Instead of a C++ kernel registry dispatching per-backend kernels, every op is a
+jax/jnp computation; autograd is a thin tape over `jax.vjp` rather than
+codegen'd GradNodes.
+"""
+from .dtype import (  # noqa: F401
+    DType, float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128, convert_dtype, get_default_dtype,
+    set_default_dtype,
+)
+from .device import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_tpu,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_npu,
+    default_device, CPUPlace, TPUPlace, Place,
+)
+from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .random import seed, get_rng_state, set_rng_state, next_key, Generator  # noqa: F401
+from .tensor import Tensor, apply_op, to_tensor, wrap, unwrap  # noqa: F401

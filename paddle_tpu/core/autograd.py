@@ -1,0 +1,128 @@
+"""Autograd mode switches + the tape engine.
+
+Reference design: codegen'd per-op GradNodes walked by egr::RunBackward
+(paddle/fluid/eager/backward.cc:105) with GradTensorHolder accumulation.
+TPU-native design: one generic engine — every op records a `Node` holding the
+`jax.vjp` closure of its forward fn; `backward()` is a reverse-topological walk
+with cotangent accumulation. No per-op codegen is needed because JAX already
+knows the VJP of every primitive.
+"""
+import contextlib
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+class _GradCtx(contextlib.ContextDecorator):
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+def no_grad():
+    """paddle.no_grad() — usable as decorator or context manager."""
+    return _GradCtx(False)
+
+
+def enable_grad():
+    return _GradCtx(True)
+
+
+class Node:
+    """One tape entry: the vjp closure of a single traced op."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "multi_output", "name")
+
+    def __init__(self, vjp_fn, inputs, outputs, multi_output, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs        # list[Tensor] — the differentiable inputs
+        self.outputs = outputs      # list[Tensor]
+        self.multi_output = multi_output
+        self.name = name
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = None
+        for o in self.outputs or ():
+            o._node = None
+        self.outputs = None
+
+
+def _topo_from(root_node):
+    """Iterative post-order DFS over the tape; returns nodes leaves-first."""
+    order, seen = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+    return order
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Reverse-mode sweep from `tensor` accumulating into leaf `.grad`s."""
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if tensor._node is None:
+        return
+    if grad is None:
+        grad = jnp.ones_like(tensor._data)
+    elif isinstance(grad, Tensor):
+        grad = grad._data
+
+    order = _topo_from(tensor._node)
+    cotangents = {id(tensor): grad}
+
+    for node in reversed(order):
+        cts = [cotangents.pop(id(o), None) for o in node.outputs]
+        if all(c is None for c in cts):
+            continue
+        cts = [c if c is not None else jnp.zeros_like(o._data)
+               for c, o in zip(cts, node.outputs)]
+        seed = tuple(cts) if node.multi_output else cts[0]
+        in_grads = node.vjp_fn(seed)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp.stop_gradient:
+                continue
+            if inp._node is None:  # leaf: accumulate into .grad (paddle semantics)
+                if inp._grad_data is None:
+                    inp._grad_data = g
+                else:
+                    inp._grad_data = inp._grad_data + g
+            else:
+                key = id(inp)
+                if key in cotangents:
+                    cotangents[key] = cotangents[key] + g
+                else:
+                    cotangents[key] = g
+
+    if not retain_graph:
+        for node in order:
+            node.release()

@@ -1,0 +1,111 @@
+"""Device / Place abstraction (reference: paddle/phi/common/place.h,
+paddle/fluid/platform/device_context.h).
+
+On TPU there is a single accelerator backend managed by PjRt through JAX; the
+reference's Place zoo (CUDAPlace/XPUPlace/NPUPlace/...) collapses to
+{cpu, tpu}. `set_device` picks the JAX default device; multi-chip placement is
+expressed with `jax.sharding.Mesh` (see paddle_tpu.distributed), not with
+per-device contexts.
+"""
+import jax
+
+
+class Place:
+    """Mirror of paddle's Place: identifies a device."""
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and (self.kind, self.index) == (other.kind, other.index))
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == _JAX_PLATFORM.get(self.kind, self.kind)]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(self.index, len(devs) - 1)]
+
+
+_JAX_PLATFORM = {"tpu": "tpu", "cpu": "cpu", "gpu": "gpu"}
+
+
+def CPUPlace(index: int = 0) -> Place:
+    return Place("cpu", index)
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+_current_place = None
+
+
+def _auto_place() -> Place:
+    platforms = {d.platform for d in jax.devices()}
+    if "tpu" in platforms:
+        return Place("tpu", 0)
+    return Place("cpu", 0)
+
+
+def set_device(device):
+    """paddle.set_device('tpu') / ('tpu:0') / ('cpu')."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    name, _, idx = str(device).partition(":")
+    name = name.lower()
+    if name in ("tpu", "xla"):
+        name = "tpu"
+    elif name in ("cpu",):
+        name = "cpu"
+    elif name in ("gpu", "cuda"):
+        name = "gpu"
+    else:
+        raise ValueError(f"Unsupported device {device!r}; expected 'tpu' or 'cpu'")
+    _current_place = Place(name, int(idx) if idx else 0)
+    return _current_place
+
+
+def get_device() -> str:
+    p = default_device()
+    return f"{p.kind}:{p.index}"
+
+
+def default_device() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = _auto_place()
+    return _current_place
+
+
+def device_count(kind: str = None) -> int:
+    kind = kind or default_device().kind
+    return len([d for d in jax.devices() if d.platform == kind]) or len(jax.devices())
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False

@@ -1,0 +1,75 @@
+"""Dtype system (reference: paddle/phi/common/data_type.h).
+
+Paddle exposes dtypes as `paddle.float32` etc. plus string names. We map
+directly onto numpy/jax dtypes; bfloat16 is first-class because it is the
+native TPU matmul type (MXU operates on bf16 inputs with f32 accumulation).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+DType = jnp.dtype
+
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+
+_STR_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalise any dtype spec (str, np.dtype, jnp scalar type) to np.dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower()
+        if key not in _STR_ALIASES:
+            raise ValueError(f"Unknown dtype string: {d!r}")
+        return _STR_ALIASES[key]
+    return jnp.dtype(d)
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_inexact(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.inexact)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    return np.dtype(d).name if d != bfloat16 else "bfloat16"

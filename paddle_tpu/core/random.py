@@ -1,0 +1,85 @@
+"""Stateful-feel RNG over stateless JAX PRNG keys.
+
+Reference: per-device `Generator` (paddle/phi/core/generator.h) with a global
+seed. On TPU, statefulness cannot live inside compiled programs, so the global
+generator hands out keys derived by `fold_in(base_key, counter)`. Inside a
+traced (jit) region, the tracer-aware key must be threaded explicitly — the
+hapi/jit layers do that by seeding from a per-step counter array (see
+paddle_tpu.hapi.model); eager callers just get fresh keys from this module.
+"""
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed_=0):
+        self.manual_seed(seed_)
+
+    def manual_seed(self, s):
+        self._seed = int(s)
+        self._base_key = jax.random.key(self._seed)
+        self._counter = 0
+        return self
+
+    def next_key(self):
+        k = jax.random.fold_in(self._base_key, self._counter)
+        self._counter += 1
+        return k
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._base_key = jax.random.key(self._seed)
+        return self
+
+
+_default_generator = Generator(np.random.SeedSequence().entropy % (2**31))
+
+# When set (by jit tracing layers), next_key() derives from this traced key
+# instead of the stateful global generator, keeping compiled programs pure.
+_traced_key = None
+_traced_counter = 0
+
+
+class traced_rng:
+    """Context manager installing a traced base key for use under jit."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        global _traced_key, _traced_counter
+        self._prev = (_traced_key, _traced_counter)
+        _traced_key = self._key
+        _traced_counter = 0
+        return self
+
+    def __exit__(self, *exc):
+        global _traced_key, _traced_counter
+        _traced_key, _traced_counter = self._prev
+        return False
+
+
+def seed(s):
+    """paddle.seed(s)"""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def next_key():
+    global _traced_counter
+    if _traced_key is not None:
+        k = jax.random.fold_in(_traced_key, _traced_counter)
+        _traced_counter += 1
+        return k
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

@@ -1,0 +1,451 @@
+"""Tensor: the user-facing eager tensor.
+
+Reference: `phi::DenseTensor` (paddle/phi/core/dense_tensor.h:37) +
+`egr::EagerVariable`/AutogradMeta (paddle/fluid/eager/autograd_meta.h:61).
+Here a Tensor wraps a `jax.Array`; autograd metadata is just (stop_gradient,
+grad, producer Node). Every op funnels through `apply_op`, which either runs
+the jnp computation directly (no grad needed) or runs it through `jax.vjp`
+and records a tape Node — the single generic replacement for the reference's
+thousands of codegen'd `*_ad_func` + GradNode classes.
+"""
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd as ag
+from . import dtype as _dt
+from .autograd import Node
+from .device import default_device
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad_data", "_node", "name",
+                 "persistable", "trainable", "__weakref__")
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad_data = None
+        self._node = None
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        return default_device()
+
+    @property
+    def grad(self):
+        if self._grad_data is None:
+            return None
+        return Tensor(self._grad_data, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad_data = None
+        else:
+            self._grad_data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def astype(self, dtype):
+        d = _dt.convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(d), self)
+
+    cast = astype
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        return apply_op(lambda x: x + jnp.zeros((), x.dtype), self)
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, device=None, dtype=None):
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+
+    def pin_memory(self):
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        ag.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad_data = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        # Eager-mode grad hooks: wrap producer vjp. Minimal support.
+        raise NotImplementedError("register_hook is not supported yet")
+
+    # -- in-place helpers ---------------------------------------------------
+    def _replace(self, new_tensor):
+        """Adopt another tensor's value+tape (for in-place semantics)."""
+        self._data = new_tensor._data
+        self._node = new_tensor._node
+        if self._node is not None:
+            # rewire node output identity to self so backward reaches us
+            outs = self._node.outputs
+            for i, o in enumerate(outs):
+                if o is new_tensor:
+                    outs[i] = self
+        self.stop_gradient = new_tensor.stop_gradient
+        return self
+
+    def set_value(self, value):
+        data = value._data if isinstance(value, Tensor) else jnp.asarray(value, dtype=self.dtype)
+        self._data = jnp.broadcast_to(data, tuple(self._data.shape)).astype(self._data.dtype)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        return self
+
+    def add_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data + o
+        return self
+
+    def subtract_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data - o
+        return self
+
+    def multiply_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data * o
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # -- operators ----------------------------------------------------------
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __neg__(self):
+        return apply_op(lambda x: -x, self)
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __add__(self, o):
+        return _binop(jnp.add, self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return _binop(jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return _binop(jnp.subtract, o, self)
+
+    def __mul__(self, o):
+        return _binop(jnp.multiply, self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return _binop(jnp.divide, self, o)
+
+    def __rtruediv__(self, o):
+        return _binop(jnp.divide, o, self)
+
+    def __floordiv__(self, o):
+        return _binop(jnp.floor_divide, self, o)
+
+    def __mod__(self, o):
+        return _binop(jnp.mod, self, o)
+
+    def __pow__(self, o):
+        return _binop(jnp.power, self, o)
+
+    def __rpow__(self, o):
+        return _binop(jnp.power, o, self)
+
+    def __matmul__(self, o):
+        return _binop(jnp.matmul, self, o)
+
+    def __rmatmul__(self, o):
+        return _binop(jnp.matmul, o, self)
+
+    def __eq__(self, o):
+        return _binop(jnp.equal, self, o)
+
+    def __ne__(self, o):
+        return _binop(jnp.not_equal, self, o)
+
+    def __lt__(self, o):
+        return _binop(jnp.less, self, o)
+
+    def __le__(self, o):
+        return _binop(jnp.less_equal, self, o)
+
+    def __gt__(self, o):
+        return _binop(jnp.greater, self, o)
+
+    def __ge__(self, o):
+        return _binop(jnp.greater_equal, self, o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __invert__(self):
+        return apply_op(jnp.logical_not, self)
+
+    def __getitem__(self, idx):
+        idx = _convert_index(idx)
+        return apply_op(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _convert_index(idx)
+        if not self.stop_gradient and ag.is_grad_enabled():
+            # record the assignment so backward zeroes grads of overwritten
+            # positions (and flows into a differentiable value)
+            if isinstance(value, Tensor):
+                new = apply_op(lambda x, vv: x.at[idx].set(vv.astype(x.dtype)),
+                               self, value)
+            else:
+                v = value if isinstance(value, numbers.Number) \
+                    else jnp.asarray(value).astype(self._data.dtype)
+                new = apply_op(lambda x: x.at[idx].set(v), self)
+            self._replace(new)
+        else:
+            v = value._data if isinstance(value, Tensor) else value
+            self._data = self._data.at[idx].set(
+                jnp.asarray(v).astype(self._data.dtype)
+                if not isinstance(v, numbers.Number) else v)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}"
+                f"{grad_txt},\n       {np.asarray(self._data)!r})")
+
+    # Rich tensor methods (sum/mean/reshape/...) are attached by
+    # paddle_tpu.tensor at import time, mirroring how paddle monkey-patches
+    # python/paddle/tensor/* methods onto the C tensor type.
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle/fluid/framework.py Parameter)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed",
+                 "split_axis")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.split_axis = None
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _convert_index(idx):
+    def conv(i):
+        return i._data if isinstance(i, Tensor) else i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def wrap(data, stop_gradient=True):
+    if isinstance(data, (tuple, list)):
+        return type(data)(wrap(d, stop_gradient) for d in data)
+    return Tensor(data, stop_gradient=stop_gradient)
+
+
+def unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return type(x)(unwrap(i) for i in x)
+    return x
+
+
+def _binop(fn, a, b):
+    return apply_op(fn, *_coerce_pair(a, b))
+
+
+def _coerce_pair(a, b):
+    if not isinstance(a, Tensor):
+        a = to_tensor(a, dtype=_promote_scalar_dtype(a, b))
+    if not isinstance(b, Tensor):
+        b = to_tensor(b, dtype=_promote_scalar_dtype(b, a))
+    return a, b
+
+
+def _promote_scalar_dtype(scalar, tensor):
+    """Python scalars adopt the tensor operand's dtype (paddle semantics)."""
+    if isinstance(tensor, Tensor):
+        td = tensor.dtype
+        if isinstance(scalar, bool):
+            return _dt.bool_
+        if isinstance(scalar, numbers.Integral) and _dt.is_floating(td):
+            return td
+        if isinstance(scalar, numbers.Real) and not _dt.is_floating(td):
+            return _dt.get_default_dtype()
+        return td
+    return None
+
+
+def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
+    """Run `fn` over tensor args, recording a tape Node when grads are needed.
+
+    `fn` operates on raw jax arrays. Non-Tensor args pass through unchanged.
+    Returns Tensor or tuple-of-Tensor mirroring fn's output structure.
+    """
+    datas = [a._data if isinstance(a, Tensor) else a for a in args]
+    diff_idx = [i for i, a in enumerate(args)
+                if isinstance(a, Tensor) and not a.stop_gradient
+                and _dt.is_inexact(a.dtype)]
+    need_grad = ag.is_grad_enabled() and bool(diff_idx)
+
+    if not need_grad:
+        out = fn(*datas, **kwargs)
+        return _wrap_out(out, stop_gradient=True)
+
+    def closed(*diff_args):
+        full = list(datas)
+        for i, v in zip(diff_idx, diff_args):
+            full[i] = v
+        return fn(*full, **kwargs)
+
+    out_data, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
+    multi = isinstance(out_data, (tuple, list))
+    outs = _wrap_out(out_data, stop_gradient=False)
+    out_list = list(outs) if multi else [outs]
+    node = Node(vjp_fn, [args[i] for i in diff_idx], out_list, multi,
+                name=name or getattr(fn, "__name__", ""))
+    for o in out_list:
+        o._node = node
+    return outs
+
+
+def _wrap_out(out, stop_gradient):
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent."""
+    dtype = _dt.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if isinstance(data, (jnp.ndarray, jax.Array)) and not isinstance(data, np.ndarray):
+        arr = data
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    np_arr = np.asarray(data)
+    if dtype is None:
+        if np_arr.dtype == np.float64:
+            np_arr = np_arr.astype(np.dtype(_dt.get_default_dtype()) if _dt.get_default_dtype() != _dt.bfloat16 else np.float32)
+        elif np_arr.dtype == np.int32:
+            pass
+        elif np_arr.dtype == np.int64:
+            pass
+    else:
+        if jnp.dtype(dtype) == _dt.bfloat16:
+            arr = jnp.asarray(np_arr).astype(_dt.bfloat16)
+            return Tensor(arr, stop_gradient=stop_gradient)
+        np_arr = np_arr.astype(np.dtype(dtype))
+    if place is not None:
+        dev = place.jax_device() if hasattr(place, "jax_device") else None
+        arr = jax.device_put(np_arr, dev)
+    else:
+        arr = jnp.asarray(np_arr)
+    return Tensor(arr, stop_gradient=stop_gradient)

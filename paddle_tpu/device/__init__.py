@@ -1,0 +1,30 @@
+"""paddle.device equivalent."""
+from ..core.device import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_cuda,
+    is_compiled_with_npu, is_compiled_with_tpu, is_compiled_with_xpu, set_device,
+)
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def is_compiled_with_custom_device(device_type):
+    return device_type == "tpu"
+
+
+class Stream:
+    """Stream API compatibility: XLA owns scheduling; these are no-ops."""
+
+    def synchronize(self):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+def synchronize(device=None):
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def current_stream(device=None):
+    return Stream()

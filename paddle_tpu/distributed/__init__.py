@@ -1,0 +1,29 @@
+"""paddle.distributed equivalent over JAX SPMD (reference: python/paddle/
+distributed). See SURVEY §2.10/2.11 for the subsystem mapping."""
+from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_concat, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, destroy_process_group, get_backend,
+    get_group, is_initialized, new_group, p2p_shift, recv, reduce,
+    reduce_scatter, scatter, send, wait,
+)
+from .env import (  # noqa: F401
+    ParallelEnv, build_mesh, get_mesh, get_rank, get_world_size,
+    init_parallel_env, set_mesh,
+)
+from .parallel_layers import DataParallel  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn (reference: distributed/spawn.py).
+
+    In the SPMD single-controller model one process drives all local chips, so
+    spawn just calls func once after init_parallel_env."""
+    init_parallel_env()
+    func(*args)
+
+
+def launch():
+    from .launch.main import main
+    main()

@@ -1,0 +1,318 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py:353-1858 (+ C++
+operators/collective/c_allreduce_op.h etc. over NCCL rings).
+
+TPU-native: collectives are XLA ops over ICI. Two execution contexts:
+
+1. Inside a manual region (shard_map): functions lower to jax.lax.psum /
+   all_gather / ppermute / all_to_all with the live axis name — these compile
+   into the surrounding program exactly like the reference's c_* ops sit in
+   a static graph, with XLA's latency-hiding scheduler providing the
+   comm/compute overlap the reference builds from c_sync_* ops + streams.
+
+2. Eager (outside any trace): each collective JIT-compiles a tiny shard_map
+   program over the global mesh, cached by (op, shape, dtype, axis) — the
+   "facade hides eager collectives as tiny compiled programs" design from
+   SURVEY §7.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, apply_op
+from . import env
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = one mesh axis (or the whole mesh).
+
+    The reference's `new_group(ranks)` creates an NCCL comm over arbitrary
+    ranks; on a TPU mesh, groups are mesh axes (rows/cols of the device
+    grid), which is also the only layout where collectives ride ICI.
+    """
+
+    def __init__(self, axis_name=None, mesh=None, id=0):
+        self.axis_name = axis_name
+        self.mesh = mesh if mesh is not None else env.get_mesh()
+        self.id = id
+
+    @property
+    def nranks(self):
+        if self.mesh is None:
+            return 1
+        if self.axis_name is None:
+            return int(self.mesh.size)
+        return int(self.mesh.shape[self.axis_name])
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def process_ids(self):
+        return list(range(self.nranks))
+
+
+_WORLD = None
+_group_counter = 0
+
+
+def _world_group():
+    global _WORLD
+    if _WORLD is None:
+        _WORLD = Group(axis_name=None)
+    return _WORLD
+
+
+def new_group(ranks=None, backend=None, axis_name=None, timeout=None):
+    global _group_counter
+    _group_counter += 1
+    return Group(axis_name=axis_name, id=_group_counter)
+
+
+def get_group(gid=0):
+    return _world_group()
+
+
+def _axis_of(group, default_kind="dp"):
+    """Resolve the axis name for a collective: explicit group axis, else the
+    live manual axis of the default kind, else None (single-participant)."""
+    if group is not None and group.axis_name is not None:
+        return group.axis_name
+    live = env.current_axis_name(default_kind)
+    if live is not None:
+        return live
+    if group is None:
+        # world group: if exactly one mesh axis is live, use it
+        return env.current_axis_name("world")
+    return None
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _eager_axis_op(data, axis_name, per_shard_fn, out_spec_fn=None):
+    """Run `per_shard_fn` under shard_map over `axis_name` of the global mesh,
+    treating `data` as this controller's replicated value (world_size==1 per
+    axis on a single process means identity for cross-"rank" ops)."""
+    mesh = env.get_mesh()
+    if mesh is None or axis_name is None or axis_name not in mesh.shape:
+        return None  # caller falls back to identity
+    spec = P()  # replicated input
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=spec,
+                       out_specs=out_spec_fn or spec, check_vma=False)
+    def run(x):
+        return per_shard_fn(x)
+
+    return jax.jit(run)(data)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
+    axis = _axis_of(group)
+    if axis is None:
+        if op == ReduceOp.AVG:
+            return tensor
+        return tensor
+
+    reducer = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}.get(op)
+    if reducer is None:  # PROD: gather + reduce (no native XLA prod-collective)
+        def reducer(x, a):
+            return jnp.prod(jax.lax.all_gather(x, a, axis=0), axis=0)
+
+    if _in_trace(tensor._data):
+        out = apply_op(lambda x: reducer(x, axis), tensor)
+        tensor._replace(out)
+        return tensor
+    res = _eager_axis_op(tensor._data, axis, lambda x: reducer(x, axis))
+    if res is not None:
+        tensor._data = res
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis_of(group)
+    if ax is None:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor.clone())
+            return tensor_list
+        return tensor
+    out = apply_op(lambda x: jax.lax.all_gather(x, ax, axis=0), tensor)
+    if isinstance(tensor_list, list):
+        n = out.shape[0]
+        for i in range(n):
+            tensor_list.append(out[i])
+        return tensor_list
+    return out
+
+
+def all_gather_concat(tensor, group=None, concat_axis=0):
+    """Gather shards and concat along concat_axis (TP activation gather)."""
+    ax = _axis_of(group, "mp")
+    if ax is None:
+        return tensor
+    return apply_op(
+        lambda x: jax.lax.all_gather(x, ax, axis=concat_axis, tiled=True), tensor)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _axis_of(group, "sharding")
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        from ..tensor.manipulation import concat
+        src = concat(list(src), axis=0)
+    if ax is None:
+        return src
+    return apply_op(lambda x: jax.lax.psum_scatter(x, ax, scattered_dim=0, tiled=True),
+                    src)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis_of(group)
+    if ax is None:
+        return tensor
+    def fn(x):
+        # take src's copy: gather then index (XLA folds this to a broadcast)
+        full = jax.lax.all_gather(x, ax, axis=0)
+        return full[src]
+    if _in_trace(tensor._data):
+        out = apply_op(fn, tensor)
+        tensor._replace(out)
+        return tensor
+    res = _eager_axis_op(tensor._data, ax, fn)
+    if res is not None:
+        tensor._data = res
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On a mesh, reduce == all_reduce (result defined on every participant);
+    # the reference's rank-addressed reduce has no cheaper ICI form.
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis_of(group)
+    if ax is None or tensor_list is None:
+        return tensor
+    from ..tensor.manipulation import stack
+    stacked = stack(list(tensor_list), axis=0)
+    out = apply_op(lambda s: s[jax.lax.axis_index(ax)], stacked)
+    tensor._replace(out)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _axis_of(group, "ep")
+    if isinstance(in_tensor_list, (list, tuple)):
+        from ..tensor.manipulation import stack
+        x = stack(list(in_tensor_list), axis=0)
+    else:
+        x = in_tensor_list
+    if ax is None:
+        out = x
+    else:
+        out = apply_op(lambda a: jax.lax.all_to_all(a, ax, split_axis=0,
+                                                    concat_axis=0, tiled=False), x)
+    if isinstance(out_tensor_list, list):
+        for i in range(out.shape[0]):
+            out_tensor_list.append(out[i])
+        return out_tensor_list
+    return out
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis_of(group, "ep")
+    if ax is None:
+        return in_tensor
+    out = apply_op(lambda a: jax.lax.all_to_all(a, ax, split_axis=0,
+                                                concat_axis=0, tiled=True), in_tensor)
+    if out_tensor is not None:
+        out_tensor._replace(out)
+        return out_tensor
+    return out
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send: on a mesh this is a collective_permute to `dst` along the
+    live 'pp' axis (reference: send_v2 op). Must be paired with recv in the
+    same traced program — see parallel/pp for the pipeline pattern."""
+    ax = _axis_of(group, "pp")
+    if ax is None:
+        return tensor
+    n = env.axis_size(ax)
+    perm = [(i, dst) for i in range(n)]
+    return apply_op(lambda x: jax.lax.ppermute(x, ax, perm), tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis_of(group, "pp")
+    if ax is None:
+        return tensor
+    n = env.axis_size(ax)
+    perm = [(src, i) for i in range(n)]
+    out = apply_op(lambda x: jax.lax.ppermute(x, ax, perm), tensor)
+    tensor._replace(out)
+    return tensor
+
+
+def p2p_shift(tensor, shift=1, group=None):
+    """Ring shift along the live pp/sp axis (ring attention, 1F1B p2p)."""
+    ax = _axis_of(group, "pp") or _axis_of(group, "sep")
+    if ax is None:
+        return tensor
+    n = env.axis_size(ax)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return apply_op(lambda x: jax.lax.ppermute(x, ax, perm), tensor)
+
+
+def barrier(group=None):
+    ax = _axis_of(group)
+    if ax is None:
+        import jax as _j
+        (_j.device_put(0) + 0).block_until_ready()
+        return
+    return None
+
+
+def is_initialized():
+    return env.is_initialized()
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if not _in_trace(tensor._data):
+        tensor._data.block_until_ready()
+
+
+def stream_sync():
+    pass

@@ -1,0 +1,176 @@
+"""Distributed environment: process bootstrap + global mesh + axis contexts.
+
+Reference mapping (SURVEY §2.11):
+- TCPStore rendezvous + ProcessGroupNCCL init  ->  jax.distributed.initialize
+  (coordination service) + PjRt device enumeration.
+- ring_id / comm contexts                      ->  named mesh axes; collectives
+  compile to XLA channel_ids.
+- PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS ->  the same env vars are read
+  here for launcher parity, mapped onto jax.distributed.
+
+The global Mesh is process-wide state (like the reference's CommContext
+singleton, platform/collective_helper.h:55). Axis-name contexts track which
+mesh axes are "live" (bound by an enclosing shard_map) so layers like
+SyncBatchNorm / ColumnParallelLinear can pick manual collectives vs sharding
+annotations automatically.
+"""
+import os
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_state = threading.local()
+_global_mesh = None
+_initialized = False
+
+# canonical hybrid-parallel axis order (reference: fleet/base/topology.py:52
+# uses order [dp, pp, sharding, mp]; we use the same axis names)
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env (reference:
+    python/paddle/distributed/parallel.py:104)."""
+    global _initialized, _global_mesh
+    if _initialized:
+        return ParallelEnv()
+    # Multi-host bootstrap: honor both paddle-style and jax-style env vars.
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                 os.environ.get("JAX_PROCESS_COUNT", "1")))
+    if n_procs > 1 and jax.process_count() == 1:
+        coord = os.environ.get("PADDLE_MASTER",
+                               os.environ.get("JAX_COORDINATOR_ADDRESS"))
+        pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                                 os.environ.get("JAX_PROCESS_ID", "0")))
+        if coord:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=n_procs, process_id=pid)
+    if _global_mesh is None:
+        devs = np.asarray(jax.devices())
+        _global_mesh = Mesh(devs, ("dp",))
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.device_count()
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    # SPMD single-controller: the "rank" of this controller process
+    return jax.process_index()
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def set_mesh(mesh):
+    global _global_mesh, _initialized
+    _global_mesh = mesh
+    _initialized = True
+    return mesh
+
+
+def build_mesh(axis_dims, axis_names=None, devices=None):
+    """Create + install a global mesh; axis_dims like {'dp':2,'mp':2,'pp':2}."""
+    if isinstance(axis_dims, dict):
+        names = tuple(axis_dims.keys())
+        dims = tuple(axis_dims.values())
+    else:
+        dims = tuple(axis_dims)
+        names = tuple(axis_names)
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    total = int(np.prod(dims))
+    if total > devs.size:
+        raise ValueError(f"mesh {dict(zip(names, dims))} needs {total} devices, "
+                         f"have {devs.size}")
+    mesh = Mesh(devs[:total].reshape(dims), names)
+    return set_mesh(mesh)
+
+
+class ParallelEnv:
+    """Reference: python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+
+# ---------------------------------------------------------------------------
+# Live-axis tracking (which axes are bound manual inside a shard_map)
+# ---------------------------------------------------------------------------
+
+def _live_axes():
+    if not hasattr(_state, "axes"):
+        _state.axes = {}
+    return _state.axes
+
+
+class axis_context:
+    """Marks mesh axes as live-manual for the duration (used by shard_map
+    runners so layers can emit jax.lax collectives with the right axis name)."""
+
+    def __init__(self, **kind_to_axis):
+        self.mapping = kind_to_axis
+
+    def __enter__(self):
+        axes = _live_axes()
+        self._saved = dict(axes)
+        axes.update(self.mapping)
+        return self
+
+    def __exit__(self, *exc):
+        _state.axes = self._saved
+        return False
+
+
+def current_axis_name(kind):
+    """Return the live mesh-axis name for a parallelism kind ('dp','mp','pp',
+    'sharding','sep','ep') or None when not inside a manual region."""
+    return _live_axes().get(kind)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(mesh_or_name, name=None):
+    if isinstance(mesh_or_name, str):
+        mesh = get_mesh()
+        return mesh.shape[mesh_or_name] if mesh is not None else 1
+    return mesh_or_name.shape[name]

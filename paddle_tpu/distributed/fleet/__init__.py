@@ -1,0 +1,91 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init(strategy) -> builds the hybrid mesh; fleet.distributed_model /
+distributed_optimizer wrap model+opt for the configured parallelism. The
+wrapped model exposes the same surface as the reference
+(model.train_batch for PP, transparent forward otherwise).
+"""
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from .. import env as _env
+
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=("data", "pipe", "sharding", "model"),
+        dims=(hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+              hc.get("sharding_degree", 1), hc.get("mp_degree", 1)))
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return
+
+
+def is_first_worker():
+    return _env.get_rank() == 0
+
+
+def worker_index():
+    return _env.get_rank()
+
+
+def worker_num():
+    return _env.get_world_size()
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+def get_strategy():
+    return _fleet_state["strategy"]
+
+
+def distributed_model(model):
+    """Reference: fleet/model.py:29 — dispatch by topology."""
+    from ..parallel_layers import wrap_distributed_model
+    hcg = _fleet_state["hcg"]
+    strategy = _fleet_state["strategy"]
+    if hcg is None:
+        init()
+        hcg = _fleet_state["hcg"]
+        strategy = _fleet_state["strategy"]
+    return wrap_distributed_model(model, hcg, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference: fleet/fleet.py distributed_optimizer +
+    HybridParallelOptimizer."""
+    from ..parallel_layers import HybridParallelOptimizer
+    hcg = _fleet_state["hcg"]
+    return HybridParallelOptimizer(optimizer, hcg, _fleet_state["strategy"])
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    """Reference: fleet/base/role_maker.py:526 — reads PADDLE_* env."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def is_first_worker(self):
+        return _env.get_rank() == 0

@@ -1,0 +1,121 @@
+"""Hybrid-parallel topology (reference: python/paddle/distributed/fleet/base/
+topology.py:52 CommunicateTopology, :134 HybridCommunicateGroup).
+
+TPU-native: the topology IS a jax.sharding.Mesh. The reference builds one
+NCCL process-group per axis-slice; here each axis is a mesh dimension and
+"groups" are the mesh axes themselves (collectives along an axis ride ICI).
+"""
+import numpy as np
+
+from .. import __name__ as _pkg  # noqa: F401
+from ... import env
+from ...collective import Group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world_size = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+
+# paddle axis name -> mesh axis name
+_AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
+             "sep": "sep"}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology):
+        self._topo = topology
+        dims = {_AXIS_MAP[n]: topology.get_dim(n)
+                for n in topology.get_hybrid_group_names()}
+        # build the global mesh in canonical order dp, pp, sharding, (sep,) mp
+        order = [a for a in env.HYBRID_AXES if a in dims]
+        mesh_dims = {a: dims[a] for a in order}
+        self.mesh = env.build_mesh(mesh_dims)
+        self._dp_degree = dims.get("dp", 1)
+        self._mp_degree = dims.get("mp", 1)
+        self._pp_degree = dims.get("pp", 1)
+        self._sharding_degree = dims.get("sharding", 1)
+        self._sep_degree = dims.get("sep", 1)
+
+    # ---- degree / rank queries (single-controller SPMD: logical rank 0) ----
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_global_rank(self):
+        return env.get_rank()
+
+    # ---- groups: mesh axes ----
+    def get_data_parallel_group(self):
+        return Group(axis_name="dp", mesh=self.mesh)
+
+    def get_model_parallel_group(self):
+        return Group(axis_name="mp", mesh=self.mesh)
+
+    def get_pipe_parallel_group(self):
+        return Group(axis_name="pp", mesh=self.mesh)
+
+    def get_sharding_parallel_group(self):
+        return Group(axis_name="sharding", mesh=self.mesh)
+
+    def get_sep_parallel_group(self):
+        return Group(axis_name="sep", mesh=self.mesh)
+
+    def get_check_parallel_group(self, *a):
+        return Group(axis_name=None, mesh=self.mesh)
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"

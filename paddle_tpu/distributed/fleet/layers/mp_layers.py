@@ -1,0 +1,203 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding:39, ColumnParallelLinear:155, RowParallelLinear:293,
+ParallelCrossEntropy:438) + mp_ops.py (_c_identity/_mp_allreduce).
+
+TPU-native dual mode:
+- GSPMD (default): parameters carry `split_axis` metadata; the fleet/jit
+  runner shards them over the 'mp' mesh axis with NamedSharding and XLA's
+  SPMD partitioner inserts the all-reduces — zero manual collectives, and
+  XLA overlaps them with compute (the reference needed c_identity/c_allreduce
+  pairs + comm streams).
+- Manual (inside shard_map, live 'mp' axis): forward emits jax.lax.psum /
+  all_gather explicitly, exactly mirroring the reference's op placement:
+  column: identity fwd / allreduce bwd; row: allreduce fwd.
+"""
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, apply_op
+from ....nn import functional as F
+from ....nn.initializer import XavierUniform
+from ....nn.layer.layers import Layer
+from ... import env
+
+
+def _mp_axis():
+    return env.current_axis_name("mp")
+
+
+def _mp_degree():
+    from .. import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg else 1
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dimension split over mp
+    (reference mp_layers.py:39: per-rank [start,end) rows, masked lookup +
+    allreduce)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        self.weight.split_axis = 0  # shard vocab rows over mp
+
+    def forward(self, x):
+        axis = _mp_axis()
+        if axis is None:
+            # GSPMD mode: plain lookup; partitioner handles the sharded gather
+            return F.embedding(x, self.weight)
+
+        def fn(ids, w):
+            n_shard = jax.lax.axis_index(axis)
+            per = w.shape[0]  # local rows
+            start = n_shard * per
+            ids_i = ids.astype(jnp.int32) - start
+            valid = (ids_i >= 0) & (ids_i < per)
+            local = jnp.take(w, jnp.clip(ids_i, 0, per - 1), axis=0)
+            local = jnp.where(valid[..., None], local, 0.0)
+            return jax.lax.psum(local, axis)
+        return apply_op(fn, x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features split over mp (reference mp_layers.py:155)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        self.weight.split_axis = 1
+        if has_bias is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias.is_distributed = True
+            self.bias.split_axis = 0
+
+    def forward(self, x):
+        axis = _mp_axis()
+        if axis is None:
+            return F.linear(x, self.weight, self.bias)
+
+        # manual: input replicated (identity fwd, psum bwd); output is the
+        # local shard; optionally gather
+        def fn(a, w, *b):
+            # identity fwd / psum bwd on the input == _c_identity
+            a = _c_identity_manual(a, axis)
+            out = a @ w
+            if b:
+                out = out + b[0]
+            if self.gather_output:
+                out = jax.lax.all_gather(out, axis, axis=out.ndim - 1, tiled=True)
+            return out
+        args = (x, self.weight) if self.bias is None else (x, self.weight, self.bias)
+        return apply_op(fn, *args)
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features split over mp (reference mp_layers.py:293)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        self.weight.split_axis = 0
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        axis = _mp_axis()
+        if axis is None:
+            return F.linear(x, self.weight, self.bias)
+
+        def fn(a, w, *b):
+            if not self.input_is_parallel:
+                # split the replicated input to this shard's columns
+                idx = jax.lax.axis_index(axis)
+                per = w.shape[0]
+                a = jax.lax.dynamic_slice_in_dim(a, idx * per, per, axis=a.ndim - 1)
+            out = a @ w
+            out = jax.lax.psum(out, axis)
+            if b:
+                out = out + b[0]
+            return out
+        args = (x, self.weight) if self.bias is None else (x, self.weight, self.bias)
+        return apply_op(fn, *args)
+
+
+def _c_identity_manual(a, axis):
+    """identity forward, psum backward (reference mp_ops.py _c_identity)."""
+    @jax.custom_vjp
+    def ident(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis),)
+
+    ident.defvjp(fwd, bwd)
+    return ident(a)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE (reference mp_layers.py:438 +
+    c_softmax_with_cross_entropy op): logits sharded on the class dim; the
+    softmax normalizer is psum'd so no rank ever materializes full logits."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        axis = _mp_axis()
+        if axis is None:
+            return F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+
+        def fn(logits, lab):
+            per = logits.shape[-1]
+            idx = jax.lax.axis_index(axis)
+            start = idx * per
+            # global max for stability
+            local_max = jnp.max(logits, axis=-1, keepdims=True)
+            gmax = jax.lax.pmax(local_max, axis)
+            shifted = logits - gmax
+            local_sum = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+            gsum = jax.lax.psum(local_sum, axis)
+            logz = jnp.log(gsum)
+            li = lab.astype(jnp.int32)
+            if li.ndim == logits.ndim:
+                li = li[..., 0]
+            local_ids = li - start
+            valid = (local_ids >= 0) & (local_ids < per)
+            picked = jnp.take_along_axis(
+                shifted, jnp.clip(local_ids, 0, per - 1)[..., None], axis=-1)[..., 0]
+            picked = jnp.where(valid, picked, 0.0)
+            picked = jax.lax.psum(picked, axis)
+            return (logz[..., 0] - picked)[..., None]
+        return apply_op(fn, input, label)

@@ -1,0 +1,210 @@
+"""paddle.distribution equivalent (reference: python/paddle/distribution)."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.random import next_key
+from ..core.tensor import Tensor, apply_op, to_tensor
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return apply_op(jnp.exp, self.log_prob(value))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        return Tensor(jax.random.normal(next_key(), shp) * self.scale + self.loc)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        def fn(v):
+            var = self.scale ** 2
+            return -((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply_op(fn, value)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _d(low)
+        self.high = _d(high)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        return Tensor(jax.random.uniform(next_key(), shp) * (self.high - self.low) + self.low)
+
+    def log_prob(self, value):
+        def fn(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return apply_op(fn, value)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _d(logits)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(next_key(), self.logits,
+                                             shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            return jnp.take_along_axis(logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return apply_op(fn, value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _d(probs)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.probs_.shape
+        return Tensor(jax.random.bernoulli(next_key(), self.probs_, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log(1 - p)
+        return apply_op(fn, value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _d(alpha)
+        self.beta = _d(beta)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+
+        def fn(v):
+            return (self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) \
+                - betaln(self.alpha, self.beta)
+        return apply_op(fn, value)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _d(concentration)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(next_key(), self.concentration, tuple(shape)))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        return Tensor(jax.random.gumbel(next_key(), shp) * self.scale + self.loc)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _d(rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.rate.shape
+        return Tensor(jax.random.exponential(next_key(), shp) / self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        return Tensor(jax.random.laplace(next_key(), shp) * self.scale + self.loc)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs_ = _d(probs)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.base = Normal(loc, scale)
+
+    def sample(self, shape=()):
+        return apply_op(jnp.exp, self.base.sample(shape))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _d(concentration)
+        self.rate = _d(rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+        return Tensor(jax.random.gamma(next_key(), self.concentration, shp) / self.rate)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _d(rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.rate.shape
+        return Tensor(jax.random.poisson(next_key(), self.rate, shp).astype(jnp.float32))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, axis=-1)
+        lq = jax.nn.log_softmax(q.logits, axis=-1)
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+    raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")

@@ -1,0 +1,74 @@
+"""paddle.fft equivalent (reference: python/paddle/fft.py) — jnp.fft backed."""
+import jax.numpy as jnp
+
+from .core.tensor import apply_op
+
+
+def _norm(norm):
+    return {"backward": "backward", "ortho": "ortho", "forward": "forward"}[norm or "backward"]
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=_norm(norm)), x)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))

@@ -1,0 +1,5 @@
+"""Framework utilities: save/load, flags (reference: python/paddle/framework)."""
+from . import io  # noqa: F401
+from . import flags  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401

@@ -1,0 +1,67 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:646,876).
+
+Serialization: pickle with tensors converted to numpy (paddle uses the same
+approach — pickled state_dict with core-serialized tensors). bfloat16 arrays
+round-trip via a (dtype-tag, uint16-view) encoding since numpy lacks bf16.
+"""
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container."""
+
+    def __init__(self, array):
+        if array.dtype == jnp.bfloat16:
+            self.dtype = "bfloat16"
+            self.data = np.asarray(array.astype(jnp.float32))
+        else:
+            self.dtype = str(np.dtype(array.dtype))
+            self.data = np.asarray(array)
+
+    def to_tensor(self):
+        if self.dtype == "bfloat16":
+            return Tensor(jnp.asarray(self.data).astype(jnp.bfloat16))
+        return Tensor(jnp.asarray(self.data))
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj._data)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode(v) for v in obj)
+    if isinstance(obj, jnp.ndarray) and not isinstance(obj, np.ndarray):
+        return _TensorPayload(obj)
+    return obj
+
+
+def _decode(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        t = obj.to_tensor()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _decode(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _decode(obj, return_numpy)

@@ -1,0 +1,7 @@
+"""paddle.hapi equivalent."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger, VisualDL,
+)
+from .model import Model  # noqa: F401
+from .model_summary import flops, summary  # noqa: F401

@@ -1,0 +1,300 @@
+"""paddle.Model high-level API.
+
+Reference: python/paddle/hapi/model.py (Model:914, fit:1573,
+DynamicGraphAdapter.train_batch:705). TPU-native: instead of the reference's
+dual dygraph/static adapters, there is ONE adapter that jit-compiles the full
+train step (forward + loss + backward + optimizer update) into a single XLA
+program — the "static graph" is free, and per-step python overhead is one
+dispatch. BN buffers and optimizer state are carried functionally.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _rng
+from ..core.tensor import Tensor
+from ..nn.layer.layers import functional_call, functional_state
+from .callbacks import CallbackList, ProgBarLogger
+from ..metric import Metric
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_fn = None
+        self._opt_state = None
+        self.stop_training = False
+
+    # ---------------------------------------------------------------- prep
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._train_step_fn = None
+        self._eval_fn = None
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        if callable(self._loss) and not hasattr(self._loss, "forward"):
+            loss = self._loss(*outs, *labs)
+        else:
+            loss = self._loss(outs[0], labs[0])
+        if isinstance(loss, (list, tuple)):
+            from ..tensor.math import add_n
+            loss = add_n([l.sum() for l in loss])
+        return loss
+
+    def _build_train_step(self):
+        network = self.network
+        optimizer = self._optimizer
+
+        def train_step(params, buffers, opt_state, lr, seed, inputs, labels):
+            def loss_fn(p):
+                with _rng.traced_rng(seed):
+                    outputs, new_buffers = functional_call(
+                        network, p, buffers,
+                        args=tuple(Tensor(i) for i in inputs), train=True)
+                loss = self._compute_loss(
+                    outputs, tuple(Tensor(l) for l in labels))
+                outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+                # aux must be raw arrays — Tensor wrappers would leak tracers
+                return loss._data, ([o._data for o in outs], new_buffers)
+
+            (loss, (raw_outs, new_buffers)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt_state = optimizer.apply_gradients_functional(
+                params, grads, opt_state, lr=lr)
+            return loss, new_params, new_buffers, new_opt_state, raw_outs
+
+        return jax.jit(train_step)
+
+    def _build_eval_step(self):
+        network = self.network
+
+        def eval_step(params, buffers, seed, inputs, labels):
+            with _rng.traced_rng(seed):
+                outputs, _ = functional_call(
+                    network, params, buffers,
+                    args=tuple(Tensor(i) for i in inputs), train=False)
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            loss = None
+            if self._loss is not None:
+                loss = self._compute_loss(outputs, tuple(Tensor(l) for l in labels))._data
+            return loss, [o._data for o in outs]
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------------------- batching
+    @staticmethod
+    def _split_batch(data):
+        if isinstance(data, (list, tuple)):
+            raws = [d._data if isinstance(d, Tensor) else jnp.asarray(np.asarray(d))
+                    for d in data]
+            if len(raws) >= 2:
+                return tuple(raws[:-1]), (raws[-1],)
+            return tuple(raws), ()
+        raw = data._data if isinstance(data, Tensor) else jnp.asarray(np.asarray(data))
+        return (raw,), ()
+
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        in_raw = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(np.asarray(i))
+                       for i in inputs)
+        lab_raw = tuple(l._data if isinstance(l, Tensor) else jnp.asarray(np.asarray(l))
+                        for l in (labels or ()))
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        params, buffers = functional_state(self.network)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.functional_state(params)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        seed = _rng.next_key()
+        loss, new_params, new_buffers, self._opt_state, outs = self._train_step_fn(
+            params, buffers, self._opt_state, lr, seed, in_raw, lab_raw)
+        self._write_back(new_params, new_buffers)
+        if isinstance(self._optimizer._lr, object) and hasattr(self._optimizer._lr, "step"):
+            pass  # schedulers step per epoch by callback; per-step via user
+        metrics_out = self._update_metrics(outs, lab_raw)
+        return [float(np.asarray(loss))], metrics_out
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        in_raw = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(np.asarray(i))
+                       for i in inputs)
+        lab_raw = tuple(l._data if isinstance(l, Tensor) else jnp.asarray(np.asarray(l))
+                        for l in (labels or ()))
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_step()
+        params, buffers = functional_state(self.network)
+        seed = _rng.next_key()
+        loss, outs = self._eval_fn(params, buffers, seed, in_raw, lab_raw)
+        metrics_out = self._update_metrics(outs, lab_raw)
+        return ([float(np.asarray(loss))] if loss is not None else []), metrics_out
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        outs = self.network(*[i if isinstance(i, Tensor) else Tensor(jnp.asarray(np.asarray(i)))
+                              for i in inputs])
+        self.network.train()
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [o.numpy() for o in outs]
+
+    def _write_back(self, new_params, new_buffers):
+        for n, p in self.network.named_parameters():
+            if n in new_params:
+                p._data = new_params[n]
+        for n, b in self.network.named_buffers():
+            if n in new_buffers:
+                b._data = new_buffers[n]
+
+    def _update_metrics(self, outs, labels):
+        results = []
+        for m in self._metrics:
+            pred = Tensor(outs[0])
+            lab = Tensor(labels[0]) if labels else None
+            r = m.compute(pred, lab)
+            r = m.update(r if isinstance(r, Tensor) else r)
+            results.append(r)
+        return results
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks = CallbackList(callbacks, model=self, verbose=verbose,
+                            metrics=["loss"] + [n for m in self._metrics
+                                                for n in (m.name() if isinstance(m.name(), list)
+                                                          else [m.name()])],
+                            epochs=epochs, steps=steps, log_freq=log_freq)
+        cbks.on_begin("train")
+        global_step = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, data in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, {})
+                ins, labs = self._unpack(data)
+                losses, metrics = self.train_batch(ins, labs)
+                logs = {"loss": losses[0], "step": step}
+                for m in self._metrics:
+                    names = m.name() if isinstance(m.name(), list) else [m.name()]
+                    acc = m.accumulate()
+                    accs = acc if isinstance(acc, list) else [acc]
+                    logs.update(dict(zip(names, accs)))
+                cbks.on_batch_end("train", step, logs)
+                global_step += 1
+                if num_iters is not None and global_step >= num_iters:
+                    break
+            if hasattr(self._optimizer, "_lr") and hasattr(self._optimizer._lr, "step"):
+                self._optimizer._lr.step()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=0)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for data in loader:
+            ins, labs = self._unpack(data)
+            l, _ = self.eval_batch(ins, labs)
+            if l:
+                losses.append(l[0])
+        out = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            acc = m.accumulate()
+            accs = acc if isinstance(acc, list) else [acc]
+            out.update(dict(zip(names, accs)))
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for data in loader:
+            ins, _ = self._unpack(data)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    @staticmethod
+    def _unpack(data):
+        if isinstance(data, (list, tuple)):
+            if len(data) >= 2:
+                return list(data[:-1]), [data[-1]]
+            return list(data), None
+        return [data], None
+
+    # ----------------------------------------------------------------- io
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        import os
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,3 @@
+"""paddle.incubate equivalent (reference: python/paddle/incubate)."""
+from . import autotune  # noqa: F401
+from . import nn  # noqa: F401

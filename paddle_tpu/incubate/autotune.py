@@ -1,0 +1,17 @@
+"""Kernel/dataloader autotune config (reference: python/paddle/incubate/autotune.py).
+
+On TPU, XLA's autotuning (latency-hiding scheduler, fusion) replaces the
+reference's runtime kernel autotune cache (phi/kernels/autotune). This module
+keeps the config surface and toggles the knobs we do own.
+"""
+_config = {"kernel": {"enable": True}, "dataloader": {"enable": False},
+           "layout": {"enable": False}}
+
+
+def set_config(config=None):
+    if config:
+        _config.update(config)
+
+
+def get_config():
+    return dict(_config)

@@ -1,0 +1,52 @@
+"""incubate.nn: Fused transformer layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py backed by
+fused_attention_op.cu / fused_feedforward_op.cu).
+
+On TPU the "fusion" is XLA + the Pallas flash-attention kernel, so these are
+thin aliases of the standard layers with identical signatures.
+"""
+from ...nn.layer.transformer import (
+    MultiHeadAttention as FusedMultiHeadAttention,
+    TransformerEncoderLayer as FusedTransformerEncoderLayer,
+)
+from ...nn.layer.common import Linear as _Linear
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-05,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None,
+                 ln2_scale_attr=None, ln2_bias_attr=None, name=None):
+        super().__init__()
+        from ...nn.layer.norm import LayerNorm
+        from ...nn.layer.common import Dropout
+        self.normalize_before = normalize_before
+        self.linear1 = _Linear(d_model, dim_feedforward, linear1_weight_attr,
+                               linear1_bias_attr)
+        self.linear2 = _Linear(dim_feedforward, d_model, linear2_weight_attr,
+                               linear2_bias_attr)
+        self.norm = LayerNorm(d_model, epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.act_dropout = Dropout(act_dropout_rate if act_dropout_rate is not None
+                                   else dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        out = self.linear2(self.act_dropout(self.activation(self.linear1(src))))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedLinear(_Linear):
+    """cublasLt fused_gemm_epilogue equivalent: XLA fuses bias+act into the
+    matmul automatically, so plain Linear already is the fused op."""
+    pass

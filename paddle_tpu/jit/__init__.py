@@ -1,0 +1,109 @@
+"""paddle.jit equivalent.
+
+Reference: @to_static AST-transform pipeline (fluid/dygraph/dygraph_to_static/
+program_translator.py:1001) compiling dygraph code to a ProgramDesc.
+TPU-native: @to_static wraps the function with jax.jit over the functionalized
+layer — the traced jaxpr/HLO *is* the static program, XLA is the executor.
+"""
+import functools
+
+import jax
+
+from ..core import random as _rng
+from ..core.tensor import Tensor, unwrap, wrap
+from ..nn.layer.layers import Layer, functional_call, functional_state
+
+
+class StaticFunction:
+    """A jit-compiled callable over a Layer method or free function."""
+
+    def __init__(self, fn, layer=None, input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return StaticFunction(self._fn, layer=instance, input_spec=self._input_spec)
+
+    def _build(self, train):
+        layer = self._layer
+
+        if layer is None:
+            @functools.partial(jax.jit)
+            def compiled(seed, *raw_args):
+                with _rng.traced_rng(seed):
+                    out = self._fn(*wrap(list(raw_args)))
+                return unwrap(out)
+            return compiled
+
+        @functools.partial(jax.jit)
+        def compiled(params, buffers, seed, *raw_args):
+            with _rng.traced_rng(seed):
+                out, new_buffers = functional_call(
+                    layer, params, buffers,
+                    args=tuple(Tensor(a) for a in raw_args),
+                    train=train, method=self._fn)
+            return unwrap(out), new_buffers
+        return compiled
+
+    def __call__(self, *args):
+        import jax.random as jrandom
+        raw = tuple(a._data if isinstance(a, Tensor) else a for a in args)
+        seed = _rng.next_key()
+        if self._layer is None:
+            key = ("free",)
+            if key not in self._cache:
+                self._cache[key] = self._build(True)
+            out = self._cache[key](seed, *raw)
+            return wrap(out) if not isinstance(out, (tuple, list)) else wrap(list(out))
+        train = self._layer.training
+        key = ("layer", train)
+        if key not in self._cache:
+            self._cache[key] = self._build(train)
+        params, buffers = functional_state(self._layer)
+        out, new_buffers = self._cache[key](params, buffers, seed, *raw)
+        # write back mutated buffers (BN running stats)
+        for n, b in self._layer.named_buffers():
+            if n in new_buffers:
+                b._data = new_buffers[n]
+        if isinstance(out, (tuple, list)):
+            return type(out)(Tensor(o) for o in out)
+        return Tensor(out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward.__func__
+                                        if hasattr(fn.forward, "__func__") else fn.forward,
+                                        layer=fn)
+            return fn
+        return StaticFunction(fn, input_spec=input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists params + config (AOT executable export is
+    handled by paddle_tpu.inference)."""
+    from ..framework.io import save as _save
+    _save(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **configs):
+    raise NotImplementedError(
+        "paddle_tpu.jit.load: load weights with paddle_tpu.load and rebuild "
+        "the Layer; AOT executables via paddle_tpu.inference")
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+class TracedLayer:
+    pass

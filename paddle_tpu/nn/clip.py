@@ -1,0 +1,107 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py, nn/clip.py).
+
+ClipGradByGlobalNorm matches the reference semantics: one global norm over
+all grads, scale applied uniformly. In hybrid-parallel runs the fleet
+optimizer substitutes a group-aware version (distributed/fleet/hybrid).
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+    def clip_tree(self, grads):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(g._data.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out
+
+    def clip_tree(self, grads):
+        import jax
+
+        def clip_one(g):
+            norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            return (g * scale).astype(g.dtype)
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            sq.append(jnp.sum(g._data.astype(jnp.float32) ** 2))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out
+
+    def clip_tree(self, grads):
+        """Functional form over a pytree of raw arrays (jit path)."""
+        import jax
+        leaves = jax.tree_util.tree_leaves(grads)
+        global_norm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p._grad_data is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad_data)) for p in params]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p._grad_data.astype(jnp.float32)),
+                                                norm_type)) for p in params),
+                          1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p._grad_data = (p._grad_data * scale).astype(p._grad_data.dtype)
+    return Tensor(total)

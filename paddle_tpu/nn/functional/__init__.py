@@ -1,0 +1,7 @@
+"""paddle.nn.functional equivalent (reference: python/paddle/nn/functional)."""
+from .activation import *  # noqa: F401,F403
+from .attention import scaled_dot_product_attention, sparse_attention  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403

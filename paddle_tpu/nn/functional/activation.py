@@ -1,0 +1,159 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All are single jnp expressions; XLA fuses them into adjacent matmuls on TPU,
+which is why the reference's fused activation kernels need no equivalent here.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply_op
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    return x._replace(relu(x))
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, x)
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, x)
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(jnp.dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op(fn, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(jnp.dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op(fn, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a - threshold,
+                                        jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), x)
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(lambda a: jnp.where(a * beta > threshold, a,
+                                        jnp.log1p(jnp.exp(beta * a)) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, x)
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            w_b = w.reshape(())
+        else:
+            ax = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ax] = w.size
+            w_b = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w_b)
+    return apply_op(fn, x, weight)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        c = a.shape[axis]
+        new_shape = list(a.shape)
+        new_shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return apply_op(fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.random import next_key
+    def fn(a):
+        g = jax.random.gumbel(next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            one_hot = (jnp.arange(y.shape[axis]) ==
+                       jnp.moveaxis(idx, axis, -1)).astype(y.dtype)
+            y_hard = jnp.moveaxis(one_hot, -1, axis)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply_op(fn, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a, 0.0), x)

@@ -1,0 +1,60 @@
+"""Attention functionals.
+
+The reference implements fused MHA as hand-written CUDA
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h). Here the
+hot path is a Pallas flash-attention kernel (paddle_tpu/ops/flash_attention.py)
+with a pure-XLA fallback; both are exposed through one functional.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+
+
+def _sdpa_ref(q, k, v, mask, dropout_key, dropout_p, causal, scale):
+    # q,k,v: (B, S, H, D) — paddle convention
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        S_q, S_k = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((S_q, S_k), dtype=bool), k=S_k - S_q)
+        s = jnp.where(cm, s, jnp.finfo(s.dtype).min)
+    if mask is not None:
+        s = s + mask
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(s.dtype)
+    if dropout_p > 0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1 - dropout_p), 0.0).astype(p.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(out, 1, 2)  # B,S,H,D
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """paddle.nn.functional.scaled_dot_product_attention — (B, S, H, D) layout."""
+    from ...core.random import next_key
+
+    D = query.shape[-1]
+    scale = 1.0 / (D ** 0.5)
+    dk = next_key() if (dropout_p > 0 and training) else None
+
+    use_flash = attn_mask is None and dropout_p == 0.0
+    if use_flash:
+        from ...ops.flash_attention import flash_attention_bshd
+        def fn(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=is_causal, scale=scale)
+        return apply_op(fn, query, key, value)
+
+    def fn(q, k, v, *m):
+        return _sdpa_ref(q, k, v, m[0] if m else None, dk,
+                         dropout_p if training else 0.0, is_causal, scale)
+    args = (query, key, value) if attn_mask is None else (query, key, value, attn_mask)
+    return apply_op(fn, *args)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
+    raise NotImplementedError(
+        "sparse_attention: use scaled_dot_product_attention or ring attention "
+        "(paddle_tpu.distributed.ring_attention) on TPU")

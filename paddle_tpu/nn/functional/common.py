@@ -1,0 +1,244 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, ...
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...core.random import next_key
+from ...core.tensor import Tensor, apply_op
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Paddle weight layout: (in_features, out_features)."""
+    def fn(a, w, *b):
+        out = jnp.matmul(a, w)
+        if b:
+            out = out + b[0]
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply_op(fn, *args)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return apply_op(fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(ids, w):
+        ids_i = ids.astype(jnp.int32)
+        out = jnp.take(w, ids_i, axis=0)
+        if padding_idx is not None:
+            pad = (ids_i == padding_idx)[..., None]
+            out = jnp.where(pad, jax.lax.stop_gradient(out), out)
+        return out
+    return apply_op(fn, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(y, *pd):
+        k = y.shape[-1]
+        smooth = pd[0] if pd else jnp.full((k,), 1.0 / k, y.dtype)
+        return (1 - epsilon) * y + epsilon * smooth
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return apply_op(fn, *args)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def fn(a):
+        p = list(pad)
+        if len(p) == 2 * a.ndim:
+            pairs = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle spatial spec is ordered innermost-first: [Wl,Wr,Ht,Hb,...]
+            spatial = len(p) // 2
+            sp_pairs = [(p[2 * i], p[2 * i + 1]) for i in range(spatial)][::-1]
+            if data_format.startswith("NC"):
+                pairs = [(0, 0), (0, 0)] + sp_pairs
+            else:
+                pairs = [(0, 0)] + sp_pairs + [(0, 0)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode=jmode, constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+    return apply_op(fn, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def fn(a):
+        cf = data_format.startswith("NC")
+        spatial_in = a.shape[2:] if cf else a.shape[1:-1]
+        if size is not None:
+            out_sp = tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                           for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial_in)
+            out_sp = tuple(int(s * f) for s, f in zip(spatial_in, sf))
+        if cf:
+            out_shape = a.shape[:2] + out_sp
+        else:
+            out_shape = (a.shape[0],) + out_sp + (a.shape[-1],)
+        method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+                  "trilinear": "trilinear", "bicubic": "cubic", "area": "linear"}[mode]
+        if method == "trilinear":
+            method = "linear"
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, C // (r * r), r, r, H, W)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H, W, r, r, C // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(N, H * r, W * r, C // (r * r))
+    return apply_op(fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, C, H // r, r, W // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H // r, r, W // r, r, C)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(N, H // r, W // r, C * r * r)
+    return apply_op(fn, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op(fn, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+    args = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return apply_op(fn, *args)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _pair
+
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def fn(a):
+        N, C, H, W = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        oh = (H + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (W + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = a_p[:, :, i * dl[0]:i * dl[0] + oh * st[0]:st[0],
+                            j * dl[1]:j * dl[1] + ow * st[1]:st[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # N, C, k*k, oh, ow
+        return out.reshape(N, C * ks[0] * ks[1], oh * ow)
+    return apply_op(fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    raise NotImplementedError("fold is not implemented yet")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def fn(lens):
+        m = maxlen if maxlen is not None else int(lens.max())
+        return (jnp.arange(m)[None, :] < lens[:, None]).astype(jnp.dtype(dtype))
+    return apply_op(fn, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(a):
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        fold_c = int(C * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold_c], jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+                                 v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = v[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(NT, C, H, W)
+    return apply_op(fn, x)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    raise NotImplementedError
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError

@@ -1,0 +1,266 @@
+"""Convolution + pooling functionals (reference:
+python/paddle/nn/functional/conv.py, pooling.py; kernels phi/kernels/gpudnn).
+
+On TPU, convs lower straight to XLA's conv HLO which tiles onto the MXU —
+no cuDNN-style algorithm selection or autotuning layer is needed.
+Default layout is NCHW for paddle parity; XLA relayouts internally.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import apply_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, spatial, kernel, stride, dilation):
+    """Normalise paddle padding spec to lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # NCHW-style 4d spec [[0,0],[0,0],[ph,ph],[pw,pw]]
+        return [tuple(p) for p in padding[-spatial:]]
+    return [(int(p), int(p)) for p in padding]
+
+
+def _conv_nd(x, w, bias, stride, padding, dilation, groups, spatial, data_format,
+             transposed=False, output_padding=0):
+    chars = "DHW"[-spatial:]
+    if data_format in (f"NC{chars}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + chars
+    else:
+        lhs_spec = "N" + chars + "C"
+    rhs_spec = "OI" + chars
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(w.shape), (lhs_spec, rhs_spec, out_spec))
+    strides = _pair(stride, spatial)
+    dils = _pair(dilation, spatial)
+    pad = _conv_padding(padding, spatial, tuple(w.shape[2:]), strides, dils)
+
+    def fn(a, wt, *b):
+        if not transposed:
+            out = jax.lax.conv_general_dilated(
+                a, wt, window_strides=strides, padding=pad,
+                rhs_dilation=dils, dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=None)
+        else:
+            # paddle Conv2DTranspose weight layout: (in, out/groups, kH, kW)
+            outpad = _pair(output_padding, spatial)
+            if isinstance(pad, str):
+                pads = [(0, 0)] * spatial if pad == "VALID" else None
+                if pads is None:
+                    raise ValueError("SAME padding unsupported for transpose conv")
+            else:
+                pads = pad
+            k = wt.shape[2:]
+            tpads = []
+            for i in range(spatial):
+                eff_k = (k[i] - 1) * dils[i] + 1
+                lo = eff_k - 1 - pads[i][0]
+                hi = eff_k - 1 - pads[i][1] + outpad[i]
+                tpads.append((lo, hi))
+            wt_t = jnp.swapaxes(wt, 0, 1)  # (out/g, in, ...)
+            wt_t = jnp.flip(wt_t, axis=tuple(range(2, 2 + spatial)))
+            if groups > 1:
+                # regroup: (in, out/g, ...) with in = g*in_g
+                in_ch = a.shape[1]
+                wt_g = wt.reshape((groups, in_ch // groups) + wt.shape[1:])
+                wt_g = jnp.swapaxes(wt_g, 1, 2)  # g, out/g, in/g, ...
+                wt_t = wt_g.reshape((wt.shape[1] * groups, in_ch // groups) + wt.shape[2:])
+                wt_t = jnp.flip(wt_t, axis=tuple(range(2, 2 + spatial)))
+            out = jax.lax.conv_general_dilated(
+                a, wt_t, window_strides=(1,) * spatial, padding=tpads,
+                lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn,
+                feature_group_count=groups)
+        if b:
+            ch_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            bshape = [1] * out.ndim
+            bshape[ch_axis] = -1
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = (x, w) if bias is None else (x, w, bias)
+    return apply_op(fn, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format, transposed=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format, transposed=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format, transposed=True, output_padding=output_padding)
+
+
+# ---------------------------------------------------------------- pooling
+
+
+def _pool_nd(x, kernel, stride, padding, spatial, reducer, init, ceil_mode=False,
+             data_format="NCHW", exclusive=True, is_avg=False):
+    ks = _pair(kernel, spatial)
+    st = _pair(stride if stride is not None else kernel, spatial)
+    pad = _conv_padding(padding, spatial, ks, st, (1,) * spatial)
+    channels_first = data_format.startswith("NC")
+    if channels_first:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else pad)
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)]
+    if isinstance(pad, str):
+        pads = pad
+
+    def fn(a):
+        if is_avg:
+            summed = jax.lax.reduce_window(a, 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0),
+                                           jax.lax.add, window, strides, pads)
+            if exclusive and (isinstance(pads, str) or any(p != (0, 0) for p in pads)):
+                ones = jnp.ones_like(a)
+                cnt = jax.lax.reduce_window(ones, 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0),
+                                            jax.lax.add, window, strides, pads)
+                return summed / cnt
+            return summed / float(np.prod(ks))
+        return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, pads)
+
+    return apply_op(fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
+                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    ceil_mode, "NCL")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    ceil_mode, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    ceil_mode, data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.add, lambda d: 0,
+                    ceil_mode, "NCL", exclusive, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.add, lambda d: 0,
+                    ceil_mode, data_format, exclusive, is_avg=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.add, lambda d: 0,
+                    ceil_mode, data_format, exclusive, is_avg=True)
+
+
+def _adaptive_regions(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+    return starts, ends
+
+
+def _adaptive_pool2d(x, output_size, mode):
+    out_hw = _pair(output_size, 2)
+
+    def fn(a):
+        H, W = a.shape[-2], a.shape[-1]
+        oh, ow = out_hw
+        if H % oh == 0 and W % ow == 0:
+            kh, kw = H // oh, W // ow
+            r = a.reshape(a.shape[:-2] + (oh, kh, ow, kw))
+            if mode == "avg":
+                return r.mean(axis=(-3, -1))
+            return r.max(axis=(-3, -1))
+        hs, he = _adaptive_regions(H, oh)
+        ws, we = _adaptive_regions(W, ow)
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                block = a[..., int(hs[i]):int(he[i]), int(ws[j]):int(we[j])]
+                red = block.mean(axis=(-2, -1)) if mode == "avg" else block.max(axis=(-2, -1))
+                cols.append(red)
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+    return apply_op(fn, x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool2d(x, output_size, "avg")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool2d(x, output_size, "max")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def fn(a):
+        L = a.shape[-1]
+        o = int(output_size)
+        if L % o == 0:
+            return a.reshape(a.shape[:-1] + (o, L // o)).mean(axis=-1)
+        ss, ee = _adaptive_regions(L, o)
+        return jnp.stack([a[..., int(s):int(e)].mean(axis=-1) for s, e in zip(ss, ee)], axis=-1)
+    return apply_op(fn, x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    def fn(a):
+        L = a.shape[-1]
+        o = int(output_size)
+        if L % o == 0:
+            return a.reshape(a.shape[:-1] + (o, L // o)).max(axis=-1)
+        ss, ee = _adaptive_regions(L, o)
+        return jnp.stack([a[..., int(s):int(e)].max(axis=-1) for s, e in zip(ss, ee)], axis=-1)
+    return apply_op(fn, x)

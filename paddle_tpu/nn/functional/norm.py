@@ -1,0 +1,181 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm mutates the running-stat tensors in place (as the reference's BN
+kernel does); under jit tracing the mutated values are tracers that the
+functionalization layer reads back as extra outputs (nn/layer/layers.py).
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+
+
+def _ch_axis(ndim, data_format):
+    return 1 if data_format.startswith("NC") else ndim - 1
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    axis = _ch_axis(x.ndim, data_format)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    def bshape(ndim):
+        s = [1] * ndim
+        s[axis] = -1
+        return s
+
+    if use_stats:
+        def fn(a, rm, rv, *wb):
+            mean = rm.reshape(bshape(a.ndim))
+            var = rv.reshape(bshape(a.ndim))
+            out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+            if len(wb) >= 1 and wb[0] is not None:
+                out = out * wb[0].reshape(bshape(a.ndim))
+            if len(wb) == 2 and wb[1] is not None:
+                out = out + wb[1].reshape(bshape(a.ndim))
+            return out
+        args = [x, running_mean, running_var]
+        if weight is not None:
+            args.append(weight)
+        if bias is not None:
+            args.append(bias)
+        return apply_op(fn, *args)
+
+    # training mode: compute batch stats, update running stats in place
+    def fn(a, *wb):
+        mean = jnp.mean(a, axis=reduce_axes)
+        var = jnp.var(a, axis=reduce_axes)
+        out = (a - mean.reshape(bshape(a.ndim))) * jax.lax.rsqrt(
+            var.reshape(bshape(a.ndim)) + epsilon)
+        if len(wb) >= 1 and wb[0] is not None:
+            out = out * wb[0].reshape(bshape(a.ndim))
+        if len(wb) == 2 and wb[1] is not None:
+            out = out + wb[1].reshape(bshape(a.ndim))
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    out = apply_op(fn, *args)
+
+    # running-stat update on raw arrays (no tape)
+    a = x._data
+    mean = jnp.mean(a, axis=reduce_axes)
+    var = jnp.var(a, axis=reduce_axes)
+    n = a.size // a.shape[axis]
+    unbiased_var = var * (n / max(n - 1, 1))
+    running_mean._data = running_mean._data * momentum + mean * (1 - momentum)
+    running_var._data = running_var._data * momentum + unbiased_var * (1 - momentum)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(-n_axes, 0))
+
+    def fn(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        if len(wb) >= 1 and wb[0] is not None:
+            out = out * wb[0]
+        if len(wb) == 2 and wb[1] is not None:
+            out = out + wb[1]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(a, *wb):
+        N = a.shape[0]
+        if data_format.startswith("NC"):
+            C = a.shape[1]
+            g = a.reshape((N, num_groups, C // num_groups) + a.shape[2:])
+            axes = tuple(range(2, g.ndim))
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            shape = [1, C] + [1] * (a.ndim - 2)
+        else:
+            C = a.shape[-1]
+            g = a.reshape(a.shape[:-1] + (num_groups, C // num_groups))
+            axes = tuple(range(1, a.ndim - 1)) + (a.ndim,)
+            mean = jnp.mean(g, axis=axes, keepdims=True)
+            var = jnp.var(g, axis=axes, keepdims=True)
+            out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+            shape = [1] * (a.ndim - 1) + [C]
+        if len(wb) >= 1 and wb[0] is not None:
+            out = out * wb[0].reshape(shape)
+        if len(wb) == 2 and wb[1] is not None:
+            out = out + wb[1].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def fn(a, *wb):
+        axes = tuple(range(2, a.ndim)) if data_format.startswith("NC") else \
+            tuple(range(1, a.ndim - 1))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        C = a.shape[1] if data_format.startswith("NC") else a.shape[-1]
+        shape = [1, C] + [1] * (a.ndim - 2) if data_format.startswith("NC") \
+            else [1] * (a.ndim - 1) + [C]
+        if len(wb) >= 1 and wb[0] is not None:
+            out = out * wb[0].reshape(shape)
+        if len(wb) == 2 and wb[1] is not None:
+            out = out + wb[1].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(fn, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        padded = jnp.pad(moved, [(0, 0)] * (a.ndim - 1) + [(half, size - half - 1)])
+        win = sum(padded[..., i:i + moved.shape[-1]] for i in range(size))
+        win = jnp.moveaxis(win, -1, ch_axis)
+        return a / jnp.power(k + alpha * win, beta)
+    return apply_op(fn, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        if p == 2:
+            nrm = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply_op(fn, x)

@@ -1,0 +1,174 @@
+"""Initializers (reference: python/paddle/nn/initializer, fluid/initializer.py).
+
+Each initializer is a callable (shape, dtype) -> jax array, drawing keys from
+the global generator so paddle_tpu.seed() makes init reproducible.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtype as _dt
+from ...core.random import next_key
+from ...core.tensor import Tensor
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+    def _compute_fans(self, shape):
+        if len(shape) == 0:
+            return 1, 1
+        if len(shape) == 1:
+            return shape[0], shape[0]
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        receptive = int(np.prod(shape[2:]))
+        return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype=_dt.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        d = _dt.convert_dtype(dtype)
+        return (jax.random.normal(next_key(), tuple(shape), jnp.float32) *
+                self.std + self.mean).astype(d)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        d = _dt.convert_dtype(dtype)
+        return (jax.random.truncated_normal(next_key(), -2.0, 2.0, tuple(shape),
+                                            jnp.float32) * self.std + self.mean).astype(d)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        d = _dt.convert_dtype(dtype)
+        return jax.random.uniform(next_key(), tuple(shape), jnp.float32,
+                                  self.low, self.high).astype(d)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype):
+        fi, fo = self._compute_fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype):
+        fi, fo = self._compute_fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = self._compute_fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        return Normal(0.0, std)(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype):
+        fi, _ = self._compute_fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        v = self.value._data if isinstance(self.value, Tensor) else jnp.asarray(np.asarray(self.value))
+        return v.reshape(tuple(shape)).astype(_dt.convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        d = _dt.convert_dtype(dtype)
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        mat = jax.random.normal(next_key(), (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(mat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.gain * q[:rows, :cols].reshape(shape)).astype(d)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        d = _dt.convert_dtype(dtype)
+        out = np.zeros(shape, dtype=np.float32)
+        out_ch, in_ch = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(out_ch // self.groups, in_ch)):
+                idx = (g * (out_ch // self.groups) + i, i) + tuple(centers)
+                out[idx] = 1.0
+        return jnp.asarray(out).astype(d)
+
+
+# paddle.nn.initializer module-level convenience
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+calculate_gain = lambda nonlinearity, param=None: {  # noqa: E731
+    "sigmoid": 1.0, "linear": 1.0, "conv2d": 1.0,
+    "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+    "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+    "selu": 3.0 / 4,
+}.get(nonlinearity, 1.0)

@@ -1,0 +1,383 @@
+"""Layer: the module base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py:85 (`Layer`). Same user
+surface (sublayers/parameters/buffers/state_dict/hooks), plus a TPU-native
+addition: `functional_state` / `functional_call`, which turn any Layer into a
+pure function over a params/buffers pytree so whole training steps can be
+jit-compiled into a single XLA program (the reference needed a separate
+static-graph engine + to_static AST transforms for this).
+"""
+from collections import OrderedDict
+
+import numpy as np
+
+from ...core import dtype as _dt
+from ...core.tensor import Parameter, Tensor
+from ..initializer import Constant, XavierUniform, Uniform
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = _dt.convert_dtype(dtype)
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ---------------------------------------------------------------- attrs
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning params")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            if params is not None:
+                params.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Tensor):
+            if buffers is not None:
+                buffers[name] = value
+                self._non_persistable_buffer_names_set.add(name)
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params.pop(name)
+                else:
+                    raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+            if layers is not None and name in layers and not isinstance(value, Layer):
+                layers.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+        if name in self.__dict__:
+            object.__delattr__(self, name)
+
+    # ------------------------------------------------------------- creation
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..param_attr import ParamAttr
+
+        dtype = _dt.convert_dtype(dtype) or self._dtype
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        elif is_bias:
+            init = Constant(0.0)
+        else:
+            init = XavierUniform()
+        data = init(tuple(shape), dtype)
+        p = Parameter(data, name=attr.name if attr else None,
+                      trainable=attr.trainable if attr else True)
+        if attr is not None:
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+            p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        object.__setattr__(self, str(name), sublayer) if str(name).isidentifier() else None
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if persistable:
+            self._non_persistable_buffer_names_set.discard(name)
+        else:
+            self._non_persistable_buffer_names_set.add(name)
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    # ------------------------------------------------------------ traversal
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None or id(l) in layers_set:
+                continue
+            layers_set.add(id(l))
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield sub_prefix, l
+            yield from l.named_sublayers(prefix=sub_prefix, layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + ("." if prefix else "") + name), p
+        if include_sublayers:
+            for lname, l in self.named_children():
+                sub_prefix = prefix + ("." if prefix else "") + lname
+                for n, p in l.named_parameters(prefix=sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, b in self._buffers.items():
+            if b is not None and id(b) not in seen:
+                seen.add(id(b))
+                yield (prefix + ("." if prefix else "") + name), b
+        if include_sublayers:
+            for lname, l in self.named_children():
+                sub_prefix = prefix + ("." if prefix else "") + lname
+                for n, b in l.named_buffers(prefix=sub_prefix):
+                    if id(b) not in seen:
+                        seen.add(id(b))
+                        yield n, b
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # ----------------------------------------------------------- mode/hooks
+    def train(self):
+        self.training = True
+        for l in self.children():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.children():
+            l.eval()
+        return self
+
+    def register_forward_pre_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle._hook_id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle._hook_id] = hook
+        return handle
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            mod_str = repr(l)
+            mod_str = "\n".join("  " + line for line in mod_str.split("\n"))
+            lines.append(f"  ({name}): " + mod_str.strip())
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    # ------------------------------------------------------------ state I/O
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix,
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        persist = self._persistable_buffer_names()
+        for name, b in self.named_buffers(prefix=structured_name_prefix,
+                                          include_sublayers=include_sublayers):
+            bare = name[len(structured_name_prefix):].lstrip(".") if structured_name_prefix else name
+            if bare in persist:
+                dest[name] = b
+        return dest
+
+    def _persistable_buffer_names(self):
+        names = set()
+        for prefix, l in self.named_sublayers(include_self=True):
+            for bname in l._buffers:
+                if bname not in l._non_persistable_buffer_names_set:
+                    names.add((prefix + "." if prefix else "") + bname)
+        return names
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        import jax.numpy as jnp
+        missing, unexpected = [], []
+        own = self.state_dict()
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                data = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(data.shape) != tuple(t._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: got {tuple(data.shape)}, "
+                        f"expected {tuple(t._data.shape)}")
+                t._data = data.astype(t._data.dtype)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # --------------------------------------------------------------- dtype
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(dtype)
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(dtype)
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def _cast_all(self, dtype):
+        d = _dt.convert_dtype(dtype)
+        for p in self.parameters():
+            if _dt.is_floating(p.dtype):
+                p._data = p._data.astype(d)
+        for b in self.buffers():
+            if _dt.is_floating(b.dtype):
+                b._data = b._data.astype(d)
+        self._dtype = d
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks):
+        self._hooks_ref = hooks
+        self._hook_id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks_ref.pop(self._hook_id, None)
+
+
+# ---------------------------------------------------------------------------
+# Functionalization bridge: Layer -> pure function over pytrees (jit path).
+# ---------------------------------------------------------------------------
+
+def functional_state(layer):
+    """Extract (params, buffers) dicts of raw jax arrays."""
+    params = {n: p._data for n, p in layer.named_parameters()}
+    buffers = {n: b._data for n, b in layer.named_buffers()}
+    return params, buffers
+
+
+def functional_call(layer, params, buffers, args=(), kwargs=None, train=None,
+                    method=None):
+    """Run layer.forward with the given raw arrays swapped in.
+
+    Returns (outputs, new_buffers). Mutations the forward makes to buffers
+    (e.g. BN running stats) are captured in new_buffers. Safe under jit
+    tracing: tracing happens once, single-threaded, and originals restored.
+    """
+    kwargs = kwargs or {}
+    param_objs = dict(layer.named_parameters())
+    buffer_objs = dict(layer.named_buffers())
+    saved = {n: t._data for n, t in {**param_objs, **buffer_objs}.items()}
+    prev_training = layer.training
+    try:
+        if train is not None:
+            layer.train() if train else layer.eval()
+        for n, t in param_objs.items():
+            t._data = params[n]
+        for n, t in buffer_objs.items():
+            if n in buffers:
+                t._data = buffers[n]
+        if method is None:
+            out = layer(*args, **kwargs)
+        else:
+            out = method(layer, *args, **kwargs) if not hasattr(method, "__self__") \
+                else method(*args, **kwargs)
+        new_buffers = {n: t._data for n, t in buffer_objs.items()}
+    finally:
+        for n, t in {**param_objs, **buffer_objs}.items():
+            t._data = saved[n]
+        if train is not None:
+            layer.train() if prev_training else layer.eval()
+    return out, new_buffers

@@ -1,0 +1,223 @@
+"""Norm layers (reference: python/paddle/nn/layer/norm.py).
+
+SyncBatchNorm: the reference allreduces batch stats over NCCL
+(paddle/fluid/operators/sync_batch_norm_op.cu). Here, when a data-parallel
+mesh axis is active (inside shard_map) it uses jax.lax.pmean over that axis;
+otherwise it degrades to local BatchNorm — same semantics as the reference
+on a single device.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                              is_bias=True)
+        from ...tensor.creation import zeros, ones
+        self.register_buffer("_mean", zeros((num_features,)), persistable=True)
+        self.register_buffer("_variance", ones((num_features,)), persistable=True)
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
+                            training=self.training, momentum=self._momentum,
+                            epsilon=self._epsilon, data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-style BatchNorm(num_channels) — acts like BatchNorm2D."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 param_attr=None, bias_attr=None, data_layout="NCHW",
+                 use_global_stats=False, **kw):
+        super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr,
+                         data_layout, use_global_stats or None)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN. Stats are pmean'd over the 'dp' mesh axis when one is
+    live (shard_map context); otherwise local (single-replica) stats."""
+
+    def forward(self, x):
+        from ...distributed.env import current_axis_name
+        axis = current_axis_name("dp")
+        if not self.training or axis is None:
+            return super().forward(x)
+
+        ch_axis = 1 if self._data_format.startswith("NC") else x.ndim - 1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        eps, momentum = self._epsilon, self._momentum
+        rm, rv = self._mean, self._variance
+
+        has_w = self.weight is not None
+        has_b = self.bias is not None
+
+        def fn(a, *wb):
+            mean = jax.lax.pmean(jnp.mean(a, axis=reduce_axes), axis)
+            mean_sq = jax.lax.pmean(jnp.mean(a * a, axis=reduce_axes), axis)
+            var = mean_sq - mean * mean
+            shape = [1] * a.ndim
+            shape[ch_axis] = -1
+            out = (a - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if has_b:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+
+        args = [x] + ([self.weight] if has_w else []) + ([self.bias] if has_b else [])
+        out, mean, var = apply_op(fn, *args)
+        rm._data = rm._data * momentum + mean._data * (1 - momentum)
+        rv._data = rv._data * momentum + var._data * (1 - momentum)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum, layer._epsilon,
+                                data_format=layer._data_format)
+            if layer.weight is not None:
+                out.weight._data = layer.weight._data
+            if layer.bias is not None:
+                out.bias._data = layer.bias._data
+            out._mean._data = layer._mean._data
+            out._variance._data = layer._variance._data
+        for name, sub in list(layer._sub_layers.items()):
+            new_sub = cls.convert_sync_batchnorm(sub)
+            if new_sub is not sub:
+                layer._sub_layers[name] = new_sub
+                object.__setattr__(layer, name, new_sub)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self._normalized_shape, attr=weight_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self._normalized_shape, attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_channels,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = None if weight_attr is False else self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+InstanceNorm1D = InstanceNorm2D
+InstanceNorm3D = InstanceNorm2D
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
+        super().__init__()
+        raise NotImplementedError("SpectralNorm layer is not implemented yet")

@@ -1,0 +1,231 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is jax.lax.scan (compiler-friendly static loop)
+instead of the reference's cuDNN RNN kernels / per-step dygraph loop.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ..initializer import Uniform
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    pass
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        bound = 1.0 / hidden_size ** 0.5
+        init = Uniform(-bound, bound)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+            states = zeros((inputs.shape[0], self.hidden_size), dtype=inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        out = apply_op(fn, inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        bound = 1.0 / hidden_size ** 0.5
+        init = Uniform(-bound, bound)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+            h = zeros((inputs.shape[0], self.hidden_size), dtype=inputs.dtype)
+            c = zeros((inputs.shape[0], self.hidden_size), dtype=inputs.dtype)
+        else:
+            h, c = states
+
+        def fn(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply_op(fn, inputs, h, c, self.weight_ih, self.weight_hh,
+                                self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        bound = 1.0 / hidden_size ** 0.5
+        init = Uniform(-bound, bound)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+            states = zeros((inputs.shape[0], self.hidden_size), dtype=inputs.dtype)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        out = apply_op(fn, inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence runner (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        seq_axis = 0 if self.time_major else 1
+        T = inputs.shape[seq_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs, states = [], initial_states
+        for t in steps:
+            x_t = inputs[t] if self.time_major else inputs[:, t]
+            o, states = self.cell(x_t, states)
+            outs.append(o)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+        return stack(outs, axis=seq_axis), states
+
+
+class _MultiLayerRNN(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.dropout = dropout
+        from .container import LayerList
+        cells, cells_bw = [], []
+        for i in range(num_layers):
+            in_size = input_size if i == 0 else hidden_size * (2 if self.bidirect else 1)
+            cells.append(self._make_cell(in_size, hidden_size, activation))
+            if self.bidirect:
+                cells_bw.append(self._make_cell(in_size, hidden_size, activation))
+        self.cells = LayerList(cells)
+        self.cells_bw = LayerList(cells_bw) if self.bidirect else None
+
+    def _make_cell(self, in_size, hidden, activation):
+        if self.MODE == "LSTM":
+            return LSTMCell(in_size, hidden)
+        if self.MODE == "GRU":
+            return GRUCell(in_size, hidden)
+        return SimpleRNNCell(in_size, hidden, activation)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat, stack
+        x = inputs
+        final_h, final_c = [], []
+        for i in range(self.num_layers):
+            runner = RNN(self.cells[i], time_major=self.time_major)
+            out_f, st_f = runner(x)
+            if self.bidirect:
+                runner_b = RNN(self.cells_bw[i], is_reverse=True,
+                               time_major=self.time_major)
+                out_b, st_b = runner_b(x)
+                x = concat([out_f, out_b], axis=-1)
+                sts = [st_f, st_b]
+            else:
+                x = out_f
+                sts = [st_f]
+            for st in sts:
+                if self.MODE == "LSTM":
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+        h = stack(final_h, axis=0)
+        if self.MODE == "LSTM":
+            c = stack(final_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN"
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "GRU"
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        out_f, st_f = self.rnn_fw(inputs)
+        out_b, st_b = self.rnn_bw(inputs)
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)

@@ -1,0 +1,2 @@
+"""Custom TPU kernels (Pallas) — the equivalent of the reference's
+paddle/fluid/operators/fused/ CUDA kernels."""

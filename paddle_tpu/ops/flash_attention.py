@@ -1,0 +1,58 @@
+"""Flash attention for TPU.
+
+Replaces the reference's fused attention CUDA ops
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) with a
+Pallas TPU kernel (blockwise online-softmax), falling back to a pure-XLA
+implementation on CPU or when shapes don't tile.
+
+Layout contract: (B, S, H, D) in / out ("BSHD", paddle's MHA layout).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _ref_attention_bhsd(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S_q, S_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S_q, S_k), dtype=bool), k=S_k - S_q)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _use_pallas(q):
+    if jax.default_backend() != "tpu":
+        return False
+    B, S, H, D = q.shape
+    return S % 128 == 0 and D in (64, 128, 256)
+
+
+def _pallas_flash_bhsd(q, k, v, causal, scale):
+    from .pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    """q,k,v: (B, S, H, D). Returns (B, S, H, D)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if _use_pallas(qt):
+        out = _pallas_flash_bhsd(qt, kt, vt, causal, scale)
+    else:
+        out = _ref_attention_bhsd(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, scale=None):
+    """q,k,v: (B, H, S, D) (GPT-internal layout)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if _use_pallas(q):
+        return _pallas_flash_bhsd(q, k, v, causal, scale)
+    return _ref_attention_bhsd(q, k, v, causal, scale)

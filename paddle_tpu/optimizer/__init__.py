@@ -1,0 +1,5 @@
+"""paddle.optimizer equivalent (reference: python/paddle/optimizer)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp, SGD,
+)

@@ -1,0 +1,378 @@
+"""Optimizer base + SGD/Momentum/Adam/AdamW/Lamb/...
+
+Reference: python/paddle/optimizer/optimizer.py:120 (accumulators,
+_append_optimize_op, clip hooks) + phi optimizer kernels
+(paddle/phi/kernels/gpu/adamw_kernel.cu etc.).
+
+TPU-native twist: each optimizer defines ONE pure `_update(param, grad,
+state, lr_t) -> (new_param, new_state)` rule. The eager `step()` applies it
+per-parameter; the jit path (hapi/fleet/bench) applies the same rule inside a
+compiled train step via `apply_gradients_functional`, so eager and compiled
+training are numerically identical.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (float, int)):
+            from ..regularizer import L2Decay
+            self._weight_decay = L2Decay(float(weight_decay))
+        else:
+            self._weight_decay = weight_decay
+        self._accumulators = {}   # param id -> dict of state arrays
+        self._step_count = 0
+        # name of the param currently being updated (set by step() /
+        # apply_gradients_functional; read by decay-exclusion rules)
+        self._current_param_name = None
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr.get_lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    def _lr_value(self):
+        return jnp.asarray(self.get_lr(), dtype=jnp.float32)
+
+    # --------------------------------------------------------------- state
+    def _state_for(self, p):
+        sid = id(p)
+        if sid not in self._accumulators:
+            self._accumulators[sid] = self._init_state(p._data)
+        return self._accumulators[sid]
+
+    def _init_state(self, param_data):
+        return {}
+
+    def _update(self, param, grad, state, lr_t):
+        raise NotImplementedError
+
+    # ---------------------------------------------------------------- step
+    @property
+    def _param_groups(self):
+        return self._parameters
+
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameters
+                        if not p.stop_gradient and p._grad_data is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr_t = self._lr_value()
+        self._step_count += 1
+        for i, (p, g) in enumerate(params_grads):
+            if g is None:
+                continue
+            gd = g._data if isinstance(g, Tensor) else g
+            gd = self._apply_decay(p, gd)
+            state = self._state_for(p)
+            self._current_param_name = p.name or f"param_{i}"
+            new_p, new_state = self._update(p._data, gd, state, lr_t)
+            p._data = new_p
+            self._accumulators[id(p)] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameters]
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def _apply_decay(self, p, gd):
+        """L2 regularizer folded into grads (non-decoupled; AdamW overrides)."""
+        reg = p.regularizer if getattr(p, "regularizer", None) is not None \
+            else self._weight_decay
+        if reg is None or self._decoupled_decay():
+            return gd
+        return gd + reg.coeff * p._data if hasattr(reg, "coeff") else gd
+
+    def _decoupled_decay(self):
+        return False
+
+    # ------------------------------------------------- functional(jit) path
+    def functional_state(self, params_dict):
+        """Init {name: state-dict} pytree for a {name: raw array} params dict."""
+        return {n: self._init_state(v) for n, v in params_dict.items()}
+
+    def apply_gradients_functional(self, params, grads, opt_state, lr=None,
+                                   step_count=None):
+        """Pure update over pytrees: used inside jit-compiled train steps.
+
+        params/grads: {name: array}; opt_state: {name: state}; returns
+        (new_params, new_opt_state). Grad clip + weight decay included.
+        """
+        lr_t = jnp.asarray(lr if lr is not None else self.get_lr(), jnp.float32)
+        if self._grad_clip is not None:
+            grads = self._grad_clip.clip_tree(grads)
+        new_params, new_state = {}, {}
+        for n, p in params.items():
+            g = grads[n]
+            if g is None:
+                new_params[n] = p
+                new_state[n] = opt_state[n]
+                continue
+            if self._weight_decay is not None and not self._decoupled_decay() \
+                    and hasattr(self._weight_decay, "coeff"):
+                g = g + self._weight_decay.coeff * p
+            st = dict(opt_state[n])
+            if step_count is not None and "step" in st:
+                st["step"] = step_count
+            self._current_param_name = n
+            np_, ns = self._update(p, g, st, lr_t)
+            new_params[n] = np_
+            new_state[n] = ns
+        return new_params, new_state
+
+    def state_dict(self):
+        out = {"step_count": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        for i, p in enumerate(self._parameters):
+            st = self._accumulators.get(id(p))
+            if st:
+                key = p.name or f"param_{i}"
+                out[key] = {k: Tensor(v) for k, v in st.items()}
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameters):
+            key = p.name or f"param_{i}"
+            if key in state_dict:
+                self._accumulators[id(p)] = {
+                    k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in state_dict[key].items()}
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, param, grad, state, lr_t):
+        return param - lr_t.astype(param.dtype) * grad.astype(param.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, param_data):
+        return {"velocity": jnp.zeros_like(param_data)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(param.dtype)
+        v = state["velocity"] * self._momentum + g
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        return param - lr_t.astype(param.dtype) * update, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _init_state(self, param_data):
+        return {"moment1": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        t = state["step"] + 1
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * (g * g)
+        mhat = m / (1 - self._beta1 ** t.astype(jnp.float32))
+        vhat = v / (1 - self._beta2 ** t.astype(jnp.float32))
+        upd = lr_t * mhat / (jnp.sqrt(vhat) + self._eps)
+        new_p = (param.astype(jnp.float32) - upd).astype(param.dtype)
+        return new_p, {"moment1": m, "moment2": v, "step": t}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else getattr(weight_decay, "coeff", 0.01)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_decay(self):
+        return True
+
+    def _update(self, param, grad, state, lr_t):
+        # decoupled weight decay (Loshchilov & Hutter), reference adamw kernel:
+        # paddle/phi/kernels/gpu/adamw_kernel.cu
+        decay = self._coeff
+        name = self._current_param_name
+        if self._apply_decay_param_fun is not None and name is not None \
+                and not self._apply_decay_param_fun(name):
+            decay = 0.0
+        p32 = param.astype(jnp.float32)
+        p32 = p32 * (1 - lr_t * decay)
+        g = grad.astype(jnp.float32)
+        t = state["step"] + 1
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * (g * g)
+        mhat = m / (1 - self._beta1 ** t.astype(jnp.float32))
+        vhat = v / (1 - self._beta2 ** t.astype(jnp.float32))
+        new_p = (p32 - lr_t * mhat / (jnp.sqrt(vhat) + self._eps)).astype(param.dtype)
+        return new_p, {"moment1": m, "moment2": v, "step": t}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, param_data):
+        return {"moment": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        t = state["step"] + 1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        lr_eff = lr_t / (1 - self._beta1 ** t.astype(jnp.float32))
+        new_p = (param.astype(jnp.float32) - lr_eff * m / (u + self._eps)).astype(param.dtype)
+        return new_p, {"moment": m, "inf_norm": u, "step": t}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, param_data):
+        st = {"mean_square": jnp.zeros_like(param_data, dtype=jnp.float32),
+              "momentum": jnp.zeros_like(param_data, dtype=jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(param_data, dtype=jnp.float32)
+        return st
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr_t * g / denom
+        new_p = (param.astype(jnp.float32) - mom).astype(param.dtype)
+        st = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            st["mean_grad"] = mg
+        return new_p, st
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_state(self, param_data):
+        return {"moment": jnp.full_like(param_data, self._init_val, dtype=jnp.float32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        mom = state["moment"] + g * g
+        new_p = (param.astype(jnp.float32) - lr_t * g / (jnp.sqrt(mom) + self._eps)
+                 ).astype(param.dtype)
+        return new_p, {"moment": mom}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, param_data):
+        return {"moment1": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        t = state["step"] + 1
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t.astype(jnp.float32))
+        vhat = v / (1 - self._beta2 ** t.astype(jnp.float32))
+        p32 = param.astype(jnp.float32)
+        decay = self._lamb_decay
+        if self._exclude_fn is not None and self._current_param_name is not None \
+                and self._exclude_fn(self._current_param_name):
+            decay = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + decay * p32
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = (p32 - lr_t * trust * r).astype(param.dtype)
+        return new_p, {"moment1": m, "moment2": v, "step": t}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, param_data):
+        return {"avg_squared_grad": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(param_data, dtype=jnp.float32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        new_p = (param.astype(jnp.float32) - lr_t * upd).astype(param.dtype)
+        return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}

@@ -1,0 +1,11 @@
+"""Regularizers (reference: python/paddle/regularizer.py)."""
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff

@@ -1,0 +1,62 @@
+"""paddle.sparse equivalent (reference: python/paddle/sparse + phi sparse
+kernels).
+
+TPU-native note: XLA has no native sparse tensor; COO here is a thin wrapper
+(indices, values, shape) with ops implemented via scatter/gather — adequate
+for sparse gradients and sparse nn. The reference's SparseCooTensor is
+paddle/phi/core/sparse_coo_tensor.h.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices_ = indices if isinstance(indices, Tensor) else Tensor(jnp.asarray(indices))
+        self.values_ = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+        self.shape = list(shape)
+
+    def indices(self):
+        return self.indices_
+
+    def values(self):
+        return self.values_
+
+    def to_dense(self):
+        out = jnp.zeros(tuple(self.shape), dtype=self.values_._data.dtype)
+        idx = tuple(self.indices_._data[i] for i in range(self.indices_._data.shape[0]))
+        return Tensor(out.at[idx].add(self.values_._data))
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_t = crows if isinstance(crows, Tensor) else Tensor(jnp.asarray(crows))
+    cols_t = cols if isinstance(cols, Tensor) else Tensor(jnp.asarray(cols))
+    values_t = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    # convert CSR -> COO rows
+    crows_np = np.asarray(crows_t._data)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = jnp.stack([jnp.asarray(rows), cols_t._data.astype(rows.dtype)])
+    return SparseCooTensor(Tensor(indices), values_t, shape)
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        return Tensor(jnp.matmul(x.to_dense()._data, y._data))
+    return Tensor(jnp.matmul(x._data, y._data))
+
+
+def add(x, y, name=None):
+    xd = x.to_dense()._data if isinstance(x, SparseCooTensor) else x._data
+    yd = y.to_dense()._data if isinstance(y, SparseCooTensor) else y._data
+    return Tensor(xd + yd)

@@ -1,0 +1,160 @@
+"""paddle.static equivalent — the declarative-graph surface.
+
+Reference: python/paddle/static (Program/Executor over ProgramDesc,
+framework.py:5223). TPU-native: a Program is a deferred trace — ops recorded
+by running the user's python under tracing, compiled by XLA at Executor.run.
+We keep the API (Program/program_guard/data/Executor) so static-style user
+code ports, but the "IR" is the jaxpr XLA sees, not a ProgramDesc.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor
+from ..jit import to_static  # noqa: F401
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = _dt.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    """A deferred computation: list of (fn, feeds, fetches) built under
+    program_guard by `data` placeholders + user ops."""
+
+    def __init__(self):
+        self._inputs = {}        # name -> InputSpec
+        self._build_fns = []     # callables executed at run time
+        self._fetch_builder = None
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack = []
+
+
+def default_main_program():
+    return _guard_stack[-1][0] if _guard_stack else _default_main
+
+
+def default_startup_program():
+    return _guard_stack[-1][1] if _guard_stack else _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _guard_stack.append((self.main, self.startup))
+        return self
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Static placeholder. In the TPU build, static programs are executed by
+    tracing the user fn with real inputs, so `data` returns a named spec
+    tensor filled with zeros (shape[0]=-1 -> 1 for the spec)."""
+    spec_shape = tuple(1 if (s is None or s < 0) else s for s in shape)
+    t = Tensor(jnp.zeros(spec_shape, dtype=_dt.convert_dtype(dtype)))
+    t.name = name
+    prog = default_main_program()
+    prog._inputs[name] = InputSpec(shape, dtype, name)
+    return t
+
+
+class Executor:
+    """paddle.static.Executor facade. `run` jit-executes the program's traced
+    function against the feed dict. For to_static-style usage, prefer
+    paddle_tpu.jit.to_static; this exists for API parity."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        outs = []
+        for f in (fetch_list or []):
+            if isinstance(f, Tensor):
+                outs.append(f.numpy() if return_numpy else f)
+            else:
+                outs.append(f)
+        return outs
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    """Reference: framework/details/build_strategy.h. XLA owns all of these
+    decisions now; kept for config-surface parity."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.enable_auto_fusion = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+        self.gradient_scale_strategy = None
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class WeightNormParamAttr:
+    pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.save / paddle_tpu.inference")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit / paddle_tpu.inference")
+
+
+# paddle.static.nn subset
+class nn:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        from ..nn import functional as F
+        from ..nn.initializer import XavierUniform
+        w = XavierUniform()((int(np.prod(x.shape[num_flatten_dims:])), size), x.dtype)
+        out = F.linear(x.reshape(list(x.shape[:num_flatten_dims]) + [-1]), Tensor(w))
+        if activation:
+            out = getattr(F, activation)(out)
+        return out

@@ -1,0 +1,46 @@
+"""paddle.tensor equivalent: the functional tensor-op surface.
+
+Mirrors python/paddle/tensor/* from the reference. Also monkey-patches the
+op set onto core.Tensor as methods, the same way the reference patches
+python ops onto the C tensor type (python/paddle/tensor/__init__.py).
+"""
+from ..core.tensor import Tensor
+from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+_METHOD_MODULES = [math, manipulation, linalg, logic, search, stat, creation]
+
+# names that must not become Tensor methods (creation ops, module helpers)
+_SKIP = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "to_tensor", "apply_op", "Tensor", "assign", "scatter_nd",
+    "builtins_sum", "sum_arrays", "jax_topk", "broadcast_shape", "is_tensor",
+}
+
+
+def _patch_tensor_methods():
+    for mod in _METHOD_MODULES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # a few paddle-specific aliases
+    Tensor.abs_ = Tensor.abs  # not truly inplace; acceptable alias
+
+
+_patch_tensor_methods()

@@ -1,0 +1,122 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor, to_tensor, apply_op  # noqa: F401
+
+
+def _d(dtype):
+    d = _dt.convert_dtype(dtype)
+    return d if d is not None else _dt.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(shape), dtype=_d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(tuple(shape), dtype=_d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(tuple(shape), fill_value, dtype=_d(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.zeros_like(a, dtype=_dt.convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.ones_like(a, dtype=_dt.convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(lambda a: jnp.full_like(a, fill_value, dtype=_dt.convert_dtype(dtype)), x)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange over Tensor bounds is not supported; pass numbers")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = _dt.int64
+        else:
+            dtype = _dt.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_d(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a), k=offset).astype(bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply_op(fn, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in tensors],
+                        indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = to_tensor(x) if not isinstance(x, Tensor) else x.clone()
+    if output is not None:
+        output._replace(src)
+        return output
+    return src
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op(lambda r, i: r + 1j * i, real, imag)
+
+
+def tolist(x):
+    return x.tolist()

@@ -1,0 +1,8 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — delegates to XLA."""
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+
+
+def einsum(equation, *operands):
+    return apply_op(lambda *xs: jnp.einsum(equation, *xs), *operands)

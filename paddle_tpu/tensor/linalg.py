@@ -1,0 +1,183 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py:240 matmul)."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _binop
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply_op(fn, x, y)
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def fn(a):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=keepdim),
+                         1.0 / p)
+    return apply_op(fn, x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=float(p) if p != 2 else 2, axis=None)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis if axis != 9 else next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op(fn, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply_op(fn, x)
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply_op(fn, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    import jax
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op(fn, x, y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply_op(fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply_op(fn, x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32)
+    return apply_op(fn, x)
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def fn(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return apply_op(fn, input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def fn(a, *w):
+        return jnp.bincount(a.reshape(-1).astype(jnp.int32),
+                            weights=w[0] if w else None,
+                            minlength=minlength)
+    args = (x, weights) if weights is not None else (x,)
+    return apply_op(fn, *args)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)

@@ -1,0 +1,82 @@
+"""Comparison / logic ops (reference: python/paddle/tensor/logic.py)."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, _binop
+
+
+def equal(x, y, name=None):
+    return _binop(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return _binop(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return _binop(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return _binop(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return _binop(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return _binop(jnp.less_equal, x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _binop(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _binop(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _binop(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op(jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _binop(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _binop(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _binop(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op(jnp.bitwise_not, x)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,306 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    return apply_op(lambda a: jnp.reshape(a, _shape_arg(shape)), x)
+
+
+def reshape_(x, shape, name=None):
+    return x._replace(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply_op(fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op(fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._data) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def fn(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply_op(fn, x)
+
+
+def transpose(x, perm=None, name=None):
+    return apply_op(lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    return apply_op(lambda a: a.T if a.ndim >= 2 else a, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *xs: jnp.stack(xs, axis=axis), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} along axis {axis} is not divisible "
+                f"by {num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s < 0 for s in sizes):
+            known = builtins_sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+    outs = []
+    for i in range(len(sizes)):
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        outs.append(apply_op(lambda a, lo=lo, hi=hi: jnp.take(a, jnp.arange(lo, hi), axis=axis), x))
+    return outs
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    return [squeeze(s, axis) for s in split(x, n, axis)]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    tgt = _shape_arg(shape)
+
+    def fn(a):
+        full = list(tgt)
+        src = list(a.shape)
+        # paddle: -1 keeps the original dim
+        src = [1] * (len(full) - len(src)) + src
+        for i, s in enumerate(full):
+            if s == -1:
+                full[i] = src[i]
+        return jnp.broadcast_to(a, tuple(full))
+    return apply_op(fn, x)
+
+
+def expand_as(x, y, name=None):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op(lambda a: jnp.broadcast_to(a, _shape_arg(shape)), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    tgt = jnp.broadcast_shapes(*shapes)
+    return [broadcast_to(t, tgt) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1).astype(jnp.int32), axis=axis),
+                    x, index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        idx = idx.astype(jnp.int32)
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op(fn, x, index)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                    arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                         for d in range(i.ndim))
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[full_idx].multiply(v)
+        return a.at[full_idx].set(v)
+    return apply_op(fn, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[i].set(u.astype(a.dtype))
+        zeroed = a.at[i].set(jnp.zeros_like(u, dtype=a.dtype))
+        return zeroed.at[i].add(u.astype(a.dtype))
+    return apply_op(fn, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, i, u):
+        i = i.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u.astype(a.dtype))
+    return apply_op(fn, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    return scatter_nd_add(zeros(shape, dtype=updates.dtype), index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    def fn(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i.astype(jnp.int32)]
+    return apply_op(fn, x, index)
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: computed on host (not jittable) — paddle parity.
+    data = np.asarray(x._data)
+    m = np.asarray(mask._data).astype(bool)
+    return Tensor(jnp.asarray(data[np.broadcast_to(m, data.shape)]))
+
+
+import builtins as _builtins  # noqa: E402
+
+
+def slice(input, axes, starts, ends, name=None):
+    def fn(a):
+        idx = [_builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s._data) if isinstance(s, Tensor) else int(s)
+            e = int(e._data) if isinstance(e, Tensor) else int(e)
+            idx[ax] = _builtins.slice(s, e)
+        return a[tuple(idx)]
+    return apply_op(fn, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        idx = [_builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = _builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply_op(fn, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    data = np.asarray(x._data)
+    res = np.unique(data, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    data = np.asarray(x._data).reshape(-1) if axis is None else np.asarray(x._data)
+    keep = np.ones(len(data), dtype=bool)
+    keep[1:] = data[1:] != data[:-1]
+    out = Tensor(jnp.asarray(data[keep]))
+    return out
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply_op(lambda a: jnp.repeat(a if axis is not None else a.reshape(-1),
+                                         r, axis=axis if axis is not None else 0), x)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: a[..., 0] + 1j * a[..., 1], x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(a):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        in_range = (a >= lo) & (a < hi)
+        return jnp.where(in_range, a - lo, ignore_value)
+    return apply_op(fn, input)

@@ -1,0 +1,309 @@
+"""Math ops (reference: python/paddle/tensor/math.py).
+
+Each fn is the eager counterpart of a PHI kernel family; here they are all
+jnp calls routed through apply_op so the tape sees them. Under jit the same
+code traces straight into XLA, where fusion happens automatically (the
+reference needed fused ops + graph passes for that).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core.tensor import Tensor, apply_op, _binop, to_tensor
+
+
+def _u(fn, name=None):
+    def op(x, *a, **kw):
+        kw.pop("name", None)
+        return apply_op(fn, x, **kw)
+    op.__name__ = name or getattr(fn, "__name__", "op")
+    return op
+
+
+exp = _u(jnp.exp)
+expm1 = _u(jnp.expm1)
+log = _u(jnp.log)
+log2 = _u(jnp.log2)
+log10 = _u(jnp.log10)
+log1p = _u(jnp.log1p)
+sqrt = _u(jnp.sqrt)
+rsqrt = _u(lambda x: jax.lax.rsqrt(x), "rsqrt")
+square = _u(jnp.square)
+sin = _u(jnp.sin)
+cos = _u(jnp.cos)
+tan = _u(jnp.tan)
+asin = _u(jnp.arcsin)
+acos = _u(jnp.arccos)
+atan = _u(jnp.arctan)
+sinh = _u(jnp.sinh)
+cosh = _u(jnp.cosh)
+tanh = _u(jnp.tanh)
+asinh = _u(jnp.arcsinh)
+acosh = _u(jnp.arccosh)
+atanh = _u(jnp.arctanh)
+abs = _u(jnp.abs)
+ceil = _u(jnp.ceil)
+floor = _u(jnp.floor)
+round = _u(jnp.round)
+trunc = _u(jnp.trunc)
+reciprocal = _u(jnp.reciprocal)
+sign = _u(jnp.sign)
+erf = _u(jax.scipy.special.erf, "erf")
+erfinv = _u(jax.scipy.special.erfinv, "erfinv")
+lgamma = _u(jax.scipy.special.gammaln, "lgamma")
+digamma = _u(jax.scipy.special.digamma, "digamma")
+neg = _u(jnp.negative)
+frac = _u(lambda x: x - jnp.trunc(x), "frac")
+
+
+def add(x, y, name=None):
+    return _binop(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binop(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binop(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _binop(jnp.divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _binop(jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return _binop(jnp.mod, x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return _binop(jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return _binop(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return _binop(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return _binop(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return _binop(jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return _binop(jnp.arctan2, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+    out = apply_op(fn, x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda a: jnp.sum(a, axis=_axis(axis), dtype=d, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda a: jnp.prod(a, axis=_axis(axis), dtype=d, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda a: jnp.cumsum(a if axis is not None else a.reshape(-1),
+                                         axis=axis, dtype=d), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda a: jnp.cumprod(a if dim is not None else a.reshape(-1),
+                                          axis=dim, dtype=d), x)
+
+
+def isnan(x, name=None):
+    return apply_op(jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return apply_op(jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return apply_op(jnp.isfinite, x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op(lambda *xs: sum_arrays(xs), *inputs)
+
+
+def sum_arrays(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        sel = idx.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(stacked.shape[1])]
+    return apply_op(fn, index, *inputs)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def kron(x, y, name=None):
+    return _binop(jnp.kron, x, y)
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return apply_op(lambda a: jnp.diff(a, n=n, axis=axis), x)
+
+
+def angle(x, name=None):
+    return apply_op(jnp.angle, x)
+
+
+def conj(x, name=None):
+    return apply_op(jnp.conj, x)
+
+
+def real(x, name=None):
+    return apply_op(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply_op(jnp.imag, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+def inner(x, y, name=None):
+    return _binop(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return _binop(jnp.outer, x, y)
+
+
+def heaviside(x, y, name=None):
+    return _binop(jnp.heaviside, x, y)
+
+
+def rad2deg(x, name=None):
+    return apply_op(jnp.rad2deg, x)
+
+
+def deg2rad(x, name=None):
+    return apply_op(jnp.deg2rad, x)
+
+
+def gcd(x, y, name=None):
+    return _binop(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return _binop(jnp.lcm, x, y)
+
+
+def take(x, index, mode="raise", name=None):
+    return apply_op(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1).astype(jnp.int32),
+                                          mode="clip" if mode != "wrap" else "wrap"),
+                    x, index)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim)
+                    .astype(jnp.int64), x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))

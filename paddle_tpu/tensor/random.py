@@ -1,0 +1,98 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+All sampling routes through core.random.next_key() so eager calls are
+reproducible after paddle_tpu.seed(n) and jit-traced calls pick up the
+traced key installed by the step runner (core/random.py traced_rng).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core.random import next_key
+from ..core.tensor import Tensor, apply_op
+
+
+def _d(dtype):
+    d = _dt.convert_dtype(dtype)
+    return d if d is not None else _dt.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_key(), tuple(shape), dtype=_d(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(next_key(), shp) * s + m)
+    shp = tuple(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(next_key(), shp, dtype=_dt.get_default_dtype()) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(next_key(), tuple(shape), dtype=_d(dtype),
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(next_key(), tuple(x._data.shape),
+                                 dtype=x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.convert_dtype(dtype) or _dt.int64
+    return Tensor(jax.random.randint(next_key(), tuple(shape), low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(_dt.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def sample(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(next_key(), logits, axis=-1,
+                                          shape=p.shape[:-1] + (num_samples,))
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    return Tensor(sample(x._data).astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(next_key(), x._data).astype(x._data.dtype))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(next_key(), x._data).astype(x._data.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = jax.random.exponential(next_key(), tuple(x._data.shape),
+                                     dtype=x._data.dtype) / lam
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = jax.random.normal(next_key(), tuple(x._data.shape),
+                                dtype=x._data.dtype) * std + mean
+    return x

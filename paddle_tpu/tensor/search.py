@@ -1,0 +1,132 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        out = jnp.argmax(a if axis is not None else a.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.dtype(dtype) if dtype else jnp.int64)
+    return apply_op(fn, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        out = jnp.argmin(a if axis is not None else a.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.dtype(dtype) if dtype else jnp.int64)
+    return apply_op(fn, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+    return apply_op(fn, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        out = jnp.sort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return apply_op(fn, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k._data)
+
+    def fn(a):
+        ax = axis if axis is not None else a.ndim - 1
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax_topk(moved, k, largest)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+    return apply_op(fn, x)
+
+
+def jax_topk(a, k, largest):
+    import jax
+    if largest:
+        v, i = jax.lax.top_k(a, k)
+    else:
+        v, i = jax.lax.top_k(-a, k)
+        v = -v
+    return v, i
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply_op(lambda c, a, b: jnp.where(c.astype(bool), a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    data = np.asarray(x._data)
+    nz = np.nonzero(data)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return apply_op(lambda a, m: jnp.where(m.astype(bool), jnp.asarray(v, a.dtype), a), x, mask)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+
+    def fn(a, v):
+        if accumulate:
+            return a.at[idx].add(v.astype(a.dtype))
+        return a.at[idx].set(v.astype(a.dtype))
+    return apply_op(fn, x, value)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(s, v):
+        out = jnp.searchsorted(s, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_op(fn, sorted_sequence, values)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        inds = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            inds = jnp.expand_dims(inds, axis)
+        return vals, inds
+    return apply_op(fn, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    data = np.asarray(x._data)
+    from scipy import stats  # available via numpy ecosystem; fallback manual
+    raise NotImplementedError("mode is not implemented")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.quantile(a, q, axis=axis, keepdims=keepdim), x)

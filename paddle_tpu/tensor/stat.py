@@ -1,0 +1,23 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+from .math import _axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim), x)

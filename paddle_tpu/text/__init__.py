@@ -1,0 +1,6 @@
+"""paddle.text equivalent (reference: python/paddle/text) — NLP datasets are
+download-based in the reference; zero-egress here, so synthetic LM data is
+provided for training/benchmarks and the model zoo lives in
+paddle_tpu.text.models (BERT/GPT/ERNIE)."""
+from . import models  # noqa: F401
+from .datasets import FakeTextDataset, LMDataset  # noqa: F401

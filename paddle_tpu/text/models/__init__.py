@@ -1,0 +1,3 @@
+"""NLP model zoo: GPT / BERT / ERNIE (TPU-native flagship models)."""
+from .gpt import GPT, GPTConfig, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b, gpt_6p7b  # noqa: F401
+from .bert import Bert, BertConfig  # noqa: F401

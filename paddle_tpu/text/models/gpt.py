@@ -1,0 +1,155 @@
+"""GPT model family — the flagship decoder LM.
+
+Reference capability: PaddleNLP-style GPT trained via fleet hybrid parallel
+(BASELINE.md GPT-3 1.3B/6.7B configs). TPU-native: pre-LN transformer with
+the Pallas flash-attention path (ops/flash_attention.py), TP-annotated
+parameters (split_axis) so the fleet/jit runner can shard over 'mp', and a
+single jit-compiled train step (see paddle_tpu.parallel.gpt_train).
+"""
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...nn import (Dropout, Embedding, GELU, Layer, LayerList, LayerNorm, Linear)
+from ...nn import functional as F
+from ...nn.initializer import Normal
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    max_position_embeddings: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = None
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    initializer_range: float = 0.02
+    tie_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = Normal(0.0, cfg.initializer_range)
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.qkv = Linear(h, 3 * h, weight_attr=init)
+        self.qkv.weight.split_axis = 1  # column-parallel over mp
+        self.qkv.bias.split_axis = 0
+        self.out_proj = Linear(h, h, weight_attr=init)
+        self.out_proj.weight.split_axis = 0  # row-parallel over mp
+        self.dropout = cfg.attention_dropout
+
+    def forward(self, x):
+        B, S, H = x.shape
+        qkv = self.qkv(x)  # B,S,3H
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # B,S,h,d
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.dropout, is_causal=True,
+            training=self.training)
+        out = out.reshape([B, S, H])
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, cfg.initializer_range)
+        self.fc1 = Linear(cfg.hidden_size, cfg.intermediate_size, weight_attr=init)
+        self.fc1.weight.split_axis = 1
+        self.fc1.bias.split_axis = 0
+        self.fc2 = Linear(cfg.intermediate_size, cfg.hidden_size, weight_attr=init)
+        self.fc2.weight.split_axis = 0
+        self.act = GELU(approximate=True)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPT(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = Normal(0.0, cfg.initializer_range)
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=init)
+        self.wte.weight.split_axis = 0  # vocab-parallel
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                             weight_attr=init)
+        self.drop = Dropout(cfg.hidden_dropout)
+        self.blocks = LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size)
+        if not cfg.tie_embeddings:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  weight_attr=init, bias_attr=False)
+
+    def forward(self, input_ids):
+        B, S = input_ids.shape
+        from ...tensor.creation import arange
+        pos = arange(0, S, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self.cfg.tie_embeddings:
+            logits = apply_op(lambda h, w: jnp.einsum("bsh,vh->bsv", h, w),
+                              x, self.wte.weight)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(logits.reshape([-1, self.cfg.vocab_size]),
+                               labels.reshape([-1]))
+
+    def num_params(self):
+        import numpy as np
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+def gpt_tiny(**kw):
+    return GPT(GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                         max_position_embeddings=256, vocab_size=1024, **kw))
+
+
+def gpt_125m(**kw):
+    return GPT(GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw))
+
+
+def gpt_350m(**kw):
+    return GPT(GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw))
+
+
+def gpt_1p3b(**kw):
+    return GPT(GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                         max_position_embeddings=2048, **kw))
+
+
+def gpt_6p7b(**kw):
+    return GPT(GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                         max_position_embeddings=2048, **kw))

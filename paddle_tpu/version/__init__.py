@@ -1,0 +1,12 @@
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+with_gpu = "OFF"
+with_tpu = "ON"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (TPU-native, JAX/XLA backend)")

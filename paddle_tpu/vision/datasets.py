@@ -1,0 +1,106 @@
+"""Vision datasets (reference: python/paddle/vision/datasets).
+
+Zero-egress environment: no downloads. Cifar10/MNIST load from a local file
+when present; FakeData provides deterministic synthetic samples for tests and
+smoke-training.
+"""
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image-classification data."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), dtype=np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Cifar10(Dataset):
+    """Reads the standard python-pickle CIFAR-10 archive from data_file."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        self.mode = mode
+        self.data = []
+        self.labels = []
+        candidates = [data_file,
+                      os.path.expanduser("~/.cache/paddle/dataset/cifar/cifar-10-python.tar.gz"),
+                      "/root/data/cifar-10-python.tar.gz"]
+        path = next((p for p in candidates if p and os.path.exists(p)), None)
+        if path is None:
+            raise FileNotFoundError(
+                "CIFAR-10 archive not found (no network in this environment); "
+                "pass data_file= or use paddle_tpu.vision.datasets.FakeData")
+        names = [f"cifar-10-batches-py/data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["cifar-10-batches-py/test_batch"]
+        with tarfile.open(path) as tf:
+            for n in names:
+                with tf.extractfile(n) as f:
+                    d = pickle.load(f, encoding="bytes")
+                self.data.append(d[b"data"])
+                self.labels.extend(d[b"labels"])
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        label = np.asarray(self.labels[idx], dtype=np.int64)
+        if self.transform:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("Cifar100 archive loader not wired; use Cifar10/FakeData")
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        import gzip
+        base = os.path.expanduser("~/.cache/paddle/dataset/mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                "MNIST files not found (no network); use FakeData for smoke tests")
+        with gzip.open(image_path, "rb") as f:
+            self.images = np.frombuffer(f.read(), np.uint8, offset=16).reshape(-1, 28, 28)
+        with gzip.open(label_path, "rb") as f:
+            self.labels = np.frombuffer(f.read(), np.uint8, offset=8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, np.asarray(self.labels[idx], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,29 @@
+"""Test env: 8 virtual CPU devices (SURVEY §4 — mirrors the reference's
+subprocess-faked multi-device topology with XLA's host-platform device count)."""
+import os
+
+# Force CPU with 8 virtual devices (the shell env points JAX at the real TPU
+# via JAX_PLATFORMS=axon; tests must not run there).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon sitecustomize pins the TPU backend regardless of JAX_PLATFORMS;
+# jax.config wins over it.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on CPU"
+assert jax.device_count() == 8, "tests expect 8 virtual CPU devices"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

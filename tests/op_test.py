@@ -1,0 +1,68 @@
+"""OpTest harness — the equivalent of the reference's
+python/paddle/fluid/tests/unittests/op_test.py:309.
+
+check_output: runs the op and compares against a numpy reference.
+check_grad: compares tape gradients against numeric finite differences
+(reference op_test.py:126 get_numeric_gradient / :1868 check_grad).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def numeric_grad(fn, tensors, wrt_index, out_reduce=None, delta=1e-3):
+    """Central-difference gradient of sum(fn(*tensors)) w.r.t. tensors[wrt_index]."""
+    base = [t.numpy().astype(np.float64) for t in tensors]
+
+    def eval_sum(arrays):
+        ts = [paddle.to_tensor(a.astype(np.float32)) for a in arrays]
+        out = fn(*ts)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        total = 0.0
+        for o in outs:
+            total += float(np.asarray(o.numpy(), dtype=np.float64).sum())
+        return total
+
+    x = base[wrt_index]
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = eval_sum(base)
+        flat[i] = orig - delta
+        fm = eval_sum(base)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * delta)
+    return grad
+
+
+def check_grad(fn, arrays, rtol=1e-2, atol=1e-3, delta=1e-3):
+    """Analytic (tape) grads vs finite differences for every float input."""
+    tensors = [paddle.to_tensor(a.astype(np.float32), stop_gradient=False)
+               for a in arrays]
+    out = fn(*tensors)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    total = outs[0].sum()
+    for o in outs[1:]:
+        total = total + o.sum()
+    total.backward()
+    for i, t in enumerate(tensors):
+        num = numeric_grad(fn, [paddle.to_tensor(a.astype(np.float32)) for a in arrays],
+                           i, delta=delta)
+        ana = t.grad.numpy().astype(np.float64)
+        np.testing.assert_allclose(ana, num, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")
+
+
+def check_output(fn, arrays, numpy_fn, rtol=1e-5, atol=1e-6):
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = fn(*tensors)
+    ref = numpy_fn(*arrays)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.numpy(), np.float64),
+                                   np.asarray(r, np.float64), rtol=rtol, atol=atol)

@@ -1,0 +1,144 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0], rtol=1e-6)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0] * 3, rtol=1e-6)
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_broadcast_grad(self):
+        check_grad(lambda a, b: a + b,
+                   [np.random.rand(3, 4), np.random.rand(4)])
+        check_grad(lambda a, b: a * b,
+                   [np.random.rand(2, 1, 4), np.random.rand(3, 1)])
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [np.random.rand(3, 4), np.random.rand(4, 2)])
+
+    def test_nonlinear_grads(self):
+        check_grad(paddle.tanh, [np.random.rand(3, 3) * 0.5])
+        check_grad(paddle.exp, [np.random.rand(3, 3) * 0.5])
+        check_grad(lambda x: F.softmax(x, -1), [np.random.randn(2, 5) * 0.5])
+        check_grad(lambda x: F.gelu(x), [np.random.randn(3, 3) * 0.5], rtol=2e-2)
+
+    def test_reduction_grads(self):
+        check_grad(lambda x: paddle.mean(x, axis=0), [np.random.rand(3, 4)])
+        check_grad(lambda x: paddle.sum(x * x, axis=1), [np.random.rand(3, 4)])
+
+    def test_indexing_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                             stop_gradient=False)
+        x[0].sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[1, 1, 1], [0, 0, 0]], rtol=1e-6)
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.ones(3, np.float32))  # stopped
+        (x * y).sum().backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = x.detach() * 3
+        assert z.stop_gradient
+
+    def test_no_grad(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            y = (x * 2).sum()
+        assert y._node is None
+
+    def test_multi_output_op(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        vals, idx = paddle.topk(x, 2, axis=1)
+        vals.sum().backward()
+        g = x.grad.numpy()
+        assert g.sum() == pytest.approx(6.0)
+
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        h = x * x          # used twice
+        y = (h + h).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0], rtol=1e-6)
+
+    def test_backward_nonscalar_with_grad(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor(np.full((2, 2), 2.0, np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 6.0), rtol=1e-6)
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0], rtol=1e-6)
+        # .grad untouched
+        assert x.grad is None
+
+
+class TestPyLayer:
+    def test_custom_fn(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0], rtol=1e-6)
+
+
+class TestFunctionalGrads:
+    def test_conv2d_grad(self):
+        check_grad(lambda x, w: F.conv2d(x, w, stride=1, padding=1),
+                   [np.random.rand(1, 2, 5, 5), np.random.rand(3, 2, 3, 3)],
+                   rtol=2e-2, atol=2e-3)
+
+    def test_layer_norm_grad(self):
+        check_grad(lambda x, w, b: F.layer_norm(x, 4, w, b),
+                   [np.random.rand(3, 4), np.random.rand(4), np.random.rand(4)],
+                   rtol=2e-2, atol=2e-3)
+
+    def test_cross_entropy_grad(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4], np.int64)
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        loss = F.cross_entropy(x, paddle.to_tensor(labels))
+        loss.backward()
+        # analytic: softmax - onehot, / N
+        import scipy.special
+        p = scipy.special.softmax(logits, axis=1)
+        onehot = np.eye(5)[labels]
+        np.testing.assert_allclose(x.grad.numpy(), (p - onehot) / 4,
+                                   rtol=1e-4, atol=1e-5)

@@ -1,0 +1,231 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestLinear:
+    def test_forward_shape_and_math(self):
+        layer = nn.Linear(4, 3)
+        x = t(np.random.rand(2, 4))
+        out = layer(x)
+        assert out.shape == [2, 3]
+        ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_no_bias(self):
+        layer = nn.Linear(4, 3, bias_attr=False)
+        assert layer.bias is None
+
+
+class TestConvPool:
+    def test_conv2d_shapes(self):
+        x = t(np.random.rand(2, 3, 8, 8))
+        assert nn.Conv2D(3, 6, 3)(x).shape == [2, 6, 6, 6]
+        assert nn.Conv2D(3, 6, 3, padding=1)(x).shape == [2, 6, 8, 8]
+        assert nn.Conv2D(3, 6, 3, stride=2, padding=1)(x).shape == [2, 6, 4, 4]
+        assert nn.Conv2D(3, 6, 3, groups=3, padding=1)(x).shape == [2, 6, 8, 8]
+
+    def test_conv2d_matches_manual(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        w = np.random.rand(1, 1, 3, 3).astype(np.float32)
+        out = F.conv2d(t(x), t(w))
+        ref = np.zeros((1, 1, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                ref[0, 0, i, j] = (x[0, 0, i:i+3, j:j+3] * w[0, 0]).sum()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_conv_transpose(self):
+        x = t(np.random.rand(2, 4, 5, 5))
+        out = nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1, output_padding=1)(x)
+        assert out.shape == [2, 3, 10, 10]
+
+    def test_pools(self):
+        x = t(np.random.rand(2, 3, 8, 8))
+        assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy()[..., 0, 0],
+            x.numpy().mean((2, 3)), rtol=1e-5)
+
+    def test_maxpool_matches_numpy(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        out = F.max_pool2d(t(x), 2, 2).numpy()
+        ref = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+class TestNorm:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.rand(4, 3, 5, 5) * 2 + 1)
+        bn.train()
+        out = bn(x)
+        np.testing.assert_allclose(out.numpy().mean((0, 2, 3)), np.zeros(3),
+                                   atol=1e-5)
+        np.testing.assert_allclose(out.numpy().std((0, 2, 3)), np.ones(3),
+                                   atol=1e-3)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = t(np.random.rand(2, 4, 8) * 3)
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros((2, 4)), atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), np.ones((2, 4)), atol=1e-2)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = t(np.random.rand(2, 4, 3, 3))
+        assert gn(x).shape == [2, 4, 3, 3]
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1],
+                                   rtol=1e-6)
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = t(np.ones((100, 100)))
+        d.train()
+        out = d(x).numpy()
+        frac = (out == 0).mean()
+        assert 0.3 < frac < 0.7
+        # upscale_in_train preserves expectation
+        assert abs(out.mean() - 1.0) < 0.1
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+class TestActivationsLosses:
+    def test_activations(self):
+        x = t(np.array([-1.0, 0.0, 2.0]))
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2], rtol=1e-6)
+        np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                                   1 / (1 + np.exp([1.0, 0.0, -2.0])), rtol=1e-5)
+        s = F.softmax(t(np.random.rand(3, 5))).numpy()
+        np.testing.assert_allclose(s.sum(-1), np.ones(3), rtol=1e-5)
+
+    def test_cross_entropy_loss(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 1, 2, 3], np.int64)
+        loss = nn.CrossEntropyLoss()(t(logits), paddle.to_tensor(labels))
+        import scipy.special
+        logp = scipy.special.log_softmax(logits, axis=1)
+        ref = -logp[np.arange(4), labels].mean()
+        assert float(loss) == pytest.approx(ref, rel=1e-4)
+
+    def test_mse_bce(self):
+        a, b = np.random.rand(3, 4), np.random.rand(3, 4)
+        assert float(nn.MSELoss()(t(a), t(b))) == pytest.approx(
+            ((a - b) ** 2).mean(), rel=1e-4)
+        p = np.clip(np.random.rand(8), 0.01, 0.99)
+        y = (np.random.rand(8) > 0.5).astype(np.float32)
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        assert float(nn.BCELoss()(t(p), t(y))) == pytest.approx(ref, rel=1e-3)
+
+
+class TestContainersState:
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = t(np.random.rand(3, 4))
+        assert seq(x).shape == [3, 2]
+        assert len(seq) == 3
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+
+    def test_named_parameters_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.bn = nn.BatchNorm1D(8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.bn(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc1.weight" in names and "bn.weight" in names
+        sd = net.state_dict()
+        assert "bn._mean" in sd  # persistable buffer
+        net2 = Net()
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net2.fc1.weight.numpy(),
+                                      net.fc1.weight.numpy())
+
+    def test_train_eval_propagation(self):
+        seq = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        seq.eval()
+        assert not seq[1].training
+        seq.train()
+        assert seq[1].training
+
+    def test_save_load_roundtrip(self, tmp_path):
+        net = nn.Linear(3, 3)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(loaded)
+        np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 5, 16))
+        assert mha(x, x, x).shape == [2, 5, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.rand(2, 5, 16))
+        assert enc(x).shape == [2, 5, 16]
+
+    def test_sdpa_causal(self):
+        q = np.random.rand(1, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True)
+        assert out.shape == [1, 4, 2, 8]
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(out.numpy()[0, 0], q[0, 0], rtol=1e-4)
+
+
+class TestRNN:
+    def test_lstm_gru(self):
+        lstm = nn.LSTM(4, 8, num_layers=1)
+        x = t(np.random.rand(2, 5, 4))
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [1, 2, 8]
+        gru = nn.GRU(4, 8)
+        out, h = gru(x)
+        assert out.shape == [2, 5, 8]
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        p = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+        p.grad = paddle.to_tensor(np.full(4, 10.0, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p, p.grad)])
+        norm = np.linalg.norm(out[0][1].numpy())
+        assert norm == pytest.approx(1.0, rel=1e-4)

@@ -1,0 +1,195 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert (paddle.full([2, 2], 7).numpy() == 7).all()
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        x = paddle.to_tensor(np.ones((3, 3), np.float32))
+        assert paddle.tril(x).numpy().sum() == 6
+        assert paddle.triu(x, 1).numpy().sum() == 3
+
+    def test_to_tensor_dtypes(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert "int" in str(t.dtype)
+        t = paddle.to_tensor([1.0, 2.0], dtype="bfloat16")
+        assert t.dtype == paddle.bfloat16
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_output(paddle.add, [a, b], np.add)
+        check_output(paddle.subtract, [a, b], np.subtract)
+        check_output(paddle.multiply, [a, b], np.multiply)
+        check_output(paddle.divide, [a, b], np.divide, rtol=1e-5)
+        check_output(paddle.maximum, [a, b], np.maximum)
+
+    def test_scalar_broadcast(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        assert ((x + 1).numpy() == 2).all()
+        assert ((2 * x).numpy() == 2).all()
+        assert ((1 - x).numpy() == 0).all()
+        assert ((x / 2).numpy() == 0.5).all()
+
+    def test_reductions(self):
+        a = np.random.rand(3, 4, 5).astype(np.float32)
+        check_output(lambda x: paddle.sum(x, axis=1), [a], lambda x: x.sum(1))
+        check_output(lambda x: paddle.mean(x, axis=[0, 2]), [a],
+                     lambda x: x.mean((0, 2)))
+        check_output(lambda x: paddle.max(x, axis=-1, keepdim=True), [a],
+                     lambda x: x.max(-1, keepdims=True))
+        check_output(paddle.prod, [a], np.prod, rtol=1e-4)
+
+    def test_unary(self):
+        a = np.random.rand(4, 4).astype(np.float32) + 0.1
+        check_output(paddle.exp, [a], np.exp)
+        check_output(paddle.log, [a], np.log)
+        check_output(paddle.sqrt, [a], np.sqrt)
+        check_output(paddle.tanh, [a], np.tanh)
+        check_output(paddle.abs, [a - 0.5], np.abs)
+
+    def test_clip_cumsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        check_output(lambda x: paddle.clip(x, -0.5, 0.5), [a],
+                     lambda x: np.clip(x, -0.5, 0.5))
+        check_output(lambda x: paddle.cumsum(x, axis=1), [a],
+                     lambda x: np.cumsum(x, 1), rtol=1e-5)
+
+    def test_logsumexp(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as ref_lse
+        check_output(lambda x: paddle.logsumexp(x, axis=1), [a],
+                     lambda x: ref_lse(x, axis=1), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        check_output(lambda x: paddle.reshape(x, [3, 8]), [a],
+                     lambda x: x.reshape(3, 8))
+        check_output(lambda x: paddle.transpose(x, [2, 0, 1]), [a],
+                     lambda x: x.transpose(2, 0, 1))
+        check_output(lambda x: paddle.flatten(x, 1), [a],
+                     lambda x: x.reshape(2, 12))
+
+    def test_concat_stack_split(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b]), rtol=1e-6)
+        out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        assert out.shape == [2, 2, 3]
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2], np.int64)
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_array_equal(out.numpy(), x[[0, 2]])
+        u = np.ones((2, 3), np.float32) * 9
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(u))
+        assert (out.numpy()[[0, 2]] == 9).all()
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = np.random.rand(2, 1, 3).astype(np.float32)
+        assert paddle.squeeze(paddle.to_tensor(a), 1).shape == [2, 3]
+        assert paddle.unsqueeze(paddle.to_tensor(a), 0).shape == [1, 2, 1, 3]
+        assert paddle.tile(paddle.to_tensor(a), [2, 1, 1]).shape == [4, 1, 3]
+
+    def test_indexing(self):
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+        assert x[0].shape == [3, 4]
+        assert x[:, 1].shape == [2, 4]
+        assert x[0, 1, 2].item() == 6.0
+        assert x[..., -1].shape == [2, 3]
+        x[0, 0, 0] = 99.0
+        assert x[0, 0, 0].item() == 99.0
+
+    def test_where_topk_sort(self):
+        a = np.random.randn(3, 5).astype(np.float32)
+        vals, idx = paddle.topk(paddle.to_tensor(a), k=2, axis=1)
+        ref = np.sort(a, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        out = paddle.where(paddle.to_tensor(a > 0),
+                           paddle.to_tensor(a), paddle.to_tensor(-a))
+        np.testing.assert_allclose(out.numpy(), np.abs(a), rtol=1e-6)
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        check_output(paddle.matmul, [a, b], np.matmul, rtol=1e-5)
+        check_output(lambda x, y: paddle.matmul(x, y, transpose_y=True),
+                     [a, np.random.rand(5, 4).astype(np.float32)],
+                     lambda x, y: x @ y.T, rtol=1e-5)
+
+    def test_einsum_norm(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        check_output(lambda x: paddle.einsum("ij->ji", x), [a], lambda x: x.T)
+        check_output(lambda x: paddle.norm(x), [a],
+                     lambda x: np.sqrt((x ** 2).sum()), rtol=1e-5)
+
+    def test_svd_solve(self):
+        a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 2
+        b = np.random.rand(4, 2).astype(np.float32)
+        x = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(a @ x.numpy(), b, rtol=1e-3, atol=1e-4)
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        b = paddle.to_tensor(np.array([2.0, 2.0, 2.0], np.float32))
+        assert (a < b).numpy().tolist() == [True, False, False]
+        assert (a == b).numpy().tolist() == [False, True, False]
+        assert paddle.logical_and(a > 1, b > 1).numpy().tolist() == [False, True, True]
+
+    def test_argmax_nonzero(self):
+        a = np.array([[0, 3, 1], [5, 0, 2]], np.float32)
+        assert paddle.argmax(paddle.to_tensor(a), axis=1).numpy().tolist() == [1, 0]
+        nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+        assert nz.numpy().tolist() == [[1], [3]]
+
+
+class TestRandom:
+    def test_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 3]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([3, 3]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=2.0, max=3.0).numpy()
+        assert u.min() >= 2.0 and u.max() <= 3.0
+        r = paddle.randint(0, 5, [50]).numpy()
+        assert r.min() >= 0 and r.max() < 5
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestDtypeCast:
+    def test_astype(self):
+        a = paddle.to_tensor(np.array([1.7, 2.3], np.float32))
+        assert a.astype("int32").numpy().tolist() == [1, 2]
+        assert a.astype(paddle.bfloat16).dtype == paddle.bfloat16
