@@ -1,0 +1,72 @@
+"""Benchmark: flagship GPT training throughput on one TPU chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.40 (A100-class MFU target from BASELINE.md).
+
+The whole train step (fwd+bwd+AdamW) is one jit-compiled XLA program in
+bfloat16; eager/per-op dispatch never touches the TPU (remote per-op compile
+through the axon tunnel is pathologically slow — see .claude/skills/verify).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+
+    # GPT-350M-class: fits one v5e chip (16GB) with AdamW f32 states + remat
+    cfg = GPTSpmdConfig(
+        vocab_size=50304, max_seq_len=1024, hidden=1024, layers=24, heads=16,
+        param_dtype="bfloat16" if on_tpu else "float32",
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        remat=True)
+    B, S = (8, 1024) if on_tpu else (2, 128)
+
+    plan = MeshPlan()
+    step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=2e-4)
+    params, state = init_fn(jax.random.key(0))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    labs = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    lr = jnp.float32(2e-4)
+
+    # warmup/compile
+    loss, params, state = step_fn(params, state, toks, labs, lr)
+    jax.block_until_ready(loss)
+
+    n_steps = 10 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss, params, state = step_fn(params, state, toks, labs, lr)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * n_steps / dt
+    flops_per_token = 6 * n_params  # standard fwd+bwd estimate (ex-remat)
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; nominal for CPU
+    mfu = achieved_flops / peak
+
+    print(json.dumps({
+        "metric": "gpt350m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "params": n_params,
+                  "backend": backend, "step_ms": round(1000 * dt / n_steps, 1),
+                  "loss": float(loss)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -184,8 +184,22 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=No
         src = concat(list(src), axis=0)
     if ax is None:
         return src
-    return apply_op(lambda x: jax.lax.psum_scatter(x, ax, scattered_dim=0, tiled=True),
-                    src)
+    if op == ReduceOp.SUM:
+        return apply_op(
+            lambda x: jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True),
+            src)
+
+    # non-SUM: gather + elementwise reduce + take the local slice
+    red = {ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+           ReduceOp.AVG: jnp.mean}[op]
+
+    def fn(x):
+        full = red(jax.lax.all_gather(x, ax, axis=0), axis=0)
+        n = jax.lax.axis_size(ax)
+        per = full.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(
+            full, jax.lax.axis_index(ax) * per, per, 0)
+    return apply_op(fn, src)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):

@@ -1,0 +1,85 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+NET-NEW vs the reference (SURVEY §2.10: sequence parallelism is ABSENT in
+ShawnNew/Paddle; its long-sequence support stops at fused MHA + TP head
+splitting). Design: blockwise attention with online-softmax accumulation
+(RingAttention, Liu et al. 2023); K/V blocks rotate around the 'sp' mesh
+axis via jax.lax.ppermute (ICI neighbor exchange), so each device only ever
+holds S/sp keys — sequence length scales linearly with the mesh axis.
+
+Call INSIDE shard_map with q/k/v already sequence-sharded:
+    q, k, v: (B, H, S_local, D) on each device; axis_name: the sp mesh axis.
+Causality uses global positions: shard i owns rows [i*S_local, (i+1)*S_local).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _block_attend(q, k, v, m, l, o, row_off, col_off, causal, scale):
+    """One (q-block x kv-block) step of online softmax, f32 accumulators.
+
+    q: (B,H,Sq,D); k,v: (B,H,Sk,D); m,l: (B,H,Sq); o: (B,H,Sq,D).
+    row_off/col_off: global offsets of the q rows / kv cols (traced scalars).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        rows = row_off + jnp.arange(q.shape[2])[:, None]
+        cols = col_off + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(rows >= cols, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (m_new == -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+    """Blockwise ring attention over `axis_name` (manual/shard_map context)."""
+    B, H, S_loc, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    m0 = jnp.full((B, H, S_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S_loc), jnp.float32)
+    o0 = jnp.zeros((B, H, S_loc, D), jnp.float32)
+    row_off = my * S_loc
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(step, m, l, o, k_cur, v_cur):
+        # kv currently held originates from shard (my - step) mod n
+        col_off = jnp.mod(my - step, n) * S_loc
+        return _block_attend(qf, k_cur.astype(jnp.float32),
+                             v_cur.astype(jnp.float32),
+                             m, l, o, row_off, col_off, causal, scale)
+
+    def body(step, carry):
+        m, l, o, k_cur, v_cur = carry
+        m, l, o = attend(step, m, l, o, k_cur, v_cur)
+        # rotate kv to the next device (ring over ICI)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    # n-1 rotated steps, final block attended outside the loop (no wasted
+    # trailing ppermute pair)
+    m, l, o, k_last, v_last = jax.lax.fori_loop(0, n - 1, body,
+                                                (m0, l0, o0, k, v))
+    m, l, o = attend(n - 1, m, l, o, k_last, v_last)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_bshd(q, k, v, axis_name, causal=True, scale=None):
+    """(B, S, H, D) wrapper matching paddle's MHA layout."""
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    return jnp.swapaxes(ring_attention(qt, kt, vt, axis_name, causal, scale), 1, 2)
