@@ -196,6 +196,10 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=No
     def fn(x):
         full = red(jax.lax.all_gather(x, ax, axis=0), axis=0)
         n = jax.lax.axis_size(ax)
+        if full.shape[0] % n:
+            raise ValueError(
+                f"reduce_scatter: dim 0 ({full.shape[0]}) not divisible by "
+                f"group size {n}")
         per = full.shape[0] // n
         return jax.lax.dynamic_slice_in_dim(
             full, jax.lax.axis_index(ax) * per, per, 0)

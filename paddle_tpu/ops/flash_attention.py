@@ -24,9 +24,10 @@ def _ref_attention_bhsd(q, k, v, causal, scale):
 
 
 def _use_pallas(q):
+    """q here is always (B, H, S, D) — both callers transpose first."""
     if jax.default_backend() != "tpu":
         return False
-    B, S, H, D = q.shape
+    B, H, S, D = q.shape
     return S % 128 == 0 and D in (64, 128, 256)
 
 

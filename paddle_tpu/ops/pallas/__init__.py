@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the hand-fused hot ops.
+
+Replaces the reference's hand-written fused CUDA kernels
+(paddle/fluid/operators/fused/: fused_attention_op.cu, fmha_ref.h,
+fused_softmax_mask.cu.h, fused_dropout_* ...) with Mosaic/Pallas TPU
+kernels. Everything else is left to XLA fusion, which covers what the
+reference's 211 IR fusion passes do by hand.
+"""
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,336 @@
+"""Flash attention (blockwise online-softmax) as Pallas TPU kernels.
+
+TPU-native replacement for the reference's fused MHA CUDA ops
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h): instead
+of a monolithic CUDA kernel per (fwd, bwd), three Pallas kernels tile the
+attention matrix into (block_q, block_k) VMEM blocks so the full S×S score
+matrix never materialises in HBM:
+
+  * `_fwd_kernel`   — online-softmax forward, saves per-row logsumexp
+  * `_dq_kernel`    — dQ accumulation (grid over q-blocks, scan k-blocks)
+  * `_dkv_kernel`   — dK/dV accumulation (grid over k-blocks, scan q-blocks)
+
+Layout: (B, H, S, D). Causal masking skips fully-masked blocks entirely
+(the grid still visits them but compute is predicated off with `pl.when`,
+so the MXU work is ~halved). All softmax statistics are kept in float32
+regardless of input dtype (bf16 inputs hit the MXU in bf16, accumulate
+in f32 — same policy as the reference's fp16 fused attention).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 128
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal, sm_scale, nk, bq, bk):
+    i = pl.program_id(1)   # q block
+    j = pl.program_id(2)   # k block
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (j <= i) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+            s = jnp.where(row >= col, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                                   # (bq, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                                  # (bq, bk)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    last_j = i if causal else nk - 1
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_ref[:, :1]
+        # causal with bq == bk guarantees every row saw >= 1 valid column,
+        # but guard anyway so fully-masked rows emit 0, not NaN
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = (m_ref[:, :1] + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = lse
+
+
+def _mha_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    BH, S, D = q.shape
+    nq = S // block_q
+    nk = S // block_k
+    grid = (BH, nq, nk)
+
+    kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
+                               nk=nk, bq=block_q, bk=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, causal, sm_scale, nk, bq, bk):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (j <= i) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, None]                                # (bq, 1)
+        delta = delta_ref[0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        p = jnp.exp(s - lse)                                     # (bq, bk)
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+            p = jnp.where(row >= col, p, 0.0)
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale                         # (bq, bk)
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    last_j = i if causal else nk - 1
+
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, causal, sm_scale, nq, bq, bk):
+    j = pl.program_id(1)   # k block
+    i = pl.program_id(2)   # q block
+
+    first_i = j if causal else 0
+
+    @pl.when(i == first_i)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (i >= j) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        p = jnp.exp(s - lse)                                     # (bq, bk)
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+            p = jnp.where(row >= col, p, 0.0)
+
+        # dV += P^T @ dO   (contract over q rows)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dK += dS^T @ Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+                  interpret):
+    BH, S, D = q.shape
+    nq = S // block_q
+    nk = S // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(_dq_kernel, causal=causal,
+                                  sm_scale=sm_scale, nk=nk,
+                                  bq=block_q, bk=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(_dkv_kernel, causal=causal,
+                                   sm_scale=sm_scale, nq=nq,
+                                   bq=block_q, bk=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public custom-vjp entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)[0]
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    B, H, S, D = q.shape
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    o, lse = _mha_forward(qf, kf, vf, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return o.reshape(B, H, S, D), (qf, kf, vf, o, lse, (B, H, S, D))
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    qf, kf, vf, o, lse, (B, H, S, D) = res
+    do = g.reshape(B * H, S, D)
+    dq, dk, dv = _mha_backward(qf, kf, vf, o, lse, do, causal, sm_scale,
+                               block_q, block_k, interpret)
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+            dv.reshape(B, H, S, D))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
+                    interpret=None):
+    """Flash attention over (B, H, S, D) tensors.
+
+    S must be a multiple of the block size. On non-TPU backends the kernels
+    run in Pallas interpret mode (numerically identical, slower) unless
+    `interpret` is given explicitly.
+    """
+    B, H, S, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(f"S={S} must be a multiple of block sizes "
+                         f"({block_q}, {block_k})")
+    if causal and block_q != block_k:
+        raise ValueError("causal masking requires block_q == block_k")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k,
+                  interpret)

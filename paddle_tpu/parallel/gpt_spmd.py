@@ -445,16 +445,16 @@ def make_train_step(cfg: GPTSpmdConfig, plan: MeshPlan, mesh=None,
         # every mp rank because the loss is mp-identical. Same for pp via the
         # psum broadcast in _pipeline_loss.
         if grad_clip:
-            leaves = jax.tree_util.tree_leaves(grads)
-            sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves)
-            # global norm must include all shards of mp/pp-sharded params
+            # global norm must include all shards of mp/pp-sharded params;
+            # _global_grad_sq sums per-leaf with its spec so replicated
+            # leaves aren't double counted
             psum_axes = tuple(a for a, d in (("mp", plan.mp), ("pp", plan.pp))
                               if d > 1)
             if psum_axes:
-                # careful: replicated leaves would be double counted; to stay
-                # exact we only support the common case where the bulk of
-                # params are sharded — compute norm per-leaf with its spec
                 sq = _global_grad_sq(grads, specs, plan)
+            else:
+                sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                         for g in jax.tree_util.tree_leaves(grads))
             gnorm = jnp.sqrt(sq)
             scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-6))
             grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
