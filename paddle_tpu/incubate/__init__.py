@@ -1,3 +1,4 @@
 """paddle.incubate equivalent (reference: python/paddle/incubate)."""
 from . import autotune  # noqa: F401
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

@@ -1,0 +1,18 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/ (MoELayer,
+gate/gshard_gate.py, switch_gate.py) with the expert-parallel all-to-all
+dispatch implemented by the `global_scatter`/`global_gather` CUDA collective
+ops (paddle/fluid/operators/collective/global_scatter_op.cc).
+
+TPU-native design: dispatch/combine are dense einsums against a
+(token, expert, capacity) one-hot — XLA fuses them — and the cross-device
+exchange is a single `jax.lax.all_to_all` over an "ep" mesh axis inside the
+compiled program, riding ICI instead of NCCL.
+"""
+from .functional import gshard_dispatch, moe_forward, init_moe_experts
+from .gate import GShardGate, SwitchGate, NaiveGate
+from .moe_layer import MoELayer
+
+__all__ = ["gshard_dispatch", "moe_forward", "init_moe_experts",
+           "GShardGate", "SwitchGate", "NaiveGate", "MoELayer"]

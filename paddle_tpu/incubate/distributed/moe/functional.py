@@ -1,0 +1,152 @@
+"""Functional MoE core: gating, dispatch/combine, expert-parallel exchange.
+
+Pure-jax functions usable both from the eager `MoELayer` (via `apply_op`)
+and inside `shard_map`'d SPMD train steps with an "ep" mesh axis.
+
+The (token, expert, capacity) one-hot dispatch follows the GShard
+formulation; the reference reaches the same result with index scatter
+kernels (moe_layer.py:106-173 prune_gate_by_capacity + global_scatter).
+Dense einsum is the right shape for the MXU: no dynamic shapes, no
+scatter — XLA fuses dispatch into the expert matmul.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_capacity(capacity_factor, k, num_tokens, num_experts):
+    """The one place the per-expert buffer size is defined."""
+    return int(np.ceil(capacity_factor * k * num_tokens / num_experts))
+
+
+def gshard_dispatch(gates, k, capacity):
+    """Top-k capacity-constrained routing.
+
+    gates: (T, E) softmax probabilities.
+    Returns (combine, dispatch, aux_loss):
+      combine  (T, E, C) float — normalized routing weights
+      dispatch (T, E, C) bool  — combine > 0
+      aux_loss scalar — load-balancing loss (E * sum(me * ce), switch-style)
+    Tokens beyond an expert's capacity C are dropped (zero rows), matching
+    the reference's prune_gate_by_capacity.
+    """
+    T, E = gates.shape
+    C = capacity
+    if k > E:
+        raise ValueError(f"top-k={k} exceeds num_experts={E}")
+
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    remaining = gates
+    prev_count = jnp.zeros((E,), jnp.int32)
+    kept_weight_sum = jnp.zeros((T,), jnp.float32)
+    aux_loss = jnp.float32(0.0)
+
+    parts = []
+    for pick in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                   # (T,)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)          # (T, E)
+        w = jnp.sum(gates * m, axis=-1)                        # (T,)
+
+        if pick == 0:
+            # load-balance: fraction routed to e × mean prob of e
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(m, axis=0)
+            aux_loss = jnp.float32(E) * jnp.sum(me * ce)
+
+        # position of each token within its expert's buffer
+        pos_in_expert = jnp.cumsum(m, axis=0) - m              # (T, E)
+        pos = jnp.sum(pos_in_expert * m, axis=-1).astype(jnp.int32)
+        pos = pos + jnp.sum(prev_count[None, :] * m, axis=-1).astype(jnp.int32)
+        prev_count = prev_count + jnp.sum(m, axis=0).astype(jnp.int32)
+
+        keep = pos < C
+        w_kept = jnp.where(keep, w, 0.0)
+        kept_weight_sum = kept_weight_sum + w_kept
+        onehot_c = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C,
+                                  dtype=jnp.float32) * keep[:, None]
+        parts.append((w_kept, m, onehot_c))
+        remaining = remaining * (1.0 - m)
+
+    denom = jnp.maximum(kept_weight_sum, 1e-9)[:, None, None]
+    for w_kept, m, onehot_c in parts:
+        combine = combine + (w_kept[:, None, None]
+                             * m[:, :, None] * onehot_c[:, None, :])
+    combine = combine / denom
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss
+
+
+def _expert_ffn(x, params, activation):
+    """x: (E_local, C_total, d); params: dict of stacked (E_local, ...) arrays."""
+    h = jnp.einsum("ecd,edf->ecf", x, params["w1"]) + params["b1"][:, None, :]
+    h = activation(h)
+    return jnp.einsum("ecf,efd->ecd", h, params["w2"]) + params["b2"][:, None, :]
+
+
+def moe_forward(x, gate_w, expert_params, *, k=2, capacity_factor=1.2,
+                axis_name=None, num_experts=None,
+                activation=jax.nn.gelu, jitter_noise=None):
+    """MoE FFN over flattened tokens.
+
+    x: (T, d) local tokens. gate_w: (d, E) with E the GLOBAL expert count.
+    expert_params: stacked expert weights — (E, ...) without `axis_name`, or
+    the LOCAL (E//ep, ...) shard inside a shard_map with `axis_name="ep"`.
+
+    Returns (out (T, d), aux_loss). With `axis_name`, dispatched tokens are
+    exchanged with a single all_to_all each way (the reference's
+    global_scatter / global_gather pair).
+
+    jitter_noise: optional (rng_key, eps) — multiplies gate logits by
+    U[1-eps, 1+eps] (switch-transformer training jitter).
+    """
+    T, d = x.shape
+    E = num_experts or gate_w.shape[-1]
+    ep = jax.lax.axis_size(axis_name) if axis_name else 1
+    if E % ep:
+        raise ValueError(f"num_experts={E} not divisible by ep={ep}")
+    C = compute_capacity(capacity_factor, k, T, E)
+
+    logits = jnp.dot(x, gate_w, preferred_element_type=jnp.float32)
+    if jitter_noise is not None:
+        key, eps = jitter_noise
+        logits = logits * jax.random.uniform(key, logits.shape,
+                                             minval=1.0 - eps,
+                                             maxval=1.0 + eps)
+    gates = jax.nn.softmax(logits, axis=-1)
+    combine, dispatch, aux = gshard_dispatch(gates, k, C)
+    combine = combine.astype(x.dtype)
+
+    # dispatch: (T, E, C) × (T, d) → (E, C, d)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+
+    if axis_name and ep > 1:
+        # send expert-slabs to their owners; receive my experts' tokens from
+        # every rank: (E, C, d) → (E/ep, ep*C, d)
+        expert_in = jax.lax.all_to_all(expert_in, axis_name,
+                                       split_axis=0, concat_axis=1,
+                                       tiled=True)
+        expert_out = _expert_ffn(expert_in, expert_params, activation)
+        expert_out = jax.lax.all_to_all(expert_out, axis_name,
+                                        split_axis=1, concat_axis=0,
+                                        tiled=True)
+    else:
+        expert_out = _expert_ffn(expert_in, expert_params, activation)
+
+    out = jnp.einsum("ecd,tec->td", expert_out, combine)
+    return out, aux
+
+
+def init_moe_experts(key, num_experts_local, d_model, d_hidden,
+                     dtype=jnp.float32):
+    """Stacked FFN expert params: dict of (E_local, ...) arrays."""
+    k1, k2 = jax.random.split(key)
+    s1 = 1.0 / np.sqrt(d_model)
+    s2 = 1.0 / np.sqrt(d_hidden)
+    return {
+        "w1": jax.random.uniform(k1, (num_experts_local, d_model, d_hidden),
+                                 dtype, -s1, s1),
+        "b1": jnp.zeros((num_experts_local, d_hidden), dtype),
+        "w2": jax.random.uniform(k2, (num_experts_local, d_hidden, d_model),
+                                 dtype, -s2, s2),
+        "b2": jnp.zeros((num_experts_local, d_model), dtype),
+    }

@@ -1,0 +1,89 @@
+"""Eager MoELayer.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py
+(MoELayer: gate → global_scatter → experts → global_gather → combine).
+Here the whole routed FFN is one `apply_op` over the functional core, so
+it records a single tape node eagerly and traces into one fused XLA
+region under jit. Expert parallelism (ep > 1) is the SPMD path: use
+`functional.moe_forward(axis_name="ep")` inside a shard_map — eager mode
+keeps all experts local, like the reference with mp_group=None.
+"""
+import jax
+import numpy as np
+
+from ....core.tensor import apply_op
+from ....nn.layer.layers import Layer
+from ....nn.initializer import Uniform
+from .functional import moe_forward
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+_GATES = {"gshard": GShardGate, "naive": NaiveGate, "switch": SwitchGate}
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts FFN with stacked expert weights.
+
+    Args:
+        d_model: token width.
+        d_hidden: expert FFN hidden width.
+        num_experts: number of experts (global).
+        gate: "gshard" | "switch" | "naive" or a BaseGate instance.
+        capacity_factor: per-expert buffer slack.
+
+    `forward` returns the routed output; the load-balancing auxiliary loss
+    of the latest forward is kept in `self.aux_loss` (a Tensor wired into
+    the tape — add `layer.aux_loss * coeff` to the training loss).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 capacity_factor=None, activation=jax.nn.gelu):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.activation = activation
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+            if capacity_factor is not None:
+                self.gate.capacity_factor = capacity_factor
+        else:
+            self.gate = _GATES[gate](d_model, num_experts,
+                                     capacity_factor
+                                     if capacity_factor is not None else 1.2)
+
+        s1 = 1.0 / np.sqrt(d_model)
+        s2 = 1.0 / np.sqrt(d_hidden)
+        self.w1 = self.create_parameter((num_experts, d_model, d_hidden),
+                                        default_initializer=Uniform(-s1, s1))
+        self.b1 = self.create_parameter((num_experts, d_hidden), is_bias=True)
+        self.w2 = self.create_parameter((num_experts, d_hidden, d_model),
+                                        default_initializer=Uniform(-s2, s2))
+        self.b2 = self.create_parameter((num_experts, d_model), is_bias=True)
+        self.aux_loss = None
+
+    def forward(self, x):
+        k = self.gate.top_k
+        cf = self.gate.capacity_factor
+        act = self.activation
+        jitter = None
+        if self.training and self.gate.jitter_eps > 0:
+            from ....core.random import next_key
+            jitter = (next_key(), self.gate.jitter_eps)
+
+        def fn(xd, gw, w1, b1, w2, b2):
+            t = xd.reshape(-1, xd.shape[-1])
+            out, aux = moe_forward(
+                t, gw, {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+                k=k, capacity_factor=cf, activation=act,
+                jitter_noise=jitter)
+            return out.reshape(xd.shape), aux
+
+        out, aux = apply_op(fn, x, self.gate.weight, self.w1, self.b1,
+                            self.w2, self.b2, name="moe")
+        self.aux_loss = aux if self.gate.has_aux_loss else aux * 0.0
+        return out
+
+    def extra_repr(self):
+        return (f"d_model={self.d_model}, d_hidden={self.d_hidden}, "
+                f"num_experts={self.num_experts}, "
+                f"gate={type(self.gate).__name__}")

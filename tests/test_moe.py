@@ -1,0 +1,151 @@
+"""MoE gating, dispatch, expert-parallel all-to-all, and eager MoELayer.
+
+Mirrors the reference's MoE tests (unittests/collective/...global_scatter /
+test_moe_api) but on a virtual 8-device CPU mesh instead of NCCL ranks.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.incubate.distributed.moe import (
+    MoELayer, gshard_dispatch, init_moe_experts, moe_forward)
+
+
+def test_dispatch_weights_normalized_and_capacity():
+    T, E, C, k = 32, 4, 4, 2
+    gates = jax.nn.softmax(jax.random.normal(jax.random.key(0), (T, E)))
+    combine, dispatch, aux = gshard_dispatch(gates, k, C)
+    assert combine.shape == (T, E, C)
+    # each (expert, slot) holds at most one token
+    per_slot = np.asarray(dispatch).sum(axis=0)
+    assert per_slot.max() <= 1
+    # per-expert load never exceeds capacity
+    assert np.asarray(dispatch).sum(axis=(0, 2)).max() <= C
+    # routed tokens have weights summing to 1
+    w = np.asarray(combine).sum(axis=(1, 2))
+    routed = np.asarray(dispatch).any(axis=(1, 2))
+    np.testing.assert_allclose(w[routed], 1.0, atol=1e-5)
+    assert float(aux) > 0
+
+
+def test_capacity_drops_overflow():
+    # all tokens prefer expert 0 → only C survive
+    T, E, C = 16, 4, 3
+    gates = jnp.tile(jnp.asarray([[0.97, 0.01, 0.01, 0.01]]), (T, 1))
+    combine, dispatch, _ = gshard_dispatch(gates, 1, C)
+    assert int(np.asarray(dispatch)[:, 0, :].sum()) == C
+
+
+def test_moe_matches_dense_single_expert():
+    # E=1, k=1, capacity >= T: routing is the identity → plain FFN
+    T, d, h = 16, 8, 32
+    x = jax.random.normal(jax.random.key(1), (T, d))
+    params = init_moe_experts(jax.random.key(2), 1, d, h)
+    gate_w = jnp.zeros((d, 1))
+    out, _ = moe_forward(x, gate_w, params, k=1, capacity_factor=float(T))
+    ref = jax.nn.gelu(x @ params["w1"][0] + params["b1"][0]) @ params["w2"][0] \
+        + params["b2"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_expert_parallel_matches_local():
+    """all_to_all dispatch over ep=4 must be numerically identical to the
+    single-device computation with the same global expert stack."""
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    T, d, h, E, ep = 64, 16, 32, 8, 4
+    x = jax.random.normal(jax.random.key(3), (T, d))
+    gate_w = jax.random.normal(jax.random.key(4), (d, E)) * 0.1
+    params = init_moe_experts(jax.random.key(5), E, d, h)
+
+    ref, ref_aux = moe_forward(x, gate_w, params, k=2, capacity_factor=2.0)
+
+    mesh = Mesh(np.asarray(devs[:ep]), ("ep",))
+
+    def spmd(x, gate_w, params):
+        out, aux = moe_forward(x, gate_w, params, k=2, capacity_factor=2.0,
+                               axis_name="ep", num_experts=E)
+        return out, aux
+
+    shmapped = jax.jit(jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P(), P(), P("ep")),
+        out_specs=(P(), P()),
+        check_vma=False))
+    out, aux = shmapped(x, gate_w, params)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(float(aux), float(ref_aux), atol=1e-5)
+
+
+def test_expert_parallel_grads():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    T, d, h, E, ep = 32, 8, 16, 4, 4
+    x = jax.random.normal(jax.random.key(6), (T, d))
+    gate_w = jax.random.normal(jax.random.key(7), (d, E)) * 0.1
+    params = init_moe_experts(jax.random.key(8), E, d, h)
+    mesh = Mesh(np.asarray(devs[:ep]), ("ep",))
+
+    def loss_spmd(x, gate_w, params):
+        out, aux = moe_forward(x, gate_w, params, k=2, capacity_factor=2.0,
+                               axis_name="ep", num_experts=E)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    def loss_ref(x, gate_w, params):
+        out, aux = moe_forward(x, gate_w, params, k=2, capacity_factor=2.0)
+        return jnp.sum(out ** 2) + 0.01 * aux
+
+    grad_spmd = jax.jit(jax.shard_map(
+        jax.grad(loss_spmd, argnums=2), mesh=mesh,
+        in_specs=(P(), P(), P("ep")), out_specs=P("ep"),
+        check_vma=False))
+    g = grad_spmd(x, gate_w, params)
+    g_ref = jax.grad(loss_ref, argnums=2)(x, gate_w, params)
+    # x is replicated: every rank computes the same loss over the same
+    # tokens, and the all_to_all transpose sums the ep identical cotangent
+    # streams into the expert owners — so SPMD grads are exactly ep × local.
+    for name in ("w1", "b1", "w2", "b2"):
+        np.testing.assert_allclose(np.asarray(g[name]),
+                                   ep * np.asarray(g_ref[name]),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"grad {name}")
+
+
+def test_eager_moe_layer_trains():
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+
+    layer = MoELayer(d_model=8, d_hidden=16, num_experts=4, gate="gshard")
+    o = opt.Adam(1e-2, parameters=layer.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(32, 8).astype("float32"))
+    target = paddle.to_tensor(rng.rand(32, 8).astype("float32"))
+
+    losses = []
+    for step in range(12):
+        out = layer(x)
+        loss = ((out - target) ** 2).mean() + 0.01 * layer.aux_loss
+        loss.backward()
+        if step == 0:
+            # expert weights actually receive gradient
+            assert layer.w1.grad is not None
+            assert float(np.abs(np.asarray(layer.w1.grad.numpy())).max()) > 0
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_layer_state_dict():
+    layer = MoELayer(d_model=4, d_hidden=8, num_experts=2, gate="switch")
+    sd = layer.state_dict()
+    assert any("w1" in k for k in sd)
+    assert any("gate" in k for k in sd)
