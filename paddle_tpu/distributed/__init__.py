@@ -13,6 +13,7 @@ from .env import (  # noqa: F401
     init_parallel_env, set_mesh,
 )
 from .parallel_layers import DataParallel  # noqa: F401
+from .store import TCPStore  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -1,13 +1,20 @@
 """paddle.io equivalent: Dataset/DataLoader/samplers.
 
 Reference: python/paddle/fluid/dataloader/ (`_DataLoaderIterMultiProcess`
-worker-process pool, dataloader_iter.py:342). TPU-native simplification: the
-hot path feeds numpy batches straight to device; multi-worker prefetch uses a
-thread pool (JAX arrays are produced on the main thread; workers only run
-user dataset code + collate in numpy), which avoids the reference's
-shared-memory tensor plumbing (mmap_allocator.cc) entirely.
+worker-process pool, dataloader_iter.py:342). Two prefetch engines:
+
+* num_workers>0 + use_shared_memory (default): true worker PROCESSES
+  returning batches through the native C++ shm ring
+  (paddle_tpu/native/src/shm_ring.cc) — the reference's shared-memory
+  tensor path (mmap_allocator.cc) without a Python pipe in the loop.
+* fallback (native lib unavailable, IterableDataset, or
+  use_shared_memory=False): a prefetch thread running user dataset code.
+
+JAX arrays are always produced in the trainer process; workers stay numpy.
 """
 import itertools
+import os
+import pickle
 import queue
 import threading
 
@@ -15,6 +22,7 @@ import numpy as np
 
 from ..core.random import _default_generator
 from ..core.tensor import Tensor, to_tensor
+from .worker import WorkerInfo, get_worker_info, numpy_collate, worker_loop
 
 
 class Dataset:
@@ -243,9 +251,13 @@ class DataLoader:
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
+        self._user_collate_fn = collate_fn is not None
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self._use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -281,7 +293,15 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
-        # Threaded prefetch: workers run user __getitem__ + collate.
+        if self._use_shared_memory and not self._iterable_mode:
+            from .. import native
+            if native.available():
+                yield from self._iter_multiprocess()
+                return
+        yield from self._iter_threaded()
+
+    # -- threaded fallback -------------------------------------------------
+    def _iter_threaded(self):
         maxsize = max(2, self.num_workers * self.prefetch_factor)
         q = queue.Queue(maxsize=maxsize)
         sentinel = object()
@@ -302,6 +322,113 @@ class DataLoader:
             yield item
         t.join()
 
+    # -- multi-process over the native shm ring ----------------------------
+    def _iter_multiprocess(self):
+        import multiprocessing as mp
 
-def get_worker_info():
-    return None
+        from .. import native
+
+        ctx = mp.get_context("fork")
+        global _RING_SEQ
+        _RING_SEQ += 1
+        ring_name = f"/pt_dl_{os.getpid()}_{_RING_SEQ}"
+        ring_cap = max(8 << 20,
+                       self.num_workers * self.prefetch_factor * (4 << 20))
+        ring = native.ShmRing(ring_name, ring_cap)
+        index_queue = ctx.Queue()
+        batches = list(self.batch_sampler)
+
+        # incremental dispatch: at most num_workers * prefetch_factor batch
+        # indices outstanding, so worker-side ring pressure AND parent-side
+        # reorder buffering both stay bounded (reference:
+        # dataloader_iter.py _try_put_indices / _outstanding_capacity)
+        dispatch_iter = iter(enumerate(batches))
+        max_outstanding = max(2, self.num_workers * self.prefetch_factor)
+        state = {"outstanding": 0, "exhausted": False}
+
+        def dispatch_one():
+            if state["exhausted"]:
+                return
+            item = next(dispatch_iter, None)
+            if item is None:
+                state["exhausted"] = True
+                for _ in range(self.num_workers):
+                    index_queue.put(None)
+                return
+            index_queue.put(item)
+            state["outstanding"] += 1
+
+        for _ in range(max_outstanding):
+            dispatch_one()
+
+        collate = (self.collate_fn if self._user_collate_fn else numpy_collate)
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        procs = [
+            ctx.Process(
+                target=worker_loop,
+                args=(self.dataset, collate, ring_name, index_queue,
+                      self.worker_init_fn, wid, self.num_workers, base_seed),
+                daemon=True)
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+
+        # timeout=0 (default) means "no deadline" — poll in 10 s slices so a
+        # dead worker is still detected promptly (the watchdog role of
+        # launch_utils.watch_local_trainers)
+        user_deadline_ms = int(self.timeout * 1000) if self.timeout else None
+        poll_ms = min(user_deadline_ms, 10000) if user_deadline_ms else 10000
+        buffered = {}
+        next_idx = 0
+        try:
+            while next_idx < len(batches):
+                if next_idx in buffered:
+                    yield self._finalize_batch(buffered.pop(next_idx))
+                    next_idx += 1
+                    continue
+                waited_ms = 0
+                while True:
+                    try:
+                        data = ring.get(timeout_ms=poll_ms)
+                        break
+                    except TimeoutError:
+                        dead = [p.pid for p in procs if not p.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} died "
+                                f"unexpectedly") from None
+                        waited_ms += poll_ms
+                        if user_deadline_ms and waited_ms >= user_deadline_ms:
+                            raise
+                if data is None:
+                    raise RuntimeError("DataLoader ring closed early")
+                i, status, payload = pickle.loads(data)
+                state["outstanding"] -= 1
+                dispatch_one()
+                if status == "err":
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {i}:\n{payload}")
+                buffered[i] = payload
+        finally:
+            ring.close()
+            for p in procs:
+                p.join(timeout=1)
+                if p.is_alive():
+                    p.terminate()
+            ring.release()
+
+    def _finalize_batch(self, batch):
+        """numpy structure → device tensors (runs in the trainer process)."""
+        if self._user_collate_fn:
+            return batch
+        if isinstance(batch, list):
+            return [self._finalize_batch(b) for b in batch]
+        if isinstance(batch, dict):
+            return {k: self._finalize_batch(v) for k, v in batch.items()}
+        if isinstance(batch, np.ndarray):
+            return to_tensor(batch)
+        return batch
+
+
+_RING_SEQ = 0

@@ -1,0 +1,87 @@
+"""Multi-process DataLoader worker loop over the native shm ring.
+
+Reference: python/paddle/fluid/dataloader/dataloader_iter.py:342
+(`_DataLoaderIterMultiProcess`: worker `multiprocessing.Process` pool,
+index queues, shared-memory tensor return, watchdog). Here the return
+path is the C++ shm ring (paddle_tpu/native/src/shm_ring.cc): workers
+pickle numpy batches straight into shared memory; the trainer process
+drains, reorders, and converts to device arrays.
+
+Workers never touch JAX — batches stay numpy until the parent converts,
+so fork()ing after the parent initialized the TPU backend is safe.
+"""
+import pickle
+import traceback
+
+import numpy as np
+
+
+class WorkerInfo:
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def numpy_collate(batch):
+    """Default collate for worker processes: stacks to numpy, never jax."""
+    from ..core.tensor import Tensor
+
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return [numpy_collate([b[i] for b in batch]) for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: numpy_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b.numpy()) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    return batch
+
+
+def worker_loop(dataset, collate_fn, ring_name, index_queue, worker_init_fn,
+                wid, num_workers, base_seed):
+    from ..native import ShmRing
+
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, dataset)
+    np.random.seed((base_seed + wid) % (2 ** 31))
+    ring = ShmRing(ring_name, create=False)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        while True:
+            item = index_queue.get()
+            if item is None:
+                break
+            i, indices = item
+            try:
+                batch = collate_fn([dataset[j] for j in indices])
+                payload = pickle.dumps((i, "ok", batch),
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception:
+                payload = pickle.dumps((i, "err", traceback.format_exc()))
+            try:
+                ring.put(payload)
+            except RuntimeError:
+                # batch bigger than the whole ring: report instead of dying
+                ring.put(pickle.dumps((
+                    i, "err",
+                    f"batch {i} pickled to {len(payload)} bytes, larger than "
+                    f"the shm ring; raise DataLoader use_shared_memory "
+                    f"capacity or reduce batch size")))
+    except (EOFError, KeyboardInterrupt):
+        pass
+    finally:
+        ring.release()

@@ -1,0 +1,283 @@
+"""ctypes bindings to libpaddle_tpu_native.so — the C++ runtime layer.
+
+The compute path is JAX/XLA; this is the native runtime *around* it, the
+role C++ plays in the reference:
+
+  ShmRing   — shared-memory batch transport for the multi-process
+              DataLoader (≈ mmap_allocator.cc + blocking_queue.h)
+  TCPStore  — multi-host rendezvous/coordination KV service
+              (≈ distributed/store/tcp_store.cc)
+  HostArena — best-fit auto-growth host allocator for staging buffers
+              (≈ allocation/auto_growth_best_fit_allocator.cc)
+  stats     — named runtime counters (≈ platform/monitor.h StatRegistry)
+
+Built on first use with the in-tree Makefile (g++); if the toolchain is
+unavailable everything degrades: `available()` returns False and the
+Python fallbacks stay in place.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "build", "libpaddle_tpu_native.so")
+_lib = None
+_build_lock = threading.Lock()
+_build_failed = False
+
+
+def _sources_newer_than_so():
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    src = os.path.join(_DIR, "src")
+    return any(os.path.getmtime(os.path.join(src, f)) > so_mtime
+               for f in os.listdir(src))
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if _sources_newer_than_so():
+                subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                               capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.SubprocessError):
+            _build_failed = True
+            return None
+        _declare(lib)
+        _lib = lib
+    return _lib
+
+
+def _declare(lib):
+    P, U64, I64, I32 = (ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+                        ctypes.c_int)
+    S = ctypes.c_char_p
+    sigs = {
+        "ptn_ring_create": (P, [S, U64]),
+        "ptn_ring_attach": (P, [S]),
+        "ptn_ring_put": (I32, [P, ctypes.c_char_p, U64, I32]),
+        "ptn_ring_get": (I32, [P, ctypes.POINTER(P), ctypes.POINTER(U64), I32]),
+        "ptn_ring_close": (None, [P]),
+        "ptn_ring_release": (None, [P]),
+        "ptn_buf_free": (None, [P]),
+        "ptn_store_server_start": (P, [I32]),
+        "ptn_store_server_port": (I32, [P]),
+        "ptn_store_server_stop": (None, [P]),
+        "ptn_store_client_connect": (P, [S, I32, I32]),
+        "ptn_store_client_close": (None, [P]),
+        "ptn_store_set": (I32, [P, S, ctypes.c_char_p, U64]),
+        "ptn_store_get": (I32, [P, S, ctypes.POINTER(P), ctypes.POINTER(U64)]),
+        "ptn_store_wait": (I32, [P, S, ctypes.POINTER(P), ctypes.POINTER(U64)]),
+        "ptn_store_add": (I32, [P, S, I64, ctypes.POINTER(I64)]),
+        "ptn_store_delete": (I32, [P, S]),
+        "ptn_arena_create": (P, [U64]),
+        "ptn_arena_alloc": (P, [P, U64]),
+        "ptn_arena_free": (I32, [P, P]),
+        "ptn_arena_stats": (None, [P, ctypes.POINTER(U64), ctypes.POINTER(U64),
+                                   ctypes.POINTER(U64)]),
+        "ptn_arena_destroy": (None, [P]),
+        "ptn_stat_add": (I64, [S, I64]),
+        "ptn_stat_get": (I64, [S]),
+        "ptn_stat_peak": (I64, [S]),
+        "ptn_stat_reset": (None, [S]),
+    }
+    for name, (res, args) in sigs.items():
+        fn = getattr(lib, name)
+        fn.restype = res
+        fn.argtypes = args
+
+
+def available():
+    return _load() is not None
+
+
+def _take_buf(pp, ln):
+    data = ctypes.string_at(pp.value, ln.value)
+    _lib.ptn_buf_free(pp.value)
+    return data
+
+
+class ShmRing:
+    """Cross-process blocking byte-record queue in shared memory."""
+
+    def __init__(self, name, capacity=64 << 20, create=True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.name = name
+        self._create = create
+        nm = name.encode()
+        self._h = (lib.ptn_ring_create(nm, capacity) if create
+                   else lib.ptn_ring_attach(nm))
+        if not self._h:
+            raise RuntimeError(f"ShmRing {'create' if create else 'attach'} "
+                               f"failed: {name}")
+
+    def put(self, data: bytes, timeout_ms=-1):
+        rc = _lib.ptn_ring_put(self._h, data, len(data), timeout_ms)
+        if rc == -2:
+            raise EOFError("ring closed")
+        if rc == -1:
+            raise TimeoutError("ring put timeout")
+        if rc != 0:
+            raise RuntimeError(f"ring put failed ({rc})")
+
+    def get(self, timeout_ms=-1):
+        """Returns bytes, or None when the ring is closed and drained."""
+        pp = ctypes.c_void_p()
+        ln = ctypes.c_uint64()
+        rc = _lib.ptn_ring_get(self._h, ctypes.byref(pp), ctypes.byref(ln),
+                               timeout_ms)
+        if rc == -2:
+            return None
+        if rc == -1:
+            raise TimeoutError("ring get timeout")
+        if rc != 0:
+            raise RuntimeError(f"ring get failed ({rc})")
+        return _take_buf(pp, ln)
+
+    def close(self):
+        if self._h:
+            _lib.ptn_ring_close(self._h)
+
+    def release(self):
+        if self._h:
+            _lib.ptn_ring_release(self._h)
+            self._h = None
+
+
+class TCPStoreServer:
+    def __init__(self, port=0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._h = lib.ptn_store_server_start(port)
+        if not self._h:
+            raise RuntimeError(f"TCPStore server failed to bind port {port}")
+        self.port = lib.ptn_store_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            _lib.ptn_store_server_stop(self._h)
+            self._h = None
+
+
+class TCPStoreClient:
+    def __init__(self, host="127.0.0.1", port=0, timeout_ms=30000):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._h = lib.ptn_store_client_connect(host.encode(), port, timeout_ms)
+        if not self._h:
+            raise RuntimeError(f"TCPStore connect failed: {host}:{port}")
+
+    def set(self, key, value: bytes):
+        if _lib.ptn_store_set(self._h, key.encode(), value, len(value)) != 0:
+            raise RuntimeError(f"store set failed: {key}")
+
+    def get(self, key):
+        """Non-blocking; returns None if absent."""
+        pp = ctypes.c_void_p()
+        ln = ctypes.c_uint64()
+        if _lib.ptn_store_get(self._h, key.encode(), ctypes.byref(pp),
+                              ctypes.byref(ln)) != 0:
+            return None
+        return _take_buf(pp, ln)
+
+    def wait(self, key):
+        """Blocks until the key exists, returns its value."""
+        pp = ctypes.c_void_p()
+        ln = ctypes.c_uint64()
+        if _lib.ptn_store_wait(self._h, key.encode(), ctypes.byref(pp),
+                               ctypes.byref(ln)) != 0:
+            raise RuntimeError(f"store wait failed: {key}")
+        return _take_buf(pp, ln)
+
+    def add(self, key, delta=1):
+        out = ctypes.c_int64()
+        if _lib.ptn_store_add(self._h, key.encode(), delta,
+                              ctypes.byref(out)) != 0:
+            raise RuntimeError(f"store add failed: {key}")
+        return out.value
+
+    def delete(self, key):
+        _lib.ptn_store_delete(self._h, key.encode())
+
+    def close(self):
+        if self._h:
+            _lib.ptn_store_client_close(self._h)
+            self._h = None
+
+
+class HostArena:
+    """Best-fit auto-growth host allocator; returns memoryviews over the
+    arena's mmap'd chunks."""
+
+    def __init__(self, chunk_bytes=64 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._h = lib.ptn_arena_create(chunk_bytes)
+        self._live = {}
+
+    def alloc(self, size):
+        p = _lib.ptn_arena_alloc(self._h, size)
+        if not p:
+            raise MemoryError(f"arena alloc({size}) failed")
+        buf = (ctypes.c_ubyte * size).from_address(p)
+        mv = memoryview(buf).cast("B")
+        self._live[id(mv)] = (p, mv)
+        return mv
+
+    def free(self, mv):
+        entry = self._live.pop(id(mv), None)
+        if entry is None:
+            raise ValueError("unknown arena buffer")
+        mv.release()
+        if _lib.ptn_arena_free(self._h, entry[0]) != 0:
+            raise RuntimeError("double free")
+
+    def stats(self):
+        a = ctypes.c_uint64()
+        r = ctypes.c_uint64()
+        p = ctypes.c_uint64()
+        _lib.ptn_arena_stats(self._h, ctypes.byref(a), ctypes.byref(r),
+                             ctypes.byref(p))
+        return {"allocated": a.value, "reserved": r.value, "peak": p.value}
+
+    def destroy(self):
+        if self._h:
+            for ptr, mv in self._live.values():
+                mv.release()
+            self._live.clear()
+            _lib.ptn_arena_destroy(self._h)
+            self._h = None
+
+
+def stat_add(name, delta=1):
+    lib = _load()
+    return lib.ptn_stat_add(name.encode(), delta) if lib else 0
+
+
+def stat_get(name):
+    lib = _load()
+    return lib.ptn_stat_get(name.encode()) if lib else 0
+
+
+def stat_peak(name):
+    lib = _load()
+    return lib.ptn_stat_peak(name.encode()) if lib else 0
+
+
+def stat_reset(name):
+    lib = _load()
+    if lib:
+        lib.ptn_stat_reset(name.encode())

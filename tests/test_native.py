@@ -1,0 +1,240 @@
+"""C++ runtime layer: shm ring, TCP kv-store, host arena, stats, and the
+multi-process DataLoader built on them.
+
+Mirrors the reference's native-runtime tests
+(distributed/store/test_tcp_store.cc, allocator unit tests, and the
+multiprocess DataLoader suites under fluid/tests/unittests/).
+"""
+import multiprocessing as mp
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+# ---------------------------------------------------------------------- arena
+
+def test_arena_alloc_free_coalesce():
+    a = native.HostArena(1 << 20)
+    bufs = [a.alloc(100_000) for _ in range(5)]
+    bufs[0][:5] = b"hello"
+    assert bytes(bufs[0][:5]) == b"hello"
+    st = a.stats()
+    assert st["allocated"] >= 500_000
+    assert st["reserved"] >= st["allocated"]
+    # free middle blocks, coalesced region must satisfy a larger alloc
+    a.free(bufs[1])
+    a.free(bufs[2])
+    big = a.alloc(150_000)
+    assert a.stats()["reserved"] == st["reserved"]  # no new chunk needed
+    for b in (bufs[0], bufs[3], bufs[4], big):
+        a.free(b)
+    assert a.stats()["allocated"] == 0
+    a.destroy()
+
+
+def test_arena_double_free_detected():
+    a = native.HostArena(1 << 16)
+    b = a.alloc(100)
+    a.free(b)
+    with pytest.raises(ValueError):
+        a.free(b)
+    a.destroy()
+
+
+def test_arena_growth():
+    a = native.HostArena(1 << 16)  # 64 KiB chunks
+    big = a.alloc(1 << 20)         # forces a dedicated 1 MiB chunk
+    assert a.stats()["reserved"] >= 1 << 20
+    a.free(big)
+    a.destroy()
+
+
+# ---------------------------------------------------------------------- stats
+
+def test_stat_registry():
+    native.stat_reset("t/x")
+    assert native.stat_add("t/x", 5) == 5
+    assert native.stat_add("t/x", -2) == 3
+    assert native.stat_get("t/x") == 3
+    assert native.stat_peak("t/x") == 5
+    native.stat_reset("t/x")
+    assert native.stat_get("t/x") == 0
+
+
+# ------------------------------------------------------------------- kv store
+
+def test_store_set_get_add():
+    s = native.TCPStoreServer()
+    c = native.TCPStoreClient(port=s.port)
+    c.set("alpha", b"1")
+    assert c.get("alpha") == b"1"
+    assert c.get("nope") is None
+    assert c.add("n", 3) == 3
+    assert c.add("n", 4) == 7
+    c.delete("alpha")
+    assert c.get("alpha") is None
+    c.close()
+    s.stop()
+
+
+def test_store_wait_blocks_until_set():
+    s = native.TCPStoreServer()
+    c1 = native.TCPStoreClient(port=s.port)
+    c2 = native.TCPStoreClient(port=s.port)
+    got = {}
+
+    def waiter():
+        got["v"] = c1.wait("late-key")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    assert "v" not in got
+    c2.set("late-key", b"now")
+    t.join(timeout=5)
+    assert got["v"] == b"now"
+    c1.close()
+    c2.close()
+    s.stop()
+
+
+def test_tcpstore_class_barrier():
+    from paddle_tpu.distributed import TCPStore
+
+    master = TCPStore(is_master=True, world_size=3)
+    peers = [TCPStore(port=master.port, world_size=3) for _ in range(2)]
+    stores = [master] + peers
+    done = []
+
+    def arrive(st, delay):
+        time.sleep(delay)
+        st.barrier("b1")
+        done.append(time.monotonic())
+
+    ts = [threading.Thread(target=arrive, args=(st, 0.1 * i))
+          for i, st in enumerate(stores)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(done) == 3
+    # all released within a short window of each other
+    assert max(done) - min(done) < 1.0
+    for st in stores:
+        st.stop()
+
+
+# ------------------------------------------------------------------- shm ring
+
+def test_ring_roundtrip_and_wrap():
+    r = native.ShmRing("/pt_t_ring1", 4096)
+    # records larger than half capacity force wrap markers
+    msgs = [bytes([i]) * (1000 + 137 * i) for i in range(8)]
+    got = []
+
+    def consumer():
+        for _ in msgs:
+            got.append(r.get())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for m in msgs:
+        r.put(m)
+    t.join(timeout=10)
+    assert got == msgs
+    r.close()
+    assert r.get() is None
+    r.release()
+
+
+def _ring_child(name, n):
+    from paddle_tpu import native as nat
+    ring = nat.ShmRing(name, create=False)
+    for i in range(n):
+        ring.put(pickle.dumps((i, np.full((64,), i, dtype=np.int32))))
+    ring.release()
+
+
+def test_ring_cross_process():
+    name = "/pt_t_ring2"
+    r = native.ShmRing(name, 1 << 20)
+    n = 20
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=_ring_child, args=(name, n))
+    p.start()
+    seen = set()
+    for _ in range(n):
+        i, arr = pickle.loads(r.get(timeout_ms=20000))
+        assert (arr == i).all()
+        seen.add(i)
+    p.join(timeout=10)
+    assert seen == set(range(n))
+    r.close()
+    r.release()
+
+
+def test_ring_rewind_on_empty_no_deadlock():
+    # after draining, a record that is bigger than the space to the end of
+    # the buffer must still fit (offsets rewind instead of deadlocking)
+    r = native.ShmRing("/pt_t_ring4", 4096)
+    r.put(b"a" * 3000)
+    assert len(r.get()) == 3000
+    r.put(b"b" * 3500, timeout_ms=2000)   # would hang before the rewind fix
+    assert len(r.get()) == 3500
+    r.close()
+    r.release()
+
+
+def test_ring_put_too_large_rejected():
+    r = native.ShmRing("/pt_t_ring3", 1024)
+    with pytest.raises(RuntimeError):
+        r.put(b"z" * 4096)
+    r.close()
+    r.release()
+
+
+# ------------------------------------------------- multi-process DataLoader
+
+class _SquareDataset:
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        return np.full((4, 4), i * i, dtype=np.float32), i
+
+
+def test_dataloader_multiprocess_matches_serial():
+    from paddle_tpu.io import DataLoader
+
+    ds = _SquareDataset()
+    serial = list(DataLoader(ds, batch_size=5, num_workers=0))
+    parallel = list(DataLoader(ds, batch_size=5, num_workers=2))
+    assert len(serial) == len(parallel) == 8
+    for (xs, ys), (xp, yp) in zip(serial, parallel):
+        np.testing.assert_array_equal(xs.numpy(), xp.numpy())
+        np.testing.assert_array_equal(ys.numpy(), yp.numpy())
+
+
+class _BoomDataset:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom on 5")
+        return np.zeros(2, dtype=np.float32)
+
+
+def test_dataloader_worker_exception_propagates():
+    from paddle_tpu.io import DataLoader
+
+    with pytest.raises(RuntimeError, match="boom on 5"):
+        list(DataLoader(_BoomDataset(), batch_size=4, num_workers=2))
