@@ -24,6 +24,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK = 128
+_LANE = 128           # TPU lane width; lse/delta carry a broadcast lane dim
 _NEG_INF = -1e30
 
 
@@ -82,8 +83,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         # but guard anyway so fully-masked rows emit 0, not NaN
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse = (m_ref[:, :1] + jnp.log(l_safe))[:, 0]
-        lse_ref[0] = lse
+        # lse is stored with a broadcast 128-lane trailing dim: TPU block
+        # shapes need the last two dims (8,128)-aligned, so a flat (BH, S)
+        # layout with (1, block_q) blocks is not lowerable
+        lse = m_ref[:, :1] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _mha_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -104,11 +108,11 @@ def _mha_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, _LANE), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),     # acc
@@ -141,8 +145,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]                                # (bq, 1)
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                                  # (bq, 1)
+        delta = delta_ref[0][:, :1]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -189,8 +193,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -226,7 +230,9 @@ def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
     BH, S, D = q.shape
     nq = S // block_q
     nk = S // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (BH, S, _LANE))
 
     dq_kernel = functools.partial(_dq_kernel, causal=causal,
                                   sm_scale=sm_scale, nk=nk,
@@ -239,8 +245,8 @@ def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -259,8 +265,8 @@ def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
