@@ -18,17 +18,21 @@ class TCPStore:
     """paddle-compatible surface: TCPStore(host, port, is_master, world_size)."""
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=30.0):
+                 world_size=1, timeout=900.0):
         self.host = host
         self.is_master = is_master
         self.world_size = world_size
+        # timeout governs connect AND every blocking store op (paddle
+        # TCPStore semantics): a dead peer turns into a TimeoutError, not a
+        # silent hang until the scheduler's wall clock
+        self._timeout_ms = int(timeout * 1000) if timeout and timeout > 0 else -1
         self._server = None
         if is_master:
             self._server = native.TCPStoreServer(port)
             port = self._server.port
         self.port = port
         self._client = native.TCPStoreClient(host, port,
-                                             timeout_ms=int(timeout * 1000))
+                                             timeout_ms=self._timeout_ms)
         self._barrier_gen = {}
 
     def set(self, key, value):
@@ -38,7 +42,7 @@ class TCPStore:
 
     def get(self, key):
         """Blocks until the key is set (paddle TCPStore.get semantics)."""
-        return self._client.wait(key)
+        return self._client.wait(key, timeout_ms=self._timeout_ms)
 
     def get_nowait(self, key):
         return self._client.get(key)
@@ -46,8 +50,9 @@ class TCPStore:
     def add(self, key, amount=1):
         return self._client.add(key, amount)
 
-    def wait(self, key):
-        return self._client.wait(key)
+    def wait(self, key, timeout=None):
+        tmo = int(timeout * 1000) if timeout is not None else self._timeout_ms
+        return self._client.wait(key, timeout_ms=tmo)
 
     def delete_key(self, key):
         self._client.delete(key)
@@ -69,7 +74,7 @@ class TCPStore:
                 prev = f"__barrier/{name}/{gen - 1}"
                 self.delete_key(prev + "/count")
                 self.delete_key(prev + "/release")
-        self._client.wait(key + "/release")
+        self._client.wait(key + "/release", timeout_ms=self._timeout_ms)
 
     def stop(self):
         if self._client is not None:

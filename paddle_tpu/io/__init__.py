@@ -22,7 +22,8 @@ import numpy as np
 
 from ..core.random import _default_generator
 from ..core.tensor import Tensor, to_tensor
-from .worker import WorkerInfo, get_worker_info, numpy_collate, worker_loop
+from .worker import (WorkerInfo, collate, get_worker_info, numpy_collate,
+                     worker_loop)
 
 
 class Dataset:
@@ -228,18 +229,7 @@ class DistributedBatchSampler(BatchSampler):
 
 
 def default_collate_fn(batch):
-    sample = batch[0]
-    if isinstance(sample, (tuple, list)):
-        return [default_collate_fn([b[i] for b in batch]) for i in range(len(sample))]
-    if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
-    if isinstance(sample, Tensor):
-        return to_tensor(np.stack([b.numpy() for b in batch]))
-    if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
-    if isinstance(sample, (int, float, np.integer, np.floating)):
-        return to_tensor(np.asarray(batch))
-    return batch
+    return collate(batch, to_tensor)
 
 
 class DataLoader:
@@ -329,59 +319,61 @@ class DataLoader:
         from .. import native
 
         ctx = mp.get_context("fork")
-        global _RING_SEQ
-        _RING_SEQ += 1
-        ring_name = f"/pt_dl_{os.getpid()}_{_RING_SEQ}"
+        ring_name = f"/pt_dl_{os.getpid()}_{next(_RING_SEQ)}"
         ring_cap = max(8 << 20,
                        self.num_workers * self.prefetch_factor * (4 << 20))
         ring = native.ShmRing(ring_name, ring_cap)
-        index_queue = ctx.Queue()
-        batches = list(self.batch_sampler)
-
-        # incremental dispatch: at most num_workers * prefetch_factor batch
-        # indices outstanding, so worker-side ring pressure AND parent-side
-        # reorder buffering both stay bounded (reference:
-        # dataloader_iter.py _try_put_indices / _outstanding_capacity)
-        dispatch_iter = iter(enumerate(batches))
-        max_outstanding = max(2, self.num_workers * self.prefetch_factor)
-        state = {"outstanding": 0, "exhausted": False}
-
-        def dispatch_one():
-            if state["exhausted"]:
-                return
-            item = next(dispatch_iter, None)
-            if item is None:
-                state["exhausted"] = True
-                for _ in range(self.num_workers):
-                    index_queue.put(None)
-                return
-            index_queue.put(item)
-            state["outstanding"] += 1
-
-        for _ in range(max_outstanding):
-            dispatch_one()
-
-        collate = (self.collate_fn if self._user_collate_fn else numpy_collate)
-        base_seed = int(np.random.randint(0, 2 ** 31))
-        procs = [
-            ctx.Process(
-                target=worker_loop,
-                args=(self.dataset, collate, ring_name, index_queue,
-                      self.worker_init_fn, wid, self.num_workers, base_seed),
-                daemon=True)
-            for wid in range(self.num_workers)
-        ]
-        for p in procs:
-            p.start()
-
-        # timeout=0 (default) means "no deadline" — poll in 10 s slices so a
-        # dead worker is still detected promptly (the watchdog role of
-        # launch_utils.watch_local_trainers)
-        user_deadline_ms = int(self.timeout * 1000) if self.timeout else None
-        poll_ms = min(user_deadline_ms, 10000) if user_deadline_ms else 10000
-        buffered = {}
-        next_idx = 0
+        procs = []
+        # everything past ring creation runs under the finally so a sampler
+        # exception or fork failure can't leak the shm segment / workers
         try:
+            index_queue = ctx.Queue()
+            batches = list(self.batch_sampler)
+
+            # incremental dispatch: at most num_workers * prefetch_factor
+            # batch indices outstanding, so worker-side ring pressure AND
+            # parent-side reorder buffering both stay bounded (reference:
+            # dataloader_iter.py _try_put_indices / _outstanding_capacity)
+            dispatch_iter = iter(enumerate(batches))
+            max_outstanding = max(2, self.num_workers * self.prefetch_factor)
+            exhausted = [False]
+
+            def dispatch_one():
+                if exhausted[0]:
+                    return
+                item = next(dispatch_iter, None)
+                if item is None:
+                    exhausted[0] = True
+                    for _ in range(self.num_workers):
+                        index_queue.put(None)
+                    return
+                index_queue.put(item)
+
+            for _ in range(max_outstanding):
+                dispatch_one()
+
+            worker_collate = (self.collate_fn if self._user_collate_fn
+                              else numpy_collate)
+            base_seed = int(np.random.randint(0, 2 ** 31))
+            procs = [
+                ctx.Process(
+                    target=worker_loop,
+                    args=(self.dataset, worker_collate, ring_name, index_queue,
+                          self.worker_init_fn, wid, self.num_workers,
+                          base_seed),
+                    daemon=True)
+                for wid in range(self.num_workers)
+            ]
+            for p in procs:
+                p.start()
+
+            # timeout=0 (default) means "no deadline" — poll in 10 s slices
+            # so a dead worker is still detected promptly (the watchdog role
+            # of launch_utils.watch_local_trainers)
+            user_deadline_ms = int(self.timeout * 1000) if self.timeout else None
+            poll_ms = min(user_deadline_ms, 10000) if user_deadline_ms else 10000
+            buffered = {}
+            next_idx = 0
             while next_idx < len(batches):
                 if next_idx in buffered:
                     yield self._finalize_batch(buffered.pop(next_idx))
@@ -404,7 +396,6 @@ class DataLoader:
                 if data is None:
                     raise RuntimeError("DataLoader ring closed early")
                 i, status, payload = pickle.loads(data)
-                state["outstanding"] -= 1
                 dispatch_one()
                 if status == "err":
                     raise RuntimeError(
@@ -431,4 +422,4 @@ class DataLoader:
         return batch
 
 
-_RING_SEQ = 0
+_RING_SEQ = itertools.count(1)  # itertools.count is atomic under the GIL

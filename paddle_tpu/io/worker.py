@@ -30,24 +30,34 @@ def get_worker_info():
     return _worker_info
 
 
-def numpy_collate(batch):
-    """Default collate for worker processes: stacks to numpy, never jax."""
+def collate(batch, leaf):
+    """Shared collate recursion: structure handling lives here once; `leaf`
+    decides what a stacked ndarray becomes (numpy in workers, device tensor
+    in the trainer)."""
     from ..core.tensor import Tensor
 
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
-        return [numpy_collate([b[i] for b in batch]) for i in range(len(sample))]
+        return [collate([b[i] for b in batch], leaf) for i in range(len(sample))]
     if isinstance(sample, dict):
-        return {k: numpy_collate([b[k] for b in batch]) for k in sample}
+        return {k: collate([b[k] for b in batch], leaf) for k in sample}
     if isinstance(sample, Tensor):
-        return np.stack([np.asarray(b.numpy()) for b in batch])
+        return leaf(np.stack([np.asarray(b.numpy()) for b in batch]))
     if isinstance(sample, np.ndarray):
-        return np.stack(batch)
-    if isinstance(sample, (int, np.integer)):
-        return np.asarray(batch, dtype=np.int64)
-    if isinstance(sample, (float, np.floating)):
-        return np.asarray(batch, dtype=np.float32)
+        return leaf(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        # let numpy promote mixed int/float batches; floats narrow to f32
+        # (framework default dtype) instead of numpy's f64
+        arr = np.asarray(batch)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        return leaf(arr)
     return batch
+
+
+def numpy_collate(batch):
+    """Default collate for worker processes: stacks to numpy, never jax."""
+    return collate(batch, lambda arr: arr)
 
 
 def worker_loop(dataset, collate_fn, ring_name, index_queue, worker_init_fn,
@@ -74,7 +84,7 @@ def worker_loop(dataset, collate_fn, ring_name, index_queue, worker_init_fn,
                 payload = pickle.dumps((i, "err", traceback.format_exc()))
             try:
                 ring.put(payload)
-            except RuntimeError:
+            except ValueError:
                 # batch bigger than the whole ring: report instead of dying
                 ring.put(pickle.dumps((
                     i, "err",

@@ -75,7 +75,8 @@ def _declare(lib):
         "ptn_store_client_close": (None, [P]),
         "ptn_store_set": (I32, [P, S, ctypes.c_char_p, U64]),
         "ptn_store_get": (I32, [P, S, ctypes.POINTER(P), ctypes.POINTER(U64)]),
-        "ptn_store_wait": (I32, [P, S, ctypes.POINTER(P), ctypes.POINTER(U64)]),
+        "ptn_store_wait": (I32, [P, S, I64, ctypes.POINTER(P),
+                                 ctypes.POINTER(U64)]),
         "ptn_store_add": (I32, [P, S, I64, ctypes.POINTER(I64)]),
         "ptn_store_delete": (I32, [P, S]),
         "ptn_arena_create": (P, [U64]),
@@ -127,6 +128,9 @@ class ShmRing:
             raise EOFError("ring closed")
         if rc == -1:
             raise TimeoutError("ring put timeout")
+        if rc == -3:
+            raise ValueError(f"record of {len(data)} bytes larger than ring "
+                             f"capacity")
         if rc != 0:
             raise RuntimeError(f"ring put failed ({rc})")
 
@@ -192,12 +196,16 @@ class TCPStoreClient:
             return None
         return _take_buf(pp, ln)
 
-    def wait(self, key):
-        """Blocks until the key exists, returns its value."""
+    def wait(self, key, timeout_ms=-1):
+        """Blocks until the key exists (or timeout_ms elapses), returns its
+        value."""
         pp = ctypes.c_void_p()
         ln = ctypes.c_uint64()
-        if _lib.ptn_store_wait(self._h, key.encode(), ctypes.byref(pp),
-                               ctypes.byref(ln)) != 0:
+        rc = _lib.ptn_store_wait(self._h, key.encode(), timeout_ms,
+                                 ctypes.byref(pp), ctypes.byref(ln))
+        if rc == -2:
+            raise TimeoutError(f"store wait timed out: {key}")
+        if rc != 0:
             raise RuntimeError(f"store wait failed: {key}")
         return _take_buf(pp, ln)
 

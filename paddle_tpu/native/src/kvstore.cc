@@ -7,10 +7,11 @@
 //
 // Protocol (length-prefixed, little-endian):
 //   request:  u8 cmd | u32 klen | key | u64 vlen | value
-//   response: u8 ok  | u64 vlen | value
-// Commands: 1=SET 2=GET(nonblock) 3=WAIT(get, block until set) 4=ADD(i64)
-//           5=DELETE
+//   response: u8 ok  | u64 vlen | value     (ok: 1=found 0=miss/err 2=timeout)
+// Commands: 1=SET 2=GET(nonblock) 3=WAIT(get, block until set; optional i64
+//           timeout_ms payload) 4=ADD(i64) 5=DELETE
 #include <arpa/inet.h>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -25,6 +26,12 @@
 #include <vector>
 
 namespace {
+
+// the port is reachable by anything on the network: cap lengths so a stray
+// scanner's garbage can't drive a huge allocation (uncaught bad_alloc in a
+// worker thread would terminate the whole trainer)
+constexpr uint32_t kMaxKeyLen = 1u << 16;
+constexpr uint64_t kMaxValLen = 1ull << 30;
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = (uint8_t*)buf;
@@ -67,9 +74,11 @@ struct Server {
       uint32_t klen;
       uint64_t vlen;
       if (!read_full(fd, &cmd, 1) || !read_full(fd, &klen, 4)) break;
+      if (klen > kMaxKeyLen) break;  // malformed/hostile: drop connection
       std::string key(klen, '\0');
       if (klen && !read_full(fd, &key[0], klen)) break;
       if (!read_full(fd, &vlen, 8)) break;
+      if (vlen > kMaxValLen) break;
       std::string val(vlen, '\0');
       if (vlen && !read_full(fd, &val[0], vlen)) break;
 
@@ -89,10 +98,16 @@ struct Server {
           else out = it->second;
           break;
         }
-        case 3: {  // WAIT (blocking get)
+        case 3: {  // WAIT (blocking get, optional i64 timeout_ms payload)
+          int64_t tmo = -1;
+          if (val.size() == 8) memcpy(&tmo, val.data(), 8);
           std::unique_lock<std::mutex> g(mu);
-          cv.wait(g, [&] { return stopping || kv.count(key); });
-          if (stopping) ok = 0;
+          auto pred = [&] { return stopping || kv.count(key); };
+          bool signalled = true;
+          if (tmo < 0) cv.wait(g, pred);
+          else signalled = cv.wait_for(g, std::chrono::milliseconds(tmo), pred);
+          if (!signalled) ok = 2;           // timeout
+          else if (!kv.count(key)) ok = 0;  // stopping
           else out = kv[key];
           break;
         }
@@ -217,10 +232,11 @@ void* ptn_store_client_connect(const char* host, int port, int timeout_ms) {
     return nullptr;
   }
   // simple retry loop: the server rank may come up later
+  // (timeout_ms < 0 = retry forever)
   int waited = 0;
   while (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
     ::close(fd);
-    if (waited >= timeout_ms) return nullptr;
+    if (timeout_ms >= 0 && waited >= timeout_ms) return nullptr;
     usleep(100 * 1000);
     waited += 100;
     fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -233,7 +249,8 @@ void* ptn_store_client_connect(const char* host, int port, int timeout_ms) {
   return c;
 }
 
-// returns 0 ok / -1 not-found-or-error; GET/WAIT/ADD fill *out (malloc'd)
+// returns 0 ok / -1 not-found-or-error / -2 timeout;
+// GET/WAIT/ADD fill *out (malloc'd)
 static int request(Client* c, uint8_t cmd, const char* key, const void* val,
                    uint64_t vlen, void** out, uint64_t* out_len) {
   std::lock_guard<std::mutex> g(c->mu);
@@ -245,8 +262,10 @@ static int request(Client* c, uint8_t cmd, const char* key, const void* val,
   uint8_t ok;
   uint64_t olen;
   if (!read_full(c->fd, &ok, 1) || !read_full(c->fd, &olen, 8)) return -1;
+  if (olen > kMaxValLen) return -1;
   std::string o(olen, '\0');
   if (olen && !read_full(c->fd, &o[0], olen)) return -1;
+  if (ok == 2) return -2;
   if (!ok) return -1;
   if (out) {
     *out = malloc(olen ? olen : 1);
@@ -264,8 +283,11 @@ int ptn_store_get(void* cp, const char* key, void** out, uint64_t* len) {
   return request((Client*)cp, 2, key, nullptr, 0, out, len);
 }
 
-int ptn_store_wait(void* cp, const char* key, void** out, uint64_t* len) {
-  return request((Client*)cp, 3, key, nullptr, 0, out, len);
+int ptn_store_wait(void* cp, const char* key, int64_t timeout_ms, void** out,
+                   uint64_t* len) {
+  if (timeout_ms < 0)
+    return request((Client*)cp, 3, key, nullptr, 0, out, len);
+  return request((Client*)cp, 3, key, &timeout_ms, 8, out, len);
 }
 
 int ptn_store_add(void* cp, const char* key, int64_t delta, int64_t* result) {

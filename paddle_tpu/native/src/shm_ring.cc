@@ -131,7 +131,7 @@ void* ptn_ring_attach(const char* name) {
   return r;
 }
 
-// 0 ok, -1 timeout, -2 closed, -3 too large / bad args
+// 0 ok, -1 timeout, -2 closed, -3 too large, -4 wait/lock failure
 int ptn_ring_put(void* rp, const void* buf, uint64_t len, int timeout_ms) {
   auto* r = (Ring*)rp;
   RingHeader* h = r->h;
@@ -140,7 +140,7 @@ int ptn_ring_put(void* rp, const void* buf, uint64_t len, int timeout_ms) {
 
   timespec ts;
   if (timeout_ms >= 0) abs_deadline(&ts, timeout_ms);
-  if (lock_robust(&h->mu) != 0) return -3;
+  if (lock_robust(&h->mu) != 0) return -4;
   for (;;) {
     if (h->closed) {
       pthread_mutex_unlock(&h->mu);
@@ -173,21 +173,29 @@ int ptn_ring_put(void* rp, const void* buf, uint64_t len, int timeout_ms) {
     int rc = (timeout_ms < 0)
                  ? pthread_cond_wait(&h->not_full, &h->mu)
                  : pthread_cond_timedwait(&h->not_full, &h->mu, &ts);
-    if (rc == ETIMEDOUT) {
+    if (rc == EOWNERDEAD) {
+      // a peer died holding the lock while we were waiting: the implicit
+      // re-lock inside cond_wait reported it — recover the mutex or every
+      // later lock fails ENOTRECOVERABLE
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
+    } else if (rc != 0) {
+      pthread_mutex_unlock(&h->mu);
+      return -4;  // wait machinery failed — distinct from -3 (too large)
     }
   }
 }
 
 // 0 ok (malloc'd copy in *out, free with ptn_buf_free), -1 timeout,
-// -2 closed-and-drained
+// -2 closed-and-drained, -4 wait failure
 int ptn_ring_get(void* rp, void** out, uint64_t* out_len, int timeout_ms) {
   auto* r = (Ring*)rp;
   RingHeader* h = r->h;
   timespec ts;
   if (timeout_ms >= 0) abs_deadline(&ts, timeout_ms);
-  if (lock_robust(&h->mu) != 0) return -3;
+  if (lock_robust(&h->mu) != 0) return -4;
   for (;;) {
     while (h->head != h->tail) {
       uint64_t off = h->tail % h->capacity;
@@ -218,9 +226,14 @@ int ptn_ring_get(void* rp, void** out, uint64_t* out_len, int timeout_ms) {
     int rc = (timeout_ms < 0)
                  ? pthread_cond_wait(&h->not_empty, &h->mu)
                  : pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
-    if (rc == ETIMEDOUT) {
+    if (rc == EOWNERDEAD) {
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
+    } else if (rc != 0) {
+      pthread_mutex_unlock(&h->mu);
+      return -4;
     }
   }
 }

@@ -106,6 +106,29 @@ def test_store_wait_blocks_until_set():
     s.stop()
 
 
+def test_store_wait_timeout():
+    s = native.TCPStoreServer()
+    c = native.TCPStoreClient(port=s.port)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        c.wait("never-set", timeout_ms=300)
+    assert 0.2 < time.monotonic() - t0 < 5.0
+    # the connection stays usable after a timed-out wait
+    c.set("k", b"v")
+    assert c.wait("k", timeout_ms=1000) == b"v"
+    c.close()
+    s.stop()
+
+
+def test_tcpstore_wait_applies_store_timeout():
+    from paddle_tpu.distributed import TCPStore
+
+    st = TCPStore(is_master=True, world_size=2, timeout=0.3)
+    with pytest.raises(TimeoutError):
+        st.wait("absent")
+    st.stop()
+
+
 def test_tcpstore_class_barrier():
     from paddle_tpu.distributed import TCPStore
 
@@ -195,7 +218,7 @@ def test_ring_rewind_on_empty_no_deadlock():
 
 def test_ring_put_too_large_rejected():
     r = native.ShmRing("/pt_t_ring3", 1024)
-    with pytest.raises(RuntimeError):
+    with pytest.raises(ValueError):
         r.put(b"z" * 4096)
     r.close()
     r.release()
