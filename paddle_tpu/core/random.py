@@ -83,3 +83,18 @@ def get_rng_state():
 
 def set_rng_state(state):
     _default_generator.set_state(state)
+
+
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def fork_rng(seed_):
+    """Run a region under an independent, reproducible RNG stream, restoring
+    the previous state on exit (used by the TP RNGStatesTracker)."""
+    saved = _default_generator.get_state()
+    _default_generator.manual_seed(int(seed_))
+    try:
+        yield
+    finally:
+        _default_generator.set_state(saved)

@@ -7,6 +7,7 @@ wrapped model exposes the same surface as the reference
 """
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from . import meta_parallel  # noqa: F401
 from .. import env as _env
 
 _fleet_state = {"initialized": False, "strategy": None, "hcg": None}
@@ -58,11 +59,82 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """Reference: fleet/fleet.py distributed_optimizer +
+    """Reference: fleet/fleet.py distributed_optimizer — applies the
+    meta-optimizers selected by DistributedStrategy flags (reference:
+    strategy_compiler.py composing program-rewrite passes), then wraps in
     HybridParallelOptimizer."""
     from ..parallel_layers import HybridParallelOptimizer
     hcg = _fleet_state["hcg"]
-    return HybridParallelOptimizer(optimizer, hcg, _fleet_state["strategy"])
+    strategy = strategy or _fleet_state["strategy"] or DistributedStrategy()
+
+    # optimizer-substitution meta-optimizers (reference: lars/lamb passes
+    # swap the optimize op; here we swap the update rule)
+    from ...optimizer import Lamb, LarsMomentum, Momentum, SGD
+    if strategy.lars and isinstance(optimizer, (SGD, Momentum)):
+        cfg = getattr(strategy, "lars_configs", {}) or {}
+        optimizer = LarsMomentum(
+            learning_rate=optimizer._lr,
+            momentum=getattr(optimizer, "_momentum", 0.9),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            parameters=optimizer._parameters,
+            grad_clip=optimizer._grad_clip,
+            exclude_from_weight_decay=cfg.get("exclude_from_weight_decay", []))
+    if strategy.lamb and not isinstance(optimizer, Lamb):
+        cfg = getattr(strategy, "lamb_configs", {}) or {}
+        optimizer = Lamb(
+            learning_rate=optimizer._lr,
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            parameters=optimizer._parameters,
+            grad_clip=optimizer._grad_clip)
+
+    opt = HybridParallelOptimizer(optimizer, hcg, strategy)
+    if strategy.gradient_merge:
+        k = int(strategy.gradient_merge_configs.get("k_steps", 1))
+        avg = bool(strategy.gradient_merge_configs.get("avg", True))
+        opt = GradientMergeOptimizer(opt, k_steps=k, avg=avg)
+    return opt
+
+
+class GradientMergeOptimizer:
+    """Gradient-merge meta-optimizer (reference:
+    meta_optimizers/gradient_merge_optimizer.py): accumulate grads for
+    k_steps calls of step(), apply once."""
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        self._inner = inner
+        self._k = max(int(k_steps), 1)
+        self._avg = avg
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._count += 1
+        if self._count < self._k:
+            return  # keep accumulating (grads sum across backward calls)
+        if self._avg and self._k > 1:
+            for p in self._inner._inner_opt._parameters:
+                if p._grad_data is not None:
+                    p._grad_data = p._grad_data / float(self._k)
+        self._inner.step()
+        self._inner.clear_grad()
+        self._count = 0
+
+    def clear_grad(self, *a, **k):
+        # only clear when a full merge window just applied; mid-window the
+        # accumulated grads must survive the user's step()/clear_grad() pair
+        if self._count == 0:
+            self._inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        # must NOT fall through __getattr__ to the inner minimize (that
+        # would bypass the merge window entirely)
+        loss.backward()
+        self.step()
 
 
 def barrier_worker():

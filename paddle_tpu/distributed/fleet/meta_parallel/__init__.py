@@ -1,0 +1,57 @@
+"""fleet.meta_parallel — pipeline layers + hybrid wrappers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/ (pp_layers.py:211
+PipelineLayer, pipeline_parallel.py:120-200 1F1B schedule, :464 interleaved
+schedule, tensor_parallel.py, sharding/).
+"""
+from .pp_layers import (LayerDesc, PipelineLayer, PipelineParallel,
+                        SharedLayerDesc)
+from ...parallel_layers import DataParallel as TensorParallel  # facade alias
+from ...parallel_layers import DataParallel as ShardingParallel  # facade alias
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel", "TensorParallel", "ShardingParallel",
+           "get_rng_state_tracker", "RNGStatesTracker"]
+
+
+class RNGStatesTracker:
+    """TP-aware dropout RNG (reference: parallel_layers/random.py
+    get_rng_state_tracker — tracks per-group generator states so dropout is
+    identical inside a TP group but different across groups).
+
+    TPU-native: randomness is stateless PRNG keys. Entering `rng_state(name)`
+    folds the name into the key stream, so 'global_seed' vs 'local_seed'
+    regions draw from decorrelated, reproducible streams — the same contract,
+    without mutable generator state."""
+
+    def __init__(self):
+        self._seeds = {}
+
+    def add(self, name, seed):
+        self._seeds[name] = int(seed)
+
+    def get_states_tracker(self):
+        return dict(self._seeds)
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        from ....core import random as _rng
+
+        @contextlib.contextmanager
+        def ctx():
+            seed = self._seeds.get(name)
+            if seed is None:
+                # deterministic fold of the region name
+                seed = abs(hash(name)) % (2 ** 31)
+            with _rng.fork_rng(seed):
+                yield
+
+        return ctx()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

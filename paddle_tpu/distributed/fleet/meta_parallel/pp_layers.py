@@ -1,0 +1,239 @@
+"""Pipeline-parallel layers + schedule.
+
+Reference:
+- `PipelineLayer` partitions a LayerDesc list into stages
+  (fleet/meta_parallel/parallel_layers/pp_layers.py:211; segmentation
+  `uniform` / by param count; shared embeddings via SharedLayerDesc:79).
+- `PipelineParallel.forward_backward_pipeline` runs the 1F1B schedule
+  (fleet/meta_parallel/pipeline_parallel.py:120-200) over send_v2/recv_v2
+  p2p ops with a SendRecvMeta shape handshake (pp_utils/p2p_communication.py).
+
+TPU-native design: under a single-controller SPMD runtime there is no
+per-stage process and no p2p handshake — the whole pipeline lives in one
+program. `train_batch` runs the micro-batch loop (forward/backward per
+micro-batch with gradient accumulation, one optimizer step), which is
+numerically identical to 1F1B (the schedule only changes overlap, which XLA
+owns here). The compiled mega-step path — stage loop inside `shard_map` with
+`collective_permute` activations riding ICI, `lax.scan` over the 1F1B ticks
+— is `paddle_tpu.parallel.gpt_spmd._pipeline_loss`, which this API feeds
+when the model is a homogeneous block stack.
+
+Shared embeddings (tied input/output weights) need no gradient allreduce:
+a SharedLayerDesc key maps to ONE Layer object reused in both stages, so the
+autograd tape accumulates both contributions into the same parameter —
+`allreduce_shared_weight_gradients` is therefore a structural no-op kept for
+API parity.
+"""
+import re
+
+import numpy as np
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input of a LayerDesc must be a Layer class")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer shared between stages (reference pp_layers.py:79): the classic
+    use is tying the input embedding and the output projection."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Holds every stage of the pipeline (single controller = all stages
+    resident) with a recorded stage partition.
+
+    seg_method: "uniform" (equal layer counts), "param" (balance by
+    parameter count), or "layer:ClassName" (stage boundaries before each
+    named layer class, reference-style).
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = max(int(num_stages or 1), 1)
+        self._recompute_interval = recompute_interval
+
+        self._shared = {}      # key -> built Layer
+        self._descs = list(layers)
+        built = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append((self._shared[d.layer_name], d))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), d))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline entry: {d!r}")
+        self._built = built
+        for i, (l, _) in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(f"seg_{i}", l)
+
+        self._boundaries = self._segment(seg_method)
+
+    # -------------------------------------------------------- segmentation
+    def _param_counts(self):
+        counts = []
+        for l, _ in self._built:
+            n = 0
+            if isinstance(l, Layer):
+                n = sum(int(np.prod(p.shape)) for p in l.parameters())
+            counts.append(max(n, 1))
+        return counts
+
+    def _segment(self, method):
+        n = len(self._built)
+        k = self._num_stages
+        if k <= 1:
+            return [0, n]
+        if method == "uniform":
+            bounds = [round(i * n / k) for i in range(k + 1)]
+        elif method == "param":
+            w = np.cumsum(self._param_counts())
+            total = w[-1]
+            bounds = [0]
+            for s in range(1, k):
+                bounds.append(int(np.searchsorted(w, total * s / k)) + 1)
+            bounds.append(n)
+            bounds = sorted(set(min(b, n) for b in bounds))
+            while len(bounds) < k + 1:   # degenerate tiny models
+                bounds.append(n)
+        elif method.startswith("layer:"):
+            name = method.split(":", 1)[1]
+            marks = [i for i, (l, _) in enumerate(self._built)
+                     if type(l).__name__ == name]
+            if len(marks) < k:
+                raise ValueError(f"only {len(marks)} '{name}' layers for "
+                                 f"{k} stages")
+            per = len(marks) // k
+            bounds = [0] + [marks[per * s] for s in range(1, k)] + [n]
+        else:
+            raise ValueError(f"unknown seg_method {method!r}")
+        return bounds
+
+    # ------------------------------------------------------------- queries
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage):
+        lo, hi = self._boundaries[stage], self._boundaries[stage + 1]
+        return [l for l, _ in self._built[lo:hi]]
+
+    def stage_of_layer(self, idx):
+        return int(np.searchsorted(self._boundaries, idx, side="right")) - 1
+
+    def allreduce_shared_weight_gradients(self):
+        """No-op by construction: shared descs reuse one Layer object, so
+        both stages' grads already accumulate into the same parameter."""
+
+    # ------------------------------------------------------------- forward
+    def forward(self, x):
+        from .... import amp  # noqa: F401  (autocast state visible to layers)
+        for i, (l, desc) in enumerate(self._built):
+            if isinstance(desc, SharedLayerDesc) and desc.forward_func \
+                    is not None:
+                x = desc.forward_func(l, x)
+            else:
+                x = l(x)
+            if self._recompute_interval and isinstance(x, Tensor):
+                # recompute segmentation is applied by the compiled runner
+                # (jax.checkpoint); eager execution keeps activations
+                pass
+        return x
+
+
+class PipelineParallel(Layer):
+    """Micro-batched pipeline trainer (reference:
+    meta_parallel/pipeline_parallel.py PipelineParallel.train_batch)."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = getattr(strategy, "pipeline_configs", None) or {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.schedule_mode = cfg.get("schedule_mode", "1F1B")
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def _split_micro(self, data):
+        x, y = data
+        m = self.accumulate_steps
+        xs = x.split(m, axis=0) if m > 1 else [x]
+        ys = y.split(m, axis=0) if m > 1 else [y]
+        return list(zip(xs, ys))
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One pipeline step: micro-batch loop, grad accumulation, one
+        optimizer step. Returns the averaged loss tensor."""
+        if self._layers._loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        micro = self._split_micro(data)
+        m = len(micro)
+        optimizer.clear_grad()
+        total = None
+        for x_mb, y_mb in micro:
+            out = self._layers(x_mb)
+            loss = self._layers._loss_fn(out, y_mb)
+            loss = loss / float(m)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        micro = self._split_micro(data)
+        total = None
+        for x_mb, y_mb in micro:
+            out = self._layers(x_mb)
+            if compute_loss:
+                out = self._layers._loss_fn(out, y_mb) / float(len(micro))
+            total = out if total is None else total + out
+        return total

@@ -1,0 +1,13 @@
+"""paddle.distributed.launch — multi-process/multi-host job launcher.
+
+Reference: python/paddle/distributed/launch/main.py:18 + controllers/
+(collective.py spawns trainers with PADDLE_* env; master.py provides an
+HTTP/etcd rendezvous; watcher.py tears the job down when a trainer dies).
+
+TPU-native: one process per HOST drives all local chips (SPMD single
+controller), so `--nproc_per_node` defaults to 1; the rendezvous master is
+the native TCPStore (rank 0 hosts it); trainer death handling is the same
+watchdog loop. Multi-host jax.distributed bootstrap reads the PADDLE_*
+variables this launcher sets (distributed/env.py init_parallel_env).
+"""
+from .main import launch, main  # noqa: F401

@@ -111,9 +111,8 @@ def wrap_distributed_model(model, hcg, strategy):
         return DataParallel(model)
     mode = hcg.get_parallel_mode()
     if mode == "pipeline":
-        # PipelineLayer models manage their own schedule (parallel/pp_layers)
-        from .parallel.pp_layers import PipelineParallel
-        if hasattr(model, "get_stage_layers"):
+        from .fleet.meta_parallel import PipelineLayer, PipelineParallel
+        if isinstance(model, PipelineLayer):
             return PipelineParallel(model, hcg, strategy)
         return DataParallel(model)
     # data/model/sharding parallel: transparent wrapper; shardings are applied

@@ -1,0 +1,229 @@
+"""paddle.inference equivalent — the deploy product.
+
+Reference: paddle/fluid/inference (§2.7 of SURVEY.md): `AnalysisPredictor`
+(inference/api/analysis_predictor.h:95) loads a saved ProgramDesc + params,
+runs IR fusion passes, optionally offloads subgraphs to TensorRT, and serves
+through zero-copy input/output handles (details/zero_copy_tensor.cc), with
+`AnalysisConfig` (inference/api/analysis_config.cc) as the knob surface.
+
+TPU-native design: the saved artifact is an AOT-exported StableHLO program
+(`paddle_tpu.jit.save`) — the XLA compiler IS the analysis/fusion pass
+pipeline, so `switch_ir_optim`-style knobs are accepted-and-absorbed. The
+Predictor deserializes the program once, compiles per concrete input shape
+(shape-polymorphic artifacts recompile per batch size, cached), and serves
+through handle objects whose `copy_from_cpu`/`copy_to_cpu` map to device
+put/get — the TPU analogue of zero-copy CPU tensors.
+"""
+import os
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PrecisionType", "PlaceType",
+           "create_predictor", "get_version"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    TPU = "tpu"
+    # reference enum also has GPU/XPU/NPU — single-backend build
+    GPU = "tpu"
+
+
+class Config:
+    """AnalysisConfig-compatible surface. Knobs that XLA owns are recorded
+    but have no effect (noted per method)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and params_file is None and \
+                os.path.isdir(prog_file):
+            # Config(model_dir) form
+            d = prog_file
+            prog_file = os.path.join(d, "__model__")
+            params_file = os.path.join(d, "__params__")
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._precision = PrecisionType.Float32
+        self._memory_optim = True
+        self._ir_optim = True
+        self._profile = False
+        self._glog_info = True
+        self._cpu_math_threads = 1
+
+    # -- model location ----------------------------------------------------
+    def set_prog_file(self, path):
+        self._prog_file = path
+
+    def set_params_file(self, path):
+        self._params_file = path
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    def set_model(self, prog_file, params_file):
+        self._prog_file = prog_file
+        self._params_file = params_file
+
+    # -- device ------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        """Single-backend build: selects the TPU (memory pool is managed by
+        the XLA runtime allocator, the size hint is ignored)."""
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    # -- optimization knobs (absorbed by XLA) --------------------------------
+    def switch_ir_optim(self, x=True):
+        """Graph fusion/layout passes are XLA's job; kept for parity."""
+        self._ir_optim = x
+
+    def enable_memory_optim(self, x=True):
+        """Buffer reuse is XLA's job; kept for parity."""
+        self._memory_optim = x
+
+    def enable_tensorrt_engine(self, *a, **k):
+        """TensorRT is CUDA-specific; the XLA TPU compiler plays this role.
+        Accepted as a no-op so deploy scripts port unchanged."""
+
+    def enable_profile(self):
+        self._profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        pass
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+    def summary(self):
+        return (f"Config(prog={self._prog_file}, params={self._params_file}, "
+                f"device={self._device}, precision={self._precision})")
+
+
+class _Handle:
+    """Zero-copy-style IO handle (reference: ZeroCopyTensor). Inputs stage a
+    host array and device-put lazily at run(); outputs hold the device
+    array and copy_to_cpu fetches it."""
+
+    def __init__(self, name, shape=None, dtype=None):
+        self.name = name
+        self._shape = shape
+        self._dtype = dtype
+        self._value = None
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def share_external_data(self, arr):
+        self._value = arr  # no copy; caller keeps it alive
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        v = self._value
+        return list(v.shape) if v is not None else list(self._shape or [])
+
+    def type(self):
+        return self._dtype
+
+
+class Predictor:
+    """AnalysisPredictor equivalent over a deserialized AOT program."""
+
+    def __init__(self, config):
+        from jax import export as jexport
+
+        import jax.numpy as jnp
+
+        from ..framework.io import load as _load
+
+        self._config = config
+        with open(config.prog_file(), "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        payload = _load(config.params_file(), return_numpy=True)
+        self._params = {n: jnp.asarray(v) for n, v in payload["params"].items()}
+        self._buffers = {n: jnp.asarray(v)
+                         for n, v in payload["buffers"].items()}
+
+        # in_avals is the FLATTENED calling convention: one aval per
+        # param/buffer leaf, then the user inputs
+        n_state = len(self._params) + len(self._buffers)
+        in_avals = self._exported.in_avals[n_state:]
+        # the exported calling convention flattens pytrees; user-facing input
+        # names are positional (feed order == input_spec order at save time)
+        self._input_names = [f"input_{i}" for i in range(len(in_avals))]
+        self._inputs = {n: _Handle(n, tuple(a.shape), str(a.dtype))
+                        for n, a in zip(self._input_names, in_avals)}
+        self._output_names = []
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Execute. Either feed via handles then run(), or pass a list of
+        numpy arrays directly (returns list of numpy outputs)."""
+        import jax.numpy as jnp
+
+        if inputs is not None:
+            for n, arr in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(arr))
+        args = [jnp.asarray(self._inputs[n]._value) for n in self._input_names]
+        out = self._exported.call(self._params, self._buffers, *args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._output_names, outs):
+            h = _Handle(n, tuple(o.shape), str(o.dtype))
+            h._value = o
+            self._outputs[n] = h
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def get_version():
+    from .. import __version__
+    return __version__

@@ -80,7 +80,7 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
         if isinstance(fn, Layer):
             fn.forward = StaticFunction(fn.forward.__func__
                                         if hasattr(fn.forward, "__func__") else fn.forward,
-                                        layer=fn)
+                                        layer=fn, input_spec=input_spec)
             return fn
         return StaticFunction(fn, input_spec=input_spec)
     if function is not None:
@@ -88,17 +88,124 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     return decorate
 
 
+MODEL_SUFFIX = ".pdmodel"      # serialized jax.export.Exported (StableHLO)
+PARAMS_SUFFIX = ".pdiparams"   # params + buffers payload
+
+
+def _to_arg_specs(input_spec):
+    """InputSpec/Tensor list → ShapeDtypeStructs; None/-1 dims become
+    export symbolic dims (shape-polymorphic serving: one artifact, any
+    batch size — the reference gets this from ProgramDesc's -1 dims)."""
+    import jax
+    from jax import export as jexport
+
+    from ..static import InputSpec
+
+    scope = jexport.SymbolicScope()
+    specs = []
+    sym_by_pos = {}
+    for i, s in enumerate(input_spec):
+        if isinstance(s, Tensor):
+            s = InputSpec.from_tensor(s)
+        dims = []
+        for j, d in enumerate(s.shape):
+            if d is None or (isinstance(d, int) and d < 0):
+                # dynamic dims at the same POSITION share one symbol — two
+                # [None, 8] inputs get the same batch dim, as a ProgramDesc
+                # with -1 dims would; distinct positions stay independent
+                if j not in sym_by_pos:
+                    sym_by_pos[j] = jexport.symbolic_shape(
+                        f"dim{j}", scope=scope)[0]
+                dims.append(sym_by_pos[j])
+            else:
+                dims.append(d)
+        specs.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+    return specs
+
+
+def _export_layer(layer, input_spec):
+    """Trace the layer's eval-mode forward into a serializable AOT program
+    (reference: @to_static capture into ProgramDesc + jit/serializer.cc;
+    here the program IS the exported StableHLO)."""
+    import jax
+    from jax import export as jexport
+
+    params, buffers = functional_state(layer)
+
+    def pure(params, buffers, *inputs):
+        out, _ = functional_call(layer, params, buffers,
+                                 args=tuple(Tensor(a) for a in inputs),
+                                 train=False)
+        return unwrap(out)
+
+    shape_of = lambda tree: jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+    arg_specs = _to_arg_specs(input_spec)
+    exp = jexport.export(jax.jit(pure))(shape_of(params), shape_of(buffers),
+                                        *arg_specs)
+    return exp, params, buffers
+
+
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persists params + config (AOT executable export is
-    handled by paddle_tpu.inference)."""
+    """paddle.jit.save equivalent: writes `path.pdmodel` (serialized AOT
+    program, shape-polymorphic over None dims) + `path.pdiparams` (weights).
+
+    Reference: python/paddle/fluid/dygraph/jit.py jit.save → TranslatedLayer
+    (program + params via fluid/jit/serializer.cc)."""
     from ..framework.io import save as _save
-    _save(layer.state_dict(), path + ".pdparams")
+
+    if isinstance(layer, StaticFunction):
+        raise TypeError("pass the Layer itself, not its StaticFunction")
+    if input_spec is None:
+        # a @to_static(input_spec=...) forward carries the spec already
+        fwd = getattr(layer, "forward", None)
+        input_spec = getattr(fwd, "_input_spec", None)
+    if input_spec is None:
+        raise ValueError("paddle_tpu.jit.save requires input_spec (list of "
+                         "InputSpec or example Tensors), or a forward "
+                         "decorated @to_static(input_spec=...)")
+    exp, params, buffers = _export_layer(layer, input_spec)
+    with open(path + MODEL_SUFFIX, "wb") as f:
+        f.write(exp.serialize())
+    _save({"params": params, "buffers": buffers}, path + PARAMS_SUFFIX)
+
+
+class TranslatedLayer(Layer):
+    """A deserialized AOT program + weights, callable like the original
+    Layer (inference only — the exported program is the eval-mode forward)."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._param_tree = params
+        self._buffer_tree = buffers
+
+    def forward(self, *inputs):
+        raw = tuple(a._data if isinstance(a, Tensor) else a for a in inputs)
+        out = self._exported.call(self._param_tree, self._buffer_tree, *raw)
+        if isinstance(out, (tuple, list)):
+            return type(out)(Tensor(o, stop_gradient=True) for o in out)
+        return Tensor(out, stop_gradient=True)
+
+    def state_dict(self, *a, **k):
+        d = dict(self._param_tree)
+        d.update(self._buffer_tree)
+        return {n: Tensor(v, stop_gradient=True) for n, v in d.items()}
 
 
 def load(path, **configs):
-    raise NotImplementedError(
-        "paddle_tpu.jit.load: load weights with paddle_tpu.load and rebuild "
-        "the Layer; AOT executables via paddle_tpu.inference")
+    """paddle.jit.load equivalent → TranslatedLayer."""
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from ..framework.io import load as _load
+
+    with open(path + MODEL_SUFFIX, "rb") as f:
+        exp = jexport.deserialize(f.read())
+    payload = _load(path + PARAMS_SUFFIX, return_numpy=True)
+    as_jnp = lambda tree: {n: jnp.asarray(v) for n, v in tree.items()}
+    return TranslatedLayer(exp, as_jnp(payload["params"]),
+                           as_jnp(payload["buffers"]))
 
 
 def not_to_static(fn=None):

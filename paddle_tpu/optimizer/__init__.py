@@ -1,5 +1,6 @@
 """paddle.optimizer equivalent (reference: python/paddle/optimizer)."""
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp, SGD,
+    Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    Optimizer, RMSProp, SGD,
 )

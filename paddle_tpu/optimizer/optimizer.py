@@ -358,6 +358,43 @@ class Lamb(Optimizer):
         return new_p, {"moment1": m, "moment2": v, "step": t}
 
 
+class LarsMomentum(Optimizer):
+    """LARS (reference: fleet meta-optimizer `lars` over
+    operators/optimizers/lars_momentum_op): layer-wise trust-ratio-scaled
+    momentum SGD for large-batch training."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay=None,
+                 epsilon=0.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._decay = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _init_state(self, param_data):
+        return {"velocity": jnp.zeros_like(param_data, dtype=jnp.float32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        decay = self._decay
+        name = self._current_param_name or ""
+        if any(tag in name for tag in self._exclude):
+            decay = 0.0
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + decay * w_norm + self._eps),
+            1.0)
+        v = self._momentum * state["velocity"] + \
+            lr_t * local_lr * (g + decay * p32)
+        return (p32 - v).astype(param.dtype), {"velocity": v}
+
+
 class Adadelta(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):

@@ -96,6 +96,18 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        # load_inference_model returns a callable program (TranslatedLayer):
+        # execute it paddle-style with the feed dict in feed-name order
+        if callable(program):
+            feed = feed or {}
+            args = [Tensor(jnp.asarray(np.asarray(feed[k])))
+                    for k in sorted(feed.keys())]
+            out = program(*args)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            if return_numpy:
+                outs = [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                        for o in outs]
+            return outs
         outs = []
         for f in (fetch_list or []):
             if isinstance(f, Tensor):
@@ -138,12 +150,56 @@ class WeightNormParamAttr:
     pass
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit.save / paddle_tpu.inference")
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """paddle.static.save_inference_model equivalent.
+
+    Reference (fluid/io.py) serializes a pruned ProgramDesc + params. Our
+    static programs execute by tracing, so the computation to save must be
+    a callable: pass the Layer (or function) as `program` (or as
+    `fetch_vars` when it is callable), with `feed_vars` a list of
+    InputSpec/example Tensors. Writes `{path}.pdmodel` + `{path}.pdiparams`
+    readable by paddle_tpu.inference.Config/Predictor and
+    paddle_tpu.jit.load."""
+    from ..jit import save as _jit_save
+    from ..nn.layer.layers import Layer
+
+    target = program if program is not None else fetch_vars
+    if isinstance(target, Layer):
+        _jit_save(target, path_prefix, input_spec=list(feed_vars))
+        return
+    if callable(target):
+        target = _FnLayer(target)
+        _jit_save(target, path_prefix, input_spec=list(feed_vars))
+        return
+    raise TypeError(
+        "save_inference_model needs the computation as a callable: pass the "
+        "Layer/function via fetch_vars or program=. (Static-graph Variables "
+        "carry no graph here — the traced jaxpr is the program.)")
 
 
-def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError("use paddle_tpu.jit / paddle_tpu.inference")
+def _FnLayer(fn):
+    """Wrap a bare function as a parameter-less Layer so it rides jit.save."""
+    from ..nn.layer.layers import Layer
+
+    class _Wrapped(Layer):
+        def forward(self, *args):
+            return fn(*args)
+
+    return _Wrapped()
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_names) paddle-style; `program`
+    is a TranslatedLayer — call it directly, or use Executor.run with feeds."""
+    from ..jit import load as _jit_load
+    layer = _jit_load(path_prefix)
+    n_state = len(layer._param_tree) + len(layer._buffer_tree)
+    n_in = len(layer._exported.in_avals) - n_state
+    feed_names = [f"input_{i}" for i in range(max(n_in, 0))]
+    fetch_names = [f"output_{i}"
+                   for i in range(len(layer._exported.out_avals))]
+    return layer, feed_names, fetch_names
 
 
 # paddle.static.nn subset

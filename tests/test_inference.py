@@ -1,0 +1,166 @@
+"""Inference/deploy slice: jit.save/load AOT programs + Predictor serving.
+
+Mirrors the reference's inference API tests (inference/tests/api/) and
+jit save/load suites (test_jit_save_load.py): save an eval-mode model,
+reload it cold, and check numerical identity with the live layer.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+def _small_net():
+    net = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(),
+        nn.BatchNorm1D(16),
+        nn.Linear(16, 4),
+    )
+    net.eval()
+    return net
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = _small_net()
+    x = paddle.to_tensor(np.random.RandomState(0).rand(3, 8).astype("float32"))
+    want = net(x).numpy()
+
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    got = loaded(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_jit_load_polymorphic_batch(tmp_path):
+    net = _small_net()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (1, 5, 17):
+        x = paddle.to_tensor(np.ones((bs, 8), np.float32))
+        assert list(loaded(x).shape) == [bs, 4]
+
+
+def test_jit_save_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError):
+        paddle.jit.save(_small_net(), str(tmp_path / "m"))
+
+
+def test_predictor_handles(tmp_path):
+    net = _small_net()
+    x = np.random.RandomState(1).rand(4, 8).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+
+    cfg = Config(path + ".pdmodel", path + ".pdiparams")
+    cfg.enable_memory_optim()
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert names == ["input_0"]
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    assert pred.run() is True
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    # direct-list form
+    out2 = pred.run([x])[0]
+    np.testing.assert_allclose(out2, want, rtol=1e-5, atol=1e-5)
+
+
+def test_save_load_inference_model(tmp_path):
+    net = _small_net()
+    path = str(tmp_path / "inf")
+    paddle.static.save_inference_model(
+        path, [InputSpec([None, 8], "float32")], net)
+    prog, feeds, fetches = paddle.static.load_inference_model(path)
+    assert feeds == ["input_0"]
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    np.testing.assert_allclose(prog(x).numpy(), net(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_save_inference_model_function_form(tmp_path):
+    def fn(a, b):
+        return paddle.matmul(a, b)
+
+    path = str(tmp_path / "fn")
+    paddle.static.save_inference_model(
+        path, [InputSpec([2, 3], "float32"), InputSpec([3, 2], "float32")],
+        fn)
+    loaded = paddle.jit.load(path)
+    a = np.random.RandomState(2).rand(2, 3).astype("float32")
+    b = np.random.RandomState(3).rand(3, 2).astype("float32")
+    np.testing.assert_allclose(
+        loaded(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(), a @ b,
+        rtol=1e-5, atol=1e-5)
+
+
+def test_multi_input_shared_batch_dim(tmp_path):
+    class TwoIn(nn.Layer):
+        def forward(self, a, b):
+            return paddle.matmul(a + b, paddle.transpose(a, [1, 0]))
+
+    net = TwoIn()
+    path = str(tmp_path / "two")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32"),
+                                           InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (2, 6):
+        a = paddle.to_tensor(np.ones((bs, 8), np.float32))
+        b = paddle.to_tensor(np.ones((bs, 8), np.float32))
+        assert list(loaded(a, b).shape) == [bs, bs]
+
+
+def test_executor_runs_loaded_program(tmp_path):
+    net = _small_net()
+    path = str(tmp_path / "exe")
+    paddle.static.save_inference_model(
+        path, [InputSpec([None, 8], "float32")], net)
+    prog, feeds, fetches = paddle.static.load_inference_model(path)
+    exe = paddle.static.Executor()
+    x = np.random.RandomState(4).rand(3, 8).astype("float32")
+    outs = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches)
+    np.testing.assert_allclose(outs[0], net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_multi_output_fetch_names(tmp_path):
+    class TwoOut(nn.Layer):
+        def forward(self, x):
+            return x * 2.0, x.sum()
+
+    path = str(tmp_path / "mo")
+    paddle.static.save_inference_model(
+        path, [InputSpec([2, 2], "float32")], TwoOut())
+    _, feeds, fetches = paddle.static.load_inference_model(path)
+    assert fetches == ["output_0", "output_1"]
+
+
+def test_jit_save_uses_to_static_spec(tmp_path):
+    net = _small_net()
+    net = paddle.jit.to_static(net,
+                               input_spec=[InputSpec([None, 8], "float32")])
+    path = str(tmp_path / "ts")
+    paddle.jit.save(net, path)   # no explicit input_spec
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.ones((3, 8), np.float32))
+    assert list(loaded(x).shape) == [3, 4]
+
+
+def test_bf16_params_roundtrip(tmp_path):
+    net = nn.Linear(4, 4)
+    net._cast_all("bfloat16")
+    net.eval()
+    path = str(tmp_path / "bf")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "bfloat16")])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32), dtype="bfloat16")
+    want = net(x).astype("float32").numpy()
+    got = loaded(x).astype("float32").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)

@@ -1,0 +1,92 @@
+"""Launcher + elastic: env wiring, watchdog teardown, TTL leases.
+
+Mirrors the reference's launcher tests (test_launch_coverage.py,
+test_fleet_elastic_manager.py): subprocess trainers with PADDLE_* env,
+watchdog kills survivors on failure, elastic manager tracks leases."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.distributed.launch.main import (build_args, launch,
+                                                watch_local_trainers)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(script_body, tmp_path, extra=()):
+    script = tmp_path / "trainer.py"
+    script.write_text(script_body)
+    argv = list(extra) + [str(script)]
+    return launch(argv)
+
+
+def test_launch_sets_trainer_env(tmp_path):
+    out = tmp_path / "env.txt"
+    body = (
+        "import os\n"
+        f"open({str(out)!r}, 'a').write("
+        "os.environ['PADDLE_TRAINER_ID'] + '/' + "
+        "os.environ['PADDLE_TRAINERS_NUM'] + '\\n')\n"
+    )
+    rc = _run_launch(body, tmp_path, ["--nproc_per_node", "2"])
+    assert rc == 0
+    lines = sorted(out.read_text().splitlines())
+    assert lines == ["0/2", "1/2"]
+
+
+def test_launch_watchdog_kills_survivors(tmp_path):
+    marker = tmp_path / "lived_too_long"
+    body = (
+        "import os, sys, time\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "if rank == 0:\n"
+        "    sys.exit(3)\n"
+        "time.sleep(30)\n"
+        f"open({str(marker)!r}, 'w').write('x')\n"
+    )
+    t0 = time.monotonic()
+    rc = _run_launch(body, tmp_path, ["--nproc_per_node", "2"])
+    assert rc == 3
+    assert time.monotonic() - t0 < 25, "watchdog did not kill the survivor"
+    assert not marker.exists()
+
+
+def test_watch_local_trainers_all_ok():
+    procs = [subprocess.Popen([sys.executable, "-c", "pass"])
+             for _ in range(2)]
+    assert watch_local_trainers(procs) == 0
+
+
+def test_build_args_remainder():
+    args = build_args(["--nproc_per_node", "4", "train.py", "--lr", "0.1"])
+    assert args.nproc_per_node == 4
+    assert args.training_script == "train.py"
+    assert args.training_script_args == ["--lr", "0.1"]
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_elastic_manager_leases():
+    from paddle_tpu.distributed import TCPStore
+
+    store = TCPStore(is_master=True, world_size=2, timeout=5.0)
+    m0 = ElasticManager(store, rank=0, np_range=(1, 2), ttl_s=1.0,
+                        heartbeat_s=0.2)
+    m1 = ElasticManager(store, rank=1, np_range=(1, 2), ttl_s=1.0,
+                        heartbeat_s=0.2)
+    m0.register()
+    m1.register()
+    time.sleep(0.4)
+    assert sorted(m0.alive_nodes(2)) == [0, 1]
+    assert not m0.need_rescale(2)
+    # rank 1 dies: its lease lapses, rescale becomes necessary
+    m1.exit()
+    time.sleep(1.3)
+    assert m0.alive_nodes(2) == [0]
+    assert m0.need_rescale(2)
+    m0.exit()
+    store.stop()
