@@ -117,14 +117,18 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):
     spec = _as_partition_spec(mesh, shard_spec, arr.ndim)
     sharding = NamedSharding(mesh, spec)
     if isinstance(arr, jax.core.Tracer):
+        # under tracing: constraint only — never write a Tracer back into a
+        # persistent Parameter (it would escape the trace)
         out = jax.lax.with_sharding_constraint(arr, sharding)
-    else:
-        out = jax.device_put(arr, sharding)
+        if wrapped:
+            t = Tensor(out, stop_gradient=x.stop_gradient)
+            t.name = x.name
+            t._dist_attr = (mesh, spec)
+            return t
+        return out
+    out = jax.device_put(arr, sharding)
     if wrapped:
-        t = Tensor(out, stop_gradient=x.stop_gradient)
-        t.name = x.name
-        t._dist_attr = (mesh, spec)
-        # in-place placement too, paddle-style (annotating a Parameter
+        # eager: place in-place, paddle-style (annotating a Parameter
         # inside a Layer must stick)
         x._data = out
         x._dist_attr = (mesh, spec)
@@ -285,17 +289,18 @@ class Engine:
             arrs.append(jax.device_put(a, self._data_sharding(a.ndim)))
         return arrs
 
-    def _loader(self, data, batch_size, shuffle=True):
+    def _loader(self, data, batch_size, shuffle=True, drop_last=False):
         from ...io import DataLoader, Dataset
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              drop_last=True)
+                              drop_last=drop_last)
         return data  # already a loader/iterable of batches
 
     # ---------------------------------------------------------------- API
     def fit(self, train_data, epochs=1, batch_size=32, steps_per_epoch=None,
             verbose=1, log_freq=10):
-        loader = self._loader(train_data, batch_size)
+        # fixed batch shape for the compiled step (and dp-divisibility)
+        loader = self._loader(train_data, batch_size, drop_last=True)
         if self._state is None:
             self._init_state()
         if self._train_step is None:

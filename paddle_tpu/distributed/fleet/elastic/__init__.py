@@ -93,20 +93,50 @@ class ElasticManager:
 
 
 def launch_elastic(args, spawn_fn):
-    """Supervise spawn_fn under the elastic policy: on non-zero exit,
-    re-launch while the healthy node set stays within [min, max]."""
+    """Supervise spawn_fn under the elastic policy: register this node's
+    TTL lease in the rendezvous store, and on a trainer failure re-launch
+    only while the healthy node set stays within the [min, max] range
+    (reference: manager.py watch loop + relaunch)."""
     lo, _, hi = str(args.nnodes).partition(":")
-    lo = int(lo)
-    hi = int(hi or lo)
+    lo, hi = int(lo), int(hi or lo)
+    rank = getattr(args, "rank", 0)
+
+    manager = None
+    store = None
+    try:
+        from ...store import TCPStore
+        if args.master:
+            host, _, port = args.master.partition(":")
+            store = TCPStore(host or "127.0.0.1", int(port or 0),
+                             is_master=(rank == 0), world_size=hi,
+                             timeout=30.0)
+        else:
+            store = TCPStore(is_master=True, world_size=hi, timeout=30.0)
+        manager = ElasticManager(store, rank=rank, np_range=(lo, hi))
+        manager.register()
+    except Exception:
+        manager = None  # no native store: degrade to plain retry
+
     attempts = 0
-    while True:
-        rc = spawn_fn(args, args.nproc_per_node, _port())
-        if rc == 0:
-            return 0
-        attempts += 1
-        if attempts > 10:
-            return rc
-        time.sleep(min(2 ** attempts, 30))
+    try:
+        while True:
+            rc = spawn_fn(args, args.nproc_per_node, _port())
+            if rc == 0:
+                return 0
+            attempts += 1
+            if attempts > 10:
+                return rc
+            if manager is not None:
+                alive = manager.alive_nodes(hi)
+                if len(alive) < lo:
+                    # below the minimum scale: no point relaunching
+                    return rc
+            time.sleep(min(2 ** attempts, 30))
+    finally:
+        if manager is not None:
+            manager.exit()
+        if store is not None:
+            store.stop()
 
 
 def _port():

@@ -42,8 +42,10 @@ class RNGStatesTracker:
         def ctx():
             seed = self._seeds.get(name)
             if seed is None:
-                # deterministic fold of the region name
-                seed = abs(hash(name)) % (2 ** 31)
+                # process-stable fold of the region name (hash() is salted
+                # per interpreter and would desync ranks)
+                import zlib
+                seed = zlib.crc32(name.encode()) % (2 ** 31)
             with _rng.fork_rng(seed):
                 yield
 

@@ -217,7 +217,8 @@ class PipelineParallel(Layer):
                 scaler.scale(loss).backward()
             else:
                 loss.backward()
-            total = loss if total is None else total + loss.detach()
+            d = loss.detach()   # keep no micro-batch graph alive in the sum
+            total = d if total is None else total + d
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

@@ -1,0 +1,231 @@
+"""Parameter server — sparse recommender-model training support.
+
+Reference (SURVEY §2.6 "the one PS"): brpc client/server
+(ps/service/brpc_ps_client.cc, brpc_ps_server.cc) around sharded hash
+embedding tables (ps/table/memory_sparse_table.cc) with accessor/optimizer
+plugins (sparse_sgd_rule.cc), an async gradient-aggregating Communicator
+(ps/service/communicator/communicator.cc), and worker-side ops
+(distributed_lookup_table_op, distributed_push_sparse_op).
+
+TPU-native design:
+- The TABLE is native C++ (paddle_tpu/native/src/ps_table.cc): striped hash
+  map, server-side sgd/adagrad/adam sparse rules, deterministic on-miss init,
+  binary save/load. Dense parameters don't need a PS on TPU — they live
+  HBM-sharded on the mesh (ZeRO); the PS exists for embedding spaces larger
+  than HBM, which stay host-side.
+- The CLIENT is in-process (the reference ships exactly this fake for tests:
+  ps/service/ps_local_client.h). Multi-host RPC transport (brpc) is
+  descoped: on TPU pods the fleet design keeps big embeddings host-resident
+  per worker with ID-range sharding over hosts via the same table API —
+  `shard_for(key)` below — and exchange rides the DataLoader/allgather
+  path, not a bespoke RPC mesh.
+- The async Communicator is a thread that merges gradients by key and
+  pushes every `send_wait_times` batches (communicator.cc semantics).
+- `SparseEmbedding` is the lookup op: pull on forward, push on backward
+  through the autograd tape (the distributed_lookup_table /
+  distributed_push_sparse op pair).
+"""
+import queue
+import threading
+
+import numpy as np
+
+from ... import native
+from ...core.autograd import Node, is_grad_enabled
+from ...core.tensor import Tensor
+
+__all__ = ["SparseTable", "AsyncCommunicator", "SparseEmbedding",
+           "sparse_embedding", "PSContext", "shard_for"]
+
+SparseTable = native.SparseTable
+
+
+def shard_for(keys, num_shards):
+    """ID-range sharding: which host owns each key (reference: feasign %
+    shard_num routing in brpc_ps_client)."""
+    return np.asarray(keys, dtype=np.int64) % int(num_shards)
+
+
+class AsyncCommunicator:
+    """Background gradient pusher (reference: communicator.cc AsyncCommunicator
+    — send queues per table, merge-by-key, batched push)."""
+
+    def __init__(self, table, merge_batches=4, queue_size=64):
+        self._table = table
+        self._merge = max(int(merge_batches), 1)
+        self._q = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._running = False
+        self._inflight = 0                  # pushed but not yet in the table
+        self._cv = threading.Condition()
+
+    def start(self):
+        self._running = True
+        self._thread.start()
+
+    def push_sparse(self, keys, grads):
+        if not self._running:
+            self._table.push(keys, grads)  # sync fallback
+            return
+        with self._cv:
+            self._inflight += 1
+        self._q.put((np.asarray(keys, np.int64).copy(),
+                     np.asarray(grads, np.float32).copy()))
+
+    def _loop(self):
+        pending = []
+        while not self._stop.is_set() or not self._q.empty() or pending:
+            try:
+                pending.append(self._q.get(timeout=0.05))
+            except queue.Empty:
+                pass
+            # flush at the merge threshold, or whenever the queue runs dry
+            # (so flush()/barrier callers never wait on a partial window)
+            if pending and (len(pending) >= self._merge or self._q.empty()):
+                self._flush(pending)
+                with self._cv:
+                    self._inflight -= len(pending)
+                    self._cv.notify_all()
+                pending = []
+
+    def _flush(self, items):
+        # merge by key: one push per unique id with summed grads
+        keys = np.concatenate([k for k, _ in items])
+        grads = np.concatenate([g for _, g in items])
+        uniq, inv = np.unique(keys, return_inverse=True)
+        merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
+        np.add.at(merged, inv, grads)
+        self._table.push(uniq, merged)
+
+    def flush(self, timeout=30.0):
+        """Block until every queued gradient landed in the table (barrier
+        before eval/save)."""
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._inflight == 0,
+                                     timeout=timeout):
+                raise TimeoutError("AsyncCommunicator flush timed out")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10)
+        self._running = False
+
+
+class SparseEmbedding:
+    """Host-side huge embedding lookup with PS update on backward.
+
+    forward: ids -> pull rows from the table -> device Tensor
+    backward: output grad -> (async) push into the table
+
+    This is intentionally an eager-path op: the pull/push crosses the
+    host/device boundary, exactly like the reference's
+    distributed_lookup_table op does a PS RPC around the CUDA graph."""
+
+    def __init__(self, dim, rule="adagrad", lr=0.05, init_range=0.01,
+                 seed=0, communicator=None, table=None):
+        self.table = table if table is not None else \
+            SparseTable(dim, rule=rule, lr=lr, init_range=init_range,
+                        seed=seed)
+        self.dim = self.table.dim
+        self.comm = communicator
+
+    def __call__(self, ids):
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            dtype=np.int64)
+        flat = ids_np.reshape(-1)
+        rows = self.table.pull(flat)                      # (n, dim) numpy
+        out = Tensor(rows.reshape(*ids_np.shape, self.dim),
+                     stop_gradient=not is_grad_enabled())
+        if not out.stop_gradient:
+            table, comm, dim = self.table, self.comm, self.dim
+
+            def vjp(g):
+                g_np = np.asarray(g, np.float32).reshape(-1, dim)
+                if comm is not None:
+                    comm.push_sparse(flat, g_np)
+                else:
+                    table.push(flat, g_np)
+                return ()
+
+            out._node = Node(vjp, inputs=[], outputs=[out],
+                             multi_output=False, name="sparse_embedding")
+        return out
+
+
+def sparse_embedding(ids, table, communicator=None):
+    """Functional form of SparseEmbedding over an existing table."""
+    return SparseEmbedding(table.dim, table=table,
+                           communicator=communicator)(ids)
+
+
+class PSContext:
+    """fleet PS-mode runtime facade (reference: ps/the_one_ps.py TheOnePS).
+
+    Tables are registered by name; `init_server`/`run_server` exist for
+    API parity (in-process serving), `save/load` persist all tables."""
+
+    def __init__(self):
+        self._tables = {}
+        self._comms = {}
+
+    def create_table(self, name, dim, rule="adagrad", lr=0.05,
+                     init_range=0.01, seed=0, async_push=True):
+        t = SparseTable(dim, rule=rule, lr=lr, init_range=init_range,
+                        seed=seed)
+        self._tables[name] = t
+        if async_push:
+            c = AsyncCommunicator(t)
+            c.start()
+            self._comms[name] = c
+        return t
+
+    def table(self, name):
+        return self._tables[name]
+
+    def communicator(self, name):
+        return self._comms.get(name)
+
+    def embedding(self, name):
+        return SparseEmbedding(self._tables[name].dim,
+                               table=self._tables[name],
+                               communicator=self._comms.get(name))
+
+    def init_server(self, *a, **k):
+        pass
+
+    def run_server(self):
+        pass
+
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        self.barrier()
+
+    def barrier(self):
+        for c in self._comms.values():
+            c.flush()
+
+    def save(self, dirname):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        self.barrier()
+        for name, t in self._tables.items():
+            t.save(os.path.join(dirname, f"{name}.pstable"))
+
+    def load(self, dirname):
+        import os
+        for name, t in self._tables.items():
+            path = os.path.join(dirname, f"{name}.pstable")
+            if os.path.exists(path):
+                t.load(path)
+
+    def shutdown(self):
+        for c in self._comms.values():
+            c.stop()
+        self._comms.clear()
+        for t in self._tables.values():
+            t.destroy()
+        self._tables.clear()

@@ -1,0 +1,79 @@
+"""Training auto-checkpoint — epoch-granular save/resume.
+
+Reference: python/paddle/incubate/checkpoint/auto_checkpoint.py (+
+checkpoint_saver.py): Fleet jobs wrap their epoch loop in
+`train_epoch_range`, which transparently restores the last completed epoch
+from HDFS and saves on each epoch boundary, keyed by a job id.
+
+TPU-native: same contract over the local/posix filesystem (the reference's
+fs.py HDFS abstraction collapses to a directory); tensors ride
+paddle.save/paddle.load.
+"""
+import json
+import os
+
+__all__ = ["train_epoch_range", "ExeTrainStatus"]
+
+_CKPT_DIR_ENV = "PADDLE_CHECKPOINT_DIR"
+
+
+class ExeTrainStatus:
+    """Tracks (epoch_no, checkpoint paths) for one named training run."""
+
+    def __init__(self, name="auto", save_dir=None):
+        self.name = name
+        self.save_dir = save_dir or os.environ.get(_CKPT_DIR_ENV,
+                                                   "./auto_checkpoint")
+        self._dir = os.path.join(self.save_dir, name)
+        self._meta = os.path.join(self._dir, "status.json")
+
+    def last_epoch(self):
+        if not os.path.exists(self._meta):
+            return -1
+        with open(self._meta) as f:
+            return json.load(f).get("epoch_no", -1)
+
+    def save(self, epoch_no, layers=None, optimizers=None):
+        from ...framework.io import save as _save
+        os.makedirs(self._dir, exist_ok=True)
+        for i, layer in enumerate(layers or []):
+            _save(layer.state_dict(), os.path.join(self._dir,
+                                                   f"layer_{i}.pdparams"))
+        for i, opt in enumerate(optimizers or []):
+            _save(opt.state_dict(), os.path.join(self._dir,
+                                                 f"opt_{i}.pdopt"))
+        tmp = self._meta + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch_no": epoch_no}, f)
+        os.replace(tmp, self._meta)  # atomic: a crash never corrupts status
+
+    def restore(self, layers=None, optimizers=None):
+        from ...framework.io import load as _load
+        for i, layer in enumerate(layers or []):
+            p = os.path.join(self._dir, f"layer_{i}.pdparams")
+            if os.path.exists(p):
+                layer.set_state_dict(_load(p))
+        for i, opt in enumerate(optimizers or []):
+            p = os.path.join(self._dir, f"opt_{i}.pdopt")
+            if os.path.exists(p):
+                opt.set_state_dict(_load(p))
+
+
+def train_epoch_range(max_epoch_num, name="auto", save_dir=None,
+                      layers=None, optimizers=None, save_checkpoint_inter=1):
+    """Resumable epoch generator:
+
+        for epoch in train_epoch_range(10, layers=[net], optimizers=[opt]):
+            train_one_epoch(...)
+
+    On restart, already-completed epochs are skipped and layer/optimizer
+    state is restored from the last checkpoint."""
+    status = ExeTrainStatus(name, save_dir)
+    start = status.last_epoch() + 1
+    if start > 0:
+        status.restore(layers, optimizers)
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        if (epoch + 1) % save_checkpoint_inter == 0 or \
+                epoch == max_epoch_num - 1:
+            status.save(epoch, layers, optimizers)

@@ -43,10 +43,14 @@ class Config:
     def __init__(self, prog_file=None, params_file=None):
         if prog_file is not None and params_file is None and \
                 os.path.isdir(prog_file):
-            # Config(model_dir) form
+            # Config(model_dir) form: find the single jit.save artifact
             d = prog_file
-            prog_file = os.path.join(d, "__model__")
-            params_file = os.path.join(d, "__params__")
+            models = sorted(f for f in os.listdir(d)
+                            if f.endswith(".pdmodel"))
+            if not models:
+                raise FileNotFoundError(f"no .pdmodel in {d}")
+            prog_file = os.path.join(d, models[0])
+            params_file = prog_file[:-len(".pdmodel")] + ".pdiparams"
         self._prog_file = prog_file
         self._params_file = params_file
         self._device = "tpu"

@@ -85,6 +85,16 @@ def _declare(lib):
         "ptn_arena_stats": (None, [P, ctypes.POINTER(U64), ctypes.POINTER(U64),
                                    ctypes.POINTER(U64)]),
         "ptn_arena_destroy": (None, [P]),
+        "ptn_pstable_create": (P, [I32, S, ctypes.c_float, ctypes.c_float,
+                                   U64]),
+        "ptn_pstable_pull": (None, [P, ctypes.POINTER(I64), I64,
+                                    ctypes.POINTER(ctypes.c_float)]),
+        "ptn_pstable_push": (None, [P, ctypes.POINTER(I64), I64,
+                                    ctypes.POINTER(ctypes.c_float)]),
+        "ptn_pstable_size": (I64, [P]),
+        "ptn_pstable_save": (I32, [P, S]),
+        "ptn_pstable_load": (I32, [P, S]),
+        "ptn_pstable_destroy": (None, [P]),
         "ptn_stat_add": (I64, [S, I64]),
         "ptn_stat_get": (I64, [S]),
         "ptn_stat_peak": (I64, [S]),
@@ -268,6 +278,72 @@ class HostArena:
             self._live.clear()
             _lib.ptn_arena_destroy(self._h)
             self._h = None
+
+
+class SparseTable:
+    """Sharded feature-id -> embedding-row store with server-side sparse
+    optimizer rules (sgd/adagrad/adam). The C++ half of the parameter
+    server; see paddle_tpu.distributed.ps."""
+
+    def __init__(self, dim, rule="adagrad", lr=0.05, init_range=0.01,
+                 seed=0):
+        import numpy as _np
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.dim = int(dim)
+        self.rule = rule
+        self._np = _np
+        self._h = lib.ptn_pstable_create(self.dim, rule.encode(),
+                                         float(lr), float(init_range),
+                                         int(seed))
+
+    def _keys_ptr(self, keys):
+        arr = self._np.ascontiguousarray(keys, dtype=self._np.int64)
+        return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+    def pull(self, keys):
+        """keys: int64 array (n,) -> float32 (n, dim); missing rows are
+        created with uniform init."""
+        arr, kp = self._keys_ptr(keys)
+        out = self._np.empty((arr.size, self.dim), dtype=self._np.float32)
+        _lib.ptn_pstable_pull(
+            self._h, kp, arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def push(self, keys, grads):
+        arr, kp = self._keys_ptr(keys)
+        g = self._np.ascontiguousarray(grads, dtype=self._np.float32)
+        if g.shape != (arr.size, self.dim):
+            raise ValueError(f"grads shape {g.shape} != ({arr.size}, "
+                             f"{self.dim})")
+        _lib.ptn_pstable_push(
+            self._h, kp, arr.size,
+            g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    def __len__(self):
+        return int(_lib.ptn_pstable_size(self._h))
+
+    def save(self, path):
+        if _lib.ptn_pstable_save(self._h, path.encode()) != 0:
+            raise IOError(f"pstable save failed: {path}")
+
+    def load(self, path):
+        rc = _lib.ptn_pstable_load(self._h, path.encode())
+        if rc != 0:
+            raise IOError(f"pstable load failed ({rc}): {path}")
+
+    def destroy(self):
+        if self._h:
+            _lib.ptn_pstable_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
 
 
 def stat_add(name, delta=1):

@@ -1,14 +1,32 @@
-"""paddle.profiler equivalent (reference: python/paddle/profiler/profiler.py:340
-+ C++ host_tracer/cuda_tracer).
+"""paddle.profiler equivalent.
 
-TPU-native: wraps jax.profiler (XPlane capture -> TensorBoard/perfetto trace),
-which replaces CUPTI. RecordEvent maps to jax.profiler.TraceAnnotation.
-Scheduler-window semantics (wait/warmup/active) are preserved.
+Reference (SURVEY §5): python/paddle/profiler/profiler.py:340 `Profiler`
+with scheduler windows, backed by C++ `platform/profiler/` — host_tracer.cc
+collects RecordEvent spans (event_tracing.h:49), cuda_tracer.cc wraps CUPTI,
+events merge into a tree (event_node.cc) exported as chrome-trace JSON
+(chrometracing_logger.cc) plus python statistics tables
+(profiler_statistic.py).
+
+TPU-native mapping:
+- host tracer  -> in-process span recorder (this file; RecordEvent spans
+  with nesting tracked per thread)
+- CUPTI tracer -> jax.profiler XPlane capture (start_trace/stop_trace),
+  viewable in TensorBoard/XProf — device-side kernel timelines come from
+  the XLA runtime, the role CUPTI plays for CUDA
+- chrome-trace logger -> export_chrome_tracing handler over the host spans
+- profiler_statistic  -> summary() aggregation table
 """
 import contextlib
+import json
+import os
+import threading
 import time
 
 import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result"]
 
 
 class ProfilerTarget:
@@ -25,7 +43,9 @@ class ProfilerState:
     RECORD_AND_RETURN = 3
 
 
-def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Reference: profiler.py make_scheduler — cycle through
+    closed/ready/record windows."""
     def scheduler(step):
         s = step - skip_first
         if s < 0:
@@ -44,86 +64,83 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
     return scheduler
 
 
-def export_chrome_tracing(dir_name, worker_name=None):
-    def handler(prof):
-        prof._log_dir = dir_name
-    return handler
+# ---------------------------------------------------------------- host tracer
+
+class _HostTracer:
+    """Span recorder (the host_tracer.cc role). Spans: dicts with name,
+    thread id, start/end (ns), nesting depth."""
+
+    def __init__(self):
+        self.enabled = False
+        self.events = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def _depth(self):
+        return getattr(self._tls, "depth", 0)
+
+    def begin(self, name, event_type):
+        if not self.enabled:
+            return None
+        rec = {"name": name, "type": event_type,
+               "tid": threading.get_ident(),
+               "ts": time.perf_counter_ns(), "dur": None,
+               "depth": self._depth()}
+        self._tls.depth = self._depth() + 1
+        return rec
+
+    def end(self, rec):
+        if rec is None:
+            return
+        self._tls.depth = max(self._depth() - 1, 0)
+        rec["dur"] = time.perf_counter_ns() - rec["ts"]
+        with self._lock:
+            self.events.append(rec)
+
+    def drain(self):
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
 
 
-class Profiler:
-    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False):
-        self._scheduler = scheduler if callable(scheduler) else (
-            make_scheduler(record=scheduler[1] - scheduler[0], skip_first=scheduler[0])
-            if isinstance(scheduler, (tuple, list)) else None)
-        self._on_trace_ready = on_trace_ready
-        self._timer_only = timer_only
-        self._log_dir = "./profiler_log"
-        self._step = 0
-        self._active = False
-        self._step_times = []
-        self._last_t = None
+_tracer = _HostTracer()
 
-    def start(self):
-        self._last_t = time.perf_counter()
-        if not self._timer_only:
-            try:
-                jax.profiler.start_trace(self._log_dir)
-                self._active = True
-            except Exception:
-                self._active = False
 
-    def stop(self):
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-        if self._on_trace_ready:
-            self._on_trace_ready(self)
-
-    def step(self, num_samples=None):
-        now = time.perf_counter()
-        if self._last_t is not None:
-            self._step_times.append(now - self._last_t)
-        self._last_t = now
-        self._step += 1
-
-    def step_info(self, unit=None):
-        if not self._step_times:
-            return ""
-        import numpy as np
-        arr = np.asarray(self._step_times[-10:])
-        return (f"avg step {arr.mean()*1000:.2f} ms, "
-                f"ips {1.0/arr.mean():.2f} steps/s")
-
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        print(self.step_info())
-
-    def __enter__(self):
-        self.start()
-        return self
-
-    def __exit__(self, *exc):
-        self.stop()
-        return False
+class TracerEventType:
+    Operator = "Operator"
+    Dataloader = "Dataloader"
+    ProfileStep = "ProfileStep"
+    Forward = "Forward"
+    Backward = "Backward"
+    Optimization = "Optimization"
+    Communication = "Communication"
+    PythonOp = "PythonOp"
+    UserDefined = "UserDefined"
 
 
 class RecordEvent:
-    """Reference: platform/profiler/event_tracing.h:49 RecordEvent."""
+    """User-code span (reference: platform/profiler/event_tracing.h:49;
+    python surface profiler/utils.py RecordEvent). Also forwards to
+    jax.profiler.TraceAnnotation so spans show up inside XPlane captures."""
 
-    def __init__(self, name, event_type=None):
+    def __init__(self, name, event_type=TracerEventType.PythonOp):
         self.name = name
-        self._ctx = None
+        self.event_type = event_type
+        self._rec = None
+        self._ann = None
 
     def begin(self):
-        self._ctx = jax.profiler.TraceAnnotation(self.name)
-        self._ctx.__enter__()
+        self._rec = _tracer.begin(self.name, self.event_type)
+        if _tracer.enabled:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
 
     def end(self):
-        if self._ctx is not None:
-            self._ctx.__exit__(None, None, None)
-            self._ctx = None
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        _tracer.end(self._rec)
+        self._rec = None
 
     def __enter__(self):
         self.begin()
@@ -134,5 +151,180 @@ class RecordEvent:
         return False
 
 
+# ------------------------------------------------------------- trace handlers
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Returns an on_trace_ready handler writing chrome://tracing JSON
+    (reference: chrometracing_logger.cc)."""
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                            ".paddle_trace.json")
+        events = []
+        for e in getattr(prof, "_window_events", None) or prof._events:
+            events.append({
+                "name": e["name"], "cat": e["type"], "ph": "X",
+                "pid": os.getpid(), "tid": e["tid"],
+                "ts": e["ts"] / 1000.0, "dur": (e["dur"] or 0) / 1000.0,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        prof._exported_path = path
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """The reference's protobuf dump; here an alias of chrome tracing (the
+    XPlane protobufs are produced by jax.profiler's own capture)."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
 def load_profiler_result(path):
-    raise NotImplementedError
+    with open(path) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------------------- profiler
+
+class Profiler:
+    """Scheduler-windowed profiler (reference: profiler.py:340).
+
+    targets defaults to host + device. timer_only=True skips the device
+    XPlane capture (benchmark mode, reference semantics)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, record=hi - lo,
+                                             repeat=1)
+        else:
+            self._scheduler = None  # always on
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._log_dir = "./profiler_log"
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._device_active = False
+        self._events = []
+        self._step_times = []
+        self._last_t = None
+        self._step_rec = None
+        self._exported_path = None
+        self._window_events = None
+
+    # ------------------------------------------------------------ lifecycle
+    def _target_state(self):
+        if self._scheduler is None:
+            return ProfilerState.RECORD
+        return self._scheduler(self._step)
+
+    def _transition(self, new):
+        recording = self._state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+        want = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if want and not recording:
+            _tracer.enabled = True
+            if not self._timer_only:
+                try:
+                    jax.profiler.start_trace(self._log_dir)
+                    self._device_active = True
+                except Exception:
+                    self._device_active = False
+        if recording and not want:
+            self._collect()
+        self._state = new
+
+    def _collect(self):
+        _tracer.enabled = False
+        window = _tracer.drain()
+        self._events.extend(window)       # cumulative, for statistics()
+        self._window_events = window      # this window only, for export
+        if self._device_active:
+            jax.profiler.stop_trace()
+            self._device_active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def start(self):
+        self._last_t = time.perf_counter()
+        self._transition(self._target_state())
+        self._open_step_span()
+
+    def stop(self):
+        self._close_step_span()
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+        self._state = ProfilerState.CLOSED
+
+    def _open_step_span(self):
+        self._step_rec = _tracer.begin(f"ProfileStep#{self._step}",
+                                       TracerEventType.ProfileStep)
+
+    def _close_step_span(self):
+        _tracer.end(self._step_rec)
+        self._step_rec = None
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._step_times.append(now - self._last_t)
+        self._last_t = now
+        self._close_step_span()
+        self._step += 1
+        self._transition(self._target_state())
+        self._open_step_span()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------ reporting
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        arr = np.asarray(self._step_times[-10:])
+        return (f"avg step {arr.mean() * 1000:.2f} ms, "
+                f"ips {1.0 / arr.mean():.2f} steps/s")
+
+    def statistics(self):
+        """Aggregate spans by name (reference: profiler_statistic.py)."""
+        import numpy as np
+        by_name = {}
+        for e in self._events:
+            by_name.setdefault(e["name"], []).append(e["dur"] or 0)
+        rows = []
+        for name, durs in by_name.items():
+            d = np.asarray(durs, dtype=np.float64) / 1e6  # ms
+            rows.append({"name": name, "calls": len(durs),
+                         "total_ms": float(d.sum()), "avg_ms": float(d.mean()),
+                         "max_ms": float(d.max()), "min_ms": float(d.min())})
+        rows.sort(key=lambda r: -r["total_ms"])
+        return rows
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        rows = self.statistics()
+        if not rows:
+            print(self.step_info())
+            return
+        width = max((len(r["name"]) for r in rows), default=4)
+        print(f"{'Name':<{width}}  {'Calls':>6}  {'Total(ms)':>10}  "
+              f"{'Avg(ms)':>9}  {'Max(ms)':>9}  {'Min(ms)':>9}")
+        for r in rows:
+            print(f"{r['name']:<{width}}  {r['calls']:>6}  "
+                  f"{r['total_ms']:>10.3f}  {r['avg_ms']:>9.3f}  "
+                  f"{r['max_ms']:>9.3f}  {r['min_ms']:>9.3f}")
+        if self._step_times:
+            print(self.step_info())

@@ -100,8 +100,15 @@ class Executor:
         # execute it paddle-style with the feed dict in feed-name order
         if callable(program):
             feed = feed or {}
+            # natural sort: input_10 after input_2
+            import re as _re
+
+            def _key(k):
+                m = _re.search(r"(\d+)$", k)
+                return (k[:m.start()], int(m.group(1))) if m else (k, -1)
+
             args = [Tensor(jnp.asarray(np.asarray(feed[k])))
-                    for k in sorted(feed.keys())]
+                    for k in sorted(feed.keys(), key=_key)]
             out = program(*args)
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             if return_numpy:

@@ -1,0 +1,87 @@
+"""Profiler: scheduler windows, host spans, chrome-trace export, stats.
+
+Mirrors the reference's test_profiler.py / test_profiler_statistic.py."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 export_chrome_tracing, load_profiler_result,
+                                 make_scheduler)
+
+
+def test_make_scheduler_windows():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                           skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[9] == ProfilerState.CLOSED          # repeat exhausted
+
+
+def test_record_event_spans_and_summary(capsys):
+    prof = Profiler(scheduler=None, timer_only=True)
+    prof.start()
+    for _ in range(3):
+        with RecordEvent("forward"):
+            with RecordEvent("matmul"):
+                np.dot(np.ones((64, 64)), np.ones((64, 64)))
+        prof.step()
+    prof.stop()
+    rows = {r["name"]: r for r in prof.statistics()}
+    assert rows["forward"]["calls"] == 3
+    assert rows["matmul"]["calls"] == 3
+    # nested span cannot be longer than its parent (aggregate)
+    assert rows["matmul"]["total_ms"] <= rows["forward"]["total_ms"] + 1e-6
+    prof.summary()
+    out = capsys.readouterr().out
+    assert "forward" in out and "avg step" in out
+
+
+def test_scheduler_gates_recording():
+    sched = make_scheduler(closed=2, record=1, repeat=1)
+    prof = Profiler(scheduler=sched, timer_only=True)
+    prof.start()
+    for i in range(4):
+        with RecordEvent(f"step{i}"):
+            pass
+        prof.step()
+    prof.stop()
+    names = {e["name"] for e in prof._events}
+    assert "step0" not in names and "step1" not in names
+    assert "step2" in names
+
+
+def test_chrome_trace_export_roundtrip(tmp_path):
+    d = str(tmp_path / "trace")
+    prof = Profiler(scheduler=None, timer_only=True,
+                    on_trace_ready=export_chrome_tracing(d))
+    prof.start()
+    with RecordEvent("work"):
+        pass
+    prof.stop()
+    assert prof._exported_path and os.path.exists(prof._exported_path)
+    data = load_profiler_result(prof._exported_path)
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "work" in names
+    assert any(n.startswith("ProfileStep#") for n in names)
+
+
+def test_profiler_in_training_loop():
+    net = nn.Linear(8, 4)
+    prof = Profiler(scheduler=(1, 3), timer_only=True)
+    prof.start()
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    for _ in range(4):
+        with RecordEvent("fw"):
+            net(x)
+        prof.step()
+    prof.stop()
+    assert len(prof._step_times) == 4
+    assert prof.step_info()
