@@ -26,7 +26,8 @@ def _is_traced(x) -> bool:
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad_data", "_node", "name",
-                 "persistable", "trainable", "_dist_attr", "__weakref__")
+                 "persistable", "trainable", "_dist_attr", "_asp_mask",
+                 "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None):
         if isinstance(data, Tensor):

@@ -2,3 +2,5 @@
 from . import autotune  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import checkpoint  # noqa: F401

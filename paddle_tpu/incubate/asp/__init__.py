@@ -1,0 +1,118 @@
+"""ASP — automatic n:m structured sparsity workflow.
+
+Reference: python/paddle/incubate/asp (fluid/contrib/sparsity): `prune_model`
+computes n:m (default 2:4) masks per supported weight, `decorate(optimizer)`
+re-applies the masks after every optimizer step so pruned weights stay
+zero, `check_sparsity` validates the pattern.
+
+TPU-native note: the reference's payoff is Ampere sparse-tensor-core
+GEMMs; the MXU has no 2:4 mode, so here ASP serves mask-correct sparse
+TRAINING (model compression research, export to sparse-capable targets),
+with masks enforced as elementwise multiplies that XLA fuses for free.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["prune_model", "decorate", "check_sparsity", "calculate_density",
+           "create_mask", "reset_excluded_layers", "set_excluded_layers"]
+
+_excluded = set()
+# the mask lives ON the parameter (slot `_asp_mask`): it dies with its
+# model and can never be mis-applied to another model's weight
+
+
+def set_excluded_layers(main_program=None, param_names=None):
+    for n in (param_names or []):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def create_mask(weight, n=2, m=4):
+    """n:m mask along the last axis: keep the n largest-|w| of every m."""
+    w = np.asarray(weight, np.float32)
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)          # (..., G, m)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols].reshape(w.shape)
+    return mask
+
+
+def _supported(p):
+    return p is not None and p._data.ndim >= 2 and \
+        p._data.shape[-1] >= 4 and not p.stop_gradient
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every supported weight in the model; masks are
+    remembered for `decorate`d optimizers to re-apply."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if name in _excluded or not _supported(p):
+            continue
+        if name.endswith("bias"):
+            continue
+        mask = jnp.asarray(create_mask(np.asarray(p._data, np.float32),
+                                       n, m), p._data.dtype)
+        p._data = p._data * mask
+        p._asp_mask = mask
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so every step() re-applies the ASP masks
+    (reference: asp.decorate -> OptimizerWithSparsityGuarantee)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner_opt = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner_opt, item)
+
+        def step(self):
+            self._inner_opt.step()
+            for p in self._inner_opt._parameters:
+                mask = getattr(p, "_asp_mask", None)
+                if mask is not None:
+                    p._data = p._data * mask
+
+        def clear_grad(self, *a, **k):
+            self._inner_opt.clear_grad()
+
+        clear_gradients = clear_grad
+
+        def minimize(self, loss, **kw):
+            loss.backward()
+            self.step()
+
+    return _ASPOptimizer(optimizer)
+
+
+def check_sparsity(weight, n=2, m=4):
+    """True iff every m-group along the last axis has <= n nonzeros."""
+    w = np.asarray(weight, np.float32)
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool(((groups != 0).sum(-1) <= n).all())
+
+
+def calculate_density(weight):
+    w = np.asarray(weight)
+    return float((w != 0).mean())

@@ -1,0 +1,207 @@
+"""Quantization — QAT (fake-quant training) + PTQ (post-training calibration).
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+`ImperativeQuantAware` (imperative_qat) swaps Linear/Conv2D for quantized
+twins with fake-quant on weights+activations (quantization_pass.py's
+fake_quantize_abs_max / moving_average_abs_max ops);
+`PostTrainingQuantization` calibrates scales (abs_max / KL histogram) over
+sample data, then emits a quantized program.
+
+TPU-native: fake-quant is a jit-fusible quant-dequant with a
+straight-through estimator (jax.custom_vjp identity) — numerically the
+reference's fake_quantize ops. int8 *execution* stays descoped: the TPU
+speedup path is bf16 (MXU-native); fake-quant here serves accuracy
+simulation and scale export.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["fake_quant", "QuantConfig", "ImperativeQuantAware",
+           "PostTrainingQuantization", "QuantedLinear", "QuantedConv2D"]
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_bwd(_, g):
+    return (g,)   # straight-through
+
+
+_ste_round.defvjp(_ste_fwd, _ste_bwd)
+
+
+def _fake_quant_raw(x, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.clip(_ste_round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def fake_quant(x, scale=None, bits=8):
+    """Quant-dequant with STE (reference: fake_quantize_abs_max op)."""
+    if scale is None:
+        data = x._data if isinstance(x, Tensor) else x
+        scale = jnp.max(jnp.abs(data))
+    if isinstance(x, Tensor):
+        return apply_op(_fake_quant_raw, x, scale=scale, bits=bits,
+                        name="fake_quant")
+    return _fake_quant_raw(x, scale, bits)
+
+
+class QuantConfig:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = moving_rate
+
+
+class _QuantedBase(Layer):
+    """Shared fake-quant plumbing: per-call weight abs-max scale +
+    moving-average activation scale (a buffer, like the reference's
+    moving_average_abs_max state)."""
+
+    def __init__(self, inner, cfg):
+        super().__init__()
+        self.inner = inner
+        self._cfg = cfg
+        from ..core.tensor import to_tensor
+        self.register_buffer("act_scale",
+                             to_tensor(np.zeros((), np.float32)))
+
+    def _quant_act(self, x):
+        cur = jnp.max(jnp.abs(x._data))
+        if self.training:
+            r = self._cfg.moving_rate
+            prev = self.act_scale._data
+            new = jnp.where(prev > 0, prev * r + cur * (1 - r), cur)
+            self.act_scale._data = new
+        else:
+            new = jnp.where(self.act_scale._data > 0,
+                            self.act_scale._data, cur)
+        return fake_quant(x, jax.lax.stop_gradient(new),
+                          self._cfg.activation_bits)
+
+    def _quant_weight(self, w):
+        scale = jax.lax.stop_gradient(jnp.max(jnp.abs(w._data)))
+        return fake_quant(w, scale, self._cfg.weight_bits)
+
+
+class QuantedLinear(_QuantedBase):
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self._quant_act(x)
+        w = self._quant_weight(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self._quant_act(x)
+        w = self._quant_weight(self.inner.weight)
+        inner = self.inner
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups)
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference: imperative/qat.py ImperativeQuantAware):
+    `quantize(model)` swaps supported sublayers in place."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9, quantizable_layer_type=None):
+        self._cfg = QuantConfig(weight_bits, activation_bits,
+                                weight_quantize_type,
+                                activation_quantize_type, moving_rate)
+
+    def quantize(self, model):
+        from ..nn import Conv2D, Linear
+        for parent in model.sublayers(include_self=True):
+            if isinstance(parent, _QuantedBase):
+                continue   # idempotent: never re-wrap a quantized twin
+            for name, child in list(parent.named_children()):
+                if isinstance(child, Linear):
+                    setattr(parent, name, QuantedLinear(child, self._cfg))
+                elif isinstance(child, Conv2D) and \
+                        type(child).__name__ == "Conv2D":
+                    setattr(parent, name, QuantedConv2D(child, self._cfg))
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from ..jit import save as jit_save
+        model.eval()
+        jit_save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ calibration (reference: post_training_quantization.py): run
+    sample batches, collect per-layer activation scales (abs_max or
+    percentile histogram), emit weight scales + a quantized eval model."""
+
+    def __init__(self, model, algo="abs_max", weight_bits=8,
+                 activation_bits=8, percentile=0.9999):
+        self._model = model
+        self._algo = algo
+        self._bits = activation_bits
+        self._wbits = weight_bits
+        self._pct = percentile
+        self._acts = {}      # layer name -> list of abs samples
+        self._hooks = []
+
+    def _make_hook(self, name):
+        def hook(layer, inputs, outputs=None):
+            x = inputs[0] if isinstance(inputs, tuple) else inputs
+            if isinstance(x, Tensor):
+                a = np.abs(np.asarray(x.numpy(), np.float32)).reshape(-1)
+                if self._algo == "abs_max":
+                    self._acts.setdefault(name, []).append(float(a.max()))
+                else:   # percentile / hist
+                    self._acts.setdefault(name, []).append(
+                        float(np.quantile(a, self._pct)))
+        return hook
+
+    def quantize(self, data_loader, batch_nums=8):
+        """Calibrate, then return (model, scales)."""
+        from ..nn import Conv2D, Linear
+        targets = [(n, l) for n, l in self._model.named_sublayers()
+                   if isinstance(l, (Linear, Conv2D))]
+        for n, l in targets:
+            self._hooks.append(l.register_forward_pre_hook(
+                self._make_hook(n)))
+        self._model.eval()
+        for i, batch in enumerate(data_loader):
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            self._model(x)
+            if i + 1 >= batch_nums:
+                break
+        for h in self._hooks:
+            h.remove()
+        scales = {}
+        for n, l in targets:
+            samples = self._acts.get(n, [0.0])
+            act_scale = float(np.mean(samples)) if self._algo != "abs_max" \
+                else float(np.max(samples))
+            w_scale = float(jnp.max(jnp.abs(l.weight._data)))
+            scales[n] = {"activation": act_scale, "weight": w_scale}
+            # bake fake-quantized weights (deploy-accuracy simulation)
+            l.weight._data = _fake_quant_raw(
+                l.weight._data, jnp.float32(w_scale), self._wbits)
+        return self._model, scales

@@ -1,22 +1,43 @@
-"""paddle.sparse equivalent (reference: python/paddle/sparse + phi sparse
-kernels).
+"""paddle.sparse equivalent (reference: python/paddle/sparse over the phi
+sparse kernel library — SparseCooTensor/SparseCsrTensor in
+paddle/phi/core/sparse_coo_tensor.h + phi/kernels/sparse/).
 
-TPU-native note: XLA has no native sparse tensor; COO here is a thin wrapper
-(indices, values, shape) with ops implemented via scatter/gather — adequate
-for sparse gradients and sparse nn. The reference's SparseCooTensor is
-paddle/phi/core/sparse_coo_tensor.h.
+TPU-native design: XLA has no first-class sparse type; COO/CSR here are
+(indices, values, shape) wrappers whose ops lower to scatter/gather —
+the same strategy jax.experimental.sparse uses. Dense-like unary ops act on
+`values` only (nnz-sized compute); binary/matmul densify at the XLA
+boundary, where fusion makes the materialization cheap at these sizes.
+Point-cloud 3D sparse convs (phi/kernels/sparse/conv_kernel.cu) are
+descoped this round — see PARITY.md.
 """
-import jax.numpy as jnp
 import numpy as np
 
+import jax.numpy as jnp
+
 from ..core.tensor import Tensor
+from . import nn  # noqa: F401
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_same_shape", "matmul", "masked_matmul",
+           "add", "subtract", "multiply", "divide", "relu", "tanh", "sin",
+           "sinh", "asin", "asinh", "atan", "atanh", "sqrt", "square",
+           "abs", "pow", "neg", "cast", "transpose", "coalesce", "nn"]
 
 
 class SparseCooTensor:
     def __init__(self, indices, values, shape):
-        self.indices_ = indices if isinstance(indices, Tensor) else Tensor(jnp.asarray(indices))
-        self.values_ = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+        self.indices_ = indices if isinstance(indices, Tensor) else \
+            Tensor(jnp.asarray(indices))
+        self.values_ = values if isinstance(values, Tensor) else \
+            Tensor(jnp.asarray(values))
         self.shape = list(shape)
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    def nnz(self):
+        return int(self.values_._data.shape[0])
 
     def indices(self):
         return self.indices_
@@ -26,37 +47,216 @@ class SparseCooTensor:
 
     def to_dense(self):
         out = jnp.zeros(tuple(self.shape), dtype=self.values_._data.dtype)
-        idx = tuple(self.indices_._data[i] for i in range(self.indices_._data.shape[0]))
+        idx = tuple(self.indices_._data[i]
+                    for i in range(self.indices_._data.shape[0]))
         return Tensor(out.at[idx].add(self.values_._data))
+
+    def to_sparse_csr(self):
+        """2-D only; rows must be sorted (coalesce() first otherwise)."""
+        ind = np.asarray(self.indices_._data)
+        order = np.lexsort((ind[1], ind[0]))
+        rows, cols = ind[0][order], ind[1][order]
+        vals = jnp.asarray(self.values_._data)[order]
+        crows = np.zeros(self.shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, cols, vals, self.shape)
 
     def is_sparse_coo(self):
         return True
 
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.values_._data.dtype})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        as_t = lambda v: v if isinstance(v, Tensor) else \
+            Tensor(jnp.asarray(v))
+        self.crows_ = as_t(crows)
+        self.cols_ = as_t(cols)
+        self.values_ = as_t(values)
+        self.shape = list(shape)
+
+    def crows(self):
+        return self.crows_
+
+    def cols(self):
+        return self.cols_
+
+    def values(self):
+        return self.values_
+
+    def nnz(self):
+        return int(self.values_._data.shape[0])
+
+    def to_sparse_coo(self, sparse_dim=2):
+        crows = np.asarray(self.crows_._data)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        indices = jnp.stack([jnp.asarray(rows, jnp.int64),
+                             self.cols_._data.astype(jnp.int64)])
+        return SparseCooTensor(Tensor(indices), self.values_, self.shape)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.values_._data.dtype})")
+
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
-    return SparseCooTensor(indices, values, shape)
+    ind = jnp.asarray(indices._data if isinstance(indices, Tensor)
+                      else indices)
+    val = jnp.asarray(values._data if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..core import dtype as _dt
+        val = val.astype(_dt.convert_dtype(dtype))
+    if shape is None:
+        shape = [int(d) + 1 for d in np.asarray(ind).max(axis=1)]
+    return SparseCooTensor(Tensor(ind), Tensor(val), shape)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    crows_t = crows if isinstance(crows, Tensor) else Tensor(jnp.asarray(crows))
-    cols_t = cols if isinstance(cols, Tensor) else Tensor(jnp.asarray(cols))
-    values_t = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
-    # convert CSR -> COO rows
-    crows_np = np.asarray(crows_t._data)
-    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    indices = jnp.stack([jnp.asarray(rows), cols_t._data.astype(rows.dtype)])
-    return SparseCooTensor(Tensor(indices), values_t, shape)
+    val = jnp.asarray(values._data if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..core import dtype as _dt
+        val = val.astype(_dt.convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, Tensor(val), shape)
+
+
+def coalesce(x):
+    """Merge duplicate coordinates (sum values), sort row-major."""
+    ind = np.asarray(x.indices_._data)
+    vals = np.asarray(x.values_._data)
+    flat = np.ravel_multi_index(tuple(ind), tuple(x.shape[:ind.shape[0]]))
+    uniq, inv = np.unique(flat, return_inverse=True)
+    merged = np.zeros((uniq.size,) + vals.shape[1:], vals.dtype)
+    np.add.at(merged, inv, vals)
+    new_ind = np.stack(np.unravel_index(uniq, tuple(x.shape[:ind.shape[0]])))
+    return SparseCooTensor(Tensor(jnp.asarray(new_ind)),
+                           Tensor(jnp.asarray(merged)), x.shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _values_op(fn):
+    def op(x, *a, name=None, **kw):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices_,
+                                   Tensor(fn(x.values_._data, *a, **kw)),
+                                   x.shape)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows_, x.cols_,
+                                   Tensor(fn(x.values_._data, *a, **kw)),
+                                   x.shape)
+        return Tensor(fn(x._data, *a, **kw))
+    return op
+
+
+# nnz-only elementwise family (zero-preserving, like the reference's sparse
+# unary kernels phi/kernels/sparse/unary_kernel.cc)
+relu = _values_op(lambda v: jnp.maximum(v, 0))
+tanh = _values_op(jnp.tanh)
+sin = _values_op(jnp.sin)
+sinh = _values_op(jnp.sinh)
+asin = _values_op(jnp.arcsin)
+asinh = _values_op(jnp.arcsinh)
+atan = _values_op(jnp.arctan)
+atanh = _values_op(jnp.arctanh)
+sqrt = _values_op(jnp.sqrt)
+square = _values_op(jnp.square)
+abs = _values_op(jnp.abs)          # noqa: A001
+neg = _values_op(jnp.negative)
+pow = _values_op(lambda v, p: jnp.power(v, p))   # noqa: A001
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtype as _dt
+    vd = _dt.convert_dtype(value_dtype) if value_dtype else None
+    idd = _dt.convert_dtype(index_dtype) if index_dtype else None
+    if isinstance(x, SparseCooTensor):
+        ind = x.indices_._data.astype(idd) if idd else x.indices_._data
+        val = x.values_._data.astype(vd) if vd else x.values_._data
+        return SparseCooTensor(Tensor(ind), Tensor(val), x.shape)
+    crows = x.crows_._data.astype(idd) if idd else x.crows_._data
+    cols = x.cols_._data.astype(idd) if idd else x.cols_._data
+    val = x.values_._data.astype(vd) if vd else x.values_._data
+    return SparseCsrTensor(Tensor(crows), Tensor(cols), Tensor(val), x.shape)
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        ind = x.indices_._data[jnp.asarray(perm)]
+        shape = [x.shape[p] for p in perm]
+        return SparseCooTensor(Tensor(ind), x.values_, shape)
+    return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
+
+
+def _dense(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x.to_dense()._data
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def matmul(x, y, name=None):
-    if isinstance(x, SparseCooTensor):
-        return Tensor(jnp.matmul(x.to_dense()._data, y._data))
-    return Tensor(jnp.matmul(x._data, y._data))
+    return Tensor(jnp.matmul(_dense(x), _dense(y)))
 
 
-def add(x, y, name=None):
-    xd = x.to_dense()._data if isinstance(x, SparseCooTensor) else x._data
-    yd = y.to_dense()._data if isinstance(y, SparseCooTensor) else y._data
-    return Tensor(xd + yd)
+def masked_matmul(x, y, mask, name=None):
+    """dense@dense masked to a sparse pattern (reference:
+    sparse/multiary masked_matmul): computes only at mask's nnz via gather
+    of the needed rows/cols."""
+    xd = _dense(x)
+    yd = _dense(y)
+    ind = mask.indices_._data if isinstance(mask, SparseCooTensor) else \
+        mask.to_sparse_coo().indices_._data
+    rows, cols = ind[0], ind[1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    out = SparseCooTensor(Tensor(ind), Tensor(vals), mask.shape)
+    return out if isinstance(mask, SparseCooTensor) else out.to_sparse_csr()
+
+
+def _binary(fn):
+    def op(x, y, name=None):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            return _from_dense_coo(Tensor(fn(_dense(x), _dense(y))))
+        if isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor):
+            return _from_dense_coo(
+                Tensor(fn(_dense(x), _dense(y)))).to_sparse_csr()
+        return Tensor(fn(_dense(x), _dense(y)))
+    return op
+
+
+def _from_dense_coo(t):
+    arr = np.asarray(t._data)
+    ind = np.stack(np.nonzero(arr))
+    return SparseCooTensor(Tensor(jnp.asarray(ind)),
+                           Tensor(jnp.asarray(arr[tuple(ind)])),
+                           list(arr.shape))
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
+def to_sparse_coo(dense, sparse_dim=None):
+    """Tensor -> SparseCooTensor of its nonzeros (paddle
+    Tensor.to_sparse_coo)."""
+    return _from_dense_coo(dense)

@@ -1,0 +1,31 @@
+"""paddle.sparse.nn — layers over sparse tensors.
+
+Reference: python/paddle/sparse/nn (ReLU, BatchNorm, Conv3D/SubmConv3D for
+point clouds). ReLU/BatchNorm act on the values vector; the 3-D submanifold
+convs are descoped this round (PARITY.md) — they need the gather-scatter
+rulebook kernels that only pay off for point-cloud workloads.
+"""
+from ...nn.layer.layers import Layer
+
+__all__ = ["ReLU", "BatchNorm"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import relu
+        return relu(x)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the nnz values (per-channel, last dim of values)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x):
+        from .. import SparseCooTensor
+        vals = self._bn(x.values_)
+        return SparseCooTensor(x.indices_, vals, x.shape)

@@ -1,6 +1,7 @@
 """paddle.vision equivalent."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
 

@@ -8,3 +8,6 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
 )
+from .ppyoloe import (  # noqa: F401
+    PPYOLOE, PPYOLOEConfig, ppyoloe_crn_tiny, ppyoloe_loss, ppyoloe_s,
+)

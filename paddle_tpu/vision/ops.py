@@ -1,0 +1,388 @@
+"""paddle.vision.ops — detection/vision operators.
+
+Reference: python/paddle/vision/ops.py (roi_align, roi_pool, nms,
+deform_conv2d/DeformConv2D, distribute_fpn_proposals, yolo_box) over CUDA
+kernels (paddle/phi/kernels/gpu/roi_align_kernel.cu, nms_kernel.cu,
+deformable_conv_kernel.cu, ...).
+
+TPU-native design: the pooled/deformable ops are expressed as vectorized
+bilinear gathers + reductions — static shapes, fuse into the surrounding
+XLA program, and batch onto the VPU/MXU (no per-box CUDA-thread loop to
+port). `nms` is a host-side numpy pass: it is sequential by nature and in
+every serving pipeline runs as postprocess off the accelerator.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["roi_align", "roi_pool", "nms", "deform_conv2d", "DeformConv2D",
+           "distribute_fpn_proposals", "yolo_box"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def _bilinear_sample(feat, ys, xs):
+    """feat: (R, C, H, W); ys: (R, A); xs: (R, B) -> (R, C, A, B).
+
+    Reference roi_align boundary semantics (phi roi_align_kernel): points
+    further than 1px outside contribute 0; points in (-1, 0] clamp to the
+    border; corner indices clamp at the far edge."""
+    R, C, H, W = feat.shape
+    valid = ((ys >= -1.0) & (ys <= H))[:, :, None] & \
+            ((xs >= -1.0) & (xs <= W))[:, None, :]
+    ys = jnp.clip(ys, 0.0, H - 1)
+    xs = jnp.clip(xs, 0.0, W - 1)
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    ly = ys - y0
+    lx = xs - x0
+    y0i = y0.astype(jnp.int32)
+    x0i = x0.astype(jnp.int32)
+    y1i = jnp.minimum(y0i + 1, H - 1)
+    x1i = jnp.minimum(x0i + 1, W - 1)
+
+    r = jnp.arange(R)[:, None, None]
+
+    def at(yi, xi):
+        # advanced-index: (R, A, B) gather per channel -> (R, A, B, C)
+        return feat[r, :, yi[:, :, None], xi[:, None, :]]
+
+    w00 = ((1 - ly)[:, :, None] * (1 - lx)[:, None, :])[..., None]
+    w01 = ((1 - ly)[:, :, None] * lx[:, None, :])[..., None]
+    w10 = (ly[:, :, None] * (1 - lx)[:, None, :])[..., None]
+    w11 = (ly[:, :, None] * lx[:, None, :])[..., None]
+    out = (at(y0i, x0i) * w00 + at(y0i, x1i) * w01 +
+           at(y1i, x0i) * w10 + at(y1i, x1i) * w11)
+    out = out * valid[..., None]
+    return jnp.transpose(out, (0, 3, 1, 2))       # (R, C, A, B)
+
+
+def _roi_sample_grid(boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio, aligned):
+    ph, pw = _pair(output_size)
+    ns = sampling_ratio if sampling_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+    b = boxes * spatial_scale
+    x1, y1, x2, y2 = b[:, 0] - off, b[:, 1] - off, b[:, 2] - off, b[:, 3] - off
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+    # sample points: (R, ph*ns) y coords, (R, pw*ns) x coords
+    iy = (jnp.arange(ph * ns) + 0.5) / ns          # in bin units
+    ix = (jnp.arange(pw * ns) + 0.5) / ns
+    ys = y1[:, None] + iy[None, :] * bin_h[:, None]
+    xs = x1[:, None] + ix[None, :] * bin_w[:, None]
+    # roi -> batch image index
+    counts = np.asarray(boxes_num) if boxes_num is not None else None
+    return ys, xs, ph, pw, ns, counts
+
+
+def _rois_feat(x, boxes, boxes_num):
+    R = boxes.shape[0]
+    if boxes_num is None:
+        bidx = jnp.zeros((R,), jnp.int32)
+    else:
+        counts = jnp.asarray(_unwrap(boxes_num), jnp.int32)
+        bidx = jnp.repeat(jnp.arange(counts.shape[0], dtype=jnp.int32),
+                          counts, total_repeat_length=R)
+    return x[bidx]
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference vision/ops.py roi_align): average of bilinear
+    samples per output bin. adaptive sampling_ratio (-1) uses 2 points/axis.
+    Differentiable w.r.t. the feature map AND the boxes (tape-recorded)."""
+    def fn(xr, br):
+        br32 = br.astype(jnp.float32)
+        ys, xs, ph, pw, ns, _ = _roi_sample_grid(
+            br32, boxes_num, output_size, spatial_scale, sampling_ratio,
+            aligned)
+        feat = _rois_feat(xr, br32, boxes_num)
+        samples = _bilinear_sample(feat, ys, xs)   # (R, C, ph*ns, pw*ns)
+        R, C = samples.shape[:2]
+        return samples.reshape(R, C, ph, ns, pw, ns).mean(axis=(3, 5))
+
+    if isinstance(x, Tensor):
+        return apply_op(fn, x, boxes if isinstance(boxes, Tensor)
+                        else to_tensor(boxes), name="roi_align")
+    return fn(jnp.asarray(x), jnp.asarray(boxes))
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    """RoIPool with the reference's exact quantized-bin max semantics
+    (phi roi_pool_kernel). Host-side numpy: bin extents are data-dependent
+    (dynamic shapes), so this legacy op stays eager — new models should use
+    roi_align, which compiles."""
+    xr = np.asarray(_unwrap(x))
+    br = np.asarray(_unwrap(boxes), np.float32) * spatial_scale
+    ph, pw = _pair(output_size)
+    N, C, H, W = xr.shape
+    R = br.shape[0]
+    counts = (np.asarray(boxes_num) if boxes_num is not None
+              else np.asarray([R]))
+    bidx = np.repeat(np.arange(counts.shape[0]), counts)
+    out = np.zeros((R, C, ph, pw), xr.dtype)
+    for r in range(R):
+        x1, y1, x2, y2 = np.round(br[r]).astype(np.int64)
+        roi_h = max(y2 - y1 + 1, 1)
+        roi_w = max(x2 - x1 + 1, 1)
+        for py in range(ph):
+            ys_ = y1 + int(np.floor(py * roi_h / ph))
+            ye = y1 + int(np.ceil((py + 1) * roi_h / ph))
+            ys_, ye = np.clip([ys_, ye], 0, H)
+            for px in range(pw):
+                xs_ = x1 + int(np.floor(px * roi_w / pw))
+                xe = x1 + int(np.ceil((px + 1) * roi_w / pw))
+                xs_, xe = np.clip([xs_, xe], 0, W)
+                if ye > ys_ and xe > xs_:
+                    out[r, :, py, px] = xr[bidx[r], :, ys_:ye,
+                                           xs_:xe].max(axis=(1, 2))
+    return to_tensor(out) if isinstance(x, Tensor) else out
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS (reference vision/ops.py nms; phi nms_kernel). Host-side
+    numpy: sequential suppression is postprocess, not accelerator work.
+    Returns kept indices (int64), score-descending."""
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes,
+                   dtype=np.float32)
+    n = b.shape[0]
+    s = (np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores,
+                    dtype=np.float32) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float32))
+    order = np.argsort(-s)
+    iou = _iou_matrix(b)
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs.numpy()
+                          if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+        iou = iou * (cats[:, None] == cats[None, :])  # suppress within class
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return to_tensor(keep) if isinstance(boxes, Tensor) else keep
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py
+    distribute_fpn_proposals): level = floor(refer + log2(sqrt(area)/scale))."""
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois, dtype=np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-9))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-9))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore = [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.where(lvl == l)[0]
+        multi_rois.append(to_tensor(rois[idx]))
+        restore.append(idx)
+    restore_ind = np.argsort(np.concatenate(restore)) if restore else \
+        np.zeros((0,), np.int64)
+    return multi_rois, to_tensor(restore_ind.astype(np.int64)), None
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLO head map (N, A*(5+C), H, W) to boxes + scores
+    (reference vision/ops.py yolo_box)."""
+    xr = _unwrap(x).astype(jnp.float32)
+    N, _, H, W = xr.shape
+    A = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    p = xr.reshape(N, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    sxy = scale_x_y
+    bx = (jax.nn.sigmoid(p[:, :, 0]) * sxy - (sxy - 1) / 2 + gx) / W
+    by = (jax.nn.sigmoid(p[:, :, 1]) * sxy - (sxy - 1) / 2 + gy) / H
+    bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / \
+        (W * downsample_ratio)
+    bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / \
+        (H * downsample_ratio)
+    obj = jax.nn.sigmoid(p[:, :, 4])
+    cls = jax.nn.sigmoid(p[:, :, 5:])
+    scores = obj[:, :, None] * cls                  # (N, A, C, H, W)
+
+    img = _unwrap(img_size).astype(jnp.float32)    # (N, 2) h, w
+    imh = img[:, 0][:, None, None, None]
+    imw = img[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    scores = jnp.transpose(scores, (0, 1, 3, 4, 2)).reshape(
+        N, -1, class_num)
+    mask = (obj.reshape(N, -1, 1) > conf_thresh)
+    boxes = boxes * mask
+    wrap = isinstance(x, Tensor)
+    return (Tensor(boxes), Tensor(scores)) if wrap else (boxes, scores)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference vision/ops.py deform_conv2d over
+    phi deformable_conv kernels): bilinear-sample the input at
+    offset-perturbed taps, then contract with the kernel — the gather+matmul
+    formulation that XLA tiles onto the MXU. Tape-recorded (grads flow to
+    input, offsets, weight, bias, and mask)."""
+    tensor_out = isinstance(x, Tensor)
+    args = [x, offset, weight]
+    has_bias = bias is not None
+    has_mask = mask is not None
+    if has_bias:
+        args.append(bias if isinstance(bias, Tensor) else to_tensor(bias))
+    if has_mask:
+        args.append(mask if isinstance(mask, Tensor) else to_tensor(mask))
+
+    def fn(xr, offr, wr, *rest):
+        b = rest[0] if has_bias else None
+        m = rest[-1] if has_mask else None
+        return _deform_conv2d_raw(xr, offr, wr, b, m, stride, padding,
+                                  dilation, deformable_groups, groups)
+
+    if tensor_out:
+        return apply_op(fn, *args, name="deform_conv2d")
+    raw = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+           for a in args]
+    return fn(*raw)
+
+
+def _deform_conv2d_raw(xr, offr, wr, bias, mask, stride, padding, dilation,
+                       deformable_groups, groups):
+    xr = xr.astype(jnp.float32)
+    offr = offr.astype(jnp.float32)
+    wr = wr.astype(jnp.float32)
+    N, C, H, W = xr.shape
+    Co, Cg, kh, kw = wr.shape
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups/deformable_groups > 1")
+
+    # sampling positions: base grid + kernel taps + learned offsets
+    oy = jnp.arange(Ho, dtype=jnp.float32) * sh - ph
+    ox = jnp.arange(Wo, dtype=jnp.float32) * sw - pw
+    ky = jnp.arange(kh, dtype=jnp.float32) * dh
+    kx = jnp.arange(kw, dtype=jnp.float32) * dw
+    # offsets layout (reference): (N, 2*kh*kw, Ho, Wo), [dy, dx] per tap
+    off = offr.reshape(N, kh * kw, 2, Ho, Wo)
+    ys = (oy[None, None, :, None] + ky.repeat(kw)[None, :, None, None] +
+          off[:, :, 0])                            # (N, kh*kw, Ho, Wo)
+    xs = (ox[None, None, None, :] + jnp.tile(kx, kh)[None, :, None, None] +
+          off[:, :, 1])
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    ly = ys - y0
+    lx = xs - x0
+
+    # gather all 4 corners: vectorized via take along flattened HW
+    def gather(yi, xi):
+        yc = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+        flat = (yc * W + xc).reshape(N, -1)        # (N, K*Ho*Wo)
+        g = jnp.take_along_axis(xr.reshape(N, C, H * W),
+                                flat[:, None, :], axis=2)
+        valid = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) &
+                 (xi <= W - 1)).reshape(N, 1, -1)
+        return g * valid
+
+    v = (gather(y0, x0) * ((1 - ly) * (1 - lx)).reshape(N, 1, -1) +
+         gather(y0, x0 + 1) * ((1 - ly) * lx).reshape(N, 1, -1) +
+         gather(y0 + 1, x0) * (ly * (1 - lx)).reshape(N, 1, -1) +
+         gather(y0 + 1, x0 + 1) * (ly * lx).reshape(N, 1, -1))
+    cols = v.reshape(N, C, kh * kw, Ho, Wo)
+    if mask is not None:                            # v2 modulation
+        cols = cols * mask.astype(jnp.float32).reshape(N, 1, kh * kw,
+                                                       Ho, Wo)
+    out = jnp.einsum("nckhw,ock->nohw", cols, wr.reshape(Co, C, kh * kw))
+    if bias is not None:
+        out = out + bias.reshape(1, Co, 1, 1)
+    return out
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference: vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._deformable_groups = deformable_groups
+        fan_in = in_channels * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        rng = np.random.RandomState(0)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            default_initializer=lambda shape, dtype: jnp.asarray(
+                rng.uniform(-bound, bound, shape), dtype))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], is_bias=True,
+                default_initializer=lambda shape, dtype: jnp.zeros(
+                    shape, dtype))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation, groups=self._groups,
+                             deformable_groups=self._deformable_groups,
+                             mask=mask)

@@ -1,0 +1,115 @@
+"""Quantization (QAT/PTQ) + ASP 2:4 sparsity.
+
+Mirrors the reference's test_imperative_qat.py / test_post_training_quant /
+test_asp_* suites."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (ImperativeQuantAware,
+                                     PostTrainingQuantization, fake_quant)
+
+
+def test_fake_quant_levels_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 101).astype("float32"),
+                         stop_gradient=False)
+    q = fake_quant(x, scale=1.0, bits=8)
+    vals = np.unique(np.round(q.numpy() * 127).astype(np.int32))
+    assert len(vals) <= 255
+    np.testing.assert_allclose(q.numpy(), x.numpy(), atol=1.0 / 127)
+    # straight-through gradient: d(sum(q))/dx == 1 strictly inside the range
+    # (exactly at ±scale the clip subgradient is 0.5 — boundary convention)
+    q.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy()[1:-1], 1.0, atol=1e-6)
+
+
+def test_qat_swaps_and_trains():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    from paddle_tpu.quantization import QuantedLinear
+    assert isinstance(net[0], QuantedLinear)
+    o = opt.Adam(1e-2, parameters=net.parameters())
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype("float32")
+    y = (x.sum(1) > 4).astype("int64")
+    losses = []
+    for _ in range(10):
+        l = lf(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+    # moving-average activation scale was tracked
+    assert float(net[0].act_scale.numpy()) > 0
+
+
+def test_qat_quantized_model_close_to_float():
+    paddle.seed(1)
+    float_net = nn.Linear(8, 4)
+    qnet = ImperativeQuantAware().quantize(
+        nn.Sequential(nn.Linear(8, 4)))
+    qnet[0].inner.weight._data = float_net.weight._data
+    qnet[0].inner.bias._data = float_net.bias._data
+    qnet.eval()
+    x = paddle.to_tensor(np.random.RandomState(2).rand(4, 8)
+                         .astype("float32"))
+    np.testing.assert_allclose(qnet(x).numpy(), float_net(x).numpy(),
+                               atol=0.05)
+
+
+def test_ptq_calibrates_scales():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    ptq = PostTrainingQuantization(net, algo="abs_max")
+    rng = np.random.RandomState(3)
+    batches = [(paddle.to_tensor(rng.rand(4, 8).astype("float32") * 3),)
+               for _ in range(4)]
+    model, scales = ptq.quantize(batches, batch_nums=4)
+    assert set(scales) == {"0", "2"}
+    assert scales["0"]["activation"] > 2.0   # saw inputs up to ~3
+    assert scales["0"]["weight"] > 0
+    # weights got baked to the int8 grid
+    w = model[0].weight.numpy()
+    q = np.round(w / scales["0"]["weight"] * 127)
+    np.testing.assert_allclose(w, q * scales["0"]["weight"] / 127,
+                               atol=1e-6)
+
+
+def test_asp_mask_pattern():
+    w = np.random.RandomState(0).rand(8, 16).astype("float32")
+    mask = asp.create_mask(w, n=2, m=4)
+    assert asp.check_sparsity(w * mask, 2, 4)
+    assert asp.calculate_density(w * mask) == pytest.approx(0.5, abs=0.01)
+    # the kept entries are the 2 largest |w| of each group of 4
+    g = (np.abs(w).reshape(8, 4, 4))
+    kept = (mask.reshape(8, 4, 4) > 0)
+    for r in range(8):
+        for c in range(4):
+            topk = set(np.argsort(-g[r, c])[:2])
+            assert set(np.where(kept[r, c])[0]) == topk
+
+
+def test_asp_prune_and_decorated_step_keeps_sparsity():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    asp.prune_model(net)
+    assert asp.check_sparsity(net[0].weight.numpy())
+    o = asp.decorate(opt.SGD(0.1, parameters=net.parameters()))
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.rand(16, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, 16))
+    for _ in range(3):
+        l = lf(net(x), y)
+        l.backward()
+        o.step()
+        o.clear_grad()
+    # sparsity survives optimizer updates
+    assert asp.check_sparsity(net[0].weight.numpy())
+    assert asp.calculate_density(net[0].weight.numpy()) <= 0.51

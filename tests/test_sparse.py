@@ -1,0 +1,100 @@
+"""paddle.sparse: COO/CSR roundtrips, values-only unary ops, masked matmul.
+
+Mirrors the reference's test_sparse_utils_op.py / test_sparse_unary_op.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    ind = [[0, 1, 2], [1, 0, 2]]
+    vals = [1.0, 2.0, 3.0]
+    return sparse.sparse_coo_tensor(ind, vals, [3, 3])
+
+
+def test_coo_to_dense():
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1], want[1, 0], want[2, 2] = 1, 2, 3
+    np.testing.assert_array_equal(_coo().to_dense().numpy(), want)
+
+
+def test_coo_csr_roundtrip():
+    coo = _coo()
+    csr = coo.to_sparse_csr()
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(csr.cols().numpy(), [1, 0, 2])
+    back = csr.to_sparse_coo()
+    np.testing.assert_array_equal(back.to_dense().numpy(),
+                                  coo.to_dense().numpy())
+
+
+def test_csr_constructor():
+    csr = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1], [1., 2., 3.],
+                                   [2, 3])
+    want = np.asarray([[1, 0, 2], [0, 3, 0]], np.float32)
+    np.testing.assert_array_equal(csr.to_dense().numpy(), want)
+
+
+def test_coalesce_merges_duplicates():
+    x = sparse.sparse_coo_tensor([[0, 0, 1], [1, 1, 0]], [1., 2., 5.],
+                                 [2, 2])
+    c = sparse.coalesce(x)
+    assert c.nnz() == 2
+    np.testing.assert_array_equal(c.to_dense().numpy(),
+                                  [[0, 3], [5, 0]])
+
+
+def test_unary_ops_touch_values_only():
+    x = _coo()
+    r = sparse.relu(sparse.neg(x))
+    assert r.nnz() == 3
+    np.testing.assert_array_equal(r.to_dense().numpy(), np.zeros((3, 3)))
+    s = sparse.square(x)
+    np.testing.assert_array_equal(np.sort(s.values().numpy()), [1, 4, 9])
+
+
+def test_binary_and_matmul():
+    x = _coo()
+    y = _coo()
+    z = sparse.add(x, y)
+    np.testing.assert_array_equal(z.to_dense().numpy(),
+                                  x.to_dense().numpy() * 2)
+    d = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    out = sparse.matmul(x, d)
+    np.testing.assert_array_equal(out.numpy(), x.to_dense().numpy())
+
+
+def test_masked_matmul():
+    rng = np.random.RandomState(0)
+    a = paddle.to_tensor(rng.rand(3, 4).astype(np.float32))
+    b = paddle.to_tensor(rng.rand(4, 3).astype(np.float32))
+    mask = _coo()
+    out = sparse.masked_matmul(a, b, mask)
+    dense = a.numpy() @ b.numpy()
+    ind = np.asarray(mask.indices().numpy())
+    for k in range(3):
+        i, j = ind[0, k], ind[1, k]
+        np.testing.assert_allclose(
+            out.to_dense().numpy()[i, j], dense[i, j], rtol=1e-5)
+
+
+def test_sparse_nn_layers():
+    x = _coo()
+    relu = sparse.nn.ReLU()
+    out = relu(x)
+    assert out.is_sparse_coo()
+    bn = sparse.nn.BatchNorm(1)
+    vals = paddle.to_tensor(np.asarray([[1.], [2.], [3.]], np.float32))
+    xb = sparse.SparseCooTensor(x.indices_, vals, [3, 3, 1])
+    out = bn(xb)
+    assert abs(float(out.values().numpy().mean())) < 1e-5
+
+
+def test_cast_and_transpose():
+    x = _coo()
+    c = sparse.cast(x, value_dtype="float64")
+    t = sparse.transpose(x, [1, 0])
+    np.testing.assert_array_equal(t.to_dense().numpy(),
+                                  x.to_dense().numpy().T)

@@ -1,0 +1,149 @@
+"""vision.ops: roi_align/roi_pool/nms/deform_conv2d/yolo_box/fpn.
+
+Mirrors the reference OpTest suites (test_roi_align_op.py, test_nms_op.py,
+test_deformable_conv_op.py): numeric checks against hand-computed or
+reference-formula values (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import (DeformConv2D, deform_conv2d,
+                                   distribute_fpn_proposals, nms, roi_align,
+                                   roi_pool, yolo_box)
+
+
+def test_roi_align_uniform_map():
+    # constant feature map: every pooled value equals the constant
+    x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    boxes = paddle.to_tensor(np.asarray([[0., 0., 7., 7.]], np.float32))
+    out = roi_align(x, boxes, boxes_num=[1], output_size=4)
+    assert list(out.shape) == [1, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-6)
+
+
+def test_roi_align_gradient_map():
+    # linear-in-x feature: pooled bin centers must be linear too
+    ramp = np.tile(np.arange(8, dtype=np.float32), (8, 1))
+    x = paddle.to_tensor(ramp[None, None])
+    boxes = paddle.to_tensor(np.asarray([[0., 0., 8., 8.]], np.float32))
+    out = roi_align(x, boxes, boxes_num=[1], output_size=2,
+                    aligned=True).numpy()[0, 0]
+    # left bins average x in [0,4) -> ~1.5; right bins [4,8) -> ~5.5
+    assert out[0, 0] < out[0, 1]
+    np.testing.assert_allclose(out[:, 1] - out[:, 0], 4.0, atol=0.2)
+
+
+def test_roi_align_batch_routing():
+    x = np.zeros((2, 1, 4, 4), np.float32)
+    x[0] = 1.0
+    x[1] = 9.0
+    boxes = np.asarray([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+    out = roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                    boxes_num=[1, 1], output_size=1).numpy()
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, atol=1e-5)
+    np.testing.assert_allclose(out[1, 0, 0, 0], 9.0, atol=1e-5)
+
+
+def test_roi_pool_takes_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 7.0
+    out = roi_pool(paddle.to_tensor(x),
+                   paddle.to_tensor(np.asarray([[0., 0., 7., 7.]],
+                                               np.float32)),
+                   boxes_num=[1], output_size=2).numpy()
+    assert out.max() > 5.0     # the spike lands in one bin's max
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.asarray([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],     # high overlap with box 0
+        [20, 20, 30, 30],   # disjoint
+    ], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    keep = nms(boxes, iou_threshold=0.5, scores=scores)
+    np.testing.assert_array_equal(np.sort(keep), [0, 2])
+
+
+def test_nms_categories_kept_separate():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.asarray([0.9, 0.8], np.float32)
+    cats = np.asarray([0, 1])
+    keep = nms(boxes, 0.5, scores, category_idxs=cats, categories=[0, 1])
+    assert len(keep) == 2       # different classes: no suppression
+
+
+def test_nms_top_k():
+    boxes = np.asarray([[i * 20, 0, i * 20 + 10, 10] for i in range(5)],
+                       np.float32)
+    scores = np.asarray([0.1, 0.9, 0.5, 0.7, 0.3], np.float32)
+    keep = nms(boxes, 0.5, scores, top_k=2)
+    np.testing.assert_array_equal(keep, [1, 3])
+
+
+def test_distribute_fpn_proposals():
+    rois = np.asarray([
+        [0, 0, 16, 16],       # small -> low level
+        [0, 0, 448, 448],     # big  -> high level
+    ], np.float32)
+    multi, restore, _ = distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 2
+    assert multi[0].shape[0] == 1 and multi[-1].shape[0] == 1
+    assert sorted(restore.numpy().tolist()) == [0, 1]
+
+
+def test_deform_conv_zero_offset_matches_conv():
+    """Zero offsets reduce deformable conv to a plain convolution."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                        paddle.to_tensor(w)).numpy()
+    import jax
+    want = jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_mask_modulation():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    w = rng.rand(2, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 18, 4, 4), np.float32)
+    full = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                         paddle.to_tensor(w),
+                         mask=np.ones((1, 9, 4, 4), np.float32)).numpy()
+    half = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                         paddle.to_tensor(w),
+                         mask=np.full((1, 9, 4, 4), 0.5,
+                                      np.float32)).numpy()
+    np.testing.assert_allclose(half, full * 0.5, rtol=1e-5)
+
+
+def test_deform_conv_layer_trains():
+    layer = DeformConv2D(2, 3, 3)
+    x = paddle.to_tensor(np.random.RandomState(2).rand(1, 2, 6, 6)
+                         .astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+    out = layer(x, off)
+    assert list(out.shape) == [1, 3, 4, 4]
+    loss = (out * out).sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+
+
+def test_yolo_box_decodes():
+    N, A, C, H, W = 1, 2, 3, 2, 2
+    x = np.zeros((N, A * (5 + C), H, W), np.float32)
+    x[:, 4] = 5.0     # anchor0 objectness ~ sigmoid(5) ~ 0.993
+    boxes, scores = yolo_box(paddle.to_tensor(x),
+                             paddle.to_tensor(np.asarray([[64, 64]],
+                                                         np.int32)),
+                             anchors=[10, 13, 16, 30], class_num=C,
+                             downsample_ratio=32)
+    assert list(boxes.shape) == [1, A * H * W, 4]
+    assert list(scores.shape) == [1, A * H * W, C]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 64).all()     # clipped to image
