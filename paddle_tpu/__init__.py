@@ -45,6 +45,7 @@ from . import device  # noqa: F401
 from . import version  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
+from . import geometric  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401

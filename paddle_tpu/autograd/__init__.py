@@ -103,3 +103,40 @@ class PyLayer:
     @staticmethod
     def backward(ctx, *args):
         raise NotImplementedError
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Functional Jacobian (reference: paddle.incubate.autograd.Jacobian).
+
+    func: Tensor(s) -> Tensor; xs: Tensor or list. Returns Tensor (or
+    nested list) of d out / d x computed with jax.jacrev."""
+    import jax
+
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    raw = [x._data for x in xs_list]
+
+    def f(*args):
+        out = func(*[Tensor(a) for a in args]) if len(args) > 1 else \
+            func(Tensor(args[0]))
+        return out._data if isinstance(out, Tensor) else out
+
+    jac = jax.jacrev(f, argnums=tuple(range(len(raw))))(*raw)
+    if single:
+        return Tensor(jac[0])
+    return [Tensor(j) for j in jac]
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Functional Hessian of a scalar-output func (reference:
+    paddle.incubate.autograd.Hessian)."""
+    import jax
+
+    single = not isinstance(xs, (list, tuple))
+    x = (xs if single else xs[0])._data
+
+    def f(a):
+        out = func(Tensor(a))
+        return (out._data if isinstance(out, Tensor) else out).reshape(())
+
+    return Tensor(jax.hessian(f)(x))

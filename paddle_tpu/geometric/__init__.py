@@ -1,0 +1,100 @@
+"""paddle.geometric — graph message passing primitives.
+
+Reference: python/paddle/geometric (send_u_recv / send_ue_recv over
+graph_send_recv ops, segment pooling kernels phi/kernels/gpu/segment_pool).
+
+TPU-native: scatter-segment ops via jnp.zeros().at[].add/max/min — XLA
+lowers these to efficient scatters; all tape-recorded for training GNNs.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+
+def _seg_reduce(vals, idx, n, pool):
+    if pool == "sum":
+        return jnp.zeros((n,) + vals.shape[1:], vals.dtype).at[idx].add(vals)
+    if pool == "mean":
+        tot = jnp.zeros((n,) + vals.shape[1:], vals.dtype).at[idx].add(vals)
+        cnt = jnp.zeros((n,), vals.dtype).at[idx].add(1.0)
+        return tot / jnp.maximum(cnt, 1.0).reshape((n,) + (1,) *
+                                                   (vals.ndim - 1))
+    if pool == "max":
+        init = jnp.full((n,) + vals.shape[1:], -jnp.inf, vals.dtype)
+        out = init.at[idx].max(vals)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if pool == "min":
+        init = jnp.full((n,) + vals.shape[1:], jnp.inf, vals.dtype)
+        out = init.at[idx].min(vals)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(f"unknown pool_type {pool!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst (reference:
+    geometric/message_passing/send_recv.py)."""
+    src = jnp.asarray(src_index._data if isinstance(src_index, Tensor)
+                      else src_index)
+    dst = jnp.asarray(dst_index._data if isinstance(dst_index, Tensor)
+                      else dst_index)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def fn(xr):
+        return _seg_reduce(xr[src], dst, n, reduce_op)
+
+    return apply_op(fn, x, name="send_u_recv") if isinstance(x, Tensor) \
+        else fn(jnp.asarray(x))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Like send_u_recv but combines node features with edge features y
+    before reducing."""
+    src = jnp.asarray(src_index._data if isinstance(src_index, Tensor)
+                      else src_index)
+    dst = jnp.asarray(dst_index._data if isinstance(dst_index, Tensor)
+                      else dst_index)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    comb = ops[message_op]
+
+    def fn(xr, yr):
+        return _seg_reduce(comb(xr[src], yr), dst, n, reduce_op)
+
+    if isinstance(x, Tensor):
+        yy = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+        return apply_op(fn, x, yy, name="send_ue_recv")
+    return fn(jnp.asarray(x), jnp.asarray(y))
+
+
+def _segment(x, segment_ids, pool):
+    seg = jnp.asarray(segment_ids._data if isinstance(segment_ids, Tensor)
+                      else segment_ids)
+    n = int(seg.max()) + 1 if seg.size else 0
+
+    def fn(xr):
+        return _seg_reduce(xr, seg, n, pool)
+
+    return apply_op(fn, x, name=f"segment_{pool}") if isinstance(x, Tensor) \
+        else fn(jnp.asarray(x))
+
+
+def segment_sum(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "sum")
+
+
+def segment_mean(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "mean")
+
+
+def segment_max(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "max")
+
+
+def segment_min(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "min")

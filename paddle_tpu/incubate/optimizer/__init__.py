@@ -1,0 +1,2 @@
+"""paddle.incubate.optimizer — functional optimizers."""
+from . import functional  # noqa: F401

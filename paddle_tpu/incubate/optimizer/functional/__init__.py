@@ -1,0 +1,3 @@
+"""Functional optimizers (reference: python/paddle/incubate/optimizer/
+functional/lbfgs.py minimize_lbfgs, bfgs.py minimize_bfgs)."""
+from .lbfgs import minimize_bfgs, minimize_lbfgs  # noqa: F401

@@ -1,0 +1,154 @@
+"""L-BFGS / BFGS minimizers.
+
+Reference: python/paddle/incubate/optimizer/functional/lbfgs.py
+(`minimize_lbfgs` — static-graph while_loop over the two-loop recursion
+with strong-Wolfe line search).
+
+TPU-native: the two-loop recursion in plain Python over jnp arrays with a
+backtracking Armijo line search; the objective is differentiated with
+jax.grad (no finite differences). History is a fixed-size deque so the
+whole minimize can also run under jit for fixed iteration counts.
+"""
+from collections import deque, namedtuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+LbfgsResult = namedtuple("LbfgsResult",
+                         ["is_converge", "num_func_calls", "x", "fx", "g"])
+
+
+def _wrap_objective(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x))
+        return out._data if isinstance(out, Tensor) else out
+    return f
+
+
+def _line_search(f, x, fx, g, p, max_steps=20, c1=1e-4, tau=0.5):
+    """Backtracking Armijo: returns (alpha, n_evals)."""
+    alpha = 1.0
+    gtp = jnp.vdot(g, p)
+    n = 0
+    for _ in range(max_steps):
+        n += 1
+        if f(x + alpha * p) <= fx + c1 * alpha * gtp:
+            break
+        alpha *= tau
+    return alpha, n
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=10,
+                   max_iters=50, tolerance_grad=1e-8, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None, line_search_fn=
+                   "strong_wolfe", dtype="float32", name=None):
+    """Returns (is_converge, num_func_calls, position, objective, gradient)
+    — the reference's result tuple."""
+    f = _wrap_objective(objective_func)
+    grad_f = jax.grad(f)
+    x = jnp.asarray(initial_position._data
+                    if isinstance(initial_position, Tensor)
+                    else initial_position, jnp.float32)
+    fx = f(x)
+    g = grad_f(x)
+    calls = 1
+    s_hist, y_hist, rho_hist = deque(maxlen=history_size), \
+        deque(maxlen=history_size), deque(maxlen=history_size)
+    converged = False
+
+    for _ in range(max_iters):
+        if jnp.max(jnp.abs(g)) < tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                             reversed(rho_hist)):
+            a = rho * jnp.vdot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if y_hist:
+            gamma = jnp.vdot(s_hist[-1], y_hist[-1]) / \
+                jnp.maximum(jnp.vdot(y_hist[-1], y_hist[-1]), 1e-12)
+        else:
+            gamma = 1.0
+        r = gamma * q
+        for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                  reversed(alphas)):
+            b = rho * jnp.vdot(y, r)
+            r = r + (a - b) * s
+        p = -r
+
+        alpha, n = _line_search(f, x, fx, g, p)
+        calls += n
+        x_new = x + alpha * p
+        fx_new = f(x_new)
+        g_new = grad_f(x_new)
+        calls += 1
+        s = x_new - x
+        y = g_new - g
+        sy = jnp.vdot(s, y)
+        if sy > 1e-10:          # curvature condition
+            s_hist.append(s)
+            y_hist.append(y)
+            rho_hist.append(1.0 / sy)
+        if jnp.max(jnp.abs(s)) < tolerance_change:
+            x, fx, g = x_new, fx_new, g_new
+            converged = True
+            break
+        x, fx, g = x_new, fx_new, g_new
+
+    return LbfgsResult(Tensor(jnp.asarray(converged)),
+                       Tensor(jnp.asarray(calls)),
+                       Tensor(x), Tensor(fx), Tensor(g))
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-8, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", dtype="float32", name=None):
+    """Dense-Hessian BFGS (reference bfgs.py) — same surface, full H."""
+    f = _wrap_objective(objective_func)
+    grad_f = jax.grad(f)
+    x = jnp.asarray(initial_position._data
+                    if isinstance(initial_position, Tensor)
+                    else initial_position, jnp.float32)
+    n_dim = x.size
+    H = jnp.eye(n_dim) if initial_inverse_hessian_estimate is None else \
+        jnp.asarray(initial_inverse_hessian_estimate._data
+                    if isinstance(initial_inverse_hessian_estimate, Tensor)
+                    else initial_inverse_hessian_estimate)
+    fx = f(x)
+    g = grad_f(x)
+    calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if jnp.max(jnp.abs(g)) < tolerance_grad:
+            converged = True
+            break
+        p = -(H @ g.reshape(-1)).reshape(x.shape)
+        alpha, n = _line_search(f, x, fx, g, p)
+        calls += n
+        x_new = x + alpha * p
+        g_new = grad_f(x_new)
+        fx = f(x_new)
+        calls += 1
+        s = (x_new - x).reshape(-1)
+        y = (g_new - g).reshape(-1)
+        sy = jnp.vdot(s, y)
+        if sy > 1e-10:
+            rho = 1.0 / sy
+            I = jnp.eye(n_dim)
+            V = I - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        if jnp.max(jnp.abs(x_new - x)) < tolerance_change:
+            x, g = x_new, g_new
+            converged = True
+            break
+        x, g = x_new, g_new
+    return LbfgsResult(Tensor(jnp.asarray(converged)),
+                       Tensor(jnp.asarray(calls)), Tensor(x), Tensor(fx),
+                       Tensor(g))

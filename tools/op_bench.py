@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Config-driven op microbenchmark.
+
+Reference: paddle/fluid/operators/benchmark/op_tester.cc (+
+op_tester_config.cc): run one op by name with configured shapes/dtypes,
+report latency. Here: ops resolve from paddle_tpu.tensor / nn.functional,
+each case runs jit-compiled (compile excluded) and eager.
+
+Usage:
+  python tools/op_bench.py                          # built-in suite
+  python tools/op_bench.py --op matmul --shape 1024x1024,1024x1024 \
+      --dtype bfloat16 --repeat 50
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+DEFAULT_SUITE = [
+    {"op": "matmul", "shapes": ["1024x1024", "1024x1024"]},
+    {"op": "add", "shapes": ["4096x4096", "4096x4096"]},
+    {"op": "softmax", "shapes": ["64x4096"]},
+    {"op": "mean", "shapes": ["4096x4096"]},
+    {"op": "relu", "shapes": ["4096x4096"]},
+    {"op": "layer_norm", "shapes": ["64x4096"]},
+]
+
+
+def _parse_shape(s):
+    return tuple(int(d) for d in s.split("x"))
+
+
+def _resolve(op_name):
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    if op_name == "layer_norm":
+        import jax.numpy as jnp
+
+        def ln(x):
+            w = paddle.to_tensor(np.ones(x.shape[-1], np.float32))
+            b = paddle.to_tensor(np.zeros(x.shape[-1], np.float32))
+            return F.layer_norm(x, x.shape[-1:], weight=w, bias=b)
+        return ln
+    for mod in (paddle, F):
+        fn = getattr(mod, op_name, None)
+        if fn is not None:
+            return fn
+    raise SystemExit(f"unknown op {op_name!r}")
+
+
+def bench_case(op_name, shapes, dtype="float32", repeat=20):
+    import jax
+
+    import paddle_tpu as paddle
+
+    fn = _resolve(op_name)
+    rng = np.random.RandomState(0)
+    args = [paddle.to_tensor(rng.rand(*s).astype("float32"), dtype=dtype)
+            for s in shapes]
+
+    # eager
+    fn(*args)  # warm
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = fn(*args)
+    jax.block_until_ready(out._data)
+    eager_us = (time.perf_counter() - t0) / repeat * 1e6
+
+    # jit
+    raw = [a._data for a in args]
+    jfn = jax.jit(lambda *xs: fn(*[paddle.Tensor(x) for x in xs])._data)
+    jax.block_until_ready(jfn(*raw))  # compile
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = jfn(*raw)
+    jax.block_until_ready(out)
+    jit_us = (time.perf_counter() - t0) / repeat * 1e6
+
+    return {"op": op_name, "shapes": ["x".join(map(str, s)) for s in shapes],
+            "dtype": dtype, "eager_us": round(eager_us, 1),
+            "jit_us": round(jit_us, 1), "repeat": repeat}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op")
+    ap.add_argument("--shape", help="comma-separated, e.g. 64x128,128x256")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--repeat", type=int, default=20)
+    a = ap.parse_args()
+    if a.op:
+        cases = [{"op": a.op,
+                  "shapes": (a.shape or "1024x1024").split(","),
+                  "dtype": a.dtype}]
+    else:
+        cases = DEFAULT_SUITE
+    for c in cases:
+        res = bench_case(c["op"], [_parse_shape(s) for s in c["shapes"]],
+                         c.get("dtype", a.dtype), a.repeat)
+        print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
