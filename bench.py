@@ -3,11 +3,18 @@
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = achieved MFU / 0.40 (A100-class MFU target from BASELINE.md).
 
+Honest-measurement rules (VERDICT r1 item 1): every timed step fetches
+float(loss) to the host — a device->host transfer of a value that data-depends
+on the whole step, so it cannot complete before the step does, regardless of
+what the platform's block_until_ready claims. >=3 warmup steps, >=30 timed
+steps, and the result is asserted physically possible (0 < MFU < 1).
+
 The whole train step (fwd+bwd+AdamW) is one jit-compiled XLA program in
 bfloat16; eager/per-op dispatch never touches the TPU (remote per-op compile
 through the axon tunnel is pathologically slow — see .claude/skills/verify).
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -22,13 +29,19 @@ def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
 
-    # GPT-350M-class: fits one v5e chip (16GB) with AdamW f32 states + remat
+    # GPT-350M-class: fits one v5e chip (16GB) with AdamW f32 states.
+    # remat="dots" keeps MXU outputs and recomputes only elementwise ops.
+    remat_env = os.environ.get("BENCH_REMAT", "dots" if on_tpu else "full")
+    if remat_env not in ("none", "full", "dots"):
+        raise SystemExit(f"BENCH_REMAT={remat_env!r}: expected none|full|dots")
+    remat = {"none": False, "full": True, "dots": "dots"}[remat_env]
     cfg = GPTSpmdConfig(
         vocab_size=50304, max_seq_len=1024, hidden=1024, layers=24, heads=16,
         param_dtype="bfloat16" if on_tpu else "float32",
         compute_dtype="bfloat16" if on_tpu else "float32",
-        remat=True)
-    B, S = (8, 1024) if on_tpu else (2, 128)
+        remat=remat)
+    B = int(os.environ.get("BENCH_B", 16 if on_tpu else 2))
+    S = int(os.environ.get("BENCH_S", 1024 if on_tpu else 128))
 
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=2e-4)
@@ -40,31 +53,40 @@ def main():
     labs = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
     lr = jnp.float32(2e-4)
 
-    # warmup/compile
-    loss, params, state = step_fn(params, state, toks, labs, lr)
-    jax.block_until_ready(loss)
+    # warmup: compile + 3 synced steps
+    for _ in range(3):
+        loss, params, state = step_fn(params, state, toks, labs, lr)
+        loss_val = float(loss)          # host fetch = true device sync
 
-    n_steps = 10 if on_tpu else 2
+    n_steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
     t0 = time.perf_counter()
     for _ in range(n_steps):
         loss, params, state = step_fn(params, state, toks, labs, lr)
-    jax.block_until_ready(loss)
+        loss_val = float(loss)          # sync EVERY timed step
     dt = time.perf_counter() - t0
 
     tokens_per_sec = B * S * n_steps / dt
-    flops_per_token = 6 * n_params  # standard fwd+bwd estimate (ex-remat)
+    # model flops/token: 6N (fwd+bwd matmul params) + causal attention term
+    # 6 * L * S * H (QK^T and AV, fwd+bwd, x0.5 causal). Remat recompute is
+    # NOT counted (standard MFU convention).
+    flops_per_token = 6 * n_params + 6 * cfg.layers * S * cfg.hidden
     achieved_flops = tokens_per_sec * flops_per_token
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; nominal for CPU
     mfu = achieved_flops / peak
+    if on_tpu:
+        assert 0.0 < mfu < 1.0, f"impossible MFU {mfu}: measurement is broken"
+        assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
 
     print(json.dumps({
-        "metric": "gpt350m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
+        "metric": "gpt350m_train_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": "MFU (fraction of v5e bf16 peak)",
         "vs_baseline": round(mfu / 0.40, 4),
-        "extra": {"mfu": round(mfu, 4), "params": n_params,
-                  "backend": backend, "step_ms": round(1000 * dt / n_steps, 1),
-                  "loss": float(loss)},
+        "extra": {"tokens_per_sec": round(tokens_per_sec, 1),
+                  "params": n_params, "batch": B, "seq": S,
+                  "backend": backend, "n_steps": n_steps,
+                  "step_ms": round(1000 * dt / n_steps, 1),
+                  "loss": loss_val},
     }))
 
 

@@ -42,13 +42,16 @@ class Group:
     grid), which is also the only layout where collectives ride ICI.
     """
 
-    def __init__(self, axis_name=None, mesh=None, id=0):
+    def __init__(self, axis_name=None, mesh=None, id=0, ranks=None):
         self.axis_name = axis_name
         self.mesh = mesh if mesh is not None else env.get_mesh()
         self.id = id
+        self._ranks = list(ranks) if ranks is not None else None
 
     @property
     def nranks(self):
+        if self._ranks is not None:
+            return len(self._ranks)
         if self.mesh is None:
             return 1
         if self.axis_name is None:
@@ -57,6 +60,28 @@ class Group:
 
     @property
     def rank(self):
+        """Group-local rank of THIS controller, in DEVICE space (one logical
+        rank per device, matching nranks/process_ids; reference:
+        distributed/collective.py Group.rank; -1 when not a member). Under
+        single-controller SPMD the controller is identified with its first
+        addressable device."""
+        me = _my_device_rank()
+        if self._ranks is not None:
+            return self._ranks.index(me) if me in self._ranks else -1
+        if self.axis_name is None or self.mesh is None:
+            return me
+        # mesh-axis group: coordinate of this controller's first addressable
+        # device along the axis (single process owning the whole mesh -> 0)
+        try:
+            import numpy as _np
+            devs = _np.asarray(self.mesh.devices, dtype=object)
+            local = jax.local_devices()[0]
+            hits = _np.argwhere(devs == local)
+            if hits.size:
+                ax = list(self.mesh.axis_names).index(self.axis_name)
+                return int(hits[0][ax])
+        except Exception:
+            pass
         return 0
 
     @property
@@ -64,11 +89,24 @@ class Group:
         return self.nranks
 
     def get_group_rank(self, rank):
+        if self._ranks is not None:
+            return self._ranks.index(rank) if rank in self._ranks else -1
         return rank
 
     @property
     def process_ids(self):
+        if self._ranks is not None:
+            return list(self._ranks)
         return list(range(self.nranks))
+
+
+def _my_device_rank():
+    """Global index (device space) of this controller's first addressable
+    device: the SPMD notion of 'my rank'. 0 in single-process runs."""
+    try:
+        return jax.devices().index(jax.local_devices()[0])
+    except Exception:
+        return 0
 
 
 _WORLD = None
@@ -83,9 +121,26 @@ def _world_group():
 
 
 def new_group(ranks=None, backend=None, axis_name=None, timeout=None):
+    """Create a communication group (reference: collective.py:353 new_group).
+
+    On a TPU mesh, efficient groups are mesh axes. `ranks` is honored when it
+    names the full world (-> world group); arbitrary proper subsets have no
+    ICI-aligned layout and raise rather than silently communicating over the
+    wrong participants. Pass `axis_name` to group along a mesh axis.
+    """
     global _group_counter
     _group_counter += 1
-    return Group(axis_name=axis_name, id=_group_counter)
+    if ranks is not None and axis_name is None:
+        world = env.get_world_size()
+        r = sorted(int(x) for x in ranks)
+        if r == list(range(world)):
+            return Group(axis_name=None, id=_group_counter, ranks=r)
+        raise NotImplementedError(
+            f"new_group(ranks={list(ranks)}): arbitrary rank subsets are not "
+            "mesh axes; build a Mesh whose axis matches the desired group and "
+            "pass axis_name=<axis> (collectives then ride ICI), e.g. "
+            "fleet.HybridCommunicateGroup or distributed.env.build_mesh")
+    return Group(axis_name=axis_name, id=_group_counter, ranks=ranks)
 
 
 def get_group(gid=0):
@@ -298,7 +353,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def p2p_shift(tensor, shift=1, group=None):
     """Ring shift along the live pp/sp axis (ring attention, 1F1B p2p)."""
-    ax = _axis_of(group, "pp") or _axis_of(group, "sep")
+    ax = _axis_of(group, "pp") or _axis_of(group, "sp")
     if ax is None:
         return tensor
     n = env.axis_size(ax)

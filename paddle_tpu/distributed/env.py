@@ -26,8 +26,10 @@ _global_mesh = None
 _initialized = False
 
 # canonical hybrid-parallel axis order (reference: fleet/base/topology.py:52
-# uses order [dp, pp, sharding, mp]; we use the same axis names)
-HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+# uses order [dp, pp, sharding, mp]). Sequence parallelism is mesh axis "sp"
+# everywhere (the SPMD stack, parallel/gpt_spmd.py AXES); the paddle-facing
+# name "sep" is accepted at the fleet API boundary and mapped to "sp".
+HYBRID_AXES = ("dp", "pp", "sharding", "sp", "mp")
 
 
 def is_initialized():

@@ -32,9 +32,10 @@ class CommunicateTopology:
         return self._world_size
 
 
-# paddle axis name -> mesh axis name
+# paddle axis name -> mesh axis name ("sep" is paddle's name for sequence
+# parallelism; the mesh axis is "sp" to match the SPMD stack)
 _AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
-             "sep": "sep"}
+             "sep": "sp"}
 
 
 class HybridCommunicateGroup:
@@ -50,7 +51,7 @@ class HybridCommunicateGroup:
         self._mp_degree = dims.get("mp", 1)
         self._pp_degree = dims.get("pp", 1)
         self._sharding_degree = dims.get("sharding", 1)
-        self._sep_degree = dims.get("sep", 1)
+        self._sep_degree = dims.get("sp", 1)
 
     # ---- degree / rank queries (single-controller SPMD: logical rank 0) ----
     def get_data_parallel_world_size(self):
@@ -97,7 +98,7 @@ class HybridCommunicateGroup:
         return Group(axis_name="sharding", mesh=self.mesh)
 
     def get_sep_parallel_group(self):
-        return Group(axis_name="sep", mesh=self.mesh)
+        return Group(axis_name="sp", mesh=self.mesh)
 
     def get_check_parallel_group(self, *a):
         return Group(axis_name=None, mesh=self.mesh)

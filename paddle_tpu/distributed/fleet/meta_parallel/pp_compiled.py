@@ -1,0 +1,207 @@
+"""Compiled pipeline runner for arbitrary PipelineLayer stacks.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py runs 1F1B over per-stage
+worker processes with send_v2/recv_v2. TPU-native: the WHOLE pipeline is one
+shard_map'd program over the mesh's 'pp' axis driven by the same static tick
+tables as the flagship GPT path (parallel/pipeline_schedule.py):
+
+- each tick, a device runs (at most) one microbatch forward and one backward
+  for ITS stage, selected by lax.cond on the stage index — stage work is
+  heterogeneous (arbitrary LayerDesc stacks), so each stage's segment is a
+  separate functionalized branch rather than a stacked scan;
+- activations/cotangents hop stage-to-stage via ppermute and are parked in
+  circular buffers sized by the schedule (1F1B: O(pp), M-independent);
+- the backward recomputes the stage forward from the parked stage input via
+  jax.vjp (stage-granular rematerialization).
+
+Scope/limitations vs the GPT path (parallel/gpt_spmd.py):
+- parameters are REPLICATED across pp rows (compute is pipelined; parameter
+  memory is not sharded). Homogeneous block stacks that want sharded params
+  should use the stacked-layer GPT-style path.
+- inter-stage activations must share one shape/dtype (checked at trace
+  time); the last stage's output is unconstrained (it only feeds the loss).
+- buffer mutations inside stage forwards (e.g. BN running stats) are not
+  written back from the compiled step.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import functional_call
+from ....parallel.pipeline_schedule import (arrival_tables, build_tables,
+                                            required_slots)
+
+
+def _make_stage_fn(pl, s):
+    """Pure fn (params, buffers, x_raw) -> y_raw running stages' layers
+    [boundaries[s], boundaries[s+1]) of PipelineLayer `pl`."""
+    lo, hi = pl._boundaries[s], pl._boundaries[s + 1]
+
+    def seg_forward(layer_self, xin):
+        h = xin if isinstance(xin, Tensor) else Tensor(xin)
+        for i in range(lo, hi):
+            layer, desc = layer_self._built[i]
+            fwd = getattr(desc, "forward_func", None)
+            h = fwd(layer, h) if fwd is not None else layer(h)
+        return h
+
+    def fn(params, buffers, x):
+        out, _ = functional_call(pl, params, buffers, args=(x,), train=True,
+                                 method=seg_forward)
+        return out._data if isinstance(out, Tensor) else out
+
+    return fn
+
+
+def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
+    """Build step(params, buffers, x, y) -> (loss, grads) jit-compiled over
+    `mesh` (axes may include 'dp' for data parallelism and must include 'pp'
+    of size pl.get_num_stages()). grads match the params dict and are already
+    averaged over microbatches (and dp)."""
+    pp = int(mesh.shape["pp"])
+    M = int(microbatches)
+    if pp < 2:
+        raise ValueError("compiled pipeline needs pp >= 2")
+    if pl._loss_fn is None:
+        raise ValueError("PipelineLayer needs loss_fn for the compiled step")
+    stage_fns = [_make_stage_fn(pl, s) for s in range(pp)]
+
+    def loss_raw(out, y):
+        l = pl._loss_fn(Tensor(out), Tensor(y))
+        return (l._data if isinstance(l, Tensor) else l).astype(jnp.float32)
+
+    f_t, b_t, _ = build_tables(M, pp, schedule)
+    fwd3, bwd3 = f_t[:, :, None], b_t[:, :, None]
+    farr_n, garr_n = arrival_tables(fwd3, bwd3, pp, 1)
+    W = required_slots(fwd3, bwd3, farr_n, garr_n, M, pp, 1)
+    T = f_t.shape[0]
+    fwd_tbl = jnp.asarray(f_t)
+    bwd_tbl = jnp.asarray(b_t)
+    farr = jnp.asarray(farr_n[:, :, 0])
+    garr = jnp.asarray(garr_n[:, :, 0])
+    has_dp = "dp" in mesh.shape and mesh.shape["dp"] > 1
+    data_spec = P("dp") if has_dp else P()
+    f32 = jnp.float32
+
+    def sharded(params, buffers, x, y):
+        stage = jax.lax.axis_index("pp")
+        is_last = stage == pp - 1
+        B_loc = x.shape[0]
+        B_mb = B_loc // M
+        x_mb = x.reshape((M, B_mb) + x.shape[1:])
+        y_mb = y.reshape((M, B_mb) + y.shape[1:])
+
+        # inter-stage activation shape: trace stage outputs abstractly
+        act = jax.eval_shape(stage_fns[0], params, buffers, x_mb[0])
+        for s in range(1, pp - 1):
+            nxt = jax.eval_shape(stage_fns[s], params, buffers,
+                                 jax.ShapeDtypeStruct(act.shape, act.dtype))
+            if nxt.shape != act.shape or nxt.dtype != act.dtype:
+                raise ValueError(
+                    f"pipeline stages must share one inter-stage activation "
+                    f"shape; stage {s} maps {act.shape} -> {nxt.shape}")
+        zero_act = jnp.zeros(act.shape, act.dtype)
+
+        def zeros_params():
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, f32), params)
+
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            buf, gbuf, fchan, gchan, loss_sum, gacc = carry
+            f_idx = fwd_tbl[t, stage]
+            b_idx = bwd_tbl[t, stage]
+            valid_f = f_idx >= 0
+            valid_b = b_idx >= 0
+            fi = jnp.clip(f_idx, 0, M - 1)
+            bi = jnp.clip(b_idx, 0, M - 1)
+
+            # park channel arrivals (channels are overwritten every tick)
+            a_f = farr[t, stage]
+            buf = jax.lax.cond(
+                a_f >= 0,
+                lambda: buf.at[jnp.clip(a_f, 0, M - 1) % W].set(fchan),
+                lambda: buf)
+            a_g = garr[t, stage]
+            gbuf = jax.lax.cond(
+                a_g >= 0,
+                lambda: gbuf.at[jnp.clip(a_g, 0, M - 1) % W].set(gchan),
+                lambda: gbuf)
+
+            # ---- forward (stages 0..pp-2; the last stage's forward happens
+            # inside its backward's value_and_grad) ----
+            y_f = zero_act
+            for s in range(pp - 1):
+                def run_f(s=s):
+                    xin = x_mb[fi] if s == 0 else buf[fi % W]
+                    return stage_fns[s](params, buffers, xin).astype(act.dtype)
+                y_f = y_f + jax.lax.cond(
+                    (stage == s) & valid_f, run_f, lambda: zero_act)
+
+            # ---- backward ----
+            l_b = jnp.zeros((), f32)
+            g_send = zero_act
+            for s in range(pp):
+                def run_b(s=s):
+                    if s == pp - 1:
+                        xin = buf[bi % W] if s > 0 else x_mb[bi]
+
+                        def head(p, xi):
+                            out = stage_fns[s](p, buffers, xi)
+                            return loss_raw(out, y_mb[bi])
+                        l, (gp, gx) = jax.value_and_grad(
+                            head, argnums=(0, 1))(params, xin)
+                        return l, gp, gx.astype(act.dtype)
+                    if s == 0:
+                        _, vjp = jax.vjp(
+                            lambda p: stage_fns[s](p, buffers, x_mb[bi]),
+                            params)
+                        (gp,) = vjp(gbuf[bi % W])
+                        return jnp.zeros((), f32), gp, zero_act
+                    _, vjp = jax.vjp(
+                        lambda p, xi: stage_fns[s](p, buffers, xi),
+                        params, buf[bi % W])
+                    gp, gx = vjp(gbuf[bi % W])
+                    return jnp.zeros((), f32), gp, gx.astype(act.dtype)
+
+                def skip_b():
+                    return (jnp.zeros((), f32),
+                            jax.tree_util.tree_map(
+                                lambda p: jnp.zeros(p.shape, p.dtype), params),
+                            zero_act)
+
+                l_s, gp_s, gx_s = jax.lax.cond(
+                    (stage == s) & valid_b, run_b, skip_b)
+                l_b = l_b + l_s
+                g_send = g_send + gx_s
+                gacc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(f32), gacc, gp_s)
+
+            fchan = jax.lax.ppermute(y_f, "pp", fwd_perm)
+            gchan = jax.lax.ppermute(g_send, "pp", bwd_perm)
+            return (buf, gbuf, fchan, gchan, loss_sum + l_b, gacc), None
+
+        carry0 = (jnp.zeros((W,) + act.shape, act.dtype),
+                  jnp.zeros((W,) + act.shape, act.dtype),
+                  zero_act, zero_act, jnp.zeros((), f32), zeros_params())
+        (_, _, _, _, loss_sum, gacc), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        loss = jax.lax.psum(jnp.where(is_last, loss_sum / M, 0.0), "pp")
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g / M, "pp"), gacc)
+        if has_dp:
+            loss = jax.lax.pmean(loss, "dp")
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "dp"), grads)
+        return loss, grads
+
+    sh = jax.shard_map(
+        sharded, mesh=mesh,
+        in_specs=(P(), P(), data_spec, data_spec),
+        out_specs=(P(), P()), check_vma=False)
+    return jax.jit(sh)

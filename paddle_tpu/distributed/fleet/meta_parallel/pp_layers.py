@@ -189,6 +189,17 @@ class PipelineParallel(Layer):
         cfg = getattr(strategy, "pipeline_configs", None) or {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.schedule_mode = cfg.get("schedule_mode", "1F1B")
+        self._compiled_step = None   # (shape key, jitted fn)
+
+    def _pipeline_mesh(self):
+        """The live mesh if it can host this pipeline's stages over 'pp'."""
+        from ... import env
+        mesh = env.get_mesh()
+        stages = self._layers.get_num_stages()
+        if (mesh is not None and "pp" in mesh.shape
+                and int(mesh.shape["pp"]) == stages and stages > 1):
+            return mesh
+        return None
 
     def forward(self, x):
         return self._layers(x)
@@ -205,6 +216,12 @@ class PipelineParallel(Layer):
         optimizer step. Returns the averaged loss tensor."""
         if self._layers._loss_fn is None:
             raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        mesh = self._pipeline_mesh()
+        if mesh is not None and scaler is None and self.accumulate_steps > 1:
+            loss = self._train_batch_compiled(data, optimizer, mesh)
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         micro = self._split_micro(data)
         m = len(micro)
         optimizer.clear_grad()
@@ -228,6 +245,34 @@ class PipelineParallel(Layer):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return total
+
+    def _train_batch_compiled(self, data, optimizer, mesh):
+        """SPMD fast path: the whole 1F1B pipeline (fwd+bwd, all stages) is
+        ONE compiled program over the mesh's 'pp' axis (pp_compiled.py);
+        gradients land on .grad and the optimizer steps eagerly."""
+        from ....nn.layer.layers import functional_state
+        from .pp_compiled import make_compiled_pipeline_step
+
+        x, y = data
+        key = (tuple(x.shape), str(x.dtype), tuple(y.shape), str(y.dtype),
+               self.accumulate_steps, id(mesh))
+        if self._compiled_step is None or self._compiled_step[0] != key:
+            mode = self.schedule_mode.lower()
+            sched = {"1f1b": "1f1b", "eager1f1b": "eager1f1b",
+                     "fthenb": "gpipe", "gpipe": "gpipe"}.get(mode, "1f1b")
+            step = make_compiled_pipeline_step(
+                self._layers, mesh, self.accumulate_steps, schedule=sched)
+            self._compiled_step = (key, step)
+        step = self._compiled_step[1]
+        params, buffers = functional_state(self._layers)
+        loss, grads = step(params, buffers, x._data, y._data)
+        named = dict(self._layers.named_parameters())
+        for n, g in grads.items():
+            p = named[n]
+            p.grad = Tensor(g.astype(p._data.dtype))
+        optimizer.step()
+        optimizer.clear_grad()
+        return Tensor(loss)
 
     def eval_batch(self, data, compute_loss=True):
         micro = self._split_micro(data)

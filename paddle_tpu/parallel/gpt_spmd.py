@@ -26,9 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .pipeline_schedule import (arrival_tables, build_interleaved_tables,
+                                build_tables, required_slots)
 from .ring_attention import ring_attention
 
 AXES = ("dp", "pp", "sharding", "sp", "mp")
+
+_BLOCK_LEAVES = ("ln1_w", "ln1_b", "w_qkv", "b_qkv", "w_proj", "b_proj",
+                 "ln2_w", "ln2_b", "w_fc1", "b_fc1", "w_fc2", "b_fc2")
 
 
 @dataclass
@@ -41,7 +46,10 @@ class GPTSpmdConfig:
     ffn: int = None
     param_dtype: str = "float32"     # storage dtype ("bfloat16" for bench)
     compute_dtype: str = "float32"   # activation dtype
-    remat: bool = True               # jax.checkpoint each block (HBM saver)
+    # remat: False = none, True = full per-block checkpoint (max HBM saving),
+    # "dots" = save matmul outputs, recompute elementwise (best MFU/HBM trade
+    # on TPU: recompute is cheap VPU work, the MXU results are kept)
+    remat: object = True
     init_std: float = 0.02
 
     def __post_init__(self):
@@ -57,6 +65,12 @@ class MeshPlan:
     sp: int = 1
     mp: int = 1
     microbatches: int = 1            # pipeline microbatches (per-device batch)
+    # pipeline schedule: "1f1b" (activation buffer bounded by pp — the 1F1B
+    # memory guarantee), "eager1f1b" (minimum ticks, ~2x the buffer, still
+    # O(pp) and M-independent), or "gpipe" (autodiff-through-scan reverse
+    # schedule; activation memory grows with microbatches — comparison only)
+    schedule: str = "1f1b"
+    vpp: int = 1                     # interleaved virtual stages per device
 
     @property
     def dims(self):
@@ -217,14 +231,16 @@ def _block(h, blk, cfg, plan):
 
 def _stage_blocks(h, params, cfg, plan):
     """Apply this pp-stage's local stack of blocks via lax.scan."""
-    block_leaves = ("ln1_w", "ln1_b", "w_qkv", "b_qkv", "w_proj", "b_proj",
-                    "ln2_w", "ln2_b", "w_fc1", "b_fc1", "w_fc2", "b_fc2")
-    stacked = {k: params[k] for k in block_leaves}
+    stacked = {k: params[k] for k in _BLOCK_LEAVES}
 
     def apply_block(h, blk):
         return _block(h, blk, cfg, plan)
 
-    if cfg.remat:
+    if cfg.remat == "dots":
+        apply_block = jax.checkpoint(
+            apply_block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif cfg.remat:
         apply_block = jax.checkpoint(apply_block)
 
     def body(h, blk):
@@ -334,6 +350,223 @@ def _pipeline_loss(tokens, labels, params, cfg, plan):
 
 
 # ---------------------------------------------------------------------------
+# 1F1B / interleaved pipeline: manual fwd+bwd schedule (no autodiff-through-
+# scan). Reference: fleet/meta_parallel/pipeline_parallel.py:120 (1F1B),
+# :464 (interleaved virtual stages). TPU-native design:
+#   - the schedule is a static tick table (pipeline_schedule.py); the
+#     compiled program is ONE lax.scan whose body runs at most one microbatch
+#     forward and one backward per stage per tick, gated by lax.cond — so
+#     embedding runs only on stage 0 and the LM head only on the last stage
+#     (each pp row shares the predicate, so mp/sp collectives inside the
+#     branches stay consistent).
+#   - activation memory: only STAGE INPUTS are buffered, in a circular
+#     buffer of `slots` = cap+1 entries (pp+1 for 1F1B) — M-independent.
+#     The backward recomputes the stage forward from the saved input via
+#     jax.vjp (Megatron "full recompute" style), which is also what bounds
+#     the buffer to inputs rather than per-layer activations.
+#   - gradients accumulate in f32 carries; the tied wte receives its
+#     embedding contribution on stage 0 and its LM-head contribution on the
+#     last stage (summed by the caller's psum over pp).
+# ---------------------------------------------------------------------------
+
+def interleave_permutation(L, pp, vpp):
+    """Stacked-layer storage order for interleaved pipelining: device s's
+    contiguous local shard holds its vpp chunks back-to-back, chunk c of
+    device s being virtual stage k = c*pp + s (logical layers
+    [k*L/D, (k+1)*L/D), D = pp*vpp). perm[new_pos] = logical_layer.
+
+    This is a storage LAYOUT only — the pipeline body composes chunks in
+    logical order, so the computed function is identical to the unpermuted
+    model (checkpoints written under vpp>1 store this layout).
+    """
+    D = pp * vpp
+    Lk = L // D
+    perm = []
+    for s in range(pp):
+        for c in range(vpp):
+            k = c * pp + s
+            perm.extend(range(k * Lk, (k + 1) * Lk))
+    return np.asarray(perm)
+
+
+def _pipeline_manual_loss_and_grads(tokens, labels, params, cfg, plan):
+    """1F1B/interleaved pipeline step: returns (local mean loss, grads pytree)
+    with grads already divided by microbatch count (same semantics as
+    value_and_grad of the mean loss). Runs inside shard_map."""
+    pp, M, V = plan.pp, plan.microbatches, plan.vpp
+    stage = jax.lax.axis_index("pp")
+    is_first = stage == 0
+    is_last = stage == pp - 1
+    cdt = jnp.dtype(cfg.compute_dtype)
+    B_loc, S_loc = tokens.shape
+    B_mb = B_loc // M
+    tok_mb = tokens.reshape(M, B_mb, S_loc)
+    lab_mb = labels.reshape(M, B_mb, S_loc)
+    Hd = cfg.hidden
+
+    if V > 1:
+        fwd_tbl, bwd_tbl, _ = build_interleaved_tables(M, pp, V)
+    else:
+        f_t, b_t, _ = build_tables(M, pp, plan.schedule)
+        fwd_tbl, bwd_tbl = f_t[:, :, None], b_t[:, :, None]
+    farr, garr = arrival_tables(fwd_tbl, bwd_tbl, pp, V)
+    W = required_slots(fwd_tbl, bwd_tbl, farr, garr, M, pp, V)
+    T = fwd_tbl.shape[0]
+    fwd_tbl = jnp.asarray(fwd_tbl)
+    bwd_tbl = jnp.asarray(bwd_tbl)
+    farr = jnp.asarray(farr)
+    garr = jnp.asarray(garr)
+
+    bp_all = {k: params[k] for k in _BLOCK_LEAVES}
+    hp = {k: params[k] for k in ("lnf_w", "lnf_b", "wte")}
+    ep = {k: params[k] for k in ("wte", "wpe")}
+    L_loc = bp_all["w_qkv"].shape[0]
+    Lk = L_loc // V
+
+    def chunk_params(c):
+        return {k: jax.lax.slice_in_dim(v, c * Lk, (c + 1) * Lk, axis=0)
+                for k, v in bp_all.items()}
+
+    def stage_fn(bp_, x):
+        return _stage_blocks(x, bp_, cfg, plan)
+
+    def zeros_like_t(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), tree)
+
+    zero_act = jnp.zeros((B_mb, S_loc, Hd), cdt)
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+    f32 = jnp.float32
+
+    def acc(a_tree, g_tree):
+        return jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(f32), a_tree, g_tree)
+
+    def tick(carry, t):
+        buf, gbuf, fchan, gchan, loss_sum, g_bp, g_hp, g_ep = carry
+        new_ys, new_gs = [], []
+        for c in range(V):
+            f_idx = fwd_tbl[t, stage, c]
+            b_idx = bwd_tbl[t, stage, c]
+            valid_f = f_idx >= 0
+            valid_b = b_idx >= 0
+            fi = jnp.clip(f_idx, 0, M - 1)
+            bi = jnp.clip(b_idx, 0, M - 1)
+            bp_c = chunk_params(c)
+
+            # ---- park arrivals: the ppermute channels are overwritten every
+            # tick, so incoming activations/cotangents go into the circular
+            # buffers NOW even if this stage runs them later ----
+            a_f = farr[t, stage, c]
+            inc = fchan[c] if c == 0 else jnp.where(is_first, fchan[c - 1],
+                                                    fchan[c])
+            buf = jax.lax.cond(
+                a_f >= 0,
+                lambda: buf.at[c, jnp.clip(a_f, 0, M - 1) % W].set(inc),
+                lambda: buf)
+            a_g = garr[t, stage, c]
+            g_inc = gchan[c] if c == V - 1 else jnp.where(is_last,
+                                                          gchan[c + 1],
+                                                          gchan[c])
+            gbuf = jax.lax.cond(
+                a_g >= 0,
+                lambda: gbuf.at[c, jnp.clip(a_g, 0, M - 1) % W].set(g_inc),
+                lambda: gbuf)
+
+            # ---- forward: stage 0 chunk 0 embeds its input (and parks it
+            # for the backward recompute); everyone else reads the buffer ----
+            if c == 0:
+                x_f = jax.lax.cond(
+                    is_first,
+                    lambda: _embed(tok_mb[fi], ep, cfg, plan),
+                    lambda: buf[c, fi % W])
+                buf = jax.lax.cond(
+                    valid_f & is_first,
+                    lambda: buf.at[c, fi % W].set(x_f),
+                    lambda: buf)
+            else:
+                x_f = buf[c, fi % W]
+            # the last virtual stage's output is consumed nowhere (its
+            # backward recomputes the forward inside value_and_grad), so
+            # skip that compute instead of shipping a dead activation
+            run_f = valid_f if c < V - 1 else (valid_f & ~is_last)
+            y_f = jax.lax.cond(
+                run_f, lambda: stage_fn(bp_c, x_f), lambda: zero_act)
+            new_ys.append(y_f)
+
+            # ---- backward: last virtual stage seeds from the loss; others
+            # apply the parked cotangent through the stage vjp ----
+            x_b = buf[c, bi % W]
+            g_in = gbuf[c, bi % W]
+
+            def mid_branch():
+                _, vjp = jax.vjp(stage_fn, bp_c, x_b)
+                gb, gx = vjp(g_in)
+                return jnp.zeros((), f32), gb, zeros_like_t(hp), gx
+
+            if c == V - 1:
+                def last_branch():
+                    def head(bp_, hp_, x):
+                        y = stage_fn(bp_, x)
+                        return _vocab_parallel_loss(y, lab_mb[bi], hp_,
+                                                    cfg, plan)
+                    l, (gb, gh, gx) = jax.value_and_grad(
+                        head, argnums=(0, 1, 2))(bp_c, hp, x_b)
+                    return l, gb, gh, gx
+
+                def do_b():
+                    return jax.lax.cond(is_last, last_branch, mid_branch)
+            else:
+                do_b = mid_branch
+
+            def skip_b():
+                return (jnp.zeros((), f32), zeros_like_t(bp_c),
+                        zeros_like_t(hp), zero_act)
+
+            l_b, gb_c, gh_c, g_x = jax.lax.cond(valid_b, do_b, skip_b)
+            new_gs.append(g_x)
+
+            if c == 0:
+                def emb_b():
+                    _, evjp = jax.vjp(
+                        lambda e: _embed(tok_mb[bi], e, cfg, plan), ep)
+                    return evjp(g_x)[0]
+                g_ep = acc(g_ep, jax.lax.cond(
+                    is_first & valid_b, emb_b, lambda: zeros_like_t(ep)))
+            g_bp = {k: g_bp[k].at[c * Lk:(c + 1) * Lk]
+                    .add(gb_c[k].astype(f32)) for k in g_bp}
+            g_hp = acc(g_hp, gh_c)
+            loss_sum = loss_sum + l_b
+
+        fchan = jax.lax.ppermute(jnp.stack(new_ys), "pp", fwd_perm)
+        gchan = jax.lax.ppermute(jnp.stack(new_gs).astype(cdt), "pp",
+                                 bwd_perm)
+        return (buf, gbuf, fchan, gchan, loss_sum, g_bp, g_hp, g_ep), None
+
+    carry0 = (
+        jnp.zeros((V, W, B_mb, S_loc, Hd), cdt),
+        jnp.zeros((V, W, B_mb, S_loc, Hd), cdt),
+        jnp.zeros((V, B_mb, S_loc, Hd), cdt),
+        jnp.zeros((V, B_mb, S_loc, Hd), cdt),
+        jnp.zeros((), f32),
+        {k: jnp.zeros(v.shape, f32) for k, v in bp_all.items()},
+        {k: jnp.zeros(v.shape, f32) for k, v in hp.items()},
+        {k: jnp.zeros(v.shape, f32) for k, v in ep.items()},
+    )
+    (_, _, _, _, loss_sum, g_bp, g_hp, g_ep), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    loss = _axis_psum(jnp.where(is_last, loss_sum / M, 0.0), "pp")
+    grads = {k: v / M for k, v in g_bp.items()}
+    grads["wte"] = (g_ep["wte"] + g_hp["wte"]) / M
+    grads["wpe"] = g_ep["wpe"] / M
+    grads["lnf_w"] = g_hp["lnf_w"] / M
+    grads["lnf_b"] = g_hp["lnf_b"] / M
+    return loss, grads
+
+
+# ---------------------------------------------------------------------------
 # ZeRO-2 sharded AdamW (f32 master weights)
 # ---------------------------------------------------------------------------
 
@@ -422,7 +655,11 @@ def make_train_step(cfg: GPTSpmdConfig, plan: MeshPlan, mesh=None,
         return _pipeline_loss(tokens, labels, params, cfg, plan)
 
     def sharded_step(params, opt_state, tokens, labels, lr):
-        loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
+        if plan.pp > 1 and (plan.vpp > 1 or plan.schedule != "gpipe"):
+            loss, grads = _pipeline_manual_loss_and_grads(
+                tokens, labels, params, cfg, plan)
+        else:
+            loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
         # grad sync over all data axes BEFORE clipping so the global-norm
         # clip sees the true batch gradient (paddle semantics). The ZeRO
         # psum_scatter then acts as a slice of the replicated mean.
@@ -479,6 +716,12 @@ def make_train_step(cfg: GPTSpmdConfig, plan: MeshPlan, mesh=None,
 
     def init_fn(key):
         params = init_gpt_params(cfg, key)
+        if plan.vpp > 1:
+            # interleaved storage layout (same logical model — see
+            # interleave_permutation)
+            perm = interleave_permutation(cfg.layers, plan.pp, plan.vpp)
+            params = {k: (v[perm] if k in _BLOCK_LEAVES else v)
+                      for k, v in params.items()}
         params = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
             params, specs, is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,228 @@
+"""1F1B / interleaved pipeline schedules (VERDICT r1 item 3).
+
+Reference behavior: fleet/meta_parallel/pipeline_parallel.py:120 (1F1B),
+:464 (interleaved). Here the schedule is a static tick table driving one
+compiled scan (paddle_tpu/parallel/pipeline_schedule.py); these tests check
+(a) the tables respect pipeline dataflow and the 1F1B activation bound,
+(b) loss parity of every schedule against the single-device golden, and
+(c) the compiled 1F1B program's temp memory is far below GPipe's at M=8.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
+from paddle_tpu.parallel.gpt_spmd import (_pipeline_loss,
+                                          _pipeline_manual_loss_and_grads,
+                                          init_gpt_params, param_specs)
+from paddle_tpu.parallel.pipeline_schedule import (arrival_tables,
+                                                   build_interleaved_tables,
+                                                   build_tables,
+                                                   required_slots,
+                                                   schedule_stats)
+
+CFG = GPTSpmdConfig(vocab_size=128, max_seq_len=64, hidden=32, layers=8,
+                    heads=4, ffn=64, remat=False)
+B, S = 8, 32
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (jnp.asarray(rng.randint(0, CFG.vocab_size, (B, S))),
+            jnp.asarray(rng.randint(0, CFG.vocab_size, (B, S))))
+
+
+def _run(plan, n=3):
+    toks, labs = _data()
+    step, init, _ = make_train_step(CFG, plan, learning_rate=1e-2)
+    params, state = init(jax.random.key(0))
+    out = []
+    for _ in range(n):
+        loss, params, state = step(params, state, toks, labs,
+                                   jnp.float32(1e-2))
+        out.append(float(loss))
+    return out
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return _run(MeshPlan())
+
+
+# ---------------------------------------------------------------------------
+# schedule table invariants
+# ---------------------------------------------------------------------------
+
+def _check_dataflow(fwd, bwd, M, pp, vpp=1):
+    """F(j,k) strictly after F(j,k-1); B(j,k) after B(j,k+1) (same-tick fold
+    allowed only at the last virtual stage); every microbatch runs exactly
+    once per virtual stage."""
+    if fwd.ndim == 2:
+        fwd, bwd = fwd[:, :, None], bwd[:, :, None]
+    D = pp * vpp
+    T = fwd.shape[0]
+    ftick = np.full((D, M), -1)
+    btick = np.full((D, M), -1)
+    for t in range(T):
+        for s in range(pp):
+            for c in range(vpp):
+                k = c * pp + s
+                j = fwd[t, s, c]
+                if j >= 0:
+                    assert ftick[k][j] == -1, "duplicate forward"
+                    ftick[k][j] = t
+                j = bwd[t, s, c]
+                if j >= 0:
+                    assert btick[k][j] == -1, "duplicate backward"
+                    btick[k][j] = t
+    assert (ftick >= 0).all() and (btick >= 0).all(), "missing work"
+    for k in range(D):
+        for j in range(M):
+            if k > 0:
+                assert ftick[k][j] > ftick[k - 1][j]
+            if k < D - 1:
+                assert btick[k][j] > btick[k + 1][j]
+            else:
+                assert btick[k][j] >= ftick[k][j]
+
+
+def test_1f1b_tables_dataflow_and_bound():
+    M, pp = 8, 4
+    fwd, bwd, _ = build_tables(M, pp, "1f1b")
+    _check_dataflow(fwd, bwd, M, pp)
+    stats = schedule_stats(fwd, bwd)
+    # the 1F1B guarantee: in-flight at stage s never exceeds pp - s
+    for s, peak in enumerate(stats["peak_inflight"]):
+        assert peak <= pp - s, (s, stats)
+
+
+def test_gpipe_tables_inflight_grows_with_m():
+    fwd, bwd, _ = build_tables(8, 4, "gpipe")
+    _check_dataflow(fwd, bwd, 8, 4)
+    assert schedule_stats(fwd, bwd)["peak_inflight"][0] > 4
+
+
+def test_eager1f1b_min_ticks():
+    M, pp = 8, 4
+    fwd, bwd, _ = build_tables(M, pp, "eager1f1b")
+    _check_dataflow(fwd, bwd, M, pp)
+    # lockstep lower bound: fill (pp-1) + M + drain (pp-1)
+    assert fwd.shape[0] == M + 2 * (pp - 1)
+
+
+def test_interleaved_tables_dataflow():
+    M, pp, vpp = 8, 4, 2
+    fwd, bwd, _ = build_interleaved_tables(M, pp, vpp)
+    _check_dataflow(fwd, bwd, M, pp, vpp)
+
+
+def test_required_slots_m_independent():
+    pp = 4
+    slots = [required_slots(
+        *(lambda f, b: (f[:, :, None], b[:, :, None],
+                        *arrival_tables(f[:, :, None], b[:, :, None], pp, 1)))(
+            *build_tables(M, pp, "1f1b")[:2]), M, pp, 1)
+        for M in (8, 16, 32)]
+    assert slots[0] == slots[1] == slots[2], slots  # O(pp), not O(M)
+
+
+# ---------------------------------------------------------------------------
+# loss parity vs single-device golden
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("plan", [
+    MeshPlan(pp=4, microbatches=8, schedule="1f1b"),
+    MeshPlan(pp=4, microbatches=8, schedule="eager1f1b"),
+    MeshPlan(pp=4, microbatches=4, vpp=2),
+    MeshPlan(pp=2, microbatches=4, vpp=4),
+    MeshPlan(pp=2, mp=2, dp=2, microbatches=2),
+], ids=["1f1b", "eager1f1b", "interleave_v2", "interleave_v4", "hybrid"])
+def test_pipeline_parity(plan, golden):
+    losses = _run(plan)
+    diff = max(abs(a - b) for a, b in zip(golden, losses))
+    assert diff < 3e-4, (plan, golden, losses)
+
+
+# ---------------------------------------------------------------------------
+# memory: compiled 1F1B temp footprint << GPipe at M=8, pp=4
+# ---------------------------------------------------------------------------
+
+def _temp_bytes(schedule, M=8, pp=4):
+    plan = MeshPlan(pp=pp, microbatches=M, schedule=schedule)
+    mesh = plan.build_mesh()
+    specs = param_specs(CFG)
+    data_spec = P(("dp", "sharding"), "sp")
+
+    def loss_fn(params, toks, labs):
+        if schedule == "gpipe":
+            def local(p, t, l):
+                return _pipeline_loss(t, l, p, CFG, plan)
+            return jax.value_and_grad(local)(params, toks, labs)
+        return _pipeline_manual_loss_and_grads(toks, labs, params, CFG, plan)
+
+    sh = jax.shard_map(loss_fn, mesh=mesh,
+                       in_specs=(specs, data_spec, data_spec),
+                       out_specs=(P(), specs), check_vma=False)
+    toks = jnp.zeros((2 * M, S), jnp.int32)
+    params = init_gpt_params(CFG, jax.random.key(0))
+    comp = jax.jit(sh).lower(params, toks, toks).compile()
+    return comp.memory_analysis().temp_size_in_bytes
+
+
+def test_1f1b_memory_below_gpipe():
+    g = _temp_bytes("gpipe")
+    f = _temp_bytes("1f1b")
+    assert f < 0.5 * g, (f, g)
+
+
+# ---------------------------------------------------------------------------
+# generic PipelineLayer -> compiled SPMD pipeline (VERDICT r1 item 4):
+# a non-GPT LayerDesc stack must really run distributed over the pp axis
+# ---------------------------------------------------------------------------
+
+def test_generic_pipeline_layer_compiled_parity():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+
+    paddle.seed(11)
+    descs = [LayerDesc(nn.Linear, 8, 32), LayerDesc(nn.ReLU),
+             LayerDesc(nn.Linear, 32, 32), LayerDesc(nn.ReLU),
+             LayerDesc(nn.Linear, 32, 4)]
+    pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    golden = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 32),
+                           nn.ReLU(), nn.Linear(32, 4))
+    golden.set_state_dict({k.replace("seg_", ""): v
+                           for k, v in pl.state_dict().items()})
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs["pp_degree"] = 2
+    strategy.hybrid_configs["dp_degree"] = 4
+    strategy.pipeline_configs["accumulate_steps"] = 4
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(pl)
+
+    o_pp = popt.SGD(0.1, parameters=pl.parameters())
+    o_g = popt.SGD(0.1, parameters=golden.parameters())
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(3)
+    for step in range(3):
+        x = paddle.to_tensor(rng.rand(16, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 4, 16))
+        loss_pp = model.train_batch((x, y), o_pp)
+        loss_g = lf(golden(x), y)
+        loss_g.backward()
+        o_g.step()
+        o_g.clear_grad()
+        np.testing.assert_allclose(float(loss_pp), float(loss_g), rtol=3e-5,
+                                   atol=1e-6)
+    # the compiled SPMD path must actually have been taken
+    assert model._compiled_step is not None
+    w_pp = dict(pl.named_parameters())["seg_0.weight"].numpy()
+    w_g = dict(golden.named_parameters())["0.weight"].numpy()
+    np.testing.assert_allclose(w_pp, w_g, rtol=3e-5, atol=3e-6)
