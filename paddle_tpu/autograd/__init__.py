@@ -19,28 +19,41 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
          only_inputs=True, allow_unused=False, no_grad_vars=None):
-    """paddle.grad — computes grads of outputs w.r.t. inputs without touching
-    .grad. Implemented by running the tape backward into a side dict."""
+    """paddle.grad — grads of outputs w.r.t. inputs without touching .grad.
+
+    create_graph=True records the backward pass on the tape, so the returned
+    gradients are differentiable (double grad / gradient penalty — reference:
+    eager/general_grad.h, eager_utils RunBackward(create_graph))."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
-    grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+    grad_outputs = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+        else [grad_outputs]
 
-    # save/restore leaf .grad state so paddle.grad is side-effect free
-    saved = [(p, p._grad_data) for p in inputs]
-    for p in inputs:
-        p._grad_data = None
-    retain = True if retain_graph is None else retain_graph
+    retain = create_graph if retain_graph is None else retain_graph
+    capture = {id(p): p for p in inputs}
+    totals = {}
     for out, go in zip(outputs, grad_outputs):
-        _engine.backward(out, go, retain_graph=retain)
+        got = _engine.run_backward(out, go, retain_graph=retain,
+                                   create_graph=create_graph,
+                                   capture=capture,
+                                   accumulate_leaf_grads=False)
+        for k, v in got.items():
+            totals[k] = v if k not in totals else totals[k] + v
+
     results = []
-    for p, old in saved:
-        g = p._grad_data
-        if g is None and not allow_unused:
+    for p in inputs:
+        g = totals.get(id(p))
+        if g is None:
+            if allow_unused:
+                results.append(None)
+                continue
             g = jnp.zeros_like(p._data)
-        results.append(Tensor(g) if g is not None else None)
-        p._grad_data = old
+        if isinstance(g, Tensor):
+            results.append(g if create_graph else Tensor(g._data))
+        else:
+            results.append(Tensor(g, stop_gradient=not create_graph))
     return results
 
 

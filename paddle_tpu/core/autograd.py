@@ -49,14 +49,25 @@ def enable_grad():
 class Node:
     """One tape entry: the vjp closure of a single traced op."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "multi_output", "name")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "multi_output", "name", "fwd")
 
-    def __init__(self, vjp_fn, inputs, outputs, multi_output, name=""):
+    # unhashable on purpose: double-grad records vjp calls through apply_op
+    # with the Node in a closure cell, and an identity-hashed Node would fill
+    # the eager op cache with one dead entry per backward pass
+    __hash__ = None
+
+    def __init__(self, vjp_fn, inputs, outputs, multi_output, name="",
+                 fwd=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs        # list[Tensor] — the differentiable inputs
         self.outputs = outputs      # list[Tensor]
         self.multi_output = multi_output
         self.name = name
+        # closed forward over the diff inputs (raw arrays): lets create_graph
+        # re-derive the vjp as a function of the PRIMALS, so second-order
+        # terms (which live in the residuals) survive. None => second order
+        # through this node is zero (e.g. PyLayer with opaque backward).
+        self.fwd = fwd
 
     def release(self):
         self.vjp_fn = None
@@ -64,6 +75,7 @@ class Node:
         for o in self.outputs or ():
             o._node = None
         self.outputs = None
+        self.fwd = None
 
 
 def _topo_from(root_node):
@@ -85,44 +97,128 @@ def _topo_from(root_node):
     return order
 
 
-def backward(tensor, grad=None, retain_graph=False):
-    """Reverse-mode sweep from `tensor` accumulating into leaf `.grad`s."""
-    import jax.numpy as jnp
+def _apply_hooks(tensor, g, create_graph):
+    """Run a tensor's registered grad hooks over its finalized cotangent.
+    Hooks see (and may return) Tensors — reference: imperative/hooks.h."""
+    hooks = getattr(tensor, "_hooks", None)
+    if not hooks:
+        return g
     from .tensor import Tensor
+    gt = g if isinstance(g, Tensor) else Tensor(g)
+    for hook in list(hooks.values()):
+        out = hook(gt)
+        if out is not None:
+            gt = out if isinstance(out, Tensor) else Tensor(out)
+    if create_graph or isinstance(g, Tensor):
+        return gt
+    return gt._data
 
+
+def run_backward(tensor, grad=None, retain_graph=False, create_graph=False,
+                 capture=None, accumulate_leaf_grads=True):
+    """Generic reverse sweep from `tensor`.
+
+    create_graph: cotangents flow as Tensors and every vjp call is recorded
+    through apply_op, so the produced gradients are themselves differentiable
+    (double grad — reference: eager/general_grad.h).
+    capture: optional {id(t): t} of tensors whose finalized cotangent should
+    be returned (paddle.grad); leaves still accumulate .grad only when
+    accumulate_leaf_grads.
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor, apply_op
+
+    captured = {}
     if tensor._node is None:
-        return
+        if capture and id(tensor) in capture:
+            g0 = grad if grad is not None else jnp.ones_like(tensor._data)
+            captured[id(tensor)] = g0
+        return captured
     if grad is None:
         grad = jnp.ones_like(tensor._data)
-    elif isinstance(grad, Tensor):
+    if isinstance(grad, Tensor) and not create_graph:
         grad = grad._data
+    if create_graph and not isinstance(grad, Tensor):
+        grad = Tensor(grad, stop_gradient=False)
+
+    def zero_like(o):
+        z = jnp.zeros_like(o._data)
+        return Tensor(z, stop_gradient=False) if create_graph else z
+
+    def add(a, b):
+        return a + b   # Tensor + Tensor or raw + raw
 
     order = _topo_from(tensor._node)
     cotangents = {id(tensor): grad}
+    leaf_grads = {}    # id -> (leaf tensor, accumulated cotangent)
 
     for node in reversed(order):
         cts = [cotangents.pop(id(o), None) for o in node.outputs]
         if all(c is None for c in cts):
             continue
-        cts = [c if c is not None else jnp.zeros_like(o._data)
+        cts = [c if c is not None else zero_like(o)
                for c, o in zip(cts, node.outputs)]
-        seed = tuple(cts) if node.multi_output else cts[0]
-        in_grads = node.vjp_fn(seed)
+        # cotangents of this node's outputs are final here (reverse topo):
+        # fire hooks, record captures
+        for o, i in zip(node.outputs, range(len(cts))):
+            cts[i] = _apply_hooks(o, cts[i], create_graph)
+            if capture and id(o) in capture:
+                captured[id(o)] = cts[i]
+        if create_graph:
+            if node.fwd is not None:
+                # differentiate-through-backward: rebuild the vjp from the
+                # primal inputs so d(grad)/d(primal) is on the tape
+                n_in = len(node.inputs)
+
+                def call(*vals, _node=node, _n=n_in):
+                    import jax as _jax
+                    _, vjp_fn = _jax.vjp(_node.fwd, *vals[:_n])
+                    seeds = vals[_n:]
+                    return vjp_fn(tuple(seeds) if _node.multi_output
+                                  else seeds[0])
+                in_grads = apply_op(call, *node.inputs, *cts,
+                                    name=f"grad:{node.name}")
+            else:
+                def call(*seeds, _node=node):
+                    return _node.vjp_fn(tuple(seeds) if _node.multi_output
+                                        else seeds[0])
+                in_grads = apply_op(call, *cts, name=f"grad:{node.name}")
+            if not isinstance(in_grads, tuple):
+                in_grads = (in_grads,)
+        else:
+            seed = tuple(cts) if node.multi_output else cts[0]
+            in_grads = node.vjp_fn(seed)
         for inp, g in zip(node.inputs, in_grads):
             if inp.stop_gradient:
                 continue
-            if inp._node is None:  # leaf: accumulate into .grad (paddle semantics)
-                if inp._grad_data is None:
-                    inp._grad_data = g
+            key = id(inp)
+            if inp._node is None:
+                if key in leaf_grads:
+                    leaf_grads[key] = (inp, add(leaf_grads[key][1], g))
                 else:
-                    inp._grad_data = inp._grad_data + g
+                    leaf_grads[key] = (inp, g)
+            elif key in cotangents:
+                cotangents[key] = add(cotangents[key], g)
             else:
-                key = id(inp)
-                if key in cotangents:
-                    cotangents[key] = cotangents[key] + g
-                else:
-                    cotangents[key] = g
+                cotangents[key] = g
 
-    if not retain_graph:
+    for key, (leaf, g) in leaf_grads.items():
+        g = _apply_hooks(leaf, g, create_graph)
+        if capture and key in capture:
+            captured[key] = g
+        if accumulate_leaf_grads:
+            raw = g._data if isinstance(g, Tensor) else g
+            if leaf._grad_data is None:
+                leaf._grad_data = raw
+            else:
+                leaf._grad_data = leaf._grad_data + raw
+
+    if not (retain_graph or create_graph):
         for node in order:
             node.release()
+    return captured
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Reverse-mode sweep from `tensor` accumulating into leaf `.grad`s."""
+    run_backward(tensor, grad, retain_graph=retain_graph)

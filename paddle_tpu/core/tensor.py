@@ -27,7 +27,7 @@ def _is_traced(x) -> bool:
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad_data", "_node", "name",
                  "persistable", "trainable", "_dist_attr", "_asp_mask",
-                 "__weakref__")
+                 "_hooks", "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
@@ -137,8 +137,19 @@ class Tensor:
     clear_gradient = clear_grad
 
     def register_hook(self, hook):
-        # Eager-mode grad hooks: wrap producer vjp. Minimal support.
-        raise NotImplementedError("register_hook is not supported yet")
+        """Register a gradient hook: hook(grad Tensor) -> new grad or None,
+        fired when this tensor's cotangent is finalized during backward
+        (reference: imperative/hooks.h TensorHook). Returns a removable
+        handle (.remove())."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a grad hook on a tensor with "
+                "stop_gradient=True")
+        hooks = getattr(self, "_hooks", None)
+        if hooks is None:
+            hooks = {}
+            self._hooks = hooks
+        return HookRemoveHelper(hooks, hook)
 
     # -- in-place helpers ---------------------------------------------------
     def _replace(self, new_tensor):
@@ -311,6 +322,22 @@ class Tensor:
     # python/paddle/tensor/* methods onto the C tensor type.
 
 
+class HookRemoveHelper:
+    """Handle returned by register_hook (reference:
+    python/paddle/fluid/dygraph/base.py HookRemoveHelper)."""
+
+    _next_id = 0
+
+    def __init__(self, hooks_dict, hook):
+        self._hooks = hooks_dict
+        self._id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+        hooks_dict[self._id] = hook
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
 class Parameter(Tensor):
     """Trainable tensor (reference: paddle/fluid/framework.py Parameter)."""
 
@@ -379,6 +406,104 @@ def _promote_scalar_dtype(scalar, tensor):
     return None
 
 
+# ---------------------------------------------------------------------------
+# Eager per-op executable cache (SURVEY §7 hard part #1; VERDICT r1 item 6).
+#
+# The reference's whole eager/ C++ fast path exists to make per-op dispatch
+# cheap; on TPU the equivalent is: never re-trace or re-compile an op the
+# runtime has already seen. apply_op keys a cache on the op's IDENTITY
+# (code object + closure cells + static args/kwargs + which args are
+# differentiable); the cached entry is ONE jax.jit wrapper, and jit's own
+# executable cache then keys on input shapes/dtypes. The backward closure
+# returned by jax.vjp is a jax.tree_util.Partial pytree, so it crosses the
+# jit boundary and the transposed program is jitted (and cached) the same
+# way through _BWD_CALL.
+#
+# Ops whose identity can't be hashed (arrays captured in closures, unhashable
+# kwargs) fall back to the direct re-trace path — correct, just uncached.
+# ---------------------------------------------------------------------------
+
+_EAGER_CACHE = {}
+_CACHE_STATS = {"hits": 0, "misses": 0, "bypass": 0}
+eager_op_cache_enabled = True
+
+
+def _hashable(x):
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+def _op_cache_key(fn, args, kwargs, diff_idx):
+    """Cache key capturing the op's identity + all static (non-Tensor)
+    operands, or None when any part is unhashable."""
+    if hasattr(fn, "__code__"):
+        try:
+            cells = tuple(c.cell_contents for c in (fn.__closure__ or ()))
+        except ValueError:          # empty cell
+            return None
+        defaults = (fn.__defaults__ or ()) + tuple(
+            sorted((fn.__kwdefaults__ or {}).items()))
+        if not (_hashable(cells) and _hashable(defaults)):
+            return None
+        ident = (fn.__code__, cells, defaults)
+    elif _hashable(fn):
+        ident = (fn,)
+    else:
+        return None
+    statics = tuple((i, a) for i, a in enumerate(args)
+                    if not isinstance(a, Tensor))
+    kw = tuple(sorted(kwargs.items()))
+    if not (_hashable(statics) and _hashable(kw)):
+        return None
+    return (ident, statics, kw, tuple(diff_idx), len(args))
+
+
+def _build_cached_op(fn, args, kwargs, diff_idx, with_grad):
+    """One jit-wrapped runner for this op identity; jit caches executables
+    per input shape/dtype from here on."""
+    tensor_idx = tuple(i for i, a in enumerate(args) if isinstance(a, Tensor))
+    static_vals = {i: a for i, a in enumerate(args)
+                   if not isinstance(a, Tensor)}
+    diff_pos = tuple(tensor_idx.index(i) for i in diff_idx)
+
+    def assemble(tensor_datas):
+        full = [None] * len(args)
+        for i, v in zip(tensor_idx, tensor_datas):
+            full[i] = v
+        for i, v in static_vals.items():
+            full[i] = v
+        return fn(*full, **kwargs)
+
+    if not with_grad:
+        @jax.jit
+        def run(td):
+            return assemble(td)
+        return run
+
+    @jax.jit
+    def run(td):
+        def diff_call(*diff_vals):
+            full_td = list(td)
+            for p, v in zip(diff_pos, diff_vals):
+                full_td[p] = v
+            return assemble(full_td)
+        return jax.vjp(diff_call, *[td[p] for p in diff_pos])
+
+    return run
+
+
+@jax.jit
+def _BWD_CALL(vjp_fn, seed):
+    return vjp_fn(seed)
+
+
+def _cached_bwd(vjp_fn):
+    return lambda seed: _BWD_CALL(vjp_fn, seed)
+
+
 def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
     """Run `fn` over tensor args, recording a tape Node when grads are needed.
 
@@ -390,6 +515,42 @@ def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
                 if isinstance(a, Tensor) and not a.stop_gradient
                 and _dt.is_inexact(a.dtype)]
     need_grad = ag.is_grad_enabled() and bool(diff_idx)
+
+    # compiled-executable fast path: skip inside an outer trace (XLA already
+    # owns that program) and for unhashable op identities
+    key = None
+    if eager_op_cache_enabled and not any(_is_traced(d) for d in datas):
+        key = _op_cache_key(fn, args, kwargs, diff_idx)
+    if key is not None:
+        runner = _EAGER_CACHE.get((key, need_grad))
+        if runner is None:
+            _CACHE_STATS["misses"] += 1
+            runner = _build_cached_op(fn, args, kwargs, diff_idx, need_grad)
+            _EAGER_CACHE[(key, need_grad)] = runner
+        else:
+            _CACHE_STATS["hits"] += 1
+        td = tuple(d for d, a in zip(datas, args) if isinstance(a, Tensor))
+        if not need_grad:
+            return _wrap_out(runner(td), stop_gradient=True)
+        out_data, vjp_fn = runner(td)
+        multi = isinstance(out_data, (tuple, list))
+        outs = _wrap_out(out_data, stop_gradient=False)
+        out_list = list(outs) if multi else [outs]
+
+        def closed_cached(*diff_vals, _datas=tuple(datas),
+                          _diff=tuple(diff_idx)):
+            full = list(_datas)
+            for i, v in zip(_diff, diff_vals):
+                full[i] = v
+            return fn(*full, **kwargs)
+
+        node = Node(_cached_bwd(vjp_fn), [args[i] for i in diff_idx],
+                    out_list, multi, name=name or getattr(fn, "__name__", ""),
+                    fwd=closed_cached)
+        for o in out_list:
+            o._node = node
+        return outs
+    _CACHE_STATS["bypass"] += 1
 
     if not need_grad:
         out = fn(*datas, **kwargs)
@@ -406,7 +567,7 @@ def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
     outs = _wrap_out(out_data, stop_gradient=False)
     out_list = list(outs) if multi else [outs]
     node = Node(vjp_fn, [args[i] for i in diff_idx], out_list, multi,
-                name=name or getattr(fn, "__name__", ""))
+                name=name or getattr(fn, "__name__", ""), fwd=closed)
     for o in out_list:
         o._node = node
     return outs

@@ -58,7 +58,7 @@ class Model:
             loss = add_n([l.sum() for l in loss])
         return loss
 
-    def _build_train_step(self):
+    def _build_train_step(self, sharded=True):
         network = self.network
         optimizer = self._optimizer
 
@@ -80,7 +80,29 @@ class Model:
                 params, grads, opt_state, lr=lr)
             return loss, new_params, new_buffers, new_opt_state, raw_outs
 
+        mesh = self._dp_mesh() if sharded else None
+        if mesh is not None:
+            # auto data parallelism (reference hapi/model.py:190 wraps in
+            # DataParallel): batch sharded over the mesh 'dp' axis, params
+            # replicated; the GSPMD partitioner inserts the gradient
+            # all-reduce because grads of replicated params from a sharded
+            # batch require it. Loss/semantics identical to single device.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(mesh, P())
+            data = NamedSharding(mesh, P("dp"))
+            return jax.jit(train_step,
+                           in_shardings=(repl, repl, repl, repl, repl,
+                                         data, data),
+                           out_shardings=repl)
         return jax.jit(train_step)
+
+    @staticmethod
+    def _dp_mesh():
+        from ..distributed import env as dist_env
+        mesh = dist_env.get_mesh()
+        if mesh is not None and "dp" in mesh.shape and mesh.shape["dp"] > 1:
+            return mesh
+        return None
 
     def _build_eval_step(self):
         network = self.network
@@ -119,12 +141,23 @@ class Model:
                         for l in (labels or ()))
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
+        step_fn = self._train_step_fn
+        mesh = self._dp_mesh()
+        if mesh is not None:
+            dp = int(mesh.shape["dp"])
+            if any(r.ndim and r.shape[0] % dp for r in in_raw + lab_raw):
+                # ragged final batch can't shard evenly over dp: run it
+                # replicated (numerically identical, just unparallel)
+                if getattr(self, "_train_step_plain", None) is None:
+                    self._train_step_plain = self._build_train_step(
+                        sharded=False)
+                step_fn = self._train_step_plain
         params, buffers = functional_state(self.network)
         if self._opt_state is None:
             self._opt_state = self._optimizer.functional_state(params)
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         seed = _rng.next_key()
-        loss, new_params, new_buffers, self._opt_state, outs = self._train_step_fn(
+        loss, new_params, new_buffers, self._opt_state, outs = step_fn(
             params, buffers, self._opt_state, lr, seed, in_raw, lab_raw)
         self._write_back(new_params, new_buffers)
         if isinstance(self._optimizer._lr, object) and hasattr(self._optimizer._lr, "step"):

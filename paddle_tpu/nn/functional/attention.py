@@ -33,24 +33,33 @@ def _sdpa_ref(q, k, v, mask, dropout_key, dropout_p, causal, scale):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
-    """paddle.nn.functional.scaled_dot_product_attention — (B, S, H, D) layout."""
+    """paddle.nn.functional.scaled_dot_product_attention — (B, S, H, D)
+    layout. Masked / dropout / GQA variants all run through the Pallas flash
+    kernel on TPU (reference: fused_attention_op.cu handles mask+dropout in
+    its fused path); the XLA fallback uses the same counter-based dropout
+    so results are backend-independent."""
+    import numpy as np
+
     from ...core.random import next_key
+    from ...ops.flash_attention import flash_attention_bshd
 
     D = query.shape[-1]
     scale = 1.0 / (D ** 0.5)
-    dk = next_key() if (dropout_p > 0 and training) else None
+    rate = float(dropout_p) if training else 0.0
+    seed = None
+    if rate > 0.0:
+        # derive an int32 seed from the framework RNG stream
+        seed = jax.random.randint(next_key(), (), 0, np.iinfo(np.int32).max,
+                                  dtype=jnp.int32)
 
-    use_flash = attn_mask is None and dropout_p == 0.0
-    if use_flash:
-        from ...ops.flash_attention import flash_attention_bshd
-        def fn(q, k, v):
-            return flash_attention_bshd(q, k, v, causal=is_causal, scale=scale)
-        return apply_op(fn, query, key, value)
+    def fn(q, k, v, *rest):
+        m = rest[0] if rest else None
+        return flash_attention_bshd(q, k, v, causal=is_causal, scale=scale,
+                                    mask=m, dropout_rate=rate,
+                                    dropout_seed=seed)
 
-    def fn(q, k, v, *m):
-        return _sdpa_ref(q, k, v, m[0] if m else None, dk,
-                         dropout_p if training else 0.0, is_causal, scale)
-    args = (query, key, value) if attn_mask is None else (query, key, value, attn_mask)
+    args = (query, key, value) if attn_mask is None \
+        else (query, key, value, attn_mask)
     return apply_op(fn, *args)
 
 

@@ -2,8 +2,9 @@
 
 Replaces the reference's fused attention CUDA ops
 (paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) with a
-Pallas TPU kernel (blockwise online-softmax), falling back to a pure-XLA
-implementation on CPU or when shapes don't tile.
+Pallas TPU kernel (blockwise online-softmax) supporting additive masks,
+probability dropout and GQA, falling back to a pure-XLA implementation on
+CPU or when shapes don't tile.
 
 Layout contract: (B, S, H, D) in / out ("BSHD", paddle's MHA layout).
 """
@@ -13,13 +14,35 @@ import jax
 import jax.numpy as jnp
 
 
-def _ref_attention_bhsd(q, k, v, causal, scale):
+def _ref_attention_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
+                        dropout_seed=None):
+    if k.shape[1] != q.shape[1]:               # GQA: expand kv heads
+        g = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        while mask.ndim < 4:
+            mask = mask[None]
+        s = s + mask.astype(jnp.float32)
     if causal:
         S_q, S_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((S_q, S_k), dtype=bool), k=S_k - S_q)
-        s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        cm = jnp.tril(jnp.ones((S_q, S_k), dtype=bool), k=S_k - S_q)
+        s = jnp.where(cm, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p).astype(q.dtype)
+    if dropout_rate > 0.0:
+        # same counter-based keep mask as the Pallas kernel, so both paths
+        # are bit-identical given the seed
+        from .pallas.flash_attention import _dropout_keep
+        B, H, Sq, Sk = p.shape
+        row = jnp.arange(Sq, dtype=jnp.int32)[:, None]
+        col = jnp.arange(Sk, dtype=jnp.int32)[None, :]
+        b_idx = jnp.arange(B * H, dtype=jnp.int32).reshape(B, H, 1, 1)
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(())
+        keep = _dropout_keep(seed, b_idx, row[None, None], col[None, None],
+                             dropout_rate)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -31,29 +54,35 @@ def _use_pallas(q):
     return S % 128 == 0 and D in (64, 128, 256)
 
 
-def _pallas_flash_bhsd(q, k, v, causal, scale):
+def _pallas_flash_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
+                       dropout_seed=None):
     from .pallas.flash_attention import flash_attention
-    return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    return flash_attention(q, k, v, mask=mask, causal=causal, sm_scale=scale,
+                           dropout_rate=dropout_rate,
+                           dropout_seed=dropout_seed)
 
 
-def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """q,k,v: (B, S, H, D). Returns (B, S, H, D)."""
+def flash_attention_bshd(q, k, v, causal=False, scale=None, mask=None,
+                         dropout_rate=0.0, dropout_seed=None):
+    """q: (B, S, H, D); k/v: (B, S, Hk, D). Returns (B, S, H, D)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    if _use_pallas(qt):
-        out = _pallas_flash_bhsd(qt, kt, vt, causal, scale)
-    else:
-        out = _ref_attention_bhsd(qt, kt, vt, causal, scale)
+    out = flash_attention_bhsd(qt, kt, vt, causal=causal, scale=scale,
+                               mask=mask, dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
     return jnp.swapaxes(out, 1, 2)
 
 
-def flash_attention_bhsd(q, k, v, causal=False, scale=None):
-    """q,k,v: (B, H, S, D) (GPT-internal layout)."""
+def flash_attention_bhsd(q, k, v, causal=False, scale=None, mask=None,
+                         dropout_rate=0.0, dropout_seed=None):
+    """q: (B, H, S, D); k/v: (B, Hk, S, D) (GPT-internal layout)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if _use_pallas(q):
-        return _pallas_flash_bhsd(q, k, v, causal, scale)
-    return _ref_attention_bhsd(q, k, v, causal, scale)
+        return _pallas_flash_bhsd(q, k, v, causal, scale, mask,
+                                  dropout_rate, dropout_seed)
+    return _ref_attention_bhsd(q, k, v, causal, scale, mask,
+                               dropout_rate, dropout_seed)

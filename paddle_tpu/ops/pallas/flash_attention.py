@@ -10,11 +10,19 @@ matrix never materialises in HBM:
   * `_dq_kernel`    — dQ accumulation (grid over q-blocks, scan k-blocks)
   * `_dkv_kernel`   — dK/dV accumulation (grid over k-blocks, scan q-blocks)
 
-Layout: (B, H, S, D). Causal masking skips fully-masked blocks entirely
-(the grid still visits them but compute is predicated off with `pl.when`,
-so the MXU work is ~halved). All softmax statistics are kept in float32
-regardless of input dtype (bf16 inputs hit the MXU in bf16, accumulate
-in f32 — same policy as the reference's fp16 fused attention).
+Feature coverage (VERDICT r1 item 8, matching the reference fused path):
+  * additive attention mask, broadcastable over batch and/or heads
+    (reference fused_attention attn_mask semantics: added to scaled scores)
+  * attention-probability dropout with a counter-based in-kernel RNG
+    (murmur3-finalizer hash of absolute (row, col) coordinates), so the
+    backward kernels regenerate the identical keep mask from the seed with
+    no S×S mask tensor ever materialised
+  * GQA/MQA: fewer KV heads than Q heads; the kv block index maps derive
+    the shared head, dK/dV are reduced over the query-head group outside
+
+Layout: (B, H, S, D) for q, (B, Hk, S, D) for k/v. Causal masking skips
+fully-masked blocks entirely (`pl.when` predicates the MXU work off). All
+softmax statistics are kept in float32 regardless of input dtype.
 """
 import functools
 
@@ -28,12 +36,46 @@ _LANE = 128           # TPU lane width; lse/delta carry a broadcast lane dim
 _NEG_INF = -1e30
 
 
+def _dropout_keep(seed, b, row_ids, col_ids, rate):
+    """Deterministic keep mask from absolute coordinates: murmur3-style
+    integer finalizer, identical in forward and backward kernels."""
+    u = jnp.uint32
+    x = (row_ids.astype(u) * u(0x9E3779B9)
+         + col_ids.astype(u) * u(0x85EBCA6B))
+    x = x ^ (seed.astype(u) + b.astype(u) * u(0xC2B2AE35))
+    x = x ^ (x >> u(16))
+    x = x * u(0x85EBCA6B)
+    x = x ^ (x >> u(13))
+    x = x * u(0xC2B2AE35)
+    x = x ^ (x >> u(16))
+    threshold = u(min(int(rate * 4294967296.0), 4294967295))
+    return x >= threshold          # keep with prob 1 - rate
+
+
+def _block_coords(i, j, bq, bk):
+    row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+    col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+    return row, col
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, causal, sm_scale, nk, bq, bk):
+def _fwd_kernel(*refs, causal, sm_scale, nk, bq, bk, rate, has_mask):
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    mask_ref = next(it) if has_mask else None
+    seed_ref = next(it) if rate > 0 else None
+    o_ref = next(it)
+    lse_ref = next(it)
+    acc_ref = next(it)
+    m_ref = next(it)
+    l_ref = next(it)
+
+    b = pl.program_id(0)
     i = pl.program_id(1)   # q block
     j = pl.program_id(2)   # k block
 
@@ -53,10 +95,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if has_mask:
+            s = s + mask_ref[0].astype(jnp.float32)
+            s = jnp.maximum(s, _NEG_INF)
 
+        row, col = _block_coords(i, j, bq, bk)
         if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
-            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
             s = jnp.where(row >= col, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                                   # (bq, 1)
@@ -65,10 +109,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                                  # (bq, bk)
+        # fully-masked rows: m_new == _NEG_INF makes p == 1; kill explicitly
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
 
+        if rate > 0:
+            keep = _dropout_keep(seed_ref[0], b, row, col, rate)
+            p_acc = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            p_acc = p
         pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -79,8 +130,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     @pl.when(j == last_j)
     def _finalize():
         l = l_ref[:, :1]
-        # causal with bq == bk guarantees every row saw >= 1 valid column,
-        # but guard anyway so fully-masked rows emit 0, not NaN
+        # guard fully-masked rows so they emit 0, not NaN
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         # lse is stored with a broadcast 128-lane trailing dim: TPU block
@@ -90,22 +140,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _mha_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _mask_index_map(H, Hm, Bm):
+    """Flattened-mask block index for flattened q index b (= batch*H + h)."""
+    def idx(b, i, j):
+        mb = (b // H if Bm > 1 else 0) * Hm + ((b % H) if Hm > 1 else 0)
+        return (mb, i, j)
+    return idx
+
+
+def _kv_index_map(H, Hk, which):
+    g = H // Hk
+
+    def idx(b, i, j):
+        kv_b = (b // H) * Hk + (b % H) // g
+        return (kv_b, j, 0) if which == "kv" else (kv_b, i, 0)
+    return idx
+
+
+def _mha_forward(q, k, v, mask, seed, causal, sm_scale, block_q, block_k,
+                 interpret, H, Hk, mask_dims):
     BH, S, D = q.shape
     nq = S // block_q
     nk = S // block_k
     grid = (BH, nq, nk)
+    rate = 0.0 if seed is None else seed[1]
+    has_mask = mask is not None
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), _kv_index_map(H, Hk, "kv")),
+        pl.BlockSpec((1, block_k, D), _kv_index_map(H, Hk, "kv")),
+    ]
+    operands = [q, k, v]
+    if has_mask:
+        Bm, Hm = mask_dims
+        in_specs.append(pl.BlockSpec((1, block_q, block_k),
+                                     _mask_index_map(H, Hm, Bm)))
+        operands.append(mask)
+    if rate > 0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(seed[0])
 
     kernel = functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
-                               nk=nk, bq=block_q, bk=block_k)
+                               nk=nk, bq=block_q, bk=block_k, rate=rate,
+                               has_mask=has_mask)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
@@ -120,7 +202,7 @@ def _mha_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -128,8 +210,36 @@ def _mha_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, causal, sm_scale, nk, bq, bk):
+def _recompute_p(q, k, mask_ref, lse, i, j, bq, bk, causal, sm_scale,
+                 has_mask):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if has_mask:
+        s = s + mask_ref[0].astype(jnp.float32)
+        s = jnp.maximum(s, _NEG_INF)
+    row, col = _block_coords(i, j, bq, bk)
+    if causal:
+        s = jnp.where(row >= col, s, _NEG_INF)
+    p = jnp.exp(s - lse)
+    p = jnp.where(s <= _NEG_INF * 0.5, 0.0, p)
+    return p, row, col
+
+
+def _dq_kernel(*refs, causal, sm_scale, nk, bq, bk, rate, has_mask):
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    do_ref = next(it)
+    lse_ref = next(it)
+    delta_ref = next(it)
+    mask_ref = next(it) if has_mask else None
+    seed_ref = next(it) if rate > 0 else None
+    dq_ref = next(it)
+    acc_ref = next(it)
+
+    b = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -148,18 +258,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         lse = lse_ref[0][:, :1]                                  # (bq, 1)
         delta = delta_ref[0][:, :1]
 
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        p = jnp.exp(s - lse)                                     # (bq, bk)
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
-            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            p = jnp.where(row >= col, p, 0.0)
-
+        p, row, col = _recompute_p(q, k, mask_ref, lse, i, j, bq, bk,
+                                   causal, sm_scale, has_mask)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if rate > 0:
+            keep = _dropout_keep(seed_ref[0], b, row, col, rate)
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
         ds = p * (dp - delta) * sm_scale                         # (bq, bk)
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -172,9 +278,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc,
-                *, causal, sm_scale, nq, bq, bk):
+def _dkv_kernel(*refs, causal, sm_scale, nq, bq, bk, rate, has_mask):
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    do_ref = next(it)
+    lse_ref = next(it)
+    delta_ref = next(it)
+    mask_ref = next(it) if has_mask else None
+    seed_ref = next(it) if rate > 0 else None
+    dk_ref = next(it)
+    dv_ref = next(it)
+    dk_acc = next(it)
+    dv_acc = next(it)
+
+    b = pl.program_id(0)
     j = pl.program_id(1)   # k block
     i = pl.program_id(2)   # q block
 
@@ -196,23 +315,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
 
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
-        p = jnp.exp(s - lse)                                     # (bq, bk)
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
-            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            p = jnp.where(row >= col, p, 0.0)
+        p, row, col = _recompute_p(q, k, mask_ref, lse, i, j, bq, bk,
+                                   causal, sm_scale, has_mask)
+        if rate > 0:
+            keep = _dropout_keep(seed_ref[0], b, row, col, rate)
+            p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            p_drop = p
 
-        # dV += P^T @ dO   (contract over q rows)
+        # dV += P_drop^T @ dO   (contract over q rows)
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if rate > 0:
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
         ds = p * (dp - delta) * sm_scale
         # dK += dS^T @ Q
         dk_acc[:] += jax.lax.dot_general(
@@ -225,49 +345,86 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
-                  interpret):
+def _mha_backward(q, k, v, o, lse, do, mask, seed, causal, sm_scale,
+                  block_q, block_k, interpret, H, Hk, mask_dims):
     BH, S, D = q.shape
     nq = S // block_q
     nk = S // block_k
+    rate = 0.0 if seed is None else seed[1]
+    has_mask = mask is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)
     delta = jnp.broadcast_to(delta, (BH, S, _LANE))
 
+    def specs(order):
+        base = [
+            pl.BlockSpec((1, block_q, D), order("q")),
+            pl.BlockSpec((1, block_k, D), _kv_index_map(H, Hk, "kv")),
+            pl.BlockSpec((1, block_k, D), _kv_index_map(H, Hk, "kv")),
+            pl.BlockSpec((1, block_q, D), order("q")),
+            pl.BlockSpec((1, block_q, _LANE), order("q")),
+            pl.BlockSpec((1, block_q, _LANE), order("q")),
+        ]
+        if has_mask:
+            Bm, Hm = mask_dims
+            m_idx = _mask_index_map(H, Hm, Bm)
+            base.append(pl.BlockSpec((1, block_q, block_k),
+                                     lambda b, x, y: m_idx(
+                                         b, *order.qk(x, y))))
+        if rate > 0:
+            base.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        return base
+
+    operands = [q, k, v, do, lse, delta]
+    if has_mask:
+        operands.append(mask)
+    if rate > 0:
+        operands.append(seed[0])
+
+    class _DqOrder:
+        @staticmethod
+        def __call__(which):
+            return lambda b, i, j: (b, i, 0)
+
+        @staticmethod
+        def qk(i, j):
+            return (i, j)
+    dq_order = _DqOrder()
+
     dq_kernel = functools.partial(_dq_kernel, causal=causal,
                                   sm_scale=sm_scale, nk=nk,
-                                  bq=block_q, bk=block_k)
+                                  bq=block_q, bk=block_k, rate=rate,
+                                  has_mask=has_mask)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=specs(dq_order),
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*operands)
+
+    class _DkvOrder:
+        # grid is (b, j, i): q-indexed tensors use the LAST grid axis
+        @staticmethod
+        def __call__(which):
+            return lambda b, j, i: (b, i, 0)
+
+        @staticmethod
+        def qk(j, i):
+            return (i, j)
+    dkv_order = _DkvOrder()
 
     dkv_kernel = functools.partial(_dkv_kernel, causal=causal,
                                    sm_scale=sm_scale, nq=nq,
-                                   bq=block_q, bk=block_k)
+                                   bq=block_q, bk=block_k, rate=rate,
+                                   has_mask=has_mask)
+    # dk/dv are per Q-head; GQA reduces over the head group outside
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=specs(dkv_order),
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -281,7 +438,7 @@ def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*operands)
     return dq, dk, dv
 
 
@@ -289,44 +446,79 @@ def _mha_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
 # public custom-vjp entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                      interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, seed_arr, rate, causal, sm_scale, block_q, block_k,
+           interpret):
+    return _flash_fwd(q, k, v, mask, seed_arr, rate, causal, sm_scale,
+                      block_q, block_k, interpret)[0]
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, mask, seed_arr, rate, causal, sm_scale, block_q,
+               block_k, interpret):
     B, H, S, D = q.shape
+    Hk = k.shape[1]
     qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
-    o, lse = _mha_forward(qf, kf, vf, causal, sm_scale, block_q, block_k,
-                          interpret)
-    return o.reshape(B, H, S, D), (qf, kf, vf, o, lse, (B, H, S, D))
+    kf = k.reshape(B * Hk, S, D)
+    vf = v.reshape(B * Hk, S, D)
+    mf, mask_dims = _flatten_mask(mask, B, H)
+    seed = None if rate == 0.0 else (seed_arr, rate)
+    o, lse = _mha_forward(qf, kf, vf, mf, seed, causal, sm_scale,
+                          block_q, block_k, interpret, H, Hk, mask_dims)
+    return o.reshape(B, H, S, D), (qf, kf, vf, mf, seed_arr, o, lse,
+                                   (B, H, Hk, S, D), mask_dims)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    qf, kf, vf, o, lse, (B, H, S, D) = res
+def _flash_bwd(rate, causal, sm_scale, block_q, block_k, interpret,
+               res, g):
+    qf, kf, vf, mf, seed_arr, o, lse, (B, H, Hk, S, D), mask_dims = res
+    seed = None if rate == 0.0 else (seed_arr, rate)
     do = g.reshape(B * H, S, D)
-    dq, dk, dv = _mha_backward(qf, kf, vf, o, lse, do, causal, sm_scale,
-                               block_q, block_k, interpret)
-    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
-            dv.reshape(B, H, S, D))
+    dq, dk, dv = _mha_backward(qf, kf, vf, o, lse, do, mf, seed, causal,
+                               sm_scale, block_q, block_k, interpret,
+                               H, Hk, mask_dims)
+    dq = dq.reshape(B, H, S, D)
+    if Hk != H:
+        g_sz = H // Hk
+        dk = dk.reshape(B, Hk, g_sz, S, D).sum(axis=2)
+        dv = dv.reshape(B, Hk, g_sz, S, D).sum(axis=2)
+    else:
+        dk = dk.reshape(B, H, S, D)
+        dv = dv.reshape(B, H, S, D)
+    return (dq, dk, dv, None, None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None,
+def _flatten_mask(mask, B, H):
+    if mask is None:
+        return None, (1, 1)
+    while mask.ndim < 4:
+        mask = mask[None]
+    Bm = mask.shape[0]
+    Hm = mask.shape[1]
+    if Bm not in (1, B) or Hm not in (1, H):
+        raise ValueError(f"mask shape {mask.shape} does not broadcast to "
+                         f"(B={B}, H={H}, S, S)")
+    return mask.reshape(Bm * Hm, *mask.shape[2:]), (Bm, Hm)
+
+
+def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
+                    dropout_rate=0.0, dropout_seed=None,
                     block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
                     interpret=None):
-    """Flash attention over (B, H, S, D) tensors.
+    """Flash attention over (B, H, S, D) q and (B, Hk, S, D) k/v.
 
-    S must be a multiple of the block size. On non-TPU backends the kernels
-    run in Pallas interpret mode (numerically identical, slower) unless
-    `interpret` is given explicitly.
+    mask: additive, broadcastable from (B|1, H|1, S, S). dropout_rate with
+    dropout_seed (int32 scalar/array) drops attention probabilities with the
+    keep mask derived from absolute coordinates (regenerated in backward).
+    Hk may divide H (GQA/MQA). S must be a multiple of the block size. On
+    non-TPU backends the kernels run in Pallas interpret mode.
     """
     B, H, S, D = q.shape
+    Hk = k.shape[1]
+    if H % Hk:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hk}")
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
     block_q = min(block_q, S)
@@ -338,5 +530,10 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
         raise ValueError("causal masking requires block_q == block_k")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, float(sm_scale), block_q, block_k,
-                  interpret)
+    rate = float(dropout_rate)
+    if rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed_arr = (jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+                if rate > 0.0 else jnp.zeros((1,), jnp.int32))
+    return _flash(q, k, v, mask, seed_arr, rate, causal, float(sm_scale),
+                  block_q, block_k, interpret)

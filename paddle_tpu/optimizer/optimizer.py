@@ -33,6 +33,9 @@ class Optimizer:
         # name of the param currently being updated (set by step() /
         # apply_gradients_functional; read by decay-exclusion rules)
         self._current_param_name = None
+        # per-param jitted update rules (eager fast path): name-dependent
+        # decay decisions bind at trace time, so the cache is per parameter
+        self._jitted_updates = {}
 
     # ------------------------------------------------------------------ lr
     def get_lr(self):
@@ -77,10 +80,19 @@ class Optimizer:
             if g is None:
                 continue
             gd = g._data if isinstance(g, Tensor) else g
-            gd = self._apply_decay(p, gd)
             state = self._state_for(p)
             self._current_param_name = p.name or f"param_{i}"
-            new_p, new_state = self._update(p._data, gd, state, lr_t)
+            runner = self._jitted_updates.get(id(p))
+            if runner is None:
+                # one compiled decay+update program per parameter; jit's own
+                # cache handles re-compilation if shapes ever change
+                def _make(p=p):
+                    def f(pd, gd_, st, lr):
+                        return self._update(pd, self._apply_decay(p, gd_, pd),
+                                            st, lr)
+                    return jax.jit(f)
+                runner = self._jitted_updates[id(p)] = _make()
+            new_p, new_state = runner(p._data, gd, state, lr_t)
             p._data = new_p
             self._accumulators[id(p)] = new_state
 
@@ -95,13 +107,17 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
-    def _apply_decay(self, p, gd):
-        """L2 regularizer folded into grads (non-decoupled; AdamW overrides)."""
+    def _apply_decay(self, p, gd, pd=None):
+        """L2 regularizer folded into grads (non-decoupled; AdamW overrides).
+        `pd` is the param value to use (pass the traced value under jit —
+        p._data would bake a stale constant into the compiled update)."""
         reg = p.regularizer if getattr(p, "regularizer", None) is not None \
             else self._weight_decay
         if reg is None or self._decoupled_decay():
             return gd
-        return gd + reg.coeff * p._data if hasattr(reg, "coeff") else gd
+        if pd is None:
+            pd = p._data
+        return gd + reg.coeff * pd if hasattr(reg, "coeff") else gd
 
     def _decoupled_decay(self):
         return False

@@ -142,3 +142,67 @@ class TestFunctionalGrads:
         onehot = np.eye(5)[labels]
         np.testing.assert_allclose(x.grad.numpy(), (p - onehot) / 4,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestHooksAndDoubleGrad:
+    """register_hook + create_graph double grad (VERDICT r1 item 9;
+    reference: imperative/hooks.h, eager/general_grad.h)."""
+
+    def test_register_hook_scales_grad(self):
+        x = paddle.to_tensor(np.array([1., 2., 3.], np.float32),
+                             stop_gradient=False)
+        seen = []
+        h = x.register_hook(lambda g: seen.append(g.numpy()) or g * 2)
+        (x * x).sum().backward()
+        np.testing.assert_allclose(seen[0], [2., 4., 6.])
+        np.testing.assert_allclose(x.grad.numpy(), [4., 8., 12.])
+        h.remove()
+        x.clear_grad()
+        (x * x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2., 4., 6.])
+
+    def test_hook_on_intermediate(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * 3.0
+        y.register_hook(lambda g: g * 10.0)
+        (y * y).backward()          # dy = 2y = 12 -> hook -> 120 -> dx = 360
+        np.testing.assert_allclose(x.grad.numpy(), [360.])
+
+    def test_hook_on_stop_gradient_raises(self):
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        with pytest.raises(RuntimeError):
+            x.register_hook(lambda g: g)
+
+    def test_double_grad_gradient_penalty(self):
+        import paddle_tpu.autograd as pag
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (g,) = pag.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.])
+        (g * g).sum().backward()    # d/dx 9x^4 = 36 x^3
+        np.testing.assert_allclose(x.grad.numpy(), [288.])
+
+    def test_double_grad_matmul_matches_jax(self):
+        import jax
+        import paddle_tpu.autograd as pag
+        rng = np.random.RandomState(0)
+        xv = rng.rand(3, 4).astype("float32")
+        Wv = rng.rand(4, 2).astype("float32")
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        W = paddle.to_tensor(Wv, stop_gradient=False)
+        (gx,) = pag.grad(((x @ W) ** 2).sum(), x, create_graph=True)
+        (gx ** 2).sum().backward()
+        gfn = jax.grad(lambda xx: ((xx @ Wv) ** 2).sum())
+        pfn = jax.grad(lambda xx: (gfn(xx) ** 2).sum())
+        np.testing.assert_allclose(x.grad.numpy(), np.asarray(pfn(xv)),
+                                   rtol=1e-4)
+
+    def test_grad_no_create_graph_side_effect_free(self):
+        import paddle_tpu.autograd as pag
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        (g,) = pag.grad(x * x, x)
+        np.testing.assert_allclose(g.numpy(), [6.])
+        assert x.grad is None

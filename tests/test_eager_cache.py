@@ -1,0 +1,89 @@
+"""Eager per-op executable cache (SURVEY §7 hard part #1; VERDICT r1 item 6).
+
+The reference's eager C++ fast path exists to make per-op dispatch cheap
+(paddle/fluid/eager/api/generated/...); the TPU-native equivalent caches one
+jit wrapper per op identity so repeated eager ops run compiled executables
+instead of re-tracing jax.vjp per call (core/tensor.py apply_op).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core import tensor as T
+
+
+def _train(steps):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 128),
+                        nn.ReLU(), nn.Linear(128, 10))
+    o = opt.Adam(1e-3, parameters=net.parameters())
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(32, 64).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, 32))
+    for _ in range(3):
+        l = lf(net(x), y)
+        l.backward()
+        o.step()
+        o.clear_grad()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l = lf(net(x), y)
+        l.backward()
+        o.step()
+        o.clear_grad()
+        float(l)
+    return (time.perf_counter() - t0) / steps, float(l)
+
+
+@pytest.fixture
+def cache_toggle():
+    prev = T.eager_op_cache_enabled
+    yield
+    T.eager_op_cache_enabled = prev
+    T._EAGER_CACHE.clear()
+
+
+def test_cached_eager_matches_uncached_and_is_faster(cache_toggle):
+    T.eager_op_cache_enabled = False
+    T._EAGER_CACHE.clear()
+    dt_off, loss_off = _train(20)
+    T.eager_op_cache_enabled = True
+    T._EAGER_CACHE.clear()
+    dt_on, loss_on = _train(20)
+    assert abs(loss_off - loss_on) < 1e-5
+    speedup = dt_off / dt_on
+    # measured ~13x on an idle machine; assert conservatively for CI noise
+    assert speedup > 4.0, f"eager cache speedup only {speedup:.1f}x"
+
+
+def test_cache_hits_accumulate(cache_toggle):
+    T.eager_op_cache_enabled = True
+    T._EAGER_CACHE.clear()
+    h0 = T._CACHE_STATS["hits"]
+    m0 = T._CACHE_STATS["misses"]
+    a = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    for _ in range(5):
+        (a * 2.0 + 1.0).sum().backward()
+        a.clear_grad()
+    assert T._CACHE_STATS["hits"] > h0
+    # steady state: no new misses after the first iteration's traces
+    m_mid = T._CACHE_STATS["misses"]
+    (a * 2.0 + 1.0).sum().backward()
+    assert T._CACHE_STATS["misses"] == m_mid
+
+
+def test_distinct_bound_defaults_do_not_collide(cache_toggle):
+    # lambdas sharing __code__ but differing in bound defaults (the split()
+    # pattern) must not share a cache entry
+    T.eager_op_cache_enabled = True
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    parts = paddle.split(x, 3, axis=1)
+    assert [p.shape for p in parts] == [[2, 1], [2, 1], [2, 1]]
+    parts = paddle.split(x, [1, 2], axis=1)
+    assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+    np.testing.assert_allclose(parts[1].numpy(), x.numpy()[:, 1:3])
