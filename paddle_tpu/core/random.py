@@ -12,17 +12,27 @@ import numpy as np
 
 
 class Generator:
+    """Base keys are materialised lazily: constructing a Generator (and hence
+    importing paddle_tpu, which builds the default one below) must not
+    initialize the accelerator backend — `jax.random.key` does."""
+
     def __init__(self, seed_=0):
         self.manual_seed(seed_)
 
     def manual_seed(self, s):
         self._seed = int(s)
-        self._base_key = jax.random.key(self._seed)
+        self._base_key = None
         self._counter = 0
         return self
 
+    @property
+    def base_key(self):
+        if self._base_key is None:
+            self._base_key = jax.random.key(self._seed)
+        return self._base_key
+
     def next_key(self):
-        k = jax.random.fold_in(self._base_key, self._counter)
+        k = jax.random.fold_in(self.base_key, self._counter)
         self._counter += 1
         return k
 
@@ -31,7 +41,7 @@ class Generator:
 
     def set_state(self, state):
         self._seed, self._counter = state
-        self._base_key = jax.random.key(self._seed)
+        self._base_key = None
         return self
 
 

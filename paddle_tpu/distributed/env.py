@@ -45,12 +45,17 @@ def init_parallel_env():
     # Multi-host bootstrap: honor both paddle-style and jax-style env vars.
     n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM",
                                  os.environ.get("JAX_PROCESS_COUNT", "1")))
-    if n_procs > 1 and jax.process_count() == 1:
+    if n_procs > 1:
+        # NOTE: jax.distributed.initialize must run before ANYTHING that
+        # initializes the XLA backend — including jax.process_count()/
+        # jax.devices() — so the already-initialized check uses the
+        # coordination-service client state, not a device query.
         coord = os.environ.get("PADDLE_MASTER",
                                os.environ.get("JAX_COORDINATOR_ADDRESS"))
         pid = int(os.environ.get("PADDLE_TRAINER_ID",
                                  os.environ.get("JAX_PROCESS_ID", "0")))
-        if coord:
+        already = getattr(jax._src.distributed.global_state, "client", None)
+        if coord and already is None:
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=n_procs, process_id=pid)
     if _global_mesh is None:

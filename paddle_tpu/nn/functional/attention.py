@@ -63,7 +63,53 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     return apply_op(fn, *args)
 
 
-def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
-    raise NotImplementedError(
-        "sparse_attention: use scaled_dot_product_attention or ring attention "
-        "(paddle_tpu.distributed.ring_attention) on TPU")
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """paddle.nn.functional.sparse_attention — CSR-restricted attention.
+
+    Reference: python/paddle/nn/functional/sparse_attention.py (CUDA-only
+    sparse kernel, paddle/fluid/operators/sparse_attention_op.cu). q/k/v are
+    (B, H, S, D); sparse_csr_offset (B, H, S+1) and sparse_csr_columns
+    (B, H, nnz) name, per query row, the key columns it may attend to.
+
+    TPU-first design: the CSR pattern is expanded to a dense boolean mask and
+    the whole thing runs as one masked MXU matmul + softmax. On TPU, gather/
+    scatter sparsity loses to dense compute unless density is ~1% — the
+    patterns this API serves (sliding window + global tokens) are far denser,
+    and XLA fuses the mask into the softmax so no S×S float tensor persists.
+    Gradients flow through q/k/v via the same masked path.
+    """
+    has_kpm = key_padding_mask is not None
+    has_am = attn_mask is not None
+
+    def fn(q, k, v, off, cols, *rest):
+        B, H, S, D = q.shape
+        nnz = cols.shape[-1]
+        scale = 1.0 / (D ** 0.5)
+
+        def one(off1, cols1):
+            rows = jnp.searchsorted(off1, jnp.arange(nnz, dtype=off1.dtype),
+                                    side="right") - 1
+            return jnp.zeros((S, S), bool).at[rows, cols1].set(True)
+
+        allowed = jax.vmap(one)(off.reshape(B * H, S + 1),
+                                cols.reshape(B * H, nnz)).reshape(B, H, S, S)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        rest = list(rest)
+        if has_kpm:
+            kpm = rest.pop(0)           # (B, S); 0 => masked key
+            allowed = allowed & (kpm != 0)[:, None, None, :]
+        if has_am:
+            am = rest.pop(0)            # (S, S); 0 => masked
+            allowed = allowed & (am != 0)[None, None]
+        s = jnp.where(allowed, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p).astype(q.dtype)  # all-masked rows
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    args = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    if key_padding_mask is not None:
+        args.append(key_padding_mask)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply_op(fn, *args)

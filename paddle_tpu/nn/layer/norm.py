@@ -218,6 +218,52 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """paddle.nn.SpectralNorm: forward(weight) -> weight / sigma_max.
+
+    Reference semantics: python/paddle/fluid/layers/nn.py:3866 +
+    phi spectral_norm kernel — reshape weight to (h, w) with `dim` leading,
+    run `power_iters` rounds of u/v power iteration (no gradient through the
+    iteration), sigma = u^T W v. Matching the reference kernel, the stored
+    weight_u/weight_v are COPIED, not updated: the same weight gives the
+    identical output on every forward.
+    """
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm layer is not implemented yet")
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        self._shape = tuple(int(s) for s in weight_shape)
+        h = self._shape[dim]
+        w = 1
+        for i, s in enumerate(self._shape):
+            if i != dim:
+                w *= s
+        from ...core.random import next_key
+        ku, kv = jax.random.split(next_key())
+        self.register_buffer("weight_u", Tensor(jax.random.normal(ku, (h,))))
+        self.register_buffer("weight_v", Tensor(jax.random.normal(kv, (w,))))
+
+    def forward(self, weight):
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def fn(wt, u, v):
+            perm = [dim] + [d for d in range(wt.ndim) if d != dim]
+            mat = jnp.transpose(wt, perm).reshape(wt.shape[dim], -1)
+
+            def body(_, uv):
+                u, v = uv
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+                return (u, v)
+
+            u, v = jax.lax.fori_loop(0, iters, body, (u, v))
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = jnp.sum(u * (mat @ v))
+            return wt / sigma, u, v
+
+        out, _, _ = apply_op(fn, weight, self.weight_u, self.weight_v)
+        return out

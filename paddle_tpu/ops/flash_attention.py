@@ -46,12 +46,14 @@ def _ref_attention_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _use_pallas(q):
-    """q here is always (B, H, S, D) — both callers transpose first."""
+def _use_pallas(q, k):
+    """q/k here are always (B, H, S, D) — both callers transpose first."""
     if jax.default_backend() != "tpu":
         return False
     B, H, S, D = q.shape
-    return S % 128 == 0 and D in (64, 128, 256)
+    # the Pallas kernel assumes one S for q and k/v; cross-length attention
+    # (e.g. sequence-parallel q over gathered full-length k/v) falls back
+    return S == k.shape[2] and S % 128 == 0 and D in (64, 128, 256)
 
 
 def _pallas_flash_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
@@ -81,7 +83,7 @@ def flash_attention_bhsd(q, k, v, causal=False, scale=None, mask=None,
     """q: (B, H, S, D); k/v: (B, Hk, S, D) (GPT-internal layout)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if _use_pallas(q):
+    if _use_pallas(q, k):
         return _pallas_flash_bhsd(q, k, v, causal, scale, mask,
                                   dropout_rate, dropout_seed)
     return _ref_attention_bhsd(q, k, v, causal, scale, mask,

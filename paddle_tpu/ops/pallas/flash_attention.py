@@ -357,10 +357,17 @@ def _mha_backward(q, k, v, o, lse, do, mask, seed, causal, sm_scale,
     delta = jnp.broadcast_to(delta, (BH, S, _LANE))
 
     def specs(order):
+        # _kv_index_map is written for logical (b, i, j); grid order differs
+        # between the dq call (b, i, j) and the dkv call (b, j, i), so route
+        # the grid counters through order.qk exactly like the mask spec does.
+        kv_idx = _kv_index_map(H, Hk, "kv")
+
+        def kv_map(b, x, y):
+            return kv_idx(b, *order.qk(x, y))
         base = [
             pl.BlockSpec((1, block_q, D), order("q")),
-            pl.BlockSpec((1, block_k, D), _kv_index_map(H, Hk, "kv")),
-            pl.BlockSpec((1, block_k, D), _kv_index_map(H, Hk, "kv")),
+            pl.BlockSpec((1, block_k, D), kv_map),
+            pl.BlockSpec((1, block_k, D), kv_map),
             pl.BlockSpec((1, block_q, D), order("q")),
             pl.BlockSpec((1, block_q, _LANE), order("q")),
             pl.BlockSpec((1, block_q, _LANE), order("q")),

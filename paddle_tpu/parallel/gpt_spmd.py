@@ -189,6 +189,30 @@ def _ln(x, w, b, eps=1e-5):
     return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
 
 
+def _allgather_sp_attention(q, k, v, causal=True):
+    """Sequence-parallel attention via all-gather of K/V over the sp axis.
+
+    q/k/v: (B, h_loc, S_loc, d), S_loc = S/sp. K and V are gathered to the
+    full sequence (group-scoped collective — safe inside lax.cond, unlike
+    ppermute) and attention runs locally over the (S_loc, S) tile with the
+    causal mask offset by this shard's global row position.
+    """
+    from ..ops.flash_attention import flash_attention_bhsd
+
+    S_loc = q.shape[2]
+    k_full = jax.lax.all_gather(k, "sp", axis=2, tiled=True)
+    v_full = jax.lax.all_gather(v, "sp", axis=2, tiled=True)
+    mask = None
+    if causal:
+        row0 = jax.lax.axis_index("sp") * S_loc
+        rows = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (S_loc, k_full.shape[2]), 0)
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (S_loc, k_full.shape[2]), 1)
+        mask = jnp.where(rows >= cols, 0.0, -jnp.inf)[None, None]
+    return flash_attention_bhsd(q, k_full, v_full, causal=False, mask=mask)
+
+
 def _attention(h, blk, cfg, plan):
     B, S, _ = h.shape
     heads_loc = cfg.heads // plan.mp
@@ -201,7 +225,16 @@ def _attention(h, blk, cfg, plan):
     q = jnp.moveaxis(qkv[:, :, :, 0], 2, 1)        # (B,h_loc,S,d)
     k = jnp.moveaxis(qkv[:, :, :, 1], 2, 1)
     v = jnp.moveaxis(qkv[:, :, :, 2], 2, 1)
-    if plan.sp > 1:
+    if plan.sp > 1 and plan.pp > 1:
+        # Inside the 1F1B/interleaved tick body, stage compute is gated by
+        # lax.cond on the (t, stage)-dependent tick table. XLA lowers
+        # ppermute to CollectivePermute, a FULL-participation op (every
+        # device must execute it, pairs or not), so the ring's ppermute
+        # inside stage-divergent branches deadlocks the mesh. all_gather and
+        # psum are group-scoped (replica_groups) and legal there, so pp+sp
+        # uses all-gather sequence parallelism instead of the ring.
+        o = _allgather_sp_attention(q, k, v, causal=True)
+    elif plan.sp > 1:
         o = ring_attention(q, k, v, "sp", causal=True)
     else:
         from ..ops.flash_attention import flash_attention_bhsd

@@ -1,4 +1,5 @@
 """Search/sort ops (reference: python/paddle/tensor/search.py)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -114,10 +115,42 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
     return apply_op(fn, x)
 
 
+def _mode_last(a):
+    """Mode over the trailing axis, a: (..., n). Module-level so mode()'s op
+    closure stays cacheable (a per-call inner function would defeat the eager
+    executable cache's code-identity key)."""
+    n = a.shape[-1]
+    srt = jnp.sort(a, axis=-1)
+    lo = jax.vmap(lambda s: jnp.searchsorted(s, s, side="left"))(
+        srt.reshape(-1, n)).reshape(srt.shape)
+    hi = jax.vmap(lambda s: jnp.searchsorted(s, s, side="right"))(
+        srt.reshape(-1, n)).reshape(srt.shape)
+    counts = hi - lo
+    best = jnp.argmax(counts, axis=-1)            # first max => smallest value
+    vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    pos = jnp.arange(n)
+    idx = jnp.argmax(jnp.where(a == vals[..., None], pos, -1), axis=-1)
+    return vals, idx.astype(jnp.int64)
+
+
 def mode(x, axis=-1, keepdim=False, name=None):
-    data = np.asarray(x._data)
-    from scipy import stats  # available via numpy ecosystem; fallback manual
-    raise NotImplementedError("mode is not implemented")
+    """paddle.mode: most frequent value (and its index) along `axis`.
+
+    Reference: paddle/phi/kernels/cpu/mode_kernel.cc. TPU-first shape-static
+    algorithm: sort the axis, get each element's run length via two
+    searchsorted passes (O(n log n), no S×S equality matrix), pick the
+    smallest modal value, then report the index of its last occurrence in the
+    unsorted input (paddle tie-break).
+    """
+    def fn(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = _mode_last(moved)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+    return apply_op(fn, x)
 
 
 def median(x, axis=None, keepdim=False, name=None):

@@ -1,0 +1,100 @@
+"""Worker script for the real multi-process distributed test.
+
+Mirrors the reference's forked-trainer pattern
+(python/paddle/fluid/tests/unittests/test_dist_base.py:792-1029): each
+process rendezvouses through the jax coordination service (the TPU-native
+replacement for TCPStore + ProcessGroupNCCL init, see
+paddle_tpu/distributed/env.py:44-55), then
+
+  (a) runs an 8-way psum across the 2-process global mesh and
+  (b) trains a small MLP data-parallel for 5 steps,
+
+writing {"psum": ..., "losses": [...]} as JSON to the path in argv[4].
+Invoked as: dist_worker.py <process_id> <num_processes> <port> <out.json>
+(num_processes=1 produces the single-process golden on the same 8 devices).
+"""
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+    n_local = 8 // nproc
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count={n_local}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # paddle-style launcher env (exercises the init_parallel_env bootstrap)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nproc)
+    os.environ["PADDLE_TRAINER_ID"] = str(pid)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.distributed.init_parallel_env()
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+    def global_array(np_val, spec):
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(np_val.shape, sh,
+                                            lambda idx: np_val[idx])
+
+    # ---- (a) collective: psum of per-device (rank+1) over all 8 devices
+    ranks = global_array(np.arange(8, dtype=np.float32) + 1, P("dp"))
+
+    @jax.jit
+    def psum_all(x):
+        return shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P())(x)
+
+    psum_val = float(np.asarray(jax.device_get(psum_all(ranks)))[0])
+
+    # ---- (b) DP training: MLP on a fixed global batch, grads psum'd over dp
+    rng = np.random.RandomState(0)
+    W1 = global_array(rng.randn(16, 32).astype(np.float32) * 0.1, P())
+    W2 = global_array(rng.randn(32, 1).astype(np.float32) * 0.1, P())
+    X = global_array(rng.randn(64, 16).astype(np.float32), P("dp"))
+    Y = global_array(rng.randn(64, 1).astype(np.float32), P("dp"))
+
+    def local_step(w1, w2, x, y):
+        def loss_fn(w1, w2):
+            h = jnp.tanh(x @ w1)
+            return jnp.mean((h @ w2 - y) ** 2)
+
+        l, (g1, g2) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w1, w2)
+        l = jax.lax.pmean(l, "dp")
+        g1 = jax.lax.pmean(g1, "dp")
+        g2 = jax.lax.pmean(g2, "dp")
+        return l, w1 - 0.1 * g1, w2 - 0.1 * g2
+
+    step = jax.jit(shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P())))
+
+    losses = []
+    for _ in range(5):
+        loss, W1, W2 = step(W1, W2, X, Y)
+        losses.append(float(np.asarray(jax.device_get(loss))))
+
+    with open(out_path, "w") as f:
+        json.dump({"psum": psum_val, "losses": losses,
+                   "process_count": jax.process_count()}, f)
+
+
+if __name__ == "__main__":
+    main()

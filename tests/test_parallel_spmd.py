@@ -84,6 +84,20 @@ def test_hybrid_sharding_mp(golden):
     np.testing.assert_allclose(losses, golden, rtol=5e-4)
 
 
+def test_hybrid_pp_sp(golden):
+    """pp×sp: the 1F1B tick body gates stage compute with lax.cond, where
+    ppermute (a full-participation CollectivePermute) would deadlock — this
+    combo must route attention through group-scoped all_gather (r3 fix)."""
+    losses = run_steps(MeshPlan(pp=2, sp=2, dp=2, microbatches=2))
+    np.testing.assert_allclose(losses, golden, rtol=5e-4)
+
+
+def test_hybrid_pp_sp_vpp(golden):
+    """pp×sp×vpp: interleaved schedule + sequence parallelism."""
+    losses = run_steps(MeshPlan(pp=2, sp=2, dp=2, microbatches=4, vpp=2))
+    np.testing.assert_allclose(losses, golden, rtol=5e-4)
+
+
 def test_ring_attention_unit():
     """ring attention == full causal attention on sequence shards."""
     from paddle_tpu.parallel.ring_attention import ring_attention

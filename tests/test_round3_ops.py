@@ -1,0 +1,133 @@
+"""Ops closed in round 3: mode, SpectralNorm, sparse_attention.
+
+Reference tests mirrored: test_mode_op.py, test_spectral_norm_op.py,
+test_sparse_attention_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------- mode
+
+@pytest.mark.parametrize("axis,keepdim", [(-1, False), (0, True), (1, False)])
+def test_mode_matches_numpy(axis, keepdim):
+    rng = np.random.RandomState(0)
+    # small integer values force repeated entries
+    x = rng.randint(0, 4, (5, 6, 7)).astype("float32")
+    vals, idx = paddle.mode(paddle.to_tensor(x), axis=axis, keepdim=keepdim)
+    vals, idx = np.asarray(vals.numpy()), np.asarray(idx.numpy())
+
+    moved = np.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    ref_vals = np.empty(flat.shape[0], dtype=x.dtype)
+    ref_idx = np.empty(flat.shape[0], dtype=np.int64)
+    for r, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]           # smallest modal value
+        ref_vals[r] = v
+        ref_idx[r] = np.where(row == v)[0][-1]  # last occurrence
+    shape = moved.shape[:-1]
+    ref_vals = ref_vals.reshape(shape)
+    ref_idx = ref_idx.reshape(shape)
+    if keepdim:
+        ref_vals = np.expand_dims(ref_vals, axis)
+        ref_idx = np.expand_dims(ref_idx, axis)
+    np.testing.assert_allclose(vals, ref_vals)
+    np.testing.assert_array_equal(idx, ref_idx)
+
+
+# ---------------------------------------------------------------- SpectralNorm
+
+def _np_spectral_norm(weight, u, v, dim, power_iters, eps):
+    # mirror of the reference op-test math (test_spectral_norm_op.py:26)
+    shape = weight.shape
+    h = shape[dim]
+    perm = [dim] + [d for d in range(len(shape)) if d != dim]
+    mat = weight.transpose(perm).reshape(h, -1)
+    u = u.reshape(h, 1).copy()
+    v = v.reshape(-1, 1).copy()
+    for _ in range(power_iters):
+        v = mat.T @ u
+        v /= np.sqrt((v * v).sum()) + eps
+        u = mat @ v
+        u /= np.sqrt((u * u).sum()) + eps
+    sigma = (u * (mat @ v)).sum()
+    return weight / sigma
+
+
+@pytest.mark.parametrize("dim,shape", [(0, (6, 5)), (1, (3, 4, 2))])
+def test_spectral_norm_layer(dim, shape):
+    rng = np.random.RandomState(1)
+    w = rng.randn(*shape).astype("float32")
+    layer = paddle.nn.SpectralNorm(shape, dim=dim, power_iters=3)
+    u0 = np.asarray(layer.weight_u.numpy()).copy()
+    v0 = np.asarray(layer.weight_v.numpy()).copy()
+    out = layer(paddle.to_tensor(w))
+    ref = _np_spectral_norm(w, u0, v0, dim, 3, 1e-12)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=1e-4, atol=1e-4)
+    # reference kernel copies u/v (never writes back): buffers unchanged and
+    # repeated forwards of the same weight are identical
+    np.testing.assert_array_equal(np.asarray(layer.weight_u.numpy()), u0)
+    out2 = layer(paddle.to_tensor(w))
+    np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                  np.asarray(out2.numpy()))
+
+
+def test_spectral_norm_largest_singular_value_converges():
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 8).astype("float32")
+    layer = paddle.nn.SpectralNorm((8, 8), dim=0, power_iters=50)
+    out = np.asarray(layer(paddle.to_tensor(w)).numpy())
+    # after normalization the top singular value is ~1
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+# ---------------------------------------------------------------- sparse_attention
+
+def _csr_full(S):
+    """CSR pattern allowing everything (dense equivalence check)."""
+    offset = np.arange(S + 1, dtype=np.int32) * S
+    columns = np.tile(np.arange(S, dtype=np.int32), S)
+    return offset, columns
+
+
+def test_sparse_attention_dense_pattern_matches_softmax():
+    B, H, S, D = 1, 2, 8, 4
+    rng = np.random.RandomState(3)
+    q = rng.randn(B, H, S, D).astype("float32")
+    k = rng.randn(B, H, S, D).astype("float32")
+    v = rng.randn(B, H, S, D).astype("float32")
+    off1, col1 = _csr_full(S)
+    off = np.broadcast_to(off1, (B, H, S + 1)).copy()
+    cols = np.broadcast_to(col1, (B, H, col1.size)).copy()
+
+    out = paddle.nn.functional.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(off), paddle.to_tensor(cols))
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_attention_banded_pattern():
+    # band of width 1 (diagonal only): output rows equal v rows exactly
+    B, H, S, D = 1, 1, 6, 4
+    rng = np.random.RandomState(4)
+    q = rng.randn(B, H, S, D).astype("float32")
+    k = rng.randn(B, H, S, D).astype("float32")
+    v = rng.randn(B, H, S, D).astype("float32")
+    offset = np.arange(S + 1, dtype=np.int32).reshape(1, 1, S + 1)
+    columns = np.arange(S, dtype=np.int32).reshape(1, 1, S)
+
+    out = paddle.nn.functional.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offset), paddle.to_tensor(columns))
+    np.testing.assert_allclose(np.asarray(out.numpy()), v,
+                               rtol=1e-5, atol=1e-5)
