@@ -65,10 +65,20 @@ def run_config(B, S, remat, n_steps, on_tpu):
         loss, params, state = step_fn(params, state, toks, labs, lr)
         loss_val = float(loss)          # host fetch = true device sync
 
+    # Timed loop: EVERY step's loss is fetched to the host (each value
+    # data-depends on its whole step, so nothing can be elided), but the
+    # fetch of step i overlaps the dispatch of step i+1 — one step deep.
+    # The timer stops only after the LAST loss reaches the host, which
+    # transitively requires every step to have finished; the ~70ms tunnel
+    # round-trip is paid once instead of per step.
     t0 = time.perf_counter()
+    prev = None
     for _ in range(n_steps):
         loss, params, state = step_fn(params, state, toks, labs, lr)
-        loss_val = float(loss)          # sync EVERY timed step
+        if prev is not None:
+            loss_val = float(prev)
+        prev = loss
+    loss_val = float(prev)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = B * S * n_steps / dt

@@ -1,0 +1,110 @@
+"""Eager (per-op dispatch) training-loop benchmark — SURVEY §7 hard part #1.
+
+The reference's default UX is eager (paddle/fluid/eager/ exists to make
+per-op dispatch fast). Here every eager op goes through the per-op
+executable cache (core/tensor.py): first use compiles one XLA program per
+op, later uses dispatch the cached executable. This benchmark measures the
+end-to-end cost of that dispatch on the CURRENT backend for a small MLP
+train step (fwd + bwd + SGD), against the same math as ONE jit program.
+
+Prints one JSON line:
+  {"metric": "eager_mlp_step_ms", ..., "extra": {"jit_step_ms", "ratio",
+   "cache": {...}}}
+
+Same honest-sync rules as bench.py: a host fetch of a step-dependent value
+closes every timed iteration.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.core.tensor import _CACHE_STATS
+
+    backend = jax.default_backend()
+    B, D, H, C = 256, 64, 256, 8
+    rng = np.random.RandomState(0)
+    x_np = rng.rand(B, D).astype("float32")
+    y_np = rng.randint(0, C, B)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(D, H), nn.ReLU(),
+                        nn.Linear(H, H), nn.ReLU(), nn.Linear(H, C))
+    o = opt.SGD(0.05, parameters=net.parameters())
+    lf = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(x_np)
+    y = paddle.to_tensor(y_np)
+
+    def eager_step():
+        loss = lf(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return float(loss)           # host fetch = sync
+
+    for _ in range(3):               # warmup: fills the per-op cache
+        eager_step()
+    n = int(os.environ.get("BENCH_EAGER_STEPS", 20))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss_val = eager_step()
+    eager_ms = (time.perf_counter() - t0) / n * 1000
+
+    # jit reference: identical math, one compiled program
+    params = {i: (l.weight._data, l.bias._data)
+              for i, l in enumerate(net) if hasattr(l, "weight")}
+
+    @jax.jit
+    def jit_step(params, xj, yj):
+        def loss_fn(params):
+            h = xj
+            ks = sorted(params)
+            for i, k in enumerate(ks):
+                w, b = params[k]
+                h = h @ w + b
+                if i < len(ks) - 1:
+                    h = jax.nn.relu(h)
+            logz = jax.nn.logsumexp(h, axis=-1)
+            picked = jnp.take_along_axis(h, yj[:, None], axis=-1)[:, 0]
+            return jnp.mean(logz - picked)
+
+        l, g = jax.value_and_grad(loss_fn)(params)
+        new = {k: (w - 0.05 * gw, b - 0.05 * gb)
+               for (k, (w, b)), (gw, gb) in
+               zip(params.items(), (g[k] for k in params))}
+        return l, new
+
+    xj = jnp.asarray(x_np)
+    yj = jnp.asarray(y_np)
+    for _ in range(3):
+        l, params = jit_step(params, xj, yj)
+        _ = float(l)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        l, params = jit_step(params, xj, yj)
+        _ = float(l)
+    jit_ms = (time.perf_counter() - t0) / n * 1000
+
+    print(json.dumps({
+        "metric": "eager_mlp_step_ms",
+        "value": round(eager_ms, 2),
+        "unit": "ms per eager train step (fwd+bwd+SGD)",
+        "vs_baseline": round(jit_ms / eager_ms, 4) if eager_ms else 0,
+        "extra": {"jit_step_ms": round(jit_ms, 2),
+                  "eager_over_jit": round(eager_ms / jit_ms, 1),
+                  "backend": backend, "steps": n, "loss": loss_val,
+                  "cache": dict(_CACHE_STATS)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

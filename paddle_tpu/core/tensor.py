@@ -504,12 +504,47 @@ def _cached_bwd(vjp_fn):
     return lambda seed: _BWD_CALL(vjp_fn, seed)
 
 
+def _nan_check_enabled():
+    from ..framework.flags import _FLAGS
+    return _FLAGS.get("FLAGS_check_nan_inf", False)
+
+
+def _check_finite(outs, opname):
+    """FLAGS_check_nan_inf per-op scan (reference: eager/nan_inf_utils.cc,
+    framework/details/nan_inf_utils_detail.cc): raise naming the op the
+    moment any eager output contains NaN/Inf. Debug-only path — each check
+    syncs the device."""
+    out_list = outs if isinstance(outs, tuple) else (outs,)
+    for i, o in enumerate(out_list):
+        d = o._data if isinstance(o, Tensor) else o
+        if isinstance(d, jax.core.Tracer):
+            # inside a jit/shard_map trace bool() would concretize; the
+            # compiled paths have their own guards (GradScaler found_inf)
+            continue
+        if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating):
+            if bool(jnp.logical_or(jnp.isnan(d).any(), jnp.isinf(d).any())):
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: op '{opname or 'unknown'}' "
+                    f"produced NaN/Inf in output {i} (shape {d.shape}, "
+                    f"dtype {d.dtype})")
+    return outs
+
+
 def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
     """Run `fn` over tensor args, recording a tape Node when grads are needed.
 
     `fn` operates on raw jax arrays. Non-Tensor args pass through unchanged.
     Returns Tensor or tuple-of-Tensor mirroring fn's output structure.
     """
+    if _nan_check_enabled():
+        outs = _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
+                               **kwargs)
+        return _check_finite(outs, name or getattr(fn, "__name__", ""))
+    return _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
+                           **kwargs)
+
+
+def _apply_op_inner(fn, *args, n_outputs=None, name="", **kwargs):
     datas = [a._data if isinstance(a, Tensor) else a for a in args]
     diff_idx = [i for i, a in enumerate(args)
                 if isinstance(a, Tensor) and not a.stop_gradient

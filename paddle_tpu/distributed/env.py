@@ -172,6 +172,12 @@ def current_axis_name(kind):
     return _live_axes().get(kind)
 
 
+def in_manual_region():
+    """True when any mesh axis is live-manual (i.e. we are being traced
+    inside a shard_map body)."""
+    return bool(_live_axes())
+
+
 def axis_index(axis_name):
     return jax.lax.axis_index(axis_name)
 

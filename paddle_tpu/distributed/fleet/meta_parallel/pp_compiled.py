@@ -14,10 +14,16 @@ tables as the flagship GPT path (parallel/pipeline_schedule.py):
 - the backward recomputes the stage forward from the parked stage input via
   jax.vjp (stage-granular rematerialization).
 
-Scope/limitations vs the GPT path (parallel/gpt_spmd.py):
-- parameters are REPLICATED across pp rows (compute is pipelined; parameter
-  memory is not sharded). Homogeneous block stacks that want sharded params
-  should use the stacked-layer GPT-style path.
+Parameter ownership (reference parity: parallel_layers/pp_layers.py:211 —
+each pp rank materializes only its own stage): params used by exactly one
+stage are flattened into one (pp, maxP) f32 buffer sharded P('pp'), so each
+device physically holds only its stage's row; the stage branches unflatten
+the local row with their static treedefs. Their gradients come back packed
+the same way — no cross-stage psum. Params reachable from more than one
+stage (SharedLayerDesc embeddings) stay replicated and psum'd, which is also
+the reference's behavior (allreduce_shared_weight_gradients).
+
+Other limitations vs the GPT path (parallel/gpt_spmd.py):
 - inter-stage activations must share one shape/dtype (checked at trace
   time); the last stage's output is unconstrained (it only feeds the loss).
 - buffer mutations inside stage forwards (e.g. BN running stats) are not
@@ -26,10 +32,10 @@ Scope/limitations vs the GPT path (parallel/gpt_spmd.py):
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ....core.tensor import Tensor
-from ....nn.layer.layers import functional_call
+from ....nn.layer.layers import Layer, functional_call
 from ....parallel.pipeline_schedule import (arrival_tables, build_tables,
                                             required_slots)
 
@@ -55,6 +61,24 @@ def _make_stage_fn(pl, s):
     return fn
 
 
+def _param_ownership(pl, pp):
+    """Map every named parameter to the set of stages whose segment contains
+    a layer owning it. Returns (owned, shared): owned[s] = sorted names used
+    ONLY by stage s; shared = sorted names used by 2+ stages."""
+    name_of = {id(p): n for n, p in pl.named_parameters()}
+    stages_of = {}
+    for i, (l, _) in enumerate(pl._built):
+        if isinstance(l, Layer):
+            s = pl.stage_of_layer(i)
+            for p in l.parameters():
+                n = name_of[id(p)]
+                stages_of.setdefault(n, set()).add(s)
+    owned = {s: sorted(n for n, ss in stages_of.items() if ss == {s})
+             for s in range(pp)}
+    shared = sorted(n for n, ss in stages_of.items() if len(ss) > 1)
+    return owned, shared
+
+
 def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
     """Build step(params, buffers, x, y) -> (loss, grads) jit-compiled over
     `mesh` (axes may include 'dp' for data parallelism and must include 'pp'
@@ -67,6 +91,64 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
     if pl._loss_fn is None:
         raise ValueError("PipelineLayer needs loss_fn for the compiled step")
     stage_fns = [_make_stage_fn(pl, s) for s in range(pp)]
+
+    # ---------------- per-stage param packing plan (static) ----------------
+    owned, shared_names = _param_ownership(pl, pp)
+    pspec = {n: (tuple(p.shape), p._data.dtype)
+             for n, p in pl.named_parameters()}
+    layout = {}          # name -> (stage, start, size)
+    stage_sizes = []
+    for s in range(pp):
+        off = 0
+        for n in owned[s]:
+            size = int(np.prod(pspec[n][0])) if pspec[n][0] else 1
+            layout[n] = (s, off, size)
+            off += size
+        stage_sizes.append(off)
+    maxP = max(stage_sizes + [1])
+
+    @jax.jit
+    def _pack_rows(params):
+        """Device-side: params dict -> (pp, maxP) f32 rows (no host copy —
+        the params stay on device; this is a concat+pad program)."""
+        rows = []
+        for s in range(pp):
+            parts = [params[n].reshape(-1).astype(jnp.float32)
+                     for n in owned[s]]
+            row = jnp.concatenate(parts) if parts \
+                else jnp.zeros((0,), jnp.float32)
+            rows.append(jnp.pad(row, (0, maxP - stage_sizes[s])))
+        return jnp.stack(rows)
+
+    def pack(params):
+        """params dict -> (pp, maxP) f32 sharded over 'pp'. device_put of a
+        device-resident array is a resharding, not a host round-trip."""
+        return jax.device_put(_pack_rows(params),
+                              NamedSharding(mesh, P("pp", None)))
+
+    @jax.jit
+    def unpack_grads(rows):
+        """Device-side: (pp, maxP) f32 grads -> {name: array} in each
+        param's dtype (slices of a device array; no host transfer)."""
+        out = {}
+        for n, (s, off, size) in layout.items():
+            shape, dtype = pspec[n]
+            out[n] = rows[s, off:off + size].reshape(shape).astype(dtype)
+        return out
+
+    def own_dict(s, row):
+        return {n: jax.lax.dynamic_slice_in_dim(row, layout[n][1],
+                                                layout[n][2], 0)
+                .reshape(pspec[n][0]).astype(pspec[n][1])
+                for n in owned[s]}
+
+    def flatten_own(s, tree):
+        """Stage-s {name: grad} -> (maxP,) f32."""
+        if not owned[s]:
+            return jnp.zeros((maxP,), jnp.float32)
+        parts = [tree[n].reshape(-1).astype(jnp.float32) for n in owned[s]]
+        flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        return jnp.pad(flat, (0, maxP - stage_sizes[s]))
 
     def loss_raw(out, y):
         l = pl._loss_fn(Tensor(out), Tensor(y))
@@ -85,7 +167,12 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
     data_spec = P("dp") if has_dp else P()
     f32 = jnp.float32
 
-    def sharded(params, buffers, x, y):
+    abstract_params = {n: jax.ShapeDtypeStruct(shape, dtype)
+                       for n, (shape, dtype) in pspec.items()}
+
+    def sharded(prow, shared_params, buffers, x, y):
+        # prow: (1, maxP) local row of the packed per-stage param buffer
+        row = prow[0]
         stage = jax.lax.axis_index("pp")
         is_last = stage == pp - 1
         B_loc = x.shape[0]
@@ -94,9 +181,9 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
         y_mb = y.reshape((M, B_mb) + y.shape[1:])
 
         # inter-stage activation shape: trace stage outputs abstractly
-        act = jax.eval_shape(stage_fns[0], params, buffers, x_mb[0])
+        act = jax.eval_shape(stage_fns[0], abstract_params, buffers, x_mb[0])
         for s in range(1, pp - 1):
-            nxt = jax.eval_shape(stage_fns[s], params, buffers,
+            nxt = jax.eval_shape(stage_fns[s], abstract_params, buffers,
                                  jax.ShapeDtypeStruct(act.shape, act.dtype))
             if nxt.shape != act.shape or nxt.dtype != act.dtype:
                 raise ValueError(
@@ -104,15 +191,26 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
                     f"shape; stage {s} maps {act.shape} -> {nxt.shape}")
         zero_act = jnp.zeros(act.shape, act.dtype)
 
-        def zeros_params():
-            return jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, f32), params)
+        def zeros_shared():
+            return {n: jnp.zeros(pspec[n][0], f32) for n in shared_names}
 
         fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
         bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
 
+        def seg_call(s, own, shared, xin):
+            """Stage forward as a function of (own stage params, shared
+            params) so vjp differentiates exactly the live leaves."""
+            full = dict(shared)
+            for n, (so, off, size) in layout.items():
+                shape, dtype = pspec[n]
+                if so == s:
+                    full[n] = own[n].astype(dtype)
+                else:
+                    full[n] = jnp.zeros(shape, dtype)
+            return stage_fns[s](full, buffers, xin)
+
         def tick(carry, t):
-            buf, gbuf, fchan, gchan, loss_sum, gacc = carry
+            buf, gbuf, fchan, gchan, loss_sum, gacc_row, gacc_sh = carry
             f_idx = fwd_tbl[t, stage]
             b_idx = bwd_tbl[t, stage]
             valid_f = f_idx >= 0
@@ -138,7 +236,8 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
             for s in range(pp - 1):
                 def run_f(s=s):
                     xin = x_mb[fi] if s == 0 else buf[fi % W]
-                    return stage_fns[s](params, buffers, xin).astype(act.dtype)
+                    return seg_call(s, own_dict(s, row), shared_params,
+                                    xin).astype(act.dtype)
                 y_f = y_f + jax.lax.cond(
                     (stage == s) & valid_f, run_f, lambda: zero_act)
 
@@ -147,61 +246,81 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
             g_send = zero_act
             for s in range(pp):
                 def run_b(s=s):
+                    own = own_dict(s, row)
                     if s == pp - 1:
                         xin = buf[bi % W] if s > 0 else x_mb[bi]
 
-                        def head(p, xi):
-                            out = stage_fns[s](p, buffers, xi)
+                        def head(ow, sh, xi):
+                            out = seg_call(s, ow, sh, xi)
                             return loss_raw(out, y_mb[bi])
-                        l, (gp, gx) = jax.value_and_grad(
-                            head, argnums=(0, 1))(params, xin)
-                        return l, gp, gx.astype(act.dtype)
-                    if s == 0:
-                        _, vjp = jax.vjp(
-                            lambda p: stage_fns[s](p, buffers, x_mb[bi]),
-                            params)
-                        (gp,) = vjp(gbuf[bi % W])
-                        return jnp.zeros((), f32), gp, zero_act
+                        l, (go, gs_, gx) = jax.value_and_grad(
+                            head, argnums=(0, 1, 2))(own, shared_params, xin)
+                        return (l, flatten_own(s, go),
+                                {n: gs_[n].astype(f32) for n in shared_names},
+                                gx.astype(act.dtype))
+                    xin = x_mb[bi] if s == 0 else buf[bi % W]
                     _, vjp = jax.vjp(
-                        lambda p, xi: stage_fns[s](p, buffers, xi),
-                        params, buf[bi % W])
-                    gp, gx = vjp(gbuf[bi % W])
-                    return jnp.zeros((), f32), gp, gx.astype(act.dtype)
+                        lambda ow, sh, xi: seg_call(s, ow, sh, xi),
+                        own, shared_params, xin)
+                    go, gs_, gx = vjp(gbuf[bi % W].astype(act.dtype))
+                    if s == 0:
+                        gx = zero_act
+                    return (jnp.zeros((), f32), flatten_own(s, go),
+                            {n: gs_[n].astype(f32) for n in shared_names},
+                            gx.astype(act.dtype))
 
                 def skip_b():
-                    return (jnp.zeros((), f32),
-                            jax.tree_util.tree_map(
-                                lambda p: jnp.zeros(p.shape, p.dtype), params),
-                            zero_act)
+                    return (jnp.zeros((), f32), jnp.zeros((maxP,), f32),
+                            zeros_shared(), zero_act)
 
-                l_s, gp_s, gx_s = jax.lax.cond(
+                l_s, grow_s, gsh_s, gx_s = jax.lax.cond(
                     (stage == s) & valid_b, run_b, skip_b)
                 l_b = l_b + l_s
                 g_send = g_send + gx_s
-                gacc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(f32), gacc, gp_s)
+                gacc_row = gacc_row + grow_s
+                gacc_sh = {n: gacc_sh[n] + gsh_s[n] for n in shared_names}
 
             fchan = jax.lax.ppermute(y_f, "pp", fwd_perm)
             gchan = jax.lax.ppermute(g_send, "pp", bwd_perm)
-            return (buf, gbuf, fchan, gchan, loss_sum + l_b, gacc), None
+            return (buf, gbuf, fchan, gchan, loss_sum + l_b,
+                    gacc_row, gacc_sh), None
 
         carry0 = (jnp.zeros((W,) + act.shape, act.dtype),
                   jnp.zeros((W,) + act.shape, act.dtype),
-                  zero_act, zero_act, jnp.zeros((), f32), zeros_params())
-        (_, _, _, _, loss_sum, gacc), _ = jax.lax.scan(
+                  zero_act, zero_act, jnp.zeros((), f32),
+                  jnp.zeros((maxP,), f32), zeros_shared())
+        (_, _, _, _, loss_sum, gacc_row, gacc_sh), _ = jax.lax.scan(
             tick, carry0, jnp.arange(T))
 
         loss = jax.lax.psum(jnp.where(is_last, loss_sum / M, 0.0), "pp")
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g / M, "pp"), gacc)
+        grow = (gacc_row / M)[None]            # (1, maxP): own-stage grads
+        gsh = {n: jax.lax.psum(g / M, "pp") for n, g in gacc_sh.items()}
         if has_dp:
             loss = jax.lax.pmean(loss, "dp")
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, "dp"), grads)
-        return loss, grads
+            grow = jax.lax.pmean(grow, "dp")
+            gsh = {n: jax.lax.pmean(g, "dp") for n, g in gsh.items()}
+        return loss, grow, gsh
 
     sh = jax.shard_map(
         sharded, mesh=mesh,
-        in_specs=(P(), P(), data_spec, data_spec),
-        out_specs=(P(), P()), check_vma=False)
-    return jax.jit(sh)
+        in_specs=(P("pp", None), P(), P(), data_spec, data_spec),
+        out_specs=(P(), P("pp", None), P()), check_vma=False)
+    jitted = jax.jit(sh)
+
+    def step(params, buffers, x, y):
+        prow = pack(params)
+        shared = {n: params[n] for n in shared_names}
+        loss, grow, gsh = jitted(prow, shared, buffers, x, y)
+        grads = unpack_grads(grow)
+        for n in shared_names:
+            shape, dtype = pspec[n]
+            grads[n] = gsh[n].astype(dtype)
+        return loss, grads
+
+    step.packed_bytes_per_device = maxP * 4
+    step.replicated_param_bytes = sum(
+        int(np.prod(sh_)) * 4 for n, (sh_, _) in pspec.items()
+        if n in shared_names)
+    step.jitted = jitted
+    step.pack = pack
+    return step

@@ -3,3 +3,12 @@ from . import io  # noqa: F401
 from . import flags  # noqa: F401
 from ..core.random import seed  # noqa: F401
 from ..core.tensor import Parameter  # noqa: F401
+
+
+def eager_cache_stats():
+    """Observability for the per-op executable cache (core/tensor.py):
+    hits/misses/bypass counters plus the live entry count. Ops whose
+    closures capture arrays bypass the cache — a high 'bypass' count in an
+    eager loop is the signal to look for such ops."""
+    from ..core import tensor as _t
+    return {**_t._CACHE_STATS, "entries": len(_t._EAGER_CACHE)}

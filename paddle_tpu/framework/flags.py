@@ -25,7 +25,29 @@ _FLAGS = {
     "FLAGS_conv_workspace_size_limit": 512,
     "FLAGS_flash_attention": True,         # route MHA through pallas kernel
     "FLAGS_profile": False,
+    # persistent compiled-executable cache (reference intent: AnalysisPredictor
+    # pays analysis once, inference/api/analysis_predictor.h:95). Set to a
+    # directory to have XLA executables serialized there and reloaded by
+    # later processes, skipping compilation.
+    "FLAGS_compilation_cache_dir": "",
 }
+
+
+def enable_compilation_cache(path=None):
+    """Turn on jax's persistent compilation cache (executables serialized to
+    disk; warm processes skip XLA compilation). Called automatically on
+    import when FLAGS_compilation_cache_dir is set, and by the inference
+    Predictor for its artifact directory."""
+    import jax
+
+    path = path or _FLAGS.get("FLAGS_compilation_cache_dir")
+    if not path:
+        return False
+    _FLAGS["FLAGS_compilation_cache_dir"] = path
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return True
 
 
 def _load_env():
@@ -44,6 +66,9 @@ def _load_env():
 
 
 _load_env()
+
+if _FLAGS["FLAGS_compilation_cache_dir"]:
+    enable_compilation_cache()
 
 
 def get_flags(flags=None):

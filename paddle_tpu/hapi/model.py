@@ -31,10 +31,21 @@ class Model:
         self._train_step_fn = None
         self._eval_fn = None
         self._opt_state = None
+        self._strategy = {}
+        self._pp_step = None
         self.stop_training = False
 
     # ---------------------------------------------------------------- prep
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, strategy=None):
+        """strategy (TPU extension of reference hapi/model.py:591 static
+        fleet routing): a dict like {"microbatches": 4} tuning the pipeline
+        path. Parallelism itself comes from the global mesh
+        (paddle.distributed.build_mesh): a 'dp' axis shards the batch, an
+        'mp' axis shards every parameter that fleet's parallel layers mark
+        with split_axis (GSPMD partitioning), and a 'pp' axis (network must
+        be a PipelineLayer) runs the compiled 1F1B pipeline. mp×pp together
+        is served by the fleet/parallel API (gpt_spmd MeshPlan), not hapi."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -43,8 +54,10 @@ class Model:
             self._metrics = [metrics]
         else:
             self._metrics = list(metrics)
+        self._strategy = dict(strategy or {})
         self._train_step_fn = None
         self._eval_fn = None
+        self._pp_step = None
 
     def _compute_loss(self, outputs, labels):
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
@@ -80,27 +93,59 @@ class Model:
                 params, grads, opt_state, lr=lr)
             return loss, new_params, new_buffers, new_opt_state, raw_outs
 
-        mesh = self._dp_mesh() if sharded else None
+        mesh = self._hybrid_mesh() if sharded else None
         if mesh is not None:
-            # auto data parallelism (reference hapi/model.py:190 wraps in
-            # DataParallel): batch sharded over the mesh 'dp' axis, params
-            # replicated; the GSPMD partitioner inserts the gradient
-            # all-reduce because grads of replicated params from a sharded
-            # batch require it. Loss/semantics identical to single device.
+            # auto data/model parallelism (reference hapi/model.py:190 wraps
+            # in DataParallel; :591 routes fleet strategies): batch sharded
+            # over the mesh 'dp' axis; params that fleet's parallel layers
+            # mark with split_axis shard over 'mp'; everything else
+            # replicated. The GSPMD partitioner inserts gradient all-reduces
+            # and the mp collectives. Loss identical to single device.
             from jax.sharding import NamedSharding, PartitionSpec as P
             repl = NamedSharding(mesh, P())
-            data = NamedSharding(mesh, P("dp"))
+            data = NamedSharding(mesh, P("dp")) \
+                if "dp" in mesh.shape and mesh.shape["dp"] > 1 else repl
+            param_shardings = self._param_shardings(mesh)
             return jax.jit(train_step,
-                           in_shardings=(repl, repl, repl, repl, repl,
-                                         data, data),
-                           out_shardings=repl)
+                           in_shardings=(param_shardings, repl, repl, repl,
+                                         repl, data, data),
+                           out_shardings=(repl, param_shardings, repl,
+                                          repl, repl))
         return jax.jit(train_step)
 
+    def _param_shardings(self, mesh):
+        """Per-param NamedSharding pytree: split_axis-marked params (fleet
+        mp layers) shard over 'mp', the rest replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(mesh, P())
+        has_mp = "mp" in mesh.shape and mesh.shape["mp"] > 1
+        out = {}
+        for n, p in self.network.named_parameters():
+            ax = getattr(p, "split_axis", None)
+            if has_mp and getattr(p, "is_distributed", False) and ax is not None:
+                spec = [None] * len(p.shape)
+                spec[ax] = "mp"
+                out[n] = NamedSharding(mesh, P(*spec))
+            else:
+                out[n] = repl
+        return out
+
     @staticmethod
-    def _dp_mesh():
+    def _hybrid_mesh():
         from ..distributed import env as dist_env
         mesh = dist_env.get_mesh()
-        if mesh is not None and "dp" in mesh.shape and mesh.shape["dp"] > 1:
+        if mesh is None:
+            return None
+        useful = any(mesh.shape.get(ax, 1) > 1 for ax in ("dp", "mp"))
+        return mesh if useful else None
+
+    _dp_mesh = _hybrid_mesh
+
+    @staticmethod
+    def _pp_mesh():
+        from ..distributed import env as dist_env
+        mesh = dist_env.get_mesh()
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
             return mesh
         return None
 
@@ -139,12 +184,15 @@ class Model:
                        for i in inputs)
         lab_raw = tuple(l._data if isinstance(l, Tensor) else jnp.asarray(np.asarray(l))
                         for l in (labels or ()))
+        pp_mesh = self._pp_mesh()
+        if pp_mesh is not None:
+            return self._train_batch_pp(in_raw, lab_raw, pp_mesh)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         step_fn = self._train_step_fn
         mesh = self._dp_mesh()
         if mesh is not None:
-            dp = int(mesh.shape["dp"])
+            dp = int(mesh.shape.get("dp", 1))
             if any(r.ndim and r.shape[0] % dp for r in in_raw + lab_raw):
                 # ragged final batch can't shard evenly over dp: run it
                 # replicated (numerically identical, just unparallel)
@@ -164,6 +212,39 @@ class Model:
             pass  # schedulers step per epoch by callback; per-step via user
         metrics_out = self._update_metrics(outs, lab_raw)
         return [float(np.asarray(loss))], metrics_out
+
+    def _train_batch_pp(self, in_raw, lab_raw, mesh):
+        """Pipeline-parallel Model.fit path: the network must be a fleet
+        PipelineLayer; the whole 1F1B schedule runs as one compiled SPMD
+        program (pp_compiled.py) and the optimizer steps eagerly on the
+        returned grads (reference: hapi static adapter dispatching to fleet,
+        python/paddle/hapi/model.py:591-599)."""
+        from ..distributed.fleet.meta_parallel.pp_layers import PipelineLayer
+
+        if not isinstance(self.network, PipelineLayer):
+            raise ValueError(
+                "Model.fit over a 'pp' mesh axis needs the network to be a "
+                "fleet PipelineLayer; for tensor+pipeline hybrids use the "
+                "fleet API or parallel.make_train_step (MeshPlan)")
+        if len(in_raw) != 1 or len(lab_raw) != 1:
+            raise ValueError("pipeline Model.fit expects one input and one "
+                             "label tensor")
+        if self._pp_step is None:
+            from ..distributed.fleet.meta_parallel.pp_compiled import \
+                make_compiled_pipeline_step
+            micro = int(self._strategy.get("microbatches", 2))
+            self._pp_step = make_compiled_pipeline_step(
+                self.network, mesh, microbatches=micro,
+                schedule=self._strategy.get("schedule", "1f1b"))
+        params, buffers = functional_state(self.network)
+        loss, grads = self._pp_step(params, buffers, in_raw[0], lab_raw[0])
+        named = dict(self.network.named_parameters())
+        for n, g in grads.items():
+            p = named[n]
+            p.grad = Tensor(jnp.asarray(g, p._data.dtype))
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return [float(np.asarray(loss))], []
 
     def eval_batch(self, inputs, labels=None):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]

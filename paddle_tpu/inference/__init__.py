@@ -60,6 +60,18 @@ class Config:
         self._profile = False
         self._glog_info = True
         self._cpu_math_threads = 1
+        # persistent executable cache: serialized XLA executables live next
+        # to the artifact so a second process skips compilation entirely
+        # (AnalysisPredictor's pay-analysis-once intent). None = default dir.
+        self._compile_cache_dir = None
+        self._compile_cache = True
+
+    def enable_compile_cache(self, path=None):
+        self._compile_cache = True
+        self._compile_cache_dir = path
+
+    def disable_compile_cache(self):
+        self._compile_cache = False
 
     # -- model location ----------------------------------------------------
     def set_prog_file(self, path):
@@ -165,6 +177,12 @@ class Predictor:
         from ..framework.io import load as _load
 
         self._config = config
+        if getattr(config, "_compile_cache", False):
+            from ..framework.flags import enable_compilation_cache
+            cache_dir = config._compile_cache_dir or os.path.join(
+                os.path.dirname(os.path.abspath(config.prog_file())),
+                "_xla_cache")
+            enable_compilation_cache(cache_dir)
         with open(config.prog_file(), "rb") as f:
             self._exported = jexport.deserialize(f.read())
         payload = _load(config.params_file(), return_numpy=True)

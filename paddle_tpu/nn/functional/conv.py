@@ -165,8 +165,63 @@ def _pool_nd(x, kernel, stride, padding, spatial, reducer, init, ceil_mode=False
     return apply_op(fn, x)
 
 
+def _max_pool_with_mask(x, kernel, stride, padding, spatial,
+                        data_format="NCHW", ceil_mode=False):
+    """Max pool that also returns the argmax flat index into the input
+    spatial plane (paddle's return_mask, feeding max_unpool*). Windows are
+    enumerated as static shifted slices (kernels are small), so the whole
+    thing is one argmax over a stacked view — no serial loops on device."""
+    import itertools
+
+    if not data_format.startswith("NC"):
+        raise NotImplementedError(
+            "return_mask requires channels-first data_format")
+    if ceil_mode:
+        raise NotImplementedError("return_mask with ceil_mode is not "
+                                  "supported")
+    ks = _pair(kernel, spatial)
+    st = _pair(stride if stride is not None else kernel, spatial)
+    pad = _conv_padding(padding, spatial, ks, st, (1,) * spatial)
+    if isinstance(pad, str):
+        raise ValueError("return_mask does not support string padding")
+
+    def fn(a):
+        sp = a.shape[-spatial:]
+        out_sp = tuple((s + lo + hi - k) // t + 1
+                       for s, (lo, hi), k, t in zip(sp, pad, ks, st))
+        NEG = jnp.array(-jnp.inf, a.dtype) if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        pads_full = [(0, 0)] * (a.ndim - spatial) + list(pad)
+        ap = jnp.pad(a, pads_full, constant_values=NEG)
+        views = []
+        for offs in itertools.product(*[range(k) for k in ks]):
+            sl = tuple(slice(o, o + (osz - 1) * t + 1, t)
+                       for o, osz, t in zip(offs, out_sp, st))
+            views.append(ap[(Ellipsis,) + sl])
+        stacked = jnp.stack(views)                    # (K, ..., *out_sp)
+        k_best = jnp.argmax(stacked, axis=0)          # (..., *out_sp)
+        pooled = jnp.max(stacked, axis=0)
+        # decompose k_best into per-dim kernel offsets -> input coords
+        flat = jnp.zeros_like(k_best)
+        rem = k_best
+        for d in range(spatial):
+            inner = int(np.prod(ks[d + 1:])) if d + 1 < spatial else 1
+            off_d = rem // inner
+            rem = rem % inner
+            grid = jnp.arange(out_sp[d]) * st[d] - pad[d][0]
+            shape = [1] * pooled.ndim
+            shape[pooled.ndim - spatial + d] = out_sp[d]
+            coord = off_d + grid.reshape(shape)
+            flat = flat * sp[d] + coord
+        return pooled, flat.astype(jnp.int32)
+    return apply_op(fn, x)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   "NCL", ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
                     lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, "NCL")
@@ -174,6 +229,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   data_format, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
                     lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, data_format)
@@ -181,6 +239,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   data_format, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
                     lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, data_format)

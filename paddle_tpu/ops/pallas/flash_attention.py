@@ -31,7 +31,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK = 128
+# 512 measured best on v5e at S=1024/D=64: fwd 0.66ms vs 2.40ms at 128,
+# fwd+bwd 2.00ms vs 9.36ms (and vs 4.49ms for XLA dense attention) — the
+# (block_q, block_k) tile amortizes the VPU-side softmax bookkeeping over a
+# 4x bigger MXU dot. VMEM at 512: ~1MB scores + 3x64KB qkv blocks, well
+# under budget for D<=128. flash_attention() clamps to S when S < 512.
+DEFAULT_BLOCK = 512
 _LANE = 128           # TPU lane width; lse/delta carry a broadcast lane dim
 _NEG_INF = -1e30
 

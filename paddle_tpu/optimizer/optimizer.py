@@ -95,6 +95,22 @@ class Optimizer:
             new_p, new_state = runner(p._data, gd, state, lr_t)
             p._data = new_p
             self._accumulators[id(p)] = new_state
+        from ..framework.flags import _FLAGS
+        if _FLAGS.get("FLAGS_check_nan_inf", False):
+            # post-step scan (reference: nan_inf_utils_detail.cc) — names the
+            # first offending parameter
+            import jax.numpy as jnp
+            for i, (p, g) in enumerate(params_grads):
+                for what, t in (("grad", g), ("param", p)):
+                    d = t._data if isinstance(t, Tensor) else t
+                    if d is None or not jnp.issubdtype(d.dtype, jnp.floating):
+                        continue
+                    if bool(jnp.logical_or(jnp.isnan(d).any(),
+                                           jnp.isinf(d).any())):
+                        raise RuntimeError(
+                            f"FLAGS_check_nan_inf: NaN/Inf in {what} of "
+                            f"'{p.name or f'param_{i}'}' after optimizer "
+                            f"step {self._step_count}")
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         loss.backward()

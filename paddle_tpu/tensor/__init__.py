@@ -5,9 +5,10 @@ op set onto core.Tensor as methods, the same way the reference patches
 python ops onto the C tensor type (python/paddle/tensor/__init__.py).
 """
 from ..core.tensor import Tensor
-from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, random, search, stat
+from . import creation, einsum as _einsum_mod, extras, linalg, logic, manipulation, math, random, search, stat
 
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -17,13 +18,15 @@ from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 
-_METHOD_MODULES = [math, manipulation, linalg, logic, search, stat, creation]
+_METHOD_MODULES = [math, manipulation, linalg, logic, search, stat, creation,
+                   extras]
 
 # names that must not become Tensor methods (creation ops, module helpers)
 _SKIP = {
     "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
     "meshgrid", "to_tensor", "apply_op", "Tensor", "assign", "scatter_nd",
     "builtins_sum", "sum_arrays", "jax_topk", "broadcast_shape", "is_tensor",
+    "tril_indices", "triu_indices",
 }
 
 

@@ -1,0 +1,265 @@
+"""Op-breadth batch (round 3): tensor ops the reference exposes that were
+still missing (VERDICT r2 missing #3).
+
+Reference: python/paddle/tensor/{math,manipulation,linalg,creation}.py.
+All shape-static, jit-friendly lowerings; inplace `op_` variants follow the
+framework-wide policy of updating the Tensor's buffer in place (the
+reference's inplace ops mutate the DenseTensor holder the same way).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+
+
+# ------------------------------------------------------------ linalg-ish
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+    return apply_op(fn, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along `axis` (reference renorm_kernel)."""
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale_ = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale_[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply_op(fn, x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack the packed LU factorization (reference lu_unpack op)."""
+    def fn(a, piv):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        def perm_of(pv):
+            idx = jnp.arange(m)
+
+            def body(i, idx):
+                j = pv[i] - 1
+                a_i, a_j = idx[i], idx[j]
+                idx = idx.at[i].set(a_j).at[j].set(a_i)
+                return idx
+
+            idx = jax.lax.fori_loop(0, pv.shape[0], body, idx)
+            # swaps give perm with A[perm] = L U, i.e. I[perm] @ A = L @ U,
+            # so A = I[perm]^T @ L @ U
+            return jnp.eye(m, dtype=a.dtype)[idx].T
+
+        batch = piv.shape[:-1]
+        if batch:
+            P = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1]))
+            P = P.reshape(batch + (m, m))
+        else:
+            P = perm_of(piv)
+        # A = P @ L @ U with P as produced by the factorization
+        return P, L, U
+    return apply_op(fn, lu_data, lu_pivots)
+
+
+# ----------------------------------------------------------- elementwise
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply_op(fn, x)
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (reference sgn_kernel)."""
+    def fn(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(mag, 1e-38))
+        return jnp.sign(a)
+    return apply_op(fn, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        # numerically-stable running logsumexp as one associative scan —
+        # logaddexp is associative, so this is O(log n) depth on TPU
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+    return apply_op(fn, x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim), x)
+
+
+def cast(x, dtype):
+    from ..core import dtype as _dt
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda a: a.astype(d), x)
+
+
+# --------------------------------------------------------- index/shape ops
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def fn(a, seq):
+        out = jnp.searchsorted(seq, a, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_op(fn, x, sorted_sequence)
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(fn, x, index, value)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(a, *rest):
+        shp = [int(s) for s in np.asarray(shape).tolist()] if shape is not None \
+            else list(a.shape)
+        offs = [int(o) for o in np.asarray(offsets).tolist()] if offsets is not None \
+            else [0] * a.ndim
+        shp = [a.shape[i] - offs[i] if s == -1 else s
+               for i, s in enumerate(shp)]
+        return jax.lax.dynamic_slice(a, offs, shp)
+    return apply_op(fn, x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def fn(a):
+        n = num if num is not None else a.shape[axis]
+        return tuple(jnp.squeeze(s, axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return apply_op(fn, x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), jnp.dtype("int32")
+                              if dtype in ("int32",) else None))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), jnp.dtype("int32")
+                              if dtype in ("int32",) else None))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    def fn(a, v):
+        moved = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        m, n = moved.shape[-2:]
+        i0, j0 = (0, offset) if offset >= 0 else (-offset, 0)
+        k = min(m - i0, n - j0)
+        ii = i0 + jnp.arange(k)
+        jj = j0 + jnp.arange(k)
+        vb = jnp.broadcast_to(v, moved.shape[:-2] + (k,)).astype(a.dtype)
+        upd = moved.at[..., ii, jj].set(vb)
+        return jnp.moveaxis(upd, (-2, -1), (dim1, dim2))
+    return apply_op(fn, x, y)
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(input.ndim if hasattr(input, "ndim")
+                              else np.ndim(input), jnp.int32))
+
+
+# ------------------------------------------------------------- inplace ops
+
+def _make_inplace(fn_name):
+    """paddle's `op_` inplace variants: compute out-of-place (XLA arrays are
+    immutable), then rebind the Tensor's buffer — the same observable
+    semantics as the reference's inplace DenseTensor mutation."""
+    def inplace(self, *args, **kwargs):
+        out = getattr(self, fn_name)(*args, **kwargs)
+        self._data = out._data
+        return self
+    inplace.__name__ = fn_name + "_"
+    return inplace
+
+
+_INPLACE = ["add", "subtract", "multiply", "clip", "scale", "tanh", "erfinv",
+            "fill", "flatten", "lerp", "remainder", "squeeze", "unsqueeze",
+            "exp", "sqrt", "rsqrt", "reciprocal", "round", "floor", "ceil",
+            "sigmoid", "softmax", "cast"]
+
+
+def fill(x, value, name=None):
+    return apply_op(lambda a: jnp.full_like(a, value), x)
+
+
+def zero_(x):
+    x._data = jnp.zeros_like(x._data)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    if wrap:
+        raise NotImplementedError("fill_diagonal_: wrap=True is not "
+                                  "supported")
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        i0, j0 = (0, offset) if offset >= 0 else (-offset, 0)
+        k = min(m - i0, n - j0)
+        if k <= 0:
+            return a
+        i = i0 + jnp.arange(k)
+        j = j0 + jnp.arange(k)
+        return a.at[..., i, j].set(value)
+    x._data = fn(x._data)
+    return x
+
+
+def _patch_inplace():
+    from ..core.tensor import Tensor as T
+    if not hasattr(T, "fill"):
+        T.fill = fill
+    if not hasattr(T, "cast"):
+        T.cast = cast
+    for base in _INPLACE:
+        if hasattr(T, base) and not hasattr(T, base + "_"):
+            setattr(T, base + "_", _make_inplace(base))
+    T.zero_ = zero_
+    T.fill_diagonal_ = fill_diagonal_
+
+
+_patch_inplace()

@@ -150,7 +150,8 @@ def lu(x, pivot=True, get_infos=False, name=None):
     import jax
     def fn(a):
         lu_, piv = jax.scipy.linalg.lu_factor(a)
-        return lu_, piv.astype(jnp.int32)
+        # paddle/LAPACK pivots are 1-based sequential row swaps
+        return lu_, piv.astype(jnp.int32) + 1
     return apply_op(fn, x)
 
 

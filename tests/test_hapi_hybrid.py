@@ -1,0 +1,130 @@
+"""Model.fit beyond DP (VERDICT r2 next #10): tensor parallelism via GSPMD
+param sharding and pipeline parallelism via the compiled 1F1B path, both
+through the user-facing high-level API on the CPU mesh.
+
+Reference: python/paddle/hapi/model.py:591-599 (static adapter runs fleet
+strategies under Model.fit).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import env as dist_env
+from paddle_tpu.distributed.fleet.layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear)
+from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                        PipelineLayer)
+
+
+@pytest.fixture
+def clean_mesh():
+    prev = dist_env.get_mesh()
+    yield
+    dist_env._global_mesh = prev
+
+
+class TinyErnieBlock(nn.Layer):
+    """ERNIE-style FFN block built from fleet mp layers (column->row)."""
+
+    def __init__(self, hidden, ffn):
+        super().__init__()
+        self.ln = nn.LayerNorm(hidden)
+        self.fc1 = ColumnParallelLinear(hidden, ffn, gather_output=False)
+        self.act = nn.GELU()
+        self.fc2 = RowParallelLinear(ffn, hidden, input_is_parallel=True)
+
+    def forward(self, x):
+        return x + self.fc2(self.act(self.fc1(self.ln(x))))
+
+
+class TinyErnie(nn.Layer):
+    def __init__(self, vocab=64, hidden=16, ffn=32, n_cls=4):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, hidden)
+        self.b1 = TinyErnieBlock(hidden, ffn)
+        self.b2 = TinyErnieBlock(hidden, ffn)
+        self.head = nn.Linear(hidden, n_cls)
+
+    def forward(self, ids):
+        h = self.emb(ids)
+        h = self.b2(self.b1(h))
+        return self.head(h.mean(axis=1))
+
+
+def _ernie_losses(n_steps=4):
+    paddle.seed(5)
+    net = TinyErnie()
+    m = paddle.Model(net)
+    m.prepare(opt.Adam(1e-2, parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(n_steps):
+        x = rng.randint(0, 64, (8, 12))
+        y = rng.randint(0, 4, 8)
+        (l,), _ = m.train_batch([x], [y])
+        losses.append(l)
+    return losses
+
+
+def test_model_fit_mp_matches_single_device(clean_mesh):
+    """ERNIE-tiny with mp layers: dp=2 x mp=4 GSPMD fit == single device."""
+    dist_env.build_mesh({"dp": 2, "mp": 4})
+    mp_losses = _ernie_losses()
+    dist_env._global_mesh = None
+    single = _ernie_losses()
+    np.testing.assert_allclose(mp_losses, single, rtol=5e-4, atol=1e-5)
+
+
+def test_model_fit_mp_params_really_sharded(clean_mesh):
+    mesh = dist_env.build_mesh({"dp": 2, "mp": 4})
+    paddle.seed(1)
+    net = TinyErnie()
+    m = paddle.Model(net)
+    m.prepare(opt.SGD(0.1, parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    x = np.random.RandomState(1).randint(0, 64, (8, 12))
+    y = np.random.RandomState(2).randint(0, 4, 8)
+    m.train_batch([x], [y])
+    w = dict(net.named_parameters())["b1.fc1.weight"]
+    # after a sharded step the updated param carries the mp sharding
+    shards = w._data.sharding
+    assert "mp" in str(shards.spec), shards
+    np.testing.assert_equal(
+        len({s.device for s in w._data.addressable_shards}), 8)
+
+
+def test_model_fit_pp_pipeline_layer(clean_mesh):
+    """PipelineLayer through Model.fit: pp=2 x dp=4 compiled 1F1B matches
+    the same network trained unpipelined."""
+    dist_env.build_mesh({"dp": 4, "pp": 2})
+    paddle.seed(7)
+    descs = [LayerDesc(nn.Linear, 12, 32), LayerDesc(nn.ReLU),
+             LayerDesc(nn.Linear, 32, 32), LayerDesc(nn.ReLU),
+             LayerDesc(nn.Linear, 32, 4)]
+    pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    m = paddle.Model(pl)
+    m.prepare(opt.SGD(0.1, parameters=pl.parameters()),
+              nn.CrossEntropyLoss(), strategy={"microbatches": 4})
+
+    golden = nn.Sequential(nn.Linear(12, 32), nn.ReLU(),
+                           nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 4))
+    for gp, pp_ in zip(golden.parameters(), pl.parameters()):
+        gp._data = pp_._data
+    o_g = opt.SGD(0.1, parameters=golden.parameters())
+    lf = nn.CrossEntropyLoss()
+
+    rng = np.random.RandomState(3)
+    for _ in range(3):
+        x = rng.rand(16, 12).astype("float32")
+        y = rng.randint(0, 4, 16)
+        (l_pp,), _ = m.train_batch([x], [y])
+        l_g = lf(golden(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l_g.backward()
+        o_g.step()
+        o_g.clear_grad()
+        np.testing.assert_allclose(l_pp, float(l_g), rtol=2e-5, atol=1e-6)

@@ -1,0 +1,60 @@
+"""FLAGS_check_nan_inf consumption (VERDICT r2 weak #9 / next #9).
+
+Reference behavior: paddle/fluid/framework/details/nan_inf_utils_detail.cc +
+eager/nan_inf_utils.cc scan op outputs when the flag is set and abort naming
+the op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.framework.flags import get_flags, set_flags
+
+
+@pytest.fixture
+def nan_flag():
+    old = get_flags("FLAGS_check_nan_inf")
+    set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    set_flags(old)
+
+
+def test_per_op_scan_catches_injected_nan(nan_flag):
+    x = paddle.to_tensor(np.array([1.0, -1.0], dtype="float32"))
+    with pytest.raises(RuntimeError, match="FLAGS_check_nan_inf.*log"):
+        paddle.log(x)          # log(-1) = NaN
+
+
+def test_per_op_scan_catches_inf(nan_flag):
+    x = paddle.to_tensor(np.array([0.0, 2.0], dtype="float32"))
+    y = paddle.to_tensor(np.array([1.0, 1.0], dtype="float32"))
+    with pytest.raises(RuntimeError, match="FLAGS_check_nan_inf"):
+        y / x                  # 1/0 = inf
+
+
+def test_clean_ops_pass_and_flag_off_is_silent():
+    set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+        assert float((x * x).sum()) == 5.0     # finite: no raise
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
+    x = paddle.to_tensor(np.array([-1.0], dtype="float32"))
+    out = paddle.log(x)                        # flag off: NaN passes through
+    assert np.isnan(np.asarray(out.numpy())).all()
+
+
+def test_optimizer_post_step_scan(nan_flag):
+    lin = nn.Linear(4, 2)
+    o = opt.SGD(0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), dtype="float32"))
+    loss = lin(x).sum()
+    loss.backward()
+    # inject a NaN directly into a gradient (simulating a corrupt update)
+    import jax.numpy as jnp
+    p = list(lin.parameters())[0]
+    p.grad = paddle.to_tensor(jnp.full(p.shape, jnp.nan, jnp.float32))
+    with pytest.raises(RuntimeError, match="FLAGS_check_nan_inf"):
+        o.step()

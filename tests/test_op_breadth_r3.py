@@ -1,0 +1,222 @@
+"""Round-3 op-breadth batch: tensor extras + functional extras, verified
+against torch (the reference's own backend for these ops) where available.
+
+Reference files: python/paddle/tensor/{math,linalg,manipulation}.py,
+python/paddle/nn/functional/{loss,vision,pooling}.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+rng = np.random.RandomState(0)
+
+
+# ------------------------------------------------------------- tensor extras
+
+def test_addmm_trace_diagonal():
+    m = rng.rand(3, 3).astype("float32")
+    a = rng.rand(3, 2).astype("float32")
+    b = rng.rand(2, 3).astype("float32")
+    out = paddle.addmm(paddle.to_tensor(m), paddle.to_tensor(a),
+                       paddle.to_tensor(b), beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               0.5 * m + 2.0 * (a @ b), rtol=1e-5)
+    x = rng.rand(4, 5).astype("float32")
+    np.testing.assert_allclose(float(paddle.trace(paddle.to_tensor(x))),
+                               np.trace(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.diagonal(paddle.to_tensor(x), offset=1).numpy()),
+        np.diagonal(x, offset=1))
+
+
+def test_logcumsumexp_matches_naive():
+    x = rng.randn(3, 7).astype("float32")
+    out = paddle.logcumsumexp(paddle.to_tensor(x), axis=1)
+    ref = np.log(np.cumsum(np.exp(x.astype("float64")), axis=1))
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)
+
+
+def test_logit_sgn_renorm():
+    p = np.array([0.1, 0.5, 0.9], "float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.logit(paddle.to_tensor(p)).numpy()),
+        np.log(p / (1 - p)), rtol=1e-5)
+    v = np.array([-2.0, 0.0, 3.0], "float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.sgn(paddle.to_tensor(v)).numpy()), np.sign(v))
+    x = rng.rand(4, 6).astype("float32") + 1.0
+    out = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0).numpy()
+    norms = np.linalg.norm(np.asarray(out), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+
+
+def test_lu_unpack_roundtrip():
+    A = rng.rand(5, 5).astype("float32")
+    lu, piv = paddle.lu(paddle.to_tensor(A))
+    P, L, U = paddle.lu_unpack(lu, piv)
+    recon = np.asarray(P.numpy()) @ np.asarray(L.numpy()) @ np.asarray(U.numpy())
+    np.testing.assert_allclose(recon, A, atol=1e-5)
+
+
+def test_index_add_bucketize_unstack():
+    x = np.zeros((4, 3), "float32")
+    out = paddle.index_add(paddle.to_tensor(x),
+                           paddle.to_tensor(np.array([0, 2])), 0,
+                           paddle.to_tensor(np.ones((2, 3), "float32")))
+    np.testing.assert_allclose(np.asarray(out.numpy())[:, 0], [1, 0, 1, 0])
+    b = paddle.bucketize(paddle.to_tensor(np.array([0.5, 1.5, 2.5])),
+                         paddle.to_tensor(np.array([1.0, 2.0])))
+    np.testing.assert_array_equal(np.asarray(b.numpy()), [0, 1, 2])
+    parts = paddle.unstack(paddle.to_tensor(rng.rand(3, 4).astype("float32")))
+    assert len(parts) == 3 and parts[0].shape == [4]
+
+
+def test_inplace_variants():
+    t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    r = t.add_(paddle.to_tensor(np.array([1.0, 1.0], "float32")))
+    assert r is t
+    np.testing.assert_allclose(np.asarray(t.numpy()), [2.0, 3.0])
+    t.zero_()
+    np.testing.assert_allclose(np.asarray(t.numpy()), [0.0, 0.0])
+    e = paddle.to_tensor(np.zeros((3, 3), "float32"))
+    e.fill_diagonal_(7.0)
+    np.testing.assert_allclose(np.diag(np.asarray(e.numpy())), [7.0] * 3)
+
+
+# --------------------------------------------------------- functional extras
+
+def test_ctc_loss_matches_torch():
+    T, B, C, L = 12, 3, 6, 4
+    logits = rng.randn(T, B, C).astype("float32")
+    labels = rng.randint(1, C, (B, L))
+    in_len = np.array([12, 10, 8])
+    lab_len = np.array([4, 3, 2])
+    ours = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                      blank=0, reduction="none")
+    t_lp = torch.log_softmax(torch.tensor(logits), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        t_lp, torch.tensor(labels), torch.tensor(in_len),
+        torch.tensor(lab_len), blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(ours.numpy()), ref.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_is_finite():
+    T, B, C, L = 8, 2, 5, 3
+    logits = paddle.to_tensor(rng.randn(T, B, C).astype("float32"),
+                              stop_gradient=False)
+    loss = F.ctc_loss(logits, paddle.to_tensor(rng.randint(1, C, (B, L))),
+                      paddle.to_tensor(np.array([8, 6])),
+                      paddle.to_tensor(np.array([3, 2])))
+    loss.backward()
+    g = np.asarray(logits.grad.numpy())
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pmode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sample_matches_torch(mode, pmode, align):
+    x = rng.randn(2, 3, 5, 7).astype("float32")
+    # deliberately far out of range to exercise the padding modes
+    grid = (rng.rand(2, 4, 6, 2).astype("float32") * 3 - 1.5)
+    ours = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                         mode=mode, padding_mode=pmode,
+                         align_corners=align).numpy()
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode=mode,
+        align_corners=align, padding_mode=pmode).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-5)
+
+
+def test_affine_grid_matches_torch():
+    theta = rng.randn(2, 2, 3).astype("float32")
+    for align in (True, False):
+        ours = F.affine_grid(paddle.to_tensor(theta), (2, 3, 4, 5),
+                             align_corners=align).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(theta), (2, 3, 4, 5), align_corners=align).numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-5)
+
+
+def test_max_pool_mask_and_unpool_match_torch():
+    x = rng.rand(2, 3, 6, 8).astype("float32")
+    pooled, idx = F.max_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                               return_mask=True)
+    tp, ti = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, stride=2, padding=1, return_indices=True)
+    np.testing.assert_allclose(np.asarray(pooled.numpy()), tp.numpy())
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), ti.numpy())
+
+    p2, i2 = F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True)
+    t2, tti = torch.nn.functional.max_pool2d(torch.tensor(x), 2,
+                                             return_indices=True)
+    unp = F.max_unpool2d(p2, i2, 2)
+    ref = torch.nn.functional.max_unpool2d(t2, tti, 2)
+    np.testing.assert_allclose(np.asarray(unp.numpy()), ref.numpy())
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 4], [1, 1, 1, 1]])
+    ref = np.array([[1, 3, 3, 4, 5], [2, 2, 2, 2, 2]])
+    d, _ = F.edit_distance(paddle.to_tensor(hyp), paddle.to_tensor(ref),
+                           normalized=False)
+    np.testing.assert_allclose(np.asarray(d.numpy()).ravel(), [2.0, 5.0])
+
+
+def test_small_losses_and_vision_ops():
+    a = paddle.to_tensor(rng.randn(4, 5).astype("float32"))
+    y = paddle.to_tensor((rng.randint(0, 2, (4, 5)) * 2 - 1).astype("float32"))
+    l = F.soft_margin_loss(a, y)
+    ref = torch.nn.functional.soft_margin_loss(
+        torch.tensor(np.asarray(a.numpy())), torch.tensor(np.asarray(y.numpy())))
+    np.testing.assert_allclose(float(l), float(ref), rtol=1e-5)
+
+    x = rng.rand(1, 4, 2, 2).astype("float32")
+    cs = F.channel_shuffle(paddle.to_tensor(x), 2)
+    ref = torch.nn.functional.channel_shuffle(torch.tensor(x), 2)
+    np.testing.assert_allclose(np.asarray(cs.numpy()), ref.numpy())
+
+    d = F.diag_embed(paddle.to_tensor(np.array([[1., 2., 3.]], "float32")))
+    np.testing.assert_allclose(np.asarray(d.numpy())[0], np.diag([1., 2., 3.]))
+
+    pd = F.pairwise_distance(paddle.to_tensor(np.ones((2, 3), "float32")),
+                             paddle.to_tensor(np.zeros((2, 3), "float32")))
+    np.testing.assert_allclose(np.asarray(pd.numpy()), [np.sqrt(3)] * 2,
+                               rtol=1e-4)
+
+    zp = F.zeropad2d(paddle.to_tensor(np.ones((1, 1, 2, 2), "float32")),
+                     [1, 2, 3, 4])
+    assert zp.numpy().shape == (1, 1, 9, 5)
+
+
+def test_hsigmoid_and_margin_ce_train():
+    # both must be differentiable and finite
+    x = paddle.to_tensor(rng.rand(4, 8).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.rand(6, 8).astype("float32"),
+                         stop_gradient=False)
+    loss = F.hsigmoid_loss(x, paddle.to_tensor(np.array([0, 1, 2, 3])), 6, w)
+    loss.backward()
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(w.grad.numpy())).all()
+
+    z = paddle.to_tensor((rng.rand(4, 10) * 2 - 1).astype("float32"),
+                         stop_gradient=False)
+    ml = F.margin_cross_entropy(z, paddle.to_tensor(rng.randint(0, 10, 4)))
+    ml.backward()
+    assert np.isfinite(float(ml))
+
+
+def test_gather_tree():
+    # golden from the reference docstring (gather_tree_op)
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]])
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]])
+    out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+    ref = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+    np.testing.assert_array_equal(np.asarray(out.numpy()), ref)
