@@ -75,7 +75,11 @@ def _param_ownership(pl, pp):
                 stages_of.setdefault(n, set()).add(s)
     owned = {s: sorted(n for n, ss in stages_of.items() if ss == {s})
              for s in range(pp)}
-    shared = sorted(n for n, ss in stages_of.items() if len(ss) > 1)
+    # shared: used by 2+ stages (tied embeddings) OR not reachable through
+    # any stage layer at all (e.g. a parameterized loss_fn held directly on
+    # the PipelineLayer) — both stay replicated
+    shared = sorted(n for n, _ in pl.named_parameters()
+                    if len(stages_of.get(n, ())) != 1)
     return owned, shared
 
 

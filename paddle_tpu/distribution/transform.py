@@ -1,0 +1,309 @@
+"""paddle.distribution transforms (reference:
+python/paddle/distribution/transform.py): invertible maps with tractable
+log-det-Jacobians, composable into TransformedDistribution.
+
+TPU-native: every transform is a pair of pure jnp functions; log_det uses
+closed forms (no autodiff through the inverse), so a TransformedDistribution
+log_prob is a single fused XLA program.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution",
+]
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Transform:
+    """Base invertible transform. Subclasses define _forward, _inverse and
+    _forward_log_det_jacobian on raw arrays; the public surface takes and
+    returns Tensors through apply_op (differentiable, cached)."""
+
+    _event_rank = 0          # rank of the event the jacobian sums over
+
+    def forward(self, x):
+        return apply_op(self._forward, x)
+
+    def inverse(self, y):
+        return apply_op(self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(self._forward_log_det_jacobian, x)
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op(
+            lambda v: -self._forward_log_det_jacobian(self._inverse(v)), y)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    """y = |x|; not bijective — inverse returns the positive branch
+    (reference AbsTransform semantics)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _d(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x)), stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not bijective (softmax loses a degree of freedom); forward is
+    softmax over the last axis, inverse is log (reference semantics)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det (not "
+                                  "bijective)")
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} -> open simplex in R^{n+1} via stick breaking."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], axis=-1)
+        rem = 1.0 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / rem
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        # sum over the event: log sigma'(t) + log of remaining stick
+        log_sig = -jax.nn.softplus(-t) - jax.nn.softplus(t)
+        rem = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumsum(jnp.log1p(-z), axis=-1)[..., :-1]], axis=-1)
+        return jnp.sum(log_sig + rem, axis=-1)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Reinterpret batch dims of `base` as event dims (sums the jacobian
+    over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(ld.ndim - self.rank, ld.ndim)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_rank = max((t._event_rank for t in self.transforms),
+                               default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t._forward_log_det_jacobian(x)
+            # sum sub-event dims so terms of different event ranks align
+            extra = self._event_rank - t._event_rank
+            if extra and ld.ndim >= extra:
+                ld = jnp.sum(ld, axis=tuple(range(ld.ndim - extra, ld.ndim)))
+            total = ld if total is None else total + ld
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)
+
+
+class TransformedDistribution:
+    """Distribution of T(X) for X ~ base (reference
+    transformed_distribution.py): log_prob(y) = base.log_prob(T^-1(y)) -
+    log|det J_T(T^-1(y))|."""
+
+    def __init__(self, base, transforms):
+        from . import Distribution
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms)) \
+            if len(transforms) != 1 else transforms[0]
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = getattr(self.base, "rsample", self.base.sample)(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        base_lp = self.base.log_prob(x)
+
+        def fn(bl, v):
+            ld = self.transform._forward_log_det_jacobian(
+                self.transform._inverse(v))
+            # align: sum base log-prob over the transform's event dims
+            er = self.transform._event_rank
+            if er and bl.ndim >= er:
+                bl = jnp.sum(bl, axis=tuple(range(bl.ndim - er, bl.ndim)))
+            return bl - ld
+        return apply_op(fn, base_lp, value)

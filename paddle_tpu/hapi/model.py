@@ -229,6 +229,12 @@ class Model:
         if len(in_raw) != 1 or len(lab_raw) != 1:
             raise ValueError("pipeline Model.fit expects one input and one "
                              "label tensor")
+        micro = int(self._strategy.get("microbatches", 2))
+        if in_raw[0].shape[0] % micro:
+            raise ValueError(
+                f"pipeline Model.fit: batch size {in_raw[0].shape[0]} is not "
+                f"divisible by microbatches={micro}; set drop_last=True or "
+                f"pick a matching batch size")
         if self._pp_step is None:
             from ..distributed.fleet.meta_parallel.pp_compiled import \
                 make_compiled_pipeline_step

@@ -78,7 +78,8 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
     def fn(a, y):
-        return _reduce(jnp.log1p(jnp.exp(-y.astype(a.dtype) * a)), reduction)
+        # softplus(-y*a) == log(1 + exp(-y*a)), stable for large |a|
+        return _reduce(jax.nn.softplus(-y.astype(a.dtype) * a), reduction)
     return apply_op(fn, input, label)
 
 
@@ -381,6 +382,9 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d: return_mask is not supported")
     return _adaptive_pool3d(x, output_size, "max")
 
 

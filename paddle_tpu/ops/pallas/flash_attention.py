@@ -515,9 +515,18 @@ def _flatten_mask(mask, B, H):
     return mask.reshape(Bm * Hm, *mask.shape[2:]), (Bm, Hm)
 
 
+def _auto_block(S):
+    """Largest power-of-two block that divides S, capped at DEFAULT_BLOCK —
+    S=1024 gets 512, S=768 gets 256, S=640 gets 128."""
+    b = DEFAULT_BLOCK
+    while b > 128 and S % b:
+        b //= 2
+    return min(b, S)
+
+
 def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
                     dropout_rate=0.0, dropout_seed=None,
-                    block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK,
+                    block_q=None, block_k=None,
                     interpret=None):
     """Flash attention over (B, H, S, D) q and (B, Hk, S, D) k/v.
 
@@ -533,8 +542,8 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
         raise ValueError(f"q heads {H} not a multiple of kv heads {Hk}")
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    block_q = _auto_block(S) if block_q is None else min(block_q, S)
+    block_k = _auto_block(S) if block_k is None else min(block_k, S)
     if S % block_q or S % block_k:
         raise ValueError(f"S={S} must be a multiple of block sizes "
                          f"({block_q}, {block_k})")

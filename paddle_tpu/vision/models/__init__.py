@@ -11,3 +11,20 @@ from .mobilenet import (  # noqa: F401
 from .ppyoloe import (  # noqa: F401
     PPYOLOE, PPYOLOEConfig, ppyoloe_crn_tiny, ppyoloe_loss, ppyoloe_s,
 )
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264,
+)
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+)
+from .mobilenetv3 import (  # noqa: F401
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from .googlenet import (  # noqa: F401
+    GoogLeNet, InceptionV3, googlenet, inception_v3,
+)

@@ -1,0 +1,100 @@
+"""paddle.distribution transforms (reference: transform.py op tests
+test_distribution_transform.py): invertibility, log-det correctness vs
+autodiff, TransformedDistribution log_prob vs closed forms.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    AffineTransform, ChainTransform, ExpTransform, IndependentTransform,
+    Independent, Normal, PowerTransform, ReshapeTransform, SigmoidTransform,
+    StickBreakingTransform, TanhTransform, TransformedDistribution,
+)
+
+rng = np.random.RandomState(0)
+
+
+def _roundtrip(t, x):
+    y = t.forward(paddle.to_tensor(x))
+    back = t.inverse(y)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-4,
+                               atol=1e-5)
+    return np.asarray(y.numpy())
+
+
+@pytest.mark.parametrize("t,x", [
+    (ExpTransform(), rng.randn(3, 4).astype("float32")),
+    (AffineTransform(1.5, -2.0), rng.randn(3, 4).astype("float32")),
+    (PowerTransform(3.0), rng.rand(3, 4).astype("float32") + 0.1),
+    (SigmoidTransform(), rng.randn(3, 4).astype("float32")),
+    (TanhTransform(), rng.randn(3, 4).astype("float32") * 0.5),
+], ids=["exp", "affine", "power", "sigmoid", "tanh"])
+def test_roundtrip_and_logdet_vs_autodiff(t, x):
+    _roundtrip(t, x)
+    # scalar log-det == log |d forward/dx| element-wise (all these are
+    # element-wise bijectors)
+    ld = np.asarray(t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy())
+    grad = jax.vmap(jax.vmap(jax.grad(lambda v: t._forward(v))))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(ld, np.log(np.abs(np.asarray(grad))),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_chain_transform():
+    t = ChainTransform([AffineTransform(0.0, 2.0), ExpTransform()])
+    x = rng.randn(5).astype("float32")
+    y = _roundtrip(t, x)
+    np.testing.assert_allclose(y, np.exp(2.0 * x), rtol=1e-5)
+    ld = np.asarray(t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(ld, np.log(2.0) + 2.0 * x, rtol=1e-5)
+
+
+def test_stick_breaking_simplex():
+    x = rng.randn(4, 3).astype("float32")
+    t = StickBreakingTransform()
+    y = np.asarray(t.forward(paddle.to_tensor(x)).numpy())
+    assert y.shape == (4, 4)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+    assert (y > 0).all()
+    back = np.asarray(t.inverse(paddle.to_tensor(y)).numpy())
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+
+def test_reshape_and_independent_transform():
+    t = ReshapeTransform((4,), (2, 2))
+    x = rng.randn(3, 4).astype("float32")
+    y = t.forward(paddle.to_tensor(x))
+    assert list(y.shape) == [3, 2, 2]
+    _roundtrip(t, x)
+
+    it = IndependentTransform(ExpTransform(), 1)
+    ld = np.asarray(it.forward_log_det_jacobian(
+        paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(ld, x.sum(-1), rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal():
+    # exp(Normal) must match the LogNormal closed form
+    mu, sigma = 0.3, 0.8
+    td = TransformedDistribution(Normal(mu, sigma), [ExpTransform()])
+    v = np.array([0.5, 1.0, 2.5], "float32")
+    lp = np.asarray(td.log_prob(paddle.to_tensor(v)).numpy())
+    ref = (-np.log(v) - np.log(sigma) - 0.5 * np.log(2 * np.pi)
+           - (np.log(v) - mu) ** 2 / (2 * sigma ** 2))
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+    s = np.asarray(td.sample((1000,)).numpy())
+    assert (s > 0).all()
+
+
+def test_independent_distribution():
+    base = Normal(np.zeros(3, "float32"), np.ones(3, "float32"))
+    ind = Independent(base, 1)
+    v = rng.randn(5, 3).astype("float32")
+    lp = np.asarray(ind.log_prob(paddle.to_tensor(v)).numpy())
+    ref = np.asarray(base.log_prob(paddle.to_tensor(v)).numpy()).sum(-1)
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+    assert lp.shape == (5,)

@@ -107,6 +107,21 @@ def test_s512_grads_match_xla_fallback(causal):
                                    err_msg=f"d{name} mismatch (causal={causal})")
 
 
+def test_auto_block_sizes_for_non_512_multiples():
+    # DEFAULT_BLOCK=512 must degrade to a divisor of S (r3 review finding:
+    # S=640/768 are multiples of 128 but not 512)
+    from paddle_tpu.ops.pallas.flash_attention import _auto_block
+    assert _auto_block(1024) == 512
+    assert _auto_block(768) == 256
+    assert _auto_block(640) == 128
+    assert _auto_block(64) == 64
+    q, k, v = make_qkv(B=1, H=2, S=640, D=64, seed=5)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = ref_attention(q, k, v, True, 1.0 / (q.shape[-1] ** 0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
 def test_bf16_forward():
     q, k, v = make_qkv(S=128, dtype=jnp.bfloat16, seed=3)
     out = flash_attention(q, k, v, causal=True, interpret=True)

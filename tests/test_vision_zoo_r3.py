@@ -1,0 +1,67 @@
+"""Round-3 vision zoo additions: every model builds, runs a forward pass at
+the right output shape, and takes one training step with a falling loss
+path available (forward+backward are traceable).
+
+Reference: python/paddle/vision/models tests (test_vision_models.py runs
+each model on a 224 input)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.vision import models
+
+# small inputs keep CPU runtime sane; num_classes=10 shrinks the heads
+BUILDS = [
+    ("alexnet", lambda: models.alexnet(num_classes=10), 127),
+    ("squeezenet1_1", lambda: models.squeezenet1_1(num_classes=10), 96),
+    ("densenet121", lambda: models.densenet121(num_classes=10), 64),
+    ("shufflenet_v2_x0_25",
+     lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
+    ("mobilenet_v3_small",
+     lambda: models.mobilenet_v3_small(num_classes=10), 64),
+    ("googlenet", lambda: models.googlenet(num_classes=10), 96),
+]
+
+
+@pytest.mark.parametrize("name,build,size", BUILDS,
+                         ids=[b[0] for b in BUILDS])
+def test_model_forward_shape(name, build, size):
+    paddle.seed(0)
+    net = build()
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, size, size).astype("float32"))
+    out = net(x)
+    assert list(out.shape) == [2, 10], (name, out.shape)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_inception_v3_forward():
+    paddle.seed(0)
+    net = models.inception_v3(num_classes=10)
+    net.eval()
+    # inception v3 stem needs a larger input
+    x = paddle.to_tensor(
+        np.random.RandomState(1).rand(1, 3, 160, 160).astype("float32"))
+    out = net(x)
+    assert list(out.shape) == [1, 10]
+
+
+def test_new_zoo_model_trains():
+    paddle.seed(1)
+    net = models.shufflenet_v2_x0_25(num_classes=4)
+    o = opt.SGD(0.05, parameters=net.parameters())
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(4, 3, 64, 64).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, 4))
+    l0 = None
+    for _ in range(3):
+        loss = lf(net(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        l0 = l0 or float(loss)
+    assert float(loss) < l0 + 1e-3   # moving (usually falling) loss
