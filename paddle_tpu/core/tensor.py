@@ -530,18 +530,37 @@ def _check_finite(outs, opname):
     return outs
 
 
+def _add_op_context(e, fn, name, args):
+    """Reference-style op error context (paddle/fluid/platform/enforce.h
+    formats every kernel failure with the op name + inputs): attach the op
+    and its eager input signature as an exception note so raw XLA errors
+    become attributable."""
+    try:
+        opname = name or getattr(fn, "__name__", "<lambda>")
+        sig = ", ".join(
+            f"Tensor{tuple(a.shape)}:{a.dtype}" if isinstance(a, Tensor)
+            else type(a).__name__ for a in args)
+        e.add_note(f"  [operator < {opname} > error] inputs: ({sig})")
+    except Exception:                                        # noqa: BLE001
+        pass
+
+
 def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
     """Run `fn` over tensor args, recording a tape Node when grads are needed.
 
     `fn` operates on raw jax arrays. Non-Tensor args pass through unchanged.
     Returns Tensor or tuple-of-Tensor mirroring fn's output structure.
     """
-    if _nan_check_enabled():
-        outs = _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
+    try:
+        if _nan_check_enabled():
+            outs = _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
+                                   **kwargs)
+            return _check_finite(outs, name or getattr(fn, "__name__", ""))
+        return _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
                                **kwargs)
-        return _check_finite(outs, name or getattr(fn, "__name__", ""))
-    return _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
-                           **kwargs)
+    except Exception as e:
+        _add_op_context(e, fn, name, args)
+        raise
 
 
 def _apply_op_inner(fn, *args, n_outputs=None, name="", **kwargs):

@@ -124,17 +124,28 @@ class PSClient:
 
     def _request(self, i, op, keys, grads=None):
         with self._locks[i]:
-            s = self._sock(i)
-            msg = _HDR.pack(op, keys.size, self.dim) + keys.tobytes()
-            if grads is not None:
-                msg += grads.tobytes()
-            s.sendall(msg)
-            (n,) = struct.unpack("<I", _recv_exact(s, 4))
-            if op == OP_PULL:
-                return np.frombuffer(
-                    _recv_exact(s, 4 * n * self.dim),
-                    np.float32).reshape(n, self.dim)
-            return None
+            try:
+                s = self._sock(i)
+                msg = _HDR.pack(op, keys.size, self.dim) + keys.tobytes()
+                if grads is not None:
+                    msg += grads.tobytes()
+                s.sendall(msg)
+                (n,) = struct.unpack("<I", _recv_exact(s, 4))
+                if op == OP_PULL:
+                    return np.frombuffer(
+                        _recv_exact(s, 4 * n * self.dim),
+                        np.float32).reshape(n, self.dim)
+                return None
+            except Exception:
+                # a half-consumed socket would desynchronize the framing for
+                # every later request: drop it so the next call reconnects
+                if self._socks[i] is not None:
+                    try:
+                        self._socks[i].close()
+                    except OSError:
+                        pass
+                    self._socks[i] = None
+                raise
 
     def _route(self, keys):
         from . import shard_for

@@ -295,15 +295,14 @@ class TransformedDistribution:
         return self.transform.forward(x)
 
     def log_prob(self, value):
-        x = self.transform.inverse(value)
+        x = self.transform.inverse(value)      # computed ONCE; fn reuses it
         base_lp = self.base.log_prob(x)
 
-        def fn(bl, v):
-            ld = self.transform._forward_log_det_jacobian(
-                self.transform._inverse(v))
+        def fn(bl, xv):
+            ld = self.transform._forward_log_det_jacobian(xv)
             # align: sum base log-prob over the transform's event dims
             er = self.transform._event_rank
             if er and bl.ndim >= er:
                 bl = jnp.sum(bl, axis=tuple(range(bl.ndim - er, bl.ndim)))
             return bl - ld
-        return apply_op(fn, base_lp, value)
+        return apply_op(fn, base_lp, x)

@@ -1,17 +1,135 @@
-"""Kernel/dataloader autotune config (reference: python/paddle/incubate/autotune.py).
+"""Kernel autotune (reference: python/paddle/incubate/autotune.py +
+phi/kernels/autotune/cache.h — the runtime kernel-pick cache).
 
-On TPU, XLA's autotuning (latency-hiding scheduler, fusion) replaces the
-reference's runtime kernel autotune cache (phi/kernels/autotune). This module
-keeps the config surface and toggles the knobs we do own.
+On TPU, XLA already autotunes its own fusions, so the one knob the
+framework genuinely owns is Pallas kernel tiling. `autotune_flash_blocks`
+measures the flash-attention (block_q, block_k) candidates for a concrete
+shape ON THE DEVICE, caches the winner keyed by (backend, B, H, S, D,
+causal) — in memory and optionally on disk, the phi AlgorithmsCache role —
+and `ops.flash_attention` consults the cache on every call.
+
+The reference's dataloader/layout tuning knobs remain config-only (XLA owns
+layout on TPU; the DataLoader sizes its worker pool explicitly).
 """
-_config = {"kernel": {"enable": True}, "dataloader": {"enable": False},
+import json
+import os
+import time
+
+_config = {"kernel": {"enable": True, "tuning_range": [1, 10]},
+           "dataloader": {"enable": False},
            "layout": {"enable": False}}
+
+# (backend, B, H, S, D, causal) -> (block_q, block_k)
+_block_cache = {}
+_CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
 
 
 def set_config(config=None):
     if config:
-        _config.update(config)
+        for k, v in config.items():
+            if isinstance(v, dict) and isinstance(_config.get(k), dict):
+                _config[k].update(v)       # per-section merge (reference
+            else:                          # set_config semantics)
+                _config[k] = v
 
 
 def get_config():
     return dict(_config)
+
+
+def kernel_tuning_enabled():
+    return bool(_config.get("kernel", {}).get("enable"))
+
+
+def _cache_path():
+    return os.environ.get(_CACHE_ENV, "")
+
+
+def _load_disk_cache():
+    path = _cache_path()
+    if path and os.path.exists(path):
+        try:
+            with open(path) as f:
+                return {tuple(json.loads(k)): tuple(v)
+                        for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            return {}
+    return {}
+
+
+def _save_disk_cache():
+    path = _cache_path()
+    if path:
+        try:
+            # load-then-merge: never clobber entries written by other
+            # processes sharing the cache file
+            merged = _load_disk_cache()
+            merged.update(_block_cache)
+            with open(path, "w") as f:
+                json.dump({json.dumps(list(k)): list(v)
+                           for k, v in merged.items()}, f)
+        except OSError:
+            pass
+
+
+def lookup_flash_blocks(B, H, S, D, causal):
+    """Cached (block_q, block_k) for this shape, or None. Honors the
+    kernel.enable knob; re-reads the disk cache on a miss so entries tuned
+    by other processes become visible."""
+    import jax
+    if not kernel_tuning_enabled():
+        return None
+    key = (jax.default_backend(), B, H, S, D, bool(causal))
+    if key not in _block_cache:
+        _block_cache.update({k: v for k, v in _load_disk_cache().items()
+                             if k not in _block_cache})
+    return _block_cache.get(key)
+
+
+def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
+                          candidates=(128, 256, 512), n_iters=3):
+    """Measure each candidate square block on the live backend and cache the
+    fastest. Returns (block_q, block_k). Candidates that don't divide S or
+    fail to compile are skipped; measurement uses a host fetch as the sync
+    (the only honest sync through remote-device tunnels)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas.flash_attention import flash_attention
+
+    key = (jax.default_backend(), B, H, S, D, bool(causal))
+    hit = lookup_flash_blocks(B, H, S, D, causal)
+    if hit is not None:
+        return hit
+    if not kernel_tuning_enabled():
+        from ..ops.pallas.flash_attention import _auto_block
+        b = _auto_block(S)
+        return (b, b)
+
+    q = (jax.random.normal(jax.random.key(0), (B, H, S, D)) * 0.1) \
+        .astype(dtype)
+    interpret = jax.default_backend() != "tpu"
+    best, best_dt = None, float("inf")
+    for b in candidates:
+        if S % b or b > S:
+            continue
+        try:
+            f = jax.jit(lambda q, b=b: flash_attention(
+                q, q, q, causal=causal, block_q=b, block_k=b,
+                interpret=interpret))
+            float(jnp.ravel(f(q))[0].astype(jnp.float32))    # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                float(jnp.ravel(f(q))[0].astype(jnp.float32))
+            dt = time.perf_counter() - t0
+        except Exception:                                    # noqa: BLE001
+            continue
+        if dt < best_dt:
+            best, best_dt = (b, b), dt
+    if best is None:
+        from ..ops.pallas.flash_attention import _auto_block
+        b = _auto_block(S)           # always divides S (never poisons cache)
+        best = (b, b)
+    _block_cache[key] = best
+    _save_disk_cache()
+    return best

@@ -115,9 +115,11 @@ class AdaptiveMaxPool3D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self._output_size = output_size
+        self._return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool3d(x, self._output_size)
+        return F.adaptive_max_pool3d(x, self._output_size,
+                                     return_mask=self._return_mask)
 
 
 class MaxUnPool1D(Layer):

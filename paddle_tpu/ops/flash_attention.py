@@ -59,9 +59,22 @@ def _use_pallas(q, k):
 def _pallas_flash_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
                        dropout_seed=None):
     from .pallas.flash_attention import flash_attention
+
+    # consult the autotune cache (incubate.autotune — the phi
+    # AlgorithmsCache role); None -> the kernel's static default
+    bq = bk = None
+    try:
+        from ..incubate.autotune import lookup_flash_blocks
+        B, H, S, D = q.shape
+        hit = lookup_flash_blocks(B, H, S, D, causal)
+        if hit:
+            bq, bk = hit
+    except Exception:                                        # noqa: BLE001
+        pass
     return flash_attention(q, k, v, mask=mask, causal=causal, sm_scale=scale,
                            dropout_rate=dropout_rate,
-                           dropout_seed=dropout_seed)
+                           dropout_seed=dropout_seed,
+                           block_q=bq, block_k=bk)
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None, mask=None,

@@ -517,10 +517,14 @@ def _flatten_mask(mask, B, H):
 
 def _auto_block(S):
     """Largest power-of-two block that divides S, capped at DEFAULT_BLOCK —
-    S=1024 gets 512, S=768 gets 256, S=640 gets 128."""
+    S=1024 gets 512, S=768 gets 256, S=640 gets 128. When no candidate
+    divides S (e.g. S=192), the whole sequence is one block (S < 512, so it
+    fits VMEM)."""
     b = DEFAULT_BLOCK
     while b > 128 and S % b:
         b //= 2
+    if S % b:
+        return S
     return min(b, S)
 
 

@@ -30,15 +30,114 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
+class OpDesc:
+    """One op of the program IR (reference: framework/op_desc.h). Built from
+    a jaxpr equation: `type` is the primitive name, inputs/outputs are the
+    SSA variable names, attrs are the primitive params."""
+
+    def __init__(self, eqn):
+        self._type = eqn.primitive.name
+        self._inputs = [str(v) for v in eqn.invars]
+        self._outputs = [str(v) for v in eqn.outvars]
+        self._attrs = {k: v for k, v in eqn.params.items()
+                       if isinstance(v, (int, float, bool, str, tuple))}
+
+    def type(self):
+        return self._type
+
+    def input_arg_names(self):
+        return list(self._inputs)
+
+    def output_arg_names(self):
+        return list(self._outputs)
+
+    def attr(self, name):
+        return self._attrs.get(name)
+
+    def attr_names(self):
+        return list(self._attrs)
+
+    def __repr__(self):
+        return (f"{{Op({self._type}) inputs: {self._inputs} "
+                f"outputs: {self._outputs}}}")
+
+
 class Program:
     """A deferred computation: list of (fn, feeds, fetches) built under
-    program_guard by `data` placeholders + user ops."""
+    program_guard by `data` placeholders + user ops.
+
+    The IR surface (reference ProgramDesc, framework/program_desc.h) is the
+    captured jaxpr: `Program.capture(fn, *specs)` traces fn once and the
+    resulting Program exposes `ops()` / `var_names()` / `to_string()` over
+    the SSA graph XLA will compile — the TPU build's ProgramDesc."""
 
     def __init__(self):
         self._inputs = {}        # name -> InputSpec
         self._build_fns = []     # callables executed at run time
         self._fetch_builder = None
+        self._jaxpr = None       # ClosedJaxpr when captured
         self.random_seed = None
+
+    @classmethod
+    def capture(cls, fn, *input_specs):
+        """Trace `fn` over InputSpec/ShapeDtypeStruct args into a Program
+        with an inspectable op graph."""
+        import jax
+
+        avals = []
+        for s in input_specs:
+            if isinstance(s, InputSpec):
+                shape = tuple(1 if (d is None or d < 0) else d
+                              for d in s.shape)
+                avals.append(jax.ShapeDtypeStruct(
+                    shape, _dt.convert_dtype(s.dtype)))
+            else:
+                avals.append(s)
+
+        def raw_fn(*args):
+            outs = fn(*[Tensor(a) for a in args])
+            outs = outs if isinstance(outs, (tuple, list)) else [outs]
+            return [o._data if isinstance(o, Tensor) else o for o in outs]
+
+        prog = cls()
+        prog._jaxpr = jax.make_jaxpr(raw_fn)(*avals)
+        for i, s in enumerate(input_specs):
+            name = getattr(s, "name", None) or f"input_{i}"
+            prog._inputs[name] = s
+        return prog
+
+    # ------------------------------------------------- IR inspection
+    def ops(self):
+        if self._jaxpr is None:
+            return []
+        return [OpDesc(e) for e in self._jaxpr.jaxpr.eqns]
+
+    def var_names(self):
+        if self._jaxpr is None:
+            return []
+        seen = []
+        j = self._jaxpr.jaxpr
+        for v in list(j.invars) + list(j.outvars):
+            seen.append(str(v))
+        for e in j.eqns:
+            for v in e.outvars:
+                seen.append(str(v))
+        return sorted(set(seen))
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def block(self, i=0):
+        return self
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        if self._jaxpr is None:
+            return "Program(untraced — build with Program.capture)"
+        return self._jaxpr.jaxpr.pretty_print()
+
+    def __str__(self):
+        return self.to_string()
 
     def global_block(self):
         return self

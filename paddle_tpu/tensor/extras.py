@@ -263,3 +263,114 @@ def _patch_inplace():
 
 
 _patch_inplace()
+
+
+# ----------------------------------------------- numeric helpers (round 3b)
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, *rest):
+        xv = rest[0] if rest else None
+        return jnp.trapezoid(yv, x=xv, dx=1.0 if dx is None else dx,
+                             axis=axis)
+    return apply_op(fn, y) if x is None else apply_op(fn, y, x)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import jax.scipy.integrate  # noqa: F401
+
+    def fn(yv, *rest):
+        # cumulative trapezoid along axis, no initial zero (paddle semantics)
+        yv = jnp.moveaxis(yv, axis, -1)
+        if rest:
+            xv = jnp.broadcast_to(jnp.moveaxis(rest[0], axis, -1), yv.shape)
+            d = jnp.diff(xv, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        avg = (yv[..., 1:] + yv[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * d, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+    return apply_op(fn, y) if x is None else apply_op(fn, y, x)
+
+
+def frexp(x, name=None):
+    def fn(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+    return apply_op(fn, x)
+
+
+def ldexp(x, y, name=None):
+    return apply_op(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y)
+
+
+def copysign(x, y, name=None):
+    return apply_op(jnp.copysign, x, y)
+
+
+def nextafter(x, y, name=None):
+    return apply_op(jnp.nextafter, x, y)
+
+
+def hypot(x, y, name=None):
+    return apply_op(jnp.hypot, x, y)
+
+
+def signbit(x, name=None):
+    return apply_op(jnp.signbit, x)
+
+
+def isposinf(x, name=None):
+    return apply_op(jnp.isposinf, x)
+
+
+def isneginf(x, name=None):
+    return apply_op(jnp.isneginf, x)
+
+
+def isreal(x, name=None):
+    return apply_op(jnp.isreal, x)
+
+
+def polar(abs, angle, name=None):
+    return apply_op(lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t))
+                    .astype(jnp.complex64), abs, angle)
+
+
+def view_as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def view_as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x)
+
+
+class _FInfo:
+    def __init__(self, dtype):
+        self._i = jnp.finfo(dtype)
+        for f in ("min", "max", "eps", "tiny", "bits", "dtype"):
+            setattr(self, f, getattr(self._i, f, None))
+        self.smallest_normal = self._i.tiny
+        self.resolution = float(getattr(self._i, "resolution", 0.0))
+
+
+class _IInfo:
+    def __init__(self, dtype):
+        self._i = jnp.iinfo(dtype)
+        self.min = self._i.min
+        self.max = self._i.max
+        self.bits = self._i.bits
+        self.dtype = str(self._i.dtype)
+
+
+def finfo(dtype):
+    from ..core import dtype as _dtm
+    return _FInfo(_dtm.convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    from ..core import dtype as _dtm
+    return _IInfo(_dtm.convert_dtype(dtype))

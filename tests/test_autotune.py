@@ -1,0 +1,50 @@
+"""incubate.autotune: real kernel tiling autotune with a persistent cache
+(reference: python/paddle/incubate/autotune.py + phi/kernels/autotune)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autotune
+
+
+def test_config_surface():
+    autotune.set_config({"kernel": {"enable": True}})
+    assert autotune.get_config()["kernel"]["enable"]
+    assert autotune.kernel_tuning_enabled()
+
+
+def test_autotune_picks_a_valid_block_and_caches(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "cache.json"))
+    autotune._block_cache.clear()
+    bq, bk = autotune.autotune_flash_blocks(1, 2, 256, 64, causal=True,
+                                            dtype="float32",
+                                            candidates=(128, 256),
+                                            n_iters=1)
+    assert 256 % bq == 0 and 256 % bk == 0
+    # cached in memory and on disk
+    assert autotune.lookup_flash_blocks(1, 2, 256, 64, True) == (bq, bk)
+    assert (tmp_path / "cache.json").exists()
+    # a fresh in-memory cache reloads from disk
+    autotune._block_cache.clear()
+    assert autotune.lookup_flash_blocks(1, 2, 256, 64, True) == (bq, bk)
+
+
+def test_tuned_blocks_feed_the_flash_entry(monkeypatch):
+    """ops.flash_attention consults the cache: a poisoned entry with an
+    invalid block must surface as the kernel's block-divisibility error,
+    proving the value was actually used."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.flash_attention import _pallas_flash_bhsd
+
+    autotune._block_cache.clear()
+    key = (jax.default_backend(), 1, 2, 256, 64, True)
+    autotune._block_cache[key] = (96, 96)       # 256 % 96 != 0
+    q = jnp.ones((1, 2, 256, 64), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of block"):
+        _pallas_flash_bhsd(q, q, q, True, 0.125)
+    autotune._block_cache.clear()

@@ -224,3 +224,24 @@ print(json.dumps({"hits": hits, "out": np.asarray(out).tolist()}))
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(cold["out"]), want,
                                rtol=1e-5, atol=1e-5)
+
+
+def test_program_capture_ir_surface():
+    """Program.capture exposes the ProgramDesc-style op/var graph over the
+    traced jaxpr (reference: framework/program_desc.h inspection APIs)."""
+    from paddle_tpu.static import InputSpec, Program
+
+    def fn(x, y):
+        return (x @ y).sum() * 2.0
+
+    prog = Program.capture(fn, InputSpec([4, 8], "float32"),
+                           InputSpec([8, 2], "float32"))
+    types = [op.type() for op in prog.ops()]
+    assert "dot_general" in types, types
+    assert prog.num_blocks == 1
+    assert len(prog.var_names()) >= 3
+    s = prog.to_string()
+    assert "dot_general" in s
+    # OpDesc surface
+    op = prog.ops()[0]
+    assert op.input_arg_names() and op.output_arg_names()

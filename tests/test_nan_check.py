@@ -58,3 +58,16 @@ def test_optimizer_post_step_scan(nan_flag):
     p.grad = paddle.to_tensor(jnp.full(p.shape, jnp.nan, jnp.float32))
     with pytest.raises(RuntimeError, match="FLAGS_check_nan_inf"):
         o.step()
+
+
+def test_op_error_context_note():
+    """Raw XLA shape errors carry the paddle-style op context (reference:
+    enforce.h '[operator < X > error]' formatting)."""
+    a = paddle.to_tensor(np.ones((2, 3), "float32"))
+    b = paddle.to_tensor(np.ones((4, 5), "float32"))
+    try:
+        a @ b                       # incompatible contraction
+        assert False, "expected a shape error"
+    except Exception as e:          # noqa: BLE001
+        notes = "\n".join(getattr(e, "__notes__", []))
+        assert "[operator <" in notes and "Tensor(2, 3)" in notes, notes

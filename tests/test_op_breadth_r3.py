@@ -220,3 +220,36 @@ def test_gather_tree():
     out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
     ref = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
     np.testing.assert_array_equal(np.asarray(out.numpy()), ref)
+
+
+def test_numeric_helpers_r3b():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    np.testing.assert_allclose(
+        np.asarray(paddle.trapezoid(x).numpy()), 4.0)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cumulative_trapezoid(x).numpy()), [1.5, 4.0])
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0], "float32")))
+    assert float(np.asarray(m.numpy())[0]) == 0.5
+    assert int(np.asarray(e.numpy())[0]) == 4
+    np.testing.assert_allclose(
+        np.asarray(paddle.hypot(
+            paddle.to_tensor(np.array([3.0], "float32")),
+            paddle.to_tensor(np.array([4.0], "float32"))).numpy()), [5.0])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.signbit(
+            paddle.to_tensor(np.array([-1.0, 1.0], "float32"))).numpy()),
+        [True, False])
+    vc = paddle.view_as_complex(
+        paddle.to_tensor(np.array([[1.0, 2.0]], "float32")))
+    np.testing.assert_allclose(np.asarray(vc.numpy()), [1 + 2j])
+    np.testing.assert_allclose(
+        np.asarray(paddle.view_as_real(vc).numpy()), [[1.0, 2.0]])
+    assert paddle.finfo("bfloat16").bits == 16
+    assert paddle.iinfo("int32").max == 2**31 - 1
+    np.testing.assert_allclose(
+        np.asarray(paddle.copysign(
+            x, paddle.to_tensor(np.array([-1., -1., 1.], "float32"))
+        ).numpy()), [-1.0, -2.0, 3.0])
+    v = paddle.vander(x, n=3)
+    np.testing.assert_allclose(np.asarray(v.numpy()),
+                               np.vander(np.array([1., 2., 3.]), 3))
