@@ -117,6 +117,9 @@ def launch_elastic(args, spawn_fn):
     except Exception:
         manager = None  # no native store: degrade to plain retry
 
+    import os
+    max_restarts = int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS", "10"))
+    backoff_cap = float(os.environ.get("PADDLE_ELASTIC_BACKOFF_S", "30"))
     attempts = 0
     try:
         while True:
@@ -124,14 +127,14 @@ def launch_elastic(args, spawn_fn):
             if rc == 0:
                 return 0
             attempts += 1
-            if attempts > 10:
+            if attempts > max_restarts:
                 return rc
             if manager is not None:
                 alive = manager.alive_nodes(hi)
                 if len(alive) < lo:
                     # below the minimum scale: no point relaunching
                     return rc
-            time.sleep(min(2 ** attempts, 30))
+            time.sleep(min(2 ** attempts, backoff_cap))
     finally:
         if manager is not None:
             manager.exit()

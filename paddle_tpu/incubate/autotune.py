@@ -21,6 +21,7 @@ _config = {"kernel": {"enable": True, "tuning_range": [1, 10]},
 
 # (backend, B, H, S, D, causal) -> (block_q, block_k)
 _block_cache = {}
+_disk_loaded = False
 _CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
 
 
@@ -77,12 +78,16 @@ def lookup_flash_blocks(B, H, S, D, causal):
     kernel.enable knob; re-reads the disk cache on a miss so entries tuned
     by other processes become visible."""
     import jax
+    global _disk_loaded
     if not kernel_tuning_enabled():
         return None
     key = (jax.default_backend(), B, H, S, D, bool(causal))
-    if key not in _block_cache:
+    if key not in _block_cache and not _disk_loaded:
+        # one disk read per process (not per miss — this sits on the eager
+        # attention dispatch path); tuning refreshes it on save
         _block_cache.update({k: v for k, v in _load_disk_cache().items()
                              if k not in _block_cache})
+        _disk_loaded = True
     return _block_cache.get(key)
 
 

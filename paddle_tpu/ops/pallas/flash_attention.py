@@ -517,15 +517,23 @@ def _flatten_mask(mask, B, H):
 
 def _auto_block(S):
     """Largest power-of-two block that divides S, capped at DEFAULT_BLOCK —
-    S=1024 gets 512, S=768 gets 256, S=640 gets 128. When no candidate
-    divides S (e.g. S=192), the whole sequence is one block (S < 512, so it
-    fits VMEM)."""
+    S=1024 gets 512, S=768 gets 256, S=640 gets 128. When no power-of-two
+    candidate divides S: the whole sequence if it fits one block (S=192),
+    else the largest 8-aligned divisor of S under the cap (S=4000 -> 400,
+    keeping the score tile inside VMEM)."""
     b = DEFAULT_BLOCK
     while b > 128 and S % b:
         b //= 2
-    if S % b:
+    if S % b == 0:
+        return min(b, S)
+    if S <= DEFAULT_BLOCK:
         return S
-    return min(b, S)
+    for d in range(DEFAULT_BLOCK, 7, -8):
+        if S % d == 0:
+            return d
+    raise ValueError(
+        f"S={S} has no viable flash block (no 8-aligned divisor <= "
+        f"{DEFAULT_BLOCK}); pass block_q/block_k explicitly")
 
 
 def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,

@@ -2,5 +2,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
-    Optimizer, RMSProp, SGD,
+    NAdam, Optimizer, RAdam, RMSProp, SGD,
 )

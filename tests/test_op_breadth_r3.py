@@ -253,3 +253,76 @@ def test_numeric_helpers_r3b():
     v = paddle.vander(x, n=3)
     np.testing.assert_allclose(np.asarray(v.numpy()),
                                np.vander(np.array([1., 2., 3.]), 3))
+
+
+# -------------------------------------------------- OpTest grad checks
+
+from op_test import check_grad  # noqa: E402
+
+
+def test_grad_check_logit():
+    check_grad(lambda x: paddle.logit(x),
+               [rng.rand(3, 4) * 0.8 + 0.1])
+
+
+def test_grad_check_logcumsumexp():
+    check_grad(lambda x: paddle.logcumsumexp(x, axis=1),
+               [rng.randn(3, 5) * 0.5])
+
+
+def test_grad_check_addmm():
+    check_grad(lambda i, a, b: paddle.addmm(i, a, b, beta=0.7, alpha=1.3),
+               [rng.rand(3, 3), rng.rand(3, 2), rng.rand(2, 3)])
+
+
+def test_grad_check_renorm():
+    check_grad(lambda x: paddle.renorm(x, 2.0, 0, 2.0),
+               [rng.rand(3, 4) + 0.5])
+
+
+def test_grad_check_index_add():
+    idx = np.array([0, 2])
+    check_grad(lambda x, v: paddle.index_add(
+        x, paddle.to_tensor(idx), 0, v),
+        [rng.rand(4, 3), rng.rand(2, 3)])
+
+
+def test_grad_check_grid_sample():
+    g = (rng.rand(1, 3, 3, 2) * 1.6 - 0.8).astype("float32")
+    check_grad(lambda x: F.grid_sample(
+        x, paddle.to_tensor(g), align_corners=True),
+        [rng.rand(1, 2, 5, 5)])
+
+
+def test_grad_check_soft_margin():
+    y = (rng.randint(0, 2, (3, 4)) * 2 - 1).astype("float32")
+    check_grad(lambda x: F.soft_margin_loss(
+        x, paddle.to_tensor(y), reduction="sum"),
+        [rng.randn(3, 4)])
+
+
+def test_matrix_exp_cdist_householder():
+    import scipy.linalg
+    A = rng.rand(4, 4).astype("float32") * 0.3
+    np.testing.assert_allclose(
+        np.asarray(paddle.matrix_exp(paddle.to_tensor(A)).numpy()),
+        scipy.linalg.expm(A), rtol=1e-4)
+
+    x = rng.rand(3, 5).astype("float32")
+    y = rng.rand(4, 5).astype("float32")
+    cd = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y))
+    ref = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+    np.testing.assert_allclose(np.asarray(cd.numpy()), ref,
+                               rtol=1e-4, atol=1e-5)
+    cd1 = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y), p=1.0)
+    np.testing.assert_allclose(np.asarray(cd1.numpy()),
+                               np.abs(x[:, None] - y[None]).sum(-1),
+                               rtol=1e-4)
+
+    B = rng.rand(5, 3).astype("float32")
+    h, tau = torch.geqrf(torch.tensor(B))
+    ref_q = torch.linalg.householder_product(h, tau).numpy()
+    hp = paddle.householder_product(paddle.to_tensor(h.numpy()),
+                                    paddle.to_tensor(tau.numpy()))
+    np.testing.assert_allclose(np.asarray(hp.numpy()), ref_q,
+                               rtol=1e-4, atol=1e-5)

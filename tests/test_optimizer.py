@@ -129,3 +129,30 @@ def test_functional_update_matches_eager():
         params, {"w": jnp.asarray(g)}, state, lr=0.1)
     np.testing.assert_allclose(p_eager.numpy(), np.asarray(new_params["w"]),
                                rtol=1e-6)
+
+
+def test_nadam_radam_converge_and_match_torch():
+    """NAdam/RAdam single-param trajectories vs torch's implementations."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(4, 3).astype("float32")
+    gs = [rng.randn(4, 3).astype("float32") * 0.1 for _ in range(5)]
+
+    for ours_cls, torch_cls in ((opt.NAdam, torch.optim.NAdam),
+                                (opt.RAdam, torch.optim.RAdam)):
+        p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        o = ours_cls(learning_rate=0.01, parameters=[p])
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        to = torch_cls([tw], lr=0.01)
+        for g in gs:
+            p.grad = paddle.to_tensor(g)
+            o.step()
+            o.clear_grad()
+            tw.grad = torch.tensor(g)
+            to.step()
+            to.zero_grad()
+        np.testing.assert_allclose(np.asarray(p.numpy()),
+                                   tw.detach().numpy(),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=ours_cls.__name__)

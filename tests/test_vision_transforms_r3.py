@@ -1,0 +1,74 @@
+"""Round-3 vision transforms (reference: vision/transforms/transforms.py:
+BrightnessTransform..RandomErasing)."""
+import numpy as np
+
+from paddle_tpu.vision import transforms as T
+
+
+def test_color_transforms_shapes_and_identity():
+    np.random.seed(0)
+    img = (np.random.rand(16, 16, 3) * 255).astype("uint8")
+    for t in [T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+              T.SaturationTransform(0.4), T.HueTransform(0.1),
+              T.ColorJitter(0.2, 0.2, 0.2, 0.1)]:
+        assert np.asarray(t(img)).shape == (16, 16, 3)
+    # zero-strength color transforms are identities
+    np.testing.assert_array_equal(np.asarray(T.HueTransform(0)(img)), img)
+    np.testing.assert_array_equal(
+        np.asarray(T.BrightnessTransform(0)(img)), img)
+
+
+def test_grayscale_pad_rotation_erasing():
+    np.random.seed(1)
+    img = np.ones((8, 8, 3), "float32")
+    g = T.Grayscale(1)(img)
+    assert np.asarray(g).shape == (8, 8, 1)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+    p = T.Pad(2, fill=5.0)(img)
+    assert np.asarray(p).shape == (12, 12, 3)
+    assert np.asarray(p)[0, 0, 0] == 5.0
+
+    r = T.RandomRotation((90, 90))( np.arange(9, dtype="float32")
+                                    .reshape(3, 3, 1))
+    assert np.asarray(r).shape == (3, 3, 1)
+
+    e = T.RandomErasing(prob=1.0, value=0)(np.ones((8, 8, 3), "float32"))
+    assert (np.asarray(e) == 0).any()
+    # prob=0 leaves the image untouched
+    e2 = T.RandomErasing(prob=0.0)(img)
+    np.testing.assert_array_equal(np.asarray(e2), img)
+
+
+def test_compose_with_new_transforms():
+    np.random.seed(2)
+    img = (np.random.rand(10, 12, 3) * 255).astype("uint8")
+    pipe = T.Compose([T.Pad(1), T.ColorJitter(0.1, 0.1, 0.1, 0.05),
+                      T.ToTensor()])
+    out = pipe(img)
+    assert list(out.shape) == [3, 12, 14]
+
+
+def test_transforms_preserve_dtype_and_rank():
+    np.random.seed(3)
+    img_u8 = (np.random.rand(8, 8, 3) * 255).astype("uint8")
+    for t in [T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+              T.SaturationTransform(0.4), T.HueTransform(0.1)]:
+        out = np.asarray(t(img_u8))
+        assert out.dtype == np.uint8 and out.shape == (8, 8, 3), type(t)
+    gray2d = (np.random.rand(8, 8) * 255).astype("uint8")
+    out = np.asarray(T.BrightnessTransform(0.4)(gray2d))
+    assert out.shape == (8, 8) and out.dtype == np.uint8
+
+
+def test_pad_per_channel_fill_and_rotation_expand():
+    img = np.zeros((4, 4, 3), "float32")
+    p = np.asarray(T.Pad(1, fill=(1.0, 2.0, 3.0))(img))
+    assert p.shape == (6, 6, 3)
+    np.testing.assert_allclose(p[0, 0], [1.0, 2.0, 3.0])
+
+    r = T.RandomRotation((45, 45), expand=True)(np.ones((10, 10, 1),
+                                                        "float32"))
+    assert np.asarray(r).shape[0] > 10        # canvas grew
+    with np.testing.assert_raises(Exception):
+        T.RandomRotation(30, interpolation="bilinear")
