@@ -1,0 +1,8 @@
+"""paddle.audio — feature extraction (reference: python/paddle/audio:
+functional/{window,filters,functional}.py, features/layers.py).
+
+TPU-first: the mel filterbank is a precomputed host matrix applied as ONE
+MXU matmul over the power spectrogram; dct likewise. All layers trace/jit.
+"""
+from . import functional  # noqa: F401
+from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram  # noqa: F401

@@ -72,3 +72,13 @@ def fftfreq(n, d=1.0, dtype=None, name=None):
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     from .core.tensor import Tensor
     return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(
+        lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=_norm(norm)), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op(
+        lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=_norm(norm)), x)

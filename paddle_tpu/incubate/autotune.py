@@ -75,8 +75,9 @@ def _save_disk_cache():
 
 def lookup_flash_blocks(B, H, S, D, causal):
     """Cached (block_q, block_k) for this shape, or None. Honors the
-    kernel.enable knob; re-reads the disk cache on a miss so entries tuned
-    by other processes become visible."""
+    kernel.enable knob. The disk cache is read once per process (keeping
+    file IO off the eager dispatch path); entries tuned by other processes
+    after that point become visible on the next process start."""
     import jax
     global _disk_loaded
     if not kernel_tuning_enabled():

@@ -4,3 +4,6 @@ from .tensor.linalg import (  # noqa: F401
     inv, lstsq, lu, matmul, matrix_power, matrix_rank, multi_dot, norm, pinv,
     qr, slogdet, solve, svd, triangular_solve,
 )
+from .tensor.extras import (  # noqa: F401
+    cdist, householder_product, lu_unpack, matrix_exp, vector_norm,
+)

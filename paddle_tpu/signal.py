@@ -1,0 +1,159 @@
+"""paddle.signal — STFT/iSTFT (reference: python/paddle/signal.py,
+frame/overlap_add ops in phi/kernels/frame_kernel.cc).
+
+TPU-first: framing is one strided gather (reshape-friendly, no dynamic
+shapes), the DFT rides jnp.fft (XLA's FFT HLO), and overlap-add in istft is
+a segment-sum via scatter-add — everything jit-compatible.
+"""
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice the signal into overlapping frames (reference frame op,
+    librosa layout): axis=-1 -> (..., frame_length, num_frames);
+    axis=0 -> (num_frames, frame_length, ...)."""
+    def fn(a):
+        # for 1-D input axis=0 and axis=-1 name the same axis but paddle
+        # documents DIFFERENT output layouts; go by the literal axis value
+        time_last = axis == -1 or (a.ndim > 1 and axis == a.ndim - 1)
+        if not time_last:
+            a = jnp.moveaxis(a, 0, -1)
+        n = a.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        out = a[..., idx]                  # (..., n_frames, frame_len)
+        out = jnp.swapaxes(out, -1, -2)    # (..., frame_len, n_frames)
+        if not time_last:
+            # (..., frame_len, n_frames) -> (n_frames, frame_len, ...)
+            out = jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+        return out
+    return apply_op(fn, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference overlap_add op): axis=-1 takes
+    (..., frame_length, num_frames); axis=0 takes
+    (num_frames, frame_length, ...)."""
+    def fn(a):
+        if axis == -1 or (a.ndim > 2 and axis == a.ndim - 1):
+            fr = jnp.swapaxes(a, -1, -2)       # (..., n_frames, frame_len)
+        else:
+            # (n_frames, frame_len, ...) -> (..., n_frames, frame_len)
+            fr = jnp.moveaxis(jnp.moveaxis(a, 1, -1), 0, -2)
+        n_frames, fl = fr.shape[-2], fr.shape[-1]
+        out_len = (n_frames - 1) * hop_length + fl
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(fl)[None, :]).reshape(-1)
+        flat = fr.reshape(fr.shape[:-2] + (n_frames * fl,))
+        out = jnp.zeros(fr.shape[:-2] + (out_len,), a.dtype) \
+            .at[..., idx].add(flat)
+        if axis not in (-1, a.ndim - 1):
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply_op(fn, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py:stft). x: (B, T)
+    or (T,). Returns complex (B, n_fft//2+1, n_frames) when onesided."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    win_data = None if window is None else \
+        (window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def fn(a, *w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if w:
+            win = w[0].astype(jnp.float32)
+        else:
+            win = jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:                 # center-pad window to n_fft
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        if center:
+            a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        n_frames = 1 + (a.shape[-1] - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = a[:, idx] * win               # (B, n_frames, n_fft)
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        spec = jnp.swapaxes(spec, -1, -2)      # (B, freq, n_frames)
+        return spec[0] if squeeze else spec
+
+    args = (x,) if win_data is None else (x, Tensor(win_data))
+    return apply_op(fn, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with the standard window-sum-squares normalization
+    (reference signal.py:istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    win_data = None if window is None else \
+        (window._data if isinstance(window, Tensor) else jnp.asarray(window))
+
+    def fn(spec, *w):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        if w:
+            win = w[0].astype(jnp.float32)
+        else:
+            win = jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(spec, -1, -2)      # (B, n_frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        elif return_complex:
+            frames = jnp.fft.ifft(spec, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win                  # windowed overlap-add
+        n_frames = frames.shape[-2]
+        out_len = (n_frames - 1) * hop_length + n_fft
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        sig = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype) \
+            .at[..., idx].add(frames.reshape(frames.shape[:-2] + (-1,)))
+        wss = jnp.zeros((out_len,), jnp.float32) \
+            .at[idx].add(jnp.tile(win * win, n_frames))
+        sig = sig / jnp.maximum(wss, 1e-10)
+        if center:
+            # trim the left pad; keep the right tail if `length` needs it
+            # (torch/paddle: out[..., :length] AFTER the left trim)
+            right = out_len - n_fft // 2 if length is None \
+                else n_fft // 2 + length
+            sig = sig[..., n_fft // 2:right]
+        if length is not None:
+            if sig.shape[-1] < length:
+                sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1)
+                              + [(0, length - sig.shape[-1])])
+            sig = sig[..., :length]
+        return sig[0] if squeeze else sig
+
+    args = (x,) if win_data is None else (x, Tensor(win_data))
+    return apply_op(fn, *args)

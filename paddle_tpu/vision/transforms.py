@@ -210,7 +210,12 @@ def _as_float_hwc(img):
     (reference transforms return what they were given)."""
     orig = np.asarray(img)
     arr = orig.astype(np.float32)
-    scale = 255.0 if arr.max() > 1.5 else 1.0
+    # range from DTYPE for integers (a dark uint8 image is still 0-255);
+    # content heuristic only for floats, where both conventions exist
+    if np.issubdtype(orig.dtype, np.integer):
+        scale = float(np.iinfo(orig.dtype).max)
+    else:
+        scale = 255.0 if arr.max() > 1.5 else 1.0
     was_2d = arr.ndim == 2
     if was_2d:
         arr = arr[:, :, None]
@@ -438,12 +443,17 @@ class RandomErasing(BaseTransform):
         arr = np.array(img)
         if np.random.rand() > self.prob:
             return arr
-        if arr.ndim == 3 and arr.shape[0] in (1, 3):   # CHW
+        # CHW only when the leading dim is channel-like AND the trailing
+        # one is not (a (3, 256, 3) HWC strip stays HWC)
+        chw = (arr.ndim == 3 and arr.shape[0] in (1, 3)
+               and arr.shape[2] not in (1, 3))
+        if chw:
             H, W = arr.shape[1], arr.shape[2]
-            chw = True
         else:
             H, W = arr.shape[0], arr.shape[1]
-            chw = False
+        val = np.asarray(self.value, arr.dtype)
+        if val.ndim == 1:                     # per-channel fill
+            val = val.reshape((-1, 1, 1) if chw else (1, 1, -1))
         area = H * W
         for _ in range(10):
             target = np.random.uniform(*self.scale) * area
@@ -454,8 +464,8 @@ class RandomErasing(BaseTransform):
                 y = np.random.randint(0, H - h + 1)
                 x = np.random.randint(0, W - w + 1)
                 if chw:
-                    arr[:, y:y + h, x:x + w] = self.value
+                    arr[:, y:y + h, x:x + w] = val
                 else:
-                    arr[y:y + h, x:x + w] = self.value
+                    arr[y:y + h, x:x + w] = val
                 break
         return arr
