@@ -37,12 +37,11 @@ from . import static  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
-# the tensor star-import binds paddle_tpu.tensor.linalg over this name —
-# force-import the real namespace module and rebind it
-import importlib as _importlib
-linalg = _importlib.import_module("paddle_tpu.linalg")
+from . import linalg  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from .batch import batch  # noqa: F401
+from . import reader  # noqa: F401
 from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import text  # noqa: F401

@@ -531,13 +531,12 @@ def _auto_block(S):
     for d in range(DEFAULT_BLOCK, 7, -8):
         if S % d == 0:
             return d
-    if S <= 2048:
-        # one whole-sequence block: S^2 f32 scores <= 16MB, still in VMEM
-        return S
+    # S > 512 with no 8-aligned divisor: a whole-sequence block would be
+    # both unaligned and VMEM-hostile — fail with the actionable message
     raise ValueError(
         f"S={S} has no viable flash block (no 8-aligned divisor <= "
-        f"{DEFAULT_BLOCK} and too large for one block); pass "
-        f"block_q/block_k explicitly or pad S")
+        f"{DEFAULT_BLOCK}); pass block_q/block_k explicitly or pad S "
+        f"to a multiple of 128")
 
 
 def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,

@@ -311,8 +311,11 @@ def _vocab_parallel_loss(h, labels, params, cfg, plan):
     h = _ln(h, params["lnf_w"], params["lnf_b"])
     h = _mp_copy(h, plan)
     wte = params["wte"]                            # (V/mp, H) local
-    logits = jnp.einsum("bsh,vh->bsv", h.astype(jnp.float32),
-                        wte.astype(jnp.float32))
+    # bf16 operands, f32 accumulation: full MXU rate with f32-safe softmax
+    # statistics downstream (vs. upcasting operands, which halves+ MXU
+    # throughput for the biggest matmul in the model)
+    logits = jnp.einsum("bsh,vh->bsv", h, wte,
+                        preferred_element_type=jnp.float32)
     local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     gmax = jax.lax.stop_gradient(jax.lax.pmax(local_max, "mp")) \
         if plan.mp > 1 else local_max

@@ -1,0 +1,4 @@
+"""paddle.reader namespace (reference: python/paddle/reader/decorator.py)."""
+from .batch import (  # noqa: F401
+    batch, chain, compose, firstn, map_readers, shuffle,
+)

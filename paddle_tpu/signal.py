@@ -71,6 +71,9 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         (window._data if isinstance(window, Tensor) else jnp.asarray(window))
 
     def fn(a, *w):
+        if a.ndim not in (1, 2):
+            raise ValueError(f"stft expects a (T,) or (B, T) signal, got "
+                             f"shape {a.shape}")
         squeeze = a.ndim == 1
         if squeeze:
             a = a[None]
@@ -84,6 +87,9 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         if center:
             a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
                         mode=pad_mode)
+        if a.shape[-1] < n_fft:
+            raise ValueError(f"signal length {a.shape[-1]} < n_fft {n_fft} "
+                             f"(set center=True or pad the input)")
         n_frames = 1 + (a.shape[-1] - n_fft) // hop_length
         idx = (jnp.arange(n_frames)[:, None] * hop_length
                + jnp.arange(n_fft)[None, :])
@@ -113,6 +119,9 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         (window._data if isinstance(window, Tensor) else jnp.asarray(window))
 
     def fn(spec, *w):
+        if spec.ndim not in (2, 3):
+            raise ValueError(f"istft expects (freq, frames) or (B, freq, "
+                             f"frames), got shape {spec.shape}")
         squeeze = spec.ndim == 2
         if squeeze:
             spec = spec[None]

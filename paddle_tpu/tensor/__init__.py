@@ -47,3 +47,13 @@ def _patch_tensor_methods():
 
 
 _patch_tensor_methods()
+
+
+# Export only ops (and Tensor) — NOT the submodules, which would otherwise
+# leak into the paddle_tpu top level via its star-import and shadow
+# same-named namespace modules there (linalg bit us; math/random/search
+# are waiting to). Root-cause fix for the round-3 linalg shadowing.
+import types as _types
+
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and not isinstance(_v, _types.ModuleType)]

@@ -210,10 +210,12 @@ def _as_float_hwc(img):
     (reference transforms return what they were given)."""
     orig = np.asarray(img)
     arr = orig.astype(np.float32)
-    # range from DTYPE for integers (a dark uint8 image is still 0-255);
-    # content heuristic only for floats, where both conventions exist
+    # integer containers hold 8-bit image content regardless of width (a
+    # dark uint8 image is still 0-255, and int32/int64 pixel arrays are
+    # 0-255 too); content heuristic only for floats, where both conventions
+    # genuinely exist
     if np.issubdtype(orig.dtype, np.integer):
-        scale = float(np.iinfo(orig.dtype).max)
+        scale = 255.0
     else:
         scale = 255.0 if arr.max() > 1.5 else 1.0
     was_2d = arr.ndim == 2

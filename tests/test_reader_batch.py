@@ -1,0 +1,26 @@
+"""paddle.batch + reader combinators (reference: python/paddle/batch.py,
+reader/decorator.py)."""
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.reader as reader
+
+
+def test_batch_sizes_and_drop_last():
+    r = paddle.batch(lambda: iter(range(10)), 3)
+    assert [len(b) for b in r()] == [3, 3, 3, 1]
+    r = paddle.batch(lambda: iter(range(10)), 3, drop_last=True)
+    assert [len(b) for b in r()] == [3, 3, 3]
+    with pytest.raises(ValueError):
+        paddle.batch(lambda: iter([]), 0)
+
+
+def test_reader_combinators():
+    assert list(reader.firstn(lambda: iter(range(10)), 4)()) == [0, 1, 2, 3]
+    assert sorted(reader.shuffle(lambda: iter(range(5)), 2)()) == list(range(5))
+    assert list(reader.chain(lambda: iter([1]), lambda: iter([2]))()) == [1, 2]
+    assert list(reader.map_readers(lambda a, b: a + b,
+                                   lambda: iter([1, 2]),
+                                   lambda: iter([10, 20]))()) == [11, 22]
+    assert list(reader.compose(lambda: iter([(1, 2)]),
+                               lambda: iter([3]))()) == [(1, 2, 3)]
