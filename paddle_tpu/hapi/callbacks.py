@@ -128,6 +128,11 @@ class EarlyStopping(Callback):
         self.baseline = baseline
         self.wait = 0
         self.best = None
+        if mode not in ("auto", "min", "max"):
+            import warnings
+            warnings.warn(f"EarlyStopping: unknown mode {mode!r}, falling "
+                          f"back to 'auto'")
+            mode = "auto"
         if mode == "auto":
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
@@ -196,3 +201,73 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._fh:
             self._fh.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the optimizer LR when the monitored metric plateaus
+    (reference: python/paddle/hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode not in ("auto", "min", "max"):
+            import warnings
+            warnings.warn(f"ReduceLROnPlateau: unknown mode {mode!r}, "
+                          f"falling back to 'auto'")
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        if isinstance(val, (list, tuple)):
+            val = val[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        improved = (self.best is None or
+                    (self.mode == "min" and val < self.best - self.min_delta)
+                    or (self.mode == "max"
+                        and val > self.best + self.min_delta))
+        if improved:
+            self.best = val
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                from ..optimizer.lr import LRScheduler as _Sched
+                opt = self.model._optimizer
+                if isinstance(opt._lr, _Sched):
+                    # an LRScheduler owns the LR; don't fight it (the
+                    # reference warns and skips)
+                    import warnings
+                    warnings.warn("ReduceLROnPlateau: optimizer uses an "
+                                  "LRScheduler; skipping LR reduction")
+                else:
+                    old = opt.get_lr()
+                    new = max(old * self.factor, self.min_lr)
+                    if new < old:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:.2e} -> "
+                                  f"{new:.2e}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

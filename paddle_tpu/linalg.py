@@ -5,5 +5,5 @@ from .tensor.linalg import (  # noqa: F401
     qr, slogdet, solve, svd, triangular_solve,
 )
 from .tensor.extras import (  # noqa: F401
-    cdist, householder_product, lu_unpack, matrix_exp, vector_norm,
+    cdist, cond, householder_product, lu_unpack, matrix_exp, vector_norm,
 )

@@ -26,7 +26,7 @@ _SKIP = {
     "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
     "meshgrid", "to_tensor", "apply_op", "Tensor", "assign", "scatter_nd",
     "builtins_sum", "sum_arrays", "jax_topk", "broadcast_shape", "is_tensor",
-    "tril_indices", "triu_indices",
+    "tril_indices", "triu_indices", "gaussian",
 }
 
 

@@ -425,3 +425,54 @@ def householder_product(x, tau, name=None):
         outs = jax.vmap(one)(flat, ft)
         return outs.reshape(batch + outs.shape[-2:])
     return apply_op(fn, x, tau)
+
+
+# ----------------------------------------------- final census stragglers
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (reference: tensor/linalg.py cond)."""
+    def fn(a):
+        return jnp.linalg.cond(a, p=p)
+    return apply_op(fn, x)
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        sq = jnp.abs(a) ** 2                # abs first: complex-safe
+        if ax is None:
+            return jnp.sqrt(jnp.sum(sq))
+        return jnp.sqrt(jnp.sum(sq, axis=ax, keepdims=keepdim))
+    return apply_op(fn, x)
+
+
+def is_complex(x):
+    return jnp.issubdtype((x._data if isinstance(x, Tensor) else x).dtype,
+                          jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype((x._data if isinstance(x, Tensor) else x).dtype,
+                          jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype((x._data if isinstance(x, Tensor) else x).dtype,
+                          jnp.integer)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    """reference tensor/random.py gaussian (the op behind randn). Creation
+    op — listed in tensor/__init__._SKIP so it never becomes a Tensor
+    method."""
+    from ..core.random import next_key
+    from ..core import dtype as _dtm
+    d = _dtm.convert_dtype(dtype) if dtype else jnp.float32
+    return Tensor(mean + std * jax.random.normal(next_key(), tuple(shape),
+                                                 dtype=d))
+
+
+def shape(input, name=None):
+    """Shape as a tensor (reference tensor/attribute.py shape)."""
+    arr = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(arr.shape, jnp.int32))

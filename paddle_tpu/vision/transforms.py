@@ -210,12 +210,13 @@ def _as_float_hwc(img):
     (reference transforms return what they were given)."""
     orig = np.asarray(img)
     arr = orig.astype(np.float32)
-    # integer containers hold 8-bit image content regardless of width (a
-    # dark uint8 image is still 0-255, and int32/int64 pixel arrays are
-    # 0-255 too); content heuristic only for floats, where both conventions
-    # genuinely exist
+    # integers: 8-bit content regardless of container width (a dark uint8
+    # image is still 0-255; int64 pixel arrays are 0-255 too) UNLESS the
+    # values actually exceed 255 (full-range uint16 scans) — then the dtype
+    # range. Floats keep the content heuristic (both conventions exist).
     if np.issubdtype(orig.dtype, np.integer):
-        scale = 255.0
+        scale = 255.0 if arr.max() <= 255 \
+            else float(np.iinfo(orig.dtype).max)
     else:
         scale = 255.0 if arr.max() > 1.5 else 1.0
     was_2d = arr.ndim == 2

@@ -107,3 +107,24 @@ def test_bert_tiny_fit_dp8(dp_mesh):
         losses.append(l)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] + 0.5     # training, not diverging
+
+
+def test_reduce_lr_on_plateau_callback():
+    """hapi ReduceLROnPlateau (reference hapi/callbacks.py): flat metric
+    shrinks the LR every `patience` epochs; improvement resets the wait."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    net = paddle.nn.Linear(4, 2)
+    m = paddle.Model(net)
+    o = opt.SGD(0.1, parameters=net.parameters())
+    m.prepare(o, paddle.nn.CrossEntropyLoss())
+    cb = ReduceLROnPlateau(patience=1, factor=0.5, verbose=0)
+    cb.model = m
+    cb.on_train_begin()
+    cb.on_epoch_end(0, {"loss": 1.0})          # sets best
+    cb.on_epoch_end(1, {"loss": 1.0})          # plateau -> 0.05
+    assert abs(o.get_lr() - 0.05) < 1e-9
+    cb.on_epoch_end(2, {"loss": 0.5})          # improvement resets wait
+    cb.on_epoch_end(3, {"loss": 0.5})          # plateau -> 0.025
+    assert abs(o.get_lr() - 0.025) < 1e-9
