@@ -49,7 +49,8 @@ def enable_grad():
 class Node:
     """One tape entry: the vjp closure of a single traced op."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "multi_output", "name", "fwd")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "multi_output", "name", "fwd",
+                 "input_versions")
 
     # unhashable on purpose: double-grad records vjp calls through apply_op
     # with the Node in a closure cell, and an identity-hashed Node would fill
@@ -63,6 +64,10 @@ class Node:
         self.outputs = outputs      # list[Tensor]
         self.multi_output = multi_output
         self.name = name
+        # inplace-version snapshot of each input (reference: eager
+        # TensorWrapper::recover checks wrapper_version_snapshot): backward
+        # raises if an input was mutated in place after this op recorded it
+        self.input_versions = [getattr(t, "_version", 0) for t in inputs]
         # closed forward over the diff inputs (raw arrays): lets create_graph
         # re-derive the vjp as a function of the PRIMALS, so second-order
         # terms (which live in the residuals) survive. None => second order
@@ -91,7 +96,14 @@ def _topo_from(root_node):
             continue
         seen.add(id(node))
         stack.append((node, True))
-        for t in node.inputs:
+        for t, ver in zip(node.inputs, node.input_versions):
+            if getattr(t, "_version", 0) != ver:
+                raise RuntimeError(
+                    f"in-place modification error in backward of op "
+                    f"'{node.name}': an input tensor was mutated after the "
+                    f"op recorded it (tensor version "
+                    f"{getattr(t, '_version', 0)} != snapshot {ver}); "
+                    f"clone() the tensor before the in-place op")
             if t._node is not None and id(t._node) not in seen:
                 stack.append((t._node, False))
     return order

@@ -27,7 +27,7 @@ def _is_traced(x) -> bool:
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad_data", "_node", "name",
                  "persistable", "trainable", "_dist_attr", "_asp_mask",
-                 "_hooks", "__weakref__")
+                 "_hooks", "_version", "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
@@ -39,6 +39,11 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.trainable = not stop_gradient
+        # inplace version counter (reference: imperative/variable_wrapper.h
+        # InplaceVersion / eager TensorWrapper version snapshot): bumped on
+        # every in-place mutation; backward raises on mismatch instead of
+        # silently using post-mutation values
+        self._version = 0
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -153,48 +158,89 @@ class Tensor:
 
     # -- in-place helpers ---------------------------------------------------
     def _replace(self, new_tensor):
-        """Adopt another tensor's value+tape (for in-place semantics)."""
+        """Adopt another tensor's value+tape (for in-place semantics).
+
+        When the adopted op consumed `self` (y.tanh_() records tanh(y)),
+        the node's input reference to `self` is swapped for a snapshot of
+        the pre-inplace tensor — otherwise the node would be its own input
+        and backward would never reach the producers of the old value
+        (reference: dygraph inplace keeps the old version alive for the
+        grad graph via TensorWrapper snapshots, eager/tensor_wrapper.h)."""
+        node = new_tensor._node
+        if node is not None and node.inputs:
+            snap = None
+            for i, t in enumerate(node.inputs):
+                if t is self:
+                    if self._node is None and not self.stop_gradient:
+                        # grad would land on the hidden snapshot, invisible
+                        # to the user (reference dygraph raises the same)
+                        raise RuntimeError(
+                            "a leaf Tensor that requires grad cannot be "
+                            "used in an in-place operation; wrap it in "
+                            "no_grad() or use the out-of-place op")
+                    if snap is None:
+                        snap = Tensor(self._data,
+                                      stop_gradient=self.stop_gradient)
+                        snap._node = self._node
+                        snap._version = self._version
+                        old_node = self._node
+                        if old_node is not None:
+                            for j, o in enumerate(old_node.outputs):
+                                if o is self:
+                                    old_node.outputs[j] = snap
+                    node.inputs[i] = snap
         self._data = new_tensor._data
-        self._node = new_tensor._node
-        if self._node is not None:
+        self._node = node
+        self._version += 1
+        if node is not None:
             # rewire node output identity to self so backward reaches us
-            outs = self._node.outputs
+            outs = node.outputs
             for i, o in enumerate(outs):
                 if o is new_tensor:
                     outs[i] = self
-        self.stop_gradient = new_tensor.stop_gradient
+            # inplace under grad keeps (or gains) differentiability; under
+            # no_grad the op result carries stop_gradient=True, which must
+            # NOT freeze a previously-trainable tensor
+            self.stop_gradient = new_tensor.stop_gradient
         return self
 
     def set_value(self, value):
         data = value._data if isinstance(value, Tensor) else jnp.asarray(value, dtype=self.dtype)
         self._data = jnp.broadcast_to(data, tuple(self._data.shape)).astype(self._data.dtype)
+        self._version += 1
         return self
 
     def fill_(self, value):
         self._data = jnp.full_like(self._data, value)
+        self._version += 1
         return self
 
     def zero_(self):
         self._data = jnp.zeros_like(self._data)
+        self._version += 1
         return self
 
     def scale_(self, scale):
         self._data = self._data * scale
+        self._version += 1
         return self
 
     def add_(self, other):
         o = other._data if isinstance(other, Tensor) else other
         self._data = self._data + o
+        self._version += 1
         return self
 
     def subtract_(self, other):
         o = other._data if isinstance(other, Tensor) else other
         self._data = self._data - o
+        self._version += 1
         return self
 
     def multiply_(self, other):
         o = other._data if isinstance(other, Tensor) else other
         self._data = self._data * o
+        self._version += 1
         return self
 
     def copy_(self, other, blocking=True):
@@ -307,6 +353,7 @@ class Tensor:
             self._data = self._data.at[idx].set(
                 jnp.asarray(v).astype(self._data.dtype)
                 if not isinstance(v, numbers.Number) else v)
+            self._version += 1
 
     def __iter__(self):
         for i in range(len(self)):

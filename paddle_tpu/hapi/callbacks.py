@@ -118,6 +118,19 @@ class ModelCheckpoint(Callback):
             self.model.save(f"{self.save_dir}/final")
 
 
+def _resolve_mode(mode, monitor, cls_name):
+    """'auto'/'min'/'max' -> 'min'|'max' (shared by EarlyStopping and
+    ReduceLROnPlateau, mirroring the reference's duplicated blocks)."""
+    if mode not in ("auto", "min", "max"):
+        import warnings
+        warnings.warn(f"{cls_name}: unknown mode {mode!r}, falling back "
+                      f"to 'auto'")
+        mode = "auto"
+    if mode == "auto":
+        mode = "max" if "acc" in monitor else "min"
+    return mode
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -128,14 +141,7 @@ class EarlyStopping(Callback):
         self.baseline = baseline
         self.wait = 0
         self.best = None
-        if mode not in ("auto", "min", "max"):
-            import warnings
-            warnings.warn(f"EarlyStopping: unknown mode {mode!r}, falling "
-                          f"back to 'auto'")
-            mode = "auto"
-        if mode == "auto":
-            mode = "max" if "acc" in monitor else "min"
-        self.mode = mode
+        self.mode = _resolve_mode(mode, monitor, "EarlyStopping")
 
     def on_train_begin(self, logs=None):
         self.wait = 0
@@ -217,14 +223,7 @@ class ReduceLROnPlateau(Callback):
         self.min_delta = abs(min_delta)
         self.cooldown = cooldown
         self.min_lr = min_lr
-        if mode not in ("auto", "min", "max"):
-            import warnings
-            warnings.warn(f"ReduceLROnPlateau: unknown mode {mode!r}, "
-                          f"falling back to 'auto'")
-            mode = "auto"
-        if mode == "auto":
-            mode = "max" if "acc" in monitor else "min"
-        self.mode = mode
+        self.mode = _resolve_mode(mode, monitor, "ReduceLROnPlateau")
         self.wait = 0
         self.cooldown_counter = 0
         self.best = None

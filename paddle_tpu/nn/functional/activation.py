@@ -32,6 +32,14 @@ def silu(x, name=None):
 swish = silu
 
 
+def elu_(x, alpha=1.0, name=None):
+    return x._replace(elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._replace(softmax(x, axis, dtype))
+
+
 def sigmoid(x, name=None):
     return apply_op(jax.nn.sigmoid, x)
 

@@ -17,6 +17,7 @@ from .random import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
+from .inplace import *  # noqa: F401,F403
 
 _METHOD_MODULES = [math, manipulation, linalg, logic, search, stat, creation,
                    extras]

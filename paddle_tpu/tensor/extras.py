@@ -468,8 +468,13 @@ def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
     from ..core.random import next_key
     from ..core import dtype as _dtm
     d = _dtm.convert_dtype(dtype) if dtype else jnp.float32
-    return Tensor(mean + std * jax.random.normal(next_key(), tuple(shape),
-                                                 dtype=d))
+    if isinstance(shape, int):
+        shape = (shape,)
+    shape = tuple(int(s) for s in shape)
+    # nonzero seed = reproducible draw independent of the global generator
+    # (reference gaussian seed attr semantics); seed 0 uses the global stream
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(mean + std * jax.random.normal(key, shape, dtype=d))
 
 
 def shape(input, name=None):

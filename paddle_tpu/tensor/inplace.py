@@ -1,0 +1,75 @@
+"""In-place op variants (`paddle.tanh_`, `x.clip_()`, ...).
+
+The reference exposes an `op_` twin for most unary/binary tensor ops
+(python/paddle/tensor/__init__.py method list; generated in
+python/paddle/tensor/math.py via `generate_inplace_fn` and the
+`@inplace_apis_in_dygraph_only` wrappers). On TPU every array is immutable
+inside XLA, so "in-place" is a frontend notion: compute the out-of-place
+result and rebind the tensor's buffer — exactly what the reference's
+dygraph inplace ops do to the underlying DenseTensor allocation from the
+autograd tape's point of view (the VarBase keeps its identity, the storage
+is replaced).
+
+Like the reference (`core/tensor.py` fill_/zero_/add_ precedent in this
+repo), the tensor object keeps its Python identity, `stop_gradient`, and
+name; only `_data` changes.
+"""
+from ..core.tensor import Tensor
+from . import extras, manipulation, math as _math
+
+__all__ = []
+
+
+def _make_inplace(fn, name):
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        if isinstance(out, Tensor):
+            # _replace adopts _data AND the tape node (rewiring the node's
+            # outputs to x) so backward sees the op — plain `_data =` would
+            # silently drop the gradient contribution.
+            return x._replace(out)
+        x._data = out
+        return x
+    inplace.__name__ = name
+    inplace.__qualname__ = name
+    inplace.__doc__ = (f"In-place variant of `{fn.__name__}`: writes the "
+                       f"result back into `x` and returns it.")
+    return inplace
+
+
+# (public name, source module, functional name)
+_INPLACE_OPS = [
+    ("tanh_", _math, "tanh"),
+    ("clip_", _math, "clip"),
+    ("exp_", _math, "exp"),
+    ("sqrt_", _math, "sqrt"),
+    ("rsqrt_", _math, "rsqrt"),
+    ("reciprocal_", _math, "reciprocal"),
+    ("round_", _math, "round"),
+    ("floor_", _math, "floor"),
+    ("ceil_", _math, "ceil"),
+    ("lerp_", _math, "lerp"),
+    ("erfinv_", _math, "erfinv"),
+    ("remainder_", _math, "remainder"),
+    ("mod_", _math, "remainder"),
+    ("squeeze_", manipulation, "squeeze"),
+    ("unsqueeze_", manipulation, "unsqueeze"),
+    ("flatten_", manipulation, "flatten"),
+    ("reshape_", manipulation, "reshape"),
+    ("scatter_", manipulation, "scatter"),
+    ("put_along_axis_", manipulation, "put_along_axis"),
+    ("index_add_", extras, "index_add"),
+]
+
+for _pub, _mod, _src in _INPLACE_OPS:
+    _fn = getattr(_mod, _src)
+    globals()[_pub] = _make_inplace(_fn, _pub)
+    __all__.append(_pub)
+
+
+def _patch_methods():
+    for pub in __all__:
+        setattr(Tensor, pub, globals()[pub])
+
+
+_patch_methods()

@@ -215,7 +215,8 @@ def _as_float_hwc(img):
     # values actually exceed 255 (full-range uint16 scans) — then the dtype
     # range. Floats keep the content heuristic (both conventions exist).
     if np.issubdtype(orig.dtype, np.integer):
-        scale = 255.0 if arr.max() <= 255 \
+        # 8-bit containers cannot exceed 255: skip the full-array scan
+        scale = 255.0 if (orig.dtype.itemsize == 1 or arr.max() <= 255) \
             else float(np.iinfo(orig.dtype).max)
     else:
         scale = 255.0 if arr.max() > 1.5 else 1.0

@@ -1,0 +1,122 @@
+"""In-place op variants (reference: python/paddle/tensor generate_inplace_fn
+and @inplace_apis_in_dygraph_only surface)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_unary_inplace_identity_and_value():
+    x = paddle.to_tensor(np.array([0.5, -0.25, 2.0], "float32"))
+    ref = np.tanh(x.numpy())
+    out = paddle.tanh_(x)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), ref, rtol=1e-6)
+
+    x = paddle.to_tensor(np.array([-2.0, 0.3, 9.0], "float32"))
+    x.clip_(0.0, 1.0)
+    np.testing.assert_allclose(x.numpy(), [0.0, 0.3, 1.0])
+
+    x = paddle.to_tensor(np.array([1.0, 4.0], "float32"))
+    x.sqrt_()
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+
+
+def test_shape_changing_inplace():
+    x = paddle.to_tensor(np.zeros((2, 1, 3), "float32"))
+    x.squeeze_(1)
+    assert tuple(x.shape) == (2, 3)
+    x.unsqueeze_(0)
+    assert tuple(x.shape) == (1, 2, 3)
+    x.flatten_()
+    assert tuple(x.shape) == (6,)
+    x.reshape_([3, 2])
+    assert tuple(x.shape) == (3, 2)
+
+
+def test_binary_and_indexed_inplace():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    y = paddle.to_tensor(np.array([10.0, 20.0, 30.0], "float32"))
+    x.lerp_(y, 0.5)
+    np.testing.assert_allclose(x.numpy(), [5.5, 11.0, 16.5])
+
+    x = paddle.to_tensor(np.array([7.0, 8.0, 9.0], "float32"))
+    x.remainder_(paddle.to_tensor(np.array([4.0, 4.0, 4.0], "float32")))
+    np.testing.assert_allclose(x.numpy(), [3.0, 0.0, 1.0])
+
+    x = paddle.to_tensor(np.zeros((3, 2), "float32"))
+    upd = paddle.to_tensor(np.ones((2, 2), "float32"))
+    idx = paddle.to_tensor(np.array([0, 2]))
+    x.scatter_(idx, upd)
+    np.testing.assert_allclose(x.numpy(), [[1, 1], [0, 0], [1, 1]])
+
+    x = paddle.to_tensor(np.zeros((3, 3), "float32"))
+    v = paddle.to_tensor(np.ones((2, 3), "float32"))
+    x.index_add_(paddle.to_tensor(np.array([0, 1])), 0, v)
+    assert float(x.numpy().sum()) == 6.0
+
+
+def test_inplace_gradient_flows_through_tape():
+    """In-place ops must adopt the tape node (code-review finding): backward
+    through y.tanh_() must include the tanh derivative."""
+    x = paddle.to_tensor(np.array([0.5, 1.0], "float32"), stop_gradient=False)
+    y = x * 2.0
+    y.tanh_()
+    loss = y.sum()
+    loss.backward()
+    expect = 2.0 * (1.0 - np.tanh(np.array([1.0, 2.0])) ** 2)
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_gaussian_seed_and_int_shape():
+    a = paddle.tensor.extras.gaussian(4, seed=123)
+    b = paddle.tensor.extras.gaussian(4, seed=123)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert tuple(a.shape) == (4,)
+    c = paddle.tensor.extras.gaussian([4])
+    d = paddle.tensor.extras.gaussian([4])
+    assert not np.array_equal(c.numpy(), d.numpy())
+
+
+def test_inplace_on_grad_leaf_raises():
+    """Reference dygraph raises for inplace on a grad-requiring leaf; the
+    gradient would otherwise silently land on a hidden snapshot."""
+    import pytest
+    x = paddle.to_tensor(np.array([0.5], "float32"), stop_gradient=False)
+    with pytest.raises(RuntimeError, match="leaf"):
+        x.tanh_()
+
+
+def test_inplace_under_no_grad_preserves_trainability():
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    with paddle.no_grad():
+        x.clip_(0.0, 1.0)
+    assert not x.stop_gradient
+    np.testing.assert_allclose(x.numpy(), [1.0])
+
+
+def test_inplace_version_mismatch_raises():
+    """Mutating a tensor another op already consumed must raise in backward,
+    not silently produce wrong gradients (reference: inplace version
+    counters, imperative/variable_wrapper.h)."""
+    import pytest
+    a = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    y = a * 2.0
+    z = y * 3.0
+    y.tanh_()                      # mutates y AFTER z recorded it
+    with pytest.raises(RuntimeError, match="version"):
+        z.backward()
+
+
+def test_activation_inplace_and_swish():
+    import paddle_tpu.nn.functional as F
+    x = paddle.to_tensor(np.array([-1.0, 1.0], "float32"))
+    F.elu_(x)
+    np.testing.assert_allclose(x.numpy(), [np.exp(-1) - 1, 1.0], rtol=1e-6)
+
+    x = paddle.to_tensor(np.array([0.0, 1.0], "float32"))
+    F.softmax_(x)
+    np.testing.assert_allclose(x.numpy().sum(), 1.0, rtol=1e-6)
+
+    x = paddle.to_tensor(np.array([2.0], "float32"))
+    np.testing.assert_allclose(F.swish(x).numpy(),
+                               2.0 / (1 + np.exp(-2.0)), rtol=1e-6)
