@@ -88,7 +88,27 @@ def distributed_optimizer(optimizer, strategy=None):
             parameters=optimizer._parameters,
             grad_clip=optimizer._grad_clip)
 
-    opt = HybridParallelOptimizer(optimizer, hcg, strategy)
+    if strategy.dgc:
+        # DGC replaces the HybridParallelOptimizer core: it performs its own
+        # dp sync (the sparsified pmean IS the communication step)
+        from .meta_optimizers import DGCMomentumOptimizer
+        cfg = getattr(strategy, "dgc_configs", {}) or {}
+        opt = DGCMomentumOptimizer(
+            optimizer,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1),
+            sparsity=cfg.get("sparsity", [0.999]),
+            momentum=getattr(optimizer, "_momentum", 0.9))
+    elif strategy.localsgd:
+        # LocalSGD must NOT get the per-step dp grad pmean of
+        # HybridParallelOptimizer — replacing that with k-step parameter
+        # averaging is the entire optimization
+        from .meta_optimizers import LocalSGDOptimizer
+        cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        opt = LocalSGDOptimizer(optimizer, k_steps=cfg.get("k_steps", 1),
+                                begin_step=cfg.get("begin_step", 1))
+    else:
+        opt = HybridParallelOptimizer(optimizer, hcg, strategy)
     if strategy.gradient_merge:
         k = int(strategy.gradient_merge_configs.get("k_steps", 1))
         avg = bool(strategy.gradient_merge_configs.get("avg", True))

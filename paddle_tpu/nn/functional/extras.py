@@ -524,3 +524,32 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     if input_length is not None:
         args += [input_length, label_length]
     return apply_op(fn, *args)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode (reference: fluid/layers/nn.py:5619): argmax per
+    step, merge repeats, drop blanks. Padded-tensor semantics (the modern
+    form with input_length): input (B, T, V) probs/logits, returns
+    (decoded (B, T) padded with padding_value, out_lens (B, 1)). Without
+    input_length all T steps are live (the reference's LoD form is replaced
+    by pad+length, per PARITY LoDTensor policy)."""
+    def fn(x, *rest):
+        B, T, _ = x.shape
+        ids = jnp.argmax(x, axis=-1).astype(jnp.int32)
+        lens = rest[0].reshape(B).astype(jnp.int32) if rest \
+            else jnp.full((B,), T, jnp.int32)
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, jnp.int32), ids[:, :-1]], axis=1)
+        live = jnp.arange(T)[None] < lens[:, None]
+        keep = (ids != blank) & (ids != prev) & live
+        out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+        # compact kept tokens to the front: stable argsort on ~keep
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        gathered = jnp.take_along_axis(ids, order, axis=1)
+        pos_live = jnp.arange(T)[None] < out_len[:, None]
+        decoded = jnp.where(pos_live, gathered, padding_value)
+        return decoded, out_len[:, None]
+
+    args = [input] if input_length is None else [input, input_length]
+    return apply_op(fn, *args, n_outputs=2)

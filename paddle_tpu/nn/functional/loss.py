@@ -184,3 +184,57 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
         return _reduce(loss, reduction)
     args = [logit, label] if normalizer is None else [logit, label, normalizer]
     return apply_op(fn, *args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """Poisson NLL (reference: nn/functional/loss.py poisson_nll_loss):
+    log_input -> exp(x) - y*x; else x - y*log(x+eps). `full` adds the
+    Stirling approximation term for y > 1."""
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Gaussian NLL (reference: nn/functional/loss.py gaussian_nll_loss):
+    0.5*(log(var) + (x-y)^2/var), variance clamped at epsilon."""
+    def fn(x, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(x - y) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label, variance)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Distillation CTR loss (reference: fluid/layers/loss.py:1480,
+    operators/teacher_student_sigmoid_loss_op.cc): label encodes click z and
+    optional teacher score z' (label = -2|-1|z'|1+z'); the loss is the sum
+    of the click sigmoid CE and, when the teacher score exists, the teacher
+    sigmoid CE."""
+    def fn(x, lab):
+        x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+        softplus = lambda t: jnp.maximum(x, 0) - x * t + jnp.log1p(
+            jnp.exp(-jnp.abs(x)))
+        # click term: z = 0 for label in {-2, [0,1)}, z = 1 otherwise
+        z = jnp.where((lab > -2.0 + 1e-6) & (lab < 0.0), 1.0,
+                      jnp.where(lab >= 1.0, 1.0, 0.0))
+        z = jnp.where(lab <= -2.0 + 1e-6, 0.0, z)
+        loss = softplus(z)
+        # teacher term only when z' exists (label >= 0)
+        zprime = jnp.where(lab >= 1.0, lab - 1.0, jnp.maximum(lab, 0.0))
+        has_teacher = (lab >= 0.0)
+        loss = loss + jnp.where(has_teacher, softplus(zprime), 0.0)
+        return loss
+    return apply_op(fn, input, label)

@@ -4,3 +4,4 @@ provided for training/benchmarks and the model zoo lives in
 paddle_tpu.text.models (BERT/GPT/ERNIE)."""
 from . import models  # noqa: F401
 from .datasets import FakeTextDataset, LMDataset  # noqa: F401
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

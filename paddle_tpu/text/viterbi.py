@@ -1,0 +1,99 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py,
+kernel: paddle/phi/kernels/cpu/viterbi_decode_kernel.cc:159-320).
+
+TPU-native: the forward DP and the backtrace are both lax.scans (static
+trip count, no data-dependent Python control flow), with variable sequence
+lengths handled by the same left_length masking scheme as the reference
+kernel. Tag convention with include_bos_eos_tag=True matches the
+reference's split of the transition matrix: row n-1 = start tag, row
+n-2 = stop tag.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+from ..nn import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
+    B, T, N = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+    pot = potentials.astype(jnp.float32)
+    trans = trans.astype(jnp.float32)
+
+    start_trans = trans[N - 1]
+    stop_trans = trans[N - 2]
+
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + start_trans[None]
+        alpha = alpha + jnp.where((lengths == 1)[:, None], stop_trans[None],
+                                  0.0)
+    left0 = lengths - 1
+
+    def fwd(carry, logit_t):
+        alpha, left = carry
+        # (B, prev N, next N): best previous tag per next tag
+        scores = alpha[:, :, None] + trans[None]
+        hist = jnp.argmax(scores, axis=1).astype(jnp.int32)   # (B, N)
+        alpha_nxt = jnp.max(scores, axis=1) + logit_t
+        live = (left > 0)[:, None]
+        alpha = jnp.where(live, alpha_nxt, alpha)
+        if include_bos_eos_tag:
+            alpha = alpha + jnp.where((left == 1)[:, None], stop_trans[None],
+                                      0.0)
+        return (alpha, left - 1), hist
+
+    (alpha, _), historys = jax.lax.scan(
+        fwd, (alpha, left0), jnp.moveaxis(pot[:, 1:], 1, 0))
+
+    scores = jnp.max(alpha, axis=1)
+    last_ids = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+
+    # backtrace: walk historys in reverse; positions past a sequence's
+    # length emit 0 and hold last_ids until the live window is reached
+    # (reference kernel's int-mask choreography, viterbi_decode_kernel.cc:295)
+    def bwd(carry, hist_t):
+        last_ids, left = carry
+        left = left + 1
+        picked = jnp.take_along_axis(hist_t, last_ids[:, None],
+                                     axis=1)[:, 0]
+        upd = jnp.where(left > 0, picked, 0)
+        upd = jnp.where(left == 0, last_ids, upd)
+        new_last = jnp.where(left < 0, last_ids, upd)
+        return (new_last, left), upd
+
+    left_after = left0 - (T - 1)
+    (first_ids, _), rev_path = jax.lax.scan(
+        bwd, (last_ids, left_after), jnp.flip(historys, axis=0))
+    # path = [first steps ... , last_ids*mask(len>=T)]
+    tail = jnp.where(left_after >= 0, last_ids, 0)
+    path = jnp.concatenate(
+        [jnp.flip(jnp.moveaxis(rev_path, 0, 1), axis=1), tail[:, None]],
+        axis=1)
+    return scores, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path. potentials (B,T,N), transition (N,N),
+    lengths (B,). Returns (scores (B,), paths (B,T) int — entries past a
+    sequence's length are 0, matching the reference's padded layout)."""
+    return apply_op(
+        lambda p, t, l: _viterbi(p, t, l, include_bos_eos_tag),
+        potentials, transition_params, lengths, n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,181 @@
+"""DGC + LocalSGD meta-optimizers (reference:
+fleet/meta_optimizers/dgc_optimizer.py, localsgd_optimizer.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet
+
+
+def _tiny_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(32, 8).astype("float32")
+    w_true = rng.rand(8, 1).astype("float32")
+    y = x @ w_true
+    return x, y
+
+
+def _train(optimizer_factory, steps=20):
+    paddle.seed(7)
+    x_np, y_np = _tiny_problem()
+    net = nn.Linear(8, 1)
+    o = optimizer_factory(net)
+    losses = []
+    for _ in range(steps):
+        pred = net(paddle.to_tensor(x_np))
+        loss = ((pred - paddle.to_tensor(y_np)) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    return losses, net
+
+
+def test_dgc_rampup_equals_plain_momentum():
+    """Before rampup_begin_step DGC must track plain Momentum exactly."""
+    def plain(net):
+        return opt.Momentum(learning_rate=0.05, momentum=0.9,
+                            parameters=net.parameters())
+
+    def dgc(net):
+        fleet.init()
+        s = fleet.DistributedStrategy()
+        s.dgc = True
+        s.dgc_configs = {"rampup_begin_step": 1000, "sparsity": [0.999]}
+        return fleet.distributed_optimizer(
+            opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=net.parameters()), strategy=s)
+
+    l_plain, _ = _train(plain, steps=10)
+    l_dgc, _ = _train(dgc, steps=10)
+    np.testing.assert_allclose(l_plain, l_dgc, rtol=1e-5)
+
+
+def test_dgc_sparsified_still_converges_and_masks():
+    """With sparsity on, each step only touches the top fraction of entries,
+    the residual carries the rest, and the loss still falls."""
+    def dgc(net):
+        fleet.init()
+        s = fleet.DistributedStrategy()
+        s.dgc = True
+        s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.5]}
+        return fleet.distributed_optimizer(
+            opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=net.parameters()), strategy=s)
+
+    losses, net = _train(dgc, steps=40)
+    assert losses[-1] < losses[0] * 0.5, losses[::8]
+
+
+def test_dgc_residual_conservation():
+    """Sent + residual must conserve the accumulated velocity: nothing is
+    silently dropped (the DGC paper's correctness invariant)."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer)
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    inner = opt.Momentum(learning_rate=0.1, momentum=0.0,
+                         parameters=net.parameters())
+    o = DGCMomentumOptimizer(inner, rampup_begin_step=0, sparsity=[0.5],
+                             momentum=0.0)
+    x = paddle.to_tensor(np.eye(4, dtype="float32"))
+    y = paddle.to_tensor(np.ones((4, 1), "float32"))
+    w0 = {id(p): p.numpy().astype("float64") for p in net.parameters()}
+    g_total = {id(p): 0.0 for p in net.parameters()}
+    for _ in range(5):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        for p in net.parameters():
+            g_total[id(p)] = g_total[id(p)] + p.grad.numpy().astype("float64")
+        o.step()
+        o.clear_grad()
+    # momentum=0: applied deltas + lr*residual == lr * total grads
+    for p in net.parameters():
+        applied = w0[id(p)] - p.numpy().astype("float64")
+        residual = np.asarray(o._v[id(p)]).astype("float64")
+        np.testing.assert_allclose(applied + 0.1 * residual,
+                                   0.1 * g_total[id(p)], rtol=2e-3,
+                                   atol=1e-6)
+
+
+def test_dgc_honors_clip_and_decay():
+    """Inner optimizer's grad_clip and weight_decay must survive DGC
+    wrapping (code-review finding)."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer)
+    paddle.seed(1)
+    net = nn.Linear(4, 1)
+    clip = nn.ClipGradByGlobalNorm(1e-8)   # crushes every grad to ~0
+    inner = opt.Momentum(learning_rate=0.5, momentum=0.0,
+                         parameters=net.parameters(), grad_clip=clip)
+    o = DGCMomentumOptimizer(inner, rampup_begin_step=0, sparsity=[0.5],
+                             momentum=0.0)
+    w0 = [p.numpy().copy() for p in net.parameters()]
+    x = paddle.to_tensor(np.ones((8, 4), "float32"))
+    y = paddle.to_tensor(np.ones((8, 1), "float32") * 100)
+    loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    o.step()
+    for p, w in zip(net.parameters(), w0):
+        np.testing.assert_allclose(p.numpy(), w, atol=1e-5)
+
+
+def test_dgc_state_dict_roundtrip():
+    """Residuals + rampup position survive save/load (code-review
+    finding: resume must not silently drop unsent gradients)."""
+    def dgc(net):
+        fleet.init()
+        s = fleet.DistributedStrategy()
+        s.dgc = True
+        s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.5]}
+        return fleet.distributed_optimizer(
+            opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=net.parameters()), strategy=s)
+
+    _, net = _train(dgc, steps=5)
+    o = dgc(net)
+    # simulate: train 3 steps, snapshot, train 3 more; vs restore+3
+    x_np, y_np = _tiny_problem()
+    def run(o, n):
+        for _ in range(n):
+            loss = ((net(paddle.to_tensor(x_np)) -
+                     paddle.to_tensor(y_np)) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+    run(o, 3)
+    snap_state = o.state_dict()
+    assert "DGC" in snap_state and o._dgc_steps == 3
+    w_snap = [p.numpy().copy() for p in net.parameters()]
+    run(o, 3)
+    w_after = [p.numpy().copy() for p in net.parameters()]
+    # restore weights + optimizer state, rerun the same 3 steps
+    for p, w in zip(net.parameters(), w_snap):
+        p.set_value(paddle.to_tensor(w))
+    o2 = dgc(net)
+    o2.set_state_dict(snap_state)
+    assert o2._dgc_steps == 3 and o2._v
+    run(o2, 3)
+    for p, w in zip(net.parameters(), w_after):
+        np.testing.assert_allclose(p.numpy(), w, rtol=1e-4, atol=1e-6)
+
+
+def test_localsgd_counts_and_matches_inner_sgd():
+    """Single worker: LocalSGD == the inner optimizer trajectory, and the
+    sync cadence is every k_steps."""
+    def local(net):
+        fleet.init()
+        s = fleet.DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs = {"k_steps": 4, "begin_step": 1}
+        return fleet.distributed_optimizer(
+            opt.SGD(learning_rate=0.05, parameters=net.parameters()),
+            strategy=s)
+
+    def plain(net):
+        return opt.SGD(learning_rate=0.05, parameters=net.parameters())
+
+    l_local, _ = _train(local, steps=12)
+    l_plain, _ = _train(plain, steps=12)
+    np.testing.assert_allclose(l_local, l_plain, rtol=1e-5)

@@ -1,0 +1,114 @@
+"""viterbi_decode, ctc_greedy_decoder, and the new NLL losses
+(reference: python/paddle/text/viterbi_decode.py, fluid/layers/nn.py:5619,
+nn/functional/loss.py)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _brute_viterbi(pot, trans, length, bos_eos):
+    """Enumerate all tag paths of the live prefix (numpy golden)."""
+    T, N = pot.shape
+    L = int(length)
+    n_real = N
+    best, best_path = -1e30, None
+    for path in itertools.product(range(n_real), repeat=L):
+        s = pot[0, path[0]]
+        if bos_eos:
+            s += trans[N - 1, path[0]]
+        for t in range(1, L):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if bos_eos:
+            # kernel adds the stop ROW over tags (viterbi_decode_kernel.cc:249
+            # stop_trans = trans[N-2, :] added elementwise to alpha)
+            s += trans[N - 2, path[L - 1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path) + [0] * (T - L)
+
+
+def test_viterbi_matches_bruteforce():
+    rng = np.random.RandomState(3)
+    B, T, N = 3, 4, 3
+    pot = rng.rand(B, T, N).astype("float32")
+    trans = rng.rand(N, N).astype("float32")
+    lens = np.array([4, 2, 3], "int64")
+    for bos_eos in (False, True):
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+        for b in range(B):
+            gs, gp = _brute_viterbi(pot[b], trans, lens[b], bos_eos)
+            np.testing.assert_allclose(float(scores.numpy()[b]), gs,
+                                       rtol=1e-5)
+            assert paths.numpy()[b].tolist() == gp, (b, bos_eos)
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(0)
+    pot = paddle.to_tensor(rng.rand(2, 5, 4).astype("float32"))
+    trans = paddle.to_tensor(rng.rand(4, 4).astype("float32"))
+    lens = paddle.to_tensor(np.array([5, 3], "int64"))
+    dec = paddle.text.ViterbiDecoder(trans)
+    scores, paths = dec(pot, lens)
+    assert tuple(paths.shape) == (2, 5)
+    assert paths.numpy()[1, 3:].tolist() == [0, 0]
+
+
+def test_ctc_greedy_decoder():
+    # classes: 0..3, blank=3; batch of 2
+    probs = np.zeros((2, 6, 4), "float32")
+    seq0 = [0, 0, 3, 1, 1, 2]       # -> merge -> 0 3 1 2 -> drop blank -> 0 1 2
+    seq1 = [3, 2, 2, 3, 2, 3]       # -> 3 2 3 2 3 -> 2 2
+    for t, c in enumerate(seq0):
+        probs[0, t, c] = 1.0
+    for t, c in enumerate(seq1):
+        probs[1, t, c] = 1.0
+    dec, lens = F.ctc_greedy_decoder(paddle.to_tensor(probs), blank=3,
+                                     padding_value=-1)
+    assert lens.numpy().ravel().tolist() == [3, 2]
+    assert dec.numpy()[0, :3].tolist() == [0, 1, 2]
+    assert dec.numpy()[1, :2].tolist() == [2, 2]
+    assert (dec.numpy()[0, 3:] == -1).all()
+
+    # input_length truncates
+    dec2, lens2 = F.ctc_greedy_decoder(
+        paddle.to_tensor(probs), blank=3,
+        input_length=paddle.to_tensor(np.array([[2], [6]], "int64")))
+    assert lens2.numpy().ravel().tolist() == [1, 2]
+
+
+def test_poisson_and_gaussian_nll():
+    x = paddle.to_tensor(np.array([0.5, 1.0], "float32"))
+    y = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    out = F.poisson_nll_loss(x, y, reduction="none")
+    np.testing.assert_allclose(
+        out.numpy(), np.exp([0.5, 1.0]) - [0.5, 2.0], rtol=1e-6)
+
+    var = paddle.to_tensor(np.array([0.5, 2.0], "float32"))
+    out = F.gaussian_nll_loss(x, y, var, reduction="none")
+    np.testing.assert_allclose(
+        out.numpy(),
+        0.5 * (np.log([0.5, 2.0]) + np.square([0.5 - 1.0, 1.0 - 2.0]) /
+               np.array([0.5, 2.0])), rtol=1e-6)
+
+
+def test_teacher_student_sigmoid_loss():
+    x_np = np.array([[0.3], [-0.2], [1.0], [0.5]], "float32")
+    # labels: -2 (no teacher, no click), -1 (no teacher, click),
+    #         0.7 (teacher 0.7, no click), 1.4 (teacher 0.4, click)
+    lab_np = np.array([[-2.0], [-1.0], [0.7], [1.4]], "float32")
+    out = F.teacher_student_sigmoid_loss(
+        paddle.to_tensor(x_np), paddle.to_tensor(lab_np))
+
+    def sp(x, z):
+        return max(x, 0) - x * z + np.log1p(np.exp(-abs(x)))
+
+    exp = [sp(0.3, 0.0),
+           sp(-0.2, 1.0),
+           sp(1.0, 0.0) + sp(1.0, 0.7),
+           sp(0.5, 1.0) + sp(0.5, 0.4)]
+    np.testing.assert_allclose(out.numpy().ravel(), exp, rtol=1e-5)
