@@ -55,6 +55,76 @@ from .framework.io import save, load  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 from .jit import to_static  # noqa: F401
 
+# --- top-level parity aliases (reference python/paddle/__init__.py __all__)
+import numpy as _np
+
+dtype = _np.dtype                       # paddle.dtype: dtype constructor/type
+bool = bool_                            # noqa: A001  (paddle.bool dtype)
+from .core.device import (  # noqa: F401,E402
+    CUDAPlace, NPUPlace, CUDAPinnedPlace, disable_signal_handler)
+from .nn import ParamAttr  # noqa: F401,E402
+from .distributed.parallel_layers import DataParallel  # noqa: F401,E402
+
+# TPU has one device RNG stream; the cuda-named accessors map onto it
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+floor_mod = mod                         # noqa: F405 (alias, reference math.py)
+reverse = flip                          # noqa: F405 (alias, reference manipulation)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter factory (reference: paddle.create_parameter /
+    fluid/layers/tensor.py create_parameter). Honors ParamAttr's
+    initializer / trainable / regularizer / name the same way
+    Layer.create_parameter does."""
+    from .nn.initializer import Constant, XavierNormal
+    from .nn.param_attr import ParamAttr
+    attr = ParamAttr._to_attr(attr)
+    init = default_initializer
+    if init is None and attr is not None and attr is not False \
+            and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    import jax.numpy as jnp
+    data = init(tuple(shape), jnp.dtype(dtype))
+    p = Parameter(data, name=name)
+    if attr is not None and attr is not False:
+        if attr.name:
+            p.name = attr.name
+        # NB: builtin bool is shadowed by the paddle.bool dtype above
+        p.trainable = not not attr.trainable
+        p.stop_gradient = not attr.trainable
+        if attr.regularizer is not None:
+            p.regularizer = attr.regularizer
+    return p
+
+
+class LazyGuard:
+    """Reference paddle.LazyGuard defers parameter materialization so huge
+    models can be constructed before placement. Under PjRt, initializer ops
+    are dispatched asynchronously and buffers materialize on first use, so
+    eager construction already has lazy cost; the guard is a scope marker
+    kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference exports this helper)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, _np.integer)) and s is not None:
+                raise TypeError(f"invalid dim {s!r} in shape {shape!r}")
+    return shape
+
+
 # paddle.disable_static/enable_static compatibility: we are always "dygraph"
 _static_mode = False
 

@@ -109,3 +109,36 @@ def is_compiled_with_xpu() -> bool:
 
 def is_compiled_with_npu() -> bool:
     return False
+
+
+def CUDAPlace(index: int = 0) -> Place:
+    """Compat shim for reference code written against CUDA (reference:
+    paddle.CUDAPlace): maps to the accelerator place of THIS backend so
+    `paddle.CUDAPlace(0)` call sites keep selecting "the accelerator".
+    Warns once — there is no CUDA device here."""
+    import warnings
+    warnings.warn("CUDAPlace is not a real device on the TPU backend; "
+                  "mapping to the accelerator (TPU) place", stacklevel=2)
+    auto = _auto_place()
+    return Place("tpu", index) if auto.kind == "tpu" else auto
+
+
+def NPUPlace(index: int = 0) -> Place:
+    """Compat shim (reference: paddle.NPUPlace) — see CUDAPlace."""
+    import warnings
+    warnings.warn("NPUPlace is not a real device on the TPU backend; "
+                  "mapping to the accelerator (TPU) place", stacklevel=2)
+    return Place("tpu", index)
+
+
+def CUDAPinnedPlace() -> Place:
+    """Compat shim (reference: paddle.CUDAPinnedPlace): pinned host memory
+    maps to the host place — PjRt host buffers are already DMA-able."""
+    return Place("cpu", 0)
+
+
+def disable_signal_handler():
+    """Reference paddle.disable_signal_handler tears down the C++ fault
+    handlers (platform/init.cc). This runtime installs none (failures
+    surface as Python exceptions from PjRt), so this is a true no-op."""
+    return None

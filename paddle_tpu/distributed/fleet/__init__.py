@@ -90,7 +90,14 @@ def distributed_optimizer(optimizer, strategy=None):
 
     if strategy.dgc:
         # DGC replaces the HybridParallelOptimizer core: it performs its own
-        # dp sync (the sparsified pmean IS the communication step)
+        # dp sync (the sparsified pmean IS the communication step). The
+        # reference restricts DGC to Momentum — its update rule IS momentum
+        # SGD, so wrapping Adam/AdamW would silently swap their math out.
+        if not isinstance(optimizer, (SGD, Momentum, LarsMomentum)):
+            raise TypeError(
+                f"strategy.dgc requires a Momentum/SGD optimizer "
+                f"(reference dgc_optimizer.py restriction); got "
+                f"{type(optimizer).__name__}")
         from .meta_optimizers import DGCMomentumOptimizer
         cfg = getattr(strategy, "dgc_configs", {}) or {}
         opt = DGCMomentumOptimizer(

@@ -200,14 +200,77 @@ class Poisson(Distribution):
         return Tensor(jax.random.poisson(next_key(), self.rate, shp).astype(jnp.float32))
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a KL implementation for a distribution pair
+    (reference: distribution/kl.py register_kl). Dispatch walks the MRO of
+    both arguments, most-derived match first."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
 def kl_divergence(p, q):
+    # registered pairs first (most-derived match wins, reference kl.py
+    # _dispatch total-ordering condensed to MRO scan)
+    for pc in type(p).__mro__:
+        for qc in type(q).__mro__:
+            fn = _KL_REGISTRY.get((pc, qc))
+            if fn is not None:
+                return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
         lp = jax.nn.log_softmax(p.logits, axis=-1)
         lq = jax.nn.log_softmax(q.logits, axis=-1)
         return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+    if isinstance(p, ExponentialFamily) and isinstance(q, ExponentialFamily) \
+            and type(p) is type(q):
+        return _kl_expfamily(p, q)
     raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    distribution/exponential_family.py): subclasses expose natural
+    parameters + log-normalizer and inherit a Bregman-divergence KL.
+
+    Subclass contract: `_natural_parameters` (tuple of Tensors) and
+    `_log_normalizer(*natural_params)`.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+def _kl_expfamily(p, q):
+    """KL(p||q) for same-family members via the Bregman divergence of the
+    log-normalizer (reference exponential_family.py entropy trick): uses
+    jax.grad on the log-normalizer at p's natural parameters."""
+    p_nat = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+             for t in p._natural_parameters]
+    q_nat = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+             for t in q._natural_parameters]
+
+    def lognorm_el(*nat):
+        out = p._log_normalizer(*[Tensor(n) for n in nat])
+        return out._data if isinstance(out, Tensor) else out
+
+    # elementwise Bregman: factorized families separate per batch element,
+    # so grads of the SUMMED log-normalizer are the per-element partials
+    grads = jax.grad(lambda *nat: jnp.sum(lognorm_el(*nat)),
+                     argnums=tuple(range(len(p_nat))))(*p_nat)
+    kl = lognorm_el(*q_nat) - lognorm_el(*p_nat)
+    for g, pn, qn in zip(grads, p_nat, q_nat):
+        kl = kl - g * (qn - pn)
+    return Tensor(kl)
 
 
 class Independent(Distribution):

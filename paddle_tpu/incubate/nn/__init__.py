@@ -50,3 +50,107 @@ class FusedLinear(_Linear):
     """cublasLt fused_gemm_epilogue equivalent: XLA fuses bias+act into the
     matmul automatically, so plain Linear already is the fused op."""
     pass
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: incubate/nn/layer/fused_transformer.py:79 (op:
+    fused_bias_dropout_residual_layer_norm). out = LN(residual + dropout
+    (x + bias)). XLA fuses the chain; the class exists for API parity and
+    owns the LN (+ optional bias) parameters."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-05, name=None):
+        super().__init__()
+        from ...nn.layer.norm import LayerNorm
+        from ...nn.layer.common import Dropout
+        from ...nn.initializer import Constant
+        self.embed_dim = embed_dim
+        self.linear_bias = None if bias_attr is False else \
+            self.create_parameter((embed_dim,), attr=bias_attr, is_bias=True,
+                                  default_initializer=Constant(0.0))
+        self.norm = LayerNorm(embed_dim, epsilon, weight_attr, bias_attr)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, residual):
+        if self.linear_bias is not None:
+            x = x + self.linear_bias
+        return self.norm(residual + self.dropout(x))
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}"
+
+
+class FusedMultiTransformer(Layer):
+    """Inference transformer stack (reference:
+    incubate/nn/layer/fused_transformer.py:914 over
+    fused_multi_transformer_op.cu): pre-LN attention + FFN per layer, with
+    optional per-layer KV caches for autoregressive decode. The CUDA
+    mega-kernel's fusion is XLA's job here; attention runs through the
+    flash kernel on TPU (ops/flash_attention.py) for full sequences and
+    plain dot attention for single-step decode."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise ValueError("FusedMultiTransformer only supports "
+                             "normalize_before=True (same as the reference)")
+        if isinstance(qkv_weight_attrs, (list, tuple)):
+            num_layers = len(qkv_weight_attrs)
+        if num_layers <= 0:
+            raise ValueError("num_layers must be set (or pass per-layer "
+                             "attr lists)")
+        from ...nn.layer.norm import LayerNorm
+        from ...nn.layer.transformer import MultiHeadAttention
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.num_layers = num_layers
+        self.activation = activation
+        self._eps = epsilon
+        self.attns = LayerListHelper([
+            MultiHeadAttention(embed_dim, num_heads, dropout=dropout_rate)
+            for _ in range(num_layers)])
+        self.ffns = LayerListHelper([
+            FusedFeedForward(embed_dim, dim_feedforward,
+                             dropout_rate=dropout_rate,
+                             activation=activation, epsilon=epsilon,
+                             normalize_before=True)
+            for _ in range(num_layers)])
+        self.lns = LayerListHelper([LayerNorm(embed_dim, epsilon)
+                                    for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        out = src
+        new_caches = [] if caches is not None else None
+        for i in range(self.num_layers):
+            residual = out
+            h = self.lns[i](out)
+            if caches is not None:
+                cache = caches[i] if i < len(caches) else None
+                if cache is None:
+                    # short/empty caches list: start this layer's decode
+                    # cache fresh (MHA needs a real cache to return one)
+                    cache = self.attns[i].gen_cache(h[:, :0])
+                h, cache = self.attns[i](h, h, h, attn_mask=attn_mask,
+                                         cache=cache)
+                new_caches.append(cache)
+            else:
+                h = self.attns[i](h, h, h, attn_mask=attn_mask)
+            out = residual + h
+            out = self.ffns[i](out)
+        if new_caches is not None:
+            return out, new_caches
+        return out
+
+
+def LayerListHelper(layers):
+    from ...nn.layer.container import LayerList
+    return LayerList(layers)

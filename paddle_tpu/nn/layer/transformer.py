@@ -4,9 +4,16 @@ The attention core routes through F.scaled_dot_product_attention, which uses
 the Pallas flash-attention kernel when eligible — replacing the reference's
 fused_attention_op.cu CUDA path.
 """
+import collections
+
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor, apply_op
+
+
+def _np_dtype_of(t):
+    d = getattr(t, "dtype", None)
+    return d if d is not None else jnp.float32
 from .. import functional as F
 from .common import Dropout, Linear
 from .container import LayerList
@@ -25,6 +32,13 @@ def _convert_attention_mask(attn_mask, dtype):
 
 
 class MultiHeadAttention(Layer):
+    """reference: nn/layer/transformer.py MultiHeadAttention, incl. the
+    Cache/StaticCache protocol for autoregressive decode (gen_cache +
+    (out, new_cache) returns when a cache is passed)."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -39,23 +53,62 @@ class MultiHeadAttention(Layer):
         self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
-        self._cache = None
+
+    def _kv(self, key, value):
+        B = key.shape[0]
+        k = self.k_proj(key).reshape([B, -1, self.num_heads, self.head_dim])
+        v = self.v_proj(value).reshape([B, -1, self.num_heads, self.head_dim])
+        return k, v
+
+    def gen_cache(self, key, value=None, type=None):
+        """reference MultiHeadAttention.gen_cache: type=StaticCache projects
+        (key, value) once for cross-attention; type=Cache with value given
+        seeds a GROWING cache from pre-projected k/v (UniLM-style prefix);
+        value=None gives an empty growing Cache."""
+        if type is self.StaticCache:
+            k, v = self._kv(key, value if value is not None else key)
+            return self.StaticCache(k, v)
+        if value is not None:
+            if type is None:
+                # back-compat with the reference's two-arg call site for
+                # cross attention: gen_cache(mem, mem) -> StaticCache
+                k, v = self._kv(key, value)
+                return self.StaticCache(k, v)
+            return self.Cache(key, value)   # pre-projected k/v seed
+        B = key.shape[0]
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+        empty = Tensor(jnp.zeros((B, 0, self.num_heads, self.head_dim),
+                                 _np_dtype_of(key)))
+        return self.Cache(empty, empty)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
         B = query.shape[0]
         q = self.q_proj(query).reshape([B, -1, self.num_heads, self.head_dim])
-        k = self.k_proj(key).reshape([B, -1, self.num_heads, self.head_dim])
-        v = self.v_proj(value).reshape([B, -1, self.num_heads, self.head_dim])
-        if cache is not None:
-            k = cache.k.concat_update(k) if hasattr(cache, "k") else k
+        new_cache = None
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+            new_cache = cache          # reference returns (out, cache) for
+                                       # EVERY non-None cache, static too
+        elif isinstance(cache, self.Cache):
+            k_new, v_new = self._kv(key, value)
+            from ...tensor.manipulation import concat
+            k = concat([cache.k, k_new], axis=1)
+            v = concat([cache.v, v_new], axis=1)
+            new_cache = self.Cache(k, v)
+        else:
+            k, v = self._kv(key, value)
         mask = _convert_attention_mask(attn_mask, q.dtype)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=mask, dropout_p=self.dropout,
             training=self.training)
         out = out.reshape([B, -1, self.embed_dim])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
 
 
 class TransformerEncoderLayer(Layer):

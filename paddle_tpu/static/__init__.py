@@ -320,3 +320,6 @@ class nn:
         if activation:
             out = getattr(F, activation)(out)
         return out
+
+
+from .extras import *  # noqa: F401,F403,E402

@@ -2,7 +2,8 @@
 from .lenet import LeNet  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
-    resnext50_32x4d, resnext101_32x4d, wide_resnet50_2, wide_resnet101_2,
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
@@ -18,8 +19,9 @@ from .densenet import (  # noqa: F401
     densenet264,
 )
 from .shufflenetv2 import (  # noqa: F401
-    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
-    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish,
 )
 from .mobilenetv3 import (  # noqa: F401
     MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,

@@ -1,0 +1,65 @@
+"""ExponentialFamily Bregman KL + register_kl dispatch (reference:
+distribution/exponential_family.py, distribution/kl.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Distribution, ExponentialFamily, Normal,
+                                     kl_divergence, register_kl)
+
+
+class _NormalEF(ExponentialFamily):
+    """Normal as exponential family: nat = (mu/s^2, -1/(2 s^2)),
+    log-normalizer = -n1^2/(4 n2) - log(-2 n2)/2."""
+
+    def __init__(self, loc, scale):
+        self.loc = paddle.to_tensor(np.asarray(loc, "float32"))
+        self.scale = paddle.to_tensor(np.asarray(scale, "float32"))
+
+    @property
+    def _natural_parameters(self):
+        s2 = self.scale * self.scale
+        return (self.loc / s2, -0.5 / s2)
+
+    def _log_normalizer(self, n1, n2):
+        import jax.numpy as jnp
+        a = n1._data if hasattr(n1, "_data") else n1
+        b = n2._data if hasattr(n2, "_data") else n2
+        return paddle.Tensor(-a * a / (4 * b) - 0.5 * jnp.log(-2.0 * b))
+
+
+def test_expfamily_bregman_kl_matches_closed_form():
+    p = _NormalEF([0.0, 1.0], [1.0, 2.0])
+    q = _NormalEF([0.5, -1.0], [2.0, 1.0])
+    kl = kl_divergence(p, q).numpy()
+    # closed-form Normal KL
+    mu_p, s_p = np.array([0.0, 1.0]), np.array([1.0, 2.0])
+    mu_q, s_q = np.array([0.5, -1.0]), np.array([2.0, 1.0])
+    expect = (np.log(s_q / s_p) + (s_p**2 + (mu_p - mu_q)**2) / (2 * s_q**2)
+              - 0.5)
+    np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+
+def test_register_kl_dispatch_and_priority():
+    class A(Distribution):
+        pass
+
+    class B(A):
+        pass
+
+    @register_kl(A, A)
+    def _kl_aa(p, q):          # noqa: ANN001
+        return "aa"
+
+    @register_kl(B, A)
+    def _kl_ba(p, q):          # noqa: ANN001
+        return "ba"
+
+    assert kl_divergence(B(), A()) == "ba"     # most-derived first
+    assert kl_divergence(A(), A()) == "aa"
+    assert kl_divergence(B(), B()) == "ba"     # falls back through MRO
+
+
+def test_builtin_normal_kl_still_works():
+    p = Normal(paddle.to_tensor([0.0]), paddle.to_tensor([1.0]))
+    q = Normal(paddle.to_tensor([1.0]), paddle.to_tensor([1.0]))
+    np.testing.assert_allclose(kl_divergence(p, q).numpy(), [0.5], rtol=1e-6)

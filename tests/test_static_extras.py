@@ -1,0 +1,109 @@
+"""paddle.static surface completion (reference: python/paddle/static
+__all__): EMA, auc, py_func, gradients, scope, program state."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import static
+
+
+def test_ema_tracks_and_swaps():
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    ema = static.ExponentialMovingAverage(decay=0.5)
+    p0 = [p.numpy().copy() for p in net.parameters()]
+    ema.update(net.parameters())
+    # move weights, update again
+    for p in net.parameters():
+        with paddle.no_grad():
+            p.set_value(paddle.to_tensor(p.numpy() + 1.0))
+    ema.update()
+    p1 = [p.numpy().copy() for p in net.parameters()]
+    with ema.apply(net.parameters()):
+        # debiased EMA after 2 steps of decay 0.5:
+        # shadow = .5*(.5*0+.5*p0) + .5*p1 ; corr = 1-.25
+        for p, a, b in zip(net.parameters(), p0, p1):
+            expect = (0.25 * a + 0.5 * b) / 0.75
+            np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+    for p, b in zip(net.parameters(), p1):
+        np.testing.assert_allclose(p.numpy(), b)     # restored
+
+
+def test_static_auc_exact():
+    score = np.array([[0.8, 0.2], [0.3, 0.7], [0.4, 0.6], [0.9, 0.1]],
+                     "float32")
+    label = np.array([[0], [1], [1], [0]], "int64")
+    a = static.auc(paddle.to_tensor(score), paddle.to_tensor(label))
+    # positives scores (.7,.6) both above negatives (.2,.1): AUC = 1
+    np.testing.assert_allclose(float(a), 1.0)
+    label2 = np.array([[1], [0], [1], [0]], "int64")
+    a2 = static.auc(paddle.to_tensor(score), paddle.to_tensor(label2))
+    # pos (.2,.6) vs neg (.7,.1): wins = (.2>.1) + (.6>.1) = 2 of 4 pairs
+    np.testing.assert_allclose(float(a2), 0.5)
+
+
+def test_py_func_eager_and_traced():
+    def np_double(a):
+        return a * 2
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    out = static.py_func(np_double, x, out=x)
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones((2, 2)))
+
+    import jax, jax.numpy as jnp
+    def traced(a):
+        t = static.py_func(np_double, paddle.Tensor(a), out=paddle.Tensor(a))
+        return t._data + 1
+    r = jax.jit(traced)(jnp.ones((2, 2), jnp.float32))
+    np.testing.assert_allclose(np.asarray(r), 3 * np.ones((2, 2)))
+
+
+def test_gradients_and_append_backward():
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y = x * x
+    (gx,) = static.gradients([y], [x])
+    np.testing.assert_allclose(gx.numpy(), [4.0])
+
+    net = nn.Linear(3, 1)
+    inp = paddle.to_tensor(np.ones((2, 3), "float32"))
+    loss = net(inp).sum()
+    pgs = static.append_backward(loss)
+    assert pgs and all(g is not None for _, g in pgs)
+
+
+def test_scope_and_global_var():
+    s = static.Scope()
+    with static.scope_guard(s):
+        v = static.create_global_var([2], 3.0, "float32", name="gv")
+        assert static.global_scope().find_var("gv") is v
+    assert static.global_scope().find_var("gv") is None or \
+        static.global_scope() is not s
+
+
+def test_program_state_roundtrip(tmp_path):
+    net = nn.Linear(3, 2)
+    path = str(tmp_path / "prog")
+    static.save(net, path)
+    w0 = [p.numpy().copy() for p in net.parameters()]
+    for p in net.parameters():
+        p.set_value(paddle.to_tensor(np.zeros_like(p.numpy())))
+    static.load(net, path)
+    for p, w in zip(net.parameters(), w0):
+        np.testing.assert_allclose(p.numpy(), w)
+    state = static.load_program_state(path)
+    assert set(state) == {p.name or f"param_{i}"
+                          for i, p in enumerate(net.parameters())} \
+        or len(state) == len(list(net.parameters()))
+
+
+def test_places_and_device_guard():
+    assert static.cpu_places(2)
+    with static.device_guard("cpu"):
+        pass
+
+
+def test_ipu_descoped_raises():
+    with pytest.raises(RuntimeError, match="descoped"):
+        static.IpuStrategy()
