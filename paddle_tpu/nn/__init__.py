@@ -26,3 +26,4 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401

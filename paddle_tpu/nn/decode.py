@@ -1,0 +1,154 @@
+"""Beam-search decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode over the reference's Decoder protocol).
+
+The reference runs the loop as a static-graph While op or an eager python
+loop; on TPU the loop body is a fixed-shape step (batch*beam leading dim),
+so the whole decode jit-compiles cleanly when wrapped — the eager loop
+here is the dygraph path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _map_state(state, fn):
+    if isinstance(state, (list, tuple)):
+        return type(state)(_map_state(s, fn) for s in state)
+    return fn(state)
+
+
+class BeamSearchDecoder:
+    """reference: nn/decode.py BeamSearchDecoder. Wraps an RNN cell; each
+    step embeds the previous token, advances the cell, projects to vocab
+    (`output_fn`), and keeps the `beam_size` best continuations by summed
+    log-probability. Finished beams are frozen (only <end> continues with
+    score 0 accumulation, the reference's noend masking)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # --- decoder protocol (reference Decoder.initialize/step/finalize) ---
+    def initialize(self, initial_cell_states):
+        """Tile batch -> batch*beam; beam 0 gets log-prob 0, others -inf."""
+        states = _map_state(initial_cell_states,
+                            lambda s: jnp.repeat(_d(s), self.beam_size,
+                                                 axis=0))
+        some = states[0] if isinstance(states, (list, tuple)) else states
+        B = some.shape[0] // self.beam_size
+        tokens = jnp.full((B * self.beam_size,), self.start_token, jnp.int32)
+        log_probs = jnp.tile(
+            jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                             jnp.full((self.beam_size - 1,), -1e9)]), (B,))
+        finished = jnp.zeros((B * self.beam_size,), bool)
+        return tokens, (states, log_probs, finished)
+
+    def step(self, time, tokens, beam_state):
+        states, log_probs, finished = beam_state
+        B_beam = tokens.shape[0]
+        B = B_beam // self.beam_size
+        inp = Tensor(tokens)
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        cell_in = [Tensor(s) for s in states] \
+            if isinstance(states, (list, tuple)) else Tensor(states)
+        out = self.cell(inp, cell_in)
+        # RNN cells return (output, new_states)
+        cell_out, new_states = out if isinstance(out, tuple) and \
+            len(out) == 2 else (out, out)
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        lp_step = jax.nn.log_softmax(_d(logits).astype(jnp.float32), axis=-1)
+        V = lp_step.shape[-1]
+        # finished beams: only <end> is allowed, at zero added cost
+        end_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        lp_step = jnp.where(finished[:, None], end_only[None], lp_step)
+        total = log_probs[:, None] + lp_step            # (B*beam, V)
+        total = total.reshape(B, self.beam_size * V)
+        top_lp, top_idx = jax.lax.top_k(total, self.beam_size)
+        beam_src = top_idx // V                          # which parent beam
+        next_tok = (top_idx % V).astype(jnp.int32)
+        gather = (jnp.arange(B)[:, None] * self.beam_size
+                  + beam_src).reshape(-1)
+
+        new_states = _map_state(
+            new_states, lambda s: jnp.take(_d(s), gather, axis=0))
+        next_tok = next_tok.reshape(-1)
+        next_finished = jnp.take(finished, gather) | \
+            (next_tok == self.end_token)
+        # parent slot per new beam: needed to reconstruct sequences —
+        # without it, stacking per-slot tokens interleaves different
+        # beams' histories (reference: gather_tree over parent_ids)
+        parents = beam_src.reshape(-1).astype(jnp.int32)
+        return (next_tok, parents,
+                (new_states, top_lp.reshape(-1), next_finished),
+                next_finished)
+
+    def finalize(self, step_tokens, step_parents, final_state):
+        """Backtrace each surviving beam through the parent pointers
+        (reference: nn/decode.py BeamSearchDecoder.finalize -> gather_tree).
+        step_tokens/step_parents: lists of (B*beam,) arrays, time order."""
+        T = len(step_tokens)
+        B_beam = step_tokens[0].shape[0]
+        beam = self.beam_size
+        B = B_beam // beam
+        slot = jnp.arange(B_beam, dtype=jnp.int32)      # final slots
+        base = (jnp.arange(B_beam, dtype=jnp.int32) // beam) * beam
+        seq = []
+        for t in range(T - 1, -1, -1):
+            seq.append(jnp.take(step_tokens[t], slot))
+            slot = base + jnp.take(step_parents[t], slot)
+        ids = jnp.stack(seq[::-1], axis=-1)             # (B*beam, T)
+        return ids.reshape(B, beam, T)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """reference: nn/decode.py dynamic_decode — run decoder.initialize then
+    step until every beam is finished or max_step_num. Returns
+    (token_ids (B, beam, T), final log_probs (B, beam)) and, with
+    return_length, the per-beam lengths."""
+    tokens, state = decoder.initialize(inits)
+    B_beam = tokens.shape[0]
+    beam = decoder.beam_size
+    B = B_beam // beam
+    step_tokens, step_parents = [], []
+    finished = jnp.zeros((B_beam,), bool)
+    for t in range(int(max_step_num)):
+        tokens, parents, state, step_finished = decoder.step(t, tokens, state)
+        step_tokens.append(tokens)
+        step_parents.append(parents)
+        finished = step_finished
+        # guard FIRST: under jit `finished` is a Tracer and bool() raises;
+        # the compiled path always runs max_step_num steps (static trip)
+        if not isinstance(finished, jax.core.Tracer) and \
+                bool(jnp.all(finished)):
+            break
+    ids = decoder.finalize(step_tokens, step_parents, state)
+    _, log_probs, _ = state
+    out = (Tensor(ids), Tensor(log_probs.reshape(B, beam)))
+    if return_length:
+        # length = tokens up to and including the first <end> on the
+        # RECONSTRUCTED path (per-slot counters would not survive the
+        # parent gathers)
+        T = ids.shape[-1]
+        is_end = ids == decoder.end_token
+        any_end = jnp.any(is_end, axis=-1)
+        first_end = jnp.argmax(is_end, axis=-1)
+        lengths = jnp.where(any_end, first_end + 1, T).astype(jnp.int32)
+        return out + (Tensor(lengths),)
+    return out

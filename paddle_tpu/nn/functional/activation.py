@@ -32,6 +32,10 @@ def silu(x, name=None):
 swish = silu
 
 
+def tanh_(x, name=None):
+    return x._replace(tanh(x))
+
+
 def elu_(x, alpha=1.0, name=None):
     return x._replace(elu(x, alpha))
 

@@ -62,18 +62,14 @@ class MultiHeadAttention(Layer):
 
     def gen_cache(self, key, value=None, type=None):
         """reference MultiHeadAttention.gen_cache: type=StaticCache projects
-        (key, value) once for cross-attention; type=Cache with value given
-        seeds a GROWING cache from pre-projected k/v (UniLM-style prefix);
-        value=None gives an empty growing Cache."""
+        (key, value) once for cross-attention; the DEFAULT type is Cache —
+        with value given it seeds a GROWING cache from pre-projected k/v
+        (UniLM-style prefix, no re-projection); value=None gives an empty
+        growing Cache."""
         if type is self.StaticCache:
             k, v = self._kv(key, value if value is not None else key)
             return self.StaticCache(k, v)
         if value is not None:
-            if type is None:
-                # back-compat with the reference's two-arg call site for
-                # cross attention: gen_cache(mem, mem) -> StaticCache
-                k, v = self._kv(key, value)
-                return self.StaticCache(k, v)
             return self.Cache(key, value)   # pre-projected k/v seed
         B = key.shape[0]
         import jax.numpy as jnp

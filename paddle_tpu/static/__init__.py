@@ -308,18 +308,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return layer, feed_names, fetch_names
 
 
-# paddle.static.nn subset
-class nn:
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
-           activation=None, name=None):
-        from ..nn import functional as F
-        from ..nn.initializer import XavierUniform
-        w = XavierUniform()((int(np.prod(x.shape[num_flatten_dims:])), size), x.dtype)
-        out = F.linear(x.reshape(list(x.shape[:num_flatten_dims]) + [-1]), Tensor(w))
-        if activation:
-            out = getattr(F, activation)(out)
-        return out
+# paddle.static.nn: full layer-fn + control-flow surface (static/nn.py)
+from . import nn  # noqa: E402
 
 
 from .extras import *  # noqa: F401,F403,E402

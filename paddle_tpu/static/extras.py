@@ -349,12 +349,15 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         pos_score = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else \
             x.reshape(x.shape[0], -1)[:, -1]
         y = y.reshape(-1).astype(jnp.float32)
-        order = jnp.argsort(pos_score)
-        ranks = jnp.empty_like(order).at[order].set(
-            jnp.arange(1, y.shape[0] + 1))
+        # average ranks for ties (plain argsort would make tied scores'
+        # AUC depend on input order)
+        srt = jnp.sort(pos_score)
+        lo = jnp.searchsorted(srt, pos_score, side="left")
+        hi = jnp.searchsorted(srt, pos_score, side="right")
+        ranks = (lo + hi + 1) / 2.0
         n_pos = jnp.sum(y)
         n_neg = y.shape[0] - n_pos
-        rank_sum = jnp.sum(jnp.where(y > 0, ranks, 0))
+        rank_sum = jnp.sum(jnp.where(y > 0, ranks, 0.0))
         denom = jnp.maximum(n_pos * n_neg, 1.0)
         return (rank_sum - n_pos * (n_pos + 1) / 2) / denom
     from ..core.tensor import apply_op

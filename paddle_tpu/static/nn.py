@@ -1,0 +1,710 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/__init__.py).
+
+The reference's static-graph layer functions append ops + parameters to a
+Program. Here "static" computations are traced functions, so these helpers
+(a) create the parameters inline (like the original LayerHelper did) and
+(b) express control flow with lax.cond / lax.while_loop / lax.switch —
+the compiler-friendly TPU forms of the reference's ConditionalBlock /
+While ops (paddle/fluid/operators/controlflow/).
+
+Sequence ops: the reference's sequence_* family operates on LoDTensors.
+Per the LoDTensor policy (PARITY.md), variable-length batches here are
+(data, lengths) pairs with padding — each sequence op takes an explicit
+`length` argument where the reference read the LoD.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply_op
+from ..core import dtype as _dt
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "crf_decoding", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "multi_box_head", "nce", "prelu",
+    "py_func", "row_conv", "spectral_norm", "switch_case", "while_loop",
+    "sparse_embedding", "sequence_conv", "sequence_softmax",
+    "sequence_pool", "sequence_concat", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_expand",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+    "sequence_reverse", "StaticRNN",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# --------------------------------------------------------------- control flow
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: static/nn/control_flow.py cond -> lax.cond under a trace,
+    plain python branch eagerly."""
+    d = pred._data if isinstance(pred, Tensor) else pred
+    if isinstance(d, jax.core.Tracer):
+        def wrap(fn):
+            def inner(_):
+                out = fn()
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in (out if isinstance(out, (list, tuple))
+                                  else [out])]
+            return inner
+        outs = jax.lax.cond(jnp.reshape(d, ()), wrap(true_fn),
+                            wrap(false_fn), operand=None)
+        outs = [Tensor(o) for o in outs]
+        return outs if len(outs) > 1 else outs[0]
+    return true_fn() if bool(np.asarray(d).reshape(())) else false_fn()
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: control_flow.py case — first true predicate wins.
+    Traced predicates lower to lax.switch on the index of the first true
+    predicate (the reference nests ConditionalBlocks)."""
+    preds = [p._data if isinstance(p, Tensor) else p
+             for p, _ in pred_fn_pairs]
+    if any(isinstance(d, jax.core.Tracer) for d in preds):
+        stacked = jnp.stack([jnp.reshape(d, ()) for d in preds])
+        # index of first true; all-false selects the default slot
+        first = jnp.argmax(stacked)
+        idx = jnp.where(jnp.any(stacked), first, len(preds))
+        fns = {i: fn for i, (_, fn) in enumerate(pred_fn_pairs)}
+        dflt = default if default is not None else pred_fn_pairs[-1][1]
+        fns[len(preds)] = dflt
+        return switch_case(Tensor(idx), fns)
+    for d, (_, fn) in zip(preds, pred_fn_pairs):
+        if bool(np.asarray(d).reshape(())):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: control_flow.py switch_case -> lax.switch under a trace."""
+    d = branch_index._data if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) and \
+        isinstance(branch_fns[0], (list, tuple)) else \
+        {i: f for i, f in enumerate(branch_fns)} \
+        if isinstance(branch_fns, (list, tuple)) else dict(branch_fns)
+    keys = sorted(fns)
+    if isinstance(d, jax.core.Tracer):
+        def wrap(fn):
+            def inner(_):
+                out = fn()
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in (out if isinstance(out, (list, tuple))
+                                  else [out])]
+            return inner
+        branches = [wrap(fns[k]) for k in keys]
+        if default is not None:
+            branches.append(wrap(default))
+            idx = jnp.searchsorted(jnp.asarray(keys), jnp.reshape(d, ()))
+            hit = jnp.isin(jnp.reshape(d, ()), jnp.asarray(keys))
+            sel = jnp.where(hit, idx, len(keys))
+        else:
+            sel = jnp.clip(jnp.searchsorted(jnp.asarray(keys),
+                                            jnp.reshape(d, ())),
+                           0, len(keys) - 1)
+        outs = jax.lax.switch(sel, branches, None)
+        outs = [Tensor(o) for o in outs]
+        return outs if len(outs) > 1 else outs[0]
+    i = int(np.asarray(d).reshape(()))
+    fn = fns.get(i, default if default is not None else fns[keys[-1]])
+    return fn()
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference: control_flow.py while_loop -> lax.while_loop (compiled,
+    static shapes) when any loop var is traced; python loop eagerly."""
+    datas = [v._data if isinstance(v, Tensor) else v for v in loop_vars]
+    wrap = [isinstance(v, Tensor) for v in loop_vars]
+    traced = any(isinstance(d, jax.core.Tracer) for d in datas)
+
+    def to_user(vals):
+        return [Tensor(v) if w else v for v, w in zip(vals, wrap)]
+
+    def from_user(vals):
+        return tuple(v._data if isinstance(v, Tensor) else v for v in vals)
+
+    if traced:
+        def c(vals):
+            out = cond_fn(*to_user(list(vals)))
+            out = out._data if isinstance(out, Tensor) else out
+            return jnp.reshape(out, ())
+
+        def b(vals):
+            out = from_user(body_fn(*to_user(list(vals))))
+            # carry avals must match exactly (incl. weak_type): re-cast
+            return tuple(jax.lax.convert_element_type(o, d.dtype)
+                         for o, d in zip(out, vals))
+
+        # strip weak types from the init so body outputs can match
+        init = tuple(jax.lax.convert_element_type(jnp.asarray(d),
+                                                  jnp.asarray(d).dtype)
+                     for d in datas)
+        final = jax.lax.while_loop(c, b, init)
+        return to_user(list(final))
+    vals = list(loop_vars)
+    while True:
+        c = cond_fn(*vals)          # evaluate ONCE per iteration
+        c = c._data if isinstance(c, Tensor) else c
+        if not bool(np.asarray(c).reshape(())):
+            break
+        vals = list(body_fn(*vals))
+    return vals
+
+
+# ------------------------------------------------- param-creating layer fns
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..nn import functional as F
+    from ..nn.initializer import XavierUniform
+    w = XavierUniform()((int(np.prod(x.shape[num_flatten_dims:])), size),
+                        x.dtype)
+    out = F.linear(x.reshape(list(x.shape[:num_flatten_dims]) + [-1]),
+                   Tensor(w))
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def _layer_call(layer_cls, x, *args, **kwargs):
+    layer = layer_cls(*args, **kwargs)
+    return layer(x)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW", **kw):
+    from ..nn import BatchNorm2D, BatchNorm1D, BatchNorm3D
+    from ..nn import functional as F
+    C = input.shape[1] if data_layout.startswith("NC") else input.shape[-1]
+    cls = {3: BatchNorm1D, 4: BatchNorm2D, 5: BatchNorm3D}.get(
+        len(input.shape), BatchNorm1D)
+    bn = cls(C, momentum=momentum, epsilon=epsilon, weight_attr=param_attr,
+             bias_attr=bias_attr, data_format=data_layout)
+    if is_test:
+        bn.eval()
+    out = bn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ..nn import Embedding
+    emb = Embedding(size[0], size[1], padding_idx=padding_idx,
+                    weight_attr=param_attr)
+    return emb(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import LayerNorm
+    from ..nn import functional as F
+    shape = list(input.shape[begin_norm_axis:])
+    ln = LayerNorm(shape, epsilon, param_attr if scale else False,
+                   bias_attr if shift else False)
+    out = ln(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import functional as F
+    return F.instance_norm(input, eps=epsilon)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn import GroupNorm
+    from ..nn import functional as F
+    gn = GroupNorm(groups, input.shape[1], epsilon, param_attr, bias_attr,
+                   data_layout)
+    out = gn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference: static/nn/common.py data_norm — normalization by batch
+    statistics WITHOUT learned affine (used by CTR models)."""
+    def fn(x):
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        var = jnp.var(x, axis=0, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + epsilon)
+    from ..nn import functional as F
+    out = apply_op(fn, input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None, use_cudnn=True):
+    from ..nn import Conv2D
+    from ..nn import functional as F
+    conv = Conv2D(input.shape[1], num_filters, filter_size, stride, padding,
+                  dilation, groups, weight_attr=param_attr,
+                  bias_attr=bias_attr, data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None, use_cudnn=True):
+    from ..nn import Conv2DTranspose
+    from ..nn import functional as F
+    conv = Conv2DTranspose(input.shape[1], num_filters, filter_size, stride,
+                           padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None, use_cudnn=True):
+    from ..nn import Conv3D
+    from ..nn import functional as F
+    conv = Conv3D(input.shape[1], num_filters, filter_size, stride, padding,
+                  dilation, groups, weight_attr=param_attr,
+                  bias_attr=bias_attr, data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None, use_cudnn=True):
+    from ..nn import Conv3DTranspose
+    from ..nn import functional as F
+    conv = Conv3DTranspose(input.shape[1], num_filters, filter_size, stride,
+                           padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format)
+    out = conv(input)
+    return getattr(F, act)(out) if act else out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+    conv = DeformConv2D(input.shape[1], num_filters, filter_size, stride,
+                        padding, dilation, deformable_groups, groups,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    return conv(input, offset, mask)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    from ..nn.initializer import Constant
+    n = {"all": 1, "channel": x.shape[1], "element":
+         int(np.prod(x.shape[1:]))}[mode]
+    w = Tensor(Constant(0.25)((n,), x.dtype))
+    if mode == "element":
+        w = w.reshape(list(x.shape[1:]))
+    return F.prelu(x, w, data_format=data_format)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn import SpectralNorm
+    sn = SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                      eps=eps)
+    return sn(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: static/nn/common.py bilinear_tensor_product:
+    out_k = x W_k y^T + b."""
+    from ..nn import Bilinear
+    from ..nn import functional as F
+    bl = Bilinear(x.shape[-1], y.shape[-1], size, weight_attr=param_attr,
+                  bias_attr=bias_attr)
+    out = bl(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: operators/row_conv_op.cc (lookahead conv from DeepSpeech2):
+    out[t] = sum_{i=0..k} W[i] * in[t+i], per feature channel."""
+    from ..nn import functional as F
+    from ..nn.initializer import XavierUniform
+    D = input.shape[-1]
+    k = future_context_size + 1
+    w = Tensor(XavierUniform()((k, D), input.dtype))
+
+    def fn(x, wt):
+        # x: (B, T, D) padded forward in time
+        pads = [(0, 0), (0, k - 1), (0, 0)]
+        xp = jnp.pad(x, pads)
+        out = jnp.zeros_like(x)
+        for i in range(k):
+            out = out + xp[:, i:i + x.shape[1]] * wt[i][None, None, :]
+        return out
+
+    out = apply_op(fn, input, w)
+    return getattr(F, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: operators/nce_op.cc):
+    binary logistic loss on the true class + `num_neg_samples` uniform
+    negatives, per example."""
+    from ..core.random import next_key
+    from ..nn.initializer import XavierUniform, Constant
+    D = input.shape[-1]
+    num_neg = num_neg_samples or 10
+    w = Tensor(XavierUniform()((num_total_classes, D), input.dtype))
+    b = Tensor(Constant(0.0)((num_total_classes,), input.dtype))
+    neg = jax.random.randint(next_key(), (num_neg,), 0, num_total_classes)
+
+    def fn(x, lab, wt, bt):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        pos_logit = jnp.sum(x * wt[lab], axis=-1) + bt[lab]
+        neg_logit = x @ wt[neg].T + bt[neg][None]          # (B, num_neg)
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_logit), axis=-1)
+        return (pos_loss + neg_loss)[:, None]
+
+    return apply_op(fn, input, label, w, b)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference: static/nn/multi_box_head; op
+    prior_box + per-scale loc/conf convs). Returns (mbox_locs, mbox_confs,
+    prior_boxes, variances) concatenated over scales."""
+    from ..nn import functional as F
+    from ..nn.initializer import XavierUniform
+    locs, confs, priors, vars_ = [], [], [], []
+    n_in = len(inputs)
+    if min_sizes is None:
+        # reference ratio interpolation
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(n_in - 2, 1))
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = min_sizes[:n_in]
+        max_sizes = max_sizes[:n_in]
+    H_img = image.shape[2]
+    W_img = image.shape[3]
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        n_prior = len(ar) * (2 if flip else 1) + 2
+        B, C, H, W = feat.shape
+        # prior boxes: centers on the feature grid, sizes from min/max + ars
+        sw = (steps[i] if steps else W_img / W)
+        sh = (steps[i] if steps else H_img / H)
+        cx = (jnp.arange(W) + offset) * sw
+        cy = (jnp.arange(H) + offset) * sh
+        cxg, cyg = jnp.meshgrid(cx, cy)
+        sizes = [(min_sizes[i], min_sizes[i]),
+                 (float(np.sqrt(min_sizes[i] * max_sizes[i])),) * 2]
+        for a in ar:
+            for aa in ([a, 1.0 / a] if flip else [a]):
+                sizes.append((min_sizes[i] * np.sqrt(aa),
+                              min_sizes[i] / np.sqrt(aa)))
+        boxes = []
+        for (bw, bh) in sizes:
+            box = jnp.stack([(cxg - bw / 2) / W_img, (cyg - bh / 2) / H_img,
+                             (cxg + bw / 2) / W_img, (cyg + bh / 2) / H_img],
+                            axis=-1)
+            boxes.append(box)
+        pb = jnp.stack(boxes, axis=2).reshape(-1, 4)      # (H*W*n_prior, 4)
+        if clip:
+            pb = jnp.clip(pb, 0.0, 1.0)
+        priors.append(Tensor(pb))
+        vars_.append(Tensor(jnp.broadcast_to(jnp.asarray(variance),
+                                             pb.shape)))
+        # loc + conf convs
+        wl = Tensor(XavierUniform()((n_prior * 4, C, kernel_size,
+                                     kernel_size), feat.dtype))
+        wc = Tensor(XavierUniform()((n_prior * num_classes, C, kernel_size,
+                                     kernel_size), feat.dtype))
+        loc = F.conv2d(feat, wl, stride=stride, padding=pad)
+        conf = F.conv2d(feat, wc, stride=stride, padding=pad)
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([B, -1, 4]))
+        confs.append(conf.transpose([0, 2, 3, 1]).reshape(
+            [B, -1, num_classes]))
+    from ..tensor.manipulation import concat
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(priors, axis=0), concat(vars_, axis=0))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference: static/nn/common.py sparse_embedding -> PS-backed lookup
+    (distributed/ps SparseEmbedding over the native striped hash table)."""
+    from ..distributed.ps import SparseEmbedding
+    emb = SparseEmbedding(size[0], size[1])
+    return emb(input)
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """reference: operators/crf_decoding_op — viterbi path over linear-chain
+    CRF scores. Routed to paddle.text.viterbi_decode (no BOS/EOS rows)."""
+    from ..text import viterbi_decode
+    trans = param_attr if isinstance(param_attr, Tensor) else _t(param_attr)
+    B, T = input.shape[0], input.shape[1]
+    if length is None:
+        length = Tensor(jnp.full((B,), T, jnp.int32))
+    scores, path = viterbi_decode(input, trans, length,
+                                  include_bos_eos_tag=False)
+    return path
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from .extras import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# ------------------------------------------------------------ sequence ops
+def _lens(x, length):
+    if length is None:
+        return jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    d = length._data if isinstance(length, Tensor) else jnp.asarray(length)
+    return d.reshape(-1).astype(jnp.int32)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    from ..nn import functional as F
+    return F.sequence_mask(x, maxlen, dtype)
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """(B, T, ...) already-padded layout: overwrite positions past `length`
+    with pad_value (reference pads raggeds; here padding is re-asserted)."""
+    def fn(xd, pv, ln):
+        T = xd.shape[1]
+        live = jnp.arange(T)[None] < ln[:, None]
+        shape = live.shape + (1,) * (xd.ndim - 2)
+        return jnp.where(live.reshape(shape), xd, pv)
+    ln = _lens(x, length)
+    return apply_op(lambda xd, pv: fn(xd, pv, ln), x, _t(pad_value)), \
+        Tensor(ln)
+
+
+def sequence_unpad(x, length, name=None):
+    """Mask positions past length to 0 (stays padded: see module note)."""
+    def fn(xd, ln):
+        T = xd.shape[1]
+        live = jnp.arange(T)[None] < ln.reshape(-1, 1)
+        return jnp.where(live.reshape(live.shape + (1,) * (xd.ndim - 2)),
+                         xd, 0)
+    return apply_op(lambda xd: fn(xd, _lens(x, length)), x)
+
+
+def sequence_softmax(input, length=None, name=None):
+    def fn(x, ln):
+        live = jnp.arange(x.shape[1])[None] < ln[:, None]
+        masked = jnp.where(live, x, -jnp.inf)
+        return jnp.where(live, jax.nn.softmax(masked, axis=1), 0.0)
+    return apply_op(lambda x: fn(x, _lens(input, length)), input)
+
+
+def sequence_pool(input, pool_type="max", length=None, pad_value=0.0):
+    def fn(x, ln):
+        T = x.shape[1]
+        live = jnp.arange(T)[None] < ln[:, None]
+        shape = live.shape + (1,) * (x.ndim - 2)
+        lv = live.reshape(shape)
+        if pool_type in ("max",):
+            return jnp.max(jnp.where(lv, x, -jnp.inf), axis=1)
+        if pool_type in ("min",):
+            return jnp.min(jnp.where(lv, x, jnp.inf), axis=1)
+        s = jnp.sum(jnp.where(lv, x, 0), axis=1)
+        if pool_type == "sum":
+            return s
+        n = jnp.maximum(ln, 1).reshape((-1,) + (1,) * (x.ndim - 2))
+        if pool_type == "average" or pool_type == "mean":
+            return s / n
+        if pool_type == "sqrt":
+            return s / jnp.sqrt(n.astype(x.dtype))
+        if pool_type == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            return jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            )[:, 0]
+        if pool_type == "first":
+            return x[:, 0]
+        raise ValueError(f"pool_type {pool_type}")
+    return apply_op(lambda x: fn(x, _lens(input, length)), input)
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_concat(input, name=None):
+    """Concatenate along time (padded layout: plain concat on axis 1)."""
+    from ..tensor.manipulation import concat
+    return concat(list(input), axis=1)
+
+
+def sequence_slice(input, offset, length, name=None):
+    def fn(x, off, ln):
+        T = x.shape[1]
+        idx = off.reshape(-1, 1) + jnp.arange(T)[None]
+        live = jnp.arange(T)[None] < ln.reshape(-1, 1)
+        idx = jnp.clip(idx, 0, T - 1)
+        g = jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+        return jnp.where(live.reshape(live.shape + (1,) * (x.ndim - 2)),
+                         g, 0)
+    return apply_op(fn, input, _t(offset), _t(length))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Padded-layout expand: tile each row of x `rep` times to match y's
+    batch (the LoD-driven general case needs raggeds; repeat-factor
+    expansion covers the common usage)."""
+    def fn(xd, yd):
+        rep = yd.shape[0] // xd.shape[0]
+        return jnp.repeat(xd, rep, axis=0)
+    return apply_op(fn, x, y)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim):
+    def fn(x):
+        B = x.shape[0]
+        return x.reshape(B, -1, new_dim)
+    return apply_op(fn, input)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    def fn(x, idx, upd):
+        return x.at[jnp.arange(x.shape[0])[:, None],
+                    idx.astype(jnp.int32)].add(upd)
+    return apply_op(fn, input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    def fn(x):
+        B, T = x.shape[:2]
+        idx = jnp.arange(T)[:, None] + jnp.arange(win_size)[None]
+        valid = idx < T
+        idx = jnp.clip(idx, 0, T - 1)
+        g = x[:, idx]                       # (B, T, win)
+        return jnp.where(valid[None], g, pad_value)
+    return apply_op(fn, input)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse each sequence within its live prefix, padding stays put."""
+    def fn(xd, ln):
+        T = xd.shape[1]
+        ar = jnp.arange(T)[None]
+        idx = jnp.where(ar < ln[:, None], ln[:, None] - 1 - ar, ar)
+        return jnp.take_along_axis(
+            xd, idx.reshape(idx.shape + (1,) * (xd.ndim - 2)), axis=1)
+    return apply_op(lambda xd: fn(xd, _lens(x, length)), x)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, act=None,
+                  param_attr=None, bias_attr=None, name=None):
+    """reference: operators/sequence_ops/sequence_conv_op — context-window
+    convolution over time: concat the window features, project."""
+    from ..nn import functional as F
+    from ..nn.initializer import XavierUniform
+    D = input.shape[-1]
+    w = Tensor(XavierUniform()((filter_size * D, num_filters), input.dtype))
+    start = padding_start if padding_start is not None \
+        else -(filter_size // 2)
+
+    def fn(x, wt):
+        B, T, _ = x.shape
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            rolled = jnp.roll(x, -off, axis=1)
+            ar = jnp.arange(T)
+            valid = ((ar + off) >= 0) & ((ar + off) < T)
+            cols.append(jnp.where(valid[None, :, None], rolled, 0))
+        ctx = jnp.concatenate(cols, axis=-1)           # (B, T, k*D)
+        return ctx @ wt
+    out = apply_op(fn, input, w)
+    return getattr(F, act)(out) if act else out
+
+
+class StaticRNN:
+    """reference: static/nn/control_flow.py StaticRNN — an unrolled RNN
+    builder. Here the step function runs eagerly per time step (the jit
+    boundary belongs around the whole model on TPU)."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._memories = []     # (init, current) pairs by index
+        self._outputs = []
+        self._built = False
+
+    def step(self):
+        import contextlib
+        return contextlib.nullcontext(self)
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        self._T = x.shape[1] if len(x.shape) > 1 else x.shape[0]
+        return _SeqSlot(self, len(self._inputs) - 1)
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0):
+        if init is None:
+            B = batch_ref.shape[0]
+            init = Tensor(jnp.full((B,) + tuple(shape), value))
+        self._memories.append({"init": init, "updates": None})
+        return _MemSlot(self, len(self._memories) - 1)
+
+    def update_memory(self, mem_slot, new_val):
+        self._memories[mem_slot.idx]["updates"] = new_val
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        raise RuntimeError("StaticRNN here is a builder facade; use "
+                           "nn.RNN / lax.scan for the compiled path")
+
+
+class _SeqSlot:
+    def __init__(self, rnn, idx):
+        self.rnn = rnn
+        self.idx = idx
+
+
+class _MemSlot:
+    def __init__(self, rnn, idx):
+        self.idx = idx

