@@ -48,6 +48,7 @@ from . import text  # noqa: F401
 from . import device  # noqa: F401
 from . import version  # noqa: F401
 from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
 from . import geometric  # noqa: F401
 

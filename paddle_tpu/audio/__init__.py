@@ -6,3 +6,6 @@ MXU matmul over the power spectrogram; dct likewise. All layers trace/jit.
 """
 from . import functional  # noqa: F401
 from .features import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401

@@ -16,6 +16,10 @@ from .env import (  # noqa: F401
 )
 from .parallel_layers import DataParallel  # noqa: F401
 from .store import TCPStore  # noqa: F401
+from .comm_extras import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
+    QueueDataset, ShowClickEntry, all_gather_object, gloo_barrier,
+    gloo_init_parallel_env, gloo_release, irecv, isend, split)
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

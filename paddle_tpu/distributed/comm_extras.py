@@ -1,0 +1,248 @@
+"""distributed namespace completion (reference: python/paddle/distributed/
+__init__.py __all__): async send/recv facades, object collectives, the
+tensor-parallel `split` helper, ParallelMode, gloo shims, and the PS
+entry-attr config classes.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as C
+from . import env
+
+__all__ = [
+    "isend", "irecv", "all_gather_object", "split", "ParallelMode",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset",
+]
+
+
+class ParallelMode:
+    """reference: fleet/base/topology.py:29 ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class _Task:
+    """Completed-communication handle (reference ProcessGroup::Task). XLA
+    collectives complete by data dependency, so the task is born done;
+    wait() just materializes the result."""
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+
+    def wait(self):
+        d = self._tensor._data if isinstance(self._tensor, Tensor) else None
+        if d is not None:
+            jax.block_until_ready(d)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send (reference: distributed/communication isend). Returns a
+    Task; the send itself rides the same path as send()."""
+    C.send(tensor, dst, group)
+    return _Task(tensor)
+
+
+def irecv(tensor, src=0, group=None):
+    C.recv(tensor, src, group)
+    return _Task(tensor)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather arbitrary picklable objects (reference: collective.py:1052):
+    pickle -> uint8 tensor -> all_gather -> unpickle. Single-controller
+    SPMD: every rank's object is this process's view."""
+    n = env.get_world_size()
+    payload = pickle.dumps(obj)
+    arr = Tensor(jnp.asarray(np.frombuffer(payload, np.uint8)))
+    gathered = []
+    C.all_gather(gathered, arr, group=group)
+    del object_list[:]
+    for g in gathered[:n] or [arr] * n:
+        object_list.append(pickle.loads(bytes(np.asarray(
+            g._data if isinstance(g, Tensor) else g).astype(np.uint8))))
+    return object_list
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Tensor-parallel op splitter (reference:
+    fleet/layers/mpu/mp_ops.py:582): builds the parallel embedding /
+    column-parallel / row-parallel layer for the current mp group and
+    applies it. On a 1-device group this is the plain op (the TPU build's
+    mp sharding happens via mesh axes; the layer classes carry the
+    Megatron semantics either way)."""
+    from .fleet.layers.mp_layers import (ColumnParallelLinear,
+                                         RowParallelLinear,
+                                         VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 1:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=not gather_out)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"split: unsupported operation {operation!r} "
+                     f"(embedding|linear)")
+
+
+# ------------------------------------------------------------- gloo shims
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: parallel.py gloo_init_parallel_env — CPU rendezvous.
+    The mesh/jax.distributed path covers rendezvous here; the gloo
+    functions map to it for API compatibility."""
+    env.init_parallel_env()
+
+
+def gloo_barrier():
+    C.barrier()
+
+
+def gloo_release():
+    return None
+
+
+# ----------------------------------------------------- PS entry attrs
+class EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """reference: distributed/entry_attr.py:59 — probabilistic admission of
+    new sparse features into the PS table."""
+
+    def __init__(self, probability):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """reference: entry_attr.py:100 — admit a feature only after it has
+    been seen `count_filter` times."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """reference: entry_attr.py:142 — show/click-weighted embedding
+    updates (CTR models)."""
+
+    def __init__(self, show_name, click_name):
+        self._show = str(show_name)
+        self._click = str(click_name)
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+# ----------------------------------------------------- PS datasets
+class InMemoryDataset:
+    """reference: distributed/fleet/dataset InMemoryDataset (C++
+    data_set.cc): loads slot files into memory, supports local/global
+    shuffle, then feeds training. Condensed host implementation over
+    numpy batches — the native shm-ring DataLoader (io/) is the TPU
+    build's high-throughput path; this class keeps PS-style training
+    scripts runnable."""
+
+    def __init__(self):
+        self._filelist = []
+        self._records = []
+        self._parse_fn = None
+        self._batch_size = 1
+        self._thread = 1
+        self._use_var = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", **kwargs):
+        self._batch_size = batch_size
+        self._thread = thread_num
+        self._use_var = use_var
+
+    set_batch_size = lambda self, b: setattr(self, "_batch_size", b)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_parse_fn(self, fn):
+        self._parse_fn = fn
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    rec = self._parse_fn(line) if self._parse_fn else \
+                        line.split()
+                    self._records.append(rec)
+
+    def local_shuffle(self):
+        np.random.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-controller: global == local
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        for i in range(0, len(self._records), self._batch_size):
+            yield self._records[i:i + self._batch_size]
+
+
+class QueueDataset(InMemoryDataset):
+    """reference: QueueDataset — streaming variant; here iteration reads
+    files lazily instead of preloading."""
+
+    def load_into_memory(self):
+        raise RuntimeError("QueueDataset streams from files; iterate "
+                           "directly (reference raises the same)")
+
+    def __iter__(self):
+        batch = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    rec = self._parse_fn(line.rstrip("\n")) \
+                        if self._parse_fn else line.split()
+                    batch.append(rec)
+                    if len(batch) == self._batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch

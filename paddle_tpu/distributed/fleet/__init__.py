@@ -188,3 +188,103 @@ class PaddleCloudRoleMaker:
 
     def is_first_worker(self):
         return _env.get_rank() == 0
+
+
+class Role:
+    """reference: fleet/base/role_maker.py Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class UtilBase:
+    """reference: fleet/utils/fleet_util.py UtilBase — cross-worker helper
+    ops surfaced on fleet.util. Single-controller: reductions are local."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        arr = np.asarray(input)
+        return {"sum": arr, "max": arr, "min": arr}[mode]
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def get_file_shard(self, files):
+        n = worker_num()
+        i = worker_index()
+        return list(files)[i::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
+class Fleet:
+    """reference: fleet/fleet.py Fleet — the class behind the module-level
+    facade; instantiating gives an object with the same surface."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        return init(role_maker, is_collective, strategy, log_level)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+
+class MultiSlotDataGenerator:
+    """reference: distributed/fleet/data_generator — subclass and implement
+    generate_sample(line) yielding [(slot_name, [ids...]), ...]; run()
+    streams stdin lines to stdout in the slot wire format the
+    DataFeed/Dataset path consumes."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, record):
+        parts = []
+        for _slot, ids in record:
+            parts.append(str(len(ids)))
+            parts.extend(str(i) for i in ids)
+        return " ".join(parts)
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for rec in (gen() if callable(gen) else gen):
+                out.append(self._format(rec))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line.rstrip("\n"))
+            for rec in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(rec) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (ids stay strings)."""
+    pass

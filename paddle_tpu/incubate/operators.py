@@ -1,0 +1,145 @@
+"""incubate operators (reference: python/paddle/incubate/operators/):
+graph sampling/reindex, fused softmax-mask, segment reductions re-exported
+at the incubate level, identity_loss.
+
+Graph ops are eager/host-side by design in the reference too (they drive
+GNN minibatch construction, not device compute); sampling runs in numpy,
+the gathered tensors go to the device afterwards.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..geometric import (segment_max, segment_mean,  # noqa: F401
+                         segment_min, segment_sum)
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """reference: incubate/operators/graph_send_recv.py — gather x rows at
+    src_index, reduce into dst_index slots."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniform neighbor sampling over a CSC graph (reference:
+    graph_sample_neighbors.py; kernel phi/kernels/gpu/
+    graph_sample_neighbors_kernel.cu). Host-side numpy sampling."""
+    rown, colp, nodes = _np(row), _np(colptr), _np(input_nodes).reshape(-1)
+    rng = np.random.default_rng(0)
+    out_nb, out_cnt, out_eids = [], [], []
+    eid = _np(eids) if eids is not None else None
+    for n in nodes:
+        beg, end = int(colp[n]), int(colp[n + 1])
+        neigh = rown[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size > 0 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh = neigh[pick]
+            ids = ids[pick]
+        out_nb.append(neigh)
+        out_cnt.append(len(neigh))
+        if return_eids and eid is not None:
+            out_eids.append(eid[ids])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_nb)
+                                   if out_nb else np.zeros(0, rown.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_cnt, np.int32)))
+    if return_eids:
+        e = Tensor(jnp.asarray(np.concatenate(out_eids)
+                               if out_eids else np.zeros(0, np.int64)))
+        return neighbors, counts, e
+    return neighbors, counts
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop sampling = repeated neighbor sampling with frontier growth
+    (reference: graph_khop_sampler.py). Returns (edge_src, edge_dst,
+    sample_index, reindex_x) like the reference."""
+    nodes = _np(input_nodes).reshape(-1)
+    all_src, all_dst = [], []
+    frontier = nodes
+    seen = list(nodes)
+    for k in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr,
+                                         Tensor(jnp.asarray(frontier)),
+                                         sample_size=int(k))
+        nbn, cntn = _np(nb), _np(cnt)
+        dst = np.repeat(frontier, cntn)
+        all_src.append(nbn)
+        all_dst.append(dst)
+        frontier = np.unique(nbn)
+        seen.extend(frontier.tolist())
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    uniq = np.asarray(sorted(set(seen)), dtype=src.dtype if src.size
+                      else np.int64)
+    remap = {int(v): i for i, v in enumerate(uniq)}
+    src_r = np.asarray([remap[int(s)] for s in src], np.int64)
+    dst_r = np.asarray([remap[int(d)] for d in dst], np.int64)
+    return (Tensor(jnp.asarray(src_r)), Tensor(jnp.asarray(dst_r)),
+            Tensor(jnp.asarray(uniq)),
+            Tensor(jnp.asarray(np.asarray([remap[int(n)] for n in nodes],
+                                          np.int64))))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """reference: graph_reindex.py — contiguous reindex of (x ∪ neighbors).
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    xs, nb, cnt = _np(x).reshape(-1), _np(neighbors), _np(count)
+    out_nodes, remap = [], {}
+    for v in np.concatenate([xs, nb]):
+        if int(v) not in remap:
+            remap[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    reindex_src = np.asarray([remap[int(v)] for v in nb], np.int64)
+    dst = np.repeat(xs, cnt[:len(xs)])
+    reindex_dst = np.asarray([remap[int(v)] for v in dst], np.int64)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate/operators/softmax_mask_fuse.py (CUDA fused
+    kernel fused_softmax_mask op): softmax(x + mask) — one XLA fusion."""
+    return apply_op(
+        lambda a, m: jnp.asarray(
+            jnp.exp(a + m - jnp.max(a + m, -1, keepdims=True))
+            / jnp.sum(jnp.exp(a + m - jnp.max(a + m, -1, keepdims=True)),
+                      -1, keepdims=True)), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: softmax_mask_fuse_upper_triangle.py — causal-masked
+    softmax over the last two dims (scores masked above the diagonal)."""
+    def fn(a):
+        S = a.shape[-1]
+        row = jnp.arange(a.shape[-2])[:, None]
+        col = jnp.arange(S)[None]
+        masked = jnp.where(row >= col, a, -1e9)
+        import jax
+        return jax.nn.softmax(masked, axis=-1)
+    return apply_op(fn, x)
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate identity_loss op (IPU training marker): returns
+    x reduced — the graph identity that marks a loss output."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return apply_op(jnp.mean, x)
+    if red == "sum":
+        return apply_op(jnp.sum, x)
+    return x

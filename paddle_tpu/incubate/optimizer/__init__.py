@@ -1,2 +1,3 @@
 """paddle.incubate.optimizer — functional optimizers."""
 from . import functional  # noqa: F401
+from .wrappers import LookAhead, ModelAverage  # noqa: F401

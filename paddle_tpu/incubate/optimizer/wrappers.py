@@ -1,0 +1,129 @@
+"""LookAhead and ModelAverage optimizer wrappers (reference:
+python/paddle/incubate/optimizer/lookahead.py, modelaverage.py)."""
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+class LookAhead:
+    """k-step lookahead (Zhang et al. 2019; reference lookahead.py:33):
+    the inner ("fast") optimizer steps normally; every k steps the slow
+    weights move alpha of the way toward the fast weights and the fast
+    weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._count = 0
+        self._slow = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._count += 1
+        if self._count % self.k:
+            return
+        for p in self.inner_optimizer._parameters:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = jnp.zeros_like(p._data)
+                # first window: slow weights start from the pre-training
+                # value being 0 would be wrong — seed from current fast
+                slow = p._data
+                self._slow[id(p)] = slow
+                continue
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            p._data = slow
+            p._version += 1
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+    def state_dict(self):
+        out = dict(self.inner_optimizer.state_dict())
+        out["LookAhead"] = {"count": self._count,
+                            "slow": {str(i): Tensor(self._slow[id(p)])
+                                     for i, p in enumerate(
+                                         self.inner_optimizer._parameters)
+                                     if id(p) in self._slow}}
+        return out
+
+    def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
+        la = state_dict.pop("LookAhead", None)
+        self.inner_optimizer.set_state_dict(state_dict)
+        if la:
+            self._count = int(la.get("count", 0))
+            params = list(self.inner_optimizer._parameters)
+            for i_str, v in la.get("slow", {}).items():
+                i = int(i_str)
+                if i < len(params):
+                    self._slow[id(params[i])] = (
+                        v._data if isinstance(v, Tensor) else jnp.asarray(v))
+
+
+class ModelAverage:
+    """Running average of parameters over training (reference
+    modelaverage.py:29: sum_1/sum_2/sum_3 windowed accumulators condensed
+    to one running sum + count, same average within a window), with
+    apply()/restore() swapping like the reference."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._sum = {}
+        self._cnt = 0
+        self._backup = {}
+
+    def step(self):
+        """Accumulate after each optimizer step."""
+        self._cnt += 1
+        if self._cnt > self._max_w:
+            # restart window (reference rolls sum_1/2/3)
+            self._sum = {id(p): jnp.zeros_like(p._data)
+                         for p in self._params}
+            self._cnt = 1
+        for p in self._params:
+            s = self._sum.get(id(p))
+            self._sum[id(p)] = p._data if s is None else s + p._data
+
+    update = step
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = {id(p): p._data for p in self._params}
+            n = max(self._cnt, 1)
+            for p in self._params:
+                if id(p) in self._sum:
+                    p._data = (self._sum[id(p)] / n).astype(p._data.dtype)
+                    p._version += 1
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+                p._version += 1
+        self._backup = {}

@@ -214,3 +214,34 @@ def not_to_static(fn=None):
 
 class TracedLayer:
     pass
+
+
+class ProgramTranslator:
+    """reference: dygraph_to_static/program_translator.py:1001 — global
+    switch for to_static. Here tracing is always available; enable_to_static
+    toggles whether @to_static actually jits (parity switch)."""
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: jit/dy2static set_code_level — controls transformed-code
+    logging. Tracing has no AST transforms here; records the level."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)

@@ -1,0 +1,17 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py — delegates to the
+external paddle2onnx package). The TPU-native serialized interchange format
+is StableHLO via jax.export (jit.save / static.save_inference_model); ONNX
+export would require an out-of-repo converter exactly as the reference
+requires paddle2onnx, so export() raises with the supported alternative.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise RuntimeError(
+        "ONNX export needs the external paddle2onnx-equivalent converter "
+        "(the reference delegates too, python/paddle/onnx/export.py). "
+        "Portable serving artifacts here are StableHLO: use "
+        "paddle_tpu.jit.save(layer, path, input_spec) and serve with "
+        "paddle_tpu.inference.Predictor")

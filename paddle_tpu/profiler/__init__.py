@@ -328,3 +328,30 @@ class Profiler:
                   f"{r['max_ms']:>9.3f}  {r['min_ms']:>9.3f}")
         if self._step_times:
             print(self.step_info())
+
+
+class SortedKeys:
+    """reference: profiler/profiler_statistic.py SortedKeys — summary sort
+    orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """reference: profiler/profiler.py SummaryView — which summary tables
+    to print."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

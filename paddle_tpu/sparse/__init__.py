@@ -183,6 +183,11 @@ square = _values_op(jnp.square)
 abs = _values_op(jnp.abs)          # noqa: A001
 neg = _values_op(jnp.negative)
 pow = _values_op(lambda v, p: jnp.power(v, p))   # noqa: A001
+tan = _values_op(jnp.tan)
+log1p = _values_op(jnp.log1p)
+expm1 = _values_op(jnp.expm1)
+deg2rad = _values_op(jnp.deg2rad)
+rad2deg = _values_op(jnp.rad2deg)
 
 
 def cast(x, index_dtype=None, value_dtype=None):
@@ -260,3 +265,44 @@ def to_sparse_coo(dense, sparse_dim=None):
     """Tensor -> SparseCooTensor of its nonzeros (paddle
     Tensor.to_sparse_coo)."""
     return _from_dense_coo(dense)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector (reference: sparse/multiary mv)."""
+    return Tensor(_dense(x) @ (vec._data if isinstance(vec, Tensor)
+                               else jnp.asarray(vec)))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y), x sparse (reference sparse addmm)."""
+    xd = _dense(x) if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else x._data
+    yd = _dense(y) if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else y._data
+    ind = input._data if isinstance(input, Tensor) else _dense(input)
+    return Tensor(beta * ind + alpha * (xd @ yd))
+
+
+def reshape(x, shape, name=None):
+    """Sparse reshape (reference: sparse/unary reshape): linearize COO
+    indices and re-split under the new shape."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo(2)
+    old_shape = x.shape
+    import numpy as _np
+    new_shape = list(shape)
+    n_el = int(_np.prod(old_shape))
+    if -1 in new_shape:
+        i = new_shape.index(-1)
+        new_shape[i] = n_el // int(-_np.prod([d for d in new_shape]))
+    idx = x.indices_._data
+    strides = _np.cumprod([1] + list(old_shape[::-1]))[:-1][::-1].copy()
+    flat = (idx * jnp.asarray(strides)[:, None]).sum(0)
+    new_strides = _np.cumprod([1] + list(new_shape[::-1]))[:-1][::-1].copy()
+    new_idx = []
+    rem = flat
+    for st in new_strides:
+        new_idx.append(rem // st)
+        rem = rem % st
+    return SparseCooTensor(Tensor(jnp.stack(new_idx).astype(idx.dtype)),
+                           x.values_, new_shape)

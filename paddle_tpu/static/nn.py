@@ -99,15 +99,17 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
                                   else [out])]
             return inner
         branches = [wrap(fns[k]) for k in keys]
+        dflat = jnp.reshape(d, ())
+        idx = jnp.clip(jnp.searchsorted(jnp.asarray(keys), dflat),
+                       0, len(keys) - 1)
+        hit = jnp.isin(dflat, jnp.asarray(keys))
         if default is not None:
             branches.append(wrap(default))
-            idx = jnp.searchsorted(jnp.asarray(keys), jnp.reshape(d, ()))
-            hit = jnp.isin(jnp.reshape(d, ()), jnp.asarray(keys))
             sel = jnp.where(hit, idx, len(keys))
         else:
-            sel = jnp.clip(jnp.searchsorted(jnp.asarray(keys),
-                                            jnp.reshape(d, ())),
-                           0, len(keys) - 1)
+            # unmatched index falls to the LAST branch, same as eager /
+            # the reference
+            sel = jnp.where(hit, idx, len(keys) - 1)
         outs = jax.lax.switch(sel, branches, None)
         outs = [Tensor(o) for o in outs]
         return outs if len(outs) > 1 else outs[0]

@@ -4,4 +4,6 @@ provided for training/benchmarks and the model zoo lives in
 paddle_tpu.text.models (BERT/GPT/ERNIE)."""
 from . import models  # noqa: F401
 from .datasets import FakeTextDataset, LMDataset  # noqa: F401
+from .datasets_ref import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

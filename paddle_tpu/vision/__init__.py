@@ -6,9 +6,29 @@ from . import transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
 
 
+_image_backend = "pil"
+
+
 def set_image_backend(backend):
-    pass
+    """reference: vision/image.py set_image_backend (pil|cv2). 'numpy' is
+    this build's extra for raw-array loading; cv2 is not bundled."""
+    global _image_backend
+    if backend not in ("pil", "numpy"):
+        raise ValueError(f"image backend {backend!r} unavailable: "
+                         f"'pil' or 'numpy' (cv2 is not bundled)")
+    _image_backend = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image from disk (reference: vision/image.py image_load).
+    'pil' returns a PIL Image; 'numpy' an HWC uint8 array."""
+    from PIL import Image
+    img = Image.open(path)
+    if (backend or _image_backend) == "numpy":
+        import numpy as np
+        return np.asarray(img)
+    return img

@@ -386,3 +386,405 @@ class DeformConv2D(Layer):
                              dilation=self._dilation, groups=self._groups,
                              deformable_groups=self._deformable_groups,
                              mask=mask)
+
+
+class RoIAlign(Layer):
+    """Layer form of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    """Layer form of roi_pool (reference vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py psroi_pool,
+    phi psroi_pool kernel): input channels C = out_channels*ph*pw; bin
+    (i, j) pools its OWN channel group — average pooling per bin."""
+    ph, pw = _pair(output_size)
+    xr = np.asarray(_unwrap(x), np.float32)
+    br = np.asarray(_unwrap(boxes), np.float32) * spatial_scale
+    N, C, H, W = xr.shape
+    assert C % (ph * pw) == 0, "C must be divisible by output_size^2"
+    Cout = C // (ph * pw)
+    R = br.shape[0]
+    counts = (np.asarray(_unwrap(boxes_num), np.int64)
+              if boxes_num is not None else np.asarray([R]))
+    bidx = np.repeat(np.arange(counts.shape[0]), counts)
+    out = np.zeros((R, Cout, ph, pw), np.float32)
+    for r in range(R):
+        x1, y1, x2, y2 = br[r]
+        roi_h = max(y2 - y1, 0.1)
+        roi_w = max(x2 - x1, 0.1)
+        bh, bw = roi_h / ph, roi_w / pw
+        for py in range(ph):
+            for px in range(pw):
+                ys_ = int(np.floor(y1 + py * bh))
+                ye = int(np.ceil(y1 + (py + 1) * bh))
+                xs_ = int(np.floor(x1 + px * bw))
+                xe = int(np.ceil(x1 + (px + 1) * bw))
+                ys_, ye = np.clip([ys_, ye], 0, H)
+                xs_, xe = np.clip([xs_, xe], 0, W)
+                if ye > ys_ and xe > xs_:
+                    for c in range(Cout):
+                        ch = (c * ph + py) * pw + px
+                        out[r, c, py, px] = xr[bidx[r], ch, ys_:ye,
+                                               xs_:xe].mean()
+    return to_tensor(out) if isinstance(x, Tensor) else out
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes for one feature map (reference: vision/ops.py
+    prior_box, phi prior_box kernel). Returns (boxes (H, W, P, 4) in
+    normalized ltrb, variances broadcast to the same shape)."""
+    H, W = int(_unwrap(input).shape[2]), int(_unwrap(input).shape[3])
+    H_img, W_img = int(_unwrap(image).shape[2]), int(_unwrap(image).shape[3])
+    sw = steps[0] or W_img / W
+    sh = steps[1] or H_img / H
+    cx = (np.arange(W) + offset) * sw
+    cy = (np.arange(H) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)
+    sizes = []
+    for i, ms in enumerate(min_sizes):
+        sizes.append((ms, ms))
+        ars = []
+        for a in aspect_ratios:
+            if abs(a - 1.0) > 1e-6:
+                ars.append(a)
+                if flip:
+                    ars.append(1.0 / a)
+        ar_sizes = [(ms * np.sqrt(a), ms / np.sqrt(a)) for a in ars]
+        mx_sizes = []
+        if max_sizes is not None and i < len(max_sizes):
+            m = np.sqrt(ms * max_sizes[i])
+            mx_sizes.append((m, m))
+        if min_max_aspect_ratios_order:
+            sizes.extend(mx_sizes + ar_sizes)
+        else:
+            sizes.extend(ar_sizes + mx_sizes)
+    boxes = []
+    for (bw, bh) in sizes:
+        boxes.append(np.stack([(cxg - bw / 2) / W_img, (cyg - bh / 2) / H_img,
+                               (cxg + bw / 2) / W_img, (cyg + bh / 2) / H_img],
+                              axis=-1))
+    pb = np.stack(boxes, axis=2)                     # (H, W, P, 4)
+    if clip:
+        pb = np.clip(pb, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), pb.shape)
+    return to_tensor(pb.astype(np.float32)), to_tensor(np.ascontiguousarray(var))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference: vision/ops.py
+    box_coder, phi box_coder kernel)."""
+    def fn(pb, tb, *pv):
+        pbv = pv[0] if pv else None
+        pw = pb[..., 2] - pb[..., 0] + (0.0 if box_normalized else 1.0)
+        phh = pb[..., 3] - pb[..., 1] + (0.0 if box_normalized else 1.0)
+        pcx = pb[..., 0] + pw * 0.5
+        pcy = pb[..., 1] + phh * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[..., 2] - tb[..., 0] + (0.0 if box_normalized else 1.0)
+            th = tb[..., 3] - tb[..., 1] + (0.0 if box_normalized else 1.0)
+            tcx = tb[..., 0] + tw * 0.5
+            tcy = tb[..., 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / phh,
+                             jnp.log(tw / pw), jnp.log(th / phh)], axis=-1)
+            if pbv is not None:
+                out = out / pbv
+            return out
+        # decode_center_size
+        d = tb
+        if pbv is not None:
+            d = d * pbv
+        dcx = d[..., 0] * pw + pcx
+        dcy = d[..., 1] * phh + pcy
+        dw = jnp.exp(d[..., 2]) * pw
+        dh = jnp.exp(d[..., 3]) * phh
+        sub = 0.0 if box_normalized else 1.0
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - sub, dcy + dh * 0.5 - sub],
+                         axis=-1)
+    args = [prior_box, target_box]
+    if prior_box_var is not None:
+        args.append(prior_box_var if isinstance(prior_box_var, Tensor)
+                    else to_tensor(np.asarray(prior_box_var, np.float32)))
+    return apply_op(fn, *args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference vision/ops.py matrix_nms): scores decay
+    by the max IoU with any higher-scoring box of the same class — one
+    IoU-matrix pass, no sequential suppression. Host-side numpy."""
+    bb = np.asarray(_unwrap(bboxes), np.float32)   # (N, M, 4)
+    sc = np.asarray(_unwrap(scores), np.float32)   # (N, C, M)
+    outs, indices, nums = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        idxs = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = s > score_threshold
+            if not keep.any():
+                continue
+            ki = np.where(keep)[0]
+            order = ki[np.argsort(-s[ki])][:nms_top_k]
+            b = bb[n, order]
+            ss = s[order]
+            m = len(order)
+            x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+            add = 0.0 if normalized else 1.0
+            area = (x2 - x1 + add) * (y2 - y1 + add)
+            ix1 = np.maximum(x1[:, None], x1[None])
+            iy1 = np.maximum(y1[:, None], y1[None])
+            ix2 = np.minimum(x2[:, None], x2[None])
+            iy2 = np.minimum(y2[:, None], y2[None])
+            iw = np.maximum(ix2 - ix1 + add, 0)
+            ih = np.maximum(iy2 - iy1 + add, 0)
+            inter = iw * ih
+            iou = inter / (area[:, None] + area[None] - inter)
+            iou = np.triu(iou, k=1)                  # iou[i, j], i scored > j
+            # SOLOv2 matrix-NMS: decay_j = min_i f(iou_ij)/f(comp_i) where
+            # comp_i = max IoU of higher box i with anything scored above IT
+            comp = iou.max(axis=0)                   # comp[i] for box-as-j
+            if use_gaussian:
+                D = np.exp(-(iou ** 2 - comp[:, None] ** 2) / gaussian_sigma)
+            else:
+                D = (1 - iou) / np.maximum(1 - comp[:, None], 1e-9)
+            D = np.where(np.triu(np.ones((m, m), bool), k=1), D, np.inf)
+            decay = np.minimum(D.min(axis=0), 1.0)
+            decay[0] = 1.0                            # top box undecayed
+            new_s = ss * decay
+            ok = new_s >= post_threshold
+            for j in np.where(ok)[0]:
+                dets.append([c, new_s[j], *b[j]])
+                idxs.append(n * bb.shape[1] + order[j])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        srt = np.argsort(-dets[:, 1])[:keep_top_k]
+        outs.append(dets[srt])
+        indices.append(np.asarray(idxs, np.int64)[srt] if len(idxs)
+                       else np.zeros((0,), np.int64))
+        nums.append(len(srt))
+    out = to_tensor(np.concatenate(outs, axis=0) if outs
+                    else np.zeros((0, 6), np.float32))
+    rois_num = to_tensor(np.asarray(nums, np.int32))
+    if return_index:
+        idx = to_tensor(np.concatenate(indices) if indices
+                        else np.zeros((0,), np.int64))
+        return (out, idx, rois_num) if return_rois_num else (out, idx)
+    return (out, rois_num) if return_rois_num else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference: vision/ops.py generate_proposals):
+    decode anchor deltas, clip to image, filter small, NMS, top-k."""
+    sc = np.asarray(_unwrap(scores), np.float32)       # (N, A, H, W)
+    bd = np.asarray(_unwrap(bbox_deltas), np.float32)  # (N, 4A, H, W)
+    im = np.asarray(_unwrap(img_size), np.float32)     # (N, 2) h, w
+    an = np.asarray(_unwrap(anchors), np.float32).reshape(-1, 4)
+    va = np.asarray(_unwrap(variances), np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    rois, roi_probs, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)            # HWA
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        aw = a[:, 2] - a[:, 0] + (1.0 if pixel_offset else 0.0)
+        ah = a[:, 3] - a[:, 1] + (1.0 if pixel_offset else 0.0)
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        dcx = v[:, 0] * d[:, 0] * aw + acx
+        dcy = v[:, 1] * d[:, 1] * ah + acy
+        dw = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        dh = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        sub = 1.0 if pixel_offset else 0.0
+        boxes = np.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - sub, dcy + dh / 2 - sub], axis=-1)
+        h_im, w_im = im[n]
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, w_im - sub)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, h_im - sub)
+        ws = boxes[:, 2] - boxes[:, 0] + sub
+        hs = boxes[:, 3] - boxes[:, 1] + sub
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = nms(to_tensor(boxes), iou_threshold=nms_thresh,
+                       scores=to_tensor(s), top_k=post_nms_top_n)
+            ki = np.asarray(_unwrap(kept))
+            boxes, s = boxes[ki], s[ki]
+        rois.append(boxes)
+        roi_probs.append(s[:, None])
+        nums.append(len(boxes))
+    out = (to_tensor(np.concatenate(rois).astype(np.float32)),
+           to_tensor(np.concatenate(roi_probs).astype(np.float32)))
+    if return_rois_num:
+        return out + (to_tensor(np.asarray(nums, np.int32)),)
+    return out
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file -> decode_jpeg pipeline)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return to_tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference: decode_jpeg op;
+    PIL is the host-side codec here)."""
+    import io
+    from PIL import Image
+    data = bytes(np.asarray(_unwrap(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(np.ascontiguousarray(arr))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: vision/ops.py yolo_loss, phi yolov3_loss
+    kernel): coordinate + objectness + class losses with best-anchor
+    assignment per gt and ignore-region masking. Host/numpy reference
+    implementation (training-loop use goes through the model zoo's
+    compiled losses; this op exists for API parity and verification)."""
+    xr = np.asarray(_unwrap(x), np.float32)          # (N, C, H, W)
+    gb = np.asarray(_unwrap(gt_box), np.float32)     # (N, B, 4) cx cy w h (0-1)
+    gl = np.asarray(_unwrap(gt_label), np.int64)     # (N, B)
+    gs = (np.asarray(_unwrap(gt_score), np.float32)
+          if gt_score is not None else np.ones(gl.shape, np.float32))
+    N, C, H, W = xr.shape
+    mask = list(anchor_mask)
+    A = len(mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = an_all[mask]
+    inp_size = H * downsample_ratio
+    p = xr.reshape(N, A, 5 + class_num, H, W)
+    px = 1 / (1 + np.exp(-p[:, :, 0]))
+    py = 1 / (1 + np.exp(-p[:, :, 1]))
+    pw = p[:, :, 2]
+    phh = p[:, :, 3]
+    pobj = p[:, :, 4]
+    pcls = p[:, :, 5:]
+    loss = np.zeros((N,), np.float32)
+    eps = 1e-9
+
+    def bce(z, y):
+        zs = 1 / (1 + np.exp(-z))
+        return -(y * np.log(zs + eps) + (1 - y) * np.log(1 - zs + eps))
+
+    for n in range(N):
+        obj_mask = np.zeros((A, H, W), bool)
+        ignore = np.zeros((A, H, W), bool)
+        # predicted boxes for ignore-region computation
+        gx = (np.arange(W)[None, None] + px[n]) / W
+        gy = (np.arange(H)[None, :, None] + py[n]) / H
+        gw = an[:, 0][:, None, None] * np.exp(pw[n]) / inp_size
+        gh = an[:, 1][:, None, None] * np.exp(phh[n]) / inp_size
+        pb = np.stack([gx, gy, gw, gh], -1).reshape(-1, 4)
+        for b in range(gb.shape[1]):
+            if gb[n, b, 2] <= 0 or gb[n, b, 3] <= 0:
+                continue
+            # iou of this gt against all predictions (center format)
+            def iou_cwh(b1, b2):
+                l1 = b1[..., :2] - b1[..., 2:] / 2
+                r1 = b1[..., :2] + b1[..., 2:] / 2
+                l2 = b2[..., :2] - b2[..., 2:] / 2
+                r2 = b2[..., :2] + b2[..., 2:] / 2
+                wh = np.maximum(np.minimum(r1, r2) - np.maximum(l1, l2), 0)
+                inter = wh[..., 0] * wh[..., 1]
+                a1 = b1[..., 2] * b1[..., 3]
+                a2 = b2[..., 2] * b2[..., 3]
+                return inter / (a1 + a2 - inter + eps)
+            ious = iou_cwh(gb[n, b][None], pb).reshape(A, H, W)
+            ignore |= ious > ignore_thresh
+            # best anchor over the FULL anchor set
+            gt_wh = gb[n, b, 2:] * inp_size
+            best, best_iou = -1, 0
+            for ai, (aw, ah) in enumerate(an_all):
+                mn = np.minimum([aw, ah], gt_wh)
+                inter = mn[0] * mn[1]
+                u = aw * ah + gt_wh[0] * gt_wh[1] - inter
+                if inter / u > best_iou:
+                    best, best_iou = ai, inter / u
+            if best not in mask:
+                continue
+            a_loc = mask.index(best)
+            gi = int(gb[n, b, 0] * W)
+            gj = int(gb[n, b, 1] * H)
+            gi, gj = min(gi, W - 1), min(gj, H - 1)
+            obj_mask[a_loc, gj, gi] = True
+            ignore[a_loc, gj, gi] = False
+            tx = gb[n, b, 0] * W - gi
+            ty = gb[n, b, 1] * H - gj
+            tw = np.log(gb[n, b, 2] * inp_size / an[a_loc, 0] + eps)
+            th = np.log(gb[n, b, 3] * inp_size / an[a_loc, 1] + eps)
+            box_scale = 2.0 - gb[n, b, 2] * gb[n, b, 3]
+            sc_w = gs[n, b]
+            loss[n] += sc_w * box_scale * (
+                bce(p[n, a_loc, 0, gj, gi], tx)
+                + bce(p[n, a_loc, 1, gj, gi], ty)
+                + (pw[n, a_loc, gj, gi] - tw) ** 2
+                + (phh[n, a_loc, gj, gi] - th) ** 2)
+            delta = 1.0 / class_num if use_label_smooth else 0.0
+            tcls = np.full((class_num,), delta, np.float32)
+            tcls[gl[n, b]] = 1.0 - delta
+            loss[n] += sc_w * bce(pcls[n, a_loc, :, gj, gi], tcls).sum()
+        # objectness
+        obj_t = obj_mask.astype(np.float32)
+        obj_loss = bce(pobj[n], obj_t)
+        obj_loss = np.where(~obj_mask & ignore, 0.0, obj_loss)
+        loss[n] += obj_loss.sum()
+    return to_tensor(loss)

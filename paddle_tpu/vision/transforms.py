@@ -473,3 +473,307 @@ class RandomErasing(BaseTransform):
                     arr[y:y + h, x:x + w] = val
                 break
         return arr
+
+
+# ---------------------------------------------------------------------------
+# functional API (reference: python/paddle/vision/transforms/functional.py)
+# Host-side numpy image math (these run in DataLoader workers).
+
+def _hwc(arr):
+    """Detect CHW and return (HWC array, restore)."""
+    arr = np.asarray(arr)
+    chw = (arr.ndim == 3 and arr.shape[0] in (1, 3)
+           and arr.shape[2] not in (1, 3))
+    if chw:
+        return np.transpose(arr, (1, 2, 0)), \
+            (lambda o: np.transpose(o, (2, 0, 1)))
+    return arr, (lambda o: o)
+
+
+def crop(img, top, left, height, width):
+    arr, back = _hwc(img)
+    return back(arr[top:top + height, left:left + width])
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr, back = _hwc(img)
+    H, W = arr.shape[:2]
+    th, tw = output_size
+    top = max((H - th) // 2, 0)
+    left = max((W - tw) // 2, 0)
+    return back(arr[top:top + th, left:left + tw])
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)._apply_image(img)
+
+
+def _inverse_warp(arr, inv_fn, out_shape, interpolation="nearest", fill=0):
+    """Generic inverse-mapped warp: inv_fn(xx, yy) -> (src_x, src_y)."""
+    H, W = arr.shape[:2]
+    H_out, W_out = out_shape
+    yy, xx = np.meshgrid(np.arange(H_out), np.arange(W_out), indexing="ij")
+    src_x, src_y = inv_fn(xx.astype(np.float64), yy.astype(np.float64))
+    out = np.full((H_out, W_out) + arr.shape[2:], fill,
+                  dtype=np.float64 if interpolation == "bilinear"
+                  else arr.dtype)
+    if interpolation == "bilinear":
+        x0 = np.floor(src_x).astype(np.int64)
+        y0 = np.floor(src_y).astype(np.int64)
+        fx = src_x - x0
+        fy = src_y - y0
+        total = np.zeros((H_out, W_out) + arr.shape[2:], np.float64)
+        wsum = np.zeros((H_out, W_out), np.float64)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi, yi = x0 + dx, y0 + dy
+                w = (fx if dx else 1 - fx) * (fy if dy else 1 - fy)
+                ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+                vals = np.zeros_like(total)
+                vals[ok] = arr[yi[ok], xi[ok]]
+                if arr.ndim == 3:
+                    total += vals * w[..., None] * ok[..., None]
+                else:
+                    total += vals * w * ok
+                wsum += w * ok
+        inside = wsum > 1e-9
+        if arr.ndim == 3:
+            out[inside] = total[inside] / wsum[inside][..., None]
+        else:
+            out[inside] = total[inside] / wsum[inside]
+        return out.astype(arr.dtype)
+    xi = np.round(src_x).astype(np.int64)
+    yi = np.round(src_y).astype(np.int64)
+    ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+    out[ok] = arr[yi[ok], xi[ok]]
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr, back = _hwc(img)
+    H, W = arr.shape[:2]
+    a = np.deg2rad(angle)
+    c, s = np.cos(a), np.sin(a)
+    cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if expand:
+        H_out = int(np.ceil(abs(H * c) + abs(W * s)))
+        W_out = int(np.ceil(abs(W * c) + abs(H * s)))
+    else:
+        H_out, W_out = H, W
+    oy, ox = (H_out - 1) / 2.0, (W_out - 1) / 2.0
+
+    def inv(xx, yy):
+        return (c * (xx - ox) + s * (yy - oy) + cx,
+                -s * (xx - ox) + c * (yy - oy) + cy)
+
+    return back(_inverse_warp(arr, inv, (H_out, W_out), interpolation, fill))
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference functional.affine: rotation+translate+scale+shear about
+    the center; inverse-mapped sampling."""
+    arr, back = _hwc(img)
+    H, W = arr.shape[:2]
+    cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    a = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(
+        shear, (list, tuple)) else (shear, 0.0))]
+    tx, ty = translate
+    # forward matrix M = T(center) R S Shear T(-center) + translate
+    R = np.array([[np.cos(a), -np.sin(a)], [np.sin(a), np.cos(a)]])
+    Sh = np.array([[1, np.tan(sx)], [np.tan(sy), 1]])
+    M = scale * (R @ Sh)
+    Minv = np.linalg.inv(M)
+
+    def inv(xx, yy):
+        dx = xx - cx - tx
+        dy = yy - cy - ty
+        src_x = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
+        src_y = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
+        return src_x, src_y
+
+    return back(_inverse_warp(arr, inv, (H, W), interpolation, fill))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference functional.perspective: warp so startpoints map to
+    endpoints (homography solved in least squares)."""
+    arr, back = _hwc(img)
+    H, W = arr.shape[:2]
+    A, b = [], []
+    # solve the INVERSE homography directly: end -> start
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        b.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.append(sy)
+    h = np.linalg.lstsq(np.asarray(A, np.float64),
+                        np.asarray(b, np.float64), rcond=None)[0]
+    Hm = np.append(h, 1.0).reshape(3, 3)
+
+    def inv(xx, yy):
+        den = Hm[2, 0] * xx + Hm[2, 1] * yy + Hm[2, 2]
+        den = np.where(np.abs(den) < 1e-9, 1e-9, den)
+        return ((Hm[0, 0] * xx + Hm[0, 1] * yy + Hm[0, 2]) / den,
+                (Hm[1, 0] * xx + Hm[1, 1] * yy + Hm[1, 2]) / den)
+
+    return back(_inverse_warp(arr, inv, (H, W), interpolation, fill))
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, back = _hwc(img)
+    f, restore = _as_float_hwc(arr)
+    return back(restore(np.clip(f * brightness_factor, 0, 1)))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, back = _hwc(img)
+    f, restore = _as_float_hwc(arr)
+    gray = f.mean()
+    return back(restore(np.clip(gray + contrast_factor * (f - gray), 0, 1)))
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) through HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, back = _hwc(img)
+    f, restore = _as_float_hwc(arr)
+    import colorsys  # noqa: F401  (doc anchor: same math, vectorized)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx = f.max(-1)
+    mn = f.min(-1)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    m = d > 1e-12
+    rc = np.where(m, (mx - r) / np.where(m, d, 1), 0)
+    gc = np.where(m, (mx - g) / np.where(m, d, 1), 0)
+    bc = np.where(m, (mx - b) / np.where(m, d, 1), 0)
+    h = np.where(mx == r, bc - gc, h)
+    h = np.where(mx == g, 2.0 + rc - bc, h)
+    h = np.where(mx == b, 4.0 + gc - rc, h)
+    h = (h / 6.0) % 1.0
+    h = (h + hue_factor) % 1.0
+    s = np.where(mx > 1e-12, d / np.where(mx > 1e-12, mx, 1), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * fr)
+    t = v * (1 - s * (1 - fr))
+    i = i.astype(np.int64) % 6
+    out = np.zeros_like(f)
+    conds = [(i == 0, (v, t, p)), (i == 1, (q, v, p)), (i == 2, (p, v, t)),
+             (i == 3, (p, q, v)), (i == 4, (t, p, v)), (i == 5, (v, p, q))]
+    for cond, (rr, gg, bb) in conds:
+        out[..., 0] = np.where(cond, rr, out[..., 0])
+        out[..., 1] = np.where(cond, gg, out[..., 1])
+        out[..., 2] = np.where(cond, bb, out[..., 2])
+    return back(restore(out))
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, back = _hwc(img)
+    f, restore = _as_float_hwc(arr)
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+    gray = np.repeat(gray, num_output_channels, axis=-1)
+    return back(restore(gray))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    from ..core.tensor import Tensor as _T
+    if isinstance(img, _T):
+        d = img._data.copy() if not inplace else img._data
+        d = d.at[..., i:i + h, j:j + w].set(v) if d.ndim == 3 and \
+            d.shape[0] in (1, 3) else d.at[i:i + h, j:j + w].set(v)
+        if inplace:
+            img._data = d
+            return img
+        return _T(d)
+    arr = np.asarray(img) if inplace else np.array(img)
+    if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3):
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+class RandomAffine(BaseTransform):
+    """reference transforms.RandomAffine over functional.affine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        # shear: scalar -> x range; 2 values -> x range; 4 -> x + y ranges
+        if isinstance(shear, numbers.Number):
+            shear = (-abs(shear), abs(shear))
+        self.shear = tuple(shear) if shear is not None else None
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        arr = np.asarray(img)
+        H, W = (arr.shape[1:3] if arr.ndim == 3 and arr.shape[0] in (1, 3)
+                and arr.shape[2] not in (1, 3) else arr.shape[:2])
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * W
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * H
+        else:
+            tx = ty = 0.0
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear:
+            sh_x = np.random.uniform(*self.shear[:2])
+            sh_y = np.random.uniform(*self.shear[2:4]) \
+                if len(self.shear) >= 4 else 0.0
+            sh = (sh_x, sh_y)
+        else:
+            sh = (0.0, 0.0)
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference transforms.RandomPerspective over functional.perspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() > self.prob:
+            return np.asarray(img)
+        arr = np.asarray(img)
+        chw = (arr.ndim == 3 and arr.shape[0] in (1, 3)
+               and arr.shape[2] not in (1, 3))
+        H, W = (arr.shape[1:3] if chw else arr.shape[:2])
+        d = self.distortion_scale
+        half_h, half_w = int(H * d / 2), int(W * d / 2)
+        tl = (np.random.randint(0, half_w + 1), np.random.randint(0, half_h + 1))
+        tr = (W - 1 - np.random.randint(0, half_w + 1),
+              np.random.randint(0, half_h + 1))
+        br = (W - 1 - np.random.randint(0, half_w + 1),
+              H - 1 - np.random.randint(0, half_h + 1))
+        bl = (np.random.randint(0, half_w + 1),
+              H - 1 - np.random.randint(0, half_h + 1))
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        return perspective(img, start, [tl, tr, br, bl],
+                           self.interpolation, self.fill)

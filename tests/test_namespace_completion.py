@@ -1,0 +1,244 @@
+"""Round-3 namespace completion: text datasets over synthesized archives,
+audio wav backend, vision detection ops, distributed extras, incubate ops
+(reference: python/paddle/{text,audio,vision,distributed,incubate})."""
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_uci_housing(tmp_path):
+    rows = np.random.RandomState(0).rand(20, 14)
+    f = tmp_path / "housing.data"
+    with open(f, "w") as fh:
+        for r in rows:
+            fh.write(" ".join(f"{v:.4f}" for v in r) + "\n")
+    tr = paddle.text.UCIHousing(data_file=str(f), mode="train")
+    te = paddle.text.UCIHousing(data_file=str(f), mode="test")
+    assert len(tr) == 16 and len(te) == 4
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_imdb_tar(tmp_path):
+    tar_path = tmp_path / "aclImdb.tar.gz"
+    docs = {"aclImdb/train/pos/0.txt": b"good good movie",
+            "aclImdb/train/neg/0.txt": b"bad bad movie",
+            "aclImdb/test/pos/0.txt": b"good film"}
+    with tarfile.open(tar_path, "w:gz") as tf:
+        import io
+        for name, data in docs.items():
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    ds = paddle.text.Imdb(data_file=str(tar_path), mode="train", cutoff=0)
+    assert len(ds) == 2
+    ids, lab = ds[0]
+    assert ids.dtype == np.int64 and lab.shape == (1,)
+    labs = sorted(int(ds[i][1][0]) for i in range(2))
+    assert labs == [0, 1]
+
+
+def test_imikolov_tar(tmp_path):
+    tar_path = tmp_path / "ptb.tgz"
+    text = b"the cat sat\nthe dog sat\n"
+    import io
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for part in ("train", "valid"):
+            ti = tarfile.TarInfo(f"./simple-examples/data/ptb.{part}.txt")
+            ti.size = len(text)
+            tf.addfile(ti, io.BytesIO(text))
+    ds = paddle.text.Imikolov(data_file=str(tar_path), data_type="NGRAM",
+                              window_size=2, mode="train", min_word_freq=1)
+    assert len(ds) > 0
+    item = ds[0]
+    assert len(item) == 3          # window 2 -> 2 context + 1 target
+
+
+def test_movielens_zip(tmp_path):
+    zpath = tmp_path / "ml.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("ml-1m/movies.dat", "1::Toy Story::Animation|Comedy\n")
+        z.writestr("ml-1m/users.dat", "1::M::25::4::12345\n")
+        z.writestr("ml-1m/ratings.dat", "1::1::5::978300760\n")
+    ds = paddle.text.Movielens(data_file=str(zpath), mode="train",
+                               test_ratio=0.0)
+    assert len(ds) == 1
+    u, m, r = ds[0]
+    assert float(r[0]) == 5.0 and m[1] == "Toy Story"
+
+
+def test_wmt16_tar(tmp_path):
+    tpath = tmp_path / "wmt16.tar"
+    import io
+    en = b"a cat .\na dog .\n"
+    de = b"eine katze .\nein hund .\n"
+    with tarfile.open(tpath, "w") as tf:
+        for name, data in (("mmt16/train.en", en), ("mmt16/train.de", de)):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    ds = paddle.text.WMT16(data_file=str(tpath), mode="train")
+    assert len(ds) == 2
+    s, t, tn = ds[0]
+    assert len(t) == len(tn)
+
+
+def test_datasets_raise_without_file():
+    for cls in (paddle.text.UCIHousing, paddle.text.Imdb,
+                paddle.text.WMT14):
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            cls(data_file=None)
+
+
+def test_audio_roundtrip_and_backend(tmp_path):
+    sr = 8000
+    sig = np.sin(np.linspace(0, 50, 4000)).astype("float32")[None]
+    f = str(tmp_path / "a.wav")
+    paddle.audio.save(f, paddle.to_tensor(sig), sr)
+    inf = paddle.audio.info(f)
+    assert (inf.sample_rate, inf.num_channels, inf.num_frames) == (sr, 1, 4000)
+    wav, sr2 = paddle.audio.load(f)
+    np.testing.assert_allclose(wav.numpy(), sig, atol=1e-3)
+    assert paddle.audio.backends.list_available_backends() == ["wave_backend"]
+    with pytest.raises(NotImplementedError):
+        paddle.audio.backends.set_backend("soundfile")
+
+
+def test_box_coder_roundtrip_and_prior_box():
+    from paddle_tpu.vision import ops as V
+    priors = paddle.to_tensor(np.array([[0., 0., 10., 10.],
+                                        [5., 5., 20., 20.]], "float32"))
+    pvar = paddle.to_tensor(np.array([[0.1, 0.1, 0.2, 0.2]] * 2, "float32"))
+    target = paddle.to_tensor(np.array([[1., 1., 8., 8.],
+                                        [6., 4., 18., 22.]], "float32"))
+    enc = V.box_coder(priors, pvar, target, code_type="encode_center_size")
+    dec = V.box_coder(priors, pvar, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), target.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32"))
+    pb, var = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                          aspect_ratios=[2.0], flip=True)
+    assert tuple(pb.shape) == (4, 4, 4, 4)
+    assert (np.asarray(var.numpy())[..., 2] == 0.2).all()
+
+
+def test_matrix_nms_decay():
+    from paddle_tpu.vision import ops as V
+    bb = paddle.to_tensor(np.array([[[0, 0, 10, 10], [0, 0, 9, 9],
+                                     [20, 20, 30, 30]]], "float32"))
+    sc = paddle.to_tensor(np.array([[[0.0, 0, 0], [0.9, 0.8, 0.7]]],
+                                   "float32"))
+    out, num = V.matrix_nms(bb, sc, 0.1, 0.05, 10, 10, background_label=0)
+    assert int(num.numpy()[0]) >= 2
+    scores = out.numpy()[:, 1]
+    assert scores[0] == 0.9                     # top box undecayed
+    overlapped = out.numpy()[out.numpy()[:, 2] < 15]  # the two at (0,0)
+    assert overlapped[:, 1].min() < 0.8         # decayed below raw score
+
+
+def test_yolo_loss_positive_and_finite():
+    from paddle_tpu.vision import ops as V
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .rand(2, 3 * 9, 4, 4).astype("float32"))
+    gtb = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.4]],
+                                     [[0.2, 0.2, 0.1, 0.1]]], "float32"))
+    gtl = paddle.to_tensor(np.array([[1], [2]], "int64"))
+    loss = V.yolo_loss(x, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+                       anchor_mask=[0, 1, 2], class_num=4,
+                       ignore_thresh=0.7, downsample_ratio=8)
+    arr = loss.numpy()
+    assert arr.shape == (2,) and np.isfinite(arr).all() and (arr > 0).all()
+
+
+def test_distributed_extras():
+    objs = []
+    paddle.distributed.all_gather_object(objs, ("x", 3))
+    assert objs == [("x", 3)]
+    t = paddle.distributed.isend(paddle.to_tensor(np.ones(2, "float32")))
+    assert t.wait() and t.is_completed()
+    emb = paddle.distributed.split(
+        paddle.to_tensor(np.array([[0, 1]], "int64")), (8, 4), "embedding")
+    assert tuple(emb.shape) == (1, 2, 4)
+    assert paddle.distributed.ParallelMode.TENSOR_PARALLEL == 1
+    with pytest.raises(ValueError):
+        paddle.distributed.ProbabilityEntry(1.5)
+
+
+def test_incubate_ops_and_optimizers():
+    import jax.numpy as jnp
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 4, 4)
+                         .astype("float32"))
+    out = paddle.incubate.softmax_mask_fuse_upper_triangle(x)
+    arr = out.numpy()
+    np.testing.assert_allclose(arr.sum(-1), np.ones((2, 3, 4)), rtol=1e-5)
+    assert arr[0, 0, 0, 1] == 0                 # strictly-upper masked
+
+    seg = paddle.incubate.segment_sum(
+        paddle.to_tensor(np.array([[1.], [2.], [3.]], "float32")),
+        paddle.to_tensor(np.array([0, 0, 1])))
+    np.testing.assert_allclose(seg.numpy().ravel(), [3.0, 3.0])
+
+    # LookAhead: inner steps advance; every k the slow weights blend
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    la = paddle.incubate.LookAhead(
+        opt.SGD(learning_rate=0.1, parameters=net.parameters()),
+        alpha=0.5, k=2)
+    xx = paddle.to_tensor(np.ones((4, 4), "float32"))
+    for _ in range(4):
+        loss = (net(xx) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    assert la._count == 4 and la._slow
+
+    ma = paddle.incubate.ModelAverage(0.15, parameters=net.parameters())
+    w0 = [p.numpy().copy() for p in net.parameters()]
+    ma.step()
+    for p in net.parameters():
+        p.set_value(paddle.to_tensor(p.numpy() + 2.0))
+    ma.step()
+    with ma.apply():
+        for p, w in zip(net.parameters(), w0):
+            np.testing.assert_allclose(p.numpy(), w + 1.0, rtol=1e-5)
+    for p, w in zip(net.parameters(), w0):
+        np.testing.assert_allclose(p.numpy(), w + 2.0, rtol=1e-5)
+
+
+def test_graph_sampling():
+    # CSC graph: 3 nodes, edges (0<-1), (0<-2), (1<-2)
+    row = paddle.to_tensor(np.array([1, 2, 2], "int64"))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], "int64"))
+    nodes = paddle.to_tensor(np.array([0, 1], "int64"))
+    nb, cnt = paddle.incubate.graph_sample_neighbors(row, colptr, nodes)
+    assert cnt.numpy().tolist() == [2, 1]
+    assert sorted(nb.numpy().tolist()) == [1, 2, 2]
+    src, dst, sample_idx, reindex = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, sample_sizes=[2])
+    assert len(src.numpy()) == 3
+
+
+def test_sparse_and_fft_additions():
+    from paddle_tpu import sparse
+    import scipy.fft
+    coo = sparse.sparse_coo_tensor(
+        paddle.to_tensor(np.array([[0, 1], [1, 0]])),
+        paddle.to_tensor(np.array([2.0, 3.0], "float32")), (2, 2))
+    np.testing.assert_allclose(
+        sparse.mv(coo, paddle.to_tensor(np.array([1.0, 2.0], "float32")))
+        .numpy(), [4.0, 3.0])
+    r = sparse.reshape(coo, [4])
+    assert tuple(r.shape) == (4,) or r.shape == [4]
+    x = (np.random.RandomState(0).rand(4, 5)
+         + 1j * np.random.RandomState(1).rand(4, 5)).astype("complex64")
+    np.testing.assert_allclose(
+        paddle.fft.hfft2(paddle.to_tensor(x)).numpy(),
+        scipy.fft.hfft2(x), rtol=1e-4)
