@@ -33,6 +33,7 @@ class AudioClassificationDataset(Dataset):
         self.sample_rate = sample_rate
         self.feat_cls = _FEATS[feat_type]
         self._feat_kwargs = kwargs
+        self._feat_cache = {}      # sr -> extractor (filterbank/DCT reuse)
 
     def __len__(self):
         return len(self.files)
@@ -41,11 +42,13 @@ class AudioClassificationDataset(Dataset):
         wav, sr = _bk.load(self.files[idx])
         if self.feat_cls is None:
             return wav, self.labels[idx]
-        kw = dict(self._feat_kwargs)
-        if self.feat_cls is not Spectrogram:      # Spectrogram is sr-free
-            kw.setdefault("sr", sr)
-        feat = self.feat_cls(**kw)(wav)
-        return feat, self.labels[idx]
+        extractor = self._feat_cache.get(sr)
+        if extractor is None:
+            kw = dict(self._feat_kwargs)
+            if self.feat_cls is not Spectrogram:  # Spectrogram is sr-free
+                kw.setdefault("sr", sr)
+            extractor = self._feat_cache[sr] = self.feat_cls(**kw)
+        return extractor(wav), self.labels[idx]
 
 
 class TESS(AudioClassificationDataset):

@@ -91,7 +91,10 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
                                        weight_attr=weight_attr)
         return layer(x)
     if operation == "linear":
-        if axis == 1:
+        # reference axis semantics (mp_ops.py): axis=0 splits in_features
+        # (row-parallel: sliced input + psum), axis=1 splits out_features
+        # (column-parallel: gathered output)
+        if axis == 0:
             layer = RowParallelLinear(size[0], size[1],
                                       weight_attr=weight_attr,
                                       has_bias=bias_attr is not False,

@@ -84,63 +84,36 @@ def irfftn(x, s=None, axes=None, norm="backward", name=None):
         lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=_norm(norm)), x)
 
 
-def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    """reference fft.py hfft2: hermitian FFT over the last two axes
-    (scipy.fft backs the c2r/r2c hermitian family; numpy has only 1-D)."""
+def _hermitian_host(scipy_name, x, s, axes, norm):
+    """Shared host-side body for the hermitian 2d/nd family: scipy.fft
+    backs c2r/r2c (numpy has only the 1-D hfft/ihfft)."""
+    import numpy as np
+    import scipy.fft as _scipy_fft
+    import jax
     from .core.tensor import Tensor
     import jax.numpy as jnp
-    import scipy.fft as _scipy_fft
     d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if isinstance(d, __import__("jax").core.Tracer):
+    if isinstance(d, jax.core.Tracer):
         raise RuntimeError(
             "the hermitian 2d/nd FFT family runs host-side (scipy.fft); "
             "it cannot be used inside jit — call it eagerly, or compose "
             "jnp.fft.hfft/ihfft per axis for a compiled path")
-    import numpy as np
-    return Tensor(jnp.asarray(_scipy_fft.hfft2(np.asarray(d), s=s, axes=axes,
-                                           norm=norm)))
+    fn = getattr(_scipy_fft, scipy_name)
+    return Tensor(jnp.asarray(fn(np.asarray(d), s=s, axes=axes, norm=norm)))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """reference fft.py hfft2: hermitian FFT over the last two axes."""
+    return _hermitian_host("hfft2", x, s, axes, norm)
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    from .core.tensor import Tensor
-    import jax.numpy as jnp
-    import scipy.fft as _scipy_fft
-    import numpy as np
-    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if isinstance(d, __import__("jax").core.Tracer):
-        raise RuntimeError(
-            "the hermitian 2d/nd FFT family runs host-side (scipy.fft); "
-            "it cannot be used inside jit — call it eagerly, or compose "
-            "jnp.fft.hfft/ihfft per axis for a compiled path")
-    return Tensor(jnp.asarray(_scipy_fft.ihfft2(np.asarray(d), s=s, axes=axes,
-                                            norm=norm)))
+    return _hermitian_host("ihfft2", x, s, axes, norm)
 
 
 def hfftn(x, s=None, axes=None, norm="backward", name=None):
-    from .core.tensor import Tensor
-    import jax.numpy as jnp
-    import scipy.fft as _scipy_fft
-    import numpy as np
-    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if isinstance(d, __import__("jax").core.Tracer):
-        raise RuntimeError(
-            "the hermitian 2d/nd FFT family runs host-side (scipy.fft); "
-            "it cannot be used inside jit — call it eagerly, or compose "
-            "jnp.fft.hfft/ihfft per axis for a compiled path")
-    return Tensor(jnp.asarray(_scipy_fft.hfftn(np.asarray(d), s=s, axes=axes,
-                                           norm=norm)))
+    return _hermitian_host("hfftn", x, s, axes, norm)
 
 
 def ihfftn(x, s=None, axes=None, norm="backward", name=None):
-    from .core.tensor import Tensor
-    import jax.numpy as jnp
-    import scipy.fft as _scipy_fft
-    import numpy as np
-    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if isinstance(d, __import__("jax").core.Tracer):
-        raise RuntimeError(
-            "the hermitian 2d/nd FFT family runs host-side (scipy.fft); "
-            "it cannot be used inside jit — call it eagerly, or compose "
-            "jnp.fft.hfft/ihfft per axis for a compiled path")
-    return Tensor(jnp.asarray(_scipy_fft.ihfftn(np.asarray(d), s=s, axes=axes,
-                                            norm=norm)))
+    return _hermitian_host("ihfftn", x, s, axes, norm)

@@ -36,7 +36,9 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     graph_sample_neighbors.py; kernel phi/kernels/gpu/
     graph_sample_neighbors_kernel.cu). Host-side numpy sampling."""
     rown, colp, nodes = _np(row), _np(colptr), _np(input_nodes).reshape(-1)
-    rng = np.random.default_rng(0)
+    # np.random's GLOBAL stream: each call draws a fresh sample and
+    # np.random.seed / paddle.seed-driven pipelines stay reproducible
+    rng = np.random
     out_nb, out_cnt, out_eids = [], [], []
     eid = _np(eids) if eids is not None else None
     for n in nodes:
@@ -114,11 +116,8 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
 def softmax_mask_fuse(x, mask, name=None):
     """reference: incubate/operators/softmax_mask_fuse.py (CUDA fused
     kernel fused_softmax_mask op): softmax(x + mask) — one XLA fusion."""
-    return apply_op(
-        lambda a, m: jnp.asarray(
-            jnp.exp(a + m - jnp.max(a + m, -1, keepdims=True))
-            / jnp.sum(jnp.exp(a + m - jnp.max(a + m, -1, keepdims=True)),
-                      -1, keepdims=True)), x, mask)
+    import jax
+    return apply_op(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
 
 
 def softmax_mask_fuse_upper_triangle(x, name=None):

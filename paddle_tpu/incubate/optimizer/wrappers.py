@@ -22,6 +22,12 @@ class LookAhead:
         return getattr(self.inner_optimizer, item)
 
     def step(self):
+        if not self._slow:
+            # slow weights start from the INITIAL parameters (reference
+            # lookahead.py seeds them in the startup program), so the
+            # first window already pulls back toward the starting point
+            for p in self.inner_optimizer._parameters:
+                self._slow[id(p)] = p._data
         self.inner_optimizer.step()
         self._count += 1
         if self._count % self.k:
@@ -29,12 +35,7 @@ class LookAhead:
         for p in self.inner_optimizer._parameters:
             slow = self._slow.get(id(p))
             if slow is None:
-                slow = jnp.zeros_like(p._data)
-                # first window: slow weights start from the pre-training
-                # value being 0 would be wrong — seed from current fast
                 slow = p._data
-                self._slow[id(p)] = slow
-                continue
             slow = slow + self.alpha * (p._data - slow)
             self._slow[id(p)] = slow
             p._data = slow

@@ -305,6 +305,39 @@ def _embed(tokens, params, cfg, plan):
     return emb.astype(jnp.dtype(cfg.compute_dtype))
 
 
+@jax.custom_vjp
+def _logits_matmul(h, wte):
+    """bf16 x bf16 -> f32 logits with a bf16-cotangent backward.
+
+    Without this, the backward matmuls (dh = g @ wte, dw = g^T @ h) inherit
+    the f32 cotangent as an operand and XLA runs them at the f32 MXU rate
+    (~1/4-1/8 of bf16) — and they are the two largest matmuls in the model
+    (B*S x V x H). Casting g to the param dtype first keeps full MXU rate;
+    accumulation stays f32 via preferred_element_type (the standard
+    mixed-precision recipe, and what the reference's fused
+    c_softmax_with_cross_entropy kernel does by computing in fp16/bf16
+    with fp32 softmax statistics)."""
+    return jnp.einsum("bsh,vh->bsv", h, wte,
+                      preferred_element_type=jnp.float32)
+
+
+def _logits_matmul_fwd(h, wte):
+    return _logits_matmul(h, wte), (h, wte)
+
+
+def _logits_matmul_bwd(res, g):
+    h, wte = res
+    gl = g.astype(h.dtype)
+    dh = jnp.einsum("bsv,vh->bsh", gl, wte,
+                    preferred_element_type=jnp.float32).astype(h.dtype)
+    dw = jnp.einsum("bsv,bsh->vh", gl, h,
+                    preferred_element_type=jnp.float32).astype(wte.dtype)
+    return dh, dw
+
+
+_logits_matmul.defvjp(_logits_matmul_fwd, _logits_matmul_bwd)
+
+
 def _vocab_parallel_loss(h, labels, params, cfg, plan):
     """Tied-embedding LM head + vocab-parallel softmax CE (reference:
     c_softmax_with_cross_entropy). Returns mean NLL over local tokens."""
@@ -314,8 +347,7 @@ def _vocab_parallel_loss(h, labels, params, cfg, plan):
     # bf16 operands, f32 accumulation: full MXU rate with f32-safe softmax
     # statistics downstream (vs. upcasting operands, which halves+ MXU
     # throughput for the biggest matmul in the model)
-    logits = jnp.einsum("bsh,vh->bsv", h, wte,
-                        preferred_element_type=jnp.float32)
+    logits = _logits_matmul(h, wte)
     local_max = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     gmax = jax.lax.stop_gradient(jax.lax.pmax(local_max, "mp")) \
         if plan.mp > 1 else local_max

@@ -513,6 +513,13 @@ def box_coder(prior_box, prior_box_var, target_box,
     box_coder, phi box_coder kernel)."""
     def fn(pb, tb, *pv):
         pbv = pv[0] if pv else None
+        if tb.ndim == 3 and pb.ndim == 2:
+            # reference axis semantics: axis names the TargetBox dim the
+            # priors broadcast along (0 -> prior i pairs with tb[i, :]).
+            expand = (slice(None), None) if axis == 0 else (None, slice(None))
+            pb = pb[expand]
+            if pbv is not None and pbv.ndim == 2:
+                pbv = pbv[expand]
         pw = pb[..., 2] - pb[..., 0] + (0.0 if box_normalized else 1.0)
         phh = pb[..., 3] - pb[..., 1] + (0.0 if box_normalized else 1.0)
         pcx = pb[..., 0] + pw * 0.5
@@ -710,7 +717,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     A = len(mask)
     an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
     an = an_all[mask]
-    inp_size = H * downsample_ratio
+    in_h = H * downsample_ratio
+    in_w = W * downsample_ratio
     p = xr.reshape(N, A, 5 + class_num, H, W)
     px = 1 / (1 + np.exp(-p[:, :, 0]))
     py = 1 / (1 + np.exp(-p[:, :, 1]))
@@ -731,8 +739,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         # predicted boxes for ignore-region computation
         gx = (np.arange(W)[None, None] + px[n]) / W
         gy = (np.arange(H)[None, :, None] + py[n]) / H
-        gw = an[:, 0][:, None, None] * np.exp(pw[n]) / inp_size
-        gh = an[:, 1][:, None, None] * np.exp(phh[n]) / inp_size
+        gw = an[:, 0][:, None, None] * np.exp(pw[n]) / in_w
+        gh = an[:, 1][:, None, None] * np.exp(phh[n]) / in_h
         pb = np.stack([gx, gy, gw, gh], -1).reshape(-1, 4)
         for b in range(gb.shape[1]):
             if gb[n, b, 2] <= 0 or gb[n, b, 3] <= 0:
@@ -751,7 +759,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             ious = iou_cwh(gb[n, b][None], pb).reshape(A, H, W)
             ignore |= ious > ignore_thresh
             # best anchor over the FULL anchor set
-            gt_wh = gb[n, b, 2:] * inp_size
+            gt_wh = gb[n, b, 2:] * np.asarray([in_w, in_h])
             best, best_iou = -1, 0
             for ai, (aw, ah) in enumerate(an_all):
                 mn = np.minimum([aw, ah], gt_wh)
@@ -769,8 +777,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             ignore[a_loc, gj, gi] = False
             tx = gb[n, b, 0] * W - gi
             ty = gb[n, b, 1] * H - gj
-            tw = np.log(gb[n, b, 2] * inp_size / an[a_loc, 0] + eps)
-            th = np.log(gb[n, b, 3] * inp_size / an[a_loc, 1] + eps)
+            tw = np.log(gb[n, b, 2] * in_w / an[a_loc, 0] + eps)
+            th = np.log(gb[n, b, 3] * in_h / an[a_loc, 1] + eps)
             box_scale = 2.0 - gb[n, b, 2] * gb[n, b, 3]
             sc_w = gs[n, b]
             loss[n] += sc_w * box_scale * (

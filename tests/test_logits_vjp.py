@@ -1,0 +1,54 @@
+"""bf16-cotangent logits backward (gpt_spmd._logits_matmul custom vjp):
+in f32 it must be bit-identical to autodiff; in bf16 close to the f32
+reference (the cast touches only the cotangent operand)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.parallel.gpt_spmd import _logits_matmul
+
+
+def _loss(fn, h, w, labels):
+    logits = fn(h, w)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(lp, labels[..., None],
+                                         axis=-1))
+
+
+def test_f32_matches_plain_autodiff_exactly():
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.rand(2, 8, 16).astype("float32"))
+    w = jnp.asarray(rng.rand(32, 16).astype("float32") * 0.1)
+    labels = jnp.asarray(rng.randint(0, 32, (2, 8)))
+
+    def plain(h, w):
+        return jnp.einsum("bsh,vh->bsv", h, w,
+                          preferred_element_type=jnp.float32)
+
+    g1 = jax.grad(lambda h, w: _loss(_logits_matmul, h, w, labels),
+                  argnums=(0, 1))(h, w)
+    g2 = jax.grad(lambda h, w: _loss(plain, h, w, labels),
+                  argnums=(0, 1))(h, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16_close_to_f32_reference():
+    rng = np.random.RandomState(1)
+    h32 = rng.rand(2, 8, 16).astype("float32")
+    w32 = (rng.rand(32, 16).astype("float32") * 0.1)
+    labels = jnp.asarray(rng.randint(0, 32, (2, 8)))
+    h = jnp.asarray(h32, jnp.bfloat16)
+    w = jnp.asarray(w32, jnp.bfloat16)
+    gh, gw = jax.grad(lambda h, w: _loss(_logits_matmul, h, w, labels),
+                      argnums=(0, 1))(h, w)
+    assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+    rh, rw = jax.grad(
+        lambda h, w: _loss(lambda a, b: jnp.einsum(
+            "bsh,vh->bsv", a, b, preferred_element_type=jnp.float32),
+            h, w, labels), argnums=(0, 1))(
+        jnp.asarray(h32), jnp.asarray(w32))
+    np.testing.assert_allclose(np.asarray(gh, np.float32), np.asarray(rh),
+                               atol=2e-2, rtol=0.2)
+    np.testing.assert_allclose(np.asarray(gw, np.float32), np.asarray(rw),
+                               atol=2e-2, rtol=0.2)
