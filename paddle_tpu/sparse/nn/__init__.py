@@ -1,13 +1,14 @@
 """paddle.sparse.nn — layers over sparse tensors.
 
 Reference: python/paddle/sparse/nn (ReLU, BatchNorm, Conv3D/SubmConv3D for
-point clouds). ReLU/BatchNorm act on the values vector; the 3-D submanifold
-convs are descoped this round (PARITY.md) — they need the gather-scatter
-rulebook kernels that only pay off for point-cloud workloads.
+point clouds). ReLU/BatchNorm act on the values vector; the 3-D convs use
+a host-built rulebook + device gather/matmul/scatter (conv.py).
 """
 from ...nn.layer.layers import Layer
+from .conv import Conv3D, SubmConv3D, conv3d, subm_conv3d  # noqa: F401
+from . import functional  # noqa: F401
 
-__all__ = ["ReLU", "BatchNorm"]
+__all__ = ["ReLU", "BatchNorm", "Conv3D", "SubmConv3D"]
 
 
 class ReLU(Layer):

@@ -1,0 +1,9 @@
+"""paddle.sparse.nn.functional (reference: sparse/nn/functional)."""
+from .conv import conv3d, subm_conv3d  # noqa: F401
+
+__all__ = ["conv3d", "subm_conv3d", "relu"]
+
+
+def relu(x, name=None):
+    from .. import relu as _relu
+    return _relu(x)

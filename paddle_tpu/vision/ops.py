@@ -514,9 +514,10 @@ def box_coder(prior_box, prior_box_var, target_box,
     def fn(pb, tb, *pv):
         pbv = pv[0] if pv else None
         if tb.ndim == 3 and pb.ndim == 2:
-            # reference axis semantics: axis names the TargetBox dim the
-            # priors broadcast along (0 -> prior i pairs with tb[i, :]).
-            expand = (slice(None), None) if axis == 0 else (None, slice(None))
+            # reference axis semantics (vision/ops.py:722): axis is the
+            # PriorBox broadcast axis — axis=0: [M,4] -> [1,M,4] (prior j
+            # pairs with tb[:, j]); axis=1: [N,4] -> [N,1,4]
+            expand = (None, slice(None)) if axis == 0 else (slice(None), None)
             pb = pb[expand]
             if pbv is not None and pbv.ndim == 2:
                 pbv = pbv[expand]

@@ -127,6 +127,18 @@ def test_box_coder_roundtrip_and_prior_box():
     assert tuple(pb.shape) == (4, 4, 4, 4)
     assert (np.asarray(var.numpy())[..., 2] == 0.2).all()
 
+    # 3-D decode, reference axis semantics (vision/ops.py:722): axis=0
+    # broadcasts PriorBox [M,4] over the batch — prior j pairs with tb[:, j]
+    deltas = paddle.to_tensor(np.zeros((3, 2, 4), "float32"))   # N=3, M=2
+    dec = V.box_coder(priors, None, deltas,
+                      code_type="decode_center_size", axis=0)
+    assert tuple(dec.shape) == (3, 2, 4)
+    for n in range(3):
+        np.testing.assert_allclose(dec.numpy()[n, 0],
+                                   [0., 0., 10., 10.], atol=1e-5)
+        np.testing.assert_allclose(dec.numpy()[n, 1],
+                                   [5., 5., 20., 20.], atol=1e-5)
+
 
 def test_matrix_nms_decay():
     from paddle_tpu.vision import ops as V

@@ -1,0 +1,103 @@
+"""Sparse 3-D conv rulebook vs dense conv golden (reference:
+python/paddle/sparse/nn/layer/conv.py; kernels phi/kernels/sparse/conv_*)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_coo(rng, N, D, H, W, C, nnz):
+    seen = set()
+    while len(seen) < nnz:
+        seen.add((rng.randint(N), rng.randint(D), rng.randint(H),
+                  rng.randint(W)))
+    idx = np.asarray(sorted(seen), np.int64).T          # (4, nnz)
+    vals = rng.rand(idx.shape[1], C).astype("float32")
+    return idx, vals
+
+
+def _densify(idx, vals, shape):
+    dense = np.zeros(shape, "float32")
+    for k in range(idx.shape[1]):
+        b, z, y, x = idx[:, k]
+        dense[b, z, y, x] = vals[k]
+    return dense
+
+
+def _dense_conv3d(dense, w, stride, padding):
+    """Direct NDHWC conv3d reference in numpy."""
+    N, D, H, W, Cin = dense.shape
+    kd, kh, kw, _, Cout = w.shape
+    s, p = stride, padding
+    Do = (D + 2 * p - kd) // s + 1
+    Ho = (H + 2 * p - kh) // s + 1
+    Wo = (W + 2 * p - kw) // s + 1
+    padded = np.pad(dense, ((0, 0), (p, p), (p, p), (p, p), (0, 0)))
+    out = np.zeros((N, Do, Ho, Wo, Cout), "float32")
+    for z in range(Do):
+        for y in range(Ho):
+            for x in range(Wo):
+                patch = padded[:, z*s:z*s+kd, y*s:y*s+kh, x*s:x*s+kw]
+                out[:, z, y, x] = np.tensordot(
+                    patch, w, axes=([1, 2, 3, 4], [0, 1, 2, 3]))
+    return out
+
+
+def test_subm_conv3d_matches_dense_at_input_sites():
+    rng = np.random.RandomState(0)
+    N, D, H, W, C, Cout = 2, 5, 5, 5, 3, 4
+    idx, vals = _random_coo(rng, N, D, H, W, C, nnz=12)
+    x = sparse.sparse_coo_tensor(paddle.to_tensor(idx),
+                                 paddle.to_tensor(vals),
+                                 (N, D, H, W, C))
+    w = rng.rand(3, 3, 3, C, Cout).astype("float32") * 0.1
+    out = sparse.nn.functional.subm_conv3d(x, paddle.to_tensor(w))
+    # golden: dense conv3d 'same' padding, read at input sites only
+    dense = _densify(idx, vals, (N, D, H, W, C))
+    ref = _dense_conv3d(dense, w, stride=1, padding=1)
+    oi = np.asarray(out.indices_.numpy())
+    np.testing.assert_array_equal(oi, idx)        # submanifold: sites kept
+    for k in range(oi.shape[1]):
+        b, z, y, x_ = oi[:, k]
+        np.testing.assert_allclose(out.values_.numpy()[k],
+                                   ref[b, z, y, x_], rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_matches_dense_on_active_outputs():
+    rng = np.random.RandomState(1)
+    N, D, H, W, C, Cout = 1, 4, 4, 4, 2, 3
+    idx, vals = _random_coo(rng, N, D, H, W, C, nnz=6)
+    x = sparse.sparse_coo_tensor(paddle.to_tensor(idx),
+                                 paddle.to_tensor(vals),
+                                 (N, D, H, W, C))
+    w = rng.rand(2, 2, 2, C, Cout).astype("float32") * 0.1
+    out = sparse.nn.functional.conv3d(x, paddle.to_tensor(w), stride=1,
+                                      padding=0)
+    dense = _densify(idx, vals, (N, D, H, W, C))
+    ref = _dense_conv3d(dense, w, stride=1, padding=0)
+    oi = np.asarray(out.indices_.numpy())
+    ov = out.values_.numpy()
+    for k in range(oi.shape[1]):
+        b, z, y, x_ = oi[:, k]
+        np.testing.assert_allclose(ov[k], ref[b, z, y, x_], rtol=1e-4,
+                                   atol=1e-5)
+    # every nonzero dense output site is covered by the sparse output
+    nz = np.argwhere(np.abs(ref).sum(-1) > 1e-7)
+    covered = {tuple(oi[:, k]) for k in range(oi.shape[1])}
+    for site in map(tuple, nz):
+        assert site in covered
+
+
+def test_sparse_conv_layers_and_grad():
+    rng = np.random.RandomState(2)
+    idx, vals = _random_coo(rng, 1, 4, 4, 4, 2, nnz=5)
+    x = sparse.sparse_coo_tensor(paddle.to_tensor(idx),
+                                 paddle.to_tensor(vals), (1, 4, 4, 4, 2))
+    layer = sparse.nn.SubmConv3D(2, 4, 3, padding=1)
+    out = layer(x)
+    assert tuple(out.values_.shape) == (5, 4)
+    assert out.shape[-1] == 4          # dense_shape channel = out_channels
+    loss = out.values_.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert np.isfinite(layer.weight.grad.numpy()).all()
