@@ -7,8 +7,8 @@ TPU-native design: XLA has no first-class sparse type; COO/CSR here are
 the same strategy jax.experimental.sparse uses. Dense-like unary ops act on
 `values` only (nnz-sized compute); binary/matmul densify at the XLA
 boundary, where fusion makes the materialization cheap at these sizes.
-Point-cloud 3D sparse convs (phi/kernels/sparse/conv_kernel.cu) are
-descoped this round — see PARITY.md.
+Point-cloud 3-D sparse + submanifold convs run a host-built rulebook with
+device gather/matmul/scatter compute (`sparse/nn/conv.py`).
 """
 import numpy as np
 

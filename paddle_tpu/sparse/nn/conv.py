@@ -43,12 +43,11 @@ def _rulebook(in_idx, dense_shape, ksize, stride, padding, dilation,
     W_out = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
 
     def out_site(b, z, y, x, oz, oy, ox):
-        """Output coordinate fed by input (z,y,x) through offset (oz,oy,ox),
-        or None when off-grid / off-stride."""
-        if subm:
-            # centered offsets: output site z - (oz - k//2) * dilation
-            return (b, z - (oz - kd // 2) * dd, y - (oy - kh // 2) * dh,
-                    x - (ox - kw // 2) * dw)
+        """Output coordinate fed by input (z,y,x) through offset (oz,oy,ox)
+        — the reference mapping out = (in + pad - off*dil)/stride for BOTH
+        modes (subm differs only in restricting outputs to input sites, so
+        user padding/stride are honored, not assumed canonical); None when
+        off-grid / off-stride."""
         z2 = z + pd - oz * dd
         y2 = y + ph - oy * dh
         x2 = x + pw - ox * dw
@@ -57,6 +56,8 @@ def _rulebook(in_idx, dense_shape, ksize, stride, padding, dilation,
         z2 //= sd
         y2 //= sh
         x2 //= sw
+        if subm:
+            return (b, z2, y2, x2)
         if 0 <= z2 < D_out and 0 <= y2 < H_out and 0 <= x2 < W_out:
             return (b, z2, y2, x2)
         return None
@@ -84,21 +85,24 @@ def _rulebook(in_idx, dense_shape, ksize, stride, padding, dilation,
 
     if subm:
         out_coords = coords
+        out_spatial = (D, H, W)
     else:
         out_coords = np.asarray(sorted(out_key, key=out_key.get),
                                 np.int64).reshape(-1, 4)
+        out_spatial = (D_out, H_out, W_out)
     rules = [(np.asarray([p[0] for p in pairs], np.int32),
               np.asarray([p[1] for p in pairs], np.int32))
              for pairs in per_offset]
-    return np.asarray(out_coords, np.int64).T, rules
+    return np.asarray(out_coords, np.int64).T, rules, out_spatial
 
 
 def _sparse_conv(x, weight, bias, stride, padding, dilation, subm):
     ksize = tuple(int(s) for s in weight.shape[:3])
     in_idx = np.asarray(x.indices_._data
                         if isinstance(x.indices_, Tensor) else x.indices_)
-    out_idx_np, rules = _rulebook(in_idx, x.shape, ksize, stride, padding,
-                                  dilation, subm)
+    out_idx_np, rules, out_spatial = _rulebook(in_idx, x.shape, ksize,
+                                               stride, padding, dilation,
+                                               subm)
     m = out_idx_np.shape[1]
     Cout = int(weight.shape[-1])
 
@@ -121,19 +125,7 @@ def _sparse_conv(x, weight, bias, stride, padding, dilation, subm):
     from .. import SparseCooTensor
     args = [x.values_, weight] + ([bias] if bias is not None else [])
     out_vals = apply_op(fn, *args)
-    if subm:
-        out_shape = list(x.shape)
-        out_shape[-1] = Cout          # sites kept, channels change
-    else:
-        sd, sh, sw = stride
-        pd, ph, pw = padding
-        dd, dh, dw = dilation
-        D, H, W = x.shape[1:4]
-        out_shape = [x.shape[0],
-                     (D + 2 * pd - dd * (ksize[0] - 1) - 1) // sd + 1,
-                     (H + 2 * ph - dh * (ksize[1] - 1) - 1) // sh + 1,
-                     (W + 2 * pw - dw * (ksize[2] - 1) - 1) // sw + 1,
-                     Cout]
+    out_shape = [x.shape[0], *out_spatial, Cout]
     return SparseCooTensor(Tensor(jnp.asarray(out_idx_np)), out_vals,
                            out_shape)
 
@@ -166,6 +158,8 @@ class _SparseConvBase(Layer):
                  padding=0, dilation=1, groups=1, padding_mode="zeros",
                  weight_attr=None, bias_attr=None, data_format="NDHWC"):
         super().__init__()
+        if groups != 1:
+            raise NotImplementedError("sparse conv layers: groups > 1")
         kd, kh, kw = _triple(kernel_size)
         self.weight = self.create_parameter(
             (kd, kh, kw, in_channels, out_channels), attr=weight_attr,

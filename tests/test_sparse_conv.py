@@ -51,7 +51,10 @@ def test_subm_conv3d_matches_dense_at_input_sites():
                                  paddle.to_tensor(vals),
                                  (N, D, H, W, C))
     w = rng.rand(3, 3, 3, C, Cout).astype("float32") * 0.1
-    out = sparse.nn.functional.subm_conv3d(x, paddle.to_tensor(w))
+    # padding=1 = the canonical 'same' window; subm honors user padding
+    # like the reference (out = (in + pad - off)/stride restricted to
+    # input sites), so the golden below must use the same padding
+    out = sparse.nn.functional.subm_conv3d(x, paddle.to_tensor(w), padding=1)
     # golden: dense conv3d 'same' padding, read at input sites only
     dense = _densify(idx, vals, (N, D, H, W, C))
     ref = _dense_conv3d(dense, w, stride=1, padding=1)
