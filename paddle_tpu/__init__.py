@@ -49,6 +49,11 @@ from . import device  # noqa: F401
 from . import version  # noqa: F401
 from . import inference  # noqa: F401
 from . import onnx  # noqa: F401
+from . import utils  # noqa: F401
+from . import hub  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import regularizer  # noqa: F401
 from . import quantization  # noqa: F401
 from . import geometric  # noqa: F401
 

@@ -123,12 +123,18 @@ def CUDAPlace(index: int = 0) -> Place:
     return Place("tpu", index) if auto.kind == "tpu" else auto
 
 
+def _compat_place(name: str, index: int = 0) -> Place:
+    """Shared shim for vendor Places (reference paddle.{NPU,XPU,IPU,MLU}
+    Place): warn once and map to the accelerator place."""
+    import warnings
+    warnings.warn(f"{name} is not a real device on the TPU backend; "
+                  f"mapping to the accelerator (TPU) place", stacklevel=3)
+    return Place("tpu", index)
+
+
 def NPUPlace(index: int = 0) -> Place:
     """Compat shim (reference: paddle.NPUPlace) — see CUDAPlace."""
-    import warnings
-    warnings.warn("NPUPlace is not a real device on the TPU backend; "
-                  "mapping to the accelerator (TPU) place", stacklevel=2)
-    return Place("tpu", index)
+    return _compat_place("NPUPlace", index)
 
 
 def CUDAPinnedPlace() -> Place:

@@ -28,3 +28,54 @@ def synchronize(device=None):
 
 def current_stream(device=None):
     return Stream()
+
+
+def get_cudnn_version():
+    """reference: device/__init__.py get_cudnn_version — None when CUDA is
+    not the backend."""
+    return None
+
+
+def XPUPlace(index=0):
+    from ..core.device import _compat_place
+    return _compat_place("XPUPlace", index)
+
+
+def IPUPlace(index=0):
+    from ..core.device import _compat_place
+    return _compat_place("IPUPlace", index)
+
+
+def MLUPlace(index=0):
+    from ..core.device import _compat_place
+    return _compat_place("MLUPlace", index)
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []

@@ -1,0 +1,54 @@
+"""paddle.hub (reference: python/paddle/hub.py — list/help/load over a
+github/gitee/local 'repo' exposing hubconf.py). Zero-egress build: only
+source='local' works; remote sources raise with the local alternative.
+"""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access (zero-egress "
+            f"build); clone the repo yourself and use source='local' with "
+            f"repo_dir=<path>")
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoints exposed by the repo's hubconf.py."""
+    if os.path.isdir(repo_dir):
+        source = "local"
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    if os.path.isdir(repo_dir):
+        source = "local"
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    if os.path.isdir(repo_dir):
+        source = "local"
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(**kwargs)

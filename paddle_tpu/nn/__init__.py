@@ -27,3 +27,4 @@ from .layer.transformer import (  # noqa: F401
     TransformerEncoder, TransformerEncoderLayer,
 )
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from . import utils  # noqa: F401

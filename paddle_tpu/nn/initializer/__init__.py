@@ -172,3 +172,26 @@ calculate_gain = lambda nonlinearity, param=None: {  # noqa: E731
     "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
     "selu": 3.0 / 4,
 }.get(nonlinearity, 1.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference:
+    nn/initializer/Bilinear over fluid BilinearInitializer): weight shape
+    (C_out, C_in, kH, kW) gets the separable triangle filter."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        filt = (1 - abs(yy / fh - ch)) * (1 - abs(xx / fw - cw))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype)

@@ -1,0 +1,144 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/{weight_norm_hook,
+spectral_norm_hook,transform_parameters}.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, apply_op
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(v, dim):
+    """L2 norm over every axis except `dim` (dim=None/-1: whole tensor)."""
+    if dim is None or dim == -1:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(a for a in range(v.ndim) if a != dim)
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return jnp.sqrt(jnp.sum(v * v, axis=axes)).reshape(shape)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.<name>` as g * v / ||v|| (reference:
+    nn/utils/weight_norm_hook.py weight_norm; Salimans & Kingma 2016).
+
+    Registers <name>_g (magnitude) and <name>_v (direction) as the
+    trainable parameters and recomputes the weight before every forward
+    via a pre-forward hook on the layer."""
+    from ..layer.layers import Layer
+    assert isinstance(layer, Layer)
+    w = getattr(layer, name)
+    from ...core.tensor import Parameter
+    g = Parameter(np.asarray(_norm_except(w._data, dim)))
+    v = Parameter(np.asarray(w._data))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def compute():
+        return apply_op(
+            lambda gg, vv: vv * (gg / jnp.maximum(_norm_except(vv, dim),
+                                                  1e-12)), g, v)
+
+    orig_forward = layer.forward
+
+    def wrapped_forward(*args, **kwargs):
+        object.__setattr__(layer, name, compute())
+        return orig_forward(*args, **kwargs)
+
+    layer._wn_state = (name, dim, orig_forward)
+    layer.forward = wrapped_forward
+    object.__setattr__(layer, name, compute())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a single parameter (reference:
+    remove_weight_norm)."""
+    state = getattr(layer, "_wn_state", None)
+    if state is None or state[0] != name:
+        raise ValueError(f"layer has no weight norm on {name!r}")
+    _, dim, orig_forward = state
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    from ...core.tensor import Parameter
+    w = Parameter(np.asarray(
+        v._data * (g._data / jnp.maximum(_norm_except(v._data, dim),
+                                         1e-12))))
+    layer.add_parameter(name, w)
+    layer.forward = orig_forward
+    del layer._wn_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Divide the weight by its largest singular value, estimated by power
+    iteration before each forward (reference: spectral_norm_hook)."""
+    from ..layer.layers import Layer
+    assert isinstance(layer, Layer)
+    w = getattr(layer, name)
+    wd = np.asarray(w._data, np.float32)
+    mat = np.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = rng.randn(mat.shape[0]).astype(np.float32)
+    u /= np.linalg.norm(u) + eps
+
+    state = {"u": u}
+
+    def compute():
+        # power iteration on the CURRENT weight value, host-side and
+        # grad-free (reference keeps u as a persistent buffer); u carries
+        # over between forwards so the estimate converges during training
+        wcur = np.asarray(getattr(layer, name + "_orig")._data, np.float32)
+        m_np = np.moveaxis(wcur, dim, 0).reshape(wcur.shape[dim], -1)
+        uu = state["u"]
+        for _ in range(n_power_iterations):
+            vv = m_np.T @ uu
+            vv = vv / (np.linalg.norm(vv) + eps)
+            uu = m_np @ vv
+            uu = uu / (np.linalg.norm(uu) + eps)
+        state["u"] = uu
+        uj, vj = jnp.asarray(uu), jnp.asarray(vv)
+
+        def fn(wraw):
+            m = jnp.moveaxis(wraw, dim, 0).reshape(wraw.shape[dim], -1)
+            sigma = uj @ (m @ vj)       # differentiable w.r.t. the weight
+            return wraw / jnp.maximum(sigma, eps)
+        return apply_op(fn, getattr(layer, name + "_orig"))
+
+    from ...core.tensor import Parameter
+    orig = Parameter(np.asarray(wd))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+
+    orig_forward = layer.forward
+
+    def wrapped_forward(*args, **kwargs):
+        object.__setattr__(layer, name, compute())
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = wrapped_forward
+    object.__setattr__(layer, name, compute())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one 1-D tensor (reference:
+    transform_parameters.py)."""
+    datas = [jnp.ravel(p._data) for p in parameters]
+    return Tensor(jnp.concatenate(datas) if datas
+                  else jnp.zeros((0,), jnp.float32))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write a flat vector back into the parameter list (in place)."""
+    d = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._data.shape)) if p._data.shape else 1
+        p._data = d[off:off + n].reshape(p._data.shape).astype(p._data.dtype)
+        p._version += 1
+        off += n
+    return parameters

@@ -142,9 +142,14 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NDHWC", key=None, name=None):
     """Submanifold sparse conv3d: output sites == input sites (reference:
-    subm_conv3d; Graham et al. SSCN)."""
+    subm_conv3d; Graham et al. SSCN — stride-1 by definition: a strided
+    output grid cannot equal the input sites)."""
     if groups != 1:
         raise NotImplementedError("sparse subm_conv3d: groups > 1")
+    if _triple(stride) != (1, 1, 1):
+        raise NotImplementedError(
+            "subm_conv3d requires stride=1 (output sites are the input "
+            "sites; use sparse conv3d for strided downsampling)")
     return _sparse_conv(x, _unwrap_w(weight), bias, _triple(stride),
                         _triple(padding), _triple(dilation), subm=True)
 
@@ -180,7 +185,14 @@ class Conv3D(_SparseConvBase):
 
 
 class SubmConv3D(_SparseConvBase):
-    """reference: sparse/nn/layer/conv.py SubmConv3D."""
+    """reference: sparse/nn/layer/conv.py SubmConv3D (stride must be 1)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self._stride != (1, 1, 1):
+            raise NotImplementedError(
+                "SubmConv3D requires stride=1 (output sites are the "
+                "input sites)")
 
     def forward(self, x):
         return _sparse_conv(x, self.weight, self.bias, self._stride,

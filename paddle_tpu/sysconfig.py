@@ -1,0 +1,14 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory with this package's headers (native runtime sources)."""
+    return os.path.join(os.path.dirname(__file__), "native", "src")
+
+
+def get_lib():
+    """Directory with the native shared library."""
+    return os.path.join(os.path.dirname(__file__), "native")

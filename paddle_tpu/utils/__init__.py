@@ -1,0 +1,72 @@
+"""paddle.utils (reference: python/paddle/utils/__init__.py: deprecated,
+run_check, require_version, try_import; submodules unique_name, download)."""
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+from . import download  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    utils/deprecated.py). level 0/1 warn; level 2 raises on call."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated "
+                   f"since {since or 'an earlier release'}"
+                   + (f"; use {update_to} instead" if update_to else "")
+                   + (f". Reason: {reason}" if reason else ""))
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Device self-test (reference: utils/install_check.py run_check):
+    run a tiny matmul fwd+bwd on the current backend and report."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 3), "float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.ones((3, 2), "float32"), stop_gradient=False)
+    y = (x @ w).sum()
+    y.backward()
+    assert float(y) == 12.0 and x.grad is not None
+    import jax
+    print(f"paddle_tpu is installed successfully! backend="
+          f"{jax.default_backend()}, devices={jax.device_count()}")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed version satisfies [min, max] (reference:
+    utils/__init__ require_version)."""
+    from ..version import full_version
+
+    def parts(v):
+        return [int(x) for x in str(v).split(".") if x.isdigit()]
+
+    cur = parts(full_version)
+    if parts(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parts(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module, raising a friendly error when absent (reference:
+    utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"module {module_name!r} is required but not "
+                          f"installed (and this build cannot download)")

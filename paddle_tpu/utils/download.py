@@ -1,0 +1,28 @@
+"""reference: utils/download.py — pretrained-weight fetch. Zero-egress
+build: a local cache hit works; a download attempt raises with the path
+layout so users know where to place files."""
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.isfile(path):
+        return path
+    raise RuntimeError(
+        f"cannot download {url} (zero-egress build); place the file at "
+        f"{path} and retry")
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    root = root_dir or WEIGHTS_HOME
+    path = os.path.join(root, os.path.basename(url))
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"cannot download {url} (zero-egress build); place the file at "
+        f"{path} and retry")

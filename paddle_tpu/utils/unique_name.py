@@ -1,0 +1,28 @@
+"""reference: utils/unique_name.py — process-wide unique name generator
+with guard() scoping (used by static layer helpers)."""
+import contextlib
+
+_COUNTERS = [{}]
+
+
+def generate(key):
+    c = _COUNTERS[-1]
+    c[key] = c.get(key, -1) + 1
+    return f"{key}_{c[key]}"
+
+
+def generate_with_ignorable_key(key):
+    return generate(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    _COUNTERS.append({})
+    try:
+        yield
+    finally:
+        _COUNTERS.pop()
+
+
+def switch(new_generator=None):
+    _COUNTERS[-1] = {}

@@ -1,0 +1,151 @@
+"""paddle.utils / hub / callbacks / sysconfig / nn.utils / device
+completions (reference: python/paddle/{utils,hub,callbacks,sysconfig}.py,
+nn/utils/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_weight_norm_roundtrip_and_training():
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    w0 = layer.weight.numpy().copy()
+    nn.utils.weight_norm(layer, "weight", dim=1)
+    names = dict(layer.named_parameters())
+    assert "weight_g" in names and "weight_v" in names \
+        and "weight" not in names
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    out = layer(x)
+    # initial reparameterization reproduces the original weight
+    ref = nn.Linear(4, 3)
+    ref.weight.set_value(paddle.to_tensor(w0))
+    ref.bias.set_value(layer.bias)
+    np.testing.assert_allclose(out.numpy(), ref(x).numpy(), rtol=1e-5,
+                               atol=1e-6)
+    # gradients flow to g and v
+    out.sum().backward()
+    assert names["weight_g"].grad is not None
+    assert names["weight_v"].grad is not None
+    # remove restores a single trainable weight with the same value
+    nn.utils.remove_weight_norm(layer, "weight")
+    names = dict(layer.named_parameters())
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(layer.weight.numpy(), w0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_spectral_norm_utility_caps_sigma():
+    paddle.seed(0)
+    layer = nn.Linear(6, 6)
+    # inflate the weight so sigma >> 1
+    layer.weight.set_value(paddle.to_tensor(
+        np.eye(6, dtype="float32") * 10))
+    nn.utils.spectral_norm(layer, "weight", n_power_iterations=5)
+    x = paddle.to_tensor(np.ones((1, 6), "float32"))
+    layer(x)
+    w = np.asarray(layer.weight.numpy())
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
+
+
+def test_spectral_norm_power_iteration_accumulates():
+    """u must persist across forwards (code-review finding): with
+    n_power_iterations=1, repeated forwards converge to sigma=1."""
+    paddle.seed(0)
+    layer = nn.Linear(8, 8)
+    rng = np.random.RandomState(7)
+    w = rng.randn(8, 8).astype("float32") * 3
+    layer.weight.set_value(paddle.to_tensor(w))
+    nn.utils.spectral_norm(layer, "weight", n_power_iterations=1)
+    x = paddle.to_tensor(np.ones((1, 8), "float32"))
+    for _ in range(30):
+        layer(x)
+    sigma = np.linalg.svd(np.asarray(layer.weight.numpy()),
+                          compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=5e-2)
+
+
+def test_subm_conv_stride_raises():
+    import pytest
+    from paddle_tpu import sparse
+    with pytest.raises(NotImplementedError, match="stride"):
+        sparse.nn.SubmConv3D(2, 3, 3, stride=2)
+
+
+def test_parameters_vector_roundtrip():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+    vec = nn.utils.parameters_to_vector(net.parameters())
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert tuple(vec.shape) == (total,)
+    doubled = paddle.to_tensor(vec.numpy() * 2)
+    nn.utils.vector_to_parameters(doubled, net.parameters())
+    vec2 = nn.utils.parameters_to_vector(net.parameters())
+    np.testing.assert_allclose(vec2.numpy(), vec.numpy() * 2, rtol=1e-6)
+
+
+def test_utils_deprecated_and_versions(capsys):
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="0.1")
+    def old():
+        return 42
+
+    with pytest.warns(DeprecationWarning):
+        assert old() == 42
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0")
+    paddle.utils.run_check()
+    assert "successfully" in capsys.readouterr().out
+    np = paddle.utils.try_import("numpy")
+    assert np is not None
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_unique_name_and_download():
+    a = paddle.utils.unique_name.generate("fc")
+    b = paddle.utils.unique_name.generate("fc")
+    assert a != b
+    with paddle.utils.unique_name.guard():
+        c = paddle.utils.unique_name.generate("fc")
+        assert c == "fc_0"
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        paddle.utils.download.get_weights_path_from_url(
+            "https://example.com/w.pdparams")
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    'A tiny model.'\n"
+        "    return {'scale': scale}\n")
+    assert "tiny_model" in paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                     source="local")
+    m = paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                        scale=3)
+    assert m == {"scale": 3}
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        paddle.hub.load("user/repo", "m", source="github")
+
+
+def test_callbacks_namespace_and_device_helpers():
+    assert paddle.callbacks.EarlyStopping is not None
+    assert paddle.callbacks.ReduceLROnPlateau is not None
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+    assert paddle.device.get_cudnn_version() is None
+    assert not paddle.device.is_compiled_with_rocm()
+    assert "cpu" in paddle.device.get_all_device_type()
+    assert paddle.device.get_available_device()
+
+
+def test_bilinear_initializer():
+    from paddle_tpu.nn.initializer import Bilinear
+    w = np.asarray(Bilinear()((2, 2, 4, 4), "float32"))
+    assert w.shape == (2, 2, 4, 4)
+    # symmetric triangle filter, peak at center
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], rtol=1e-6)
+    assert w[0, 0, 1:3, 1:3].min() > w[0, 0, 0, 0]
