@@ -67,14 +67,24 @@ def is_compiled_with_mlu():
     return False
 
 
-def get_all_device_type():
+def _all_devices():
     import jax
-    return sorted({d.platform for d in jax.devices()})
+    devs = list(jax.devices())
+    try:
+        # the CPU platform always exists even when an accelerator is the
+        # default backend (jax.devices() lists only the default)
+        devs += [d for d in jax.devices("cpu") if d not in devs]
+    except RuntimeError:
+        pass
+    return devs
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in _all_devices()})
 
 
 def get_available_device():
-    import jax
-    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+    return [f"{d.platform}:{d.id}" for d in _all_devices()]
 
 
 def get_available_custom_device():

@@ -8,6 +8,8 @@ wrapped model exposes the same surface as the reference
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 from .. import env as _env
 
 _fleet_state = {"initialized": False, "strategy": None, "hcg": None}

@@ -28,10 +28,19 @@ def _check_source(source):
             f"repo_dir=<path>")
 
 
+def _resolve(repo_dir, source):
+    """Promote to local ONLY for explicit local paths (absolute or ./-
+    prefixed) — a remote-looking 'user/repo' string must never silently
+    execute whatever sits at a cwd-relative path."""
+    explicit_path = os.path.isabs(repo_dir) or repo_dir.startswith((".", "~"))
+    if explicit_path and os.path.isdir(os.path.expanduser(repo_dir)):
+        return "local"
+    return source
+
+
 def list(repo_dir, source="github", force_reload=False):  # noqa: A001
     """Entrypoints exposed by the repo's hubconf.py."""
-    if os.path.isdir(repo_dir):
-        source = "local"
+    source = _resolve(repo_dir, source)
     _check_source(source)
     mod = _load_hubconf(repo_dir)
     return [n for n in dir(mod)
@@ -39,16 +48,14 @@ def list(repo_dir, source="github", force_reload=False):  # noqa: A001
 
 
 def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
-    if os.path.isdir(repo_dir):
-        source = "local"
+    source = _resolve(repo_dir, source)
     _check_source(source)
     mod = _load_hubconf(repo_dir)
     return getattr(mod, model).__doc__
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
-    if os.path.isdir(repo_dir):
-        source = "local"
+    source = _resolve(repo_dir, source)
     _check_source(source)
     mod = _load_hubconf(repo_dir)
     return getattr(mod, model)(**kwargs)

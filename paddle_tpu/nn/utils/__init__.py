@@ -60,8 +60,10 @@ def remove_weight_norm(layer, name="weight"):
     if state is None or state[0] != name:
         raise ValueError(f"layer has no weight norm on {name!r}")
     _, dim, orig_forward = state
-    g = layer._parameters.pop(name + "_g")
-    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters[name + "_g"]
+    v = layer._parameters[name + "_v"]
+    delattr(layer, name + "_g")      # Layer.__delattr__ clears both the
+    delattr(layer, name + "_v")      # attribute and the parameter store
     from ...core.tensor import Parameter
     w = Parameter(np.asarray(
         v._data * (g._data / jnp.maximum(_norm_except(v._data, dim),
@@ -94,11 +96,14 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         wcur = np.asarray(getattr(layer, name + "_orig")._data, np.float32)
         m_np = np.moveaxis(wcur, dim, 0).reshape(wcur.shape[dim], -1)
         uu = state["u"]
+        # n_power_iterations=0 uses the stored estimate without updating
+        vv = m_np.T @ uu
+        vv = vv / (np.linalg.norm(vv) + eps)
         for _ in range(n_power_iterations):
-            vv = m_np.T @ uu
-            vv = vv / (np.linalg.norm(vv) + eps)
             uu = m_np @ vv
             uu = uu / (np.linalg.norm(uu) + eps)
+            vv = m_np.T @ uu
+            vv = vv / (np.linalg.norm(vv) + eps)
         state["u"] = uu
         uj, vj = jnp.asarray(uu), jnp.asarray(vv)
 
@@ -135,6 +140,13 @@ def parameters_to_vector(parameters, name=None):
 def vector_to_parameters(vec, parameters, name=None):
     """Write a flat vector back into the parameter list (in place)."""
     d = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    parameters = list(parameters)
+    total = sum(int(np.prod(p._data.shape)) if p._data.shape else 1
+                for p in parameters)
+    if int(d.shape[0]) != total:
+        raise ValueError(
+            f"vector has {int(d.shape[0])} elements but the parameters "
+            f"hold {total}")
     off = 0
     for p in parameters:
         n = int(np.prod(p._data.shape)) if p._data.shape else 1

@@ -48,14 +48,17 @@ def require_version(min_version, max_version=None):
     utils/__init__ require_version)."""
     from ..version import full_version
 
-    def parts(v):
-        return [int(x) for x in str(v).split(".") if x.isdigit()]
+    def parts(v, width):
+        ps = [int(x) for x in str(v).split(".") if x.isdigit()]
+        return ps + [0] * (width - len(ps))       # zero-pad: 0.1 == 0.1.0
 
-    cur = parts(full_version)
-    if parts(min_version) > cur:
+    width = max(len(str(v).split(".")) for v in
+                (full_version, min_version, max_version or "0"))
+    cur = parts(full_version, width)
+    if parts(min_version, width) > cur:
         raise Exception(
             f"installed version {full_version} < required {min_version}")
-    if max_version is not None and parts(max_version) < cur:
+    if max_version is not None and parts(max_version, width) < cur:
         raise Exception(
             f"installed version {full_version} > allowed {max_version}")
     return True

@@ -1,14 +1,16 @@
 """reference: utils/unique_name.py — process-wide unique name generator
-with guard() scoping (used by static layer helpers)."""
+with guard() scoping (used by static layer helpers). guard(prefix) also
+namespaces generated names like the reference's generator switch."""
 import contextlib
 
-_COUNTERS = [{}]
+_STACK = [{"counters": {}, "prefix": ""}]
 
 
 def generate(key):
-    c = _COUNTERS[-1]
+    top = _STACK[-1]
+    c = top["counters"]
     c[key] = c.get(key, -1) + 1
-    return f"{key}_{c[key]}"
+    return f"{top['prefix']}{key}_{c[key]}"
 
 
 def generate_with_ignorable_key(key):
@@ -17,12 +19,18 @@ def generate_with_ignorable_key(key):
 
 @contextlib.contextmanager
 def guard(new_generator=None):
-    _COUNTERS.append({})
+    prefix = new_generator if isinstance(new_generator, str) else ""
+    _STACK.append({"counters": {}, "prefix": prefix})
     try:
         yield
     finally:
-        _COUNTERS.pop()
+        _STACK.pop()
 
 
 def switch(new_generator=None):
-    _COUNTERS[-1] = {}
+    """Replace the current scope's generator state; returns the old one
+    (reference: unique_name.switch)."""
+    old = _STACK[-1]
+    prefix = new_generator if isinstance(new_generator, str) else ""
+    _STACK[-1] = {"counters": {}, "prefix": prefix}
+    return old

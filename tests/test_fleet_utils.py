@@ -1,0 +1,87 @@
+"""fleet.utils (recompute/LocalFS/HDFSClient) + static.amp (reference:
+distributed/fleet/utils, static/amp)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet
+
+
+def test_recompute_layer_value_and_grad_parity():
+    """Layer path: params thread through jax.checkpoint; values and ALL
+    grads (input + weights) match the direct call."""
+    paddle.seed(0)
+    block = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 4))
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                         .astype("float32"), stop_gradient=False)
+    out_r = fleet.recompute(block, x)
+    out_d = block(x)
+    np.testing.assert_allclose(out_r.numpy(), out_d.numpy(), rtol=1e-5)
+    out_r.sum().backward()
+    gx = x.grad.numpy().copy()
+    gws = [p.grad.numpy().copy() for p in block.parameters()]
+    x.clear_grad()
+    for p in block.parameters():
+        p.clear_grad()
+    block(x).sum().backward()
+    np.testing.assert_allclose(gx, x.grad.numpy(), rtol=1e-5)
+    for g, p in zip(gws, block.parameters()):
+        np.testing.assert_allclose(g, p.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_plain_function_fallback():
+    """Closure-captured params can't be discovered: falls back to a plain
+    call — grads stay correct (remat skipped)."""
+    paddle.seed(1)
+    lin1 = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.RandomState(1).rand(2, 4)
+                         .astype("float32"), stop_gradient=False)
+
+    def block(t):
+        return lin1(t)
+
+    out = fleet.recompute(block, x)
+    out.sum().backward()
+    assert lin1.weight.grad is not None and x.grad is not None
+
+
+def test_local_fs_and_hdfs(tmp_path):
+    fs = fleet.utils.LocalFS()
+    p = str(tmp_path / "a")
+    fs.mkdirs(p)
+    fs.touch(os.path.join(p, "f.txt"))
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["a"] and files == []
+    fs.mv(os.path.join(p, "f.txt"), str(tmp_path / "g.txt"))
+    assert fs.is_file(str(tmp_path / "g.txt"))
+    fs.delete(p)
+    assert not fs.is_exist(p)
+    with pytest.raises(RuntimeError, match="hadoop"):
+        fleet.utils.HDFSClient()
+
+
+def test_static_amp_decorate_trains():
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    deco = paddle.static.amp.decorate(
+        opt.SGD(0.05, parameters=net.parameters()))
+    x = paddle.to_tensor(np.ones((8, 4), "float32"))
+    y = paddle.to_tensor(np.ones((8, 1), "float32") * 3)
+    first = last = None
+    for _ in range(15):
+        loss = ((net(x) - y) ** 2).mean()
+        deco.minimize(loss)
+        deco.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < first
+
+
+def test_custom_op_lists():
+    ls = paddle.static.amp.CustomOpLists(custom_white_list=["matmul"])
+    assert "matmul" in ls.white_list
