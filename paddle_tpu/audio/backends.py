@@ -91,3 +91,8 @@ def save(filepath, src, sample_rate, channels_first=True,
         f.setsampwidth(width)
         f.setframerate(int(sample_rate))
         f.writeframes(arr.tobytes())
+
+
+def get_current_backend():
+    """reference: audio/backends get_current_backend alias."""
+    return get_current_audio_backend()

@@ -1,4 +1,5 @@
 """paddle.device equivalent."""
+from . import cuda  # noqa: F401
 from ..core.device import (  # noqa: F401
     CPUPlace, Place, TPUPlace, device_count, get_device, is_compiled_with_cuda,
     is_compiled_with_npu, is_compiled_with_tpu, is_compiled_with_xpu, set_device,

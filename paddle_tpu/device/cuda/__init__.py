@@ -1,0 +1,118 @@
+"""paddle.device.cuda (reference: device/cuda/__init__.py). There is no
+CUDA device here; the namespace maps onto the accelerator (TPU) so
+device-management call sites keep working: streams/events are no-ops
+(XLA owns scheduling), memory stats come from PjRt when the backend
+exposes them.
+"""
+import jax
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "empty_cache", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "get_device_properties", "get_device_name",
+           "get_device_capability"]
+
+
+class Stream:
+    """XLA owns stream scheduling; synchronize() drains the device."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        return None
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def synchronize(device=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def device_count():
+    try:
+        return len(jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def empty_cache():
+    """XLA's BFC allocator manages HBM; jax.clear_caches drops host-side
+    executable caches (the closest analogue)."""
+    jax.clear_caches()
+
+
+def _mem_stats():
+    try:
+        return jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    return int(_mem_stats().get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_mem_stats().get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    return int(_mem_stats().get("bytes_reserved",
+                                _mem_stats().get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext(stream)
+
+
+def get_device_properties(device=None):
+    d = jax.devices()[0]
+
+    class _Props:
+        name = getattr(d, "device_kind", str(d))
+        major = 0
+        minor = 0
+        total_memory = int(_mem_stats().get("bytes_limit", 0))
+        multi_processor_count = 1
+
+    return _Props()
+
+
+def get_device_name(device=None):
+    return getattr(jax.devices()[0], "device_kind", "TPU")
+
+
+def get_device_capability(device=None):
+    return (0, 0)

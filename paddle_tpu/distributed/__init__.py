@@ -4,6 +4,8 @@ from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import sharding  # noqa: F401
+from . import passes  # noqa: F401
+from . import communication  # noqa: F401
 from .collective import (  # noqa: F401
     Group, ReduceOp, all_gather, all_gather_concat, all_reduce, alltoall,
     alltoall_single, barrier, broadcast, destroy_process_group, get_backend,

@@ -102,7 +102,10 @@ class LocalFS:
             os.remove(path)
 
     def mv(self, src, dst, overwrite=False):
-        if overwrite and os.path.exists(dst):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(
+                    f"destination {dst!r} exists (pass overwrite=True)")
             self.delete(dst)
         shutil.move(src, dst)
 

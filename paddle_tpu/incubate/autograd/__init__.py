@@ -1,0 +1,142 @@
+"""incubate.autograd (reference: python/paddle/incubate/autograd:
+functional vjp/jvp/Jacobian/Hessian + the prim-op switches)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """reference: primx prim-op switch. The whole framework already traces
+    to primitive HLO ops, so this is a recorded toggle."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled
+
+
+def _raw_fn(func):
+    def raw(*datas):
+        ts = [Tensor(d) for d in datas]
+        out = func(*ts)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        res = tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+        return res if len(res) > 1 else res[0]
+    return raw
+
+
+def _datas(xs):
+    xs = xs if isinstance(xs, (tuple, list)) else [xs]
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in xs]
+
+
+def vjp(func, xs, v=None):
+    """reference: incubate/autograd/functional.py vjp -> (outputs,
+    vjp_result)."""
+    datas = _datas(xs)
+    out, pull = jax.vjp(_raw_fn(func), *datas)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else \
+            tuple(jnp.ones_like(o) for o in out)
+    else:
+        vd = _datas(v)
+        cot = vd[0] if len(vd) == 1 and not isinstance(out, tuple) \
+            else tuple(vd)
+    grads = pull(cot)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        [Tensor(o) for o in out]
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs if len(gs) > 1 else gs[0])
+
+
+def jvp(func, xs, v=None):
+    """reference: functional.py jvp -> (outputs, jvp_result)."""
+    datas = _datas(xs)
+    tangents = _datas(v) if v is not None else \
+        [jnp.ones_like(d) for d in datas]
+    out, tang = jax.jvp(_raw_fn(func), tuple(datas), tuple(tangents))
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        [Tensor(o) for o in out]
+    tg = Tensor(tang) if not isinstance(tang, tuple) else \
+        [Tensor(t) for t in tang]
+    return outs, tg
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grads of recorded eager outputs are not derivable from
+    a reverse tape; use incubate.autograd.jvp(func, xs) with the function
+    form (the reference's primal-transform path has the same
+    function-level requirement)."""
+    raise RuntimeError(
+        "forward_grad needs the function form: use "
+        "paddle.incubate.autograd.jvp(func, xs, v)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference: incubate/autograd grad — alias of paddle.grad."""
+    import paddle_tpu
+    return paddle_tpu.grad(outputs, inputs, grad_outputs)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference: incubate/autograd/functional.py
+    Jacobian): J[i, j] = d out_i / d in_j, computed via jax.jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._datas = _datas(xs)
+        self._J = jax.jacrev(_raw_fn(func),
+                             argnums=tuple(range(len(self._datas))))(
+            *self._datas)
+        if isinstance(self._J, tuple) and len(self._datas) == 1:
+            self._J = self._J[0]
+        self._batched = is_batched
+
+    def __getitem__(self, idx):
+        arr = self._J
+        if isinstance(arr, tuple):
+            arr = jnp.concatenate(
+                [a.reshape(a.shape[0], -1) for a in arr], axis=-1)
+        else:
+            arr = arr.reshape(arr.shape[0], -1) if arr.ndim > 2 else arr
+        return Tensor(arr[idx])
+
+    @property
+    def shape(self):
+        arr = self._J
+        if isinstance(arr, tuple):
+            return [int(arr[0].shape[0]),
+                    sum(int(np.prod(a.shape[1:])) for a in arr)]
+        return list(arr.shape)
+
+
+class Hessian:
+    """Lazy Hessian (reference: functional.py Hessian): H = d^2 f / dx^2
+    for scalar-output f, via jax.hessian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._datas = _datas(xs)
+        self._H = jax.hessian(_raw_fn(func))(*self._datas)
+
+    def __getitem__(self, idx):
+        arr = self._H
+        n = int(np.prod(self._datas[0].shape))
+        return Tensor(jnp.reshape(arr, (n, n))[idx])
+
+    @property
+    def shape(self):
+        n = int(np.prod(self._datas[0].shape))
+        return [n, n]

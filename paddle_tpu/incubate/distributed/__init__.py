@@ -1,1 +1,2 @@
 from . import moe  # noqa: F401
+from . import fleet  # noqa: F401

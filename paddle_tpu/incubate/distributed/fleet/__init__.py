@@ -1,0 +1,33 @@
+"""incubate.distributed.fleet (reference: recompute_sequential /
+recompute_hybrid — segment-wise activation recompute wrappers)."""
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: incubate/distributed/fleet/recompute_sequential — split a
+    Sequential into `segments` chunks, recomputing each chunk."""
+    from ....distributed.fleet.utils import recompute
+    segments = (ctx or {}).get("segments", 1)
+    layers = list(functions)
+    if segments <= 1:
+        chunks = [layers]
+    else:
+        per = max(len(layers) // segments, 1)
+        chunks = [layers[i:i + per] for i in range(0, len(layers), per)]
+    out = args[0] if len(args) == 1 else args
+
+    import paddle_tpu.nn as nn
+    for chunk in chunks:
+        seq = chunk[0] if len(chunk) == 1 else nn.Sequential(*chunk)
+        out = recompute(seq, out, **kwargs)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """reference: recompute_hybrid — recompute with hybrid-parallel RNG
+    bookkeeping (mp-aware dropout states). The stateless-PRNG design makes
+    dropout reproducible under recompute by construction, so this is
+    recompute + the ctx's offload knobs accepted for parity."""
+    from ....distributed.fleet.utils import recompute
+    return recompute(function, *args, **kwargs)

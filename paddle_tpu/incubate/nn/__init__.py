@@ -154,3 +154,6 @@ class FusedMultiTransformer(Layer):
 def LayerListHelper(layers):
     from ...nn.layer.container import LayerList
     return LayerList(layers)
+
+
+from . import functional  # noqa: F401,E402

@@ -1,0 +1,201 @@
+"""incubate.nn.functional (reference: incubate/nn/functional/
+fused_transformer.py): functional forms of the fused transformer ops.
+XLA performs the fusion; these compose the same math with the same
+signatures so call sites port unchanged.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_multi_transformer", "fused_matmul_bias", "fused_linear",
+           "fused_bias_dropout_residual_layer_norm"]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """reference: fused_matmul_bias (cublasLt epilogue) — XLA fuses the
+    bias add into the matmul."""
+    def fn(a, b, *bs):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out + bs[0] if bs else out
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply_op(fn, *args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, False, transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+        name=None):
+    """reference: incubate/nn/functional fused_bias_dropout_residual_
+    layer_norm — LN(residual + dropout(x + bias))."""
+    from ...core.random import next_key
+
+    def fn(xd, rd, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        g = next(it) if ln_scale is not None else None
+        be = next(it) if ln_bias is not None else None
+        h = xd + b if b is not None else xd
+        if training and dropout_rate > 0:
+            keep = jax.random.bernoulli(next_key(), 1 - dropout_rate,
+                                        h.shape)
+            h = jnp.where(keep, h / (1 - dropout_rate), 0)
+        h = h + rd
+        mean = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        out = (h - mean) * jax.lax.rsqrt(var + ln_epsilon)
+        if g is not None:
+            out = out * g
+        if be is not None:
+            out = out + be
+        return out
+    args = [x, residual] + [t for t in (bias, ln_scale, ln_bias)
+                            if t is not None]
+    return apply_op(fn, *args)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               transpose_qkv_wb=False, name=None):
+    """reference: fused_multi_head_attention (fused_attention_op.cu):
+    [preLN ->] qkv matmul -> MHA -> out proj [-> residual+LN]. qkv_weight
+    layout (3, H, head_dim, hidden), the op's native format."""
+    def ln(h, g, b, eps):
+        mean = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        out = (h - mean) * jax.lax.rsqrt(var + eps)
+        if g is not None:
+            out = out * g
+        if b is not None:
+            out = out + b
+        return out
+
+    def fn(xd, qkvw, lw, *rest):
+        named = {}
+        it = iter(rest)
+        for key, t in (("pre_g", pre_ln_scale), ("pre_b", pre_ln_bias),
+                       ("g", ln_scale), ("b", ln_bias),
+                       ("qkv_b", qkv_bias), ("lin_b", linear_bias),
+                       ("mask", attn_mask)):
+            if t is not None:
+                named[key] = next(it)
+        h = ln(xd, named.get("pre_g"), named.get("pre_b"), pre_ln_epsilon) \
+            if pre_layer_norm else xd
+        nh, hd = qkvw.shape[1], qkvw.shape[2]
+        qkv = jnp.einsum("bsh,tnda->bstnd" if False else "bsa,tnda->bstnd",
+                         h, qkvw)
+        if "qkv_b" in named:
+            qkv = qkv + named["qkv_b"][None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # (B,S,nh,hd)
+        q = jnp.swapaxes(q, 1, 2)
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        s = q @ jnp.swapaxes(k, -1, -2) / jnp.sqrt(float(hd))
+        if "mask" in named:
+            s = s + named["mask"]
+        p = jax.nn.softmax(s, -1)
+        o = jnp.swapaxes(p @ v, 1, 2)
+        o = o.reshape(o.shape[0], o.shape[1], nh * hd)
+        out = o @ lw
+        if "lin_b" in named:
+            out = out + named["lin_b"]
+        if add_residual:
+            out = out + xd
+        if not pre_layer_norm:
+            out = ln(out, named.get("g"), named.get("b"), ln_epsilon)
+        return out
+
+    args = [x, qkv_weight, linear_weight] + [
+        t for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias,
+                    qkv_bias, linear_bias, attn_mask) if t is not None]
+    return apply_op(fn, *args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, ring_id=-1,
+                      mode="upscale_in_train", name=None):
+    """reference: fused_feedforward (fused_feedforward_op.cu)."""
+    def fn(xd, w1, w2, *rest):
+        named = {}
+        it = iter(rest)
+        for key, t in (("b1", linear1_bias), ("b2", linear2_bias),
+                       ("g1", ln1_scale), ("lb1", ln1_bias),
+                       ("g2", ln2_scale), ("lb2", ln2_bias)):
+            if t is not None:
+                named[key] = next(it)
+
+        def ln(h, g, b, eps):
+            mean = jnp.mean(h, -1, keepdims=True)
+            var = jnp.var(h, -1, keepdims=True)
+            out = (h - mean) * jax.lax.rsqrt(var + eps)
+            if g is not None:
+                out = out * g
+            if b is not None:
+                out = out + b
+            return out
+
+        h = ln(xd, named.get("g1"), named.get("lb1"), ln1_epsilon) \
+            if pre_layer_norm else xd
+        u = h @ w1
+        if "b1" in named:
+            u = u + named["b1"]
+        u = getattr(jax.nn, activation)(u)
+        out = u @ w2
+        if "b2" in named:
+            out = out + named["b2"]
+        out = out + xd
+        if not pre_layer_norm:
+            out = ln(out, named.get("g2"), named.get("lb2"), ln2_epsilon)
+        return out
+
+    args = [x, linear1_weight, linear2_weight] + [
+        t for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias,
+                    ln2_scale, ln2_bias) if t is not None]
+    return apply_op(fn, *args)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-05, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """reference: fused_multi_transformer_op.cu functional form — per-layer
+    preLN attention + FFN over weight lists."""
+    out = x
+    for i in range(len(qkv_weights)):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i], pre_layer_norm=True,
+            pre_ln_scale=ln_scales[i], pre_ln_bias=ln_biases[i],
+            pre_ln_epsilon=epsilon, qkv_bias=qkv_biases[i],
+            linear_bias=linear_biases[i], attn_mask=attn_mask,
+            dropout_rate=dropout_rate, training=training)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i], ffn1_biases[i],
+            ffn2_biases[i], ln1_scale=ffn_ln_scales[i],
+            ln1_bias=ffn_ln_biases[i], pre_layer_norm=True,
+            activation=activation, ln1_epsilon=epsilon, training=training)
+    return out

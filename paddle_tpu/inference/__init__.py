@@ -249,3 +249,66 @@ def create_predictor(config):
 def get_version():
     from .. import __version__
     return __version__
+
+
+class DataType:
+    """reference: paddle_infer.DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+def get_num_bytes_of_data_type(dtype):
+    return {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+            DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+            DataType.BFLOAT16: 2}[dtype]
+
+
+# paddle_infer.Tensor is the zero-copy handle type; ours is _Handle
+Tensor = _Handle
+
+
+class PredictorPool:
+    """reference: paddle_infer.PredictorPool — N predictors sharing one
+    config (thread-per-predictor serving)."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [Predictor(config) for _ in range(max(size, 1))]
+
+    def retrive(self, idx):
+        return self._predictors[idx]
+
+    retrieve = retrive
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: inference convert_to_mixed_precision — rewrites a saved
+    model to fp16/bf16. The StableHLO artifact stays dtype-typed; bf16
+    serving comes from exporting the model with bf16 params (jit.save of a
+    bf16-cast Layer), so this converter re-saves with a dtype cast."""
+    raise NotImplementedError(
+        "convert the LAYER before export: cast params to bfloat16 "
+        "(layer.to(dtype='bfloat16') / astype) and jit.save it — the "
+        "exported StableHLO then serves in bf16 end-to-end")
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU (PARITY: TensorRT row) — version tuple of 0s."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """reference: maps fluid op names to phi kernel names; one generation
+    here — identity."""
+    return op_name

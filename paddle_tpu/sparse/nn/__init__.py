@@ -30,3 +30,55 @@ class BatchNorm(Layer):
         from .. import SparseCooTensor
         vals = self._bn(x.values_)
         return SparseCooTensor(x.indices_, vals, x.shape)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import functional as F
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from . import functional as F
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    """Softmax over each CSR row's nnz values (reference:
+    sparse/nn/layer/activation.py Softmax — axis=-1 over the sparse
+    layout's stored entries per row)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+
+    def forward(self, x):
+        from . import functional as F
+        return F.softmax(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """reference: sparse/nn SyncBatchNorm — cross-replica statistics.
+    Single-controller SPMD computes global batch stats by construction
+    (the batch axis is the mesh-sharded dim), so this is BatchNorm."""
+    pass
+
+
+class MaxPool3D(Layer):
+    """reference: sparse/nn/layer/pooling.py MaxPool3D over COO — pools
+    the dense voxel grid implied by the indices."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._k = kernel_size
+        self._s = stride or kernel_size
+        self._p = padding
+
+    def forward(self, x):
+        from . import functional as F
+        return F.max_pool3d(x, self._k, self._s, self._p)

@@ -1,9 +1,145 @@
 """paddle.sparse.nn.functional (reference: sparse/nn/functional)."""
 from .conv import conv3d, subm_conv3d  # noqa: F401
 
-__all__ = ["conv3d", "subm_conv3d", "relu"]
+__all__ = ["conv3d", "subm_conv3d", "relu", "relu6", "leaky_relu", "softmax", "max_pool3d", "attention"]
 
 
 def relu(x, name=None):
     from .. import relu as _relu
     return _relu(x)
+
+
+def relu6(x, name=None):
+    import jax.numpy as jnp
+    from .. import _values_op
+    return _values_op(lambda v: jnp.clip(v, 0, 6))(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    import jax
+    from .. import _values_op
+    return _values_op(lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored entries (reference: phi sparse softmax
+    kernel — CSR: per row; COO 2-D: per row of stored values)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ...core.tensor import Tensor, apply_op
+    from .. import SparseCooTensor, SparseCsrTensor
+    if isinstance(x, SparseCsrTensor):
+        crows = np.asarray(x.crows_._data)
+        segs = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+
+        def fn(v):
+            seg = jnp.asarray(segs)
+            n_rows = len(crows) - 1
+            mx = jax.ops.segment_max(v, seg, num_segments=n_rows)
+            e = jnp.exp(v - mx[seg])
+            s = jax.ops.segment_sum(e, seg, num_segments=n_rows)
+            return e / s[seg]
+        return SparseCsrTensor(x.crows_, x.cols_,
+                               apply_op(fn, x.values_), x.shape)
+    if isinstance(x, SparseCooTensor):
+        idx = np.asarray(x.indices_._data)
+        # segment by ALL dims except the softmax (last) axis: entries in
+        # the same "row" share every leading coordinate
+        if idx.shape[0] > 1:
+            lead = idx[:-1]
+            uniq, rows = np.unique(lead.T, axis=0, return_inverse=True)
+            n_rows = len(uniq)
+        else:
+            rows = idx[0] if idx.shape[0] >= 1 else np.zeros(idx.shape[1])
+            n_rows = int(rows.max()) + 1 if rows.size else 1
+
+        def fn(v):
+            seg = jnp.asarray(rows.astype(np.int32))
+            mx = jax.ops.segment_max(v, seg, num_segments=n_rows)
+            e = jnp.exp(v - mx[seg])
+            s = jax.ops.segment_sum(e, seg, num_segments=n_rows)
+            return e / s[seg]
+        return SparseCooTensor(x.indices_, apply_op(fn, x.values_), x.shape)
+    raise TypeError("sparse softmax expects a sparse tensor")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over the voxel grid (reference: phi sparse
+    pool kernel): output sites = distinct pooled cells of the input
+    sites; value = max over the cell's members."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor, apply_op
+    from .. import SparseCooTensor
+    k = kernel_size if isinstance(kernel_size, (tuple, list)) \
+        else (kernel_size,) * 3
+    s = stride or k
+    s = s if isinstance(s, (tuple, list)) else (s,) * 3
+    p = padding if isinstance(padding, (tuple, list)) else (padding,) * 3
+    idx = np.asarray(x.indices_._data)                # (4, nnz)
+    D, H, W = x.shape[1:4]
+    D_out = (D + 2 * p[0] - k[0]) // s[0] + 1
+    H_out = (H + 2 * p[1] - k[1]) // s[1] + 1
+    W_out = (W + 2 * p[2] - k[2]) // s[2] + 1
+
+    def cell_range(c, pad, kk, st, n_out):
+        """All output cells whose window [o*st-pad, o*st-pad+kk) covers c."""
+        lo = (c + pad - kk) // st + 1
+        hi = (c + pad) // st
+        return range(max(lo, 0), min(hi, n_out - 1) + 1)
+
+    cells = {}
+    gathers, scatters = [], []
+    for i in range(idx.shape[1]):
+        b, z, y, xx = idx[:, i]
+        for oz in cell_range(z, p[0], k[0], s[0], D_out):
+            for oy in cell_range(y, p[1], k[1], s[1], H_out):
+                for ox in cell_range(xx, p[2], k[2], s[2], W_out):
+                    j = cells.setdefault((b, oz, oy, ox), len(cells))
+                    gathers.append(i)
+                    scatters.append(j)
+    gathers = np.asarray(gathers, np.int32)
+    scatters = np.asarray(scatters, np.int32)
+    m = len(cells)
+    out_idx = np.asarray(sorted(cells, key=cells.get), np.int64).T
+
+    def fn(v):
+        import jax
+        return jax.ops.segment_max(v[jnp.asarray(gathers)],
+                                   jnp.asarray(scatters), num_segments=m)
+
+    out_shape = [x.shape[0], D_out, H_out, W_out, x.shape[-1]]
+    return SparseCooTensor(Tensor(jnp.asarray(out_idx)),
+                           apply_op(fn, x.values_), out_shape)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference: sparse/nn/functional/attention.py
+    over the CSR sparse_attention kernel): scores only at the CSR mask's
+    stored positions (+ optional key-padding and additive masks),
+    row-softmax, weighted sum."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor, apply_op
+    from .. import _dense
+
+    def fn(q, k_, v, *rest):
+        it = iter(rest)
+        kpm = next(it) if key_padding_mask is not None else None
+        am = next(it) if attn_mask is not None else None
+        mask = jnp.where(_dense(sparse_mask) != 0, 0.0, -1e9)
+        d = q.shape[-1]
+        s = q @ jnp.swapaxes(k_, -1, -2) / jnp.sqrt(float(d)) + mask
+        if kpm is not None:
+            # (B, S_k) zero/one keep mask (reference semantics)
+            s = s + jnp.where(kpm[:, None, None, :] != 0, 0.0, -1e9)
+        if am is not None:
+            s = s + am
+        import jax
+        pr = jax.nn.softmax(s, axis=-1)
+        return pr @ v
+    args = [query, key, value] + [t for t in (key_padding_mask, attn_mask)
+                                  if t is not None]
+    return apply_op(fn, *args)

@@ -311,6 +311,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 # paddle.static.nn: full layer-fn + control-flow surface (static/nn.py)
 from . import nn  # noqa: E402
 from . import amp  # noqa: E402
+from . import sparsity  # noqa: E402
 
 
 from .extras import *  # noqa: F401,F403,E402

@@ -24,11 +24,16 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
              use_pure_fp16=False, use_fp16_guard=None, use_bf16=True):
     """reference: mixed_precision/decorator.py decorate — returns an
     optimizer whose minimize() scales the loss and unscales grads."""
-    scaler = GradScaler(init_loss_scaling=init_loss_scaling,
-                        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
-                        incr_every_n_steps=incr_every_n_steps,
-                        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
-                        enable=use_dynamic_loss_scaling)
+    # static loss scaling = dynamic machinery with frozen ratios: the
+    # scale stays at init_loss_scaling but the loss IS still scaled
+    # (enable=False would silently force scale=1.0)
+    scaler = GradScaler(
+        init_loss_scaling=init_loss_scaling,
+        incr_ratio=incr_ratio if use_dynamic_loss_scaling else 1.0,
+        decr_ratio=decr_ratio if use_dynamic_loss_scaling else 1.0,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        enable=True)
 
     class _Decorated:
         def __init__(self, inner):

@@ -1,0 +1,19 @@
+"""paddle.static.sparsity (reference: static/sparsity = incubate/asp
+static facade): 2:4 structured-sparsity workflow."""
+from ..incubate.asp import (  # noqa: F401
+    calculate_density, decorate, prune_model, reset_excluded_layers,
+    set_excluded_layers)
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer"]
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """reference: asp add_supported_layer — register a custom prunable
+    layer type."""
+    from ..incubate import asp
+    reg = getattr(asp, "_SUPPORTED_LAYERS", None)
+    if reg is None:
+        asp._SUPPORTED_LAYERS = reg = []
+    reg.append((layer, pruning_func))

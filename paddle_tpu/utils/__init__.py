@@ -6,6 +6,7 @@ import warnings
 
 from . import unique_name  # noqa: F401
 from . import download  # noqa: F401
+from . import cpp_extension  # noqa: F401
 
 __all__ = ["deprecated", "run_check", "require_version", "try_import"]
 

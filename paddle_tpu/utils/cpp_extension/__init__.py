@@ -1,0 +1,67 @@
+"""paddle.utils.cpp_extension (reference: utils/cpp_extension — setuptools
+helpers + JIT `load` for custom C++ ops). This build supports HOST C++
+extensions for real: `load` compiles sources with g++ into a shared
+library and returns a ctypes handle (the native runtime uses the same
+boundary, native/__init__.py). Device kernels use Pallas/custom_vjp per
+docs/CUSTOM_OPS.md; CUDAExtension raises accordingly.
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
+           "get_build_directory"]
+
+
+def get_build_directory(verbose=False):
+    d = os.path.expanduser("~/.cache/paddle_tpu/extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def CppExtension(sources, *args, **kwargs):
+    """setuptools.Extension factory (reference cpp_extension.CppExtension)."""
+    from setuptools import Extension
+    name = kwargs.pop("name", "paddle_tpu_ext")
+    kwargs.setdefault("language", "c++")
+    return Extension(name, sources, *args, **kwargs)
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension targets nvcc; on this TPU backend write device "
+        "kernels with Pallas (docs/CUSTOM_OPS.md tier 2) and host code "
+        "with CppExtension/load")
+
+
+def setup(**attrs):
+    """reference cpp_extension.setup — setuptools.setup preconfigured for
+    the C++ extension build."""
+    from setuptools import setup as _setup
+    attrs.setdefault("script_args", ["build_ext", "--inplace"])
+    return _setup(**attrs)
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         interpreter=None, verbose=False):
+    """JIT-compile C++ sources into <name>.so and load via ctypes
+    (reference cpp_extension.load returns the imported module; the ctypes
+    namespace is this runtime's native-op boundary)."""
+    build_dir = build_directory or get_build_directory()
+    srcs = [os.path.abspath(s) for s in sources]
+    key = hashlib.sha1(
+        ("|".join(srcs) + "|" +
+         "|".join(open(s, "rb").read().decode("utf-8", "ignore")
+                  for s in srcs)).encode()).hexdigest()[:16]
+    out = os.path.join(build_dir, f"{name}_{key}.so")
+    if not os.path.exists(out):
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + (extra_cxx_cflags or [])
+               + sum([["-I", p] for p in (extra_include_paths or [])], [])
+               + srcs + ["-o", out] + (extra_ldflags or []))
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)

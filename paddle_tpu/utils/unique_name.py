@@ -28,9 +28,13 @@ def guard(new_generator=None):
 
 
 def switch(new_generator=None):
-    """Replace the current scope's generator state; returns the old one
-    (reference: unique_name.switch)."""
+    """Replace the current scope's generator state; returns the old one.
+    Passing a previously returned state dict RESTORES it (the reference's
+    save/restore idiom: pre = switch(); ...; switch(pre))."""
     old = _STACK[-1]
-    prefix = new_generator if isinstance(new_generator, str) else ""
-    _STACK[-1] = {"counters": {}, "prefix": prefix}
+    if isinstance(new_generator, dict) and "counters" in new_generator:
+        _STACK[-1] = new_generator
+    else:
+        prefix = new_generator if isinstance(new_generator, str) else ""
+        _STACK[-1] = {"counters": {}, "prefix": prefix}
     return old

@@ -104,3 +104,163 @@ class MNIST(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    """reference: vision/datasets/mnist.py FashionMNIST — same IDX format,
+    different archive (local file per zero-egress policy)."""
+    pass
+
+
+class DatasetFolder(Dataset):
+    """reference: vision/datasets/folder.py DatasetFolder — one class per
+    subdirectory; loader/extensions configurable."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".gif", ".webp", ".npy"))
+        import os
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, names in sorted(os.walk(cdir)):
+                for n in sorted(names):
+                    path = os.path.join(dirpath, n)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        n.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root!r}")
+
+    @staticmethod
+    def _pil_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class ImageFolder(DatasetFolder):
+    """reference: folder.py ImageFolder — unlabeled flat folder."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._pil_loader
+        exts = tuple(extensions or (".jpg", ".jpeg", ".png", ".bmp",
+                                    ".gif", ".webp", ".npy"))
+        self.samples = []
+        for dirpath, _, names in sorted(os.walk(root)):
+            for n in sorted(names):
+                path = os.path.join(dirpath, n)
+                ok = is_valid_file(path) if is_valid_file else \
+                    n.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root!r}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+class Flowers(Dataset):
+    """reference: vision/datasets/flowers.py — 102 Flowers (image tgz +
+    label/setid .mat). Zero-egress: pass the local files."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if not (data_file and label_file and setid_file):
+            raise RuntimeError(
+                "Flowers needs local copies (zero-egress build): "
+                "data_file=102flowers.tgz, label_file=imagelabels.mat, "
+                "setid_file=setid.mat (the reference's cached archives)")
+        import scipy.io as sio
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key].ravel()
+        self.labels = labels
+        self.data_file = data_file
+        import tarfile
+        self._tar = tarfile.open(data_file)
+        self._names = {m.name.split("/")[-1]: m.name
+                       for m in self._tar.getmembers()
+                       if m.name.endswith(".jpg")}
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        import io
+        from PIL import Image
+        i = int(self.indexes[idx])
+        name = self._names[f"image_{i:05d}.jpg"]
+        img = Image.open(io.BytesIO(
+            self._tar.extractfile(name).read())).convert("RGB")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[i - 1]) - 1
+
+
+class VOC2012(Dataset):
+    """reference: vision/datasets/voc2012.py — segmentation pairs from the
+    VOCtrainval tar. Zero-egress: pass the local tar."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None:
+            raise RuntimeError(
+                "VOC2012 needs a local VOCtrainval_11-May-2012.tar "
+                "(zero-egress build)")
+        import tarfile
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        base = "VOCdevkit/VOC2012"
+        seg = {"train": "train.txt", "valid": "val.txt",
+               "trainval": "trainval.txt", "test": "val.txt"}[mode]
+        lst = self._tar.extractfile(
+            f"{base}/ImageSets/Segmentation/{seg}").read().decode().split()
+        self._pairs = [(f"{base}/JPEGImages/{n}.jpg",
+                        f"{base}/SegmentationClass/{n}.png") for n in lst]
+
+    def __len__(self):
+        return len(self._pairs)
+
+    def __getitem__(self, idx):
+        import io
+        from PIL import Image
+        ip, lp = self._pairs[idx]
+        img = Image.open(io.BytesIO(self._tar.extractfile(ip).read()))
+        lab = Image.open(io.BytesIO(self._tar.extractfile(lp).read()))
+        img = np.asarray(img.convert("RGB"))
+        lab = np.asarray(lab)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
