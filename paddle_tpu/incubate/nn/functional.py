@@ -13,6 +13,33 @@ __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_bias_dropout_residual_layer_norm"]
 
 
+def _dropout_key(rate, training):
+    """Draw the PRNG key OUTSIDE the traced fn and hand back its raw
+    uint32 data as a Tensor operand: unlike a key in a closure cell (which
+    is unhashable and would bypass the eager-op cache for the whole fused
+    layer), a Tensor operand varies per call while the cache key — and the
+    compiled executable — stay stable."""
+    if not training or rate <= 0:
+        return None
+    from ...core.random import next_key
+    return Tensor(jax.random.key_data(next_key()))
+
+
+def _dropout(h, rate, training, mode, kd):
+    """Bernoulli dropout for the fused ops (reference fused_attention_op.cu /
+    fused_feedforward_op.cu drop after activation and before the residual).
+    `kd` is raw key data (from _dropout_key), already unwrapped to an array."""
+    if not training or rate <= 0:
+        if mode == "downscale_in_infer" and rate > 0:
+            return h * (1 - rate)
+        return h
+    keep = jax.random.bernoulli(jax.random.wrap_key_data(kd), 1 - rate,
+                                h.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, h / (1 - rate), 0)
+    return jnp.where(keep, h, 0)
+
+
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
                       name=None):
     """reference: fused_matmul_bias (cublasLt epilogue) — XLA fuses the
@@ -38,18 +65,19 @@ def fused_bias_dropout_residual_layer_norm(
         name=None):
     """reference: incubate/nn/functional fused_bias_dropout_residual_
     layer_norm — LN(residual + dropout(x + bias))."""
-    from ...core.random import next_key
+    key = _dropout_key(dropout_rate, training)
+    # fn's closure must hold only hashable statics (names, not Tensors):
+    # closure cells are part of the eager-cache identity, and the fresh
+    # per-call key Tensor in a cell would turn every call into a cache miss.
+    present = tuple(n for n, t in (("b", bias), ("g", ln_scale),
+                                   ("be", ln_bias), ("kd", key))
+                    if t is not None)
 
     def fn(xd, rd, *rest):
-        it = iter(rest)
-        b = next(it) if bias is not None else None
-        g = next(it) if ln_scale is not None else None
-        be = next(it) if ln_bias is not None else None
+        named = dict(zip(present, rest))
+        b, g, be = named.get("b"), named.get("g"), named.get("be")
         h = xd + b if b is not None else xd
-        if training and dropout_rate > 0:
-            keep = jax.random.bernoulli(next_key(), 1 - dropout_rate,
-                                        h.shape)
-            h = jnp.where(keep, h / (1 - dropout_rate), 0)
+        h = _dropout(h, dropout_rate, training, mode, named.get("kd"))
         h = h + rd
         mean = jnp.mean(h, -1, keepdims=True)
         var = jnp.var(h, -1, keepdims=True)
@@ -59,7 +87,7 @@ def fused_bias_dropout_residual_layer_norm(
         if be is not None:
             out = out + be
         return out
-    args = [x, residual] + [t for t in (bias, ln_scale, ln_bias)
+    args = [x, residual] + [t for t in (bias, ln_scale, ln_bias, key)
                             if t is not None]
     return apply_op(fn, *args)
 
@@ -77,6 +105,9 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     """reference: fused_multi_head_attention (fused_attention_op.cu):
     [preLN ->] qkv matmul -> MHA -> out proj [-> residual+LN]. qkv_weight
     layout (3, H, head_dim, hidden), the op's native format."""
+    attn_key = _dropout_key(attn_dropout_rate, training)
+    out_key = _dropout_key(dropout_rate, training)
+
     def ln(h, g, b, eps):
         mean = jnp.mean(h, -1, keepdims=True)
         var = jnp.var(h, -1, keepdims=True)
@@ -87,15 +118,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             out = out + b
         return out
 
+    present = tuple(n for n, t in (
+        ("pre_g", pre_ln_scale), ("pre_b", pre_ln_bias), ("g", ln_scale),
+        ("b", ln_bias), ("qkv_b", qkv_bias), ("lin_b", linear_bias),
+        ("mask", attn_mask), ("attn_k", attn_key), ("out_k", out_key))
+        if t is not None)
+
     def fn(xd, qkvw, lw, *rest):
-        named = {}
-        it = iter(rest)
-        for key, t in (("pre_g", pre_ln_scale), ("pre_b", pre_ln_bias),
-                       ("g", ln_scale), ("b", ln_bias),
-                       ("qkv_b", qkv_bias), ("lin_b", linear_bias),
-                       ("mask", attn_mask)):
-            if t is not None:
-                named[key] = next(it)
+        named = dict(zip(present, rest))
         h = ln(xd, named.get("pre_g"), named.get("pre_b"), pre_ln_epsilon) \
             if pre_layer_norm else xd
         nh, hd = qkvw.shape[1], qkvw.shape[2]
@@ -111,11 +141,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         if "mask" in named:
             s = s + named["mask"]
         p = jax.nn.softmax(s, -1)
+        p = _dropout(p, attn_dropout_rate, training, mode,
+                     named.get("attn_k"))
         o = jnp.swapaxes(p @ v, 1, 2)
         o = o.reshape(o.shape[0], o.shape[1], nh * hd)
         out = o @ lw
         if "lin_b" in named:
             out = out + named["lin_b"]
+        out = _dropout(out, dropout_rate, training, mode, named.get("out_k"))
         if add_residual:
             out = out + xd
         if not pre_layer_norm:
@@ -124,7 +157,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     args = [x, qkv_weight, linear_weight] + [
         t for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias,
-                    qkv_bias, linear_bias, attn_mask) if t is not None]
+                    qkv_bias, linear_bias, attn_mask, attn_key, out_key)
+        if t is not None]
     return apply_op(fn, *args)
 
 
@@ -136,14 +170,16 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                       pre_layer_norm=False, training=True, ring_id=-1,
                       mode="upscale_in_train", name=None):
     """reference: fused_feedforward (fused_feedforward_op.cu)."""
+    key1 = _dropout_key(dropout1_rate, training)
+    key2 = _dropout_key(dropout2_rate, training)
+
+    present = tuple(n for n, t in (
+        ("b1", linear1_bias), ("b2", linear2_bias), ("g1", ln1_scale),
+        ("lb1", ln1_bias), ("g2", ln2_scale), ("lb2", ln2_bias),
+        ("k1", key1), ("k2", key2)) if t is not None)
+
     def fn(xd, w1, w2, *rest):
-        named = {}
-        it = iter(rest)
-        for key, t in (("b1", linear1_bias), ("b2", linear2_bias),
-                       ("g1", ln1_scale), ("lb1", ln1_bias),
-                       ("g2", ln2_scale), ("lb2", ln2_bias)):
-            if t is not None:
-                named[key] = next(it)
+        named = dict(zip(present, rest))
 
         def ln(h, g, b, eps):
             mean = jnp.mean(h, -1, keepdims=True)
@@ -161,9 +197,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         if "b1" in named:
             u = u + named["b1"]
         u = getattr(jax.nn, activation)(u)
+        u = _dropout(u, dropout1_rate, training, mode, named.get("k1"))
         out = u @ w2
         if "b2" in named:
             out = out + named["b2"]
+        out = _dropout(out, dropout2_rate, training, mode, named.get("k2"))
         out = out + xd
         if not pre_layer_norm:
             out = ln(out, named.get("g2"), named.get("lb2"), ln2_epsilon)
@@ -171,7 +209,7 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
 
     args = [x, linear1_weight, linear2_weight] + [
         t for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias,
-                    ln2_scale, ln2_bias) if t is not None]
+                    ln2_scale, ln2_bias, key1, key2) if t is not None]
     return apply_op(fn, *args)
 
 
@@ -192,10 +230,12 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             pre_ln_scale=ln_scales[i], pre_ln_bias=ln_biases[i],
             pre_ln_epsilon=epsilon, qkv_bias=qkv_biases[i],
             linear_bias=linear_biases[i], attn_mask=attn_mask,
-            dropout_rate=dropout_rate, training=training)
+            dropout_rate=dropout_rate, training=training, mode=mode)
         out = fused_feedforward(
             out, ffn1_weights[i], ffn2_weights[i], ffn1_biases[i],
             ffn2_biases[i], ln1_scale=ffn_ln_scales[i],
             ln1_bias=ffn_ln_biases[i], pre_layer_norm=True,
-            activation=activation, ln1_epsilon=epsilon, training=training)
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon, training=training,
+            mode=mode)
     return out

@@ -1,6 +1,9 @@
 """Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.tensor import Tensor, apply_op
 
@@ -216,6 +219,49 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
     return apply_op(fn, input, label, variance)
 
 
+@functools.lru_cache(maxsize=None)
+def _tss_op(lower, upper):
+    """Memoized custom-vjp op per (lower, upper) bound pair: one object per
+    bounds keeps the eager-op cache keyed stably across calls.
+
+    Forward is UNCLIPPED (the reference kernel never clips x in the forward,
+    teacher_student_sigmoid_loss_op.h:43-63); the soft_max bounds only zero
+    the gradient outside them (grad kernel :92-113)."""
+    @jax.custom_vjp
+    def _tss(x, lab):
+        softplus = lambda t: jnp.maximum(x, 0) - x * t + jnp.log1p(
+            jnp.exp(-jnp.abs(x)))
+        # click term: z = 0 for label < -1 or label in [0,1), z = 1 otherwise
+        z = jnp.where(lab < -1.0, 0.0,
+                      jnp.where(lab < 0.0, 1.0,
+                                jnp.where(lab < 1.0, 0.0, 1.0)))
+        loss = softplus(z)
+        # teacher term only when z' exists (label >= 0)
+        zprime = jnp.where(lab < 1.0, lab, lab - 1.0)
+        loss = loss + jnp.where(lab >= 0.0, softplus(zprime), 0.0)
+        return loss
+
+    def _tss_fwd(x, lab):
+        return _tss(x, lab), (x, lab)
+
+    def _tss_bwd(res, g):
+        x, lab = res
+        sum_val = jnp.clip(x, lower, upper)
+        pred = 1.0 / (1.0 + jnp.exp(-sum_val))
+        base = jnp.where(lab < -1.0, -pred,
+                         jnp.where(lab < 0.0, 1.0 - pred,
+                                   lab - 2.0 * pred))
+        base = jnp.where((sum_val >= upper) | (sum_val <= lower), 0.0, base)
+        if jnp.issubdtype(jnp.result_type(lab), jnp.floating):
+            lab_ct = jnp.zeros_like(lab)
+        else:          # integer labels: jax expects a float0 cotangent
+            lab_ct = np.zeros(jnp.shape(lab), dtype=jax.dtypes.float0)
+        return (-base * g, lab_ct)
+
+    _tss.defvjp(_tss_fwd, _tss_bwd)
+    return _tss
+
+
 def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
                                  soft_max_lower_bound=-15.0):
     """Distillation CTR loss (reference: fluid/layers/loss.py:1480,
@@ -223,18 +269,5 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
     optional teacher score z' (label = -2|-1|z'|1+z'); the loss is the sum
     of the click sigmoid CE and, when the teacher score exists, the teacher
     sigmoid CE."""
-    def fn(x, lab):
-        x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
-        softplus = lambda t: jnp.maximum(x, 0) - x * t + jnp.log1p(
-            jnp.exp(-jnp.abs(x)))
-        # click term: z = 0 for label in {-2, [0,1)}, z = 1 otherwise
-        z = jnp.where((lab > -2.0 + 1e-6) & (lab < 0.0), 1.0,
-                      jnp.where(lab >= 1.0, 1.0, 0.0))
-        z = jnp.where(lab <= -2.0 + 1e-6, 0.0, z)
-        loss = softplus(z)
-        # teacher term only when z' exists (label >= 0)
-        zprime = jnp.where(lab >= 1.0, lab - 1.0, jnp.maximum(lab, 0.0))
-        has_teacher = (lab >= 0.0)
-        loss = loss + jnp.where(has_teacher, softplus(zprime), 0.0)
-        return loss
-    return apply_op(fn, input, label)
+    return apply_op(_tss_op(float(soft_max_lower_bound),
+                            float(soft_max_up_bound)), input, label)

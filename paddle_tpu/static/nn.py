@@ -43,6 +43,19 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     """reference: static/nn/control_flow.py cond -> lax.cond under a trace,
     plain python branch eagerly."""
     d = pred._data if isinstance(pred, Tensor) else pred
+    if true_fn is None and false_fn is None:
+        return None
+    if isinstance(d, jax.core.Tracer) and (true_fn is None or
+                                           false_fn is None):
+        # Reference none-branch semantics (static/nn/control_flow.py cond):
+        # a None branch contributes no outputs, so the other branch must
+        # also return None; the cond then returns None.
+        out = (true_fn or false_fn)()
+        if out is not None:
+            raise ValueError(
+                "cond: incompatible branch returns — one branch is None "
+                "so the other must return None as well")
+        return None
     if isinstance(d, jax.core.Tracer):
         def wrap(fn):
             def inner(_):
@@ -55,7 +68,8 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
                             wrap(false_fn), operand=None)
         outs = [Tensor(o) for o in outs]
         return outs if len(outs) > 1 else outs[0]
-    return true_fn() if bool(np.asarray(d).reshape(())) else false_fn()
+    fn = true_fn if bool(np.asarray(d).reshape(())) else false_fn
+    return fn() if fn is not None else None
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -465,15 +479,38 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
 
 
 def crf_decoding(input, param_attr, label=None, length=None):
-    """reference: operators/crf_decoding_op — viterbi path over linear-chain
-    CRF scores. Routed to paddle.text.viterbi_decode (no BOS/EOS rows)."""
-    from ..text import viterbi_decode
+    """reference: operators/crf_decoding_op.h:120-157 — viterbi path over a
+    linear-chain CRF. Transition takes the linear_chain_crf layout
+    [num_tags + 2, num_tags]: row 0 = start weights, row 1 = stop weights,
+    rows 2.. = the square tag->tag block (crf_decoding_op.h: alpha(0,i) =
+    w(0,i)+x(0,i); final score += w(tag_num+i)). A square [N, N] transition
+    (no start/stop) is also accepted. With `label`, returns the reference's
+    1/0 correctness mask over live positions (crf_decoding_op.h:66-78)."""
+    from ..text.viterbi import _viterbi
     trans = param_attr if isinstance(param_attr, Tensor) else _t(param_attr)
-    B, T = input.shape[0], input.shape[1]
+    B, T, N = input.shape
     if length is None:
         length = Tensor(jnp.full((B,), T, jnp.int32))
-    scores, path = viterbi_decode(input, trans, length,
-                                  include_bos_eos_tag=False)
+
+    def decode(pot, tr, ln):
+        if tr.shape[0] == N + 2:
+            start, stop, square = tr[0], tr[1], tr[2:]
+        else:
+            start = stop = None
+            square = tr
+        _, path = _viterbi(pot, square, ln, include_bos_eos_tag=False,
+                           start_trans=start, stop_trans=stop)
+        return path
+
+    path = apply_op(decode, input, trans, length)
+    if label is not None:
+        lab = label if isinstance(label, Tensor) else _t(label)
+
+        def correct(p, lb, ln):
+            live = jnp.arange(T)[None, :] < ln.reshape(-1, 1)
+            return jnp.where(live, (lb.reshape(B, T) == p), 0).astype(p.dtype)
+
+        return apply_op(correct, path, lab, length)
     return path
 
 

@@ -17,18 +17,29 @@ from ..nn import Layer
 __all__ = ["viterbi_decode", "ViterbiDecoder"]
 
 
-def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
+def _viterbi(potentials, trans, lengths, include_bos_eos_tag,
+             start_trans=None, stop_trans=None):
+    """Core DP. With include_bos_eos_tag, start/stop live in rows N-1 / N-2
+    of the square `trans` (paddle.text convention). Alternatively explicit
+    `start_trans`/`stop_trans` vectors may be passed (CRF [N+2, N] layout,
+    reference crf_decoding_op.h:144-151) with `trans` the square block."""
     B, T, N = potentials.shape
     lengths = lengths.astype(jnp.int32)
     pot = potentials.astype(jnp.float32)
     trans = trans.astype(jnp.float32)
 
-    start_trans = trans[N - 1]
-    stop_trans = trans[N - 2]
+    if include_bos_eos_tag:
+        start_trans = trans[N - 1]
+        stop_trans = trans[N - 2]
+    if start_trans is not None:
+        start_trans = start_trans.astype(jnp.float32)
+    if stop_trans is not None:
+        stop_trans = stop_trans.astype(jnp.float32)
 
     alpha = pot[:, 0]
-    if include_bos_eos_tag:
+    if start_trans is not None:
         alpha = alpha + start_trans[None]
+    if stop_trans is not None:
         alpha = alpha + jnp.where((lengths == 1)[:, None], stop_trans[None],
                                   0.0)
     left0 = lengths - 1
@@ -41,7 +52,7 @@ def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
         alpha_nxt = jnp.max(scores, axis=1) + logit_t
         live = (left > 0)[:, None]
         alpha = jnp.where(live, alpha_nxt, alpha)
-        if include_bos_eos_tag:
+        if stop_trans is not None:
             alpha = alpha + jnp.where((left == 1)[:, None], stop_trans[None],
                                       0.0)
         return (alpha, left - 1), hist
