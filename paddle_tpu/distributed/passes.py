@@ -1,24 +1,27 @@
 """paddle.distributed.passes (reference: distributed/passes/__init__.py
-new_pass/PassManager/PassContext over program-rewrite passes). The XLA
-compiler owns the reference's rewrite passes (fuse/recompute/amp/...);
-this surface keeps pass-driven launch scripts running: known pass names
-map to the corresponding config knobs, applied when the program/strategy
-reaches the compiled path.
+new_pass/PassManager/PassContext over program-rewrite passes, with the
+user-extensible registry of paddle/fluid/framework/ir/pass.h:236).
+
+TPU-native: a pass is a jaxpr rewrite rule (static/ir_pass.py) applied to a
+`static.Program.capture`d program by re-tracing. Two classes of names:
+
+- REAL passes (amp cast-insertion, recompute tagging, and anything users
+  register with `static.ir_pass.register_pass`) transform the IR.
+- ABSORBED names map to XLA facilities or config knobs; applying them
+  records the intent in the PassContext (XLA already performs the rewrite
+  inside its own pipeline), which keeps pass-driven launch scripts running.
 """
+from ..static.ir_pass import (get_registered_pass, register_pass,  # noqa: F401
+                              registered_pass_names)
 
-__all__ = ["new_pass", "PassManager", "PassContext"]
+__all__ = ["new_pass", "PassManager", "PassContext", "register_pass"]
 
-_KNOWN = {
+_ABSORBED = {
     "fuse_all_reduce": "absorbed (XLA collective combining)",
     "fuse_elewise_add_act": "absorbed (XLA fusion)",
     "fuse_bn_act": "absorbed (XLA fusion)",
     "fuse_optimizer": "absorbed (one compiled update program)",
-    "recompute": "maps to Strategy.recompute / GPTSpmdConfig.remat",
-    "auto_parallel_recompute": "maps to Strategy.recompute",
-    "amp": "maps to amp.auto_cast / Strategy.amp",
-    "auto_parallel_amp": "maps to Strategy.amp",
     "auto_parallel_sharding": "maps to MeshPlan.sharding",
-    "auto_parallel_fp16": "maps to Strategy.amp (bf16 on TPU)",
 }
 
 
@@ -37,14 +40,30 @@ class _Pass:
     def __init__(self, name, attrs):
         self.name = name
         self.attrs = attrs or {}
-        self.note = _KNOWN.get(name)
+        self.rule = get_registered_pass(name)
+        self.note = _ABSORBED.get(name)
 
     def apply(self, main_programs=None, startup_programs=None, context=None):
-        if self.name not in _KNOWN:
+        if self.rule is None and self.name not in _ABSORBED:
             raise ValueError(
-                f"unknown pass {self.name!r}; known: {sorted(_KNOWN)}")
+                f"unknown pass {self.name!r}; registered: "
+                f"{registered_pass_names()}, absorbed: {sorted(_ABSORBED)}")
         if context is not None:
             context.set_attr(self.name, self.attrs or True)
+        if self.rule is None:
+            return main_programs
+        progs = (main_programs if isinstance(main_programs, (list, tuple))
+                 else [main_programs])
+        for p in progs:
+            if p is not None and getattr(p, "_jaxpr", None) is not None:
+                p.apply_pass(self.rule, self.attrs)
+            elif p is not None:
+                import warnings
+                warnings.warn(
+                    f"pass {self.name!r} is a real IR transform but the "
+                    "program has no captured jaxpr (build it with "
+                    "static.Program.capture); program left UNCHANGED",
+                    stacklevel=2)
         return main_programs
 
 

@@ -13,6 +13,20 @@ __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_bias_dropout_residual_layer_norm"]
 
 
+def _layer_norm(h, g, b, eps):
+    """Shared LN helper. Module-level on purpose: the traced fns reference
+    it as a global, so it never lands in a closure cell where a fresh
+    per-call object would invalidate the eager-op cache key."""
+    mean = jnp.mean(h, -1, keepdims=True)
+    var = jnp.var(h, -1, keepdims=True)
+    out = (h - mean) * jax.lax.rsqrt(var + eps)
+    if g is not None:
+        out = out * g
+    if b is not None:
+        out = out + b
+    return out
+
+
 def _dropout_key(rate, training):
     """Draw the PRNG key OUTSIDE the traced fn and hand back its raw
     uint32 data as a Tensor operand: unlike a key in a closure cell (which
@@ -108,16 +122,6 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     attn_key = _dropout_key(attn_dropout_rate, training)
     out_key = _dropout_key(dropout_rate, training)
 
-    def ln(h, g, b, eps):
-        mean = jnp.mean(h, -1, keepdims=True)
-        var = jnp.var(h, -1, keepdims=True)
-        out = (h - mean) * jax.lax.rsqrt(var + eps)
-        if g is not None:
-            out = out * g
-        if b is not None:
-            out = out + b
-        return out
-
     present = tuple(n for n, t in (
         ("pre_g", pre_ln_scale), ("pre_b", pre_ln_bias), ("g", ln_scale),
         ("b", ln_bias), ("qkv_b", qkv_bias), ("lin_b", linear_bias),
@@ -126,8 +130,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     def fn(xd, qkvw, lw, *rest):
         named = dict(zip(present, rest))
-        h = ln(xd, named.get("pre_g"), named.get("pre_b"), pre_ln_epsilon) \
-            if pre_layer_norm else xd
+        # NB: helpers must be module-level (a per-call local in a closure
+        # cell would defeat the eager-op cache key)
+        h = _layer_norm(xd, named.get("pre_g"), named.get("pre_b"),
+                        pre_ln_epsilon) if pre_layer_norm else xd
         nh, hd = qkvw.shape[1], qkvw.shape[2]
         qkv = jnp.einsum("bsh,tnda->bstnd" if False else "bsa,tnda->bstnd",
                          h, qkvw)
@@ -152,7 +158,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         if add_residual:
             out = out + xd
         if not pre_layer_norm:
-            out = ln(out, named.get("g"), named.get("b"), ln_epsilon)
+            out = _layer_norm(out, named.get("g"), named.get("b"),
+                              ln_epsilon)
         return out
 
     args = [x, qkv_weight, linear_weight] + [
@@ -181,18 +188,8 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     def fn(xd, w1, w2, *rest):
         named = dict(zip(present, rest))
 
-        def ln(h, g, b, eps):
-            mean = jnp.mean(h, -1, keepdims=True)
-            var = jnp.var(h, -1, keepdims=True)
-            out = (h - mean) * jax.lax.rsqrt(var + eps)
-            if g is not None:
-                out = out * g
-            if b is not None:
-                out = out + b
-            return out
-
-        h = ln(xd, named.get("g1"), named.get("lb1"), ln1_epsilon) \
-            if pre_layer_norm else xd
+        h = _layer_norm(xd, named.get("g1"), named.get("lb1"),
+                        ln1_epsilon) if pre_layer_norm else xd
         u = h @ w1
         if "b1" in named:
             u = u + named["b1"]
@@ -204,7 +201,8 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         out = _dropout(out, dropout2_rate, training, mode, named.get("k2"))
         out = out + xd
         if not pre_layer_norm:
-            out = ln(out, named.get("g2"), named.get("lb2"), ln2_epsilon)
+            out = _layer_norm(out, named.get("g2"), named.get("lb2"),
+                              ln2_epsilon)
         return out
 
     args = [x, linear1_weight, linear2_weight] + [

@@ -124,6 +124,33 @@ class Program:
                 seen.append(str(v))
         return sorted(set(seen))
 
+    # ------------------------------------------------- IR rewriting
+    def apply_pass(self, rule, attrs=None):
+        """Rewrite the captured IR with a pass rule (see static/ir_pass.py):
+        `rule(op, attrs) -> None | replacement outputs`. Mutates this
+        Program's jaxpr in place (reference passes mutate the ProgramDesc,
+        ir/pass.h:69) and returns self. Raises if the Program was not built
+        with Program.capture."""
+        if self._jaxpr is None:
+            raise ValueError(
+                "apply_pass needs a captured IR — build the Program with "
+                "Program.capture(fn, *input_specs)")
+        from .ir_pass import apply_rule
+        a = dict(attrs or {})
+        self._jaxpr = apply_rule(self._jaxpr, lambda op: rule(op, a))
+        return self
+
+    def run_captured(self, *args):
+        """Execute the captured (possibly pass-rewritten) jaxpr on concrete
+        inputs; returns the raw output list."""
+        if self._jaxpr is None:
+            raise ValueError("no captured IR")
+        import jax
+        flat = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        return jax.core.eval_jaxpr(self._jaxpr.jaxpr, self._jaxpr.consts,
+                                   *flat)
+
     @property
     def num_blocks(self):
         return 1

@@ -1,0 +1,208 @@
+"""Writable program-transform surface over the captured jaxpr IR.
+
+Reference: the static-graph pass system — user-extensible `Pass` subclasses
+registered in a PassRegistry and applied to a mutable program
+(paddle/fluid/framework/ir/pass.h:69,236; Python Program/Block/Operator
+mutation surface, python/paddle/fluid/framework.py:2716,3556,5223).
+
+TPU-native: the program IR is the jaxpr that XLA compiles, so a pass is an
+*equation rewrite rule* applied by re-tracing. The rule sees each op with
+its live input values (tracers) and can:
+
+- return None            -> keep the op unchanged,
+- return replacement out -> replace it (build anything: insert casts, wrap
+                            in jax.checkpoint, call other jnp ops, ...),
+- return op.inputs[...]  -> delete it (forward its inputs),
+
+and variable renaming / wiring is handled by the re-trace itself. Dead
+equations are swept by DCE afterwards, mirroring the reference's
+memory-optimize passes. A custom pass is ~5 lines:
+
+    @register_pass("cast_matmuls")
+    def cast_matmuls(op, attrs):
+        if op.name != "dot_general":
+            return None
+        lo = [x.astype("bfloat16") for x in op.inputs]
+        return [o.astype(op.out_avals[0].dtype) for o in op.bind(*lo)]
+"""
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jex_core
+
+__all__ = ["OpView", "apply_rule", "register_pass", "get_registered_pass",
+           "registered_pass_names"]
+
+
+class OpView:
+    """One equation as seen by a rewrite rule: primitive name, params, live
+    input values, and the original output avals (for dtype/shape-preserving
+    rewrites)."""
+
+    def __init__(self, eqn, invals):
+        self._eqn = eqn
+        self.name = eqn.primitive.name
+        self.params = dict(eqn.params)
+        self.inputs = list(invals)
+        self.out_avals = [v.aval for v in eqn.outvars]
+
+    def bind(self, *args, **param_overrides):
+        """Re-apply this op (optionally with different inputs/params).
+        Always returns a list of outputs."""
+        params = dict(self._eqn.params)
+        params.update(param_overrides)
+        out = _bind_eqn(self._eqn.primitive, args or self.inputs, params)
+        return list(out) if self._eqn.primitive.multiple_results else [out]
+
+    def __repr__(self):
+        return f"OpView({self.name}, {len(self.inputs)} inputs)"
+
+
+def _bind_eqn(prim, invals, params):
+    """Re-bind a primitive the way jax.core.eval_jaxpr does: higher-order
+    primitives (custom_jvp_call, pjit, scan, ...) store traced jaxprs in
+    params that get_bind_params converts back into callable subfuns."""
+    subfuns, bind_params = prim.get_bind_params(params)
+    return prim.bind(*subfuns, *invals, **bind_params)
+
+
+def _default_eval(eqn, invals, rule):
+    """Default evaluation of an unmatched equation. Passes see THROUGH
+    jit/remat blocks (like reference ir passes see the whole graph,
+    ir/graph.h): pjit bodies are inlined-and-rewritten, remat2 bodies are
+    rewritten and re-wrapped in jax.checkpoint so the tag survives.
+    Other higher-order ops (scan/while/cond/custom_*) are re-bound opaquely
+    — rules do not see inside them."""
+    name = eqn.primitive.name
+    if name == "remat2":
+        inner = eqn.params["jaxpr"]
+
+        def f(*xs):
+            return _eval_with_rule(inner, (), rule, xs)
+
+        out = jax.checkpoint(f, policy=eqn.params.get("policy"),
+                             prevent_cse=eqn.params.get("prevent_cse", True)
+                             )(*invals)
+        return list(out)
+    if name == "pjit" and "jaxpr" in eqn.params:
+        closed = eqn.params["jaxpr"]
+        return _eval_with_rule(closed.jaxpr, closed.consts, rule, invals)
+    out = _bind_eqn(eqn.primitive, invals, eqn.params)
+    return list(out) if eqn.primitive.multiple_results else [out]
+
+
+def _eval_with_rule(jaxpr, consts, rule, args):
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jex_core.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    for v, c in zip(jaxpr.constvars, consts):
+        write(v, c)
+    for v, a in zip(jaxpr.invars, args):
+        write(v, a)
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        out = rule(OpView(eqn, invals))
+        if out is None:
+            out = _default_eval(eqn, invals, rule)
+        elif not isinstance(out, (list, tuple)):
+            out = [out]
+        if len(out) != len(eqn.outvars):
+            raise ValueError(
+                f"pass rule for {eqn.primitive.name} returned {len(out)} "
+                f"outputs, op has {len(eqn.outvars)}")
+        drop = getattr(jax.core, "DropVar", None) or getattr(
+            jex_core, "DropVar", ())
+        for v, val in zip(eqn.outvars, out):
+            if not isinstance(v, drop):
+                write(v, val)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def apply_rule(closed_jaxpr, rule):
+    """Rewrite a ClosedJaxpr by re-tracing it under `rule`; returns a new
+    ClosedJaxpr (the original is untouched). Runs DCE so deleted/orphaned
+    equations disappear from the IR."""
+    jaxpr = closed_jaxpr.jaxpr
+
+    def run(*args):
+        return _eval_with_rule(jaxpr, closed_jaxpr.consts, rule, args)
+
+    new_closed = jax.make_jaxpr(run)(*closed_jaxpr.in_avals)
+    try:
+        from jax._src.interpreters import partial_eval as pe
+        # instantiate=True keeps ALL invars even if a rewrite orphaned one:
+        # the Program's calling convention (InputSpecs) must not change
+        dced, _ = pe.dce_jaxpr(new_closed.jaxpr,
+                               [True] * len(new_closed.jaxpr.outvars),
+                               instantiate=True)
+        new_closed = jex_core.ClosedJaxpr(dced, new_closed.consts)
+    except Exception:                                        # noqa: BLE001
+        pass          # DCE is an optimization of the printed IR, not load-bearing
+    return new_closed
+
+
+# ------------------------------------------------------------ pass registry
+_REGISTRY = {}
+
+
+def register_pass(name):
+    """Register a rewrite rule `fn(op: OpView, attrs: dict) -> None | outs`
+    under `name` for use with distributed.passes.new_pass (the reference's
+    REGISTER_PASS, ir/pass.h:236)."""
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_registered_pass(name):
+    return _REGISTRY.get(name)
+
+
+def registered_pass_names():
+    return sorted(_REGISTRY)
+
+
+# ------------------------------------------------- shipped real passes
+_MATMUL_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+@register_pass("auto_parallel_fp16")
+@register_pass("auto_parallel_amp")
+@register_pass("amp")
+def _amp_cast_pass(op, attrs):
+    """Cast-insertion AMP (reference: fluid/contrib/mixed_precision/
+    fp16_utils.py graph rewrite): matmul/conv inputs are cast to the low
+    dtype, the op runs at the MXU rate, and the output is cast back to its
+    original dtype. Non-float inputs and already-low inputs pass through."""
+    if op.name not in _MATMUL_PRIMS:
+        return None
+    lo = jnp.dtype(attrs.get("dtype", "bfloat16"))
+    ins = [x.astype(lo)
+           if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != lo else x
+           for x in op.inputs]
+    outs = op.bind(*ins)
+    return [o.astype(a.dtype) for o, a in zip(outs, op.out_avals)]
+
+
+@register_pass("auto_parallel_recompute")
+@register_pass("recompute")
+def _recompute_tag_pass(op, attrs):
+    """Recompute-tagging (reference: fleet recompute pass /
+    distributed/passes/auto_parallel_recompute.py): matched ops are wrapped
+    in jax.checkpoint, which emits a remat tag into the IR so XLA
+    rematerialises them in backward instead of saving activations."""
+    match = tuple(attrs.get("ops", _MATMUL_PRIMS))
+    if op.name not in match:
+        return None
+
+    def f(*xs):
+        out = op.bind(*xs)
+        return tuple(out) if len(out) > 1 else out[0]
+
+    out = jax.checkpoint(f)(*op.inputs)
+    return list(out) if isinstance(out, tuple) else [out]

@@ -112,6 +112,15 @@ def test_fused_attention_applies_dropout_in_training():
         x, qkvw, lw, pre_layer_norm=True, dropout_rate=1.0,
         attn_dropout_rate=0.0, training=True)
     np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-6)
+    # attention layer must also hit the eager cache in training mode
+    from paddle_tpu.core.tensor import _CACHE_STATS
+    FF.fused_multi_head_attention(x, qkvw, lw, pre_layer_norm=True,
+                                  dropout_rate=0.3, training=True)
+    before = dict(_CACHE_STATS)
+    FF.fused_multi_head_attention(x, qkvw, lw, pre_layer_norm=True,
+                                  dropout_rate=0.3, training=True)
+    assert _CACHE_STATS["hits"] >= before["hits"] + 1
+    assert _CACHE_STATS["misses"] == before["misses"]
     paddle.seed(3)
     ref = FF.fused_multi_head_attention(
         x, qkvw, lw, pre_layer_norm=True, dropout_rate=0.0,
@@ -136,8 +145,7 @@ def _np_tss_forward(x, lab):
 
 def test_teacher_student_sigmoid_loss_forward_cases():
     # boundary per the reference kernel: z=0 iff label < -1.0
-    # (teacher_student_sigmoid_loss_op.h:44); -1.5 is a clicked... no:
-    # -1.5 in (-2,-1) must take the z=0 branch.
+    # (teacher_student_sigmoid_loss_op.h:44), so -1.5 takes the z=0 branch.
     xs = np.array([0.3, -0.7, 2.0, -1.2, 0.5, 20.0], "float32")
     labs = np.array([-2.0, -1.5, -1.0, 0.4, 1.7, 0.2], "float32")
     out = F.teacher_student_sigmoid_loss(
