@@ -663,7 +663,22 @@ def _apply_op_inner(fn, *args, n_outputs=None, name="", **kwargs):
             full[i] = v
         return fn(*full, **kwargs)
 
-    out_data, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
+    diff_vals = tuple(datas[i] for i in diff_idx)
+    if any(_is_traced(d) for d in datas):
+        # Inside an outer trace the PRIMAL ops recorded here are what the
+        # outer jax.grad/vjp differentiates — they must come from a direct
+        # fn call so custom_vjp rules survive (an eager jax.vjp here would
+        # consume them and hand the outer trace the raw linearized forward:
+        # e.g. a psum inside shard_map(check_vma=False) then transposes to
+        # psum, inflating cotangents). The tape's own vjp is deferred to
+        # backward time; if the tape is never walked (functional training),
+        # no extra ops are ever traced.
+        out_data = closed(*diff_vals)
+
+        def vjp_fn(*cts, _dv=diff_vals, _closed=closed):
+            return jax.vjp(_closed, *_dv)[1](*cts)
+    else:
+        out_data, vjp_fn = jax.vjp(closed, *diff_vals)
     multi = isinstance(out_data, (tuple, list))
     outs = _wrap_out(out_data, stop_gradient=False)
     out_list = list(outs) if multi else [outs]

@@ -164,6 +164,68 @@ def _in_trace(x):
     return isinstance(x, jax.core.Tracer)
 
 
+# ---------------------------------------------------------------------------
+# True cross-process eager collectives (reference: ProcessGroup's eager ops,
+# paddle/fluid/distributed/collective/ProcessGroup.h:99-234). Each PROCESS is
+# one rank (paddle's trainer); values differ per process, and the result is
+# materialized on every process. Implementation: a tiny cached compiled
+# program over a 1-D world mesh spanning all global devices — each process's
+# local devices carry its value; one representative per process is reduced.
+# ---------------------------------------------------------------------------
+
+_WORLD_MESH = []
+
+
+def _world_mesh():
+    if not _WORLD_MESH:
+        import numpy as np
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        _WORLD_MESH.append(Mesh(np.array(devs), ("world",)))
+    return _WORLD_MESH[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _xproc_program(kind, src, n_local):
+    """Compiled reducer over the world mesh. kind: sum/max/min/prod/avg or
+    bcast (then `src` is the source PROCESS index)."""
+    mesh = _world_mesh()
+
+    def per_shard(x):
+        # x: (1, ...) this device's copy; gather all, keep one per process
+        full = jax.lax.all_gather(x, "world", axis=0, tiled=True)
+        reps = full[::n_local]
+        if kind == "sum":
+            return jnp.sum(reps, axis=0)
+        if kind == "max":
+            return jnp.max(reps, axis=0)
+        if kind == "min":
+            return jnp.min(reps, axis=0)
+        if kind == "prod":
+            return jnp.prod(reps, axis=0)
+        if kind == "avg":
+            return jnp.mean(reps, axis=0)
+        return reps[src]                                    # bcast
+
+    return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=P("world"),
+                                 out_specs=P(), check_vma=False))
+
+
+def _xproc_collective(np_val, kind, src=0):
+    """Run an eager cross-process collective on this process's value; blocks
+    until every process has contributed (real rendezvous semantics)."""
+    import numpy as np
+    mesh = _world_mesh()
+    n_dev = mesh.devices.size
+    local = jax.local_devices()
+    np_val = np.asarray(np_val)
+    sh = NamedSharding(mesh, P("world"))
+    shards = [jax.device_put(np_val[None], d) for d in local]
+    garr = jax.make_array_from_single_device_arrays(
+        (n_dev,) + np_val.shape, sh, shards)
+    out = _xproc_program(kind, src, len(local))(garr)
+    return np.asarray(out.addressable_shards[0].data)
+
+
 def _eager_axis_op(data, axis_name, per_shard_fn, out_spec_fn=None):
     """Run `per_shard_fn` under shard_map over `axis_name` of the global mesh,
     treating `data` as this controller's replicated value (world_size==1 per
@@ -182,6 +244,16 @@ def _eager_axis_op(data, axis_name, per_shard_fn, out_spec_fn=None):
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
+    if group is None and not _in_trace(tensor._data) \
+            and jax.process_count() > 1:
+        # eager multi-controller WORLD collective: each process is a rank
+        # with its own value. Axis-scoped groups fall through to the
+        # mesh-axis path — a world reduce would both ignore the group and
+        # hang if the group spans a process subset.
+        kind = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max", ReduceOp.MIN: "min",
+                ReduceOp.PROD: "prod", ReduceOp.AVG: "avg"}[op]
+        tensor._data = jnp.asarray(_xproc_collective(tensor._data, kind))
+        return tensor
     axis = _axis_of(group)
     if axis is None:
         if op == ReduceOp.AVG:
@@ -261,6 +333,11 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=No
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    if group is None and not _in_trace(tensor._data) \
+            and jax.process_count() > 1:
+        tensor._data = jnp.asarray(
+            _xproc_collective(tensor._data, "bcast", src=src))
+        return tensor
     ax = _axis_of(group)
     if ax is None:
         return tensor
@@ -374,6 +451,15 @@ def barrier(group=None):
             "barrier() inside a compiled/manual region has no effect on "
             "TPU: order collectives by data dependency instead (psum/"
             "all_gather results must be consumed)")
+    if group is None and jax.process_count() > 1:
+        # real WORLD rendezvous: the compiled world collective cannot
+        # complete until every process has dispatched it. Subgroup barriers
+        # fall through (only this controller's devices can be drained; a
+        # world collective would deadlock a process-subset group).
+        import numpy as np
+        total = _xproc_collective(np.ones((), np.float32), "sum")
+        assert int(total) == jax.process_count(), total
+        return
     devs = jax.local_devices()
     if group is not None and getattr(group, "mesh", None) is not None:
         # only THIS controller's devices can be synced; remote mesh devices

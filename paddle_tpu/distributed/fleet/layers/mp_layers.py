@@ -64,7 +64,7 @@ class VocabParallelEmbedding(Layer):
             valid = (ids_i >= 0) & (ids_i < per)
             local = jnp.take(w, jnp.clip(ids_i, 0, per - 1), axis=0)
             local = jnp.where(valid[..., None], local, 0.0)
-            return jax.lax.psum(local, axis)
+            return _mp_allreduce_manual(local, axis)
         return apply_op(fn, x, self.weight)
 
 
@@ -104,7 +104,7 @@ class ColumnParallelLinear(Layer):
             if b:
                 out = out + b[0]
             if self.gather_output:
-                out = jax.lax.all_gather(out, axis, axis=out.ndim - 1, tiled=True)
+                out = _c_concat_manual(out, axis)
             return out
         args = (x, self.weight) if self.bias is None else (x, self.weight, self.bias)
         return apply_op(fn, *args)
@@ -139,8 +139,7 @@ class RowParallelLinear(Layer):
                 idx = jax.lax.axis_index(axis)
                 per = w.shape[0]
                 a = jax.lax.dynamic_slice_in_dim(a, idx * per, per, axis=a.ndim - 1)
-            out = a @ w
-            out = jax.lax.psum(out, axis)
+            out = _mp_allreduce_manual(a @ w, axis)
             if b:
                 out = out + b[0]
             return out
@@ -164,6 +163,47 @@ def _c_identity_manual(a, axis):
     return ident(a)
 
 
+def _mp_allreduce_manual(a, axis):
+    """psum forward, identity backward (reference mp_ops.py _mp_allreduce —
+    Megatron's g-function). NOT a raw lax.psum: under shard_map with
+    check_vma=False jax transposes psum to psum, inflating the (already
+    replicated) cotangent by the axis size."""
+    @jax.custom_vjp
+    def ar(v):
+        return jax.lax.psum(v, axis)
+
+    def fwd(v):
+        return jax.lax.psum(v, axis), None
+
+    def bwd(_, g):
+        return (g,)
+
+    ar.defvjp(fwd, bwd)
+    return ar(a)
+
+
+def _c_concat_manual(a, axis):
+    """all_gather on the last dim forward, slice-own-shard backward
+    (reference mp_ops.py _c_concat / c_split): transpose-safe regardless of
+    the shard_map rep-checking mode."""
+    per = a.shape[-1]                    # static local shard width
+
+    @jax.custom_vjp
+    def cat(v):
+        return jax.lax.all_gather(v, axis, axis=v.ndim - 1, tiled=True)
+
+    def fwd(v):
+        return jax.lax.all_gather(v, axis, axis=v.ndim - 1, tiled=True), None
+
+    def bwd(_, g):
+        idx = jax.lax.axis_index(axis)
+        return (jax.lax.dynamic_slice_in_dim(g, idx * per, per,
+                                             axis=g.ndim - 1),)
+
+    cat.defvjp(fwd, bwd)
+    return cat(a)
+
+
 class ParallelCrossEntropy(Layer):
     """Vocab-parallel softmax CE (reference mp_layers.py:438 +
     c_softmax_with_cross_entropy op): logits sharded on the class dim; the
@@ -185,10 +225,12 @@ class ParallelCrossEntropy(Layer):
             start = idx * per
             # global max for stability
             local_max = jnp.max(logits, axis=-1, keepdims=True)
-            gmax = jax.lax.pmax(local_max, axis)
+            # the shift is gradient-neutral; stop_gradient also sidesteps
+            # pmax's transpose under check_vma=False
+            gmax = jax.lax.stop_gradient(jax.lax.pmax(local_max, axis))
             shifted = logits - gmax
             local_sum = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
-            gsum = jax.lax.psum(local_sum, axis)
+            gsum = _mp_allreduce_manual(local_sum, axis)
             logz = jnp.log(gsum)
             li = lab.astype(jnp.int32)
             if li.ndim == logits.ndim:
@@ -198,6 +240,6 @@ class ParallelCrossEntropy(Layer):
             picked = jnp.take_along_axis(
                 shifted, jnp.clip(local_ids, 0, per - 1)[..., None], axis=-1)[..., 0]
             picked = jnp.where(valid, picked, 0.0)
-            picked = jax.lax.psum(picked, axis)
+            picked = _mp_allreduce_manual(picked, axis)
             return (logz[..., 0] - picked)[..., None]
         return apply_op(fn, input, label)

@@ -16,9 +16,10 @@ tables as the flagship GPT path (parallel/pipeline_schedule.py):
 
 Parameter ownership (reference parity: parallel_layers/pp_layers.py:211 —
 each pp rank materializes only its own stage): params used by exactly one
-stage are flattened into one (pp, maxP) f32 buffer sharded P('pp'), so each
-device physically holds only its stage's row; the stage branches unflatten
-the local row with their static treedefs. Their gradients come back packed
+stage are flattened into one (pp, mp, maxP) f32 buffer sharded
+P('pp','mp'), so each device physically holds only its stage's row — and,
+under tensor parallelism, only its mp shard of split_axis-marked params;
+the stage branches unflatten the local row with their static treedefs. Their gradients come back packed
 the same way — no cross-stage psum. Params reachable from more than one
 stage (SharedLayerDesc embeddings) stay replicated and psum'd, which is also
 the reference's behavior (allreduce_shared_weight_gradients).
@@ -32,6 +33,7 @@ Other limitations vs the GPT path (parallel/gpt_spmd.py):
 import jax
 import jax.numpy as jnp
 import numpy as np
+from contextlib import nullcontext as _nullcontext
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ....core.tensor import Tensor
@@ -85,10 +87,19 @@ def _param_ownership(pl, pp):
 
 def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
     """Build step(params, buffers, x, y) -> (loss, grads) jit-compiled over
-    `mesh` (axes may include 'dp' for data parallelism and must include 'pp'
-    of size pl.get_num_stages()). grads match the params dict and are already
-    averaged over microbatches (and dp)."""
+    `mesh` (axes may include 'dp' for data parallelism and 'mp' for tensor
+    parallelism via fleet mp layers; must include 'pp' of size
+    pl.get_num_stages()). grads match the params dict (global shapes) and
+    are already averaged over microbatches (and dp).
+
+    mp x pp (reference: hapi static adapter running any fleet strategy,
+    python/paddle/hapi/model.py:591-599): params marked is_distributed/
+    split_axis by the mp layers are packed as per-(stage, mp-rank) shards in
+    a (pp, mp, maxP) buffer sharded P('pp','mp'); the schedule body is
+    traced under env.axis_context(mp='mp') so Column/RowParallelLinear /
+    VocabParallelEmbedding emit their manual psum/all_gather collectives."""
     pp = int(mesh.shape["pp"])
+    mp = int(mesh.shape.get("mp", 1))
     M = int(microbatches)
     if pp < 2:
         raise ValueError("compiled pipeline needs pp >= 2")
@@ -98,9 +109,32 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
 
     # ---------------- per-stage param packing plan (static) ----------------
     owned, shared_names = _param_ownership(pl, pp)
-    pspec = {n: (tuple(p.shape), p._data.dtype)
+    # mp-distributed params (fleet mp layers mark split_axis) are packed as
+    # per-rank shards with LOCAL shapes; everything else is replicated over mp
+    mp_split = {}
+    for n, p in pl.named_parameters():
+        ax = getattr(p, "split_axis", None)
+        if mp > 1 and getattr(p, "is_distributed", False) and ax is not None:
+            if p.shape[ax] % mp:
+                raise ValueError(
+                    f"param {n}: dim {ax} of {tuple(p.shape)} is not "
+                    f"divisible by mp={mp}")
+            mp_split[n] = ax
+    bad = [n for n in shared_names if n in mp_split]
+    if bad:
+        raise ValueError(
+            f"mp-distributed params shared across pipeline stages are not "
+            f"supported in the compiled mp x pp path: {bad}")
+
+    gspec = {n: (tuple(p.shape), p._data.dtype)
              for n, p in pl.named_parameters()}
-    layout = {}          # name -> (stage, start, size)
+    pspec = {}            # name -> (LOCAL shape, dtype)
+    for n, (shape, dtype) in gspec.items():
+        if n in mp_split:
+            ax = mp_split[n]
+            shape = shape[:ax] + (shape[ax] // mp,) + shape[ax + 1:]
+        pspec[n] = (shape, dtype)
+    layout = {}          # name -> (stage, start, size)  [sizes are LOCAL]
     stage_sizes = []
     for s in range(pp):
         off = 0
@@ -113,31 +147,50 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
 
     @jax.jit
     def _pack_rows(params):
-        """Device-side: params dict -> (pp, maxP) f32 rows (no host copy —
-        the params stay on device; this is a concat+pad program)."""
-        rows = []
+        """Device-side: params dict -> (pp, mp, maxP) f32 rows (no host copy
+        — the params stay on device; this is a slice+concat+pad program)."""
+        stages = []
         for s in range(pp):
-            parts = [params[n].reshape(-1).astype(jnp.float32)
-                     for n in owned[s]]
-            row = jnp.concatenate(parts) if parts \
-                else jnp.zeros((0,), jnp.float32)
-            rows.append(jnp.pad(row, (0, maxP - stage_sizes[s])))
-        return jnp.stack(rows)
+            rows = []
+            for r in range(mp):
+                parts = []
+                for n in owned[s]:
+                    v = params[n]
+                    if n in mp_split:
+                        ax = mp_split[n]
+                        per = v.shape[ax] // mp
+                        v = jax.lax.slice_in_dim(v, r * per, (r + 1) * per,
+                                                 axis=ax)
+                    parts.append(v.reshape(-1).astype(jnp.float32))
+                row = jnp.concatenate(parts) if parts \
+                    else jnp.zeros((0,), jnp.float32)
+                rows.append(jnp.pad(row, (0, maxP - stage_sizes[s])))
+            stages.append(jnp.stack(rows))
+        return jnp.stack(stages)
 
     def pack(params):
-        """params dict -> (pp, maxP) f32 sharded over 'pp'. device_put of a
-        device-resident array is a resharding, not a host round-trip."""
+        """params dict -> (pp, mp, maxP) f32 sharded over ('pp','mp').
+        device_put of a device-resident array is a resharding, not a host
+        round-trip."""
         return jax.device_put(_pack_rows(params),
-                              NamedSharding(mesh, P("pp", None)))
+                              NamedSharding(mesh, row_spec))
 
     @jax.jit
     def unpack_grads(rows):
-        """Device-side: (pp, maxP) f32 grads -> {name: array} in each
-        param's dtype (slices of a device array; no host transfer)."""
+        """Device-side: (pp, mp, maxP) f32 grads -> {name: array} in each
+        param's GLOBAL shape/dtype: mp shards concatenate back along their
+        split axis, replicated params average their mp copies."""
         out = {}
         for n, (s, off, size) in layout.items():
             shape, dtype = pspec[n]
-            out[n] = rows[s, off:off + size].reshape(shape).astype(dtype)
+            per_rank = [rows[s, r, off:off + size].reshape(shape)
+                        for r in range(mp)]
+            if n in mp_split:
+                g = jnp.concatenate(per_rank, axis=mp_split[n]) \
+                    if mp > 1 else per_rank[0]
+            else:
+                g = sum(per_rank) / mp
+            out[n] = g.astype(dtype)
         return out
 
     def own_dict(s, row):
@@ -169,14 +222,23 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
     garr = jnp.asarray(garr_n[:, :, 0])
     has_dp = "dp" in mesh.shape and mesh.shape["dp"] > 1
     data_spec = P("dp") if has_dp else P()
+    row_spec = P("pp", "mp", None) if mp > 1 else P("pp", None, None)
     f32 = jnp.float32
 
     abstract_params = {n: jax.ShapeDtypeStruct(shape, dtype)
                        for n, (shape, dtype) in pspec.items()}
 
     def sharded(prow, shared_params, buffers, x, y):
-        # prow: (1, maxP) local row of the packed per-stage param buffer
-        row = prow[0]
+        # prow: (1, 1, maxP) local row of the packed per-(stage, mp-rank)
+        # param buffer. Tracing runs under axis_context(mp=...) when mp>1 so
+        # the fleet mp layers pick their manual-collective path.
+        from ... import env as dist_env
+        ctx = dist_env.axis_context(mp="mp") if mp > 1 else _nullcontext()
+        with ctx:
+            return _sharded_body(prow, shared_params, buffers, x, y)
+
+    def _sharded_body(prow, shared_params, buffers, x, y):
+        row = prow[0, 0]
         stage = jax.lax.axis_index("pp")
         is_last = stage == pp - 1
         B_loc = x.shape[0]
@@ -297,8 +359,15 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
             tick, carry0, jnp.arange(T))
 
         loss = jax.lax.psum(jnp.where(is_last, loss_sum / M, 0.0), "pp")
-        grow = (gacc_row / M)[None]            # (1, maxP): own-stage grads
+        # (1, 1, maxP): this (stage, mp-rank)'s own grads
+        grow = (gacc_row / M)[None, None]
         gsh = {n: jax.lax.psum(g / M, "pp") for n, g in gacc_sh.items()}
+        if mp > 1:
+            # every mp rank computes the identical loss (row-parallel psums
+            # re-replicate activations); pmean keeps the P() out_spec honest.
+            # Shared (replicated) params likewise see identical grads.
+            loss = jax.lax.pmean(loss, "mp")
+            gsh = {n: jax.lax.pmean(g, "mp") for n, g in gsh.items()}
         if has_dp:
             loss = jax.lax.pmean(loss, "dp")
             grow = jax.lax.pmean(grow, "dp")
@@ -307,8 +376,8 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
 
     sh = jax.shard_map(
         sharded, mesh=mesh,
-        in_specs=(P("pp", None), P(), P(), data_spec, data_spec),
-        out_specs=(P(), P("pp", None), P()), check_vma=False)
+        in_specs=(row_spec, P(), P(), data_spec, data_spec),
+        out_specs=(P(), row_spec, P()), check_vma=False)
     jitted = jax.jit(sh)
 
     def step(params, buffers, x, y):

@@ -45,7 +45,9 @@ class Model:
         'mp' axis shards every parameter that fleet's parallel layers mark
         with split_axis (GSPMD partitioning), and a 'pp' axis (network must
         be a PipelineLayer) runs the compiled 1F1B pipeline. mp×pp together
-        is served by the fleet/parallel API (gpt_spmd MeshPlan), not hapi."""
+        also routes through the compiled pipeline: mp-marked params are
+        packed as per-(stage, mp-rank) shards and the fleet mp layers run
+        their manual-collective path (pp_compiled.py)."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -224,8 +226,8 @@ class Model:
         if not isinstance(self.network, PipelineLayer):
             raise ValueError(
                 "Model.fit over a 'pp' mesh axis needs the network to be a "
-                "fleet PipelineLayer; for tensor+pipeline hybrids use the "
-                "fleet API or parallel.make_train_step (MeshPlan)")
+                "fleet PipelineLayer (mp/dp axes compose with it through "
+                "the compiled pipeline)")
         if len(in_raw) != 1 or len(lab_raw) != 1:
             raise ValueError("pipeline Model.fit expects one input and one "
                              "label tensor")
