@@ -56,6 +56,27 @@ def convert_dtype(d):
     return jnp.dtype(d)
 
 
+def canonical(d):
+    """int64 policy: jax runs with x64 disabled (TPU-native widths), so
+    64-bit integer/float requests canonicalize to their 32-bit forms at the
+    API boundary — silently, as ONE documented policy, instead of a jax
+    UserWarning per call site. paddle's int64 default dtype strings remain
+    accepted everywhere; the arrays simply carry the 32-bit layout XLA
+    would truncate to anyway."""
+    import jax
+    if d is None:
+        return None
+    d = convert_dtype(d)
+    if not jax.config.jax_enable_x64:
+        if d == int64:
+            return int32
+        if d == float64:
+            return float32
+        if d == jnp.dtype("uint64"):
+            return jnp.dtype("uint32")
+    return d
+
+
 def is_floating(dtype) -> bool:
     return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
 

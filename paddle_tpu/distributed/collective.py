@@ -184,27 +184,89 @@ def _world_mesh():
     return _WORLD_MESH[0]
 
 
+def _world_layout():
+    """Static per-device layout of the world mesh: (sorted process ids,
+    per-device process position, first-device index of each process)."""
+    import numpy as np
+    devs = list(_world_mesh().devices.flat)
+    procs = sorted({d.process_index for d in devs})
+    pos_of = {p: i for i, p in enumerate(procs)}
+    counts = np.zeros(len(procs), np.int64)
+    rep_idx, seen = [], set()
+    for i, d in enumerate(devs):
+        counts[pos_of[d.process_index]] += 1
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            rep_idx.append(i)
+    return devs, procs, pos_of, counts, rep_idx
+
+
 @functools.lru_cache(maxsize=None)
-def _xproc_program(kind, src, n_local):
-    """Compiled reducer over the world mesh. kind: sum/max/min/prod/avg or
-    bcast (then `src` is the source PROCESS index)."""
+def _xproc_fast(kind, src_pos):
+    """O(1)-memory world reducer for float values: native psum/pmax/pmin
+    with a per-device host-built scale (1/devices-of-my-process, zeroed off
+    the source process for bcast) — no (n_devices, ...) gather."""
+    import numpy as np
     mesh = _world_mesh()
+    devs, procs, pos_of, counts, _ = _world_layout()
+    nproc = len(procs)
+
+    if kind in ("max", "min"):
+        red = jax.lax.pmax if kind == "max" else jax.lax.pmin
+
+        def per_shard(x):
+            return red(x[0], "world")
+
+        return jax.jit(jax.shard_map(
+            per_shard, mesh=mesh, in_specs=P("world"), out_specs=P(),
+            check_vma=False)), None
+
+    scale_np = np.empty((len(devs), 1), np.float32)
+    for i, d in enumerate(devs):
+        p = pos_of[d.process_index]
+        live = (kind != "bcast") or (p == src_pos)
+        scale_np[i, 0] = (1.0 / counts[p]) if live else 0.0
+
+    def per_shard(x, s):
+        out = jax.lax.psum(x[0].astype(jnp.float32) * s[0, 0], "world")
+        if kind == "avg":
+            out = out / nproc
+        return out.astype(x.dtype)
+
+    fn = jax.jit(jax.shard_map(
+        per_shard, mesh=mesh, in_specs=(P("world"), P("world", None)),
+        out_specs=P(), check_vma=False))
+    local = jax.local_devices()
+    gidx = {id(d): i for i, d in enumerate(devs)}
+    shards = [jax.device_put(scale_np[gidx[id(d)]][None], d) for d in local]
+    scale = jax.make_array_from_single_device_arrays(
+        scale_np.shape, NamedSharding(mesh, P("world", None)), shards)
+    return fn, scale
+
+
+@functools.lru_cache(maxsize=None)
+def _xproc_gather(kind, src_pos):
+    """Gather-based world reducer (exact for ints and PROD): all_gather then
+    one representative row per process. O(n_devices) memory — used only for
+    dtypes/ops the native-collective path can't serve exactly."""
+    mesh = _world_mesh()
+    _, _, _, _, rep_idx = _world_layout()
+    rep = jnp.asarray(rep_idx)
 
     def per_shard(x):
-        # x: (1, ...) this device's copy; gather all, keep one per process
         full = jax.lax.all_gather(x, "world", axis=0, tiled=True)
-        reps = full[::n_local]
+        reps = jnp.take(full, rep, axis=0)
         if kind == "sum":
             return jnp.sum(reps, axis=0)
-        if kind == "max":
-            return jnp.max(reps, axis=0)
-        if kind == "min":
-            return jnp.min(reps, axis=0)
         if kind == "prod":
             return jnp.prod(reps, axis=0)
         if kind == "avg":
             return jnp.mean(reps, axis=0)
-        return reps[src]                                    # bcast
+        if kind == "max":
+            return jnp.max(reps, axis=0)
+        if kind == "min":
+            return jnp.min(reps, axis=0)
+        return reps[src_pos]                                # bcast
 
     return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=P("world"),
                                  out_specs=P(), check_vma=False))
@@ -212,17 +274,26 @@ def _xproc_program(kind, src, n_local):
 
 def _xproc_collective(np_val, kind, src=0):
     """Run an eager cross-process collective on this process's value; blocks
-    until every process has contributed (real rendezvous semantics)."""
+    until every process has contributed (real rendezvous semantics). `src`
+    is a PROCESS index (one rank per process)."""
     import numpy as np
     mesh = _world_mesh()
     n_dev = mesh.devices.size
+    devs, procs, pos_of, _, _ = _world_layout()
     local = jax.local_devices()
     np_val = np.asarray(np_val)
+    src_pos = pos_of.get(src, 0) if kind == "bcast" else 0
     sh = NamedSharding(mesh, P("world"))
     shards = [jax.device_put(np_val[None], d) for d in local]
     garr = jax.make_array_from_single_device_arrays(
         (n_dev,) + np_val.shape, sh, shards)
-    out = _xproc_program(kind, src, len(local))(garr)
+    floaty = np.issubdtype(np_val.dtype, np.floating)
+    if kind in ("max", "min") or (floaty and kind in ("sum", "avg",
+                                                      "bcast")):
+        fn, scale = _xproc_fast(kind, src_pos)
+        out = fn(garr) if scale is None else fn(garr, scale)
+    else:
+        out = _xproc_gather(kind, src_pos)(garr)
     return np.asarray(out.addressable_shards[0].data)
 
 
@@ -245,11 +316,13 @@ def _eager_axis_op(data, axis_name, per_shard_fn, out_spec_fn=None):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     if group is None and not _in_trace(tensor._data) \
-            and jax.process_count() > 1:
+            and jax.process_count() > 1 \
+            and getattr(tensor._data, "is_fully_addressable", True):
         # eager multi-controller WORLD collective: each process is a rank
-        # with its own value. Axis-scoped groups fall through to the
-        # mesh-axis path — a world reduce would both ignore the group and
-        # hang if the group spans a process subset.
+        # with its own (locally addressable) value. Axis-scoped groups and
+        # global mesh-sharded arrays (already collectively owned) fall
+        # through to the mesh-axis path — a world reduce would both ignore
+        # the group and hang if the group spans a process subset.
         kind = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max", ReduceOp.MIN: "min",
                 ReduceOp.PROD: "prod", ReduceOp.AVG: "avg"}[op]
         tensor._data = jnp.asarray(_xproc_collective(tensor._data, kind))
@@ -334,7 +407,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=No
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     if group is None and not _in_trace(tensor._data) \
-            and jax.process_count() > 1:
+            and jax.process_count() > 1 \
+            and getattr(tensor._data, "is_fully_addressable", True):
         tensor._data = jnp.asarray(
             _xproc_collective(tensor._data, "bcast", src=src))
         return tensor

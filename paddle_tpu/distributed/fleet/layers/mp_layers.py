@@ -136,9 +136,7 @@ class RowParallelLinear(Layer):
         def fn(a, w, *b):
             if not self.input_is_parallel:
                 # split the replicated input to this shard's columns
-                idx = jax.lax.axis_index(axis)
-                per = w.shape[0]
-                a = jax.lax.dynamic_slice_in_dim(a, idx * per, per, axis=a.ndim - 1)
+                a = _c_split_manual(a, axis, w.shape[0])
             out = _mp_allreduce_manual(a @ w, axis)
             if b:
                 out = out + b[0]
@@ -180,6 +178,30 @@ def _mp_allreduce_manual(a, axis):
 
     ar.defvjp(fwd, bwd)
     return ar(a)
+
+
+def _c_split_manual(a, axis, per):
+    """slice-own-columns forward, all_gather backward (reference mp_ops.py
+    _c_split): a raw dynamic_slice's transpose zero-pads outside each rank's
+    slice, leaving upstream (replicated) tensors with per-rank PARTIAL
+    cotangents that never recombine."""
+    def _slice(v):
+        idx = jax.lax.axis_index(axis)
+        return jax.lax.dynamic_slice_in_dim(v, idx * per, per,
+                                            axis=v.ndim - 1)
+
+    @jax.custom_vjp
+    def sp(v):
+        return _slice(v)
+
+    def fwd(v):
+        return _slice(v), None
+
+    def bwd(_, g):
+        return (jax.lax.all_gather(g, axis, axis=g.ndim - 1, tiled=True),)
+
+    sp.defvjp(fwd, bwd)
+    return sp(a)
 
 
 def _c_concat_manual(a, axis):

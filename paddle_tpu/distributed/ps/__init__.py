@@ -36,8 +36,9 @@ from ...core.autograd import Node, is_grad_enabled
 from ...core.tensor import Tensor
 
 __all__ = ["SparseTable", "AsyncCommunicator", "SparseEmbedding",
-           "sparse_embedding", "PSContext", "shard_for",
-           "PSServer", "PSClient", "DistributedSparseTable"]
+           "sparse_embedding", "PSContext", "shard_for", "merge_by_key",
+           "PSServer", "PSClient", "DistributedSparseTable",
+           "DeviceEmbeddingCache", "CachedEmbedding"]
 
 SparseTable = native.SparseTable
 
@@ -46,6 +47,19 @@ def shard_for(keys, num_shards):
     """ID-range sharding: which host owns each key (reference: feasign %
     shard_num routing in brpc_ps_client)."""
     return np.asarray(keys, dtype=np.int64) % int(num_shards)
+
+
+def merge_by_key(keys, grads, dim):
+    """Canonical duplicate-key gradient merge (reference communicator.cc
+    merge-by-key before push): one summed gradient per unique id. Shared by
+    the AsyncCommunicator flush and the device embedding cache so both
+    paths stay numerically identical."""
+    keys = np.asarray(keys, np.int64).reshape(-1)
+    grads = np.asarray(grads, np.float32).reshape(-1, dim)
+    uniq, inv = np.unique(keys, return_inverse=True)
+    merged = np.zeros((uniq.size, dim), np.float32)
+    np.add.at(merged, inv, grads)
+    return uniq, merged
 
 
 class AsyncCommunicator:
@@ -92,12 +106,9 @@ class AsyncCommunicator:
                 pending = []
 
     def _flush(self, items):
-        # merge by key: one push per unique id with summed grads
         keys = np.concatenate([k for k, _ in items])
         grads = np.concatenate([g for _, g in items])
-        uniq, inv = np.unique(keys, return_inverse=True)
-        merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
-        np.add.at(merged, inv, grads)
+        uniq, merged = merge_by_key(keys, grads, grads.shape[1])
         self._table.push(uniq, merged)
 
     def flush(self, timeout=30.0):
@@ -234,3 +245,5 @@ class PSContext:
 
 
 from .rpc import DistributedSparseTable, PSClient, PSServer  # noqa: E402,F401
+from .device_cache import (CachedEmbedding,  # noqa: E402,F401
+                           DeviceEmbeddingCache)

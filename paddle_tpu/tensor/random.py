@@ -53,7 +53,7 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
 def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
     if high is None:
         low, high = 0, low
-    d = _dt.convert_dtype(dtype) or _dt.int64
+    d = _dt.canonical(dtype) or _dt.canonical(_dt.int64)
     return Tensor(jax.random.randint(next_key(), tuple(shape), low, high, dtype=d))
 
 
@@ -62,7 +62,7 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
 
 
 def randperm(n, dtype="int64", name=None):
-    return Tensor(jax.random.permutation(next_key(), n).astype(_dt.convert_dtype(dtype)))
+    return Tensor(jax.random.permutation(next_key(), n).astype(_dt.canonical(dtype)))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
@@ -75,7 +75,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         g = jax.random.gumbel(next_key(), p.shape)
         _, idx = jax.lax.top_k(logits + g, num_samples)
         return idx
-    return Tensor(sample(x._data).astype(jnp.int64))
+    return Tensor(sample(x._data).astype(_dt.canonical(_dt.int64)))
 
 
 def bernoulli(x, name=None):

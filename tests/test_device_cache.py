@@ -1,0 +1,112 @@
+"""Device-resident embedding cache (VERDICT r3 missing #4 — the GPU-PS
+analogue, reference fleet/ps_gpu_wrapper.cc + heter_ps/): build_pass pulls
+hot rows into HBM, lookup/update run compiled on-device, flush writes back.
+Training through the cache must equal training against the host table."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+
+def _fresh_tables(rule, dim=8, lr=0.1, seed=3):
+    from paddle_tpu.distributed.ps import SparseTable
+    return (SparseTable(dim, rule=rule, lr=lr, seed=seed),
+            SparseTable(dim, rule=rule, lr=lr, seed=seed))
+
+
+@pytest.mark.parametrize("rule", ["sgd", "adagrad"])
+def test_cached_training_matches_host_table(rule):
+    from paddle_tpu.distributed.ps import DeviceEmbeddingCache
+    t_host, t_cache = _fresh_tables(rule)
+    keys = np.arange(100, dtype=np.int64) * 7 + 3
+    cache = DeviceEmbeddingCache(t_cache).build_pass(keys)
+
+    rng = np.random.RandomState(0)
+    for step in range(4):
+        ids = rng.choice(keys, size=16, replace=False)
+        grads = rng.randn(16, 8).astype(np.float32)
+        # host path: merged push (framework canonical semantics,
+        # AsyncCommunicator._flush merges by key before pushing)
+        t_host.push(ids, grads)
+        cache.update(ids, grads)
+        # mid-pass lookups see the updated device rows
+        got = np.asarray(cache.lookup(ids[:4]))
+        want = t_host.pull(ids[:4])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    cache.flush()
+    # after flush the HOST table matches, including optimizer state: a
+    # further push lands identically on both
+    post_ids = keys[:10]
+    g = rng.randn(10, 8).astype(np.float32)
+    t_host.push(post_ids, g)
+    t_cache.push(post_ids, g)
+    np.testing.assert_allclose(t_cache.pull(post_ids), t_host.pull(post_ids),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_duplicate_ids_merge_like_communicator():
+    from paddle_tpu.distributed.ps import DeviceEmbeddingCache
+    t_host, t_cache = _fresh_tables("adagrad")
+    keys = np.arange(20, dtype=np.int64)
+    cache = DeviceEmbeddingCache(t_cache).build_pass(keys)
+
+    ids = np.array([1, 5, 1, 5, 9], np.int64)
+    grads = np.random.RandomState(1).randn(5, 8).astype(np.float32)
+    # canonical merged semantics on the host side
+    uniq, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((uniq.size, 8), np.float32)
+    np.add.at(merged, inv, grads)
+    t_host.push(uniq, merged)
+
+    cache.update(ids, grads).flush()
+    np.testing.assert_allclose(t_cache.pull(uniq), t_host.pull(uniq),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pull_with_state_assign_roundtrip():
+    from paddle_tpu.distributed.ps import SparseTable
+    t = SparseTable(4, rule="adagrad", lr=0.1, seed=7)
+    keys = np.array([2, 4, 6], np.int64)
+    t.push(keys, np.ones((3, 4), np.float32))       # creates rows + g2 state
+    vals, state = t.pull_with_state(keys)
+    assert vals.shape == (3, 4) and state.shape == (3, 4)
+    assert (state > 0).all()                        # g2 accumulated
+    t2 = SparseTable(4, rule="adagrad", lr=0.1, seed=99)
+    t2.assign(keys, vals, state)
+    v2, s2 = t2.pull_with_state(keys)
+    np.testing.assert_array_equal(v2, vals)
+    np.testing.assert_array_equal(s2, state)
+
+
+def test_missing_key_raises_and_adam_rejected():
+    from paddle_tpu.distributed.ps import (DeviceEmbeddingCache, SparseTable)
+    t = SparseTable(4, rule="sgd")
+    cache = DeviceEmbeddingCache(t).build_pass(np.array([1, 2, 3], np.int64))
+    with pytest.raises(KeyError):
+        cache.lookup(np.array([99], np.int64))
+    with pytest.raises(ValueError):
+        DeviceEmbeddingCache(SparseTable(4, rule="adam"))
+
+
+def test_cached_embedding_autograd_path():
+    """CachedEmbedding: forward gather + backward on-device update, flushed
+    rows reflect the gradient step."""
+    from paddle_tpu.distributed.ps import CachedEmbedding, SparseTable
+    t = SparseTable(8, rule="sgd", lr=0.5, seed=1)
+    keys = np.arange(10, dtype=np.int64)
+    before = t.pull(keys).copy()
+    emb = CachedEmbedding(t, pass_keys=keys)
+    ids = paddle.to_tensor(np.array([[0, 1], [2, 3]], np.int64))
+    out = emb(ids)
+    assert tuple(out.shape) == (2, 2, 8)
+    out.sum().backward()
+    emb.flush()
+    after = t.pull(keys)
+    # ids 0..3 moved by -lr * 1; the rest untouched
+    np.testing.assert_allclose(after[:4], before[:4] - 0.5, rtol=1e-6)
+    np.testing.assert_allclose(after[4:], before[4:], rtol=1e-6)

@@ -202,6 +202,51 @@ def test_model_fit_mp_x_pp_x_dp_parity(clean_mesh):
         np.testing.assert_allclose(l_pp, float(l_g), rtol=2e-4, atol=1e-5)
 
 
+def test_row_parallel_input_split_grads(clean_mesh):
+    """RowParallelLinear(input_is_parallel=False): the input split must be
+    transpose-safe (_c_split_manual) — upstream replicated params get the
+    FULL recombined cotangent, not per-rank partials."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer.layers import functional_call, functional_state
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.pre = nn.Linear(8, 8)          # replicated upstream layer
+            self.row = RowParallelLinear(8, 4, input_is_parallel=False)
+
+        def forward(self, x):
+            return self.row(self.pre(x))
+
+    mesh = dist_env.build_mesh({"mp": 2})
+    paddle.seed(2)
+    net = Net()
+    params, buffers = functional_state(net)
+    x = np.random.RandomState(0).rand(4, 8).astype("float32")
+
+    def loss_local(p, xx):
+        with dist_env.axis_context(mp="mp"):
+            out, _ = functional_call(net, p, buffers, args=(Tensor(xx),),
+                                     train=True)
+        return jnp.sum(out._data ** 2)
+
+    specs = {"pre.weight": P(), "pre.bias": P(),
+             "row.weight": P("mp", None), "row.bias": P()}
+    g = jax.jit(jax.shard_map(
+        lambda p, xx: jax.grad(loss_local)(p, xx), mesh=mesh,
+        in_specs=(specs, P()), out_specs=specs, check_vma=False))(params, x)
+
+    t = Tensor(jnp.asarray(x))
+    out = net(t)
+    (out ** 2).sum().backward()
+    for n, p in net.named_parameters():
+        np.testing.assert_allclose(np.asarray(g[n]), p.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
 def test_model_fit_ernie_tiny_pipeline(clean_mesh):
     """BASELINE 'ERNIE mp+pp' row through the user-facing API: ERNIE-tiny
     as a PipelineLayer (tied embeddings across first/last stage) trained by
