@@ -117,12 +117,13 @@ def probe_backend(total_budget_s, attempt_timeout_s=150, sleep_s=30):
         f"({attempt} probe attempts); last: {last}")
 
 
-def start_watchdog(seconds, what):
+def start_watchdog(seconds, what, on_fire=None):
     """Emit the structured-failure line and hard-exit if `seconds` pass
     before cancel() — covers an in-process wedge after a successful probe
-    (the hang releases the GIL: it blocks on socket I/O)."""
+    (the hang releases the GIL: it blocks on socket I/O). `on_fire` lets
+    other benches (bench_eager) emit their own metric's failure record."""
     def fire():
-        emit_failure(f"watchdog: {what} wedged for >{seconds}s")
+        (on_fire or emit_failure)(f"watchdog: {what} wedged for >{seconds}s")
         os._exit(0)
     t = threading.Timer(seconds, fire)
     t.daemon = True

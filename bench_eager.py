@@ -22,6 +22,15 @@ import numpy as np
 
 
 def main():
+    # probe in killable subprocesses first — a wedged axon grant hangs
+    # in-process backend init forever — then watchdog the in-process init
+    # the probe can't cover (the bench.py pattern)
+    import bench
+    backend = bench.probe_backend(
+        float(os.environ.get("BENCH_INIT_BUDGET_S", 600)))
+    wd = bench.start_watchdog(300, "in-process jax backend init",
+                              on_fire=_emit_failure)
+
     import jax
     import jax.numpy as jnp
 
@@ -30,7 +39,8 @@ def main():
     import paddle_tpu.optimizer as opt
     from paddle_tpu.core.tensor import _CACHE_STATS
 
-    backend = jax.default_backend()
+    assert jax.default_backend() == backend
+    wd.cancel()
     B, D, H, C = 256, 64, 256, 8
     rng = np.random.RandomState(0)
     x_np = rng.rand(B, D).astype("float32")
@@ -106,5 +116,16 @@ def main():
     }))
 
 
+def _emit_failure(error):
+    # the one-JSON-line contract holds on failure too (bench.py rule)
+    print(json.dumps({
+        "metric": "eager_mlp_step_ms", "value": 0.0,
+        "unit": "ms per eager train step (fwd+bwd+SGD)",
+        "vs_baseline": 0.0, "error": error}))
+
+
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:                               # noqa: BLE001
+        _emit_failure(f"{type(e).__name__}: {str(e)[:600]}")

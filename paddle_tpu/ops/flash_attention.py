@@ -48,6 +48,11 @@ def _ref_attention_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
 
 def _use_pallas(q, k):
     """q/k here are always (B, H, S, D) — both callers transpose first."""
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_FLASH") == "1":
+        # operator/profiling escape hatch: forces the pure-XLA attention
+        # (tools/profile_step.py uses it for the whole-model A/B row)
+        return False
     if jax.default_backend() != "tpu":
         return False
     B, H, S, D = q.shape

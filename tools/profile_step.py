@@ -1,0 +1,290 @@
+"""Profile the flagship GPT-350M train step on the live backend.
+
+VERDICT r3 item 2: decompose the step, name the top time consumers, and
+A/B the candidate levers (remat mode, batch, Pallas-vs-XLA attention,
+flash block sizes). Prints a markdown table for docs/PERF_NOTES.md.
+
+Honest-sync rules as bench.py: every timed unit ends in a host fetch of a
+value data-dependent on the work; K units per dispatch amortize the ~70ms
+tunnel RTT.
+
+Usage:  python tools/profile_step.py            # full sweep (TPU)
+        python tools/profile_step.py --quick    # step decomposition only
+Optionally XPLANE=/tmp/xplane_gpt captures a profiler trace of the main
+config for offline inspection.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK = 197e12        # v5e bf16
+
+
+def timed(fn, args, n=None, k=1, label=""):
+    """Median wall time of fn(*args) with a host fetch per call; first call
+    compiles (untimed). Returns seconds per unit."""
+    import jax
+    if n is None:
+        n = 8 if jax.default_backend() == "tpu" else 2
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        ts.append((time.perf_counter() - t0) / k)
+    return float(np.median(ts))
+
+
+def _sync(out):
+    import jax
+    leaves = jax.tree_util.tree_leaves(out)
+    if leaves:
+        np.asarray(jax.device_get(leaves[0]))
+
+
+def build(B, S, remat, lr=2e-4):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    # CPU runs are harness smoke tests, not measurements: tiny model
+    cfg = GPTSpmdConfig(
+        vocab_size=50304 if on_tpu else 1024,
+        max_seq_len=S,
+        hidden=1024 if on_tpu else 128,
+        layers=24 if on_tpu else 2,
+        heads=16 if on_tpu else 4,
+        param_dtype="bfloat16" if on_tpu else "float32",
+        compute_dtype="bfloat16" if on_tpu else "float32",
+        remat={"none": False, "full": True, "dots": "dots"}[remat])
+    plan = MeshPlan()
+    step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=lr)
+    params, state = init_fn(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    labs = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    return cfg, plan, step_fn, params, state, toks, labs, n_params
+
+
+def step_mfu(B, S, remat, scan_k=10, n=3):
+    """Steady-state step time via scan-K dispatch; returns (ms/step, MFU)."""
+    import jax
+    import jax.numpy as jnp
+    cfg, plan, step_fn, params, state, toks, labs, n_params = \
+        build(B, S, remat)
+    lr = jnp.float32(2e-4)
+
+    def multi(params, state):
+        def body(c, _):
+            p, s = c
+            loss, p, s = step_fn(p, s, toks, labs, lr)
+            return (p, s), loss
+        (p, s), losses = jax.lax.scan(body, (params, state), None,
+                                      length=scan_k)
+        return losses[-1], p, s
+
+    fn = jax.jit(multi)
+    loss, params, state = fn(params, state)
+    _sync(loss)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        loss, params, state = fn(params, state)
+        _sync(loss)
+        ts.append((time.perf_counter() - t0) / scan_k)
+    dt = float(np.median(ts))
+    fpt = 6 * n_params + 6 * cfg.layers * S * cfg.hidden
+    mfu = B * S * fpt / dt / PEAK
+    return 1000 * dt, mfu
+
+
+def decompose(B, S, remat):
+    """Piece timings (fwd, fwd+bwd, blocks, loss) at the bench config."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.gpt_spmd import (_embed, _stage_blocks,
+                                              _vocab_parallel_loss)
+    cfg, plan, step_fn, params, state, toks, labs, n_params = \
+        build(B, S, remat)
+
+    def fwd_loss(params):
+        h = _embed(toks, params, cfg, plan)
+        h = _stage_blocks(h, params, cfg, plan)
+        return _vocab_parallel_loss(h, labs, params, cfg, plan)
+
+    def blocks_only(params, h0):
+        return _stage_blocks(h0, params, cfg, plan).astype(jnp.float32).sum()
+
+    h0 = jax.jit(lambda p: _embed(toks, p, cfg, plan))(params)
+    rows = []
+    rows.append(("forward only", 1000 * timed(jax.jit(fwd_loss), (params,))))
+    rows.append(("fwd+bwd", 1000 * timed(
+        jax.jit(jax.grad(fwd_loss)), (params,))))
+    rows.append(("blocks fwd", 1000 * timed(
+        jax.jit(blocks_only), (params, h0))))
+    rows.append(("blocks fwd+bwd", 1000 * timed(
+        jax.jit(jax.grad(blocks_only, argnums=1)), (params, h0))))
+
+    def loss_only(params, h):
+        return _vocab_parallel_loss(h, labs, params, cfg, plan)
+
+    rows.append(("vocab loss fwd+bwd", 1000 * timed(
+        jax.jit(jax.grad(loss_only, argnums=1)), (params, h0))))
+    return rows
+
+
+def flash_ab(B, S, H=16, D=64):
+    """Pallas flash vs XLA fallback, fwd+bwd, at the bench shape."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.flash_attention import (_pallas_flash_bhsd,
+                                                _ref_attention_bhsd)
+    scale = 1.0 / D ** 0.5
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), "bfloat16") * 0.5
+               for kk in ks)
+
+    def run(f):
+        loss = lambda q, k, v: f(q, k, v).astype(jnp.float32).sum()
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return 1000 * timed(g, (q, k, v))
+
+    t_pallas = run(lambda q, k, v: _pallas_flash_bhsd(q, k, v, True, scale))
+    t_xla = run(lambda q, k, v: _ref_attention_bhsd(q, k, v, True, scale))
+    return t_pallas, t_xla
+
+
+def flash_blocks_sweep(B, S, H=16, D=64):
+    """block_q x block_k sweep for the Pallas kernel; returns sorted list
+    and records the winner in the autotune cache."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    scale = 1.0 / D ** 0.5
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), "bfloat16") * 0.5
+               for kk in ks)
+    results = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            if bq > S or bk > S:
+                continue
+            try:
+                f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, sm_scale=scale,
+                    block_q=bq, block_k=bk).astype(jnp.float32).sum())
+                g = jax.jit(jax.grad(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, causal=True, sm_scale=scale, block_q=bq,
+                        block_k=bk).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2)))
+                t = 1000 * (timed(f, (q, k, v)) + timed(g, (q, k, v)))
+                results.append(((bq, bk), t))
+            except Exception as e:                           # noqa: BLE001
+                results.append(((bq, bk), f"fail: {str(e)[:60]}"))
+    ok = [r for r in results if isinstance(r[1], float)]
+    if ok:
+        best = min(ok, key=lambda r: r[1])[0]
+        try:
+            from paddle_tpu.incubate import autotune as at
+            key = (jax.default_backend(), B, H, S, D, True)
+            at._block_cache[key] = tuple(best)
+            at._save_disk_cache()
+            if at._cache_path():
+                print(f"autotune: recorded flash blocks {best} for "
+                      f"(B={B},H={H},S={S},D={D}) -> {at._cache_path()}")
+            else:
+                print(f"autotune: best flash blocks {best} recorded "
+                      "IN-MEMORY ONLY — set PADDLE_TPU_AUTOTUNE_CACHE to "
+                      "a file path to persist for training runs")
+        except Exception as e:                               # noqa: BLE001
+            print(f"autotune record failed: {e}")
+    return results
+
+
+def main():
+    import bench
+    backend = bench.probe_backend(float(os.environ.get(
+        "BENCH_INIT_BUDGET_S", 600)))
+    wd = bench.start_watchdog(
+        300, "in-process jax backend init",
+        on_fire=lambda err: print(f"| watchdog | {err} |"))
+    import jax
+    assert jax.default_backend() == backend
+    wd.cancel()
+    on_tpu = backend == "tpu"
+    quick = "--quick" in sys.argv
+    B, S = (8, 1024) if on_tpu else (2, 128)
+
+    print(f"## profile_step on {backend} (B={B}, S={S})\n")
+    print("| experiment | result |")
+    print("|---|---|")
+
+    ms, mfu = step_mfu(B, S, "dots", scan_k=10 if on_tpu else 2)
+    print(f"| full step B={B} remat=dots | {ms:.1f} ms/step, "
+          f"MFU {mfu:.3f} |")
+
+    if not quick:
+        for remat in ("none", "full"):
+            try:
+                ms2, mfu2 = step_mfu(B, S, remat,
+                                     scan_k=10 if on_tpu else 2)
+                print(f"| full step B={B} remat={remat} | {ms2:.1f} ms/step, "
+                      f"MFU {mfu2:.3f} |")
+            except Exception as e:                           # noqa: BLE001
+                print(f"| full step B={B} remat={remat} | "
+                      f"fail: {str(e)[:80]} |")
+        if on_tpu:
+            try:
+                ms3, mfu3 = step_mfu(12, S, "dots", scan_k=10)
+                print(f"| full step B=12 remat=dots | {ms3:.1f} ms/step, "
+                      f"MFU {mfu3:.3f} |")
+            except Exception as e:                           # noqa: BLE001
+                print(f"| full step B=12 remat=dots | "
+                      f"fail: {str(e)[:80]} |")
+
+    for name, ms_i in decompose(B, S, "dots"):
+        print(f"| {name} | {ms_i:.1f} ms |")
+
+    if on_tpu and not quick:
+        tp, tx = flash_ab(B, S)
+        print(f"| flash fwd+bwd Pallas | {tp:.1f} ms |")
+        print(f"| flash fwd+bwd XLA fallback | {tx:.1f} ms |")
+        # whole-model A/B through the dispatch switch (not just the kernel)
+        os.environ["PADDLE_TPU_DISABLE_PALLAS_FLASH"] = "1"
+        try:
+            ms4, mfu4 = step_mfu(B, S, "dots", scan_k=10)
+            print(f"| full step B={B} remat=dots XLA-attention | "
+                  f"{ms4:.1f} ms/step, MFU {mfu4:.3f} |")
+        except Exception as e:                               # noqa: BLE001
+            print(f"| full step XLA-attention | fail: {str(e)[:80]} |")
+        finally:
+            del os.environ["PADDLE_TPU_DISABLE_PALLAS_FLASH"]
+        for blocks, t in flash_blocks_sweep(B, S):
+            t_s = f"{t:.1f} ms" if isinstance(t, float) else t
+            print(f"| flash blocks bq={blocks[0]} bk={blocks[1]} | {t_s} |")
+
+    xdir = os.environ.get("XPLANE")
+    if xdir:
+        cfgB = (B, S, "dots")
+        import jax.numpy as jnp
+        cfg, plan, step_fn, params, state, toks, labs, _ = build(*cfgB)
+        lr = jnp.float32(2e-4)
+        with jax.profiler.trace(xdir):
+            for _ in range(3):
+                loss, params, state = step_fn(params, state, toks, labs, lr)
+            _sync(loss)
+        print(f"\nXPlane trace captured to {xdir}")
+
+
+if __name__ == "__main__":
+    main()
