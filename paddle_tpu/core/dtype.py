@@ -74,6 +74,8 @@ def canonical(d):
             return float32
         if d == jnp.dtype("uint64"):
             return jnp.dtype("uint32")
+        if d == complex128:
+            return complex64
     return d
 
 

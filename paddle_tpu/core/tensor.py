@@ -107,7 +107,9 @@ class Tensor:
         return arr.astype(dtype) if dtype is not None else arr
 
     def astype(self, dtype):
-        d = _dt.convert_dtype(dtype)
+        # canonical() applies the documented int64/f64 policy silently at
+        # the API boundary (x64 is off; jax would warn-and-truncate anyway)
+        d = _dt.canonical(dtype)
         return apply_op(lambda x: x.astype(d), self)
 
     cast = astype
@@ -697,7 +699,7 @@ def _wrap_out(out, stop_gradient):
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """paddle.to_tensor equivalent."""
-    dtype = _dt.convert_dtype(dtype)
+    dtype = _dt.canonical(dtype)
     if isinstance(data, Tensor):
         arr = data._data
         if dtype is not None and arr.dtype != dtype:

@@ -14,6 +14,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from ..core import dtype as _dt
 from ..core.tensor import Tensor
 from . import nn  # noqa: F401
 
@@ -97,8 +98,9 @@ class SparseCsrTensor:
     def to_sparse_coo(self, sparse_dim=2):
         crows = np.asarray(self.crows_._data)
         rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-        indices = jnp.stack([jnp.asarray(rows, jnp.int64),
-                             self.cols_._data.astype(jnp.int64)])
+        idt = _dt.canonical(jnp.int64)
+        indices = jnp.stack([jnp.asarray(rows, idt),
+                             self.cols_._data.astype(idt)])
         return SparseCooTensor(Tensor(indices), self.values_, self.shape)
 
     def to_dense(self):
@@ -121,8 +123,7 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       else indices)
     val = jnp.asarray(values._data if isinstance(values, Tensor) else values)
     if dtype is not None:
-        from ..core import dtype as _dt
-        val = val.astype(_dt.convert_dtype(dtype))
+        val = val.astype(_dt.canonical(dtype))
     if shape is None:
         shape = [int(d) + 1 for d in np.asarray(ind).max(axis=1)]
     return SparseCooTensor(Tensor(ind), Tensor(val), shape)
@@ -132,8 +133,7 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
     val = jnp.asarray(values._data if isinstance(values, Tensor) else values)
     if dtype is not None:
-        from ..core import dtype as _dt
-        val = val.astype(_dt.convert_dtype(dtype))
+        val = val.astype(_dt.canonical(dtype))
     return SparseCsrTensor(crows, cols, Tensor(val), shape)
 
 
@@ -191,9 +191,8 @@ rad2deg = _values_op(jnp.rad2deg)
 
 
 def cast(x, index_dtype=None, value_dtype=None):
-    from ..core import dtype as _dt
-    vd = _dt.convert_dtype(value_dtype) if value_dtype else None
-    idd = _dt.convert_dtype(index_dtype) if index_dtype else None
+    vd = _dt.canonical(value_dtype) if value_dtype else None
+    idd = _dt.canonical(index_dtype) if index_dtype else None
     if isinstance(x, SparseCooTensor):
         ind = x.indices_._data.astype(idd) if idd else x.indices_._data
         val = x.values_._data.astype(vd) if vd else x.values_._data

@@ -7,7 +7,7 @@ from ..core.tensor import Tensor, to_tensor, apply_op  # noqa: F401
 
 
 def _d(dtype):
-    d = _dt.convert_dtype(dtype)
+    d = _dt.canonical(dtype)      # documented 64->32 device-boundary policy
     return d if d is not None else _dt.get_default_dtype()
 
 

@@ -127,7 +127,7 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 def cast(x, dtype):
     from ..core import dtype as _dt
-    d = _dt.convert_dtype(dtype)
+    d = _dt.canonical(dtype)      # documented 64->32 device-boundary policy
     return apply_op(lambda a: a.astype(d), x)
 
 

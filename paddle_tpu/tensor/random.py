@@ -13,7 +13,7 @@ from ..core.tensor import Tensor, apply_op
 
 
 def _d(dtype):
-    d = _dt.convert_dtype(dtype)
+    d = _dt.canonical(dtype)      # documented 64->32 device-boundary policy
     return d if d is not None else _dt.get_default_dtype()
 
 
