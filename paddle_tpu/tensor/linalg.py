@@ -1,6 +1,7 @@
 """Linear algebra ops (reference: python/paddle/tensor/linalg.py:240 matmul)."""
 import jax.numpy as jnp
 
+from ..core import dtype as _dt
 from ..core.tensor import Tensor, apply_op, _binop
 
 
@@ -163,7 +164,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):
     def fn(a):
         lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
         h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi))
-        return h.astype(jnp.int64)
+        return h.astype(_dt.canonical(jnp.int64))
     return apply_op(fn, input)
 
 

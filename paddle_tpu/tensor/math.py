@@ -302,7 +302,7 @@ def increment(x, value=1.0, name=None):
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
     return apply_op(lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim)
-                    .astype(jnp.int64), x)
+                    .astype(_dt.canonical(jnp.int64)), x)
 
 
 def numel(x, name=None):

@@ -3,6 +3,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import dtype as _dt
 from ..core.tensor import Tensor, apply_op
 
 
@@ -12,7 +13,7 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
                          axis=axis if axis is not None else 0)
         if keepdim and axis is not None:
             out = jnp.expand_dims(out, axis)
-        return out.astype(jnp.dtype(dtype) if dtype else jnp.int64)
+        return out.astype(_dt.canonical(dtype or jnp.int64))
     return apply_op(fn, x)
 
 
@@ -22,7 +23,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
                          axis=axis if axis is not None else 0)
         if keepdim and axis is not None:
             out = jnp.expand_dims(out, axis)
-        return out.astype(jnp.dtype(dtype) if dtype else jnp.int64)
+        return out.astype(_dt.canonical(dtype or jnp.int64))
     return apply_op(fn, x)
 
 
@@ -31,7 +32,7 @@ def argsort(x, axis=-1, descending=False, name=None):
         idx = jnp.argsort(a, axis=axis)
         if descending:
             idx = jnp.flip(idx, axis=axis)
-        return idx.astype(jnp.int64)
+        return idx.astype(_dt.canonical(jnp.int64))
     return apply_op(fn, x)
 
 
@@ -52,7 +53,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
         ax = axis if axis is not None else a.ndim - 1
         moved = jnp.moveaxis(a, ax, -1)
         vals, idx = jax_topk(moved, k, largest)
-        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(_dt.canonical(jnp.int64))
     return apply_op(fn, x)
 
 
@@ -107,7 +108,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         srt = jnp.sort(a, axis=axis)
         idx = jnp.argsort(a, axis=axis)
         vals = jnp.take(srt, k - 1, axis=axis)
-        inds = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+        inds = jnp.take(idx, k - 1, axis=axis).astype(_dt.canonical(jnp.int64))
         if keepdim:
             vals = jnp.expand_dims(vals, axis)
             inds = jnp.expand_dims(inds, axis)
@@ -130,7 +131,7 @@ def _mode_last(a):
     vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
     pos = jnp.arange(n)
     idx = jnp.argmax(jnp.where(a == vals[..., None], pos, -1), axis=-1)
-    return vals, idx.astype(jnp.int64)
+    return vals, idx.astype(_dt.canonical(jnp.int64))
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
