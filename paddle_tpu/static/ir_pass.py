@@ -189,6 +189,29 @@ def _amp_cast_pass(op, attrs):
     return [o.astype(a.dtype) for o, a in zip(outs, op.out_avals)]
 
 
+@register_pass("quant_aware")
+@register_pass("quantization")
+def _quant_pass(op, attrs):
+    """QAT fake-quant insertion (reference: slim/quantization/
+    quantization_pass.py QuantizationTransformPass — fake_quantize ops
+    inserted before every matmul/conv on weights and activations). Scales
+    are inline abs-max (the reference's fake_quantize_abs_max); the STE
+    round keeps the rewritten program trainable."""
+    if op.name not in _MATMUL_PRIMS:
+        return None
+    from ..quantization import _fake_quant_raw
+    wbits = attrs.get("weight_bits", 8)
+    abits = attrs.get("activation_bits", 8)
+    ins = []
+    for i, x in enumerate(op.inputs):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            bits = wbits if i == 1 else abits
+            ins.append(_fake_quant_raw(x, jnp.max(jnp.abs(x)), bits))
+        else:
+            ins.append(x)
+    return op.bind(*ins)
+
+
 @register_pass("auto_parallel_recompute")
 @register_pass("recompute")
 def _recompute_tag_pass(op, attrs):
