@@ -222,6 +222,22 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        # captured Program (possibly pass-rewritten): execute its jaxpr
+        # against the feed dict, feeds matched by the capture's input names
+        if isinstance(program, Program) and program._jaxpr is not None:
+            feed = feed or {}
+            args = []
+            for i, name in enumerate(program._inputs):
+                if name in feed:
+                    args.append(jnp.asarray(np.asarray(feed[name])))
+                else:
+                    raise KeyError(
+                        f"Executor.run: feed is missing input {name!r} "
+                        f"(captured inputs: {list(program._inputs)})")
+            outs = program.run_captured(*args)
+            if return_numpy:
+                outs = [np.asarray(o) for o in outs]
+            return list(outs)
         # load_inference_model returns a callable program (TranslatedLayer):
         # execute it paddle-style with the feed dict in feed-name order
         if callable(program):

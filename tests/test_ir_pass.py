@@ -163,6 +163,20 @@ def test_unknown_pass_still_raises():
         dist_passes.new_pass("definitely_not_a_pass").apply(object())
 
 
+def test_executor_runs_captured_and_rewritten_program():
+    """Reference UX: exe.run(program, feed={...}) over a captured (and
+    pass-rewritten) Program."""
+    prog = _mlp_program()
+    x = np.random.RandomState(7).randn(2, 8).astype("float32")
+    exe = static.Executor()
+    golden = exe.run(prog, feed={"x": x})[0]
+    dist_passes.new_pass("amp").apply(prog)
+    got = exe.run(prog, feed={"x": x})[0]
+    np.testing.assert_allclose(got, golden, rtol=5e-2, atol=5e-2)
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={})
+
+
 def test_apply_pass_requires_captured_ir():
     with pytest.raises(ValueError):
         static.Program().apply_pass(lambda op, attrs: None)
