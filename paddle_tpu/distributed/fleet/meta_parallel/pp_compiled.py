@@ -24,11 +24,16 @@ the same way — no cross-stage psum. Params reachable from more than one
 stage (SharedLayerDesc embeddings) stay replicated and psum'd, which is also
 the reference's behavior (allreduce_shared_weight_gradients).
 
-Other limitations vs the GPT path (parallel/gpt_spmd.py):
-- inter-stage activations must share one shape/dtype (checked at trace
-  time); the last stage's output is unconstrained (it only feeds the loss).
-- buffer mutations inside stage forwards (e.g. BN running stats) are not
-  written back from the compiled step.
+Buffer semantics: BN-style running stats update per microbatch inside the
+compiled step (the stage's sequential updates thread through the tick
+carry; the last stage's only forward runs inside its backward and
+contributes via value_and_grad aux) and are merged across stages at the
+end (psum of per-stage deltas over 'pp'; float stats pmean over dp/mp).
+step() returns them as its third output.
+
+Limitation vs the GPT path (parallel/gpt_spmd.py): inter-stage activations
+must share one shape/dtype (checked at trace time); the last stage's
+output is unconstrained (it only feeds the loss).
 """
 import jax
 import jax.numpy as jnp
@@ -43,8 +48,10 @@ from ....parallel.pipeline_schedule import (arrival_tables, build_tables,
 
 
 def _make_stage_fn(pl, s):
-    """Pure fn (params, buffers, x_raw) -> y_raw running stages' layers
-    [boundaries[s], boundaries[s+1]) of PipelineLayer `pl`."""
+    """Pure fn (params, buffers, x_raw) -> (y_raw, new_buffers) running
+    stages' layers [boundaries[s], boundaries[s+1]) of PipelineLayer `pl`.
+    new_buffers carries BN-style running-stat updates (reference: pipeline
+    stages update their local BN stats per microbatch)."""
     lo, hi = pl._boundaries[s], pl._boundaries[s + 1]
 
     def seg_forward(layer_self, xin):
@@ -56,9 +63,9 @@ def _make_stage_fn(pl, s):
         return h
 
     def fn(params, buffers, x):
-        out, _ = functional_call(pl, params, buffers, args=(x,), train=True,
-                                 method=seg_forward)
-        return out._data if isinstance(out, Tensor) else out
+        out, new_buffers = functional_call(pl, params, buffers, args=(x,),
+                                           train=True, method=seg_forward)
+        return (out._data if isinstance(out, Tensor) else out), new_buffers
 
     return fn
 
@@ -105,6 +112,24 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
         raise ValueError("compiled pipeline needs pp >= 2")
     if pl._loss_fn is None:
         raise ValueError("PipelineLayer needs loss_fn for the compiled step")
+    # buffers reachable from a layer owned by 2+ stages would be updated
+    # independently per stage and the disjoint-delta merge below would
+    # double-apply them — reject, like mp-split shared params
+    buf_name_of = {id(b): n for n, b in pl.named_buffers()}
+    buf_stages = {}
+    for i, (l, _) in enumerate(pl._built):
+        if isinstance(l, Layer):
+            s = pl.stage_of_layer(i)
+            for b in l.buffers():
+                n = buf_name_of.get(id(b))
+                if n is not None:
+                    buf_stages.setdefault(n, set()).add(s)
+    shared_bufs = sorted(n for n, ss in buf_stages.items() if len(ss) > 1)
+    if shared_bufs:
+        raise ValueError(
+            f"buffers on layers shared across pipeline stages are not "
+            f"supported in the compiled step (their per-stage updates "
+            f"cannot be merged): {shared_bufs}")
     stage_fns = [_make_stage_fn(pl, s) for s in range(pp)]
 
     # ---------------- per-stage param packing plan (static) ----------------
@@ -247,10 +272,12 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
         y_mb = y.reshape((M, B_mb) + y.shape[1:])
 
         # inter-stage activation shape: trace stage outputs abstractly
-        act = jax.eval_shape(stage_fns[0], abstract_params, buffers, x_mb[0])
+        act = jax.eval_shape(stage_fns[0], abstract_params, buffers,
+                             x_mb[0])[0]
         for s in range(1, pp - 1):
-            nxt = jax.eval_shape(stage_fns[s], abstract_params, buffers,
-                                 jax.ShapeDtypeStruct(act.shape, act.dtype))
+            nxt = jax.eval_shape(
+                stage_fns[s], abstract_params, buffers,
+                jax.ShapeDtypeStruct(act.shape, act.dtype))[0]
             if nxt.shape != act.shape or nxt.dtype != act.dtype:
                 raise ValueError(
                     f"pipeline stages must share one inter-stage activation "
@@ -263,9 +290,7 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
         fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
         bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
 
-        def seg_call(s, own, shared, xin):
-            """Stage forward as a function of (own stage params, shared
-            params) so vjp differentiates exactly the live leaves."""
+        def _full_params(s, own, shared):
             full = dict(shared)
             for n, (so, off, size) in layout.items():
                 shape, dtype = pspec[n]
@@ -273,10 +298,26 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
                     full[n] = own[n].astype(dtype)
                 else:
                     full[n] = jnp.zeros(shape, dtype)
-            return stage_fns[s](full, buffers, xin)
+            return full
+
+        def seg_call(s, own, shared, bufs_for, xin):
+            """Stage forward as a function of (own stage params, shared
+            params) so vjp differentiates exactly the live leaves. Buffer
+            updates are DISCARDED here — this variant serves the backward
+            recompute, which replays with `bufs_for` = the SNAPSHOT the
+            executed forward used (buffer-dependent forwards like
+            SpectralNorm/QAT scales linearize at the right point)."""
+            return stage_fns[s](_full_params(s, own, shared), bufs_for,
+                                xin)[0]
+
+        def seg_call_buf(s, own, shared, bufs, xin):
+            """Forward variant that also returns the stage's updated
+            buffers (BN running stats, per microbatch)."""
+            return stage_fns[s](_full_params(s, own, shared), bufs, xin)
 
         def tick(carry, t):
-            buf, gbuf, fchan, gchan, loss_sum, gacc_row, gacc_sh = carry
+            (buf, gbuf, fchan, gchan, loss_sum, gacc_row, gacc_sh,
+             bufs, bufsnap) = carry
             f_idx = fwd_tbl[t, stage]
             b_idx = bwd_tbl[t, stage]
             valid_f = f_idx >= 0
@@ -302,10 +343,17 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
             for s in range(pp - 1):
                 def run_f(s=s):
                     xin = x_mb[fi] if s == 0 else buf[fi % W]
-                    return seg_call(s, own_dict(s, row), shared_params,
-                                    xin).astype(act.dtype)
-                y_f = y_f + jax.lax.cond(
-                    (stage == s) & valid_f, run_f, lambda: zero_act)
+                    # park the buffer state THIS forward runs with, so the
+                    # backward recompute replays the identical function
+                    snap = jax.tree_util.tree_map(
+                        lambda sb, b: sb.at[fi % W].set(b), bufsnap, bufs)
+                    y, nb = seg_call_buf(s, own_dict(s, row), shared_params,
+                                         bufs, xin)
+                    return y.astype(act.dtype), nb, snap
+                y_s, bufs, bufsnap = jax.lax.cond(
+                    (stage == s) & valid_f, run_f,
+                    lambda: (zero_act, bufs, bufsnap))
+                y_f = y_f + y_s
 
             # ---- backward ----
             l_b = jnp.zeros((), f32)
@@ -314,32 +362,37 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
                 def run_b(s=s):
                     own = own_dict(s, row)
                     if s == pp - 1:
+                        # the last stage's ONLY forward runs here: capture
+                        # its buffer updates as value_and_grad aux
                         xin = buf[bi % W] if s > 0 else x_mb[bi]
 
                         def head(ow, sh, xi):
-                            out = seg_call(s, ow, sh, xi)
-                            return loss_raw(out, y_mb[bi])
-                        l, (go, gs_, gx) = jax.value_and_grad(
-                            head, argnums=(0, 1, 2))(own, shared_params, xin)
+                            out, nb = seg_call_buf(s, ow, sh, bufs, xi)
+                            return loss_raw(out, y_mb[bi]), nb
+                        (l, nb), (go, gs_, gx) = jax.value_and_grad(
+                            head, argnums=(0, 1, 2), has_aux=True)(
+                            own, shared_params, xin)
                         return (l, flatten_own(s, go),
                                 {n: gs_[n].astype(f32) for n in shared_names},
-                                gx.astype(act.dtype))
+                                gx.astype(act.dtype), nb)
                     xin = x_mb[bi] if s == 0 else buf[bi % W]
+                    bufs_m = jax.tree_util.tree_map(
+                        lambda sb: sb[bi % W], bufsnap)
                     _, vjp = jax.vjp(
-                        lambda ow, sh, xi: seg_call(s, ow, sh, xi),
+                        lambda ow, sh, xi: seg_call(s, ow, sh, bufs_m, xi),
                         own, shared_params, xin)
                     go, gs_, gx = vjp(gbuf[bi % W].astype(act.dtype))
                     if s == 0:
                         gx = zero_act
                     return (jnp.zeros((), f32), flatten_own(s, go),
                             {n: gs_[n].astype(f32) for n in shared_names},
-                            gx.astype(act.dtype))
+                            gx.astype(act.dtype), bufs)
 
                 def skip_b():
                     return (jnp.zeros((), f32), jnp.zeros((maxP,), f32),
-                            zeros_shared(), zero_act)
+                            zeros_shared(), zero_act, bufs)
 
-                l_s, grow_s, gsh_s, gx_s = jax.lax.cond(
+                l_s, grow_s, gsh_s, gx_s, bufs = jax.lax.cond(
                     (stage == s) & valid_b, run_b, skip_b)
                 l_b = l_b + l_s
                 g_send = g_send + gx_s
@@ -349,14 +402,18 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
             fchan = jax.lax.ppermute(y_f, "pp", fwd_perm)
             gchan = jax.lax.ppermute(g_send, "pp", bwd_perm)
             return (buf, gbuf, fchan, gchan, loss_sum + l_b,
-                    gacc_row, gacc_sh), None
+                    gacc_row, gacc_sh, bufs, bufsnap), None
 
+        bufsnap0 = jax.tree_util.tree_map(
+            lambda b: jnp.zeros((W,) + jnp.shape(b),
+                                jnp.result_type(b)), buffers)
         carry0 = (jnp.zeros((W,) + act.shape, act.dtype),
                   jnp.zeros((W,) + act.shape, act.dtype),
                   zero_act, zero_act, jnp.zeros((), f32),
-                  jnp.zeros((maxP,), f32), zeros_shared())
-        (_, _, _, _, loss_sum, gacc_row, gacc_sh), _ = jax.lax.scan(
-            tick, carry0, jnp.arange(T))
+                  jnp.zeros((maxP,), f32), zeros_shared(), buffers,
+                  bufsnap0)
+        (_, _, _, _, loss_sum, gacc_row, gacc_sh, bufs_out, _), _ = \
+            jax.lax.scan(tick, carry0, jnp.arange(T))
 
         loss = jax.lax.psum(jnp.where(is_last, loss_sum / M, 0.0), "pp")
         # (1, 1, maxP): this (stage, mp-rank)'s own grads
@@ -372,23 +429,42 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
             loss = jax.lax.pmean(loss, "dp")
             grow = jax.lax.pmean(grow, "dp")
             gsh = {n: jax.lax.pmean(g, "dp") for n, g in gsh.items()}
-        return loss, grow, gsh
+
+        # buffer merge (reference: each pp rank owns its stage's BN stats):
+        # each device holds updates only for ITS stage's buffers (others
+        # untouched), so psum of deltas over 'pp' combines the disjoint
+        # stage updates; float stats average over dp (per-rank microdata
+        # differ) and mp (identical — pmean is a no-op value-wise).
+        def merge_buf(nb, b0):
+            d = nb - b0
+            d = jax.lax.psum(d, "pp")
+            if jnp.issubdtype(jnp.result_type(d), jnp.floating):
+                if has_dp:
+                    d = jax.lax.pmean(d, "dp")
+                if mp > 1:
+                    d = jax.lax.pmean(d, "mp")
+            return (b0 + d).astype(jnp.result_type(b0))
+
+        new_buffers = jax.tree_util.tree_map(merge_buf, bufs_out, buffers)
+        return loss, grow, gsh, new_buffers
 
     sh = jax.shard_map(
         sharded, mesh=mesh,
         in_specs=(row_spec, P(), P(), data_spec, data_spec),
-        out_specs=(P(), row_spec, P()), check_vma=False)
+        out_specs=(P(), row_spec, P(), P()), check_vma=False)
     jitted = jax.jit(sh)
 
     def step(params, buffers, x, y):
+        """-> (loss, grads, new_buffers); new_buffers carries the merged
+        per-microbatch BN-style running-stat updates of every stage."""
         prow = pack(params)
         shared = {n: params[n] for n in shared_names}
-        loss, grow, gsh = jitted(prow, shared, buffers, x, y)
+        loss, grow, gsh, new_buffers = jitted(prow, shared, buffers, x, y)
         grads = unpack_grads(grow)
         for n in shared_names:
             shape, dtype = pspec[n]
             grads[n] = gsh[n].astype(dtype)
-        return loss, grads
+        return loss, grads, new_buffers
 
     step.packed_bytes_per_device = maxP * 4
     step.replicated_param_bytes = sum(

@@ -265,11 +265,14 @@ class PipelineParallel(Layer):
             self._compiled_step = (key, step)
         step = self._compiled_step[1]
         params, buffers = functional_state(self._layers)
-        loss, grads = step(params, buffers, x._data, y._data)
+        loss, grads, new_buffers = step(params, buffers, x._data, y._data)
         named = dict(self._layers.named_parameters())
         for n, g in grads.items():
             p = named[n]
             p.grad = Tensor(g.astype(p._data.dtype))
+        for n, b in self._layers.named_buffers():
+            if n in new_buffers:
+                b._data = new_buffers[n]
         optimizer.step()
         optimizer.clear_grad()
         return Tensor(loss)

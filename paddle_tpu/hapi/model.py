@@ -245,11 +245,15 @@ class Model:
                 self.network, mesh, microbatches=micro,
                 schedule=self._strategy.get("schedule", "1f1b"))
         params, buffers = functional_state(self.network)
-        loss, grads = self._pp_step(params, buffers, in_raw[0], lab_raw[0])
+        loss, grads, new_buffers = self._pp_step(params, buffers,
+                                                 in_raw[0], lab_raw[0])
         named = dict(self.network.named_parameters())
         for n, g in grads.items():
             p = named[n]
             p.grad = Tensor(jnp.asarray(g, p._data.dtype))
+        for n, b in self.network.named_buffers():
+            if n in new_buffers:
+                b._data = new_buffers[n]
         self._optimizer.step()
         self._optimizer.clear_grad()
         return [float(np.asarray(loss))], []

@@ -132,7 +132,7 @@ def test_compiled_pipeline_shards_params_per_stage():
 
     # gradient parity vs plain value_and_grad on the same weights
     x, y = _data(n=16, d=16)
-    loss, grads = step(params, buffers, x._data, y._data)
+    loss, grads, _ = step(params, buffers, x._data, y._data)
 
     def ref_loss(p):
         from paddle_tpu.nn.layer.layers import functional_call
@@ -173,7 +173,7 @@ def test_compiled_pipeline_shared_layer_replicated():
     rng = np.random.RandomState(3)
     x = paddle.to_tensor(rng.rand(8, 8).astype("float32"))
     y = paddle.to_tensor(rng.rand(8, 8).astype("float32"))
-    loss, grads = step(params, buffers, x._data, y._data)
+    loss, grads, _ = step(params, buffers, x._data, y._data)
 
     def ref_loss(p):
         out, _ = functional_call(pl, p, buffers, args=(x,), train=True)
