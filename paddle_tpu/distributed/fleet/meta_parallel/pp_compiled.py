@@ -255,10 +255,17 @@ def make_compiled_pipeline_step(pl, mesh, microbatches, schedule="1f1b"):
 
     def sharded(prow, shared_params, buffers, x, y):
         # prow: (1, 1, maxP) local row of the packed per-(stage, mp-rank)
-        # param buffer. Tracing runs under axis_context(mp=...) when mp>1 so
-        # the fleet mp layers pick their manual-collective path.
+        # param buffer. Tracing runs under axis_context so the fleet mp
+        # layers pick their manual-collective path (mp) and SyncBatchNorm
+        # syncs its stats across the data-parallel replicas (dp) — the
+        # reference's sync_batch_norm allreduce inside pipeline training.
         from ... import env as dist_env
-        ctx = dist_env.axis_context(mp="mp") if mp > 1 else _nullcontext()
+        axes = {}
+        if mp > 1:
+            axes["mp"] = "mp"
+        if has_dp:
+            axes["dp"] = "dp"
+        ctx = dist_env.axis_context(**axes) if axes else _nullcontext()
         with ctx:
             return _sharded_body(prow, shared_params, buffers, x, y)
 

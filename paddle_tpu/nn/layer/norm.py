@@ -101,6 +101,14 @@ class SyncBatchNorm(_BatchNormBase):
         has_w = self.weight is not None
         has_b = self.bias is not None
 
+        # NB: raw lax.pmean is CORRECT here, unlike the Megatron mp
+        # collectives (mp_layers custom-vjp ops). Under dp, each rank's
+        # loss is a DISTINCT slice of the global loss, so the true stat
+        # cotangent is the SUM of per-rank cotangents — exactly what
+        # pmean's psum-based transpose produces (the reference
+        # sync_batch_norm_grad allreduces dy/dy*xhat the same way). The
+        # identity-backward form is only right when every rank carries the
+        # identical replicated loss (mp), where summing would overcount.
         def fn(a, *wb):
             mean = jax.lax.pmean(jnp.mean(a, axis=reduce_axes), axis)
             mean_sq = jax.lax.pmean(jnp.mean(a * a, axis=reduce_axes), axis)
@@ -118,8 +126,13 @@ class SyncBatchNorm(_BatchNormBase):
 
         args = [x] + ([self.weight] if has_w else []) + ([self.bias] if has_b else [])
         out, mean, var = apply_op(fn, *args)
+        # running-var stores the UNBIASED estimate with the GLOBAL count
+        # (local batch x dp replicas) — same convention as F.batch_norm
+        from ...distributed.env import axis_size
+        n_g = (x._data.size // x._data.shape[ch_axis]) * int(axis_size(axis))
+        unbiased = var._data * (n_g / max(n_g - 1, 1))
         rm._data = rm._data * momentum + mean._data * (1 - momentum)
-        rv._data = rv._data * momentum + var._data * (1 - momentum)
+        rv._data = rv._data * momentum + unbiased * (1 - momentum)
         return out
 
     @classmethod

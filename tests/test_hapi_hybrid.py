@@ -330,6 +330,87 @@ def test_pipeline_shared_layer_with_buffers_rejected(clean_mesh):
         make_compiled_pipeline_step(pl, dist_env.get_mesh(), microbatches=2)
 
 
+def test_sync_batch_norm_shard_map_grads(clean_mesh):
+    """SyncBatchNorm inside a dp-live shard_map: stats AND grads must equal
+    the full-batch single-device BN. Pins the RAW lax.pmean in the stat
+    path: its psum-based transpose SUMS the distinct per-rank stat
+    cotangents, which is correct under dp-sharded losses (an mp-style
+    identity-backward collective here would drop cross-rank terms — see
+    norm.py's comment)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer.layers import functional_call, functional_state
+
+    mesh = dist_env.build_mesh({"dp": 2})
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(6, 4), nn.SyncBatchNorm(4),
+                        nn.Linear(4, 2))
+    params, buffers = functional_state(net)
+    x = np.random.RandomState(0).rand(8, 6).astype("float32")
+
+    def loss_local(p, xx):
+        with dist_env.axis_context(dp="dp"):
+            out, _ = functional_call(net, p, buffers, args=(Tensor(xx),),
+                                     train=True)
+        return jnp.sum(out._data ** 2)
+
+    g = jax.jit(jax.shard_map(
+        lambda p, xx: jax.tree_util.tree_map(
+            lambda v: jax.lax.pmean(v, "dp"),
+            jax.grad(loss_local)(p, xx)),
+        mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(),
+        check_vma=False))(params, x)
+
+    # golden: full batch on one device (plain BN == synced sharded stats)
+    t = Tensor(jnp.asarray(x))
+    out = net(t)
+    (out ** 2).sum().backward()
+    for n, p in net.named_parameters():
+        # sharded loss is a sum of per-rank sums; pmean of grads = grad/2
+        np.testing.assert_allclose(2 * np.asarray(g[n]), p.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_pipeline_sync_bn_stats(clean_mesh):
+    """SyncBatchNorm inside a dp=2 x pp=2 compiled pipeline: dp is marked
+    live, so stats sync across replicas and the written-back buffers match
+    the serial full-microbatch golden."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_compiled import \
+        make_compiled_pipeline_step
+    from paddle_tpu.nn.layer.layers import functional_call, functional_state
+
+    dist_env.build_mesh({"dp": 2, "pp": 2})
+    paddle.seed(47)
+    descs = [LayerDesc(nn.Linear, 6, 8), LayerDesc(nn.SyncBatchNorm, 8),
+             LayerDesc(nn.ReLU), LayerDesc(nn.Linear, 8, 3)]
+    pl = PipelineLayer(descs, num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    mesh = dist_env.get_mesh()
+    M = 2
+    step = make_compiled_pipeline_step(pl, mesh, microbatches=M)
+    params, buffers = functional_state(pl)
+    rng = np.random.RandomState(2)
+    x = rng.rand(8, 6).astype("float32")
+    y = rng.randint(0, 3, 8)
+    loss, grads, new_buffers = step(params, buffers, x, y)
+
+    # serial golden: full microbatches through the stack (eager SyncBN
+    # falls back to plain BN == dp-synced sharded stats). NB microbatch m
+    # is the UNION of each dp shard's m-th slice (the batch dim shards
+    # over dp first, then microbatches within each shard).
+    g_buf = dict(buffers)
+    for m in range(M):
+        xm = np.concatenate([x[r * 4 + m * 2: r * 4 + (m + 1) * 2]
+                             for r in range(2)])
+        _, g_buf = functional_call(
+            pl, params, g_buf, args=(paddle.to_tensor(xm),), train=True)
+    for n in new_buffers:
+        np.testing.assert_allclose(np.asarray(new_buffers[n]),
+                                   np.asarray(g_buf[n]), rtol=1e-4,
+                                   atol=1e-5, err_msg=n)
+
+
 def test_row_parallel_input_split_grads(clean_mesh):
     """RowParallelLinear(input_is_parallel=False): the input split must be
     transpose-safe (_c_split_manual) — upstream replicated params get the
