@@ -1,0 +1,35 @@
+#!/bin/bash
+# One-shot TPU capture: run the full perf sequence the moment the axon
+# grant is healthy. Each step is independently wall-clock bounded and
+# writes to /tmp/tpu_capture/. Run from /root/repo with the DEFAULT env
+# (JAX_PLATFORMS=axon).
+set -u
+OUT=${1:-/tmp/tpu_capture}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+if ! timeout 150 python -c "import jax; print(jax.default_backend())" \
+        > "$OUT/probe.txt" 2>&1; then
+    echo "backend still wedged; aborting (see $OUT/probe.txt)"
+    exit 1
+fi
+cat "$OUT/probe.txt"
+
+echo "== bench (ladder, scan-K) =="
+BENCH_INIT_BUDGET_S=300 timeout 2400 python bench.py \
+    > "$OUT/bench.json" 2> "$OUT/bench.err"
+cat "$OUT/bench.json"
+
+echo "== eager bench =="
+BENCH_INIT_BUDGET_S=300 timeout 1200 python bench_eager.py \
+    > "$OUT/bench_eager.json" 2> "$OUT/bench_eager.err"
+cat "$OUT/bench_eager.json"
+
+echo "== profile sweep =="
+BENCH_INIT_BUDGET_S=300 PADDLE_TPU_AUTOTUNE_CACHE="$OUT/flash_blocks.json" \
+    timeout 3600 python tools/profile_step.py \
+    > "$OUT/profile.md" 2> "$OUT/profile.err"
+cat "$OUT/profile.md"
+
+echo "== done; artifacts in $OUT =="
