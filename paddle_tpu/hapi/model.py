@@ -108,12 +108,16 @@ class Model:
             data = NamedSharding(mesh, P("dp")) \
                 if "dp" in mesh.shape and mesh.shape["dp"] > 1 else repl
             param_shardings = self._param_shardings(mesh)
+            # donate params/buffers/opt_state: the step returns their
+            # successors and train_batch writes them back, so the inputs'
+            # HBM is reusable in-place (halves peak param memory)
             return jax.jit(train_step,
                            in_shardings=(param_shardings, repl, repl, repl,
                                          repl, data, data),
                            out_shardings=(repl, param_shardings, repl,
-                                          repl, repl))
-        return jax.jit(train_step)
+                                          repl, repl),
+                           donate_argnums=(0, 1, 2))
+        return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     def _param_shardings(self, mesh):
         """Per-param NamedSharding pytree: split_axis-marked params (fleet
