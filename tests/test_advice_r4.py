@@ -203,6 +203,27 @@ def test_teacher_student_sigmoid_loss_integer_labels_backward():
                                rtol=1e-5)
 
 
+def test_tss_custom_vjp_matches_finite_differences():
+    """The hand-written VJP must equal numeric grads where the forward is
+    differentiable (inside the soft_max bounds, away from the label-band
+    edges)."""
+    from op_test import numeric_grad
+    xs = np.array([0.5, -1.2, 2.3, -0.4], "float32")
+    labs = np.array([-2.0, -1.5, 0.4, 1.7], "float32")
+
+    def fn(x):
+        return F.teacher_student_sigmoid_loss(
+            x, paddle.to_tensor(labs)).sum()
+
+    x_t = paddle.to_tensor(xs)
+    x_t.stop_gradient = False
+    loss = fn(x_t)
+    loss.backward()
+    analytic = x_t.grad.numpy()
+    numeric = numeric_grad(lambda t: fn(t), [paddle.to_tensor(xs)], 0)
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)
+
+
 def test_tss_op_identity_is_stable_for_eager_cache():
     from paddle_tpu.nn.functional.loss import _tss_op
     assert _tss_op(-15.0, 15.0) is _tss_op(-15.0, 15.0)
