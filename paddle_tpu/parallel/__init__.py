@@ -9,4 +9,4 @@ __graft_entry__.dryrun_multichip.
 from .gpt_spmd import (  # noqa: F401
     GPTSpmdConfig, MeshPlan, init_gpt_params, make_train_step, make_forward_fn,
 )
-from .ring_attention import ring_attention  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401

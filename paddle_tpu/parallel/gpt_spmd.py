@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .pipeline_schedule import (arrival_tables, build_interleaved_tables,
                                 build_tables, required_slots)
-from .ring_attention import ring_attention
+from .ring_attention import ring_attention, ulysses_attention
 
 AXES = ("dp", "pp", "sharding", "sp", "mp")
 
@@ -71,6 +71,15 @@ class MeshPlan:
     # schedule; activation memory grows with microbatches — comparison only)
     schedule: str = "1f1b"
     vpp: int = 1                     # interleaved virtual stages per device
+    # sequence-parallel attention flavor: "ring" (K/V ppermute rotation,
+    # O(S/sp) residency) or "ulysses" (head<->seq all-to-all, full-S local
+    # attention — fewer/larger ICI transfers, flash-kernel friendly)
+    sp_mode: str = "ring"
+
+    def __post_init__(self):
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sp_mode {self.sp_mode!r}; use 'ring' or 'ulysses'")
 
     @property
     def dims(self):
@@ -229,13 +238,20 @@ def _attention(h, blk, cfg, plan):
         # Inside the 1F1B/interleaved tick body, stage compute is gated by
         # lax.cond on the (t, stage)-dependent tick table. XLA lowers
         # ppermute to CollectivePermute, a FULL-participation op (every
-        # device must execute it, pairs or not), so the ring's ppermute
-        # inside stage-divergent branches deadlocks the mesh. all_gather and
-        # psum are group-scoped (replica_groups) and legal there, so pp+sp
-        # uses all-gather sequence parallelism instead of the ring.
-        o = _allgather_sp_attention(q, k, v, causal=True)
+        # device must execute it, pairs or not), so the RING's ppermute
+        # inside stage-divergent branches deadlocks the mesh. all_gather,
+        # all_to_all and psum are group-scoped (replica_groups) and legal
+        # there — so pp+sp honors sp_mode="ulysses" and otherwise uses
+        # all-gather sequence parallelism instead of the ring.
+        if plan.sp_mode == "ulysses":
+            o = ulysses_attention(q, k, v, "sp", causal=True)
+        else:
+            o = _allgather_sp_attention(q, k, v, causal=True)
     elif plan.sp > 1:
-        o = ring_attention(q, k, v, "sp", causal=True)
+        if plan.sp_mode == "ulysses":
+            o = ulysses_attention(q, k, v, "sp", causal=True)
+        else:
+            o = ring_attention(q, k, v, "sp", causal=True)
     else:
         from ..ops.flash_attention import flash_attention_bhsd
         o = flash_attention_bhsd(q, k, v, causal=True)

@@ -83,3 +83,54 @@ def ring_attention_bshd(q, k, v, axis_name, causal=True, scale=None):
     """(B, S, H, D) wrapper matching paddle's MHA layout."""
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     return jnp.swapaxes(ring_attention(qt, kt, vt, axis_name, causal, scale), 1, 2)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=True, scale=None,
+                      attn_fn=None):
+    """Ulysses-style sequence parallelism (also NET-NEW vs the reference):
+    one all-to-all re-shards each of q/k/v from sequence-sharded
+    (B, H, S/sp, D) to head-sharded (B, H/sp, S, D), full-sequence
+    attention runs locally per head group, and one all-to-all restores the
+    sequence sharding (DeepSpeed-Ulysses; Jacobs et al. 2023).
+
+    Trade-off vs ring_attention: 2x4 all-to-alls of activation size instead
+    of (sp-1) K/V ppermute rounds — fewer, larger ICI transfers and the
+    full-length attention can use the Pallas flash kernel (`attn_fn`
+    defaults to the flash dispatch); requires H % sp == 0, and each device
+    briefly holds S_full x H/sp activations.
+
+    Call INSIDE shard_map with q/k/v sequence-sharded (B, H, S_loc, D).
+    """
+    B, H, S_loc, D = q.shape
+    n = jax.lax.axis_size(axis_name)
+    if H % n:
+        raise ValueError(f"ulysses_attention needs heads ({H}) divisible "
+                         f"by the sp axis size ({n})")
+    if attn_fn is None:
+        from ..ops.flash_attention import flash_attention_bhsd
+
+        def attn_fn(q, k, v):
+            return flash_attention_bhsd(q, k, v, causal=causal, scale=scale)
+
+    # seq-sharded -> head-sharded: split the head dim across the axis,
+    # gather the sequence dim. q/k/v ride ONE fused tiled all_to_all: ICI
+    # collectives are latency-bound at these shard sizes, so one launch
+    # beats three of the same total bytes. all_to_all hands rank r the
+    # CONTIGUOUS r-th chunk of the split axis, so the stack interleaves
+    # per-rank chunks as [q_r | k_r | v_r] blocks (a plain concat would
+    # scramble q/k/v across ranks).
+    h_loc = H // n
+
+    def chunks(t):                                   # (B,H,S_loc,D) ->
+        return t.reshape(B, n, h_loc, S_loc, D)      # (B,n,h_loc,S_loc,D)
+
+    qkv = jnp.concatenate([chunks(q), chunks(k), chunks(v)], axis=2)
+    qkv = qkv.reshape(B, 3 * H, S_loc, D)            # [r][q|k|v][h_loc]
+    qkv_h = jax.lax.all_to_all(qkv, axis_name, split_axis=1, concat_axis=2,
+                               tiled=True)           # (B, 3*h_loc, S, D)
+    qh = qkv_h[:, :h_loc]
+    kh = qkv_h[:, h_loc:2 * h_loc]
+    vh = qkv_h[:, 2 * h_loc:]
+    out = attn_fn(qh, kh, vh)                        # (B, h_loc, S, D)
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)            # (B, H, S_loc, D)
