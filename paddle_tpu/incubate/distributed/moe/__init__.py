@@ -13,6 +13,8 @@ compiled program, riding ICI instead of NCCL.
 from .functional import gshard_dispatch, moe_forward, init_moe_experts
 from .gate import GShardGate, SwitchGate, NaiveGate
 from .moe_layer import MoELayer
+from .grad_clip import ClipGradForMOEByGlobalNorm
 
-__all__ = ["gshard_dispatch", "moe_forward", "init_moe_experts",
+__all__ = ["ClipGradForMOEByGlobalNorm",
+           "gshard_dispatch", "moe_forward", "init_moe_experts",
            "GShardGate", "SwitchGate", "NaiveGate", "MoELayer"]

@@ -1,0 +1,68 @@
+"""MoE-aware global-norm gradient clip.
+
+Reference: python/paddle/incubate/distributed/models/moe/grad_clip.py
+ClipGradForMOEByGlobalNorm — the global norm must count every expert's
+gradient exactly once: expert params live only on their owning ep rank,
+so their squared norms are all-reduced over the moe group and added to
+the (replicated) non-expert norm before the clip ratio is computed.
+
+TPU-native: eager single-controller by default (expert stacks live in one
+process); when a moe group / live 'ep' axis exists the expert norm rides
+`paddle.distributed.all_reduce` (a cached compiled world/axis program).
+"""
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....nn.clip import ClipGradBase
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        self.clip_norm = float(clip_norm)
+        self.moe_group = moe_group
+        if moe_group is not None and getattr(moe_group, "nranks", 1) > 1 \
+                and is_expert_param_func is None:
+            raise AssertionError(
+                "is_expert_param_func is required when moe_group spans "
+                "multiple ranks")
+        self.is_expert_param_func = is_expert_param_func
+        self.group_name = group_name
+
+    def _is_expert(self, p):
+        if self.is_expert_param_func is not None:
+            return bool(self.is_expert_param_func(p))
+        return bool(getattr(p, "is_expert", False))
+
+    def __call__(self, params_grads):
+        normal_sq, expert_sq = [], []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            sq = jnp.sum(g._data.astype(jnp.float32) ** 2)
+            (expert_sq if self._is_expert(p) else normal_sq).append(sq)
+        if not normal_sq and not expert_sq:
+            return params_grads
+
+        norm_sq = sum(normal_sq) if normal_sq else jnp.zeros((), jnp.float32)
+        if expert_sq:
+            e = sum(expert_sq)
+            if self.moe_group is not None and \
+                    getattr(self.moe_group, "nranks", 1) > 1:
+                from ....distributed import collective
+                t = Tensor(e)
+                collective.all_reduce(t, group=self.moe_group)
+                e = t._data
+            norm_sq = norm_sq + e
+
+        global_norm = jnp.sqrt(norm_sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out

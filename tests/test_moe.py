@@ -119,6 +119,32 @@ def test_expert_parallel_grads():
                                    err_msg=f"grad {name}")
 
 
+def test_moe_grad_clip():
+    """ClipGradForMOEByGlobalNorm (reference moe/grad_clip.py): expert +
+    non-expert squared norms combine into one global norm; with no expert
+    separation it equals the plain global-norm clip."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.distributed.moe import ClipGradForMOEByGlobalNorm
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    rng = np.random.RandomState(0)
+    ps, gs = [], []
+    for i, shape in enumerate([(4, 4), (8,), (3, 5)]):
+        ps.append(paddle.to_tensor(rng.rand(*shape).astype("float32")))
+        gs.append(paddle.to_tensor(rng.rand(*shape).astype("float32") * 3))
+    pairs = list(zip(ps, gs))
+
+    clipped = ClipGradForMOEByGlobalNorm(
+        1.0, is_expert_param_func=lambda p: p is ps[2])(pairs)
+    ref = ClipGradByGlobalNorm(1.0)(pairs)
+    for (_, a), (_, b) in zip(clipped, ref):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+    # clipped global norm == clip_norm when the raw norm exceeds it
+    total = np.sqrt(sum(float((g.numpy() ** 2).sum())
+                        for _, g in clipped))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
 def test_eager_moe_layer_trains():
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
