@@ -67,11 +67,13 @@ def _bind_eqn(prim, invals, params):
 
 def _default_eval(eqn, invals, rule):
     """Default evaluation of an unmatched equation. Passes see THROUGH
-    jit/remat blocks (like reference ir passes see the whole graph,
+    higher-order blocks (like reference ir passes see the whole graph,
     ir/graph.h): pjit bodies are inlined-and-rewritten, remat2 bodies are
-    rewritten and re-wrapped in jax.checkpoint so the tag survives.
-    Other higher-order ops (scan/while/cond/custom_*) are re-bound opaquely
-    — rules do not see inside them."""
+    rewritten and re-wrapped in jax.checkpoint so the tag survives, scan
+    bodies are rewritten and re-scanned (captured models stack layers in
+    scans), cond branches are rewritten under lax.switch. while_loop and
+    custom_jvp/vjp calls are re-bound opaquely — rules do not see inside
+    them."""
     name = eqn.primitive.name
     if name == "remat2":
         inner = eqn.params["jaxpr"]
@@ -86,6 +88,35 @@ def _default_eval(eqn, invals, rule):
     if name == "pjit" and "jaxpr" in eqn.params:
         closed = eqn.params["jaxpr"]
         return _eval_with_rule(closed.jaxpr, closed.consts, rule, invals)
+    if name == "scan":
+        # captured models stack layers in ONE scan (transformer blocks);
+        # passes must see inside it or they miss most of the model's FLOPs
+        inner = eqn.params["jaxpr"]
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        consts = tuple(invals[:nc])
+        carry0 = tuple(invals[nc:nc + ncar])
+        xs = tuple(invals[nc + ncar:])
+
+        def body(c, x):
+            outs = _eval_with_rule(inner.jaxpr, inner.consts, rule,
+                                   consts + tuple(c) + tuple(x))
+            return tuple(outs[:ncar]), tuple(outs[ncar:])
+
+        carry_out, ys = jax.lax.scan(
+            body, carry0, xs if xs else None,
+            length=eqn.params.get("length"),
+            reverse=eqn.params.get("reverse", False),
+            unroll=eqn.params.get("unroll", 1))
+        return list(carry_out) + list(ys)
+    if name == "cond":
+        idx, *ops = invals
+        branches = eqn.params["branches"]
+
+        def mk(b):
+            return lambda *xs: _eval_with_rule(b.jaxpr, b.consts, rule, xs)
+
+        return list(jax.lax.switch(idx, [mk(b) for b in branches], *ops))
     out = _bind_eqn(eqn.primitive, invals, eqn.params)
     return list(out) if eqn.primitive.multiple_results else [out]
 

@@ -163,6 +163,58 @@ def test_unknown_pass_still_raises():
         dist_passes.new_pass("definitely_not_a_pass").apply(object())
 
 
+def test_passes_see_inside_scan_and_cond():
+    """Captured transformer-style programs stack layers in lax.scan; the
+    amp pass must rewrite the dots INSIDE the scan body (and cond
+    branches) or it misses most of the model."""
+    import jax
+    import jax.numpy as jnp
+
+    w = np.random.RandomState(0).randn(4, 8, 8).astype("float32") * 0.3
+
+    def fn(x):
+        def body(h, wi):
+            return jnp.tanh(h @ wi), ()
+
+        h, _ = jax.lax.scan(body, x._data, jnp.asarray(w))
+        out = jax.lax.cond(jnp.sum(h) > 0,
+                           lambda v: v @ jnp.ones((8, 8), "float32"),
+                           lambda v: v, h)
+        from paddle_tpu.core.tensor import Tensor
+        return Tensor(out)
+
+    prog = static.Program.capture(fn, static.InputSpec((2, 8), "float32"))
+    x = np.random.RandomState(1).randn(2, 8).astype("float32")
+    golden = np.asarray(prog.run_captured(x)[0])
+    before = prog.to_string()
+    assert "scan" in before and "bf16" not in before
+
+    # amp reaches the dots inside the scan body (IR-level check; XLA CPU
+    # cannot EXECUTE bf16 dots inside a compiled loop, so numerics for amp
+    # are covered by the flat-program test above)
+    import copy
+    amp_prog = copy.copy(prog)
+    amp_prog._jaxpr = prog._jaxpr
+    dist_passes.new_pass("amp").apply(amp_prog)
+    assert "bf16" in amp_prog.to_string()
+
+    # execution parity through a semantic rewrite inside scan + cond:
+    # replace tanh with clip — output must change but stay bounded-close
+    @register_pass("scan_hard_tanh")
+    def scan_ht(op, attrs):
+        if op.name != "tanh":
+            return None
+        import jax.numpy as jnp
+        return [jnp.clip(op.inputs[0], -1.0, 1.0)]
+
+    dist_passes.new_pass("scan_hard_tanh").apply(prog)
+    after = prog.to_string()
+    assert "tanh" not in after
+    got = np.asarray(prog.run_captured(x)[0])
+    assert not np.allclose(got, golden)        # rewrite really applied
+    np.testing.assert_allclose(got, golden, atol=0.6)   # same ballpark
+
+
 def test_executor_runs_captured_and_rewritten_program():
     """Reference UX: exe.run(program, feed={...}) over a captured (and
     pass-rewritten) Program."""
