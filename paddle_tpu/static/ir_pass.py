@@ -71,9 +71,9 @@ def _default_eval(eqn, invals, rule):
     ir/graph.h): pjit bodies are inlined-and-rewritten, remat2 bodies are
     rewritten and re-wrapped in jax.checkpoint so the tag survives, scan
     bodies are rewritten and re-scanned (captured models stack layers in
-    scans), cond branches are rewritten under lax.switch. while_loop and
-    custom_jvp/vjp calls are re-bound opaquely — rules do not see inside
-    them."""
+    scans), cond branches are rewritten under lax.switch, while_loop
+    cond/body rewrite and re-loop. custom_jvp/vjp calls are re-bound
+    opaquely — rules do not see inside them."""
     name = eqn.primitive.name
     if name == "remat2":
         inner = eqn.params["jaxpr"]
@@ -117,6 +117,24 @@ def _default_eval(eqn, invals, rule):
             return lambda *xs: _eval_with_rule(b.jaxpr, b.consts, rule, xs)
 
         return list(jax.lax.switch(idx, [mk(b) for b in branches], *ops))
+    if name == "while":
+        cj = eqn.params["cond_jaxpr"]
+        bj = eqn.params["body_jaxpr"]
+        cn = eqn.params["cond_nconsts"]
+        bn = eqn.params["body_nconsts"]
+        cconsts = tuple(invals[:cn])
+        bconsts = tuple(invals[cn:cn + bn])
+        init = tuple(invals[cn + bn:])
+
+        def cond_f(carry):
+            return _eval_with_rule(cj.jaxpr, cj.consts, rule,
+                                   cconsts + tuple(carry))[0]
+
+        def body_f(carry):
+            return tuple(_eval_with_rule(bj.jaxpr, bj.consts, rule,
+                                         bconsts + tuple(carry)))
+
+        return list(jax.lax.while_loop(cond_f, body_f, init))
     out = _bind_eqn(eqn.primitive, invals, eqn.params)
     return list(out) if eqn.primitive.multiple_results else [out]
 

@@ -215,6 +215,47 @@ def test_passes_see_inside_scan_and_cond():
     np.testing.assert_allclose(got, golden, atol=0.6)   # same ballpark
 
 
+def test_passes_see_inside_while_loop():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        from paddle_tpu.core.tensor import Tensor
+
+        def cond(c):
+            i, h = c
+            return i < 3
+
+        def body(c):
+            i, h = c
+            return i + 1, jnp.tanh(h * 2.0)
+
+        _, h = jax.lax.while_loop(cond, body, (jnp.int32(0), x._data))
+        return Tensor(h)
+
+    prog = static.Program.capture(fn, static.InputSpec((4,), "float32"))
+    assert "while" in prog.to_string()
+    x = np.array([-2.0, -0.1, 0.1, 2.0], "float32")
+    golden = np.asarray(prog.run_captured(x)[0])
+
+    @register_pass("while_hard_tanh")
+    def wht(op, attrs):
+        import jax.numpy as jnp
+        if op.name != "tanh":
+            return None
+        return [jnp.clip(op.inputs[0], -1.0, 1.0)]
+
+    dist_passes.new_pass("while_hard_tanh").apply(prog)
+    assert "tanh" not in prog.to_string()
+    got = np.asarray(prog.run_captured(x)[0])
+    # hard-tanh(3 iters): values clamp to exactly ±1 vs tanh's asymptote
+    expect = x
+    for _ in range(3):
+        expect = np.clip(expect * 2.0, -1.0, 1.0)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+    assert not np.allclose(got, golden)
+
+
 def test_executor_runs_captured_and_rewritten_program():
     """Reference UX: exe.run(program, feed={...}) over a captured (and
     pass-rewritten) Program."""
