@@ -184,7 +184,8 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k):
         vocab_size=50304, max_seq_len=S, hidden=1024, layers=24, heads=16,
         param_dtype="bfloat16" if on_tpu else "float32",
         compute_dtype="bfloat16" if on_tpu else "float32",
-        remat={"none": False, "full": True, "dots": "dots"}[remat])
+        remat={"none": False, "full": True, "dots": "dots",
+               "dots+attn": "dots+attn"}[remat])
 
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=2e-4)
@@ -304,7 +305,8 @@ def main():
     # step-down ladder for the 16GB chip: try fastest configs first.
     # (B=16 was measured OOM for both none and dots remat on 16GB — r2/r3;
     # B=12 is untried and worth one compile: +50% tokens/step if it fits.)
-    ladder = [(12, "dots"), (8, "dots"), (8, "full"), (4, "full"),
+    ladder = [(12, "dots+attn"), (12, "dots"), (8, "dots+attn"),
+              (8, "dots"), (8, "full"), (4, "full"),
               (2, "full")]
     last_err = None
     for B, remat in ladder:

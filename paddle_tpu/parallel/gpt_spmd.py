@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .pipeline_schedule import (arrival_tables, build_interleaved_tables,
@@ -47,8 +48,12 @@ class GPTSpmdConfig:
     param_dtype: str = "float32"     # storage dtype ("bfloat16" for bench)
     compute_dtype: str = "float32"   # activation dtype
     # remat: False = none, True = full per-block checkpoint (max HBM saving),
-    # "dots" = save matmul outputs, recompute elementwise (best MFU/HBM trade
-    # on TPU: recompute is cheap VPU work, the MXU results are kept)
+    # "dots" = save matmul outputs, recompute elementwise (recompute is cheap
+    # VPU work, the MXU results are kept), "dots+attn" = dots AND the flash
+    # attention output: flash is a custom_vjp whose bwd kernel recomputes
+    # attention internally, so letting block-level remat recompute its fwd
+    # pays the attention FLOPs a third time — saving the (B,S,H) output
+    # (16 MB/layer at the bench shape) skips that (best MFU/HBM trade on TPU)
     remat: object = True
     init_std: float = 0.02
 
@@ -255,6 +260,7 @@ def _attention(h, blk, cfg, plan):
     else:
         from ..ops.flash_attention import flash_attention_bhsd
         o = flash_attention_bhsd(q, k, v, causal=True)
+    o = checkpoint_name(o, "flash_out")
     o = jnp.moveaxis(o, 1, 2).reshape(B, S, cfg.hidden // plan.mp)
     out = o @ blk["w_proj"]                        # partial sums over mp
     if plan.mp > 1:
@@ -289,6 +295,12 @@ def _stage_blocks(h, params, cfg, plan):
         apply_block = jax.checkpoint(
             apply_block,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif cfg.remat == "dots+attn":
+        apply_block = jax.checkpoint(
+            apply_block,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names("flash_out")))
     elif cfg.remat:
         apply_block = jax.checkpoint(apply_block)
 

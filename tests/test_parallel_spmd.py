@@ -54,6 +54,16 @@ def test_dp_matches_golden(golden):
     np.testing.assert_allclose(losses, golden, rtol=2e-4)
 
 
+@pytest.mark.parametrize("remat", [True, "dots", "dots+attn"])
+def test_remat_modes_match_golden(golden, remat):
+    """Rematerialization must never change values, only the recompute
+    schedule — every mode reproduces the no-remat golden exactly."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, remat=remat)
+    losses = run_steps(MeshPlan(), cfg=cfg)
+    np.testing.assert_allclose(losses, golden, rtol=1e-6)
+
+
 def test_mp_matches_golden(golden):
     losses = run_steps(MeshPlan(mp=4))
     np.testing.assert_allclose(losses, golden, rtol=2e-4)

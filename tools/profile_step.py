@@ -63,7 +63,8 @@ def build(B, S, remat, lr=2e-4):
         heads=16 if on_tpu else 4,
         param_dtype="bfloat16" if on_tpu else "float32",
         compute_dtype="bfloat16" if on_tpu else "float32",
-        remat={"none": False, "full": True, "dots": "dots"}[remat])
+        remat={"none": False, "full": True, "dots": "dots",
+               "dots+attn": "dots+attn"}[remat])
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=lr)
     params, state = init_fn(jax.random.key(0))
@@ -92,7 +93,7 @@ def step_mfu(B, S, remat, scan_k=10, n=3):
                                       length=scan_k)
         return losses[-1], p, s
 
-    fn = jax.jit(multi)
+    fn = jax.jit(multi, donate_argnums=(0, 1))
     loss, params, state = fn(params, state)
     _sync(loss)
     ts = []
@@ -211,79 +212,144 @@ def flash_blocks_sweep(B, S, H=16, D=64):
     return results
 
 
-def main():
-    import bench
-    backend = bench.probe_backend(float(os.environ.get(
-        "BENCH_INIT_BUDGET_S", 600)))
-    wd = bench.start_watchdog(
-        300, "in-process jax backend init",
-        on_fire=lambda err: print(f"| watchdog | {err} |"))
+def _reclaim():
+    """Free device memory between experiments: exception tracebacks pin
+    buffers (observed: a failed B=12 run OOM'd every later experiment),
+    so clear the last-exception state, the jit caches, and collect."""
+    import gc
     import jax
-    assert jax.default_backend() == backend
-    wd.cancel()
-    on_tpu = backend == "tpu"
-    quick = "--quick" in sys.argv
-    B, S = (8, 1024) if on_tpu else (2, 128)
+    sys.last_exc = sys.last_value = sys.last_traceback = None
+    jax.clear_caches()
+    gc.collect()
 
-    print(f"## profile_step on {backend} (B={B}, S={S})\n")
-    print("| experiment | result |")
-    print("|---|---|")
 
-    ms, mfu = step_mfu(B, S, "dots", scan_k=10 if on_tpu else 2)
-    print(f"| full step B={B} remat=dots | {ms:.1f} ms/step, "
-          f"MFU {mfu:.3f} |")
+def section(label, fn):
+    """Run one experiment section; a failure prints a row, never aborts
+    the sweep."""
+    try:
+        fn()
+    except Exception as e:                                   # noqa: BLE001
+        print(f"| {label} | fail: {str(e)[:80]} |")
+    finally:
+        _reclaim()
 
+
+def _experiments(B, S, on_tpu, quick):
+    """Ordered (name, fn) list; each fn prints its own row(s)."""
+    exps = []
+
+    def full(remat, BB=B):
+        def run():
+            ms, mfu = step_mfu(BB, S, remat, scan_k=10 if on_tpu else 2)
+            print(f"| full step B={BB} remat={remat} | {ms:.1f} ms/step, "
+                  f"MFU {mfu:.3f} |", flush=True)
+        return run
+
+    exps.append(("dots", full("dots")))
     if not quick:
-        for remat in ("none", "full"):
-            try:
-                ms2, mfu2 = step_mfu(B, S, remat,
-                                     scan_k=10 if on_tpu else 2)
-                print(f"| full step B={B} remat={remat} | {ms2:.1f} ms/step, "
-                      f"MFU {mfu2:.3f} |")
-            except Exception as e:                           # noqa: BLE001
-                print(f"| full step B={B} remat={remat} | "
-                      f"fail: {str(e)[:80]} |")
+        for remat in ("none", "full", "dots+attn"):
+            exps.append((remat, full(remat)))
         if on_tpu:
-            try:
-                ms3, mfu3 = step_mfu(12, S, "dots", scan_k=10)
-                print(f"| full step B=12 remat=dots | {ms3:.1f} ms/step, "
-                      f"MFU {mfu3:.3f} |")
-            except Exception as e:                           # noqa: BLE001
-                print(f"| full step B=12 remat=dots | "
-                      f"fail: {str(e)[:80]} |")
+            exps.append(("b12", full("dots", 12)))
+            exps.append(("b12attn", full("dots+attn", 12)))
 
-    for name, ms_i in decompose(B, S, "dots"):
-        print(f"| {name} | {ms_i:.1f} ms |")
+    def run_decompose():
+        for name, ms_i in decompose(B, S, "dots"):
+            print(f"| {name} | {ms_i:.1f} ms |", flush=True)
+
+    exps.append(("decompose", run_decompose))
 
     if on_tpu and not quick:
-        tp, tx = flash_ab(B, S)
-        print(f"| flash fwd+bwd Pallas | {tp:.1f} ms |")
-        print(f"| flash fwd+bwd XLA fallback | {tx:.1f} ms |")
-        # whole-model A/B through the dispatch switch (not just the kernel)
-        os.environ["PADDLE_TPU_DISABLE_PALLAS_FLASH"] = "1"
-        try:
-            ms4, mfu4 = step_mfu(B, S, "dots", scan_k=10)
-            print(f"| full step B={B} remat=dots XLA-attention | "
-                  f"{ms4:.1f} ms/step, MFU {mfu4:.3f} |")
-        except Exception as e:                               # noqa: BLE001
-            print(f"| full step XLA-attention | fail: {str(e)[:80]} |")
-        finally:
-            del os.environ["PADDLE_TPU_DISABLE_PALLAS_FLASH"]
-        for blocks, t in flash_blocks_sweep(B, S):
-            t_s = f"{t:.1f} ms" if isinstance(t, float) else t
-            print(f"| flash blocks bq={blocks[0]} bk={blocks[1]} | {t_s} |")
+        def run_flash_ab():
+            tp, tx = flash_ab(B, S)
+            print(f"| flash fwd+bwd Pallas | {tp:.1f} ms |")
+            print(f"| flash fwd+bwd XLA fallback | {tx:.1f} ms |", flush=True)
 
-    xdir = os.environ.get("XPLANE")
-    if xdir:
-        cfgB = (B, S, "dots")
+        exps.append(("flash_ab", run_flash_ab))
+
+        # whole-model A/B through the dispatch switch (not just the kernel)
+        def run_xla_attn():
+            os.environ["PADDLE_TPU_DISABLE_PALLAS_FLASH"] = "1"
+            try:
+                ms4, mfu4 = step_mfu(B, S, "dots", scan_k=10)
+                print(f"| full step B={B} remat=dots XLA-attention | "
+                      f"{ms4:.1f} ms/step, MFU {mfu4:.3f} |", flush=True)
+            finally:
+                del os.environ["PADDLE_TPU_DISABLE_PALLAS_FLASH"]
+
+        exps.append(("xla_attn", run_xla_attn))
+
+        def run_sweep():
+            for blocks, t in flash_blocks_sweep(B, S):
+                t_s = f"{t:.1f} ms" if isinstance(t, float) else t
+                print(f"| flash blocks bq={blocks[0]} bk={blocks[1]} | "
+                      f"{t_s} |", flush=True)
+
+        exps.append(("sweep", run_sweep))
+
+    def run_xplane():
+        xdir = os.environ.get("XPLANE")
+        if not xdir:
+            return
+        import jax
         import jax.numpy as jnp
-        cfg, plan, step_fn, params, state, toks, labs, _ = build(*cfgB)
+        cfg, plan, step_fn, params, state, toks, labs, _ = \
+            build(B, S, "dots")
         lr = jnp.float32(2e-4)
+        loss, params, state = step_fn(params, state, toks, labs, lr)
+        _sync(loss)                                    # compile untraced
         with jax.profiler.trace(xdir):
             for _ in range(3):
                 loss, params, state = step_fn(params, state, toks, labs, lr)
             _sync(loss)
-        print(f"\nXPlane trace captured to {xdir}")
+        print(f"| xplane | trace captured to {xdir} |", flush=True)
+
+    if os.environ.get("XPLANE"):
+        exps.append(("xplane", run_xplane))
+    return exps
+
+
+def main():
+    """Each experiment runs in its OWN subprocess with a hard timeout: a
+    wedged tunnel request (observed r4: one remote_compile hung >30 min,
+    stalling the whole in-process sweep) or an OOM can only cost its own
+    experiment. `--one NAME` is the child entry point."""
+    quick = "--quick" in sys.argv
+    one = sys.argv[sys.argv.index("--one") + 1] if "--one" in sys.argv \
+        else None
+
+    import bench
+    backend = os.environ.get("PROFILE_BACKEND") or bench.probe_backend(
+        float(os.environ.get("BENCH_INIT_BUDGET_S", 600)))
+    on_tpu = backend == "tpu"
+    B, S = (8, 1024) if on_tpu else (2, 128)
+
+    if one is not None:
+        wd = bench.start_watchdog(
+            280, "in-process jax backend init",
+            on_fire=lambda err: print(f"| {one} | fail: {err} |", flush=True))
+        import jax
+        assert jax.default_backend() == backend
+        wd.cancel()
+        section(one, dict(_experiments(B, S, on_tpu, quick))[one])
+        return
+
+    print(f"## profile_step on {backend} (B={B}, S={S})\n", flush=True)
+    print("| experiment | result |")
+    print("|---|---|", flush=True)
+    per_exp_s = float(os.environ.get("PROFILE_EXP_BUDGET_S", 900))
+    import subprocess
+    env = dict(os.environ, PROFILE_BACKEND=backend)
+    for name, _ in _experiments(B, S, on_tpu, quick):
+        argv = [sys.executable, "-u", os.path.abspath(__file__),
+                "--one", name]
+        if quick:
+            argv.append("--quick")
+        try:
+            subprocess.run(argv, timeout=per_exp_s, env=env)
+        except subprocess.TimeoutExpired:
+            print(f"| {name} | fail: wall-clock budget {per_exp_s:.0f}s "
+                  "exceeded (wedged tunnel request?) |", flush=True)
 
 
 if __name__ == "__main__":

@@ -27,8 +27,9 @@ BENCH_INIT_BUDGET_S=300 timeout 1200 python bench_eager.py \
 cat "$OUT/bench_eager.json"
 
 echo "== profile sweep =="
-BENCH_INIT_BUDGET_S=300 PADDLE_TPU_AUTOTUNE_CACHE="$OUT/flash_blocks.json" \
-    timeout 3600 python tools/profile_step.py \
+BENCH_INIT_BUDGET_S=300 PROFILE_EXP_BUDGET_S=600 \
+    PADDLE_TPU_AUTOTUNE_CACHE="$OUT/flash_blocks.json" \
+    timeout 7200 python -u tools/profile_step.py \
     > "$OUT/profile.md" 2> "$OUT/profile.err"
 cat "$OUT/profile.md"
 
