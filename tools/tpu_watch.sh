@@ -1,0 +1,19 @@
+#!/bin/bash
+# Grant-recovery watcher: probe the axon backend every ~5 min in a killable
+# subprocess; the moment a probe answers, run the staged capture sequence
+# once and exit. Probes while wedged hang in backend registration and are
+# reaped by `timeout` (observed r3/r4 behavior; probing does not deepen the
+# wedge — the r3 watcher did the same).
+set -u
+OUT=${1:-/tmp/tpu_capture2}
+cd "$(dirname "$0")/.."
+while true; do
+    if timeout 150 python -c "import jax; jax.default_backend()" \
+            >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) grant healthy; running capture"
+        bash tools/tpu_capture.sh "$OUT"
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) still wedged"
+    sleep 300
+done
