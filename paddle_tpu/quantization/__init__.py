@@ -20,9 +20,11 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, apply_op
 from ..nn.layer.layers import Layer
+from .observers import HistogramObserver, channel_abs_max
 
 __all__ = ["fake_quant", "QuantConfig", "ImperativeQuantAware",
-           "PostTrainingQuantization", "QuantedLinear", "QuantedConv2D"]
+           "PostTrainingQuantization", "QuantedLinear", "QuantedConv2D",
+           "HistogramObserver", "fuse_conv_bn"]
 
 
 @jax.custom_vjp
@@ -47,11 +49,21 @@ def _fake_quant_raw(x, scale, bits):
     return jnp.clip(_ste_round(x / s * qmax), -qmax, qmax) * s / qmax
 
 
-def fake_quant(x, scale=None, bits=8):
-    """Quant-dequant with STE (reference: fake_quantize_abs_max op)."""
+def fake_quant(x, scale=None, bits=8, channel_axis=None):
+    """Quant-dequant with STE (reference: fake_quantize_abs_max /
+    fake_channel_wise_quantize_abs_max ops). With `channel_axis`, `scale`
+    is a vector of per-channel scales broadcast along that axis."""
+    data = x._data if isinstance(x, Tensor) else x
     if scale is None:
-        data = x._data if isinstance(x, Tensor) else x
-        scale = jnp.max(jnp.abs(data))
+        if channel_axis is None:
+            scale = jnp.max(jnp.abs(data))
+        else:
+            axes = tuple(i for i in range(data.ndim) if i != channel_axis)
+            scale = jnp.max(jnp.abs(data), axis=axes)
+    if channel_axis is not None:
+        shape = [1] * data.ndim
+        shape[channel_axis] = -1
+        scale = jnp.asarray(scale).reshape(shape)
     if isinstance(x, Tensor):
         return apply_op(_fake_quant_raw, x, scale=scale, bits=bits,
                         name="fake_quant")
@@ -96,12 +108,26 @@ class _QuantedBase(Layer):
         return fake_quant(x, jax.lax.stop_gradient(new),
                           self._cfg.activation_bits)
 
+    # per-channel scales live on the output-channel axis (reference
+    # fake_channel_wise_quantize_abs_max: quant_axis=1 for the (in, out)
+    # Linear weight, 0 for the (out, in/g, kh, kw) Conv weight)
+    _channel_axis = None
+
     def _quant_weight(self, w):
+        if self._cfg.weight_quantize_type == "channel_wise_abs_max":
+            axes = tuple(i for i in range(w._data.ndim)
+                         if i != self._channel_axis)
+            scale = jax.lax.stop_gradient(
+                jnp.max(jnp.abs(w._data), axis=axes))
+            return fake_quant(w, scale, self._cfg.weight_bits,
+                              channel_axis=self._channel_axis)
         scale = jax.lax.stop_gradient(jnp.max(jnp.abs(w._data)))
         return fake_quant(w, scale, self._cfg.weight_bits)
 
 
 class QuantedLinear(_QuantedBase):
+    _channel_axis = 1
+
     def forward(self, x):
         from ..nn import functional as F
         x = self._quant_act(x)
@@ -110,6 +136,8 @@ class QuantedLinear(_QuantedBase):
 
 
 class QuantedConv2D(_QuantedBase):
+    _channel_axis = 0
+
     def forward(self, x):
         from ..nn import functional as F
         x = self._quant_act(x)
@@ -153,29 +181,29 @@ class ImperativeQuantAware:
 
 class PostTrainingQuantization:
     """PTQ calibration (reference: post_training_quantization.py): run
-    sample batches, collect per-layer activation scales (abs_max or
-    percentile histogram), emit weight scales + a quantized eval model."""
+    sample batches, accumulate per-layer |activation| histograms, derive
+    the clip threshold with the chosen algo (KL / hist / mse / avg /
+    abs_max / min_max — reference's supported set), emit per-channel (or
+    per-tensor) weight scales + a fake-quantized eval model."""
 
-    def __init__(self, model, algo="abs_max", weight_bits=8,
-                 activation_bits=8, percentile=0.9999):
+    def __init__(self, model, algo="KL", weight_bits=8,
+                 activation_bits=8, percentile=0.9999,
+                 weight_quantize_type="channel_wise_abs_max"):
         self._model = model
         self._algo = algo
         self._bits = activation_bits
         self._wbits = weight_bits
         self._pct = percentile
-        self._acts = {}      # layer name -> list of abs samples
+        self._wtype = weight_quantize_type
+        self._obs = {}       # layer name -> HistogramObserver
         self._hooks = []
 
     def _make_hook(self, name):
         def hook(layer, inputs, outputs=None):
             x = inputs[0] if isinstance(inputs, tuple) else inputs
             if isinstance(x, Tensor):
-                a = np.abs(np.asarray(x.numpy(), np.float32)).reshape(-1)
-                if self._algo == "abs_max":
-                    self._acts.setdefault(name, []).append(float(a.max()))
-                else:   # percentile / hist
-                    self._acts.setdefault(name, []).append(
-                        float(np.quantile(a, self._pct)))
+                self._obs.setdefault(name, HistogramObserver()).collect(
+                    np.asarray(x.numpy(), np.float32))
         return hook
 
     def quantize(self, data_loader, batch_nums=8):
@@ -196,12 +224,52 @@ class PostTrainingQuantization:
             h.remove()
         scales = {}
         for n, l in targets:
-            samples = self._acts.get(n, [0.0])
-            act_scale = float(np.mean(samples)) if self._algo != "abs_max" \
-                else float(np.max(samples))
-            w_scale = float(jnp.max(jnp.abs(l.weight._data)))
-            scales[n] = {"activation": act_scale, "weight": w_scale}
-            # bake fake-quantized weights (deploy-accuracy simulation)
-            l.weight._data = _fake_quant_raw(
-                l.weight._data, jnp.float32(w_scale), self._wbits)
+            obs = self._obs.get(n)
+            act_scale = obs.threshold(self._algo, self._bits, self._pct) \
+                if obs else 0.0
+            w = l.weight._data
+            if self._wtype == "channel_wise_abs_max":
+                axis = 1 if isinstance(l, Linear) else 0
+                w_scale = channel_abs_max(np.asarray(w), axis)
+                l.weight._data = fake_quant(
+                    w, jnp.asarray(w_scale, jnp.float32), self._wbits,
+                    channel_axis=axis)
+                w_scale = w_scale.tolist()
+            else:
+                w_scale = float(jnp.max(jnp.abs(w)))
+                l.weight._data = _fake_quant_raw(
+                    w, jnp.float32(w_scale), self._wbits)
+            scales[n] = {"activation": float(act_scale), "weight": w_scale}
         return self._model, scales
+
+
+def fuse_conv_bn(model):
+    """Fold eval-mode BatchNorm into the preceding Conv2D (reference:
+    slim/quantization/imperative/fuse_utils.py fuse_conv_bn): w' = w*g/s,
+    b' = (b-mu)*g/s + beta with s = sqrt(var+eps), per output channel.
+    Mutates `model` in place and replaces the BN with Identity."""
+    from ..nn import BatchNorm2D, Conv2D, Identity
+    for parent in model.sublayers(include_self=True):
+        children = list(parent.named_children())
+        for (n1, c1), (n2, c2) in zip(children, children[1:]):
+            if not (isinstance(c1, Conv2D) and
+                    type(c1).__name__ == "Conv2D" and
+                    isinstance(c2, BatchNorm2D)):
+                continue
+            gamma = c2.weight._data
+            beta = c2.bias._data
+            mu = c2._mean._data
+            s = jnp.sqrt(c2._variance._data + c2._epsilon)
+            f = (gamma / s).astype(c1.weight._data.dtype)
+            c1.weight._data = c1.weight._data * f.reshape(-1, 1, 1, 1)
+            b = c1.bias._data if c1.bias is not None else 0.0
+            new_b = (b - mu) * (gamma / s) + beta
+            if c1.bias is not None:
+                c1.bias._data = new_b.astype(c1.bias._data.dtype)
+            else:
+                from ..core.tensor import to_tensor
+                c1.bias = c1.create_parameter(
+                    (c1.weight._data.shape[0],), is_bias=True)
+                c1.bias._data = new_b.astype(c1.weight._data.dtype)
+            setattr(parent, n2, Identity())
+    return model

@@ -66,7 +66,8 @@ def test_qat_quantized_model_close_to_float():
 
 def test_ptq_calibrates_scales():
     net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
-    ptq = PostTrainingQuantization(net, algo="abs_max")
+    ptq = PostTrainingQuantization(net, algo="abs_max",
+                                   weight_quantize_type="abs_max")
     rng = np.random.RandomState(3)
     batches = [(paddle.to_tensor(rng.rand(4, 8).astype("float32") * 3),)
                for _ in range(4)]
@@ -79,6 +80,130 @@ def test_ptq_calibrates_scales():
     q = np.round(w / scales["0"]["weight"] * 127)
     np.testing.assert_allclose(w, q * scales["0"]["weight"] / 127,
                                atol=1e-6)
+
+
+def test_fake_quant_per_channel_beats_per_tensor():
+    """A weight with one outlier channel: per-channel scales keep the
+    small channels' resolution (reference fake_channel_wise_quantize)."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 16).astype("float32") * 0.1
+    w[:, 3] *= 100.0                       # outlier output channel
+    q_tensor = np.asarray(fake_quant(w, bits=8))
+    q_channel = np.asarray(fake_quant(w, bits=8, channel_axis=1))
+    small = [c for c in range(16) if c != 3]
+    err_t = np.abs(q_tensor[:, small] - w[:, small]).max()
+    err_c = np.abs(q_channel[:, small] - w[:, small]).max()
+    assert err_c < err_t / 10
+    # each channel is on its own int8 grid
+    from paddle_tpu.quantization import HistogramObserver  # noqa: F401
+    from paddle_tpu.quantization.observers import channel_abs_max
+    s = channel_abs_max(w, 1)
+    grid = np.round(q_channel / (s / 127)[None, :])
+    np.testing.assert_allclose(q_channel, grid * (s / 127)[None, :],
+                               atol=1e-5)
+
+
+def test_ptq_algos_produce_sane_thresholds():
+    """Every reference calibration algo yields a threshold in (0, max] on
+    a heavy-tailed activation stream; clip-based algos clip, and the mse
+    threshold is verifiably no worse than no-clip on actual quant MSE."""
+    from paddle_tpu.quantization import HistogramObserver
+    rng = np.random.RandomState(1)
+    obs = HistogramObserver()
+    samples = []
+    for _ in range(8):
+        batch = rng.lognormal(0, 1.5, 4096).astype("float32")
+        samples.append(batch)
+        obs.collect(batch)
+    samples = np.concatenate(samples)
+    mx = obs.abs_max()
+    ts = {a: obs.threshold(a) for a in
+          ("abs_max", "min_max", "avg", "hist", "KL", "mse")}
+    for a, t in ts.items():
+        assert 0 < t <= mx + obs.bin_width, (a, t)
+    assert ts["abs_max"] == ts["min_max"] == pytest.approx(mx)
+    assert ts["avg"] < mx                       # mean of batch maxes
+    for a in ("hist", "KL", "mse"):
+        assert ts[a] < mx, (a, ts[a])           # tail clipped
+    # percentile monotonicity
+    assert obs.threshold("hist", percent=0.99) < \
+        obs.threshold("hist", percent=0.9999)
+
+    def quant_mse(s):
+        q = np.clip(np.round(samples / s * 127), -127, 127) * s / 127
+        return float(np.mean((samples - q) ** 2))
+
+    assert quant_mse(ts["mse"]) <= quant_mse(mx) * 1.001
+
+
+def test_observer_zero_batches_and_jit_channel_quant():
+    """All-zero first batch must not crash the observer (dead-ReLU
+    calibration inputs); channel-axis fake_quant must trace under jit."""
+    from paddle_tpu.quantization import HistogramObserver
+    import jax
+    import jax.numpy as jnp
+    obs = HistogramObserver()
+    obs.collect(np.zeros(16, np.float32))
+    obs.collect(np.ones(16, np.float32))
+    assert obs.threshold("KL") > 0
+    w = np.random.RandomState(0).randn(4, 6).astype("float32")
+    q = jax.jit(lambda w: fake_quant(w, bits=8, channel_axis=1))(w)
+    assert np.asarray(q).shape == (4, 6)
+
+
+def test_ptq_channel_wise_weights_and_kl():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    ptq = PostTrainingQuantization(net, algo="KL")
+    rng = np.random.RandomState(3)
+    batches = [(paddle.to_tensor(rng.rand(4, 8).astype("float32") * 3),)
+               for _ in range(4)]
+    model, scales = ptq.quantize(batches, batch_nums=4)
+    assert len(scales["0"]["weight"]) == 16     # per out-feature
+    assert scales["0"]["activation"] > 0
+    w = model[0].weight.numpy()
+    s = np.asarray(scales["0"]["weight"], np.float32)
+    grid = np.round(w / (s / 127)[None, :])
+    np.testing.assert_allclose(w, grid * (s / 127)[None, :], atol=1e-5)
+
+
+def test_qat_channel_wise_trains():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    qat = ImperativeQuantAware(
+        weight_quantize_type="channel_wise_abs_max")
+    qat.quantize(net)
+    o = opt.Adam(1e-2, parameters=net.parameters())
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype("float32")
+    y = (x.sum(1) > 4).astype("int64")
+    losses = []
+    for _ in range(30):
+        l = lf(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        l.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_fuse_conv_bn_preserves_eval_output():
+    from paddle_tpu.quantization import fuse_conv_bn
+    paddle.seed(4)
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
+                        nn.ReLU(), nn.Conv2D(8, 4, 3), nn.BatchNorm2D(4))
+    rng = np.random.RandomState(5)
+    # give BN non-trivial running stats
+    net.train()
+    for _ in range(3):
+        net(paddle.to_tensor(rng.rand(4, 3, 8, 8).astype("float32")))
+    net.eval()
+    x = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype("float32"))
+    ref = net(x).numpy()
+    fuse_conv_bn(net)
+    assert type(net[1]).__name__ == "Identity"
+    assert type(net[4]).__name__ == "Identity"
+    np.testing.assert_allclose(net(x).numpy(), ref, atol=2e-5)
 
 
 def test_asp_mask_pattern():
