@@ -1,17 +1,30 @@
 """paddle.cost_model (reference: cost_model/cost_model.py CostModel —
-profile-based per-op cost table used by auto-parallel planners)."""
+profile-based per-op cost table + static_op_benchmark.json lookups used
+by auto-parallel planners).
+
+Two modes here: `profile_measure` times the whole compiled program on the
+live backend (XLA has no per-op replay), and `static_costs` /
+`get_static_op_time` attribute per-op FLOPs/bytes/roofline-time
+analytically from the jaxpr (`analytical.estimate`) — the TPU-native
+replacement for the reference's static benchmark table, and it prices
+TPU-sized shapes without executing them."""
 import time
 
-__all__ = ["CostModel"]
+from .analytical import (DEVICES, CostReport, DeviceSpec,  # noqa: F401
+                         OpCost, estimate)
+
+__all__ = ["CostModel", "estimate", "CostReport", "DeviceSpec", "DEVICES"]
 
 
 class CostModel:
     """Measure a callable's cost profile (reference CostModel.profile_
     measure wraps a program; here any callable/Layer is timed on the
-    current backend, whole-program — XLA has no per-op replay)."""
+    current backend, whole-program) and/or price it analytically per-op
+    (`static_costs`)."""
 
     def __init__(self):
         self._table = {}
+        self._static = {}    # op name -> {"time", "flops", "bytes"}
 
     def profile_measure(self, fn_or_program, *args, device="tpu",
                         fetch_cost_list=("time",), repeat=5):
@@ -34,5 +47,22 @@ class CostModel:
         self._table[getattr(fn, "__name__", "program")] = cost
         return cost
 
+    def static_costs(self, fn, *args, device="tpu-v5e", **kwargs):
+        """Analytically price `fn(*args)` per-op (no execution); fills the
+        static table consulted by `get_static_op_time` and returns the
+        CostReport."""
+        report = estimate(fn, *args, device=device, **kwargs)
+        for name, c in report.by_op.items():
+            self._static[name] = {
+                "time": 1e3 * report.device.roofline_s(c.flops, c.bytes),
+                "flops": c.flops, "bytes": c.bytes, "count": c.count}
+        return report
+
     def get_static_op_time(self, op_name, forward=True, dtype="float32"):
-        return self._table.get(op_name, {"time": 0.0})
+        """`forward`/`dtype` are accepted for reference-signature parity
+        but not keyed on: the analytic table prices the ops of whatever
+        function was traced (a traced train step already contains its
+        backward ops at their traced dtypes)."""
+        if op_name in self._static:
+            return dict(self._static[op_name])
+        return dict(self._table.get(op_name, {"time": 0.0}))
