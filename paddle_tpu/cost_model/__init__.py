@@ -53,8 +53,10 @@ class CostModel:
         CostReport."""
         report = estimate(fn, *args, device=device, **kwargs)
         for name, c in report.by_op.items():
+            t = 1e3 * report.device.roofline_s(c.flops, c.bytes)
             self._static[name] = {
-                "time": 1e3 * report.device.roofline_s(c.flops, c.bytes),
+                "time": t,                       # aggregate over all calls
+                "time_per_call": t / max(c.count, 1),
                 "flops": c.flops, "bytes": c.bytes, "count": c.count}
         return report
 
@@ -62,7 +64,10 @@ class CostModel:
         """`forward`/`dtype` are accepted for reference-signature parity
         but not keyed on: the analytic table prices the ops of whatever
         function was traced (a traced train step already contains its
-        backward ops at their traced dtypes)."""
+        backward ops at their traced dtypes). "time" is the aggregate over
+        every execution of the primitive in the traced program (scan trip
+        counts included); planners comparing op kinds should use
+        "time_per_call"."""
         if op_name in self._static:
             return dict(self._static[op_name])
         return dict(self._table.get(op_name, {"time": 0.0}))

@@ -1,6 +1,6 @@
 """NLP model zoo: GPT / BERT / ERNIE (TPU-native flagship models)."""
 from .gpt import GPT, GPTConfig, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b, gpt_6p7b  # noqa: F401
-from .bert import Bert, BertConfig  # noqa: F401
+from .bert import Bert, BertConfig, BertForPretraining  # noqa: F401
 from .ernie import (  # noqa: F401
     Ernie, ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification,
     ernie_3_base, ernie_3_base_config, ernie_pipeline_descs, ernie_tiny,

@@ -35,6 +35,7 @@ _SLOW_FILES = {
     "test_parallel_spmd.py",        # hybrid shard_map compiles: ~20s each
     "test_multiprocess_dist.py",    # forked 2-process trainers
     "test_moe.py",                  # expert-parallel grads: 20s
+    "test_examples.py",             # subprocess example smokes: ~60s each
 }
 
 
