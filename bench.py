@@ -274,12 +274,21 @@ def main():
     S = int(os.environ.get("BENCH_S", 1024 if on_tpu else 128))
     scan_k = int(os.environ.get("BENCH_K", 10 if on_tpu else 1))
 
+    # every on-chip phase below runs under a wall-clock watchdog: a wedged
+    # tunnel request blocks in uninterruptible socket I/O (observed r4: one
+    # remote_compile hung >30 min), and only a hard os._exit after emitting
+    # the structured-failure line keeps the driver's record parseable
+    rung_budget = float(os.environ.get("BENCH_RUNG_BUDGET_S", 900))
+
     parity = {}
     if on_tpu and os.environ.get("BENCH_SKIP_PREFLIGHT") != "1":
+        wd = start_watchdog(rung_budget, "flash parity preflight")
         try:
             parity = flash_parity_preflight(S)
         except Exception as e:                               # noqa: BLE001
             parity = {"flash_parity_error": str(e)[:300]}
+        finally:
+            wd.cancel()
     elif not on_tpu:
         parity = {"flash_parity_skipped": f"backend={backend} (Pallas "
                   "kernel only lowers on TPU)"}
@@ -295,7 +304,9 @@ def main():
         # explicit config: no ladder, fail loudly
         B = int(os.environ.get("BENCH_B", 16 if on_tpu else 2))
         remat = os.environ.get("BENCH_REMAT", "dots" if on_tpu else "full")
+        wd = start_watchdog(rung_budget, f"explicit config B={B}")
         finish(run_config(B, S, remat, n_steps, on_tpu, scan_k))
+        wd.cancel()
         return
 
     if not on_tpu:
@@ -310,11 +321,14 @@ def main():
               (2, "full")]
     last_err = None
     for B, remat in ladder:
+        wd = start_watchdog(rung_budget, f"ladder rung B={B},remat={remat}")
         try:
             result = run_config(B, S, remat, n_steps, on_tpu, scan_k)
+            wd.cancel()
             finish(result, rung=f"B={B},remat={remat}")
             return
         except Exception as e:          # noqa: BLE001
+            wd.cancel()
             if not _is_oom(e):
                 raise
             # keep the real exception text: a compile-service failure matches

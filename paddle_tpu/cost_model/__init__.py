@@ -70,4 +70,6 @@ class CostModel:
         "time_per_call"."""
         if op_name in self._static:
             return dict(self._static[op_name])
-        return dict(self._table.get(op_name, {"time": 0.0}))
+        rec = dict(self._table.get(op_name, {"time": 0.0}))
+        rec.setdefault("time_per_call", rec["time"])
+        return rec
