@@ -9,6 +9,19 @@ from ...core.tensor import Tensor, apply_op
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W (+ b). Paddle weight layout: (in_features, out_features)."""
+    xs, ws = tuple(x.shape), tuple(weight.shape)
+    # reference-style enforce messages (paddle/fluid/platform/enforce.h)
+    # instead of a raw XLA dot_general error from inside the compiler
+    if len(ws) != 2:
+        raise ValueError(
+            f"(InvalidArgument) linear: weight must be 2-D "
+            f"(in_features, out_features), but received weight.shape={ws}.")
+    if not xs or xs[-1] != ws[0]:
+        raise ValueError(
+            f"(InvalidArgument) linear: input's last dimension must equal "
+            f"weight's in_features ({ws[0]}), but received x.shape={xs} "
+            f"and weight.shape={ws}.")
+
     def fn(a, w, *b):
         out = jnp.matmul(a, w)
         if b:
@@ -63,6 +76,12 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    if len(tuple(weight.shape)) != 2:
+        raise ValueError(
+            f"(InvalidArgument) embedding: weight must be 2-D "
+            f"(vocab_size, embedding_dim), but received "
+            f"weight.shape={tuple(weight.shape)}.")
+
     def fn(ids, w):
         ids_i = ids.astype(jnp.int32)
         out = jnp.take(w, ids_i, axis=0)

@@ -37,6 +37,23 @@ def _conv_padding(padding, spatial, kernel, stride, dilation):
 
 def _conv_nd(x, w, bias, stride, padding, dilation, groups, spatial, data_format,
              transposed=False, output_padding=0):
+    xs, ws = tuple(x.shape), tuple(w.shape)
+    opname = f"conv{spatial}d{'_transpose' if transposed else ''}"
+    # reference-style enforce messages instead of raw XLA conv errors
+    if len(xs) != spatial + 2:
+        raise ValueError(
+            f"(InvalidArgument) {opname}: input must be {spatial + 2}-D "
+            f"(batch, channels, spatial...), but received x.shape={xs}.")
+    ch_axis = 1 if data_format.startswith("NC") else len(xs) - 1
+    cin = xs[ch_axis]
+    # weight layouts: (out, in/groups, k...) fwd; (in, out/groups, k...) transposed
+    expect = ws[0] if transposed else ws[1] * groups
+    if cin != expect:
+        raise ValueError(
+            f"(InvalidArgument) {opname}: input channels ({cin}) must "
+            f"equal {'weight.shape[0]' if transposed else 'weight.shape[1] * groups'} "
+            f"({expect}), but received x.shape={xs}, weight.shape={ws}, "
+            f"groups={groups}, data_format={data_format}.")
     chars = "DHW"[-spatial:]
     if data_format in (f"NC{chars}", "NCHW", "NCL", "NCDHW"):
         lhs_spec = "NC" + chars

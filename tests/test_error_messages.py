@@ -1,0 +1,51 @@
+"""Paddle-style (InvalidArgument) shape errors at layer entry points —
+previously bad shapes surfaced as raw XLA dot_general/conv errors
+(reference enforce.h formats every kernel failure with op + inputs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(shape):
+    return paddle.to_tensor(np.zeros(shape, np.float32))
+
+
+def test_linear_mismatch_message():
+    with pytest.raises(ValueError, match=r"\(InvalidArgument\) linear.*"
+                                         r"in_features \(16\)"):
+        F.linear(t((4, 12)), t((16, 32)))
+    with pytest.raises(ValueError, match=r"weight must be 2-D"):
+        F.linear(t((4, 12)), t((12,)))
+    lay = nn.Linear(16, 32)
+    with pytest.raises(ValueError, match="InvalidArgument"):
+        lay(t((4, 12)))
+
+
+def test_conv_mismatch_message():
+    with pytest.raises(ValueError, match=r"\(InvalidArgument\) conv2d.*"
+                                         r"input channels \(4\)"):
+        F.conv2d(t((2, 4, 8, 8)), t((8, 3, 3, 3)))
+    with pytest.raises(ValueError, match=r"conv2d: input must be 4-D"):
+        F.conv2d(t((4, 8, 8)), t((8, 3, 3, 3)))
+    # grouped: cin must equal w.shape[1] * groups
+    F.conv2d(t((2, 6, 8, 8)), t((6, 3, 3, 3)), groups=2)   # ok
+    with pytest.raises(ValueError, match="groups=2"):
+        F.conv2d(t((2, 4, 8, 8)), t((6, 3, 3, 3)), groups=2)
+    # transposed layout: (in, out/groups, k, k)
+    F.conv2d_transpose(t((2, 6, 8, 8)), t((6, 4, 3, 3)))   # ok
+    with pytest.raises(ValueError, match="conv2d_transpose"):
+        F.conv2d_transpose(t((2, 5, 8, 8)), t((6, 4, 3, 3)))
+
+
+def test_embedding_weight_message():
+    with pytest.raises(ValueError, match=r"embedding: weight must be 2-D"):
+        F.embedding(paddle.to_tensor(np.zeros((4,), np.int64)), t((10,)))
+
+
+def test_valid_calls_unaffected():
+    assert F.linear(t((4, 12)), t((12, 32))).shape == [4, 32]
+    assert F.conv2d(t((2, 3, 8, 8)), t((8, 3, 3, 3)),
+                    padding=1).shape == [2, 8, 8, 8]
