@@ -305,8 +305,10 @@ def main():
         B = int(os.environ.get("BENCH_B", 16 if on_tpu else 2))
         remat = os.environ.get("BENCH_REMAT", "dots" if on_tpu else "full")
         wd = start_watchdog(rung_budget, f"explicit config B={B}")
-        finish(run_config(B, S, remat, n_steps, on_tpu, scan_k))
-        wd.cancel()
+        try:
+            finish(run_config(B, S, remat, n_steps, on_tpu, scan_k))
+        finally:
+            wd.cancel()
         return
 
     if not on_tpu:

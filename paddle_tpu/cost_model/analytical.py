@@ -63,12 +63,13 @@ class CostReport:
     @property
     def time_ms(self):
         """Roofline estimate applied per-op (each op is either compute- or
-        bandwidth-bound). UPPER bound on memory time: per-op bytes assume
-        every operand/result round-trips HBM, but XLA fuses elementwise
-        chains so most intermediates never materialize (the flagship GPT
-        step estimates ~4x its measured time, dominated by would-be-fused
-        elementwise bytes). FLOP totals are exact; use those for balancing
-        and the time only for relative comparisons."""
+        bandwidth-bound), with a greedy producer-consumer fusion model for
+        bytes: fusable intermediates cost nothing, materialized tensors
+        cost one write + one read. Still an upper bound (~2.5x measured on
+        the flagship GPT step) — chiefly because a trace taken on a CPU
+        host prices the XLA S^2-materializing attention fallback, not the
+        Pallas flash path the chip runs. FLOP totals are exact; prefer
+        them for balancing and use time for relative comparisons."""
         return 1e3 * sum(
             self.device.roofline_s(c.flops, c.bytes)
             for c in self.by_op.values())
@@ -119,7 +120,8 @@ def _conv_flops(eqn):
 
 
 _ELEMENTWISE_FLOPS = {
-    "add": 1, "sub": 1, "mul": 1, "div": 1, "max": 1, "min": 1, "neg": 1,
+    "add": 1, "add_any": 1, "sub": 1, "mul": 1, "div": 1, "max": 1,
+    "min": 1, "neg": 1,
     "exp": 8, "log": 8, "tanh": 8, "logistic": 8, "erf": 8, "rsqrt": 4,
     "sqrt": 4, "pow": 8, "integer_pow": 2, "select_n": 1, "abs": 1,
     "sign": 1, "floor": 1, "ceil": 1, "round": 1, "cos": 8, "sin": 8,
@@ -128,9 +130,83 @@ _ELEMENTWISE_FLOPS = {
 _REDUCE_PRIMS = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
                  "argmax", "argmin", "cumsum", "cumlogsumexp", "cummax"}
 
+# ops XLA reliably fuses into neighbouring loops: their intermediates live
+# in registers/VMEM and never round-trip HBM. Reductions fuse as epilogues
+# (their INPUT read fuses with an elementwise producer); dots/convs/
+# gather/scatter/concat materialize.
+_FUSABLE = set(_ELEMENTWISE_FLOPS) | {
+    "broadcast_in_dim", "convert_element_type", "transpose", "reshape",
+    "squeeze", "expand_dims", "iota", "stop_gradient", "copy",
+    "reduce_precision", "and", "or", "not", "xor", "eq", "ne", "lt", "le",
+    "gt", "ge", "is_finite", "clamp",
+}
+
+
+_CALL_PRIMS = {"pjit", "jit", "xla_call", "closed_call", "core_call",
+               "core_closed_call", "shard_map", "remat2",
+               "custom_jvp_call", "custom_vjp_call",
+               "custom_vjp_call_jaxpr", "checkpoint", "scan", "while",
+               "cond"}
+
+
+def _fusion_maps(jaxpr):
+    """(var -> producing eqn, var -> consumers, var -> read-charging eqn,
+    external outputs) within one jaxpr, for the greedy producer-consumer
+    fusion model: a fusable op's output that only fusable ops consume is
+    never materialized; a materialized tensor costs one write plus one
+    read, charged to the first consumer whose read does NOT fuse (call/
+    control-flow consumers are skipped — their sub-jaxpr walk counts the
+    boundary read itself)."""
+    producer, consumers = {}, {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            producer[v] = i
+        for v in eqn.invars:
+            if hasattr(v, "aval") and not hasattr(v, "val"):  # skip Literals
+                consumers.setdefault(v, []).append(i)
+    external = {v for v in jaxpr.outvars if not hasattr(v, "val")}
+    charge = {}
+    for v, cs in consumers.items():
+        p = producer.get(v)
+        p_fusable = p is not None and \
+            jaxpr.eqns[p].primitive.name in _FUSABLE
+        for c in cs:
+            cname = jaxpr.eqns[c].primitive.name
+            if cname in _CALL_PRIMS:
+                continue
+            if p_fusable and cname in (_FUSABLE | _REDUCE_PRIMS):
+                continue                     # this consumer's read fuses
+            charge[v] = c
+            break
+    return producer, consumers, charge, external
+
 
 def _walk(jaxpr, report, mult=1.0):
-    for eqn in jaxpr.eqns:
+    producer, consumers, charge, external = _fusion_maps(jaxpr)
+    eqns = jaxpr.eqns
+
+    def read_bytes(eqn, idx):
+        total = 0
+        for v in eqn.invars:
+            if not hasattr(v, "aval") or hasattr(v, "val"):
+                continue                              # Literal: in-line
+            if charge.get(v) == idx:
+                total += _nbytes(v.aval)
+        return total
+
+    def write_bytes(eqn):
+        total = 0
+        for v in eqn.outvars:
+            cs = consumers.get(v, [])
+            fused_write = (eqn.primitive.name in _FUSABLE and
+                           v not in external and cs and
+                           all(eqns[c].primitive.name in
+                               (_FUSABLE | _REDUCE_PRIMS) for c in cs))
+            if not fused_write:
+                total += _nbytes(v.aval)
+        return total
+
+    for idx, eqn in enumerate(jaxpr.eqns):
         name = eqn.primitive.name
         # control flow / call primitives: recurse with multipliers
         if name in ("pjit", "jit", "xla_call", "closed_call", "core_call",
@@ -167,9 +243,8 @@ def _walk(jaxpr, report, mult=1.0):
                 report.has_while |= worst.has_while
             continue
 
-        in_bytes = sum(_nbytes(v.aval) for v in eqn.invars
-                       if hasattr(v, "aval"))
-        out_bytes = sum(_nbytes(v.aval) for v in eqn.outvars)
+        in_bytes = read_bytes(eqn, idx)
+        out_bytes = write_bytes(eqn)
         out_elems = sum(int(np.prod(v.aval.shape, initial=1))
                         for v in eqn.outvars)
         if name == "dot_general":
