@@ -11,7 +11,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SCRIPTS = ["resnet_cifar10.py", "bert_pretrain_dp.py",
            "gpt_sharding_stage2.py", "ernie_mp_pp.py",
-           "ppyoloe_detection.py"]
+           "ppyoloe_detection.py", "long_context_sp.py"]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)
