@@ -41,6 +41,11 @@ def main():
 
     assert jax.default_backend() == backend
     wd.cancel()
+    # run-phase watchdog: a wedged tunnel request mid-measurement blocks in
+    # uninterruptible socket I/O (bench.py per-rung pattern)
+    wd = bench.start_watchdog(
+        float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
+        "eager bench run", on_fire=_emit_failure)
     B, D, H, C = 256, 64, 256, 8
     rng = np.random.RandomState(0)
     x_np = rng.rand(B, D).astype("float32")
@@ -114,6 +119,7 @@ def main():
                   "backend": backend, "steps": n, "loss": loss_val,
                   "cache": dict(_CACHE_STATS)},
     }))
+    wd.cancel()   # success line emitted; never double-print on slow teardown
 
 
 def _emit_failure(error):
