@@ -42,8 +42,11 @@ def main():
     assert jax.default_backend() == backend
     wd.cancel()
     # run-phase watchdog: a wedged tunnel request mid-measurement blocks in
-    # uninterruptible socket I/O (bench.py per-rung pattern)
-    wd = bench.start_watchdog(
+    # uninterruptible socket I/O (bench.py per-rung pattern). Cancelled in
+    # main's finally so the BaseException handler never races a second
+    # failure line out of the timer thread.
+    global _run_wd
+    _run_wd = bench.start_watchdog(
         float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
         "eager bench run", on_fire=_emit_failure)
     B, D, H, C = 256, 64, 256, 8
@@ -119,7 +122,6 @@ def main():
                   "backend": backend, "steps": n, "loss": loss_val,
                   "cache": dict(_CACHE_STATS)},
     }))
-    wd.cancel()   # success line emitted; never double-print on slow teardown
 
 
 def _emit_failure(error):
@@ -130,8 +132,15 @@ def _emit_failure(error):
         "vs_baseline": 0.0, "error": error}))
 
 
+_run_wd = None
+
 if __name__ == "__main__":
     try:
         main()
     except BaseException as e:                               # noqa: BLE001
+        if _run_wd is not None:
+            _run_wd.cancel()
         _emit_failure(f"{type(e).__name__}: {str(e)[:600]}")
+    finally:
+        if _run_wd is not None:
+            _run_wd.cancel()

@@ -245,19 +245,15 @@ def _experiments(B, S, on_tpu, quick):
                   f"MFU {mfu:.3f} |", flush=True)
         return run
 
+    # decision-relevant experiments FIRST: if the grant wedges mid-sweep
+    # (observed twice), the dots+attn A/B, flash A/B and block sweep are
+    # the rows that choose the next optimization — none/full/decompose
+    # are confirmatory
     exps.append(("dots", full("dots")))
     if not quick:
-        for remat in ("none", "full", "dots+attn"):
-            exps.append((remat, full(remat)))
+        exps.append(("dots+attn", full("dots+attn")))
         if on_tpu:
-            exps.append(("b12", full("dots", 12)))
             exps.append(("b12attn", full("dots+attn", 12)))
-
-    def run_decompose():
-        for name, ms_i in decompose(B, S, "dots"):
-            print(f"| {name} | {ms_i:.1f} ms |", flush=True)
-
-    exps.append(("decompose", run_decompose))
 
     if on_tpu and not quick:
         def run_flash_ab():
@@ -306,6 +302,19 @@ def _experiments(B, S, on_tpu, quick):
 
     if os.environ.get("XPLANE"):
         exps.append(("xplane", run_xplane))
+
+    # confirmatory experiments last (see ordering note above)
+    if not quick:
+        for remat in ("none", "full"):
+            exps.append((remat, full(remat)))
+        if on_tpu:
+            exps.append(("b12", full("dots", 12)))
+
+    def run_decompose():
+        for name, ms_i in decompose(B, S, "dots"):
+            print(f"| {name} | {ms_i:.1f} ms |", flush=True)
+
+    exps.append(("decompose", run_decompose))
     return exps
 
 

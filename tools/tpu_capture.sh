@@ -22,15 +22,22 @@ BENCH_INIT_BUDGET_S=300 timeout 2400 python bench.py \
 cat "$OUT/bench.json"
 
 echo "== eager bench =="
-BENCH_INIT_BUDGET_S=300 timeout 1200 python bench_eager.py \
+BENCH_INIT_BUDGET_S=300 BENCH_RUNG_BUDGET_S=600 timeout 1200 \
+    python bench_eager.py \
     > "$OUT/bench_eager.json" 2> "$OUT/bench_eager.err"
 cat "$OUT/bench_eager.json"
 
 echo "== profile sweep =="
 BENCH_INIT_BUDGET_S=300 PROFILE_EXP_BUDGET_S=600 \
+    XPLANE="$OUT/xplane" \
     PADDLE_TPU_AUTOTUNE_CACHE="$OUT/flash_blocks.json" \
     timeout 7200 python -u tools/profile_step.py \
     > "$OUT/profile.md" 2> "$OUT/profile.err"
 cat "$OUT/profile.md"
+
+echo "== xplane summary =="
+timeout 600 python tools/xplane_summary.py "$OUT/xplane" \
+    > "$OUT/xplane_top_ops.md" 2>&1 || true
+cat "$OUT/xplane_top_ops.md"
 
 echo "== done; artifacts in $OUT =="

@@ -27,20 +27,28 @@ def summarize(path, top_n=20):
     data = ProfileData.from_file(path)
 
     def aggregate(plane):
-        # TPU device planes are hierarchical (Steps ⊃ XLA Modules ⊃ XLA
-        # Ops): summing every line would triple-count time, so keep only
-        # the finest op-level line when one exists
-        lines = list(plane.lines)
-        op_lines = [ln for ln in lines if "op" in (ln.name or "").lower()]
+        # TPU device planes carry PARALLEL hierarchy lines over the same
+        # nanoseconds (Steps / XLA Modules / XLA Ops / Framework Ops /
+        # Framework Name Scope): summing across lines multi-counts time,
+        # so pick exactly ONE line — 'XLA Ops' when present, else the
+        # line with the largest total duration
+        def line_total(ln):
+            return sum(max(ev.duration_ns, 0) for ev in ln.events)
+
+        lines = [ln for ln in plane.lines if line_total(ln) > 0]
+        if not lines:
+            return collections.Counter(), collections.Counter()
+        xla_ops = [ln for ln in lines
+                   if (ln.name or "").lower() == "xla ops"]
+        line = xla_ops[0] if xla_ops else max(lines, key=line_total)
         agg = collections.Counter()
         calls = collections.Counter()
-        for line in (op_lines or lines):
-            for ev in line.events:
-                ns = ev.duration_ns
-                if ns <= 0:
-                    continue
-                agg[ev.name] += ns
-                calls[ev.name] += 1
+        for ev in line.events:
+            ns = ev.duration_ns
+            if ns <= 0:
+                continue
+            agg[ev.name] += ns
+            calls[ev.name] += 1
         return agg, calls
 
     planes = list(data.planes)
@@ -70,4 +78,10 @@ def summarize(path, top_n=20):
 if __name__ == "__main__":
     root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/xplane_gpt"
     top = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    summarize(find_xplane(root) if os.path.isdir(root) else root, top)
+    if os.path.isdir(root):
+        path = find_xplane(root)
+    elif os.path.isfile(root):
+        path = root
+    else:
+        raise SystemExit(f"no trace at {root} (capture never ran?)")
+    summarize(path, top)
