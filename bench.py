@@ -185,7 +185,8 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k):
         param_dtype="bfloat16" if on_tpu else "float32",
         compute_dtype="bfloat16" if on_tpu else "float32",
         remat={"none": False, "full": True, "dots": "dots",
-               "dots+attn": "dots+attn"}[remat])
+               "dots+attn": "dots+attn"}[remat],
+        scan_unroll=int(os.environ.get("BENCH_UNROLL", 1)))
 
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=2e-4)

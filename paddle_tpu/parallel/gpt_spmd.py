@@ -56,10 +56,17 @@ class GPTSpmdConfig:
     # (16 MB/layer at the bench shape) skips that (best MFU/HBM trade on TPU)
     remat: object = True
     init_std: float = 0.02
+    # lax.scan unroll over the layer stack: >1 lets XLA software-pipeline
+    # adjacent blocks (weight prefetch overlapping compute) at the cost of
+    # program size; values measured via tools/profile_step.py
+    scan_unroll: int = 1
 
     def __post_init__(self):
         if self.ffn is None:
             self.ffn = 4 * self.hidden
+        if int(self.scan_unroll) < 1:
+            raise ValueError(
+                f"scan_unroll must be >= 1, got {self.scan_unroll}")
 
 
 @dataclass
@@ -307,7 +314,7 @@ def _stage_blocks(h, params, cfg, plan):
     def body(h, blk):
         return apply_block(h, blk), None
 
-    h, _ = jax.lax.scan(body, h, stacked)
+    h, _ = jax.lax.scan(body, h, stacked, unroll=int(cfg.scan_unroll))
     return h
 
 

@@ -48,7 +48,7 @@ def _sync(out):
         np.asarray(jax.device_get(leaves[0]))
 
 
-def build(B, S, remat, lr=2e-4):
+def build(B, S, remat, lr=2e-4, unroll=1):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
@@ -64,7 +64,8 @@ def build(B, S, remat, lr=2e-4):
         param_dtype="bfloat16" if on_tpu else "float32",
         compute_dtype="bfloat16" if on_tpu else "float32",
         remat={"none": False, "full": True, "dots": "dots",
-               "dots+attn": "dots+attn"}[remat])
+               "dots+attn": "dots+attn"}[remat],
+        scan_unroll=unroll)
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=lr)
     params, state = init_fn(jax.random.key(0))
@@ -76,12 +77,12 @@ def build(B, S, remat, lr=2e-4):
     return cfg, plan, step_fn, params, state, toks, labs, n_params
 
 
-def step_mfu(B, S, remat, scan_k=10, n=3):
+def step_mfu(B, S, remat, scan_k=10, n=3, unroll=1):
     """Steady-state step time via scan-K dispatch; returns (ms/step, MFU)."""
     import jax
     import jax.numpy as jnp
     cfg, plan, step_fn, params, state, toks, labs, n_params = \
-        build(B, S, remat)
+        build(B, S, remat, unroll=unroll)
     lr = jnp.float32(2e-4)
 
     def multi(params, state):
@@ -254,6 +255,13 @@ def _experiments(B, S, on_tpu, quick):
         exps.append(("dots+attn", full("dots+attn")))
         if on_tpu:
             exps.append(("b12attn", full("dots+attn", 12)))
+
+            def run_unroll():
+                ms, mfu = step_mfu(B, S, "dots+attn", scan_k=10, unroll=2)
+                print(f"| full step B={B} dots+attn unroll=2 | "
+                      f"{ms:.1f} ms/step, MFU {mfu:.3f} |", flush=True)
+
+            exps.append(("unroll2", run_unroll))
 
     if on_tpu and not quick:
         def run_flash_ab():
