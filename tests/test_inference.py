@@ -245,3 +245,36 @@ def test_program_capture_ir_surface():
     # OpDesc surface
     op = prog.ops()[0]
     assert op.input_arg_names() and op.output_arg_names()
+
+
+def test_quantized_deploy_roundtrip(tmp_path):
+    """The PTQ deploy story end-to-end: calibrate (KL, per-channel
+    weights), jit.save the quantized model, serve it via Predictor, and
+    check the served outputs match the in-process quantized model —
+    the reference's save_quantized_model -> AnalysisPredictor flow."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.quantization import PostTrainingQuantization
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(7)
+    net = _small_net()
+    rng = np.random.RandomState(2)
+    batches = [(paddle.to_tensor(rng.rand(4, 8).astype("float32")),)
+               for _ in range(4)]
+    model, scales = PostTrainingQuantization(net, algo="KL").quantize(
+        batches, batch_nums=4)
+    assert len(scales) == 2 and all(
+        s["activation"] > 0 for s in scales.values())
+
+    x = rng.rand(5, 8).astype("float32")
+    want = model(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "q")
+    paddle.jit.save(model, path, input_spec=[InputSpec([None, 8],
+                                                       "float32")])
+    pred = create_predictor(Config(path + ".pdmodel", path + ".pdiparams"))
+    inp = pred.get_input_handle(pred.get_input_names()[0])
+    inp.copy_from_cpu(x)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
