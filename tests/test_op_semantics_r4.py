@@ -1,0 +1,116 @@
+"""Subtle op semantics ported from the reference test suite, cross-checked
+against torch/numpy golden implementations (reference:
+test_cross_entropy_loss.py, test_scatter_nd_op.py, test_gather_nd_op.py,
+test_put_along_axis_op.py — the behavioral corners, not the harnesses).
+"""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestCrossEntropySemantics:
+    def test_soft_label_matches_torch(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(6, 5).astype("float32")
+        soft = rng.rand(6, 5).astype("float32")
+        soft /= soft.sum(1, keepdims=True)
+        got = F.cross_entropy(t(logits), t(soft), soft_label=True).numpy()
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(soft)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_ignore_index_mean_denominator(self):
+        """paddle (and torch) divide the mean by the count of NON-ignored
+        rows, not the batch size."""
+        rng = np.random.RandomState(1)
+        logits = rng.randn(8, 4).astype("float32")
+        labels = rng.randint(0, 4, 8).astype("int64")
+        labels[[2, 5, 6]] = -100
+        got = F.cross_entropy(t(logits), t(labels)).numpy()
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_weighted_ignore_index_mean(self):
+        """weighted mean divides by the sum of LIVE example weights
+        (reference cross_entropy kernel's weighted path)."""
+        rng = np.random.RandomState(2)
+        logits = rng.randn(8, 4).astype("float32")
+        labels = rng.randint(0, 4, 8).astype("int64")
+        labels[3] = -100
+        w = np.asarray([0.1, 0.5, 2.0, 1.0], np.float32)
+        got = F.cross_entropy(t(logits), t(labels), weight=t(w)).numpy()
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels),
+            weight=torch.tensor(w)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_all_ignored_is_finite(self):
+        logits = np.ones((3, 4), np.float32)
+        labels = np.full(3, -100, np.int64)
+        got = float(F.cross_entropy(t(logits), t(labels)))
+        assert np.isfinite(got) and got == 0.0
+
+
+class TestScatterGatherNd:
+    def test_scatter_nd_add_accumulates_duplicates(self):
+        """Duplicate indices ACCUMULATE (reference scatter_nd_add_op) —
+        the corner that at[].set would get wrong."""
+        x = np.zeros(5, np.float32)
+        idx = np.asarray([[1], [1], [1], [3]], np.int64)
+        upd = np.asarray([1.0, 2.0, 3.0, 7.0], np.float32)
+        got = paddle.scatter_nd_add(t(x), t(idx), t(upd)).numpy()
+        np.testing.assert_allclose(got, [0, 6, 0, 7, 0])
+
+    def test_scatter_overwrite_false_sums(self):
+        """paddle.scatter(overwrite=False): duplicate rows SUM, and the
+        destination row is zeroed first (not added to)."""
+        x = np.full((3, 2), 10.0, np.float32)
+        idx = np.asarray([1, 1], np.int64)
+        upd = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        got = paddle.scatter(t(x), t(idx), t(upd), overwrite=False).numpy()
+        np.testing.assert_allclose(got, [[10, 10], [4, 6], [10, 10]])
+
+    def test_gather_nd_partial_index_returns_slices(self):
+        """index depth < x.ndim gathers slices (reference gather_nd_op)."""
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.asarray([[0, 2], [1, 0]], np.int64)   # depth 2 of 3
+        got = paddle.gather_nd(t(x), t(idx)).numpy()
+        np.testing.assert_allclose(got, np.stack([x[0, 2], x[1, 0]]))
+
+    def test_put_along_axis_reduce_modes(self):
+        x = np.ones((2, 3), np.float32)
+        idx = np.asarray([[0], [2]], np.int64)
+        v = np.asarray([[5.0], [7.0]], np.float32)
+        got_add = paddle.put_along_axis(t(x), t(idx), t(v), axis=1,
+                                        reduce="add").numpy()
+        want = torch.ones(2, 3).scatter_add_(
+            1, torch.tensor(idx), torch.tensor(v)).numpy()
+        np.testing.assert_allclose(got_add, want)
+        got_mul = paddle.put_along_axis(t(x) * 2, t(idx), t(v), axis=1,
+                                        reduce="mul").numpy()
+        np.testing.assert_allclose(got_mul, [[10, 2, 2], [2, 2, 14]])
+
+
+class TestSoftmaxWithCrossEntropy:
+    def test_return_softmax(self):
+        rng = np.random.RandomState(3)
+        logits = rng.randn(4, 6).astype("float32")
+        labels = rng.randint(0, 6, (4, 1)).astype("int64")
+        out = F.softmax_with_cross_entropy(t(logits), t(labels),
+                                           return_softmax=True)
+        assert isinstance(out, (tuple, list)) and len(out) == 2, type(out)
+        loss, sm = out
+        np.testing.assert_allclose(
+            sm.numpy(),
+            torch.softmax(torch.tensor(logits), 1).numpy(), rtol=1e-5)
+        want = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels.squeeze(1)),
+            reduction="none").numpy()
+        np.testing.assert_allclose(loss.numpy().squeeze(), want, rtol=1e-5)
