@@ -4,8 +4,9 @@ phi/kernels/autotune/cache.h — the runtime kernel-pick cache).
 On TPU, XLA already autotunes its own fusions, so the one knob the
 framework genuinely owns is Pallas kernel tiling. `autotune_flash_blocks`
 measures the flash-attention (block_q, block_k) candidates for a concrete
-shape ON THE DEVICE, caches the winner keyed by (backend, B, H, S, D,
-causal) — in memory and optionally on disk, the phi AlgorithmsCache role —
+shape ON THE DEVICE, caches the winner keyed by (backend, H, S, D, causal)
+— in memory, in an optional env-path disk cache, and via the shipped
+`ops/pallas/flash_blocks_tuned.json` table, the phi AlgorithmsCache role —
 and `ops.flash_attention` consults the cache on every call.
 
 The reference's dataloader/layout tuning knobs remain config-only (XLA owns
@@ -19,8 +20,16 @@ _config = {"kernel": {"enable": True, "tuning_range": [1, 10]},
            "dataloader": {"enable": False},
            "layout": {"enable": False}}
 
-# (backend, B, H, S, D, causal) -> (block_q, block_k)
+# (backend, H, S, D, causal) -> (block_q, block_k).  Batch size is NOT part
+# of the key: tiling is set by the (S, D, causal) geometry, so a winner tuned
+# at one B serves every batch size (and per-B retuning would be dead weight).
+# _block_cache holds entries tuned IN THIS PROCESS (these get persisted to
+# the env-path file); _disk_cache holds entries loaded from the shipped file
+# and the env-path file (read-only — never written back, so a framework
+# upgrade that improves flash_blocks_tuned.json is never shadowed by a stale
+# frozen copy in the user cache).
 _block_cache = {}
+_disk_cache = {}
 _disk_loaded = False
 _CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
 
@@ -46,25 +55,46 @@ def _cache_path():
     return os.environ.get(_CACHE_ENV, "")
 
 
-def _load_disk_cache():
-    path = _cache_path()
+# Tuned blocks shipped with the framework (the phi role of the bundled
+# cuDNN-heuristics tables): winners measured on real TPU by
+# tools/profile_step.py's sweep get committed here so every process —
+# including ones with no PADDLE_TPU_AUTOTUNE_CACHE env — starts from
+# chip-measured tilings. The env-path cache (per-user/runtime) overrides.
+_SHIPPED_PATH = os.path.join(os.path.dirname(__file__), os.pardir, "ops",
+                             "pallas", "flash_blocks_tuned.json")
+
+
+def _read_cache_file(path):
     if path and os.path.exists(path):
         try:
             with open(path) as f:
-                return {tuple(json.loads(k)): tuple(v)
-                        for k, v in json.load(f).items()}
+                out = {}
+                for k, v in json.load(f).items():
+                    key = tuple(json.loads(k))
+                    if len(key) == 6:      # legacy (backend,B,H,S,D,causal)
+                        key = key[:1] + key[2:]
+                    out[key] = tuple(v)
+                return out
         except (OSError, ValueError):
             return {}
     return {}
+
+
+def _load_disk_cache():
+    merged = _read_cache_file(_SHIPPED_PATH)
+    merged.update(_read_cache_file(_cache_path()))
+    return merged
 
 
 def _save_disk_cache():
     path = _cache_path()
     if path:
         try:
-            # load-then-merge: never clobber entries written by other
-            # processes sharing the cache file
-            merged = _load_disk_cache()
+            # load-then-merge the env-path file only (never clobber entries
+            # written by other processes sharing it; never freeze shipped
+            # entries into the user cache, where they would shadow future
+            # shipped updates)
+            merged = _read_cache_file(path)
             merged.update(_block_cache)
             with open(path, "w") as f:
                 json.dump({json.dumps(list(k)): list(v)
@@ -74,22 +104,34 @@ def _save_disk_cache():
 
 
 def lookup_flash_blocks(B, H, S, D, causal):
-    """Cached (block_q, block_k) for this shape, or None. Honors the
-    kernel.enable knob. The disk cache is read once per process (keeping
-    file IO off the eager dispatch path); entries tuned by other processes
-    after that point become visible on the next process start."""
+    """Cached (block_q, block_k) for this geometry, or None (B is accepted
+    for call-site convenience but is not part of the key). Honors the
+    kernel.enable knob. Disk caches (shipped file + env path) are read once
+    per process (keeping file IO off the eager dispatch path); entries tuned
+    by other processes after that point become visible on the next process
+    start. In-process tuned entries win over disk entries."""
     import jax
     global _disk_loaded
     if not kernel_tuning_enabled():
         return None
-    key = (jax.default_backend(), B, H, S, D, bool(causal))
-    if key not in _block_cache and not _disk_loaded:
-        # one disk read per process (not per miss — this sits on the eager
-        # attention dispatch path); tuning refreshes it on save
-        _block_cache.update({k: v for k, v in _load_disk_cache().items()
-                             if k not in _block_cache})
+    key = (jax.default_backend(), H, S, D, bool(causal))
+    hit = _block_cache.get(key)
+    if hit is not None:
+        return hit
+    if not _disk_loaded:
+        _disk_cache.update(_load_disk_cache())
         _disk_loaded = True
-    return _block_cache.get(key)
+    return _disk_cache.get(key)
+
+
+def record_flash_blocks(H, S, D, causal, blocks):
+    """Record an externally-measured (block_q, block_k) winner for a
+    geometry (tools/profile_step.py's sweep) and persist it to the env-path
+    cache if configured."""
+    import jax
+    key = (jax.default_backend(), H, S, D, bool(causal))
+    _block_cache[key] = tuple(blocks)
+    _save_disk_cache()
 
 
 def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
@@ -103,7 +145,6 @@ def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
 
     from ..ops.pallas.flash_attention import flash_attention
 
-    key = (jax.default_backend(), B, H, S, D, bool(causal))
     hit = lookup_flash_blocks(B, H, S, D, causal)
     if hit is not None:
         return hit
@@ -136,6 +177,5 @@ def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
         from ..ops.pallas.flash_attention import _auto_block
         b = _auto_block(S)           # always divides S (never poisons cache)
         best = (b, b)
-    _block_cache[key] = best
-    _save_disk_cache()
+    record_flash_blocks(H, S, D, causal, best)
     return best

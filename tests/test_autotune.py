@@ -19,6 +19,7 @@ def test_autotune_picks_a_valid_block_and_caches(tmp_path, monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
                        str(tmp_path / "cache.json"))
     autotune._block_cache.clear()
+    autotune._disk_cache.clear()
     autotune._disk_loaded = False
     bq, bk = autotune.autotune_flash_blocks(1, 2, 256, 64, causal=True,
                                             dtype="float32",
@@ -30,6 +31,7 @@ def test_autotune_picks_a_valid_block_and_caches(tmp_path, monkeypatch):
     assert (tmp_path / "cache.json").exists()
     # a fresh process (empty memory cache, disk not yet read) reloads
     autotune._block_cache.clear()
+    autotune._disk_cache.clear()
     autotune._disk_loaded = False
     assert autotune.lookup_flash_blocks(1, 2, 256, 64, True) == (bq, bk)
 
@@ -44,7 +46,7 @@ def test_tuned_blocks_feed_the_flash_entry(monkeypatch):
     from paddle_tpu.ops.flash_attention import _pallas_flash_bhsd
 
     autotune._block_cache.clear()
-    key = (jax.default_backend(), 1, 2, 256, 64, True)
+    key = (jax.default_backend(), 2, 256, 64, True)
     autotune._block_cache[key] = (96, 96)       # 256 % 96 != 0
     q = jnp.ones((1, 2, 256, 64), jnp.float32)
     with pytest.raises(ValueError, match="multiple of block"):

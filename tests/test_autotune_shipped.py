@@ -1,0 +1,96 @@
+"""Shipped flash-block tuning table (the bundled cuDNN-heuristics-table
+role): entries committed into ops/pallas/flash_blocks_tuned.json serve every
+process with no env configured; the env-path cache overrides; saves never
+write shipped entries back into the user cache."""
+import json
+import os
+
+import jax
+import pytest
+
+from paddle_tpu.incubate import autotune
+
+
+@pytest.fixture
+def clean_cache(tmp_path, monkeypatch):
+    """Redirect the shipped path to tmp and reset all cache state."""
+    monkeypatch.setattr(autotune, "_SHIPPED_PATH",
+                        str(tmp_path / "shipped.json"))
+    monkeypatch.delenv("PADDLE_TPU_AUTOTUNE_CACHE", raising=False)
+
+    def reset():
+        autotune._block_cache.clear()
+        autotune._disk_cache.clear()
+        autotune._disk_loaded = False
+
+    reset()
+    yield reset
+    reset()
+
+
+def _write(path, key, blocks):
+    with open(path, "w") as f:
+        json.dump({json.dumps(list(key)): list(blocks)}, f)
+
+
+def test_shipped_file_serves_with_no_env(clean_cache):
+    backend = jax.default_backend()
+    _write(autotune._SHIPPED_PATH, (backend, 16, 1024, 64, True), (256, 512))
+    clean_cache()
+    # B is not part of the key: any batch size hits the tuned geometry
+    assert autotune.lookup_flash_blocks(8, 16, 1024, 64, True) == (256, 512)
+    assert autotune.lookup_flash_blocks(12, 16, 1024, 64, True) == (256, 512)
+    assert autotune.lookup_flash_blocks(8, 16, 2048, 64, True) is None
+
+
+def test_legacy_six_field_keys_still_load(clean_cache):
+    backend = jax.default_backend()
+    # pre-B-drop caches keyed (backend, B, H, S, D, causal)
+    _write(autotune._SHIPPED_PATH, (backend, 8, 16, 1024, 64, True),
+           (512, 256))
+    clean_cache()
+    assert autotune.lookup_flash_blocks(4, 16, 1024, 64, True) == (512, 256)
+
+
+def test_env_cache_overrides_shipped(clean_cache, tmp_path, monkeypatch):
+    backend = jax.default_backend()
+    key = (backend, 16, 1024, 64, True)
+    _write(autotune._SHIPPED_PATH, key, (256, 512))
+    env_path = tmp_path / "user_cache.json"
+    _write(env_path, key, (128, 128))
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(env_path))
+    clean_cache()
+    assert autotune.lookup_flash_blocks(8, 16, 1024, 64, True) == (128, 128)
+
+
+def test_save_never_freezes_shipped_entries(clean_cache, tmp_path,
+                                            monkeypatch):
+    """A tuned entry persists to the env cache WITHOUT dragging shipped
+    entries along — otherwise a framework upgrade improving the shipped
+    table would be shadowed forever by the stale frozen copies."""
+    backend = jax.default_backend()
+    _write(autotune._SHIPPED_PATH, (backend, 16, 1024, 64, True), (256, 512))
+    env_path = tmp_path / "user_cache.json"
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(env_path))
+    clean_cache()
+    # read the shipped entry (loads disk caches), then tune a NEW geometry
+    assert autotune.lookup_flash_blocks(8, 16, 1024, 64, True) == (256, 512)
+    autotune.record_flash_blocks(16, 2048, 64, True, (512, 512))
+    saved = json.load(open(env_path))
+    keys = [tuple(json.loads(k)) for k in saved]
+    assert (backend, 16, 2048, 64, True) in keys
+    assert (backend, 16, 1024, 64, True) not in keys
+    # upgrade the shipped table; fresh process sees the NEW shipped value
+    _write(autotune._SHIPPED_PATH, (backend, 16, 1024, 64, True), (128, 256))
+    clean_cache()
+    assert autotune.lookup_flash_blocks(8, 16, 1024, 64, True) == (128, 256)
+    # and the tuned entry survives via the env cache
+    assert autotune.lookup_flash_blocks(1, 16, 2048, 64, True) == (512, 512)
+
+
+def test_in_process_tuning_wins_over_disk(clean_cache):
+    backend = jax.default_backend()
+    _write(autotune._SHIPPED_PATH, (backend, 16, 1024, 64, True), (256, 512))
+    clean_cache()
+    autotune.record_flash_blocks(16, 1024, 64, True, (128, 128))
+    assert autotune.lookup_flash_blocks(8, 16, 1024, 64, True) == (128, 128)

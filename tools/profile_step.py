@@ -198,9 +198,7 @@ def flash_blocks_sweep(B, S, H=16, D=64):
         best = min(ok, key=lambda r: r[1])[0]
         try:
             from paddle_tpu.incubate import autotune as at
-            key = (jax.default_backend(), B, H, S, D, True)
-            at._block_cache[key] = tuple(best)
-            at._save_disk_cache()
+            at.record_flash_blocks(H, S, D, True, best)
             if at._cache_path():
                 print(f"autotune: recorded flash blocks {best} for "
                       f"(B={B},H={H},S={S},D={D}) -> {at._cache_path()}")
