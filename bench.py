@@ -316,14 +316,55 @@ def main():
         finish(run_config(2, 128, "full", n_steps, on_tpu, scan_k))
         return
 
-    # step-down ladder for the 16GB chip: try fastest configs first.
-    # (B=16 was measured OOM for both none and dots remat on 16GB — r2/r3;
-    # B=12 is untried and worth one compile: +50% tokens/step if it fits.)
-    ladder = [(12, "dots+attn"), (12, "dots"), (8, "dots+attn"),
-              (8, "dots"), (8, "full"), (4, "full"),
-              (2, "full")]
+    # Two-phase ladder for the 16GB chip.
+    # Phase 1 races the near-best configs and reports the FASTEST that fits
+    # (measured r4: B=12 dots 419.9 ms vs dots+attn 428.1 ms — within a few
+    # % of each other and which wins can flip with kernel/tuning changes, so
+    # measure both rather than bake in an ordering). Phase 2 is the OOM
+    # step-down tail where first-success wins (survival mode).
+    # (B=16 was measured OOM for both none and dots remat on 16GB — r2/r3.)
+    race = [(12, "dots"), (12, "dots+attn")]
+    tail = [(8, "dots"), (8, "dots+attn"), (8, "full"), (4, "full"),
+            (2, "full")]
+    best, contenders, errors = None, {}, []
+    for B, remat in race:
+        wd = start_watchdog(rung_budget, f"race rung B={B},remat={remat}")
+        try:
+            try:
+                result = run_config(B, S, remat, n_steps, on_tpu, scan_k)
+                contenders[f"B={B},remat={remat}"] = result["extra"]["step_ms"]
+                if best is None or result["value"] > best[0]["value"]:
+                    best = (result, f"B={B},remat={remat}")
+            except Exception as e:          # noqa: BLE001
+                errors.append((f"B={B},remat={remat}", e))
+                print(f"bench: race rung B={B},remat={remat} failed: "
+                      f"{str(e)[:200]}", file=sys.stderr)
+            # free the finished rung's executable + live buffers before the
+            # next rung compiles: both race configs are near the 16GB limit,
+            # and a retained previous rung would turn a fitting config into
+            # a false OOM. Buffer frees go through the tunnel too, so this
+            # stays INSIDE the rung's watchdog window.
+            gc.collect()
+            jax.clear_caches()
+        finally:
+            wd.cancel()
+    if best is not None:
+        result, rung = best
+        result["extra"]["race"] = contenders
+        if errors:
+            # a rung that failed while the other succeeded is still a
+            # regression signal — it must reach the driver's record, not
+            # just stderr
+            result["extra"]["race_errors"] = {
+                r: f"{type(e).__name__}: {str(e)[:300]}" for r, e in errors}
+        finish(result, rung=rung)
+        return
+    # no race rung succeeded: a non-OOM failure is a real bug — surface it
+    for _, e in errors:
+        if not _is_oom(e):
+            raise e
     last_err = None
-    for B, remat in ladder:
+    for B, remat in tail:
         wd = start_watchdog(rung_budget, f"ladder rung B={B},remat={remat}")
         try:
             result = run_config(B, S, remat, n_steps, on_tpu, scan_k)

@@ -57,16 +57,58 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     return results
 
 
+class _SavedTensors(list):
+    """`ctx.saved_tensor` is a METHOD in the reference
+    (`y, = ctx.saved_tensor()`, autograd/py_layer.py:378); earlier revisions
+    here exposed it as a property. A callable list serves both spellings."""
+
+    def __call__(self):
+        return self
+
+
 class PyLayerContext:
+    """Reference: autograd/py_layer.py EagerPyLayerContext."""
+
     def __init__(self):
-        self._saved = []
+        self._saved = _SavedTensors()
+        self._non_differentiable = []
+        self._not_inplace = []
+        self._materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        self._saved = _SavedTensors(tensors)
 
     @property
     def saved_tensor(self):
         return self._saved
+
+    def mark_not_inplace(self, *args):
+        """Mark tensors that will not be inplaced (reference
+        py_layer.py:410, where it forces a fresh output Variable).  Arrays
+        here are XLA values — ops never alias user-visible storage — so
+        recording the marks is all that's needed for API parity."""
+        self._not_inplace = list(args)
+
+    def mark_non_differentiable(self, *args):
+        """Outputs marked here are treated as stop_gradient: the engine
+        never routes cotangents through them (reference py_layer.py:450)."""
+        self._non_differentiable = list(args)
+
+    def set_materialize_grads(self, value):
+        """When False, backward() receives None (not a zeros tensor) for
+        forward outputs that got no incoming gradient (reference
+        py_layer.py:492)."""
+        self._materialize_grads = bool(value)
+
+
+def once_differentiable(backward):
+    """Decorator for PyLayer.backward forbidding grad-of-grad through it
+    (reference: autograd/py_layer.py:642). Works in either order with
+    @staticmethod (the flag must land on the bare function — apply() reads
+    it through the descriptor)."""
+    fn = backward.__func__ if isinstance(backward, staticmethod) else backward
+    fn._once_differentiable = True
+    return backward
 
 
 class PyLayer:
@@ -92,21 +134,40 @@ class PyLayer:
             return out
         multi = isinstance(out, (tuple, list))
         outs = list(out) if multi else [out]
+        nondiff = {id(t) for t in ctx._non_differentiable}
         for o in outs:
-            o.stop_gradient = False
+            o.stop_gradient = id(o) in nondiff
 
         def vjp_fn(cts):
             ct_list = list(cts) if multi else [cts]
             with no_grad():
-                gins = cls.backward(ctx, *[Tensor(c) for c in ct_list])
+                gins = cls.backward(ctx, *[None if c is None else Tensor(c)
+                                           for c in ct_list])
             gins = gins if isinstance(gins, (tuple, list)) else (gins,)
             return tuple(g._data if isinstance(g, Tensor) else g for g in gins)
+
+        def vjp_fn_tape(cts):
+            """create_graph mode: run the user backward with the tape LIVE,
+            so its ops (including uses of ctx-saved tensors, which are the
+            primal-connected Tensors) record — grads of grads flow back to
+            the primals instead of being structurally zero."""
+            ct_list = list(cts) if multi else [cts]
+            gins = cls.backward(ctx, *ct_list)
+            gins = gins if isinstance(gins, (tuple, list)) else (gins,)
+            return tuple(gins)
 
         # align vjp outputs with ALL tensor inputs; the engine skips the
         # stop_gradient ones when accumulating
         node = Node(vjp_fn, tensor_inputs, outs, multi, name=cls.__name__)
+        node.materialize = ctx._materialize_grads
+        node.vjp_fn_tape = vjp_fn_tape
+        node.once_differentiable = getattr(cls.backward,
+                                           "_once_differentiable", False)
         for o in outs:
-            o._node = node
+            # non-differentiable outputs stay detached: downstream use of
+            # them contributes no gradient path back into this node
+            if id(o) not in nondiff:
+                o._node = node
         return out
 
     @staticmethod

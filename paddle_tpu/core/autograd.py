@@ -50,7 +50,8 @@ class Node:
     """One tape entry: the vjp closure of a single traced op."""
 
     __slots__ = ("vjp_fn", "inputs", "outputs", "multi_output", "name", "fwd",
-                 "input_versions")
+                 "input_versions", "materialize", "once_differentiable",
+                 "vjp_fn_tape")
 
     # unhashable on purpose: double-grad records vjp calls through apply_op
     # with the Node in a closure cell, and an identity-hashed Node would fill
@@ -64,6 +65,20 @@ class Node:
         self.outputs = outputs      # list[Tensor]
         self.multi_output = multi_output
         self.name = name
+        # PyLayer knobs (reference EagerPyLayerContext): materialize=False
+        # passes None (not zeros) for outputs with no incoming cotangent;
+        # once_differentiable forbids building a grad-of-grad graph through
+        # this node
+        self.materialize = True
+        self.once_differentiable = False
+        # optional create_graph-mode vjp: runs the user backward WITH the
+        # tape recording (cotangents as live Tensors), so grads-of-grads
+        # flow through saved tensors back to the primals.  Without it, a
+        # fwd=None node's vjp under create_graph is re-recorded via
+        # apply_op, where saved residuals are closure constants and second
+        # order through them is structurally zero — fine for engine-internal
+        # nodes (those set fwd), wrong for user PyLayers.
+        self.vjp_fn_tape = None
         # inplace-version snapshot of each input (reference: eager
         # TensorWrapper::recover checks wrapper_version_snapshot): backward
         # raises if an input was mutated in place after this op recorded it
@@ -168,16 +183,33 @@ def run_backward(tensor, grad=None, retain_graph=False, create_graph=False,
         cts = [cotangents.pop(id(o), None) for o in node.outputs]
         if all(c is None for c in cts):
             continue
-        cts = [c if c is not None else zero_like(o)
-               for c, o in zip(cts, node.outputs)]
+        if node.materialize or create_graph:
+            # create_graph always materializes: the recorded grad-op's
+            # inputs must be arrays, not holes
+            cts = [c if c is not None else zero_like(o)
+                   for c, o in zip(cts, node.outputs)]
         # cotangents of this node's outputs are final here (reverse topo):
         # fire hooks, record captures
         for o, i in zip(node.outputs, range(len(cts))):
+            if cts[i] is None:
+                continue
             cts[i] = _apply_hooks(o, cts[i], create_graph)
             if capture and id(o) in capture:
                 captured[id(o)] = cts[i]
         if create_graph:
-            if node.fwd is not None:
+            if node.once_differentiable:
+                raise RuntimeError(
+                    f"grad of grad through once_differentiable backward "
+                    f"'{node.name}' is not allowed (reference: "
+                    f"autograd/py_layer.py once_differentiable)")
+            if node.vjp_fn_tape is not None:
+                tcts = [c if isinstance(c, Tensor)
+                        else Tensor(c, stop_gradient=False) for c in cts]
+                in_grads = node.vjp_fn_tape(
+                    tuple(tcts) if node.multi_output else tcts[0])
+                if not isinstance(in_grads, tuple):
+                    in_grads = (in_grads,)
+            elif node.fwd is not None:
                 # differentiate-through-backward: rebuild the vjp from the
                 # primal inputs so d(grad)/d(primal) is on the tape
                 n_in = len(node.inputs)
@@ -201,7 +233,9 @@ def run_backward(tensor, grad=None, retain_graph=False, create_graph=False,
             seed = tuple(cts) if node.multi_output else cts[0]
             in_grads = node.vjp_fn(seed)
         for inp, g in zip(node.inputs, in_grads):
-            if inp.stop_gradient:
+            if inp.stop_gradient or g is None:
+                # None from a user backward (PyLayer) = "no grad for this
+                # input" (reference py_layer: returned None is skipped)
                 continue
             key = id(inp)
             if inp._node is None:

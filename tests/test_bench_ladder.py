@@ -1,0 +1,84 @@
+"""bench.py ladder semantics: the race phase measures the near-best configs
+and reports the fastest; OOM-class failures fall to the step-down tail;
+non-OOM failures surface as real errors (never silently stepped over)."""
+import os
+
+import pytest
+
+
+@pytest.fixture
+def bench_mocked(monkeypatch):
+    import jax
+
+    import bench
+
+    monkeypatch.setenv("BENCH_SKIP_PREFLIGHT", "1")
+    emitted = []
+    monkeypatch.setattr(bench, "probe_backend", lambda *a, **k: "tpu")
+    monkeypatch.setattr(bench, "emit",
+                        lambda v, vb, extra=None: emitted.append((v, extra)))
+    monkeypatch.setattr(bench, "flash_parity_preflight", lambda S: {})
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    return bench, emitted
+
+
+def test_race_reports_fastest_config(bench_mocked, monkeypatch):
+    bench, emitted = bench_mocked
+    calls = []
+
+    def fake(B, S, remat, n_steps, on_tpu, scan_k):
+        calls.append((B, remat))
+        ms = {"dots": 419.9, "dots+attn": 428.1}[remat]
+        return {"value": 0.4199 / ms * 419.9 if remat == "dots" else 0.332,
+                "vs_baseline": 0.8,
+                "extra": {"step_ms": ms}} if B == 12 else None
+
+    monkeypatch.setattr(bench, "run_config", fake)
+    bench.main()
+    v, extra = emitted[0]
+    assert extra["ladder_rung"] == "B=12,remat=dots"
+    assert set(extra["race"]) == {"B=12,remat=dots", "B=12,remat=dots+attn"}
+    assert calls == [(12, "dots"), (12, "dots+attn")]
+
+
+def test_oom_race_falls_to_tail_first_success(bench_mocked, monkeypatch):
+    bench, emitted = bench_mocked
+
+    def fake(B, S, remat, n_steps, on_tpu, scan_k):
+        if B == 12:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return {"value": 0.30, "vs_baseline": 0.75, "extra": {"step_ms": 300.0}}
+
+    monkeypatch.setattr(bench, "run_config", fake)
+    bench.main()
+    _, extra = emitted[0]
+    assert extra["ladder_rung"] == "B=8,remat=dots"
+    assert "race" not in extra
+
+
+def test_non_oom_failure_raises(bench_mocked, monkeypatch):
+    bench, emitted = bench_mocked
+
+    def fake(B, S, remat, n_steps, on_tpu, scan_k):
+        raise ValueError("some real bug")
+
+    monkeypatch.setattr(bench, "run_config", fake)
+    with pytest.raises(ValueError, match="real bug"):
+        bench.main()
+    assert not emitted
+
+
+def test_race_error_with_other_success_lands_in_extra(bench_mocked,
+                                                      monkeypatch):
+    bench, emitted = bench_mocked
+
+    def fake(B, S, remat, n_steps, on_tpu, scan_k):
+        if remat == "dots+attn":
+            raise AssertionError("impossible MFU 1.2: measurement is broken")
+        return {"value": 0.33, "vs_baseline": 0.82, "extra": {"step_ms": 420.0}}
+
+    monkeypatch.setattr(bench, "run_config", fake)
+    bench.main()
+    _, extra = emitted[0]
+    assert extra["ladder_rung"] == "B=12,remat=dots"
+    assert "impossible MFU" in extra["race_errors"]["B=12,remat=dots+attn"]

@@ -1,0 +1,215 @@
+"""PyLayer context completeness (reference: python/paddle/autograd/py_layer.py
+EagerPyLayerContext:340-542 + once_differentiable:642): saved_tensor as a
+method, mark_non_differentiable, set_materialize_grads, mark_not_inplace,
+None-grad returns, once_differentiable."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, once_differentiable
+
+
+class CusTanh(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        y = paddle.tanh(x)
+        ctx.save_for_backward(y)
+        return y
+
+    @staticmethod
+    def backward(ctx, dy):
+        (y,) = ctx.saved_tensor()          # reference spelling: a METHOD
+        return dy * (1 - paddle.square(y))
+
+
+def test_saved_tensor_callable_and_property():
+    x = paddle.to_tensor(np.array([0.3, -0.7], "float32"),
+                         stop_gradient=False)
+    y = CusTanh.apply(x)
+    y.sum().backward()
+    expect = 1 - np.tanh([0.3, -0.7]) ** 2
+    np.testing.assert_allclose(np.asarray(x.grad), expect, rtol=1e-6)
+
+
+def test_mark_non_differentiable():
+    class SplitOut(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            a = x * 2.0
+            aux = paddle.round(x)          # integer-ish aux output
+            ctx.mark_non_differentiable(aux)
+            return a, aux
+
+        @staticmethod
+        def backward(ctx, da, daux):
+            # daux arrives as zeros (materialized default) and must not
+            # influence the input grad
+            return da * 2.0
+
+    x = paddle.to_tensor(np.array([1.4, 2.6], "float32"), stop_gradient=False)
+    a, aux = SplitOut.apply(x)
+    assert aux.stop_gradient
+    # using BOTH outputs downstream: aux contributes no gradient path
+    (a.sum() + aux.sum().astype("float32")).backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [2.0, 2.0], rtol=1e-6)
+
+
+def test_set_materialize_grads_false_passes_none():
+    seen = {}
+
+    class TwoOut(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.set_materialize_grads(False)
+            return x * 1.0, x * 3.0
+
+        @staticmethod
+        def backward(ctx, d0, d1):
+            seen["d1_is_none"] = d1 is None
+            g = d0 * 1.0
+            return g
+
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y0, y1 = TwoOut.apply(x)
+    y0.sum().backward()                    # y1 unused -> its cotangent absent
+    assert seen["d1_is_none"] is True
+    np.testing.assert_allclose(np.asarray(x.grad), [1.0])
+
+
+def test_materialized_default_passes_zeros():
+    seen = {}
+
+    class TwoOut(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 1.0, x * 3.0
+
+        @staticmethod
+        def backward(ctx, d0, d1):
+            seen["d1"] = None if d1 is None else np.asarray(d1)
+            return d0 * 1.0
+
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y0, y1 = TwoOut.apply(x)
+    y0.sum().backward()
+    np.testing.assert_allclose(seen["d1"], [0.0])
+
+
+def test_backward_none_return_skips_input():
+    class TwoIn(PyLayer):
+        @staticmethod
+        def forward(ctx, x, w):
+            return x * w
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2.0, None          # no grad for w
+
+    x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.array([5.0], "float32"), stop_gradient=False)
+    y = TwoIn.apply(x, w)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [2.0])
+    assert w.grad is None
+
+
+def test_once_differentiable_blocks_double_grad():
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        @once_differentiable
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2.0 * x
+
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y = Sq.apply(x)
+    (g,) = paddle.autograd.grad(y.sum(), x, create_graph=False)
+    np.testing.assert_allclose(np.asarray(g), [4.0])
+    y2 = Sq.apply(x)
+    with pytest.raises(RuntimeError, match="once_differentiable"):
+        paddle.autograd.grad(y2.sum(), x, create_graph=True)
+
+
+def test_mark_not_inplace_records():
+    class Ident(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.mark_not_inplace(x)
+            return x * 1.0
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy
+
+    x = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    Ident.apply(x).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad), [1.0])
+
+
+def test_double_grad_through_pylayer_saved_input():
+    """create_graph runs the user backward with the tape live: d2/dx2 of
+    x*x via a PyLayer that saves its INPUT is 2, not silently 0."""
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2.0 * x
+
+    x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    y = Sq.apply(x)
+    (g,) = paddle.autograd.grad(y.sum(), x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(g), [6.0])
+    (gg,) = paddle.autograd.grad(g.sum(), x)
+    np.testing.assert_allclose(np.asarray(gg), [2.0])
+
+
+def test_double_grad_none_return_under_create_graph():
+    """A None grad from the user backward must survive create_graph=True
+    untouched (not become an object-dtype array)."""
+    class TwoIn(PyLayer):
+        @staticmethod
+        def forward(ctx, x, w):
+            ctx.save_for_backward(w)
+            return x * w
+
+        @staticmethod
+        def backward(ctx, dy):
+            (w,) = ctx.saved_tensor()
+            return dy * w, None
+
+    x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.array([5.0], "float32"), stop_gradient=False)
+    y = TwoIn.apply(x, w) + w
+    gx, gw = paddle.autograd.grad(y.sum(), [x, w], create_graph=True,
+                                  allow_unused=True)
+    np.testing.assert_allclose(np.asarray(gx), [5.0])
+    # w's grad comes only from the explicit + w branch (PyLayer returned
+    # None for it)
+    np.testing.assert_allclose(np.asarray(gw), [1.0])
+
+
+def test_once_differentiable_order_with_staticmethod():
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * x
+
+        @once_differentiable            # above @staticmethod
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2.0
+
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y = Sq.apply(x)
+    with pytest.raises(RuntimeError, match="once_differentiable"):
+        paddle.autograd.grad(y.sum(), x, create_graph=True)
