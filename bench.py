@@ -173,7 +173,7 @@ def flash_parity_preflight(S, dtype="bfloat16"):
             "flash_parity_ok": bool(fwd_err < 0.05 and grad_err < 0.25)}
 
 
-def run_config(B, S, remat, n_steps, on_tpu, scan_k):
+def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
     import jax
     import jax.numpy as jnp
 
@@ -186,7 +186,10 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k):
         compute_dtype="bfloat16" if on_tpu else "float32",
         remat={"none": False, "full": True, "dots": "dots",
                "dots+attn": "dots+attn"}[remat],
-        scan_unroll=int(os.environ.get("BENCH_UNROLL", 1)))
+        scan_unroll=int(os.environ.get("BENCH_UNROLL", 1)),
+        # chunked fused linear-CE: 50304 = 8 x 6288; frees the multi-GB f32
+        # logits tensors (ops/fused_ce.py)
+        fused_ce_chunks=8 if fused_ce else 0)
 
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=2e-4)
@@ -253,6 +256,7 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k):
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"tokens_per_sec": round(tokens_per_sec, 1),
                   "params": n_params, "batch": B, "seq": S, "remat": remat,
+                  "fused_ce": bool(fused_ce),
                   "backend": jax.default_backend(),
                   "n_steps": total_steps, "scan_k": scan_k,
                   "step_ms": round(1000 * dt / total_steps, 1),
@@ -305,9 +309,11 @@ def main():
         # explicit config: no ladder, fail loudly
         B = int(os.environ.get("BENCH_B", 16 if on_tpu else 2))
         remat = os.environ.get("BENCH_REMAT", "dots" if on_tpu else "full")
+        fused = os.environ.get("BENCH_FUSED_CE") == "1"
         wd = start_watchdog(rung_budget, f"explicit config B={B}")
         try:
-            finish(run_config(B, S, remat, n_steps, on_tpu, scan_k))
+            finish(run_config(B, S, remat, n_steps, on_tpu, scan_k,
+                              fused_ce=fused))
         finally:
             wd.cancel()
         return
@@ -323,21 +329,27 @@ def main():
     # measure both rather than bake in an ordering). Phase 2 is the OOM
     # step-down tail where first-success wins (survival mode).
     # (B=16 was measured OOM for both none and dots remat on 16GB — r2/r3.)
-    race = [(12, "dots"), (12, "dots+attn")]
-    tail = [(8, "dots"), (8, "dots+attn"), (8, "full"), (4, "full"),
-            (2, "full")]
+    # rung = (B, remat, fused_ce). fused_ce chunks the LM-head loss so the
+    # multi-GB f32 logits never materialize — at B=12 it should shave loss
+    # time; the freed memory is what makes B=16 worth one compile attempt.
+    race = [(16, "dots", True), (12, "dots", True), (12, "dots", False),
+            (12, "dots+attn", False)]
+    tail = [(8, "dots", True), (8, "dots", False), (8, "dots+attn", False),
+            (8, "full", False), (4, "full", False), (2, "full", False)]
     best, contenders, errors = None, {}, []
-    for B, remat in race:
-        wd = start_watchdog(rung_budget, f"race rung B={B},remat={remat}")
+    for B, remat, fused in race:
+        rung_name = f"B={B},remat={remat}" + (",fused_ce" if fused else "")
+        wd = start_watchdog(rung_budget, f"race rung {rung_name}")
         try:
             try:
-                result = run_config(B, S, remat, n_steps, on_tpu, scan_k)
-                contenders[f"B={B},remat={remat}"] = result["extra"]["step_ms"]
+                result = run_config(B, S, remat, n_steps, on_tpu, scan_k,
+                                    fused_ce=fused)
+                contenders[rung_name] = result["extra"]["step_ms"]
                 if best is None or result["value"] > best[0]["value"]:
-                    best = (result, f"B={B},remat={remat}")
+                    best = (result, rung_name)
             except Exception as e:          # noqa: BLE001
-                errors.append((f"B={B},remat={remat}", e))
-                print(f"bench: race rung B={B},remat={remat} failed: "
+                errors.append((rung_name, e))
+                print(f"bench: race rung {rung_name} failed: "
                       f"{str(e)[:200]}", file=sys.stderr)
             # free the finished rung's executable + live buffers before the
             # next rung compiles: both race configs are near the 16GB limit,
@@ -364,12 +376,14 @@ def main():
         if not _is_oom(e):
             raise e
     last_err = None
-    for B, remat in tail:
-        wd = start_watchdog(rung_budget, f"ladder rung B={B},remat={remat}")
+    for B, remat, fused in tail:
+        rung_name = f"B={B},remat={remat}" + (",fused_ce" if fused else "")
+        wd = start_watchdog(rung_budget, f"ladder rung {rung_name}")
         try:
-            result = run_config(B, S, remat, n_steps, on_tpu, scan_k)
+            result = run_config(B, S, remat, n_steps, on_tpu, scan_k,
+                                fused_ce=fused)
             wd.cancel()
-            finish(result, rung=f"B={B},remat={remat}")
+            finish(result, rung=rung_name)
             return
         except Exception as e:          # noqa: BLE001
             wd.cancel()
@@ -377,8 +391,8 @@ def main():
                 raise
             # keep the real exception text: a compile-service failure matches
             # _is_oom too, and a fabricated "OOM" diagnosis would bury it
-            last_err = f"B={B},remat={remat}: {str(e)[:500]}"
-            print(f"bench: OOM-class failure at B={B},remat={remat}; "
+            last_err = f"{rung_name}: {str(e)[:500]}"
+            print(f"bench: OOM-class failure at {rung_name}; "
                   f"stepping down", file=sys.stderr)
             gc.collect()
             jax.clear_caches()

@@ -138,13 +138,24 @@ class PyLayer:
         for o in outs:
             o.stop_gradient = id(o) in nondiff
 
+        def _check_arity(gins):
+            if len(gins) != len(tensor_inputs):
+                raise ValueError(
+                    f"(InvalidArgument) {cls.__name__}.backward returned "
+                    f"{len(gins)} gradient(s) but forward took "
+                    f"{len(tensor_inputs)} tensor input(s) (reference "
+                    f"py_layer arity check; return None for inputs that "
+                    f"need no gradient)")
+            return gins
+
         def vjp_fn(cts):
             ct_list = list(cts) if multi else [cts]
             with no_grad():
                 gins = cls.backward(ctx, *[None if c is None else Tensor(c)
                                            for c in ct_list])
             gins = gins if isinstance(gins, (tuple, list)) else (gins,)
-            return tuple(g._data if isinstance(g, Tensor) else g for g in gins)
+            return tuple(g._data if isinstance(g, Tensor) else g
+                         for g in _check_arity(gins))
 
         def vjp_fn_tape(cts):
             """create_graph mode: run the user backward with the tape LIVE,
@@ -154,7 +165,7 @@ class PyLayer:
             ct_list = list(cts) if multi else [cts]
             gins = cls.backward(ctx, *ct_list)
             gins = gins if isinstance(gins, (tuple, list)) else (gins,)
-            return tuple(gins)
+            return tuple(_check_arity(gins))
 
         # align vjp outputs with ALL tensor inputs; the engine skips the
         # stop_gradient ones when accumulating

@@ -198,11 +198,40 @@ def run_backward(tensor, grad=None, retain_graph=False, create_graph=False,
                 captured[id(o)] = cts[i]
         if create_graph:
             if node.once_differentiable:
-                raise RuntimeError(
-                    f"grad of grad through once_differentiable backward "
-                    f"'{node.name}' is not allowed (reference: "
-                    f"autograd/py_layer.py once_differentiable)")
-            if node.vjp_fn_tape is not None:
+                # the FIRST-order grad must still succeed under
+                # create_graph (the pass may be differentiating an
+                # unrelated branch); the error fires only if these grads
+                # are themselves differentiated (reference/torch
+                # once_differentiable semantics)
+                raw = [c._data if isinstance(c, Tensor) else c for c in cts]
+                gs = node.vjp_fn(tuple(raw) if node.multi_output else raw[0])
+                if not isinstance(gs, tuple):
+                    gs = (gs,)
+                name = node.name
+
+                def poison(_seeds, _name=name):
+                    raise RuntimeError(
+                        f"grad of grad through once_differentiable backward "
+                        f"'{_name}' is not allowed (reference: "
+                        f"autograd/py_layer.py once_differentiable)")
+
+                in_grads = []
+                poisoned_outs = []
+                for g in gs:
+                    if g is None:
+                        in_grads.append(None)
+                    else:
+                        tg = Tensor(g, stop_gradient=False)
+                        in_grads.append(tg)
+                        poisoned_outs.append(tg)
+                if poisoned_outs:
+                    pnode = Node(poison, list(node.inputs), poisoned_outs,
+                                 len(poisoned_outs) > 1,
+                                 name=f"once_differentiable:{name}")
+                    for tg in poisoned_outs:
+                        tg._node = pnode
+                in_grads = tuple(in_grads)
+            elif node.vjp_fn_tape is not None:
                 tcts = [c if isinstance(c, Tensor)
                         else Tensor(c, stop_gradient=False) for c in cts]
                 in_grads = node.vjp_fn_tape(

@@ -1,0 +1,107 @@
+"""Fused linear + softmax cross-entropy over vocab chunks.
+
+The reference fuses softmax+CE per shard (c_softmax_with_cross_entropy,
+paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu) but
+still materializes the full (tokens, vocab) logits tensor. On TPU the LM
+head is HBM-bound, not FLOP-bound: at GPT-350M bench shape the f32 logits
+are ~2.5 GB and the autodiff softmax saves/rereads tensors of the same
+size. This op never materializes logits — a lax.scan over vocab chunks
+keeps one (tokens, V/chunks) tile live, accumulating the running max and
+sum-exp online (the flash-attention recipe applied to the classifier), and
+the backward recomputes each chunk's logits from the saved activations.
+
+Net effect per step at bench shape: several GB less HBM traffic and ~2.5GB
+less peak memory for one extra logits matmul of recompute FLOPs.
+
+Numerics: bf16 operands, f32 accumulation/statistics throughout — the
+same contract as the unfused `_logits_matmul` path; backward cotangents
+are cast to the operand dtype so the two big matmuls stay at bf16 MXU rate.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_logits(h, wc):
+    """(T, H) @ (Vc, H)^T -> (T, Vc) f32 accumulation."""
+    return jnp.einsum("th,vh->tv", h, wc, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(h, wte, labels, num_chunks):
+    """Per-token NLL of softmax(h @ wte^T) at `labels`, chunked over vocab.
+
+    h: (T, H); wte: (V, H) with V % num_chunks == 0; labels: (T,) int.
+    Returns (T,) f32 per-token loss.
+    """
+    nll, _ = _fwd(h, wte, labels, num_chunks)
+    return nll
+
+
+def _fwd(h, wte, labels, num_chunks):
+    T, H = h.shape
+    V = wte.shape[0]
+    if V % num_chunks:
+        raise ValueError(
+            f"(InvalidArgument) fused_linear_cross_entropy: vocab {V} "
+            f"not divisible by num_chunks {num_chunks}")
+    Vc = V // num_chunks
+    wch = wte.reshape(num_chunks, Vc, H)
+    li = labels.astype(jnp.int32)
+
+    def body(carry, args):
+        m, s, picked = carry
+        wc, c = args
+        lg = _chunk_logits(h, wc)                       # (T, Vc) f32
+        mc = jnp.max(lg, axis=-1)
+        nm = jnp.maximum(m, mc)
+        s = s * jnp.exp(m - nm) + jnp.sum(
+            jnp.exp(lg - nm[:, None]), axis=-1)
+        lid = li - c * Vc
+        ok = (lid >= 0) & (lid < Vc)
+        pk = jnp.take_along_axis(
+            lg, jnp.clip(lid, 0, Vc - 1)[:, None], axis=-1)[:, 0]
+        picked = jnp.where(ok, pk, picked)
+        return (nm, s, picked), None
+
+    init = (jnp.full((T,), -jnp.inf, jnp.float32),
+            jnp.zeros((T,), jnp.float32),
+            jnp.zeros((T,), jnp.float32))
+    (m, s, picked), _ = jax.lax.scan(
+        body, init, (wch, jnp.arange(num_chunks, dtype=jnp.int32)))
+    logz = m + jnp.log(s)
+    return logz - picked, (h, wte, li, logz)
+
+
+def _bwd(num_chunks, res, g):
+    h, wte, li, logz = res
+    T, H = h.shape
+    V = wte.shape[0]
+    Vc = V // num_chunks
+    wch = wte.reshape(num_chunks, Vc, H)
+    gf = g.astype(jnp.float32)
+
+    def body(dh, args):
+        wc, c = args
+        lg = _chunk_logits(h, wc)                       # recompute (T, Vc)
+        p = jnp.exp(lg - logz[:, None])                 # softmax chunk
+        lid = li - c * Vc
+        ok = (lid >= 0) & (lid < Vc)
+        onehot = (jnp.clip(lid, 0, Vc - 1)[:, None]
+                  == jnp.arange(Vc, dtype=jnp.int32)[None, :]) & ok[:, None]
+        coeff = (gf[:, None] * (p - onehot)).astype(h.dtype)   # (T, Vc) bf16
+        dh = dh + jnp.einsum("tv,vh->th", coeff, wc,
+                             preferred_element_type=jnp.float32)
+        dwc = jnp.einsum("tv,th->vh", coeff, h,
+                         preferred_element_type=jnp.float32) \
+            .astype(wte.dtype)
+        return dh, dwc
+
+    dh0 = jnp.zeros((T, H), jnp.float32)
+    dh, dws = jax.lax.scan(
+        body, dh0, (wch, jnp.arange(num_chunks, dtype=jnp.int32)))
+    return dh.astype(h.dtype), dws.reshape(V, H), None
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)

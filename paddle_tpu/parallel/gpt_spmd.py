@@ -60,6 +60,12 @@ class GPTSpmdConfig:
     # adjacent blocks (weight prefetch overlapping compute) at the cost of
     # program size; values measured via tools/profile_step.py
     scan_unroll: int = 1
+    # >1 enables the chunked fused linear-CE LM head (ops/fused_ce.py):
+    # logits never materialize, saving ~2.5GB peak f32 at the bench shape
+    # for one extra logits matmul of backward recompute. mp=1 only (the
+    # vocab-parallel path shards the same memory mp ways instead). Must
+    # divide vocab_size.
+    fused_ce_chunks: int = 0
 
     def __post_init__(self):
         if self.ffn is None:
@@ -67,6 +73,11 @@ class GPTSpmdConfig:
         if int(self.scan_unroll) < 1:
             raise ValueError(
                 f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if int(self.fused_ce_chunks) > 1 and \
+                self.vocab_size % int(self.fused_ce_chunks):
+            raise ValueError(
+                f"fused_ce_chunks {self.fused_ce_chunks} must divide "
+                f"vocab_size {self.vocab_size}")
 
 
 @dataclass
@@ -379,6 +390,15 @@ def _vocab_parallel_loss(h, labels, params, cfg, plan):
     h = _ln(h, params["lnf_w"], params["lnf_b"])
     h = _mp_copy(h, plan)
     wte = params["wte"]                            # (V/mp, H) local
+    if plan.mp == 1 and cfg.fused_ce_chunks > 1:
+        # chunked fused linear-CE: logits never materialize (HBM-bound LM
+        # head -> online logsumexp over vocab chunks; ops/fused_ce.py)
+        from ..ops.fused_ce import fused_linear_cross_entropy
+        B, S, H = h.shape
+        nll = fused_linear_cross_entropy(
+            h.reshape(B * S, H), wte, labels.reshape(B * S),
+            cfg.fused_ce_chunks)
+        return jnp.mean(nll)
     # bf16 operands, f32 accumulation: full MXU rate with f32-safe softmax
     # statistics downstream (vs. upcasting operands, which halves+ MXU
     # throughput for the biggest matmul in the model)

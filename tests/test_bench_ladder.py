@@ -26,40 +26,45 @@ def test_race_reports_fastest_config(bench_mocked, monkeypatch):
     bench, emitted = bench_mocked
     calls = []
 
-    def fake(B, S, remat, n_steps, on_tpu, scan_k):
-        calls.append((B, remat))
-        ms = {"dots": 419.9, "dots+attn": 428.1}[remat]
-        return {"value": 0.4199 / ms * 419.9 if remat == "dots" else 0.332,
-                "vs_baseline": 0.8,
-                "extra": {"step_ms": ms}} if B == 12 else None
+    def fake(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
+        calls.append((B, remat, fused_ce))
+        if B == 16:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        ms = {(True, "dots"): 400.0, (False, "dots"): 419.9,
+              (False, "dots+attn"): 428.1}[(fused_ce, remat)]
+        return {"value": round(419.9 / ms * 0.339, 4), "vs_baseline": 0.8,
+                "extra": {"step_ms": ms}}
 
     monkeypatch.setattr(bench, "run_config", fake)
     bench.main()
     v, extra = emitted[0]
-    assert extra["ladder_rung"] == "B=12,remat=dots"
-    assert set(extra["race"]) == {"B=12,remat=dots", "B=12,remat=dots+attn"}
-    assert calls == [(12, "dots"), (12, "dots+attn")]
+    assert extra["ladder_rung"] == "B=12,remat=dots,fused_ce"
+    assert set(extra["race"]) == {"B=12,remat=dots,fused_ce",
+                                  "B=12,remat=dots", "B=12,remat=dots+attn"}
+    assert "B=16,remat=dots,fused_ce" in extra["race_errors"]
+    assert calls == [(16, "dots", True), (12, "dots", True),
+                     (12, "dots", False), (12, "dots+attn", False)]
 
 
 def test_oom_race_falls_to_tail_first_success(bench_mocked, monkeypatch):
     bench, emitted = bench_mocked
 
-    def fake(B, S, remat, n_steps, on_tpu, scan_k):
-        if B == 12:
+    def fake(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
+        if B >= 12:
             raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
         return {"value": 0.30, "vs_baseline": 0.75, "extra": {"step_ms": 300.0}}
 
     monkeypatch.setattr(bench, "run_config", fake)
     bench.main()
     _, extra = emitted[0]
-    assert extra["ladder_rung"] == "B=8,remat=dots"
+    assert extra["ladder_rung"] == "B=8,remat=dots,fused_ce"
     assert "race" not in extra
 
 
 def test_non_oom_failure_raises(bench_mocked, monkeypatch):
     bench, emitted = bench_mocked
 
-    def fake(B, S, remat, n_steps, on_tpu, scan_k):
+    def fake(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         raise ValueError("some real bug")
 
     monkeypatch.setattr(bench, "run_config", fake)
@@ -72,7 +77,7 @@ def test_race_error_with_other_success_lands_in_extra(bench_mocked,
                                                       monkeypatch):
     bench, emitted = bench_mocked
 
-    def fake(B, S, remat, n_steps, on_tpu, scan_k):
+    def fake(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         if remat == "dots+attn":
             raise AssertionError("impossible MFU 1.2: measurement is broken")
         return {"value": 0.33, "vs_baseline": 0.82, "extra": {"step_ms": 420.0}}
@@ -80,5 +85,5 @@ def test_race_error_with_other_success_lands_in_extra(bench_mocked,
     monkeypatch.setattr(bench, "run_config", fake)
     bench.main()
     _, extra = emitted[0]
-    assert extra["ladder_rung"] == "B=12,remat=dots"
+    assert extra["ladder_rung"] == "B=16,remat=dots,fused_ce"
     assert "impossible MFU" in extra["race_errors"]["B=12,remat=dots+attn"]

@@ -130,9 +130,14 @@ def test_once_differentiable_blocks_double_grad():
     y = Sq.apply(x)
     (g,) = paddle.autograd.grad(y.sum(), x, create_graph=False)
     np.testing.assert_allclose(np.asarray(g), [4.0])
+    # first-order grad under create_graph SUCCEEDS (the error is deferred:
+    # reference/torch once_differentiable poisons the produced grads)...
     y2 = Sq.apply(x)
+    (g2,) = paddle.autograd.grad(y2.sum(), x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(g2), [4.0])
+    # ...and fires only when those grads are differentiated again
     with pytest.raises(RuntimeError, match="once_differentiable"):
-        paddle.autograd.grad(y2.sum(), x, create_graph=True)
+        paddle.autograd.grad(g2.sum(), x)
 
 
 def test_mark_not_inplace_records():
@@ -211,5 +216,50 @@ def test_once_differentiable_order_with_staticmethod():
 
     x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
     y = Sq.apply(x)
+    (g,) = paddle.autograd.grad(y.sum(), x, create_graph=True)
     with pytest.raises(RuntimeError, match="once_differentiable"):
-        paddle.autograd.grad(y.sum(), x, create_graph=True)
+        paddle.autograd.grad(g.sum(), x)
+
+
+def test_once_differentiable_unrelated_branch_penalty():
+    """Gradient penalty on a DIFFERENT branch must work even when a
+    once_differentiable PyLayer feeds the same loss (the raise is deferred
+    to an actual second differentiation of the PyLayer's grads)."""
+    class Lin(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 3.0
+
+        @staticmethod
+        @once_differentiable
+        def backward(ctx, dy):
+            return dy * 3.0
+
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    loss = Lin.apply(x).sum() + (x * x).sum()
+    (g,) = paddle.autograd.grad(loss, x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(g), [7.0])     # 3 + 2x
+    # second grad only flows through the x*x branch: d/dx(7 -> 3+2x) = 2,
+    # and the PyLayer's contribution (constant 3) is non-differentiable —
+    # but since its grad is a CONSTANT w.r.t. x, the reference errors only
+    # if the poisoned grad is actually traversed; here it is (g includes
+    # the PyLayer grad as an addend), so the raise is correct
+    with pytest.raises(RuntimeError, match="once_differentiable"):
+        paddle.autograd.grad(g.sum(), x)
+
+
+def test_backward_arity_mismatch_raises():
+    class TwoIn(PyLayer):
+        @staticmethod
+        def forward(ctx, x, w):
+            return x * w
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2.0                 # WRONG: one grad for two inputs
+
+    x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.array([5.0], "float32"), stop_gradient=False)
+    y = TwoIn.apply(x, w)
+    with pytest.raises(ValueError, match="backward returned 1"):
+        y.sum().backward()

@@ -48,7 +48,7 @@ def _sync(out):
         np.asarray(jax.device_get(leaves[0]))
 
 
-def build(B, S, remat, lr=2e-4, unroll=1):
+def build(B, S, remat, lr=2e-4, unroll=1, fused_ce=False):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
@@ -65,7 +65,8 @@ def build(B, S, remat, lr=2e-4, unroll=1):
         compute_dtype="bfloat16" if on_tpu else "float32",
         remat={"none": False, "full": True, "dots": "dots",
                "dots+attn": "dots+attn"}[remat],
-        scan_unroll=unroll)
+        scan_unroll=unroll,
+        fused_ce_chunks=8 if fused_ce else 0)
     plan = MeshPlan()
     step_fn, init_fn, _ = make_train_step(cfg, plan, learning_rate=lr)
     params, state = init_fn(jax.random.key(0))
@@ -77,12 +78,12 @@ def build(B, S, remat, lr=2e-4, unroll=1):
     return cfg, plan, step_fn, params, state, toks, labs, n_params
 
 
-def step_mfu(B, S, remat, scan_k=10, n=3, unroll=1):
+def step_mfu(B, S, remat, scan_k=10, n=3, unroll=1, fused_ce=False):
     """Steady-state step time via scan-K dispatch; returns (ms/step, MFU)."""
     import jax
     import jax.numpy as jnp
     cfg, plan, step_fn, params, state, toks, labs, n_params = \
-        build(B, S, remat, unroll=unroll)
+        build(B, S, remat, unroll=unroll, fused_ce=fused_ce)
     lr = jnp.float32(2e-4)
 
     def multi(params, state):
@@ -250,6 +251,17 @@ def _experiments(B, S, on_tpu, quick):
     # are confirmatory
     exps.append(("dots", full("dots")))
     if not quick:
+        if on_tpu:
+            # fused-CE A/B first: the race ladder's top rungs depend on it
+            def run_fused(BB):
+                def run():
+                    ms, mfu = step_mfu(BB, S, "dots", scan_k=10,
+                                       fused_ce=True)
+                    print(f"| full step B={BB} remat=dots fused_ce | "
+                          f"{ms:.1f} ms/step, MFU {mfu:.3f} |", flush=True)
+                return run
+            exps.append(("b12fused", run_fused(12)))
+            exps.append(("b16fused", run_fused(16)))
         exps.append(("dots+attn", full("dots+attn")))
         if on_tpu:
             exps.append(("b12attn", full("dots+attn", 12)))
