@@ -28,20 +28,27 @@ def _chunk_logits(h, wc):
     return jnp.einsum("th,vh->tv", h, wc, preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_linear_cross_entropy(h, wte, labels, num_chunks):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(h, wte, labels, num_chunks, axis_name=None):
     """Per-token NLL of softmax(h @ wte^T) at `labels`, chunked over vocab.
 
     h: (T, H); wte: (V, H) with V % num_chunks == 0; labels: (T,) int.
     Returns (T,) f32 per-token loss.
+
+    axis_name: vocab-parallel mode (the fused analogue of the reference's
+    c_softmax_with_cross_entropy): wte is this shard's (V/mp, H) rows,
+    labels are GLOBAL ids, and the softmax statistics cross the axis via
+    pmax/psum. The returned per-token loss is full (not partial); dh is
+    this shard's partial contribution — the caller's identity-fwd/psum-bwd
+    wrapper (`_mp_copy`) completes it, exactly as for the unfused path.
     """
-    nll, _ = _fwd(h, wte, labels, num_chunks)
+    nll, _ = _fwd(h, wte, labels, num_chunks, axis_name)
     return nll
 
 
-def _fwd(h, wte, labels, num_chunks):
+def _fwd(h, wte, labels, num_chunks, axis_name):
     T, H = h.shape
-    V = wte.shape[0]
+    V = wte.shape[0]                        # local rows when axis_name
     if V % num_chunks:
         raise ValueError(
             f"(InvalidArgument) fused_linear_cross_entropy: vocab {V} "
@@ -49,6 +56,9 @@ def _fwd(h, wte, labels, num_chunks):
     Vc = V // num_chunks
     wch = wte.reshape(num_chunks, Vc, H)
     li = labels.astype(jnp.int32)
+    if axis_name is not None:
+        li = li - jax.lax.axis_index(axis_name) * V    # local ids (may be
+        # out of this shard's [0, V) range — masked in the chunk loop)
 
     def body(carry, args):
         m, s, picked = carry
@@ -70,12 +80,18 @@ def _fwd(h, wte, labels, num_chunks):
             jnp.zeros((T,), jnp.float32))
     (m, s, picked), _ = jax.lax.scan(
         body, init, (wch, jnp.arange(num_chunks, dtype=jnp.int32)))
+    if axis_name is not None:
+        gm = jax.lax.pmax(m, axis_name)
+        s = jax.lax.psum(s * jnp.exp(m - gm), axis_name)
+        # exactly one shard owns each label; the others contributed 0
+        picked = jax.lax.psum(picked, axis_name)
+        m = gm
     logz = m + jnp.log(s)
     return logz - picked, (h, wte, li, logz)
 
 
-def _bwd(num_chunks, res, g):
-    h, wte, li, logz = res
+def _bwd(num_chunks, axis_name, res, g):
+    h, wte, li, logz = res                  # li already shard-local ids
     T, H = h.shape
     V = wte.shape[0]
     Vc = V // num_chunks
@@ -101,6 +117,9 @@ def _bwd(num_chunks, res, g):
     dh0 = jnp.zeros((T, H), jnp.float32)
     dh, dws = jax.lax.scan(
         body, dh0, (wch, jnp.arange(num_chunks, dtype=jnp.int32)))
+    # axis_name: dh stays PARTIAL (this shard's vocab slice contribution);
+    # the caller's _mp_copy wrapper psums it in its backward, mirroring the
+    # unfused path where the same partial flows out of _logits_matmul's vjp
     return dh.astype(h.dtype), dws.reshape(V, H), None
 
 

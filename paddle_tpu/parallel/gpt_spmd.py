@@ -390,14 +390,24 @@ def _vocab_parallel_loss(h, labels, params, cfg, plan):
     h = _ln(h, params["lnf_w"], params["lnf_b"])
     h = _mp_copy(h, plan)
     wte = params["wte"]                            # (V/mp, H) local
-    if plan.mp == 1 and cfg.fused_ce_chunks > 1:
+    if cfg.fused_ce_chunks > 1:
         # chunked fused linear-CE: logits never materialize (HBM-bound LM
-        # head -> online logsumexp over vocab chunks; ops/fused_ce.py)
+        # head -> online logsumexp over vocab chunks; ops/fused_ce.py).
+        # Under mp the op crosses the axis for softmax stats itself and
+        # returns a partial dh that _mp_copy's backward psums.
+        if wte.shape[0] % cfg.fused_ce_chunks:
+            # erroring (not silently falling back to unfused) — the user
+            # sized memory around this knob
+            raise ValueError(
+                f"(InvalidArgument) fused_ce_chunks={cfg.fused_ce_chunks} "
+                f"must divide the vocab shard rows {wte.shape[0]} "
+                f"(= vocab_size/mp); pick a chunk count that divides the "
+                f"LOCAL shard")
         from ..ops.fused_ce import fused_linear_cross_entropy
         B, S, H = h.shape
         nll = fused_linear_cross_entropy(
             h.reshape(B * S, H), wte, labels.reshape(B * S),
-            cfg.fused_ce_chunks)
+            cfg.fused_ce_chunks, "mp" if plan.mp > 1 else None)
         return jnp.mean(nll)
     # bf16 operands, f32 accumulation: full MXU rate with f32-safe softmax
     # statistics downstream (vs. upcasting operands, which halves+ MXU

@@ -27,10 +27,8 @@ def test_loss_parity(nc):
     h = jax.random.normal(ks[0], (T, H), jnp.float32)
     w = jax.random.normal(ks[1], (V, H), jnp.float32) * 0.1
     labels = jax.random.randint(ks[2], (T,), 0, V)
-    if nc == 1:
-        # knob semantics: chunks<=1 means "off" at the config layer, but the
-        # op itself accepts 1 chunk and must still be exact
-        pass
+    # nc=1: chunks<=1 means "off" at the config layer, but the op itself
+    # accepts one chunk and must still be exact
     got = fused_linear_cross_entropy(h, w, labels, nc)
     ref = _ref_nll(h, w, labels)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -121,3 +119,65 @@ def test_full_step_loss_matches_unfused():
                                    jnp.float32(1e-3))
         losses.append(float(loss))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+_HYBRID_COMMON = dict(vocab_size=128, max_seq_len=64, hidden=64,
+                      layers=2, heads=4, ffn=128, remat=False)
+
+
+def _run_losses(cfg, plan, n=3, B=8, S=32):
+    from paddle_tpu.parallel import make_train_step
+    step, init, _ = make_train_step(cfg, plan, learning_rate=1e-2)
+    params, state = init(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(n):
+        toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+        labs = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+        loss, params, state = step(params, state, toks, labs,
+                                   jnp.float32(1e-2))
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def hybrid_golden():
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan
+    return _run_losses(GPTSpmdConfig(**_HYBRID_COMMON), MeshPlan())
+
+
+def test_mp_vocab_parallel_fused_matches_golden(hybrid_golden):
+    """fused_ce_chunks under mp=4: loss trajectory must match the unfused
+    single-device golden (the op crosses the mp axis for softmax stats;
+    V/mp=32 rows per shard, 4 chunks of 8)."""
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan
+    fused_mp = _run_losses(
+        GPTSpmdConfig(fused_ce_chunks=4, **_HYBRID_COMMON), MeshPlan(mp=4))
+    np.testing.assert_allclose(hybrid_golden, fused_mp, rtol=2e-4)
+
+
+def test_pp_mp_hybrid_fused_matches_golden(hybrid_golden):
+    """fused CE inside the cond-gated 1F1B tick (pp=2 x mp=2): the chunk
+    scan and the mp-axis psum/pmax must be legal and exact there too."""
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan
+    fused = _run_losses(
+        GPTSpmdConfig(fused_ce_chunks=4, **_HYBRID_COMMON),
+        MeshPlan(pp=2, mp=2, microbatches=2))
+    np.testing.assert_allclose(hybrid_golden, fused, rtol=2e-4)
+
+
+def test_chunks_not_dividing_shard_raises():
+    """Global vocab divisible but the mp-local shard NOT: must raise, not
+    silently fall back to the unfused path (the user sized memory around
+    the knob)."""
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
+    # 96 % 32 == 0 (config validation passes) but the mp=4 local shard has
+    # 24 rows and 24 % 32 != 0
+    cfg = GPTSpmdConfig(vocab_size=96, max_seq_len=32, hidden=64, layers=2,
+                        heads=4, ffn=128, remat=False, fused_ce_chunks=32)
+    step, init, _ = make_train_step(cfg, MeshPlan(mp=4), learning_rate=1e-2)
+    params, state = init(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 96, (8, 32)))
+    with pytest.raises(ValueError, match="vocab shard rows"):
+        step(params, state, toks, toks, jnp.float32(1e-2))

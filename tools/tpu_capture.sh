@@ -21,12 +21,6 @@ BENCH_INIT_BUDGET_S=300 timeout 2400 python bench.py \
     > "$OUT/bench.json" 2> "$OUT/bench.err"
 cat "$OUT/bench.json"
 
-echo "== eager bench =="
-BENCH_INIT_BUDGET_S=300 BENCH_RUNG_BUDGET_S=600 timeout 1200 \
-    python bench_eager.py \
-    > "$OUT/bench_eager.json" 2> "$OUT/bench_eager.err"
-cat "$OUT/bench_eager.json"
-
 echo "== profile sweep =="
 BENCH_INIT_BUDGET_S=300 PROFILE_EXP_BUDGET_S=600 \
     XPLANE="$OUT/xplane" \
@@ -39,5 +33,14 @@ echo "== xplane summary =="
 timeout 600 python tools/xplane_summary.py "$OUT/xplane" \
     > "$OUT/xplane_top_ops.md" 2>&1 || true
 cat "$OUT/xplane_top_ops.md"
+
+# eager LAST: per-op dispatch is the most wedge-prone workload (r4 session 3:
+# it wedged the grant before the profile sweep could run) and its number is
+# the least perishable — session 2 already recorded 1.08x vs jit
+echo "== eager bench =="
+BENCH_INIT_BUDGET_S=300 BENCH_RUNG_BUDGET_S=600 timeout 1200 \
+    python bench_eager.py \
+    > "$OUT/bench_eager.json" 2> "$OUT/bench_eager.err"
+cat "$OUT/bench_eager.json"
 
 echo "== done; artifacts in $OUT =="
