@@ -10,7 +10,8 @@ from ...core.tensor import Tensor, apply_op
 
 __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_multi_transformer", "fused_matmul_bias", "fused_linear",
-           "fused_bias_dropout_residual_layer_norm"]
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_linear_cross_entropy"]
 
 
 def _layer_norm(h, g, b, eps):
@@ -237,3 +238,26 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             activation=activation, ln1_epsilon=epsilon, training=training,
             mode=mode)
     return out
+
+
+def fused_linear_cross_entropy(x, weight, label, num_chunks=8,
+                               reduction="mean", name=None):
+    """Fused LM-head linear + softmax cross-entropy over vocab chunks
+    (TPU-native extension of the fused-op family; the (tokens, vocab)
+    logits never materialize — see ops/fused_ce.py for the memory math).
+
+    x: (..., H) activations; weight: (V, H) classifier rows; label: (...,)
+    int. reduction: "mean" | "sum" | "none".
+    """
+    from ...ops.fused_ce import fused_linear_cross_entropy as _op
+
+    def call(x, w, lab):
+        from ...nn.functional.loss import _reduce
+        lead = x.shape[:-1]
+        nll = _op(x.reshape((-1, x.shape[-1])), w, lab.reshape((-1,)),
+                  int(num_chunks))
+        return _reduce(nll.reshape(lead), reduction)
+
+    return apply_op(call, x, weight, label,
+                    name=f"fused_linear_cross_entropy:{reduction}:"
+                         f"{num_chunks}")

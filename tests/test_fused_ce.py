@@ -181,3 +181,29 @@ def test_chunks_not_dividing_shard_raises():
     toks = jnp.asarray(rng.randint(0, 96, (8, 32)))
     with pytest.raises(ValueError, match="vocab shard rows"):
         step(params, state, toks, toks, jnp.float32(1e-2))
+
+
+def test_incubate_functional_surface():
+    """incubate.nn.functional.fused_linear_cross_entropy: eager Tensor API
+    with reduction modes, parity vs composed matmul+cross_entropy."""
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as F
+    import paddle_tpu.nn.functional as NF
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8, 16).astype("float32"),
+        stop_gradient=False)
+    w = paddle.to_tensor(
+        np.random.RandomState(1).randn(32, 16).astype("float32") * 0.1,
+        stop_gradient=False)
+    lab = paddle.to_tensor(np.random.RandomState(2).randint(0, 32, (4, 8)))
+    loss = F.fused_linear_cross_entropy(x, w, lab, num_chunks=4)
+    loss.backward()
+    assert np.asarray(w.grad).shape == (32, 16)
+    logits = paddle.matmul(x, paddle.transpose(w, [1, 0]))
+    ref = NF.cross_entropy(logits, lab)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    per_tok = F.fused_linear_cross_entropy(x, w, lab, num_chunks=4,
+                                           reduction="none")
+    assert tuple(per_tok.shape) == (4, 8)
+    np.testing.assert_allclose(float(per_tok.mean()), float(ref), rtol=1e-5)
