@@ -364,8 +364,19 @@ class DataLoader:
                     daemon=True)
                 for wid in range(self.num_workers)
             ]
-            for p in procs:
-                p.start()
+            # fork is deliberate (COW handoff of dataset/sampler objects +
+            # the named-shm ring, the reference DataLoader's design) and
+            # safe here because workers run a pure numpy loop and never
+            # call into JAX; suppress only the fork-vs-threads warnings at
+            # this boundary so user runs stay clean
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*fork.*", category=RuntimeWarning)
+                warnings.filterwarnings(
+                    "ignore", message=".*fork.*", category=DeprecationWarning)
+                for p in procs:
+                    p.start()
 
             # timeout=0 (default) means "no deadline" — poll in 10 s slices
             # so a dead worker is still detected promptly (the watchdog role

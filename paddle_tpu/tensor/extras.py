@@ -134,9 +134,12 @@ def cast(x, dtype):
 # --------------------------------------------------------- index/shape ops
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    from ..core import dtype as _dt
+
     def fn(a, seq):
         out = jnp.searchsorted(seq, a, side="right" if right else "left")
-        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        return out.astype(jnp.int32 if out_int32
+                          else _dt.canonical(jnp.int64))
     return apply_op(fn, x, sorted_sequence)
 
 

@@ -306,4 +306,4 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 
 
 def numel(x, name=None):
-    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+    return Tensor(jnp.asarray(x.size, dtype=_dt.canonical(jnp.int64)))

@@ -99,7 +99,8 @@ def index_put(x, indices, value, accumulate=False, name=None):
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     def fn(s, v):
         out = jnp.searchsorted(s, v, side="right" if right else "left")
-        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        return out.astype(jnp.int32 if out_int32
+                          else _dt.canonical(jnp.int64))
     return apply_op(fn, sorted_sequence, values)
 
 

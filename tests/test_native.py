@@ -192,7 +192,12 @@ def test_ring_cross_process():
     n = 20
     ctx = mp.get_context("fork")
     p = ctx.Process(target=_ring_child, args=(name, n))
-    p.start()
+    # same deliberate-fork rationale as the DataLoader: the child touches
+    # only the shm ring, never JAX
+    import warnings
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message=".*fork.*")
+        p.start()
     seen = set()
     for _ in range(n):
         i, arr = pickle.loads(r.get(timeout_ms=20000))
