@@ -156,7 +156,35 @@ def mode(x, axis=-1, keepdim=False, name=None):
 
 
 def median(x, axis=None, keepdim=False, name=None):
-    return apply_op(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+    """Reference-exact median (python/paddle/tensor/stat.py:376): even
+    counts average the two middle values; output is float32 (the reference
+    keeps float64 only for f64 inputs, which the x64-disabled policy maps
+    to f32 anyway); axis=None flattens and returns shape [1] (keepdim ->
+    [1]*ndim), NOT a scalar; axis must be an int in [-rank, rank). Any
+    NaN OR +-inf in a slice yields NaN — the reference adds
+    `sum(isnan(x)*x)` to the result (stat.py:455) and 0*inf is NaN, so
+    infs poison slices exactly like NaNs do."""
+    def fn(a):
+        if axis is not None and (not isinstance(axis, int)
+                                 or not -a.ndim <= axis < max(a.ndim, 1)):
+            raise ValueError(
+                "In median, axis should be none or an integer in range "
+                f"[-rank(x), rank(x)), got {axis!r}")
+        red = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        out = jnp.median(red, axis=ax).astype(jnp.float32)
+        # the reference adds `sum(isnan(x)*x)` (stat.py:455), which is NaN
+        # when the slice holds a NaN (1*nan) OR an inf (0*inf). The literal
+        # form can't be used here: XLA rewrites convert(isnan)*x into a
+        # select, folding the 0*inf corner away — so state the poison
+        # condition explicitly
+        red_f = red.astype(jnp.float32)
+        bad = jnp.any(jnp.isnan(red_f) | jnp.isinf(red_f), axis=ax)
+        out = jnp.where(bad, jnp.float32(jnp.nan), out)
+        if axis is None:
+            return out.reshape([1] * a.ndim) if keepdim else out.reshape([1])
+        return jnp.expand_dims(out, axis) if keepdim else out
+    return apply_op(fn, x)
 
 
 def nanmedian(x, axis=None, keepdim=False, name=None):

@@ -1,0 +1,117 @@
+"""Second batch of subtle op-semantics pins, cross-checked against the
+reference implementations' documented corners (median: stat.py:376; clip:
+clip kernel min-then-max order; histogram: histogram_kernel.cc range
+exclusion) and torch/numpy goldens where the semantics coincide."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestMedianReferenceExact:
+    def test_flatten_returns_shape_1_float32(self):
+        # reference: axis=None flattens, output shape [1], f32 even for int
+        m = paddle.median(t(np.array([[3, 1, 2, 4]], "int32")))
+        assert m.shape == [1]
+        assert str(m.dtype).endswith("float32")
+        np.testing.assert_allclose(np.asarray(m.numpy()), [2.5])
+
+    def test_flatten_keepdim_ones_shape(self):
+        m = paddle.median(t(np.zeros((2, 3, 4), "float32")), keepdim=True)
+        assert m.shape == [1, 1, 1]
+
+    def test_even_count_averages(self):
+        x = np.array([1.0, 9.0, 3.0, 7.0])
+        m = paddle.median(t(x.astype("float32")), axis=0)
+        np.testing.assert_allclose(float(m.numpy()), 5.0)
+
+    def test_inf_poisons_slice_like_reference(self):
+        # reference adds sum(isnan(x)*x) (stat.py:455): 0*inf = NaN, so a
+        # slice containing an infinity medians to NaN
+        m = paddle.median(t(np.array([1.0, 2.0, np.inf], "float32")))
+        assert np.isnan(np.asarray(m.numpy()))[0]
+
+    def test_non_int_axis_raises(self):
+        import pytest
+        with pytest.raises(ValueError, match="axis should be none or an"):
+            paddle.median(t(np.ones((2, 3), "float32")), axis=(0, 1))
+
+    def test_axis_matches_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(5, 7).astype("float32")
+        got = np.asarray(paddle.median(t(x), axis=1).numpy())
+        # torch.median picks the LOWER middle; paddle averages — compare to
+        # numpy (which also averages), and to torch.quantile(0.5)
+        np.testing.assert_allclose(got, np.median(x, axis=1), rtol=1e-6)
+        tq = torch.quantile(torch.tensor(x), 0.5, dim=1).numpy()
+        np.testing.assert_allclose(got, tq, rtol=1e-5)
+
+    def test_nan_propagates(self):
+        m = paddle.median(t(np.array([1.0, np.nan, 3.0], "float32")))
+        assert np.isnan(np.asarray(m.numpy()))[0]
+
+
+class TestClipSemantics:
+    def test_min_greater_than_max_max_wins(self):
+        # reference clip applies max(x, min) then min(., max): max wins
+        c = paddle.clip(t(np.array([1.0, 5.0, 9.0], "float32")),
+                        min=6.0, max=3.0)
+        np.testing.assert_allclose(np.asarray(c.numpy()), [3.0, 3.0, 3.0])
+        tc = torch.clamp(torch.tensor([1.0, 5.0, 9.0]), min=6.0, max=3.0)
+        np.testing.assert_allclose(np.asarray(c.numpy()), tc.numpy())
+
+
+class TestTieBreaks:
+    def test_argmax_first_occurrence(self):
+        a = paddle.argmax(t(np.array([2.0, 7.0, 7.0, 1.0], "float32")))
+        assert int(a.numpy()) == 1
+
+    def test_argmin_first_occurrence(self):
+        a = paddle.argmin(t(np.array([2.0, 0.5, 0.5, 1.0], "float32")))
+        assert int(a.numpy()) == 1
+
+
+class TestShapeArgConventions:
+    def test_expand_minus_one_keeps_dim(self):
+        e = paddle.expand(t(np.ones((1, 3), "float32")), shape=[4, -1])
+        assert e.shape == [4, 3]
+
+    def test_split_minus_one_infers(self):
+        parts = paddle.split(t(np.arange(10, dtype="float32")), [3, -1, 2])
+        assert [p.shape for p in parts] == [[3], [5], [2]]
+        np.testing.assert_allclose(np.asarray(parts[1].numpy()),
+                                   np.arange(3, 8, dtype="float32"))
+
+
+class TestLerpQuantile:
+    def test_lerp_matches_torch(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 5).astype("float32")
+        y = rng.randn(4, 5).astype("float32")
+        w = rng.rand(5).astype("float32")          # broadcast weight
+        got = np.asarray(paddle.lerp(t(x), t(y), t(w)).numpy())
+        ref = torch.lerp(torch.tensor(x), torch.tensor(y),
+                         torch.tensor(w)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_quantile_matches_torch_linear(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(6, 8).astype("float32")
+        got = np.asarray(paddle.quantile(t(x), 0.3, axis=1).numpy())
+        ref = torch.quantile(torch.tensor(x), 0.3, dim=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestHistogramRangeExclusion:
+    def test_out_of_range_values_not_counted(self):
+        # reference histogram_kernel.cc:71 counts only min<=v<=max
+        x = np.array([-5.0, 0.5, 1.5, 2.5, 99.0], "float32")
+        h = paddle.histogram(t(x), bins=3, min=0.0, max=3.0)
+        assert int(np.asarray(h.numpy()).sum()) == 3
+        ref = torch.histc(torch.tensor(x), bins=3, min=0.0, max=3.0)
+        np.testing.assert_array_equal(np.asarray(h.numpy()),
+                                      ref.numpy().astype(np.int64))
