@@ -121,8 +121,14 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
 
 
 def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    """Same list-q / list-axis / q-range conventions as quantile
+    (stat.py:665)."""
+    from .search import _check_q
+    qv = _check_q(q)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
     return apply_op(
-        lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim), x)
+        lambda a: jnp.nanquantile(a, jnp.asarray(qv), axis=ax,
+                                  keepdims=keepdim), x)
 
 
 def cast(x, dtype):

@@ -166,7 +166,7 @@ def median(x, axis=None, keepdim=False, name=None):
     infs poison slices exactly like NaNs do."""
     def fn(a):
         if axis is not None and (not isinstance(axis, int)
-                                 or not -a.ndim <= axis < max(a.ndim, 1)):
+                                 or not -a.ndim <= axis < a.ndim):
             raise ValueError(
                 "In median, axis should be none or an integer in range "
                 f"[-rank(x), rank(x)), got {axis!r}")
@@ -187,9 +187,35 @@ def median(x, axis=None, keepdim=False, name=None):
     return apply_op(fn, x)
 
 
-def nanmedian(x, axis=None, keepdim=False, name=None):
-    return apply_op(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+def nanmedian(x, axis=None, keepdim=True, name=None):
+    """Reference signature (stat.py:278): keepdim defaults to TRUE (unlike
+    median), axis may be an int or a list/tuple of ints, and the output
+    dtype follows the input."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def fn(a):
+        return jnp.nanmedian(a, axis=ax, keepdims=keepdim).astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def _check_q(q):
+    """Reference quantile validates q in [0, 1] (stat.py:602 ValueError);
+    also normalizes lists to tuples so the op closure stays hashable for
+    the eager compiled-op cache."""
+    qs = tuple(q) if isinstance(q, (list, tuple)) else (q,)
+    for v in qs:
+        if not 0 <= float(v) <= 1:
+            raise ValueError(
+                f"q should be in range [0, 1], but got {v!r}")
+    return tuple(float(v) for v in qs) if isinstance(q, (list, tuple)) \
+        else float(q)
 
 
 def quantile(x, q, axis=None, keepdim=False, name=None):
-    return apply_op(lambda a: jnp.quantile(a, q, axis=axis, keepdims=keepdim), x)
+    """Reference semantics (stat.py:602): q may be a scalar or list (list ->
+    leading dim of len(q)) and must lie in [0, 1]; axis may be an int or
+    list; NaN in a reduced row yields NaN for that row's quantiles."""
+    qv = _check_q(q)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op(lambda a: jnp.quantile(a, jnp.asarray(qv), axis=ax,
+                                           keepdims=keepdim), x)

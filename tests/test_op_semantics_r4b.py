@@ -115,3 +115,50 @@ class TestHistogramRangeExclusion:
         ref = torch.histc(torch.tensor(x), bins=3, min=0.0, max=3.0)
         np.testing.assert_array_equal(np.asarray(h.numpy()),
                                       ref.numpy().astype(np.int64))
+
+
+class TestNanmedianQuantileSignatures:
+    def test_nanmedian_keepdim_defaults_true(self):
+        # reference stat.py:278 — keepdim default is TRUE (unlike median)
+        x = t(np.array([[np.nan, 2.0, 3.0], [0.0, 1.0, 2.0]], "float32"))
+        y = paddle.nanmedian(x, axis=1)
+        assert y.shape == [2, 1]
+        np.testing.assert_allclose(np.asarray(y.numpy()), [[2.5], [1.0]])
+        y2 = paddle.nanmedian(x, axis=1, keepdim=False)
+        assert y2.shape == [2]
+
+    def test_nanmedian_list_axis_and_dtype(self):
+        x = t(np.array([[np.nan, 2.0], [4.0, 1.0]], "float32"))
+        y = paddle.nanmedian(x, axis=[0, 1])
+        assert y.shape == [1, 1]
+        np.testing.assert_allclose(np.asarray(y.numpy()), [[2.0]])
+
+    def test_quantile_list_q_leading_dim(self):
+        x = t(np.arange(8, dtype="float32").reshape(4, 2))
+        y = paddle.quantile(x, q=[0.3, 0.5], axis=0)
+        assert y.shape == [2, 2]
+        ref = np.quantile(np.arange(8, dtype="float64").reshape(4, 2),
+                          [0.3, 0.5], axis=0)
+        np.testing.assert_allclose(np.asarray(y.numpy()), ref, rtol=1e-6)
+
+    def test_quantile_list_axis_and_nan_row(self):
+        x = np.arange(8, dtype="float32").reshape(4, 2)
+        y = paddle.quantile(t(x), q=0.5, axis=[0, 1])
+        np.testing.assert_allclose(float(y.numpy()), 3.5)
+        x[0, 0] = np.nan
+        y2 = paddle.quantile(t(x), q=0.8, axis=1, keepdim=True)
+        got = np.asarray(y2.numpy())
+        assert got.shape == (4, 1)
+        assert np.isnan(got[0, 0]) and not np.isnan(got[1:]).any()
+
+    def test_quantile_out_of_range_q_raises(self):
+        import pytest
+        with pytest.raises(ValueError, match="range"):
+            paddle.quantile(t(np.ones((3,), "float32")), q=1.5)
+        with pytest.raises(ValueError, match="range"):
+            paddle.nanquantile(t(np.ones((3,), "float32")), q=[-0.2, 0.5])
+
+    def test_median_zero_dim_axis_raises(self):
+        import pytest
+        with pytest.raises(ValueError, match="axis should be none"):
+            paddle.median(t(np.float32(3.0)), axis=0)
