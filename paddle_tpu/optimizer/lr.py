@@ -171,35 +171,55 @@ class ReduceOnPlateau(LRScheduler):
         self.threshold_mode = threshold_mode
         self.cooldown = cooldown
         self.min_lr = min_lr
+        self.epsilon = epsilon
         self.best = None
         self.num_bad_epochs = 0
         self.cooldown_counter = 0
         super().__init__(learning_rate, -1, verbose)
+        # the reference does NOT route through the base-class ctor and
+        # starts at last_epoch=0 (lr.py:1369); the first metrics step
+        # therefore reports epoch 1 — keep state_dicts interchangeable
+        self.last_epoch = 0
 
     def get_lr(self):
         return self.last_lr if hasattr(self, "last_lr") else self.base_lr
 
+    def _is_better(self, current, best):
+        """Reference lr.py _is_better: 'rel' scales the threshold by best,
+        'abs' uses it directly."""
+        if self.mode == "min" and self.threshold_mode == "rel":
+            return current < best - best * self.threshold
+        if self.mode == "min":
+            return current < best - self.threshold
+        if self.threshold_mode == "rel":
+            return current > best + best * self.threshold
+        return current > best + self.threshold
+
     def step(self, metrics=None, epoch=None):
+        """Reference ReduceOnPlateau.step: while cooling down, metrics are
+        IGNORED entirely (only the counter decrements); the lr change is
+        gated by epsilon so sub-epsilon reductions are skipped."""
         if metrics is None:
             return
+        if epoch is None:
+            self.last_epoch = self.last_epoch + 1
+        else:
+            self.last_epoch = epoch
         current = float(metrics.item() if hasattr(metrics, "item") else metrics)
-        if self.best is None:
-            self.best = current
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
             return
-        better = current < self.best - self.threshold if self.mode == "min" \
-            else current > self.best + self.threshold
-        if better:
+        if self.best is None or self._is_better(current, self.best):
             self.best = current
             self.num_bad_epochs = 0
         else:
             self.num_bad_epochs += 1
-        if self.cooldown_counter > 0:
-            self.cooldown_counter -= 1
-            self.num_bad_epochs = 0
         if self.num_bad_epochs > self.patience:
-            self.last_lr = max(self.last_lr * self.factor, self.min_lr)
             self.cooldown_counter = self.cooldown
             self.num_bad_epochs = 0
+            new_lr = max(self.last_lr * self.factor, self.min_lr)
+            if self.last_lr - new_lr > self.epsilon:
+                self.last_lr = new_lr
 
 
 class CosineAnnealingDecay(LRScheduler):

@@ -191,7 +191,12 @@ def nanmedian(x, axis=None, keepdim=True, name=None):
     """Reference signature (stat.py:278): keepdim defaults to TRUE (unlike
     median), axis may be an int or a list/tuple of ints, and the output
     dtype follows the input."""
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if isinstance(axis, (list, tuple)):
+        if not axis:
+            raise ValueError("Axis list should not be empty.")
+        ax = tuple(axis)
+    else:
+        ax = axis
 
     def fn(a):
         return jnp.nanmedian(a, axis=ax, keepdims=keepdim).astype(a.dtype)
@@ -199,22 +204,31 @@ def nanmedian(x, axis=None, keepdim=True, name=None):
 
 
 def _check_q(q):
-    """Reference quantile validates q in [0, 1] (stat.py:602 ValueError);
-    also normalizes lists to tuples so the op closure stays hashable for
-    the eager compiled-op cache."""
-    qs = tuple(q) if isinstance(q, (list, tuple)) else (q,)
+    """Reference quantile validation (stat.py:506,602): q must be non-empty
+    and each value in [0, 1]. Lists normalize to tuples so the op closure
+    stays hashable for the eager compiled-op cache; a single-element list
+    behaves like a scalar (reference stacks a leading dim only for
+    len(q) > 1, stat.py:595-598)."""
+    if isinstance(q, (list, tuple)):
+        if not q:
+            raise ValueError("q should not be empty")
+        qs = tuple(float(v) for v in q)
+    else:
+        qs = (float(q),)
     for v in qs:
-        if not 0 <= float(v) <= 1:
+        if not 0 <= v <= 1:
             raise ValueError(
                 f"q should be in range [0, 1], but got {v!r}")
-    return tuple(float(v) for v in qs) if isinstance(q, (list, tuple)) \
-        else float(q)
+    if isinstance(q, (list, tuple)) and len(qs) > 1:
+        return qs
+    return qs[0]
 
 
 def quantile(x, q, axis=None, keepdim=False, name=None):
-    """Reference semantics (stat.py:602): q may be a scalar or list (list ->
-    leading dim of len(q)) and must lie in [0, 1]; axis may be an int or
-    list; NaN in a reduced row yields NaN for that row's quantiles."""
+    """Reference semantics (stat.py:602): q may be a scalar or list (a list
+    of len > 1 -> leading dim of len(q); a one-element list behaves like a
+    scalar) and must lie in [0, 1]; axis may be an int or list; NaN in a
+    reduced row yields NaN for that row's quantiles."""
     qv = _check_q(q)
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
     return apply_op(lambda a: jnp.quantile(a, jnp.asarray(qv), axis=ax,

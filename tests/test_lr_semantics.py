@@ -1,0 +1,90 @@
+"""LR scheduler boundary semantics pinned against the reference
+implementations (python/paddle/optimizer/lr.py): the SGDR recursion,
+warmup handoff, and ReduceOnPlateau's rel-threshold/cooldown/epsilon
+behavior — these silently shift loss curves when they diverge."""
+import math
+
+import paddle_tpu.optimizer.lr as lr
+
+
+def test_cosine_matches_reference_recursion_past_t_max():
+    """The reference computes lr recursively (lr.py CosineAnnealingDecay
+    .get_lr); our closed form must reproduce the recursion exactly,
+    including past T_max where the curve bounces back up."""
+    base_lr, eta_min, T_max = 0.1, 0.001, 10
+    sched = lr.CosineAnnealingDecay(base_lr, T_max, eta_min=eta_min)
+    last_lr = base_lr
+    for last_epoch in range(0, 3 * T_max + 5):
+        if last_epoch == 0:
+            ref = base_lr
+        elif (last_epoch - 1 - T_max) % (2 * T_max) == 0:
+            ref = last_lr + (base_lr - eta_min) * \
+                (1 - math.cos(math.pi / T_max)) / 2
+        else:
+            ref = (1 + math.cos(math.pi * last_epoch / T_max)) / \
+                (1 + math.cos(math.pi * (last_epoch - 1) / T_max)) * \
+                (last_lr - eta_min) + eta_min
+        assert abs(sched.get_lr() - ref) < 1e-12, (last_epoch, sched.get_lr(), ref)
+        last_lr = ref
+        sched.step()
+
+
+def test_linear_warmup_boundary_and_handoff():
+    inner = lr.CosineAnnealingDecay(0.1, 10)
+    sched = lr.LinearWarmup(inner, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    got = []
+    for _ in range(7):
+        got.append(sched.get_lr())
+        sched.step()
+    # epochs 0..3 ramp 0 -> 3/4 of end_lr; epoch 4 hands off to the wrapped
+    # sched at ITS epoch 0 (= base_lr)
+    for g, want in zip(got[:4], [0.0, 0.025, 0.05, 0.075]):
+        assert abs(g - want) < 1e-12, (got, want)
+    assert abs(got[4] - 0.1) < 1e-12
+    assert got[5] < got[4]                 # cosine now decaying
+
+
+class TestReduceOnPlateauReference:
+    def test_rel_threshold_default(self):
+        # rel mode: better means current < best - best*threshold
+        s = lr.ReduceOnPlateau(1.0, patience=0, threshold=0.1, factor=0.5)
+        s.step(10.0)                        # sets best
+        s.step(9.05)                        # 9.05 > 10*0.9 -> NOT better
+        assert s.last_lr == 0.5             # patience 0 -> immediate drop
+        s2 = lr.ReduceOnPlateau(1.0, patience=0, threshold=0.1, factor=0.5)
+        s2.step(10.0)
+        s2.step(8.9)                        # 8.9 < 9.0 -> better
+        assert s2.last_lr == 1.0
+
+    def test_abs_threshold_mode(self):
+        s = lr.ReduceOnPlateau(1.0, patience=0, threshold=0.5,
+                               threshold_mode="abs", factor=0.5)
+        s.step(10.0)
+        s.step(9.6)                         # 9.6 > 10-0.5 -> not better
+        assert s.last_lr == 0.5
+
+    def test_cooldown_ignores_metrics_entirely(self):
+        s = lr.ReduceOnPlateau(1.0, patience=0, threshold_mode="abs",
+                               threshold=0.0, factor=0.5, cooldown=2)
+        s.step(10.0)
+        s.step(11.0)                        # worse -> drop, cooldown=2
+        assert s.last_lr == 0.5
+        s.step(5.0)                         # cooling: metrics IGNORED
+        s.step(4.0)                         # cooling: metrics IGNORED
+        assert s.best == 10.0               # best untouched during cooldown
+        s.step(20.0)                        # active again: worse -> drop
+        assert s.last_lr == 0.25
+
+    def test_epsilon_gates_tiny_reductions(self):
+        s = lr.ReduceOnPlateau(1e-9, patience=0, threshold_mode="abs",
+                               threshold=0.0, factor=0.5, epsilon=1e-8)
+        s.step(1.0)
+        s.step(2.0)                         # reduction 5e-10 < epsilon
+        assert s.last_lr == 1e-9            # unchanged
+
+    def test_last_epoch_starts_at_zero_first_step_is_one(self):
+        # reference lr.py:1369: __init__ sets last_epoch=0; step() makes 1
+        s = lr.ReduceOnPlateau(1.0)
+        assert s.last_epoch == 0
+        s.step(10.0)
+        assert s.last_epoch == 1

@@ -162,3 +162,19 @@ class TestNanmedianQuantileSignatures:
         import pytest
         with pytest.raises(ValueError, match="axis should be none"):
             paddle.median(t(np.float32(3.0)), axis=0)
+
+    def test_quantile_single_element_list_is_scalar_shaped(self):
+        # reference stacks a leading dim only for len(q) > 1 (stat.py:595)
+        x = t(np.arange(8, dtype="float32").reshape(4, 2))
+        y = paddle.quantile(x, q=[0.5], axis=0)
+        assert y.shape == [2]
+        y2 = paddle.nanquantile(x, q=[0.5], axis=0)
+        assert y2.shape == [2]
+
+    def test_empty_q_and_axis_raise(self):
+        import pytest
+        x = t(np.ones((3, 2), "float32"))
+        with pytest.raises(ValueError, match="q should not be empty"):
+            paddle.quantile(x, q=[])
+        with pytest.raises(ValueError, match="Axis list should not be empty"):
+            paddle.nanmedian(x, axis=[])
