@@ -32,7 +32,20 @@ def linear(x, weight, bias=None, name=None):
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
-    if not training or p == 0:
+    if not 0 <= float(p) <= 1:
+        raise ValueError(
+            f"p argument should be a number in [0, 1], but got {p!r}")
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(
+            f"mode argument should be 'downscale_in_infer' or "
+            f"'upscale_in_train', but got {mode!r}")
+    if not training:
+        if mode == "downscale_in_infer" and p != 0:
+            # reference dropout_op: infer-time out = x * (1 - p) in this
+            # mode (train applies the raw mask unscaled)
+            return apply_op(lambda a: (a * (1.0 - p)).astype(a.dtype), x)
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if p == 0:
         return x if isinstance(x, Tensor) else Tensor(x)
     key = next_key()
 

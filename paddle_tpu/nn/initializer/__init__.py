@@ -95,11 +95,12 @@ class KaimingNormal(Initializer):
     def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
         self.fan_in = fan_in
         self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
 
     def __call__(self, shape, dtype):
         fi, _ = self._compute_fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
-        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
         std = gain / math.sqrt(fi)
         return Normal(0.0, std)(shape, dtype)
 
@@ -108,11 +109,12 @@ class KaimingUniform(Initializer):
     def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
         self.fan_in = fan_in
         self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
 
     def __call__(self, shape, dtype):
         fi, _ = self._compute_fans(shape)
         fi = self.fan_in if self.fan_in is not None else fi
-        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
         limit = gain * math.sqrt(3.0 / fi)
         return Uniform(-limit, limit)(shape, dtype)
 
@@ -166,12 +168,27 @@ def set_global_initializer(weight_init, bias_init=None):
 _global_weight_init = None
 _global_bias_init = None
 
-calculate_gain = lambda nonlinearity, param=None: {  # noqa: E731
-    "sigmoid": 1.0, "linear": 1.0, "conv2d": 1.0,
-    "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
-    "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
-    "selu": 3.0 / 4,
-}.get(nonlinearity, 1.0)
+def calculate_gain(nonlinearity, param=None):
+    """Reference fluid/initializer.py:1209 — note param=0 is a VALID leaky
+    slope (gain sqrt(2)), only None defaults to 0.01, and unknown names
+    raise."""
+    if param is None:
+        param = 0.01
+    else:
+        param = float(param)
+    table = {
+        "sigmoid": 1.0, "linear": 1.0,
+        "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0,
+        "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + param ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in table:
+        raise ValueError(
+            f"nonlinearity function {nonlinearity} is not suppported now.")
+    return table[nonlinearity]
 
 
 class Bilinear(Initializer):

@@ -160,6 +160,9 @@ class LambdaDecay(LRScheduler):
         return self.base_lr * self.lr_lambda(self.last_epoch)
 
 
+_METRICS_REQUIRED = object()
+
+
 class ReduceOnPlateau(LRScheduler):
     def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
                  threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,
@@ -175,11 +178,15 @@ class ReduceOnPlateau(LRScheduler):
         self.best = None
         self.num_bad_epochs = 0
         self.cooldown_counter = 0
-        super().__init__(learning_rate, -1, verbose)
-        # the reference does NOT route through the base-class ctor and
-        # starts at last_epoch=0 (lr.py:1369); the first metrics step
-        # therefore reports epoch 1 — keep state_dicts interchangeable
+        # the reference does NOT route through the base-class ctor
+        # ("Can not call Parent __init__", lr.py:1365-1372): the base
+        # ctor's step() probe would demand metrics; set the base fields
+        # directly, starting at last_epoch=0 so the first metrics step
+        # reports epoch 1 and state_dicts interoperate
+        self.base_lr = float(learning_rate)
+        self.last_lr = float(learning_rate)
         self.last_epoch = 0
+        self.verbose = verbose
 
     def get_lr(self):
         return self.last_lr if hasattr(self, "last_lr") else self.base_lr
@@ -195,12 +202,16 @@ class ReduceOnPlateau(LRScheduler):
             return current > best + best * self.threshold
         return current > best + self.threshold
 
-    def step(self, metrics=None, epoch=None):
-        """Reference ReduceOnPlateau.step: while cooling down, metrics are
-        IGNORED entirely (only the counter decrements); the lr change is
-        gated by epsilon so sub-epsilon reductions are skipped."""
-        if metrics is None:
-            return
+    def step(self, metrics=_METRICS_REQUIRED, epoch=None):
+        """Reference ReduceOnPlateau.step: metrics is REQUIRED (a bare
+        step() that every other scheduler accepts raises here, as in the
+        reference); while cooling down, metrics are IGNORED entirely (only
+        the counter decrements); the lr change is gated by epsilon so
+        sub-epsilon reductions are skipped."""
+        if metrics is _METRICS_REQUIRED:
+            raise TypeError(
+                "ReduceOnPlateau.step() requires the monitored metrics "
+                "(reference signature: step(metrics, epoch=None))")
         if epoch is None:
             self.last_epoch = self.last_epoch + 1
         else:

@@ -88,3 +88,9 @@ class TestReduceOnPlateauReference:
         assert s.last_epoch == 0
         s.step(10.0)
         assert s.last_epoch == 1
+
+    def test_bare_step_raises_like_reference(self):
+        import pytest
+        s = lr.ReduceOnPlateau(1.0)
+        with pytest.raises(TypeError, match="requires the monitored"):
+            s.step()

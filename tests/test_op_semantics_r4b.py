@@ -178,3 +178,63 @@ class TestNanmedianQuantileSignatures:
             paddle.quantile(x, q=[])
         with pytest.raises(ValueError, match="Axis list should not be empty"):
             paddle.nanmedian(x, axis=[])
+
+
+class TestDropoutModes:
+    def test_downscale_in_infer_scales_at_eval(self):
+        # reference dropout_op: this mode leaves training values unscaled
+        # and multiplies by (1-p) at inference
+        import paddle_tpu.nn.functional as F
+        x = t(np.ones((4, 4), "float32"))
+        y = F.dropout(x, p=0.25, training=False, mode="downscale_in_infer")
+        np.testing.assert_allclose(np.asarray(y.numpy()), 0.75, rtol=1e-6)
+        # upscale mode: eval is identity
+        y2 = F.dropout(x, p=0.25, training=False)
+        np.testing.assert_allclose(np.asarray(y2.numpy()), 1.0)
+        # downscale train: surviving values are UNscaled
+        y3 = F.dropout(x, p=0.5, training=True, mode="downscale_in_infer")
+        v = np.asarray(y3.numpy())
+        assert set(np.unique(v)).issubset({0.0, 1.0})
+
+    def test_bad_mode_raises(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError, match="upscale_in_train"):
+            F.dropout(t(np.ones((2,), "float32")), mode="bogus")
+
+
+class TestInitializerGain:
+    def test_calculate_gain_reference_table(self):
+        import math
+        import pytest
+        from paddle_tpu.nn.initializer import calculate_gain
+        assert calculate_gain("tanh") == 5.0 / 3
+        assert calculate_gain("relu") == math.sqrt(2.0)
+        assert calculate_gain("selu") == 3.0 / 4
+        # param=0 is a VALID leaky slope -> sqrt(2); only None means 0.01
+        assert calculate_gain("leaky_relu", 0) == math.sqrt(2.0)
+        assert calculate_gain("leaky_relu", 1.0) == 1.0
+        assert abs(calculate_gain("leaky_relu")
+                   - math.sqrt(2.0 / (1 + 0.01 ** 2))) < 1e-12
+        assert calculate_gain("conv2d_transpose") == 1.0
+        with pytest.raises(ValueError, match="not suppported"):
+            calculate_gain("softmax")
+
+    def test_kaiming_honors_nonlinearity(self):
+        import math
+        from paddle_tpu.nn.initializer import KaimingNormal
+        w = KaimingNormal(nonlinearity="tanh")((256, 512), "float32")
+        # std should be (5/3)/sqrt(256): loose 3-sigma-ish band on the
+        # sample std over 128k values
+        std = float(np.std(np.asarray(w.numpy() if hasattr(w, "numpy")
+                                      else w)))
+        want = (5.0 / 3) / math.sqrt(256)
+        assert abs(std - want) / want < 0.05
+
+    def test_dropout_p_out_of_range_raises(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError, match="p argument"):
+            F.dropout(t(np.ones((2,), "float32")), p=1.5)
+        with pytest.raises(ValueError, match="p argument"):
+            F.dropout(t(np.ones((2,), "float32")), p=-0.1, training=False)
