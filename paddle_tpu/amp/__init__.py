@@ -115,6 +115,10 @@ class GradScaler:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every_n:
+                # the Python loss_scaler has no floor, but the op kernel it
+                # delegates to clamps the decayed scale to >= 1
+                # (phi/kernels/impl/amp_kernel_impl.h:58-60) — that's the
+                # observable reference behavior
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
         else:

@@ -322,8 +322,9 @@ class Engine:
                 self.history["loss"].append(float(l))
                 if steps_per_epoch and step_i % steps_per_epoch == 0:
                     break
-            from ...optimizer.lr import LRScheduler
-            if isinstance(self._optimizer._lr, LRScheduler):
+            from ...optimizer.lr import LRScheduler, ReduceOnPlateau
+            if isinstance(self._optimizer._lr, LRScheduler) and \
+                    not isinstance(self._optimizer._lr, ReduceOnPlateau):
                 self._optimizer._lr.step()
         self._sync_back()
         return self.history

@@ -26,6 +26,13 @@ API parity.
 """
 import re
 
+def _needs_metrics(sched):
+    """ReduceOnPlateau requires the monitored metric; the reference leaves
+    stepping it to the user, so the bundled loops skip it."""
+    from ....optimizer.lr import ReduceOnPlateau
+    return isinstance(sched, ReduceOnPlateau)
+
+
 import numpy as np
 
 from ....core.tensor import Tensor
@@ -219,7 +226,7 @@ class PipelineParallel(Layer):
         mesh = self._pipeline_mesh()
         if mesh is not None and scaler is None and self.accumulate_steps > 1:
             loss = self._train_batch_compiled(data, optimizer, mesh)
-            if lr_scheduler is not None:
+            if lr_scheduler is not None and not _needs_metrics(lr_scheduler):
                 lr_scheduler.step()
             return loss
         micro = self._split_micro(data)
@@ -242,7 +249,7 @@ class PipelineParallel(Layer):
         else:
             optimizer.step()
         optimizer.clear_grad()
-        if lr_scheduler is not None:
+        if lr_scheduler is not None and not _needs_metrics(lr_scheduler):
             lr_scheduler.step()
         return total
 

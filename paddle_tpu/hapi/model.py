@@ -354,7 +354,11 @@ class Model:
                 if num_iters is not None and global_step >= num_iters:
                     break
             if hasattr(self._optimizer, "_lr") and hasattr(self._optimizer._lr, "step"):
-                self._optimizer._lr.step()
+                from ..optimizer.lr import ReduceOnPlateau
+                if not isinstance(self._optimizer._lr, ReduceOnPlateau):
+                    # ReduceOnPlateau needs the monitored metric — the
+                    # reference leaves stepping it to the user/callback
+                    self._optimizer._lr.step()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, verbose=0)
             if save_dir and (epoch + 1) % save_freq == 0:

@@ -160,9 +160,6 @@ class LambdaDecay(LRScheduler):
         return self.base_lr * self.lr_lambda(self.last_epoch)
 
 
-_METRICS_REQUIRED = object()
-
-
 class ReduceOnPlateau(LRScheduler):
     def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
                  threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,
@@ -202,16 +199,12 @@ class ReduceOnPlateau(LRScheduler):
             return current > best + best * self.threshold
         return current > best + self.threshold
 
-    def step(self, metrics=_METRICS_REQUIRED, epoch=None):
-        """Reference ReduceOnPlateau.step: metrics is REQUIRED (a bare
-        step() that every other scheduler accepts raises here, as in the
-        reference); while cooling down, metrics are IGNORED entirely (only
-        the counter decrements); the lr change is gated by epsilon so
-        sub-epsilon reductions are skipped."""
-        if metrics is _METRICS_REQUIRED:
-            raise TypeError(
-                "ReduceOnPlateau.step() requires the monitored metrics "
-                "(reference signature: step(metrics, epoch=None))")
+    def step(self, metrics, epoch=None):
+        """Reference ReduceOnPlateau.step: metrics is a required positional
+        (a bare step() that every other scheduler accepts raises TypeError,
+        as in the reference); while cooling down, metrics are IGNORED
+        entirely (only the counter decrements); the lr change is gated by
+        epsilon so sub-epsilon reductions are skipped."""
         if epoch is None:
             self.last_epoch = self.last_epoch + 1
         else:

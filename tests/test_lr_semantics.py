@@ -92,5 +92,18 @@ class TestReduceOnPlateauReference:
     def test_bare_step_raises_like_reference(self):
         import pytest
         s = lr.ReduceOnPlateau(1.0)
-        with pytest.raises(TypeError, match="requires the monitored"):
+        with pytest.raises(TypeError, match="metrics"):
             s.step()
+
+
+def test_grad_scaler_decay_clamps_at_one_like_reference_kernel():
+    """The reference Python loss_scaler has no floor, but the op kernel it
+    delegates to clamps the decayed scale to >= 1
+    (phi/kernels/impl/amp_kernel_impl.h:58-60)."""
+    from paddle_tpu.amp import GradScaler
+    s = GradScaler(init_loss_scaling=2.0, decr_ratio=0.5,
+                   decr_every_n_nan_or_inf=1)
+    for _ in range(4):
+        s._found_inf = True
+        s.update()
+    assert s._scale == 1.0
