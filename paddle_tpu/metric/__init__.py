@@ -37,6 +37,9 @@ class Accuracy(Metric):
         l = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
         if l.ndim == p.ndim and l.shape[-1] == 1:
             l = l[..., 0]
+        elif l.ndim == p.ndim and l.shape[-1] != 1:
+            # one-hot labels (reference metrics.py Accuracy.compute)
+            l = np.argmax(l, axis=-1)
         top = np.argsort(-p, axis=-1)[..., :self.maxk]
         correct = (top == l[..., None])
         return Tensor(np.asarray(correct.astype(np.float32)))

@@ -238,3 +238,38 @@ class TestInitializerGain:
             F.dropout(t(np.ones((2,), "float32")), p=1.5)
         with pytest.raises(ValueError, match="p argument"):
             F.dropout(t(np.ones((2,), "float32")), p=-0.1, training=False)
+
+
+class TestAdaptivePoolUneven:
+    def test_adaptive_avg_pool2d_uneven_matches_torch(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 7, 5).astype("float32")
+        import paddle_tpu.nn.functional as F
+        got = np.asarray(F.adaptive_avg_pool2d(t(x), [3, 2]).numpy())
+        ref = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x), (3, 2)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_adaptive_max_pool1d_uneven_matches_torch(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3, 10).astype("float32")
+        import paddle_tpu.nn.functional as F
+        got = np.asarray(F.adaptive_max_pool1d(t(x), 4).numpy())
+        ref = torch.nn.functional.adaptive_max_pool1d(
+            torch.tensor(x), 4).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+class TestAccuracyMetric:
+    def test_one_hot_labels(self):
+        # reference Accuracy.compute argmaxes one-hot labels
+        import paddle_tpu as paddle
+        m = paddle.metric.Accuracy(topk=(1, 2))
+        pred = t(np.array([[0.1, 0.7, 0.2], [0.8, 0.15, 0.05]], "float32"))
+        onehot = t(np.array([[0, 1, 0], [0, 0, 1]], "float32"))
+        correct = m.compute(pred, onehot)
+        accs = m.update(correct)
+        # row 1 (label 1): top-1 = [1] correct; row 2 (label 2): top-1 = [0]
+        # wrong and top-2 = [0, 1] still wrong (values untied on purpose)
+        assert accs[0] == 0.5
+        assert accs[1] == 0.5
