@@ -33,10 +33,12 @@ def main():
     if args.full:
         cfg = GPTSpmdConfig(vocab_size=50304, max_seq_len=1024, hidden=2048,
                             layers=24, heads=16, param_dtype="bfloat16",
-                            compute_dtype="bfloat16", remat="dots+attn")
+                            compute_dtype="bfloat16", remat="dots+attn",
+                            fused_ce_chunks=8)   # logits never materialize
     else:
         cfg = GPTSpmdConfig(vocab_size=512, max_seq_len=64, hidden=64,
-                            layers=2, heads=4, remat=False)
+                            layers=2, heads=4, remat=False,
+                            fused_ce_chunks=4)
     plan = MeshPlan(sharding=shard)
     step_fn, init_fn, mesh = make_train_step(cfg, plan, learning_rate=2e-4)
     params, state = init_fn(jax.random.key(0))
