@@ -79,9 +79,14 @@ def l1_loss(input, label, reduction="mean", name=None):
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    """Reference smooth_l1_loss wraps the huber_loss kernel
+    (phi/kernels/funcs huber: 0.5*x^2 for |x|<=delta else
+    delta*(|x|-0.5*delta)) — NOT torch's beta convention that divides the
+    quadratic branch by delta; the two coincide only at delta=1."""
     def fn(a, b):
         d = jnp.abs(a - b)
-        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        loss = jnp.where(d <= delta, 0.5 * d * d,
+                         delta * (d - 0.5 * delta))
         return _reduce(loss, reduction)
     return apply_op(fn, input, label)
 

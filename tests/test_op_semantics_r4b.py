@@ -273,3 +273,21 @@ class TestAccuracyMetric:
         # wrong and top-2 = [0, 1] still wrong (values untied on purpose)
         assert accs[0] == 0.5
         assert accs[1] == 0.5
+
+
+class TestSmoothL1Huber:
+    def test_delta_not_one_matches_huber(self):
+        """paddle smooth_l1_loss == torch huber_loss (the kernel it wraps),
+        NOT torch smooth_l1_loss(beta) which divides the quadratic branch."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(5)
+        x = rng.randn(6, 4).astype("float32") * 3
+        y = rng.randn(6, 4).astype("float32")
+        got = float(F.smooth_l1_loss(t(x), t(y), delta=2.0).numpy())
+        ref = float(torch.nn.functional.huber_loss(
+            torch.tensor(x), torch.tensor(y), delta=2.0))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+        # and differs from torch's smooth_l1(beta=2) by design
+        beta_ref = float(torch.nn.functional.smooth_l1_loss(
+            torch.tensor(x), torch.tensor(y), beta=2.0))
+        assert abs(got - beta_ref) > 1e-3
