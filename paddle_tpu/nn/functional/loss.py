@@ -128,7 +128,11 @@ def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean"
 
 def kl_div(input, label, reduction="mean", name=None):
     def fn(logp, y):
-        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        # reference kldiv_loss kernel: target <= 0 contributes EXACTLY 0
+        # (kldiv_loss_kernel_impl.h:31); the inner where keeps log() off
+        # non-positive values so no nan leaks through the select
+        safe_y = jnp.where(y > 0, y, 1.0)
+        loss = jnp.where(y > 0, y * (jnp.log(safe_y) - logp), 0.0)
         if reduction == "batchmean":
             return jnp.sum(loss) / logp.shape[0]
         return _reduce(loss, reduction)
