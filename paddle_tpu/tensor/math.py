@@ -286,9 +286,45 @@ def lcm(x, y, name=None):
 
 
 def take(x, index, mode="raise", name=None):
-    return apply_op(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1).astype(jnp.int32),
-                                          mode="clip" if mode != "wrap" else "wrap"),
-                    x, index)
+    """Reference take (tensor/math.py): output has INDEX's shape; 'raise'
+    mode supports negative indices (idx + numel), 'wrap' takes the
+    remainder, 'clip' clamps to [0, numel-1] (negatives -> 0)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(
+            f"'mode' in 'take' should be 'raise', 'wrap', 'clip', but "
+            f"received {mode}.")
+    if mode == "raise":
+        # bounds-check when values are concrete (eager path — under a trace
+        # the check is impossible and the reference's static mode doesn't
+        # raise either)
+        import jax.core as _jc
+        xv = getattr(x, "_data", None)
+        iv = getattr(index, "_data", None)
+        if iv is not None and xv is not None \
+                and not isinstance(iv, _jc.Tracer) \
+                and not isinstance(xv, _jc.Tracer):
+            import numpy as _np
+            n = int(_np.prod(xv.shape)) if xv.ndim else 1
+            inp = _np.asarray(iv)
+            if inp.size and (int(inp.min()) < -n or int(inp.max()) >= n):
+                raise ValueError(
+                    f"(InvalidArgument) take: index out of range for input "
+                    f"with {n} elements (valid range [-{n}, {n}), got "
+                    f"min {int(inp.min())} max {int(inp.max())}).")
+
+    def fn(a, i):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        idx = i.astype(jnp.int32)
+        if mode == "raise":
+            idx = jnp.where(idx < 0, idx + n, idx)
+            out = jnp.take(flat, idx.reshape(-1), mode="clip")
+        elif mode == "wrap":
+            out = jnp.take(flat, idx.reshape(-1), mode="wrap")
+        else:
+            out = jnp.take(flat, idx.reshape(-1), mode="clip")
+        return out.reshape(i.shape)
+    return apply_op(fn, x, index)
 
 
 def broadcast_shape(x_shape, y_shape):

@@ -319,3 +319,38 @@ class TestKlDiv:
         ref = float(torch.nn.functional.kl_div(
             torch.tensor(logp), torch.tensor(y), reduction="batchmean"))
         np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestTakeModes:
+    def test_output_has_index_shape_and_negative_raise(self):
+        x = t(np.arange(12, dtype="float32").reshape(3, 4))
+        idx = t(np.array([[0, -1], [5, -12]], "int64"))
+        got = np.asarray(paddle.take(x, idx).numpy())       # mode='raise'
+        assert got.shape == (2, 2)
+        # negative indices wrap by +numel in raise mode (reference math.py)
+        np.testing.assert_allclose(got, [[0.0, 11.0], [5.0, 0.0]])
+        ref = torch.take(torch.tensor(np.arange(12, dtype="float32")),
+                         torch.tensor([[0, -1], [5, -12]])).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_wrap_and_clip(self):
+        x = t(np.arange(6, dtype="float32"))
+        idx = t(np.array([-1, 6, 13], "int64"))
+        wrap = np.asarray(paddle.take(x, idx, mode="wrap").numpy())
+        np.testing.assert_allclose(wrap, [5.0, 0.0, 1.0])
+        clip = np.asarray(paddle.take(x, idx, mode="clip").numpy())
+        np.testing.assert_allclose(clip, [0.0, 5.0, 5.0])
+
+    def test_bad_mode_raises(self):
+        import pytest
+        with pytest.raises(ValueError, match="'mode' in 'take'"):
+            paddle.take(t(np.ones((2,), "float32")),
+                        t(np.zeros((1,), "int64")), mode="bogus")
+
+    def test_raise_mode_bounds_checks_eagerly(self):
+        import pytest
+        x = t(np.arange(6, dtype="float32"))
+        with pytest.raises(ValueError, match="index out of range"):
+            paddle.take(x, t(np.array([6], "int64")))
+        with pytest.raises(ValueError, match="index out of range"):
+            paddle.take(x, t(np.array([-7], "int64")))
