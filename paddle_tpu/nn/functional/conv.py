@@ -18,20 +18,38 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
-def _conv_padding(padding, spatial, kernel, stride, dilation):
+def _conv_padding(padding, spatial, kernel, stride, dilation,
+                  channels_first=True):
     """Normalise paddle padding spec to lax padding list of (lo, hi)."""
     if isinstance(padding, str):
         return padding.upper()  # 'SAME' / 'VALID'
     if isinstance(padding, int):
         return [(padding, padding)] * spatial
     padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # per-dim pair spec. The full-rank form carries pairs for the
+        # batch/channel dims too, positioned by data_format; the reference
+        # requires those to be zero. Must dispatch BEFORE the flat
+        # 2*spatial branch: a 2-spatial 4-pair spec has len 4 too.
+        pairs = [tuple(int(v) for v in p) for p in padding]
+        if len(pairs) == spatial + 2:
+            if channels_first:
+                nonspatial, pairs = pairs[:2], pairs[2:]
+            else:
+                nonspatial, pairs = [pairs[0], pairs[-1]], pairs[1:-1]
+            if any(v != 0 for pr in nonspatial for v in pr):
+                raise ValueError(
+                    "(InvalidArgument) conv padding: non-zero padding on "
+                    f"batch/channel dims is not supported, got {padding}")
+        elif len(pairs) != spatial:
+            raise ValueError(
+                f"(InvalidArgument) conv padding pair spec must have "
+                f"{spatial} or {spatial + 2} pairs, got {len(pairs)}")
+        return pairs
     if len(padding) == spatial and all(isinstance(p, int) for p in padding):
         return [(p, p) for p in padding]
     if len(padding) == 2 * spatial:
         return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
-    if all(isinstance(p, (list, tuple)) for p in padding):
-        # NCHW-style 4d spec [[0,0],[0,0],[ph,ph],[pw,pw]]
-        return [tuple(p) for p in padding[-spatial:]]
     return [(int(p), int(p)) for p in padding]
 
 
@@ -65,7 +83,8 @@ def _conv_nd(x, w, bias, stride, padding, dilation, groups, spatial, data_format
         tuple(x.shape), tuple(w.shape), (lhs_spec, rhs_spec, out_spec))
     strides = _pair(stride, spatial)
     dils = _pair(dilation, spatial)
-    pad = _conv_padding(padding, spatial, tuple(w.shape[2:]), strides, dils)
+    pad = _conv_padding(padding, spatial, tuple(w.shape[2:]), strides, dils,
+                        channels_first=data_format.startswith("NC"))
 
     def fn(a, wt, *b):
         if not transposed:
@@ -154,8 +173,9 @@ def _pool_nd(x, kernel, stride, padding, spatial, reducer, init, ceil_mode=False
              data_format="NCHW", exclusive=True, is_avg=False):
     ks = _pair(kernel, spatial)
     st = _pair(stride if stride is not None else kernel, spatial)
-    pad = _conv_padding(padding, spatial, ks, st, (1,) * spatial)
     channels_first = data_format.startswith("NC")
+    pad = _conv_padding(padding, spatial, ks, st, (1,) * spatial,
+                        channels_first=channels_first)
     if channels_first:
         window = (1, 1) + ks
         strides = (1, 1) + st

@@ -354,3 +354,46 @@ class TestTakeModes:
             paddle.take(x, t(np.array([6], "int64")))
         with pytest.raises(ValueError, match="index out of range"):
             paddle.take(x, t(np.array([-7], "int64")))
+
+
+class TestConvPaddingForms:
+    def test_nchw_pair_spec(self):
+        """The reference conv accepts the 4-pair NCHW spec
+        [[0,0],[0,0],[ph,ph],[pw,pw]]; it must not be parsed as a flat
+        2*spatial list."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32") * 0.2
+        y = F.conv2d(t(x), t(w), padding=[[0, 0], [0, 0], [1, 1], [2, 2]])
+        ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                         padding=(1, 2)).numpy()
+        np.testing.assert_allclose(np.asarray(y.numpy()), ref,
+                                   rtol=2e-4, atol=1e-4)
+
+    def test_asymmetric_flat_spec(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype("float32")
+        w = rng.randn(2, 2, 3, 3).astype("float32") * 0.2
+        # flat [top, bottom, left, right]
+        y = F.conv2d(t(x), t(w), padding=[1, 0, 2, 1])
+        assert list(y.shape) == [1, 2, 5, 7]
+
+    def test_nhwc_pair_spec_positions(self):
+        """Channels-last pair spec: spatial pairs sit at positions 1..S."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 8, 8, 3).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32") * 0.2
+        y = F.conv2d(t(x), t(w), padding=[[0, 0], [1, 1], [2, 2], [0, 0]],
+                     data_format="NHWC")
+        assert list(y.shape) == [1, 8, 10, 4]
+
+    def test_nonzero_batch_channel_padding_raises(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        x = t(np.ones((1, 3, 8, 8), "float32"))
+        w = t(np.ones((4, 3, 3, 3), "float32"))
+        with pytest.raises(ValueError, match="batch/channel"):
+            F.conv2d(x, w, padding=[[1, 1], [0, 0], [2, 2], [3, 3]])
