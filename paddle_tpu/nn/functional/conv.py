@@ -176,27 +176,66 @@ def _pool_nd(x, kernel, stride, padding, spatial, reducer, init, ceil_mode=False
     channels_first = data_format.startswith("NC")
     pad = _conv_padding(padding, spatial, ks, st, (1,) * spatial,
                         channels_first=channels_first)
+    if ceil_mode and isinstance(pad, str) and pad == "VALID":
+        raise ValueError(
+            'When Attr(padding) is "VALID", Attr(ceil_mode) must be False. '
+            'Received ceil_mode: True.')
+    orig_pad = pad
+    if ceil_mode and not isinstance(pad, str):
+        # reference PoolOutputSize (phi/kernels/funcs/pooling.h:368):
+        # out = ceil((in + lo + hi - k)/s) + 1, with NO torch-style
+        # drop-last-window rule. Extra hi padding realizes it; the cells
+        # are padding (value = the reduce init)
+        sp_sizes = x.shape[2:2 + spatial] if channels_first \
+            else x.shape[1:1 + spatial]
+        new_pad = []
+        for i, (lo, hi) in enumerate(pad):
+            span = sp_sizes[i] + lo + hi - ks[i]
+            out_ceil = -(-span // st[i]) + 1
+            need = (out_ceil - 1) * st[i] + ks[i] - (sp_sizes[i] + lo)
+            new_pad.append((lo, max(hi, need)))
+        pad = new_pad
     if channels_first:
+        lead = [(0, 0), (0, 0)]
         window = (1, 1) + ks
         strides = (1, 1) + st
-        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else pad)
     else:
+        lead = [(0, 0)]
         window = (1,) + ks + (1,)
         strides = (1,) + st + (1,)
-        pads = [(0, 0)] + (pad if not isinstance(pad, str) else pad) + [(0, 0)]
     if isinstance(pad, str):
         pads = pad
+    else:
+        pads = lead + pad + ([] if channels_first else [(0, 0)])
 
     def fn(a):
+        zero = 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0)
         if is_avg:
-            summed = jax.lax.reduce_window(a, 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0),
-                                           jax.lax.add, window, strides, pads)
-            if exclusive and (isinstance(pads, str) or any(p != (0, 0) for p in pads)):
-                ones = jnp.ones_like(a)
-                cnt = jax.lax.reduce_window(ones, 0.0 if a.dtype != jnp.bfloat16 else jnp.bfloat16(0),
-                                            jax.lax.add, window, strides, pads)
+            summed = jax.lax.reduce_window(a, zero, jax.lax.add, window,
+                                           strides, pads)
+            if exclusive:
+                if not isinstance(pads, str) and \
+                        all(p == (0, 0) for p in pads):
+                    return summed / float(np.prod(ks))
+                # divisor = window overlap with the INPUT (padding excluded)
+                cnt = jax.lax.reduce_window(jnp.ones_like(a), zero,
+                                            jax.lax.add, window, strides,
+                                            pads)
                 return summed / cnt
-            return summed / float(np.prod(ks))
+            # exclusive=False: divisor = window overlap with input + the
+            # ORIGINAL padding (reference pooling.cc:79-84 clamps the pool
+            # size to the padded span; only ceil-extra cells are excluded).
+            # Without ceil_mode every window lies inside that span ("SAME"
+            # included, by construction), so the divisor is the kernel size.
+            if isinstance(pads, str) or not ceil_mode:
+                return summed / float(np.prod(ks))
+            full_op = lead + orig_pad + ([] if channels_first else [(0, 0)])
+            mask = jnp.pad(jnp.ones_like(a), full_op, constant_values=1)
+            extra = [(p[0] - o[0], p[1] - o[1])
+                     for p, o in zip(pads, full_op)]
+            cnt = jax.lax.reduce_window(mask, zero, jax.lax.add, window,
+                                        strides, extra)
+            return summed / cnt
         return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, pads)
 
     return apply_op(fn, x)
@@ -260,7 +299,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
                                    "NCL", ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
-                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    lambda d: jnp.finfo(d).min if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, "NCL")
 
 
@@ -270,7 +309,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
                                    data_format, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
-                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    lambda d: jnp.finfo(d).min if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, data_format)
 
 
@@ -280,7 +319,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
                                    data_format, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
-                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    lambda d: jnp.finfo(d).min if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
                     ceil_mode, data_format)
 
 

@@ -397,3 +397,67 @@ class TestConvPaddingForms:
         w = t(np.ones((4, 3, 3, 3), "float32"))
         with pytest.raises(ValueError, match="batch/channel"):
             F.conv2d(x, w, padding=[[1, 1], [0, 0], [2, 2], [3, 3]])
+
+
+class TestPoolCeilMode:
+    def test_ceil_mode_matches_torch(self):
+        """ceil_mode was silently ignored before: output shapes and values
+        must match torch on configs where no window starts in padding
+        (where torch's drop rule and paddle's no-drop formula agree)."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 7, 7).astype("float32")
+        tx = torch.tensor(x)
+        for k, s, p in [(3, 2, 0), (3, 2, 1), (2, 2, 0), (4, 3, 1)]:
+            got = np.asarray(F.max_pool2d(t(x), k, s, p,
+                                          ceil_mode=True).numpy())
+            ref = torch.nn.functional.max_pool2d(
+                tx, k, s, p, ceil_mode=True).numpy()
+            assert got.shape == ref.shape, (k, s, p)
+            np.testing.assert_allclose(got, ref, rtol=1e-6)
+            ga = np.asarray(F.avg_pool2d(t(x), k, s, p,
+                                         ceil_mode=True).numpy())
+            ra = torch.nn.functional.avg_pool2d(
+                tx, k, s, p, ceil_mode=True,
+                count_include_pad=False).numpy()
+            assert ga.shape == ra.shape, (k, s, p)
+            np.testing.assert_allclose(ga, ra, rtol=1e-5)
+
+    def test_ceil_mode_no_drop_rule_unlike_torch(self):
+        """The reference PoolOutputSize (pooling.h:368) has NO torch-style
+        drop-last-window rule: k=2,s=2,p=1 on 3x3 gives 3x3 (torch: 2x2)."""
+        import paddle_tpu.nn.functional as F
+        y = np.arange(9, dtype="float32").reshape(1, 1, 3, 3)
+        gp = F.max_pool2d(t(y), 2, 2, 1, ceil_mode=True)
+        assert list(gp.shape) == [1, 1, 3, 3]
+
+    def test_valid_padding_with_ceil_raises(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        y = t(np.ones((1, 1, 4, 4), "float32"))
+        with pytest.raises(ValueError, match="VALID"):
+            F.max_pool2d(y, 2, 2, "VALID", ceil_mode=True)
+
+    def test_include_pad_divisor_clamped_on_ceil_windows(self):
+        """exclusive=False divides by the window's overlap with
+        input+original padding (pooling.cc:79-84), not the kernel size,
+        on ceil-extra windows."""
+        import paddle_tpu.nn.functional as F
+        x = np.ones((1, 1, 3, 3), "float32")
+        ga = np.asarray(F.avg_pool2d(t(x), 2, 2, 0, ceil_mode=True,
+                                     exclusive=False).numpy())
+        ra = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), 2, 2, 0, ceil_mode=True,
+            count_include_pad=True).numpy()
+        np.testing.assert_allclose(ga, ra, rtol=1e-6)
+
+
+    def test_all_padding_window_is_finite_lowest(self):
+        """Reference MaxPool initial() is -FLT_MAX (pooling.h:46), not
+        -inf: a ceil-extra window lying entirely in padding stays finite."""
+        import paddle_tpu.nn.functional as F
+        x = np.ones((1, 1, 3, 3), "float32")
+        out = np.asarray(F.max_pool2d(t(x), 2, 2, 1, ceil_mode=True).numpy())
+        assert out.shape == (1, 1, 3, 3)
+        assert np.isfinite(out).all()
+        assert out[0, 0, 2, 2] == np.finfo(np.float32).min
