@@ -461,3 +461,32 @@ class TestPoolCeilMode:
         assert out.shape == (1, 1, 3, 3)
         assert np.isfinite(out).all()
         assert out[0, 0, 2, 2] == np.finfo(np.float32).min
+
+
+class TestActivationConstants:
+    def test_constants_match_torch(self):
+        """hardsigmoid slope/offset, hardswish, selu alpha/scale, softplus
+        beta/threshold cutover, elu alpha, mish, silu, soft/hard/tanh-shrink
+        — all pinned against torch (same constants as the reference)."""
+        import paddle_tpu.nn.functional as F
+        x = np.linspace(-4, 4, 17).astype("float32")
+        tx = torch.tensor(x)
+        tt = t(x)
+        cases = [
+            (F.hardsigmoid(tt), torch.nn.functional.hardsigmoid(tx)),
+            (F.hardswish(tt), torch.nn.functional.hardswish(tx)),
+            (F.selu(tt), torch.nn.functional.selu(tx)),
+            (F.softplus(tt, beta=2.0, threshold=10.0),
+             torch.nn.functional.softplus(tx, beta=2.0, threshold=10.0)),
+            (F.elu(tt, alpha=0.5), torch.nn.functional.elu(tx, alpha=0.5)),
+            (F.mish(tt), torch.nn.functional.mish(tx)),
+            (F.silu(tt), torch.nn.functional.silu(tx)),
+            (F.softshrink(tt, threshold=0.7),
+             torch.nn.functional.softshrink(tx, lambd=0.7)),
+            (F.hardshrink(tt, threshold=0.7),
+             torch.nn.functional.hardshrink(tx, lambd=0.7)),
+            (F.tanhshrink(tt), torch.nn.functional.tanhshrink(tx)),
+        ]
+        for got, ref in cases:
+            np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy(),
+                                       rtol=1e-5, atol=1e-6)
