@@ -244,7 +244,44 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
 
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    raise NotImplementedError("fold is not implemented yet")
+    """col2im, the inverse of unfold (reference fold op / fold_kernel):
+    overlapping patches scatter-ADD back into the image. x: (N, C*kh*kw, L)
+    with L = Lh*Lw sliding positions. Shape-static: one strided
+    scatter-add per kernel offset (kernels are small), the exact mirror of
+    unfold's gather loop."""
+    from .conv import _pair
+
+    os_ = _pair(output_sizes)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def fn(a):
+        N, ckk, L = a.shape
+        if ckk % (ks[0] * ks[1]):
+            raise ValueError(
+                f"(InvalidArgument) fold: input channel dim {ckk} must be "
+                f"divisible by kernel area {ks[0]}*{ks[1]}.")
+        C = ckk // (ks[0] * ks[1])
+        lh = (os_[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        lw = (os_[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        if lh * lw != L:
+            raise ValueError(
+                f"(InvalidArgument) fold: input holds {L} sliding positions "
+                f"but output_sizes/kernel/stride/padding/dilation imply "
+                f"{lh}*{lw}={lh * lw}.")
+        cols = a.reshape(N, C, ks[0], ks[1], lh, lw)
+        out = jnp.zeros((N, C, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]),
+                        a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :,
+                             i * dl[0]:i * dl[0] + lh * st[0]:st[0],
+                             j * dl[1]:j * dl[1] + lw * st[1]:st[1]].add(
+                    cols[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + os_[0], pd[1]:pd[1] + os_[1]]
+    return apply_op(fn, x)
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
@@ -269,7 +306,24 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    raise NotImplementedError
+    """Reference npair_loss (nn/functional/loss.py): soft-label CE over the
+    anchor x positive similarity matrix plus 0.25*l2_reg embedding norm."""
+    def fn(a, pos, lab):
+        beta = 0.25
+        n = lab.shape[0]
+        labf = lab.reshape(n, 1).astype(jnp.float32)
+        eq = (labf == labf.T).astype(jnp.float32)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2loss = (jnp.mean(jnp.sum(a * a, 1))
+                  + jnp.mean(jnp.sum(pos * pos, 1))) * beta * l2_reg
+        sim = a @ pos.T
+        lse = jax.nn.logsumexp(sim, axis=1, keepdims=True)
+        ce_rows = jnp.sum(soft * (lse - sim), axis=1)      # per-anchor CE
+        # the reference then weights per-COLUMN by the soft labels and
+        # means (sum(labels * ce, 0) -> mean)
+        ce = jnp.mean(jnp.sum(soft * ce_rows[:, None], axis=0))
+        return l2loss + ce
+    return apply_op(fn, anchor, positive, labels)
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):

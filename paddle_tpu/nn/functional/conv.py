@@ -169,6 +169,13 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 # ---------------------------------------------------------------- pooling
 
 
+def _max_pool_init(d):
+    """-inf for floats (required by JAX's reduce_window-max VJP pattern;
+    the finite -FLT_MAX reference semantics are restored by the isneginf
+    clamp in _pool_nd), integer lowest otherwise."""
+    return -jnp.inf if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min
+
+
 def _pool_nd(x, kernel, stride, padding, spatial, reducer, init, ceil_mode=False,
              data_format="NCHW", exclusive=True, is_avg=False):
     ks = _pair(kernel, spatial)
@@ -236,7 +243,19 @@ def _pool_nd(x, kernel, stride, padding, spatial, reducer, init, ceil_mode=False
             cnt = jax.lax.reduce_window(mask, zero, jax.lax.add, window,
                                         strides, extra)
             return summed / cnt
-        return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, pads)
+        out = jax.lax.reduce_window(a, init(a.dtype), reducer, window,
+                                    strides, pads)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            # the -inf init is required for JAX's reduce_window-max VJP
+            # pattern, but the reference MaxPool initial() is the FINITE
+            # -FLT_MAX (pooling.h:46): windows with no finite value (ceil
+            # cells entirely in padding, or all--inf data) must come out
+            # -FLT_MAX, not -inf. The where is constant on that branch, so
+            # gradients are unaffected.
+            out = jnp.where(jnp.isneginf(out),
+                            jnp.asarray(jnp.finfo(a.dtype).min, a.dtype),
+                            out)
+        return out
 
     return apply_op(fn, x)
 
@@ -299,7 +318,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
                                    "NCL", ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
-                    lambda d: jnp.finfo(d).min if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    _max_pool_init,
                     ceil_mode, "NCL")
 
 
@@ -309,7 +328,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
                                    data_format, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
-                    lambda d: jnp.finfo(d).min if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    _max_pool_init,
                     ceil_mode, data_format)
 
 
@@ -319,7 +338,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
         return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
                                    data_format, ceil_mode)
     return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
-                    lambda d: jnp.finfo(d).min if jnp.issubdtype(d, jnp.floating) else jnp.iinfo(d).min,
+                    _max_pool_init,
                     ceil_mode, data_format)
 
 

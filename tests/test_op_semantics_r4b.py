@@ -490,3 +490,77 @@ class TestActivationConstants:
         for got, ref in cases:
             np.testing.assert_allclose(np.asarray(got.numpy()), ref.numpy(),
                                        rtol=1e-5, atol=1e-6)
+
+
+class TestUnfoldFold:
+    def test_unfold_fold_match_torch(self):
+        import paddle_tpu.nn.functional as F
+        x = np.arange(2 * 3 * 6 * 6, dtype="float32").reshape(2, 3, 6, 6)
+        got = np.asarray(F.unfold(t(x), kernel_sizes=3, strides=2,
+                                  paddings=1, dilations=1).numpy())
+        ref = torch.nn.functional.unfold(torch.tensor(x), 3, padding=1,
+                                         stride=2).numpy()
+        np.testing.assert_allclose(got, ref)
+        # fold scatter-adds overlaps back (col2im)
+        f = np.asarray(F.fold(t(got), output_sizes=[6, 6], kernel_sizes=3,
+                              strides=2, paddings=1).numpy())
+        rf = torch.nn.functional.fold(torch.tensor(ref), (6, 6), 3,
+                                      padding=1, stride=2).numpy()
+        np.testing.assert_allclose(f, rf)
+
+    def test_fold_dilation_grad_and_validation(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(0).randn(1, 2 * 2 * 2, 4).astype("float32")
+        f = np.asarray(F.fold(t(x), output_sizes=[5, 5], kernel_sizes=2,
+                              strides=2, dilations=2).numpy())
+        rf = torch.nn.functional.fold(torch.tensor(x), (5, 5), 2,
+                                      stride=2, dilation=2).numpy()
+        np.testing.assert_allclose(f, rf)
+        # backward: fold is a scatter-add, so d(sum)/dx == 1 everywhere
+        xt = t(x)
+        xt.stop_gradient = False
+        F.fold(xt, output_sizes=[5, 5], kernel_sizes=2,
+               strides=2, dilations=2).sum().backward()
+        np.testing.assert_allclose(np.asarray(xt.grad), np.ones_like(x))
+        with pytest.raises(ValueError, match="sliding positions"):
+            F.fold(t(x[:, :, :3]), output_sizes=[5, 5], kernel_sizes=2,
+                   strides=2, dilations=2)
+        with pytest.raises(ValueError, match="kernel area"):
+            F.fold(t(np.ones((1, 5, 4), "float32")), output_sizes=[5, 5],
+                   kernel_sizes=2, strides=2, dilations=2)
+
+
+class TestNpairAdaptive3d:
+    def test_npair_loss_matches_reference_formula(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(7)
+        a = rng.randn(4, 6).astype("float32")
+        pos = rng.randn(4, 6).astype("float32")
+        lab = np.array([0, 1, 0, 2], "int64")
+        got = float(F.npair_loss(t(a), t(pos), t(lab), l2_reg=0.002).numpy())
+        # replicate the reference python composition exactly
+        n = 4
+        eq = (lab.reshape(n, 1) == lab.reshape(1, n)).astype("float32")
+        soft = eq / eq.sum(1, keepdims=True)
+        l2 = (np.mean((a * a).sum(1)) + np.mean((pos * pos).sum(1))) \
+            * 0.25 * 0.002
+        sim = a @ pos.T
+        lse = np.log(np.exp(sim - sim.max(1, keepdims=True)).sum(1,
+                     keepdims=True)) + sim.max(1, keepdims=True)
+        ce_rows = (soft * (lse - sim)).sum(1)
+        ce = np.mean((soft * ce_rows[:, None]).sum(0))
+        np.testing.assert_allclose(got, l2 + ce, rtol=1e-5)
+
+    def test_adaptive_pool3d_uneven_matches_torch(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(8)
+        x = rng.randn(1, 2, 5, 7, 6).astype("float32")
+        got = np.asarray(F.adaptive_avg_pool3d(t(x), [2, 3, 4]).numpy())
+        ref = torch.nn.functional.adaptive_avg_pool3d(
+            torch.tensor(x), (2, 3, 4)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        gm = np.asarray(F.adaptive_max_pool3d(t(x), [2, 3, 4]).numpy())
+        rm = torch.nn.functional.adaptive_max_pool3d(
+            torch.tensor(x), (2, 3, 4)).numpy()
+        np.testing.assert_allclose(gm, rm, rtol=1e-6)
