@@ -219,19 +219,44 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     return apply_op(fn, *args)
 
 
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    from .conv import _pair
+def _unfold_paddings(paddings):
+    """Reference unfold/fold padding spec (common.py:148-162): int -> all
+    four; [h, w] -> [h, w, h, w]; [top, left, bottom, right]. Returns
+    ((top, bottom), (left, right))."""
+    if isinstance(paddings, int):
+        pd = [paddings] * 4
+    else:
+        pd = list(paddings)
+        if len(pd) == 2:
+            pd = pd * 2
+        elif len(pd) != 4:
+            raise ValueError(
+                "paddings should either be an integer or a list of 2 or 4 "
+                "integers")
+    return (int(pd[0]), int(pd[2])), (int(pd[1]), int(pd[3]))
 
+
+def _unfold_geometry(kernel_sizes, strides, dilations):
+    from .conv import _pair
     ks = _pair(kernel_sizes)
     st = _pair(strides)
-    pd = _pair(paddings)
     dl = _pair(dilations)
+    if any(s <= 0 for s in st) or any(d <= 0 for d in dl):
+        raise ValueError(
+            f"(InvalidArgument) unfold/fold: strides and dilations must be "
+            f"positive, got strides={st} dilations={dl}.")
+    return ks, st, dl
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks, st, dl = _unfold_geometry(kernel_sizes, strides, dilations)
+    (pt, pb), (pl, pr) = _unfold_paddings(paddings)
 
     def fn(a):
         N, C, H, W = a.shape
-        a_p = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
-        oh = (H + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
-        ow = (W + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        oh = (H + pt + pb - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (W + pl + pr - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
         cols = []
         for i in range(ks[0]):
             for j in range(ks[1]):
@@ -252,10 +277,8 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
     from .conv import _pair
 
     os_ = _pair(output_sizes)
-    ks = _pair(kernel_sizes)
-    st = _pair(strides)
-    pd = _pair(paddings)
-    dl = _pair(dilations)
+    ks, st, dl = _unfold_geometry(kernel_sizes, strides, dilations)
+    (pt, pb), (pl, pr) = _unfold_paddings(paddings)
 
     def fn(a):
         N, ckk, L = a.shape
@@ -264,15 +287,15 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
                 f"(InvalidArgument) fold: input channel dim {ckk} must be "
                 f"divisible by kernel area {ks[0]}*{ks[1]}.")
         C = ckk // (ks[0] * ks[1])
-        lh = (os_[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
-        lw = (os_[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        lh = (os_[0] + pt + pb - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        lw = (os_[1] + pl + pr - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
         if lh * lw != L:
             raise ValueError(
                 f"(InvalidArgument) fold: input holds {L} sliding positions "
                 f"but output_sizes/kernel/stride/padding/dilation imply "
                 f"{lh}*{lw}={lh * lw}.")
         cols = a.reshape(N, C, ks[0], ks[1], lh, lw)
-        out = jnp.zeros((N, C, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]),
+        out = jnp.zeros((N, C, os_[0] + pt + pb, os_[1] + pl + pr),
                         a.dtype)
         for i in range(ks[0]):
             for j in range(ks[1]):
@@ -280,7 +303,7 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
                              i * dl[0]:i * dl[0] + lh * st[0]:st[0],
                              j * dl[1]:j * dl[1] + lw * st[1]:st[1]].add(
                     cols[:, :, i, j])
-        return out[:, :, pd[0]:pd[0] + os_[0], pd[1]:pd[1] + os_[1]]
+        return out[:, :, pt:pt + os_[0], pl:pl + os_[1]]
     return apply_op(fn, x)
 
 
@@ -327,4 +350,38 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError
+    """PartialFC class-center sampling (reference common.py:2011): keep
+    every positive class center, uniformly sample negatives up to
+    num_samples, and remap labels into the sampled list. Dynamic output
+    shape -> computed on host (the masked_select/nonzero precedent);
+    single-process semantics (group None/False). Cross-rank sampling would
+    need the label all-gather shown in the reference docstring."""
+    import numpy as np
+
+    if not (group is None or group is False):
+        raise NotImplementedError(
+            "class_center_sample: process groups are not supported; "
+            "gather labels across ranks first (reference docstring recipe)")
+    lab = np.asarray(label._data).reshape(-1).astype(np.int64)
+    if lab.size and (lab.min() < 0 or lab.max() >= num_classes):
+        raise ValueError(
+            f"(InvalidArgument) class_center_sample: labels must lie in "
+            f"[0, {num_classes}), got min {lab.min()} max {lab.max()}.")
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                                assume_unique=True)
+        k = min(num_samples - len(pos), len(neg_pool))
+        # derive the host RNG from the framework key stream so paddle.seed
+        # reproduces the sampled negatives (dropout-et-al convention)
+        import jax
+        seed_bits = int(jax.random.randint(
+            next_key(), (), 0, np.iinfo(np.int32).max))
+        rng = np.random.default_rng(seed_bits)
+        negs = rng.choice(neg_pool, size=k, replace=False)
+        sampled = np.sort(np.concatenate([pos, negs]))
+    remapped = np.searchsorted(sampled, lab)
+    return (Tensor(jnp.asarray(remapped)),
+            Tensor(jnp.asarray(sampled)))

@@ -366,6 +366,31 @@ def _adaptive_regions(in_size, out_size):
     return starts, ends
 
 
+def _adaptive_reduce_nd(a, out_sizes, mode):
+    """Uneven adaptive pooling over the trailing len(out_sizes) axes: one
+    nested static loop over the floor/ceil buckets (_adaptive_regions).
+    Shared by the 1-D/2-D/3-D paths so the bucketing formula lives once."""
+    import itertools
+
+    spatial = len(out_sizes)
+    in_sizes = a.shape[-spatial:]
+    regions = [_adaptive_regions(i, o) for i, o in zip(in_sizes, out_sizes)]
+    red_axes = tuple(range(-spatial, 0))
+
+    def build(level, index):
+        if level == spatial:
+            sl = (Ellipsis,) + tuple(
+                slice(int(regions[d][0][index[d]]),
+                      int(regions[d][1][index[d]])) for d in range(spatial))
+            blk = a[sl]
+            return blk.mean(axis=red_axes) if mode == "avg" \
+                else blk.max(axis=red_axes)
+        return jnp.stack([build(level + 1, index + (i,))
+                          for i in range(out_sizes[level])], axis=-1 - (
+                              spatial - level - 1))
+    return build(0, ())
+
+
 def _adaptive_pool2d(x, output_size, mode):
     out_hw = _pair(output_size, 2)
 
@@ -378,17 +403,7 @@ def _adaptive_pool2d(x, output_size, mode):
             if mode == "avg":
                 return r.mean(axis=(-3, -1))
             return r.max(axis=(-3, -1))
-        hs, he = _adaptive_regions(H, oh)
-        ws, we = _adaptive_regions(W, ow)
-        rows = []
-        for i in range(oh):
-            cols = []
-            for j in range(ow):
-                block = a[..., int(hs[i]):int(he[i]), int(ws[j]):int(we[j])]
-                red = block.mean(axis=(-2, -1)) if mode == "avg" else block.max(axis=(-2, -1))
-                cols.append(red)
-            rows.append(jnp.stack(cols, axis=-1))
-        return jnp.stack(rows, axis=-2)
+        return _adaptive_reduce_nd(a, (oh, ow), mode)
     return apply_op(fn, x)
 
 
@@ -406,8 +421,7 @@ def adaptive_avg_pool1d(x, output_size, name=None):
         o = int(output_size)
         if L % o == 0:
             return a.reshape(a.shape[:-1] + (o, L // o)).mean(axis=-1)
-        ss, ee = _adaptive_regions(L, o)
-        return jnp.stack([a[..., int(s):int(e)].mean(axis=-1) for s, e in zip(ss, ee)], axis=-1)
+        return _adaptive_reduce_nd(a, (o,), "avg")
     return apply_op(fn, x)
 
 
@@ -417,6 +431,5 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
         o = int(output_size)
         if L % o == 0:
             return a.reshape(a.shape[:-1] + (o, L // o)).max(axis=-1)
-        ss, ee = _adaptive_regions(L, o)
-        return jnp.stack([a[..., int(s):int(e)].max(axis=-1) for s, e in zip(ss, ee)], axis=-1)
+        return _adaptive_reduce_nd(a, (o,), "max")
     return apply_op(fn, x)

@@ -401,26 +401,9 @@ def _adaptive_pool3d(x, output_size, mode):
             if mode == "avg":
                 return r.mean(axis=(-5, -3, -1))
             return r.max(axis=(-5, -3, -1))
-        # uneven: reference adaptive bucketing (floor/ceil regions), the
-        # same formula as the 2d path
-        from .conv import _adaptive_regions
-        ds, de = _adaptive_regions(D, od)
-        hs, he = _adaptive_regions(H, oh)
-        ws, we = _adaptive_regions(W, ow)
-        planes = []
-        for k in range(od):
-            rows = []
-            for i in range(oh):
-                cols = []
-                for j in range(ow):
-                    blk = a[..., int(ds[k]):int(de[k]),
-                            int(hs[i]):int(he[i]), int(ws[j]):int(we[j])]
-                    red = blk.mean(axis=(-3, -2, -1)) if mode == "avg" \
-                        else blk.max(axis=(-3, -2, -1))
-                    cols.append(red)
-                rows.append(jnp.stack(cols, axis=-1))
-            planes.append(jnp.stack(rows, axis=-2))
-        return jnp.stack(planes, axis=-3)
+        # uneven: shared N-d adaptive bucketing (conv._adaptive_reduce_nd)
+        from .conv import _adaptive_reduce_nd
+        return _adaptive_reduce_nd(a, (od, oh, ow), mode)
     return apply_op(fn, x)
 
 

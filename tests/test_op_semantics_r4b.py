@@ -564,3 +564,53 @@ class TestNpairAdaptive3d:
         rm = torch.nn.functional.adaptive_max_pool3d(
             torch.tensor(x), (2, 3, 4)).numpy()
         np.testing.assert_allclose(gm, rm, rtol=1e-6)
+
+
+class TestClassCenterSample:
+    def test_positives_kept_and_remapped(self):
+        import paddle_tpu.nn.functional as F
+        lab = t(np.array([3, 7, 3, 11], "int64"))
+        remapped, sampled = F.class_center_sample(lab, 20, 8)
+        s = np.asarray(sampled.numpy())
+        r = np.asarray(remapped.numpy())
+        assert len(s) == 8 and len(set(s.tolist())) == 8
+        for c in (3, 7, 11):
+            assert c in s
+        # remap consistency: sampled[remapped[i]] == label[i]
+        np.testing.assert_array_equal(s[r], [3, 7, 3, 11])
+        assert (np.sort(s) == s).all()
+
+    def test_more_positives_than_samples_keeps_all(self):
+        import paddle_tpu.nn.functional as F
+        lab = t(np.arange(6, dtype="int64"))
+        remapped, sampled = F.class_center_sample(lab, 10, 4)
+        assert len(np.asarray(sampled.numpy())) == 6
+
+    def test_label_range_validated(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError, match="labels must lie"):
+            F.class_center_sample(t(np.array([25], "int64")), 20, 8)
+
+    def test_unfold_fold_asymmetric_paddings(self):
+        """[top, left, bottom, right] spec (reference common.py:148-162)."""
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(9).randn(1, 2, 5, 5).astype("float32")
+        got = np.asarray(F.unfold(t(x), 2, strides=2,
+                                  paddings=[1, 0, 0, 1]).numpy())
+        # torch unfold only does symmetric padding; golden via explicit pad
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 0), (0, 1)])
+        ref = torch.nn.functional.unfold(torch.tensor(xp), 2,
+                                         stride=2).numpy()
+        np.testing.assert_allclose(got, ref)
+        f = np.asarray(F.fold(t(got), [5, 5], 2, strides=2,
+                              paddings=[1, 0, 0, 1]).numpy())
+        rf = torch.nn.functional.fold(torch.tensor(ref), (6, 6), 2,
+                                      stride=2).numpy()[:, :, 1:, :-1]
+        np.testing.assert_allclose(f, rf)
+
+    def test_zero_stride_raises(self):
+        import pytest
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError, match="strides and dilations"):
+            F.unfold(t(np.ones((1, 1, 4, 4), "float32")), 2, strides=0)
