@@ -139,28 +139,140 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     return apply_op(fn, x)
 
 
+def _interp_ratio(in_s, out_s, align_corners):
+    """Reference interpolate_kernel ratio: (in-1)/(out-1) when
+    align_corners else in/out; 0 when out == 1 (everything maps to 0)."""
+    if out_s <= 1:
+        return 0.0
+    return (in_s - 1) / (out_s - 1) if align_corners else in_s / out_s
+
+
+def _interp_axis_linear(a, ax, out_s, align_corners, align_mode):
+    """Separable linear interpolation along one axis with the reference's
+    source-coordinate rule (interpolate_kernel.cc:57): half-pixel when
+    align_mode == 0 and not align_corners, asymmetric otherwise."""
+    in_s = a.shape[ax]
+    ratio = _interp_ratio(in_s, out_s, align_corners)
+    i = jnp.arange(out_s, dtype=jnp.float32)
+    if align_mode == 0 and not align_corners:
+        src = ratio * (i + 0.5) - 0.5
+    else:
+        src = ratio * i
+    src = jnp.maximum(src, 0.0)
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_s - 1)
+    hi = jnp.minimum(lo + 1, in_s - 1)
+    w = (src - lo).astype(a.dtype)
+    bshape = [1] * a.ndim
+    bshape[ax] = out_s
+    w = w.reshape(bshape)
+    return jnp.take(a, lo, axis=ax) * (1 - w) + jnp.take(a, hi, axis=ax) * w
+
+
+def _interp_axis_cubic(a, ax, out_s, align_corners):
+    """Separable bicubic with the reference's A = -0.75 Keys kernel
+    (interpolate_function.h:43 — torch's constant too; jax.image.resize
+    uses A = -0.5, which visibly diverges). Half-pixel source coords
+    unless align_corners."""
+    in_s = a.shape[ax]
+    ratio = _interp_ratio(in_s, out_s, align_corners)
+    i = jnp.arange(out_s, dtype=jnp.float32)
+    src = ratio * i if align_corners else ratio * (i + 0.5) - 0.5
+    base = jnp.floor(src).astype(jnp.int32)
+    t = (src - base).astype(jnp.float32)
+    A = -0.75
+
+    def w_near(x):           # |x| <= 1
+        return (A + 2) * x ** 3 - (A + 3) * x ** 2 + 1
+
+    def w_far(x):            # 1 < |x| < 2
+        return A * x ** 3 - 5 * A * x ** 2 + 8 * A * x - 4 * A
+
+    weights = [w_far(t + 1), w_near(t), w_near(1 - t), w_far(2 - t)]
+    bshape = [1] * a.ndim
+    bshape[ax] = out_s
+    out = 0
+    for k, w in enumerate(weights):
+        idx = jnp.clip(base - 1 + k, 0, in_s - 1)
+        out = out + jnp.take(a, idx, axis=ax) * \
+            w.astype(a.dtype).reshape(bshape)
+    return out
+
+
+def _interp_axis_nearest(a, ax, out_s, align_corners):
+    """Reference nearest rule (interpolate_kernel.cc:210): int(ratio*i+0.5)
+    when align_corners else int(ratio*i)."""
+    in_s = a.shape[ax]
+    ratio = _interp_ratio(in_s, out_s, align_corners)
+    i = jnp.arange(out_s, dtype=jnp.float32)
+    src = ratio * i + (0.5 if align_corners else 0.0)
+    idx = jnp.clip(src.astype(jnp.int32), 0, in_s - 1)
+    return jnp.take(a, idx, axis=ax)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    cf = data_format.startswith("NC")
+    nd = len(tuple(x.shape)) - 2
+    spatial_in = tuple(x.shape)[2:] if cf else tuple(x.shape)[1:-1]
+    # one shared output-size computation for every mode: scalar size
+    # broadcasts to all spatial axes; a wrong-length list is a loud error
+    if size is not None:
+        sz = size if isinstance(size, (list, tuple)) else [size] * nd
+        out_sp = tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                       for s in sz)
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        out_sp = tuple(int(s * f) for s, f in zip(spatial_in, sf))
+    if len(out_sp) != nd:
+        raise ValueError(
+            f"(InvalidArgument) interpolate: size/scale_factor must give "
+            f"{nd} spatial sizes, got {out_sp}.")
+
+    if mode == "area":
+        # reference: area interpolation IS adaptive average pooling
+        # (channels-first helpers; relayout around them if needed)
+        from . import extras as _ex
+        from .conv import adaptive_avg_pool1d, adaptive_avg_pool2d
+        xin = x if cf else paddle_transpose_to_cf(x, nd)
+        if nd == 1:
+            out = adaptive_avg_pool1d(xin, out_sp[0])
+        elif nd == 2:
+            out = adaptive_avg_pool2d(xin, list(out_sp))
+        else:
+            out = _ex.adaptive_avg_pool3d(xin, list(out_sp))
+        return out if cf else paddle_transpose_to_cl(out, nd)
+
     def fn(a):
-        cf = data_format.startswith("NC")
-        spatial_in = a.shape[2:] if cf else a.shape[1:-1]
-        if size is not None:
-            out_sp = tuple(int(s._data) if isinstance(s, Tensor) else int(s)
-                           for s in (size if isinstance(size, (list, tuple)) else [size]))
-        else:
-            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
-                else [scale_factor] * len(spatial_in)
-            out_sp = tuple(int(s * f) for s, f in zip(spatial_in, sf))
-        if cf:
-            out_shape = a.shape[:2] + out_sp
-        else:
-            out_shape = (a.shape[0],) + out_sp + (a.shape[-1],)
-        method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
-                  "trilinear": "trilinear", "bicubic": "cubic", "area": "linear"}[mode]
-        if method == "trilinear":
-            method = "linear"
-        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+        spatial_axes = tuple(range(2, a.ndim)) if cf \
+            else tuple(range(1, a.ndim - 1))
+        if mode == "nearest":
+            for ax, o in zip(spatial_axes, out_sp):
+                a = _interp_axis_nearest(a, ax, o, align_corners)
+            return a
+        if mode in ("linear", "bilinear", "trilinear"):
+            for ax, o in zip(spatial_axes, out_sp):
+                a = _interp_axis_linear(a, ax, o, align_corners, align_mode)
+            return a
+        if mode == "bicubic":
+            for ax, o in zip(spatial_axes, out_sp):
+                a = _interp_axis_cubic(a, ax, o, align_corners)
+            return a
+        raise ValueError(f"(InvalidArgument) interpolate: unknown mode "
+                         f"{mode!r}")
     return apply_op(fn, x)
+
+
+def paddle_transpose_to_cf(x, nd):
+    """N...C -> NC... for the channels-first pooling helpers."""
+    perm = [0, nd + 1] + list(range(1, nd + 1))
+    return apply_op(lambda a: jnp.transpose(a, perm), x)
+
+
+def paddle_transpose_to_cl(x, nd):
+    """NC... -> N...C."""
+    perm = [0] + list(range(2, nd + 2)) + [1]
+    return apply_op(lambda a: jnp.transpose(a, perm), x)
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,

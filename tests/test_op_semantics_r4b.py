@@ -614,3 +614,87 @@ class TestClassCenterSample:
         import paddle_tpu.nn.functional as F
         with pytest.raises(ValueError, match="strides and dilations"):
             F.unfold(t(np.ones((1, 1, 4, 4), "float32")), 2, strides=0)
+
+
+class TestInterpolateModes:
+    def _x(self):
+        return np.random.RandomState(0).randn(1, 2, 5, 7).astype("float32")
+
+    def test_bilinear_both_corner_modes(self):
+        import paddle_tpu.nn.functional as F
+        x = self._x(); tx = torch.tensor(x)
+        for corners in (False, True):
+            g = np.asarray(F.interpolate(t(x), size=[8, 11], mode="bilinear",
+                                         align_corners=corners).numpy())
+            r = torch.nn.functional.interpolate(
+                tx, size=(8, 11), mode="bilinear",
+                align_corners=corners).numpy()
+            np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+    def test_nearest_is_floor_rule(self):
+        # paddle nearest: src = floor(ratio*i) (interpolate_kernel.cc:211),
+        # same as torch 'nearest'
+        import paddle_tpu.nn.functional as F
+        x = self._x(); tx = torch.tensor(x)
+        g = np.asarray(F.interpolate(t(x), size=[3, 4],
+                                     mode="nearest").numpy())
+        r = torch.nn.functional.interpolate(tx, size=(3, 4),
+                                            mode="nearest").numpy()
+        np.testing.assert_allclose(g, r)
+
+    def test_area_is_adaptive_avg(self):
+        import paddle_tpu.nn.functional as F
+        x = self._x(); tx = torch.tensor(x)
+        g = np.asarray(F.interpolate(t(x), size=[3, 4], mode="area").numpy())
+        r = torch.nn.functional.interpolate(tx, size=(3, 4),
+                                            mode="area").numpy()
+        np.testing.assert_allclose(g, r, rtol=1e-5)
+
+    def test_bicubic_uses_minus_075_kernel(self):
+        # reference A = -0.75 (interpolate_function.h:43); jax.image's
+        # cubic is A = -0.5 and visibly diverges — pinned vs torch
+        import paddle_tpu.nn.functional as F
+        x = self._x(); tx = torch.tensor(x)
+        for corners in (False, True):
+            g = np.asarray(F.interpolate(t(x), size=[8, 11], mode="bicubic",
+                                         align_corners=corners).numpy())
+            r = torch.nn.functional.interpolate(
+                tx, size=(8, 11), mode="bicubic",
+                align_corners=corners).numpy()
+            np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-5)
+
+    def test_align_mode_1_asymmetric(self):
+        # paddle-only knob: src = ratio*i for the linear family
+        import paddle_tpu.nn.functional as F
+        x1 = np.arange(8, dtype="float32").reshape(1, 1, 8)
+        g = np.asarray(F.interpolate(t(x1), size=[4], mode="linear",
+                                     align_mode=1, data_format="NCW").numpy())
+        np.testing.assert_allclose(g[0, 0], [0.0, 2.0, 4.0, 6.0])
+
+    def test_trilinear_corners(self):
+        import paddle_tpu.nn.functional as F
+        x3 = np.random.RandomState(1).randn(1, 2, 3, 4, 5).astype("float32")
+        g = np.asarray(F.interpolate(t(x3), size=[5, 6, 7], mode="trilinear",
+                                     align_corners=True,
+                                     data_format="NCDHW").numpy())
+        r = torch.nn.functional.interpolate(
+            torch.tensor(x3), size=(5, 6, 7), mode="trilinear",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+    def test_area_nhwc_and_scalar_size(self):
+        import paddle_tpu.nn.functional as F
+        x = np.random.RandomState(2).randn(1, 5, 7, 2).astype("float32")
+        g = np.asarray(F.interpolate(t(x), size=[3, 4], mode="area",
+                                     data_format="NHWC").numpy())
+        r = torch.nn.functional.interpolate(
+            torch.tensor(x).permute(0, 3, 1, 2), size=(3, 4),
+            mode="area").permute(0, 2, 3, 1).numpy()
+        assert g.shape == (1, 3, 4, 2)
+        np.testing.assert_allclose(g, r, rtol=1e-5)
+        xc = np.random.RandomState(3).randn(1, 2, 5, 7).astype("float32")
+        g2 = F.interpolate(t(xc), size=8, mode="bilinear")
+        assert list(g2.shape) == [1, 2, 8, 8]
+        import pytest
+        with pytest.raises(ValueError, match="spatial sizes"):
+            F.interpolate(t(xc), size=[8], mode="bilinear")
