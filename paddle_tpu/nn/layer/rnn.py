@@ -133,8 +133,35 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack
+
         seq_axis = 0 if self.time_major else 1
         T = inputs.shape[seq_axis]
+        if sequence_length is not None:
+            # pad+mask variable-length semantics (the documented LoD
+            # replacement; reference rnn op with SequenceLength): a
+            # reverse RNN runs forward over each sample's valid segment
+            # flipped in place, steps past a sample's length hold the
+            # state and emit zeros
+            from ..functional.common import sequence_mask
+            inputs_eff = _flip_valid(inputs, sequence_length, seq_axis) \
+                if self.is_reverse else inputs
+            mask = sequence_mask(sequence_length, maxlen=T, dtype="bool")
+            outs, states = [], initial_states
+            prev = None
+            for t in range(T):
+                x_t = inputs_eff[t] if self.time_major else inputs_eff[:, t]
+                o, states = self.cell(x_t, states)
+                valid = mask[:, t]                           # (B,) bool
+                o = _mask_rows(o, valid)
+                if prev is not None:
+                    states = _select_states(valid, states, prev)
+                prev = states
+                outs.append(o)
+            out = stack(outs, axis=seq_axis)
+            if self.is_reverse:
+                out = _flip_valid(out, sequence_length, seq_axis)
+            return out, states
         steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
         outs, states = [], initial_states
         for t in steps:
@@ -143,8 +170,53 @@ class RNN(Layer):
             outs.append(o)
         if self.is_reverse:
             outs = outs[::-1]
-        from ...tensor.manipulation import stack
         return stack(outs, axis=seq_axis), states
+
+
+def _mask_rows(o, valid):
+    from ...core.tensor import apply_op
+
+    def fn(a, v):
+        vb = v.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(vb, a, jnp.zeros_like(a))
+    return apply_op(fn, o, valid)
+
+
+def _select_states(valid, new, old):
+    """Hold the pre-step state for finished samples (reference final-state
+    semantics: the state AT each sample's last valid step)."""
+    from ...core.tensor import apply_op
+
+    def pick(n, o):
+        def fn(v, a, b):
+            vb = v.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(vb, a, b)
+        return apply_op(fn, valid, n, o)
+    if isinstance(new, (tuple, list)):
+        return type(new)(pick(n, o) for n, o in zip(new, old))
+    return pick(new, old)
+
+
+def _flip_valid(x, sequence_length, seq_axis):
+    """Reverse each sample's first `len` steps in place (steps beyond stay
+    put): gather with idx_t = len-1-t for t < len else t."""
+    from ...core.tensor import apply_op
+
+    def fn(a, sl):
+        T = a.shape[seq_axis]
+        t_idx = jnp.arange(T, dtype=jnp.int32)
+        sli = sl.astype(jnp.int32).reshape(-1, 1)            # (B,1)
+        idx = jnp.where(t_idx[None, :] < sli, sli - 1 - t_idx[None, :],
+                        t_idx[None, :])                      # (B,T)
+        if seq_axis == 1:                                    # (B,T,...)
+            return jnp.take_along_axis(
+                a, idx.reshape(idx.shape + (1,) * (a.ndim - 2)), axis=1)
+        # time-major (T,B,...): gather per batch column
+        bt = jnp.swapaxes(a, 0, 1)
+        out = jnp.take_along_axis(
+            bt, idx.reshape(idx.shape + (1,) * (bt.ndim - 2)), axis=1)
+        return jnp.swapaxes(out, 0, 1)
+    return apply_op(fn, x, sequence_length)
 
 
 class _MultiLayerRNN(Layer):
@@ -177,17 +249,32 @@ class _MultiLayerRNN(Layer):
             return GRUCell(in_size, hidden)
         return SimpleRNNCell(in_size, hidden, activation)
 
+    def _layer_init(self, initial_states, i, d):
+        """Slice the paddle-layout initial state ((L*D, B, H), or the
+        (h, c) pair of those for LSTM) for layer i, direction d."""
+        if initial_states is None:
+            return None
+        D = 2 if self.bidirect else 1
+        k = i * D + d
+        if self.MODE == "LSTM":
+            h0, c0 = initial_states
+            return (h0[k], c0[k])
+        return initial_states[k]
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...tensor.manipulation import concat, stack
         x = inputs
         final_h, final_c = [], []
         for i in range(self.num_layers):
             runner = RNN(self.cells[i], time_major=self.time_major)
-            out_f, st_f = runner(x)
+            out_f, st_f = runner(x, self._layer_init(initial_states, i, 0),
+                                 sequence_length=sequence_length)
             if self.bidirect:
                 runner_b = RNN(self.cells_bw[i], is_reverse=True,
                                time_major=self.time_major)
-                out_b, st_b = runner_b(x)
+                out_b, st_b = runner_b(
+                    x, self._layer_init(initial_states, i, 1),
+                    sequence_length=sequence_length)
                 x = concat([out_f, out_b], axis=-1)
                 sts = [st_f, st_b]
             else:
@@ -226,6 +313,12 @@ class BiRNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...tensor.manipulation import concat
-        out_f, st_f = self.rnn_fw(inputs)
-        out_b, st_b = self.rnn_bw(inputs)
+        init_f = init_b = None
+        if initial_states is not None:
+            # reference BiRNN: a (states_fw, states_bw) pair
+            init_f, init_b = initial_states
+        out_f, st_f = self.rnn_fw(inputs, init_f,
+                                  sequence_length=sequence_length)
+        out_b, st_b = self.rnn_bw(inputs, init_b,
+                                  sequence_length=sequence_length)
         return concat([out_f, out_b], axis=-1), (st_f, st_b)

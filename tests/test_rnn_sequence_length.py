@@ -1,0 +1,100 @@
+"""Variable-length RNN batches via sequence_length (the documented LoD
+replacement; reference rnn op SequenceLength semantics): outputs past each
+sample's length are zero, final states are the states AT the last valid
+step, and reverse direction flips each valid segment in place. Goldens:
+torch packed sequences."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _copy_lstm_weights(pl, tl):
+    sd = {
+        "weight_ih_l0": torch.tensor(np.asarray(pl.cells[0].weight_ih.numpy())),
+        "weight_hh_l0": torch.tensor(np.asarray(pl.cells[0].weight_hh.numpy())),
+        "bias_ih_l0": torch.tensor(np.asarray(pl.cells[0].bias_ih.numpy())),
+        "bias_hh_l0": torch.tensor(np.asarray(pl.cells[0].bias_hh.numpy())),
+    }
+    tl.load_state_dict(sd)
+
+
+def test_lstm_sequence_length_matches_torch_packed():
+    B, T, I, H = 3, 5, 4, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, T, I).astype("float32")
+    lens = np.array([5, 3, 1], "int64")
+    pl = nn.LSTM(I, H)
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_lstm_weights(pl, tl)
+    out, (h, c) = pl(paddle.to_tensor(x),
+                     sequence_length=paddle.to_tensor(lens))
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.tensor(x), torch.tensor(lens), batch_first=True)
+    po, (th, tc) = tl(packed)
+    to, _ = torch.nn.utils.rnn.pad_packed_sequence(
+        po, batch_first=True, total_length=T)
+    np.testing.assert_allclose(np.asarray(out.numpy()), to.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.numpy())[0],
+                               th.detach().numpy()[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.numpy())[0],
+                               tc.detach().numpy()[0], rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_masking_and_reverse_segments():
+    B, T, I, H = 3, 5, 4, 6
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, T, I).astype("float32")
+    lens = np.array([5, 3, 1], "int64")
+    pg = nn.GRU(I, H, direction="bidirect")
+    og, _ = pg(paddle.to_tensor(x), sequence_length=paddle.to_tensor(lens))
+    og = np.asarray(og.numpy())
+    assert (og[1, 3:] == 0).all() and (og[2, 1:] == 0).all()
+    assert not (og[1, :3] == 0).all()
+    # reverse half at step 0 equals a fwd pass over the flipped valid
+    # segment: for sample 2 (len 1) both directions see only x[2, 0]
+    fwd_half, bwd_half = og[2, 0, :H], og[2, 0, H:]
+    pg2 = nn.GRU(I, H)
+    pg2.cells[0].weight_ih.set_value(pg.cells_bw[0].weight_ih)
+    pg2.cells[0].weight_hh.set_value(pg.cells_bw[0].weight_hh)
+    pg2.cells[0].bias_ih.set_value(pg.cells_bw[0].bias_ih)
+    pg2.cells[0].bias_hh.set_value(pg.cells_bw[0].bias_hh)
+    o2, _ = pg2(paddle.to_tensor(x[2:3, :1]))
+    np.testing.assert_allclose(bwd_half, np.asarray(o2.numpy())[0, 0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_no_sequence_length_unchanged():
+    B, T, I, H = 2, 4, 3, 5
+    rng = np.random.RandomState(2)
+    x = rng.randn(B, T, I).astype("float32")
+    m = nn.SimpleRNN(I, H)
+    o1, s1 = m(paddle.to_tensor(x))
+    o2, s2 = m(paddle.to_tensor(x),
+               sequence_length=paddle.to_tensor(np.array([T, T], "int64")))
+    np.testing.assert_allclose(np.asarray(o1.numpy()),
+                               np.asarray(o2.numpy()), rtol=1e-5, atol=1e-6)
+
+
+def test_initial_states_threaded_matches_torch():
+    """Multi-layer LSTM must consume user (h0, c0) in the paddle
+    (L*D, B, H) layout — previously silently dropped."""
+    B, T, I, H = 2, 4, 3, 5
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, T, I).astype("float32")
+    h0 = rng.randn(1, B, H).astype("float32")
+    c0 = rng.randn(1, B, H).astype("float32")
+    pl = nn.LSTM(I, H)
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_lstm_weights(pl, tl)
+    out, (h, c) = pl(paddle.to_tensor(x),
+                     (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    to, (th, tc) = tl(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), to.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.numpy()), th.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.numpy()), tc.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
