@@ -447,10 +447,12 @@ def _promote_scalar_dtype(scalar, tensor):
         td = tensor.dtype
         if isinstance(scalar, bool):
             return _dt.bool_
-        if isinstance(scalar, numbers.Integral) and _dt.is_floating(td):
-            return td
+        if isinstance(scalar, numbers.Integral):
+            # int scalar adopts the tensor dtype — except bool, where
+            # arithmetic must not collapse to logical ops ((x>0)*3)
+            return td if td != _dt.bool_ else _dt.get_default_dtype()
         if isinstance(scalar, numbers.Real) and not _dt.is_floating(td):
-            return _dt.get_default_dtype()
+            return _dt.get_default_dtype()   # float scalar + int tensor
         return td
     return None
 

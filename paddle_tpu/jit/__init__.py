@@ -22,20 +22,40 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._cache = {}
+        self._tfn = None
+
+    def _transformed(self):
+        """dy2static-rewritten forward (tensor-dependent if/while/for ->
+        lax control flow); falls back to the raw fn when the source can't
+        be transformed. Reference: program_translator.py:1001."""
+        if self._tfn is None:
+            from . import dy2static
+            self._tfn = dy2static.maybe_transform(self._fn)
+        return self._tfn
 
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        return StaticFunction(self._fn, layer=instance, input_spec=self._input_spec)
+        # cache the bound StaticFunction in the instance dict so repeated
+        # calls reuse one jit cache (instance attrs shadow this non-data
+        # descriptor, so later lookups skip __get__ entirely)
+        name = self._fn.__name__
+        bound = instance.__dict__.get(name)
+        if not (isinstance(bound, StaticFunction) and bound._fn is self._fn):
+            bound = StaticFunction(self._fn, layer=instance,
+                                   input_spec=self._input_spec)
+            instance.__dict__[name] = bound
+        return bound
 
     def _build(self, train):
         layer = self._layer
+        fn = self._transformed()
 
         if layer is None:
             @functools.partial(jax.jit)
             def compiled(seed, *raw_args):
                 with _rng.traced_rng(seed):
-                    out = self._fn(*wrap(list(raw_args)))
+                    out = fn(*wrap(list(raw_args)))
                 return unwrap(out)
             return compiled
 
@@ -45,7 +65,7 @@ class StaticFunction:
                 out, new_buffers = functional_call(
                     layer, params, buffers,
                     args=tuple(Tensor(a) for a in raw_args),
-                    train=train, method=self._fn)
+                    train=train, method=fn)
             return unwrap(out), new_buffers
         return compiled
 
@@ -209,6 +229,15 @@ def load(path, **configs):
 
 
 def not_to_static(fn=None):
+    """Mark a function as exempt from dy2static rewriting (reference:
+    jit/api.py not_to_static); convert_call passes it through untouched.
+    Usable bare or as a zero-arg decorator factory."""
+    if fn is None:
+        return not_to_static
+    try:
+        fn.__dy2static_transformed__ = True
+    except (AttributeError, TypeError):
+        pass
     return fn
 
 

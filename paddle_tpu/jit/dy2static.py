@@ -1,0 +1,966 @@
+"""dygraph-to-static transpiler: compile *unmodified* Paddle-style Python —
+including tensor-dependent ``if`` / ``while`` / ``for`` / ``break`` /
+``continue`` / ``and`` / ``or`` / ``not`` — into one traceable program.
+
+Reference pipeline (30 AST files):
+  fluid/dygraph/dygraph_to_static/program_translator.py:1001 (StaticFunction
+  entry), ifelse_transformer.py (hoists branch-assigned names into true/false
+  functions), loop_transformer.py (loop-carried name analysis -> while_loop),
+  break_continue_transformer.py (break/continue -> flag variables + guards),
+  logical_transformer.py (and/or/not -> convert_logical_*),
+  convert_operators.py (runtime convert_ifelse/convert_while_loop helpers that
+  pick the dygraph or static path per call), convert_call_func.py
+  (recursively transform callees).
+
+TPU-native design: same two-phase shape, radically smaller target. The AST
+pass only needs to (1) hoist branch/loop-assigned locals into pure functions
+and (2) route control flow through runtime helpers; the helpers then decide
+per call: concrete (python) values keep plain eager Python semantics, traced
+values lower to ``lax.cond`` / ``lax.while_loop`` / ``lax.scan`` — XLA is the
+"static program", no ProgramDesc/op-by-op construction tier is needed.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Dy2StaticError", "convert_to_static", "convert_call",
+    "convert_ifelse", "convert_while", "convert_for", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "maybe_range",
+    "assert_not_traced", "ld",
+]
+
+
+class Dy2StaticError(RuntimeError):
+    """Raised when tensor-dependent control flow cannot be lowered; the
+    message names the offending construct (reference: dy2static/error.py)."""
+
+
+# --------------------------------------------------------------------------
+# undefined-variable sentinel
+# --------------------------------------------------------------------------
+class _Undefined:
+    """Placeholder for a local that is not yet bound when a tensor-dependent
+    construct starts (reference: variable_trans_func.py create_undefined_var).
+    Registered as an EMPTY pytree node so it can ride through lax.cond /
+    while_loop carries; any use raises with the variable story intact."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def _die(self, *a, **k):
+        raise Dy2StaticError(
+            "a local variable was read before assignment inside "
+            "tensor-dependent control flow (it is only assigned on one "
+            "branch/path); assign it a value before the if/loop")
+
+    __bool__ = __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = _die
+    __rmul__ = __truediv__ = __getitem__ = __call__ = __iter__ = _die
+    __neg__ = __lt__ = __le__ = __gt__ = __ge__ = _die
+
+
+UNDEF = _Undefined()
+jax.tree_util.register_pytree_node(
+    _Undefined, lambda u: ((), None), lambda aux, ch: UNDEF)
+
+
+def ld(name, lcls):
+    """Load ``name`` from a locals() snapshot, or the undefined sentinel."""
+    return lcls.get(name, UNDEF)
+
+
+# --------------------------------------------------------------------------
+# small runtime utilities
+# --------------------------------------------------------------------------
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x):
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _unwrap_tree(tree):
+    return jax.tree.map(_raw, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_like(new, old):
+    """Re-wrap jax arrays as Tensor where the original value was a Tensor
+    OR where tracing promoted a python scalar to an array."""
+    def one(n, o):
+        if isinstance(o, Tensor):
+            return Tensor(n)
+        if isinstance(n, jax.Array) and not isinstance(o, jax.Array):
+            return Tensor(n)
+        return n
+    return jax.tree.map(one, new, old,
+                        is_leaf=lambda x: isinstance(x, (Tensor, _Undefined)))
+
+
+def _tree_has_tracer(tree):
+    return any(isinstance(l, jax.core.Tracer)
+               for l in jax.tree.leaves(_unwrap_tree(tree)))
+
+
+def _scalar_bool(x):
+    r = _raw(x)
+    if isinstance(r, (jax.Array, np.ndarray, np.generic)):
+        return bool(np.asarray(r).reshape(()))
+    return bool(r)   # python values (lists, dicts, None, ...): plain truth
+
+
+def assert_not_traced(value, construct):
+    """Guard for constructs the transpiler leaves as plain Python: fine
+    eagerly, a clear error under trace (reference: error.py suggestions)."""
+    if _is_tracer(value):
+        raise Dy2StaticError(
+            f"dy2static: {construct} depends on a traced tensor and cannot "
+            f"be lowered to XLA control flow; restructure the code (e.g. "
+            f"move the 'return' out of the branch/loop) or use "
+            f"paddle.static.nn.cond / while_loop directly")
+    return value
+
+
+# --------------------------------------------------------------------------
+# runtime converters (reference: dy2static/convert_operators.py)
+# --------------------------------------------------------------------------
+def convert_ifelse(pred, true_fn, false_fn, names, vals):
+    """``if pred: ...`` where both arms assign ``names``.
+
+    Concrete pred -> run the chosen arm as plain Python. Traced pred ->
+    lax.cond over the carried locals (reference convert_ifelse builds a
+    ConditionalBlock; here both arms are traced by lax.cond itself)."""
+    if not _is_tracer(pred):
+        fn = true_fn if _scalar_bool(pred) else false_fn
+        return fn(*vals)
+
+    operands = _unwrap_tree(list(vals))
+
+    def arm(fn):
+        def inner(ops):
+            out = fn(*_wrap_like(ops, list(vals)))
+            return _unwrap_tree(list(out))
+        return inner
+
+    try:
+        outs = jax.lax.cond(jnp.reshape(_raw(pred), ()),
+                            arm(true_fn), arm(false_fn), operands)
+    except TypeError as e:
+        raise Dy2StaticError(
+            f"dy2static: the two branches of a tensor-dependent 'if' "
+            f"produced mismatched values for locals {list(names)} "
+            f"(each branch must leave every assigned local with the same "
+            f"shape/dtype; a local assigned on only one branch stays "
+            f"<undefined> on the other): {e}") from None
+    vals_l = list(vals)
+    if len(outs) == len(vals_l):
+        return tuple(_wrap_like(outs, vals_l))
+    # value-select form (both branches `return expr`): no carried locals
+    return tuple(Tensor(o) if isinstance(o, jax.Array) else o for o in outs)
+
+
+def convert_while(cond_fn, body_fn, names, vals):
+    """``while cond: body`` over loop-carried locals ``names``.
+
+    Concrete cond every iteration -> plain Python loop (correct dygraph
+    semantics, unrolled under trace only if the carry stays concrete).
+    The first traced cond switches the remaining iterations to
+    lax.while_loop (reference convert_while_loop)."""
+    vals = list(vals)
+    while True:
+        c = cond_fn(*vals)
+        if _is_tracer(c):
+            return _lax_while(cond_fn, body_fn, names, vals)
+        if not _scalar_bool(c):
+            return tuple(vals)
+        vals = list(body_fn(*vals))
+
+
+def _match_carry(out_flat, init_flat, names):
+    """Cast body outputs back to the carry avals (weak-type / dtype drift);
+    shape drift is a real error, named."""
+    res = []
+    for o, i in zip(out_flat, init_flat):
+        if isinstance(i, _Undefined) or isinstance(o, _Undefined):
+            res.append(o)
+            continue
+        o = jnp.asarray(o)
+        i = jnp.asarray(i)
+        if o.shape != i.shape:
+            raise Dy2StaticError(
+                f"dy2static: a loop-carried local changes shape across "
+                f"iterations ({i.shape} -> {o.shape}); XLA loops need "
+                f"fixed shapes. Carried locals: {list(names)}")
+        res.append(jax.lax.convert_element_type(o, i.dtype))
+    return res
+
+
+def _dtype_fixpoint(raw_body, init):
+    """Promote carry dtypes to the fixed point of the body's output dtypes:
+    eager Python promotes on the first iteration (int accumulator + float ->
+    float), but an XLA carry can't change dtype mid-loop, so promote the
+    initial values up front instead of silently truncating."""
+    for _ in range(4):
+        try:
+            outs = jax.eval_shape(raw_body, tuple(init))
+        except Exception:
+            return init   # structural problems surface via the real lowering
+        changed = False
+        nxt = []
+        for o, i in zip(outs, init):
+            if isinstance(i, _Undefined) or isinstance(o, _Undefined):
+                nxt.append(i)
+                continue
+            pd = jnp.promote_types(o.dtype, i.dtype)
+            if pd != i.dtype:
+                i = jax.lax.convert_element_type(i, pd)
+                changed = True
+            nxt.append(i)
+        init = nxt
+        if not changed:
+            break
+    return init
+
+
+def _lax_while(cond_fn, body_fn, names, vals):
+    init = [jnp.asarray(d) if not isinstance(d, _Undefined) else d
+            for d in _unwrap_tree(vals)]
+    # strip weak types so body outputs can be cast to a stable aval
+    init = [jax.lax.convert_element_type(d, d.dtype)
+            if not isinstance(d, _Undefined) else d for d in init]
+    init = _dtype_fixpoint(
+        lambda carry: tuple(_unwrap_tree(list(
+            body_fn(*_wrap_like(list(carry), vals))))), init)
+
+    def c(carry):
+        out = cond_fn(*_wrap_like(list(carry), vals))
+        return jnp.reshape(_raw(out), ())
+
+    def b(carry):
+        out = body_fn(*_wrap_like(list(carry), vals))
+        return tuple(_match_carry(_unwrap_tree(list(out)), carry, names))
+
+    try:
+        final = jax.lax.while_loop(c, b, tuple(init))
+    except TypeError as e:
+        raise Dy2StaticError(
+            f"dy2static: tensor-dependent 'while' could not be lowered "
+            f"(carried locals {list(names)} must keep a fixed "
+            f"shape/dtype/structure across iterations): {e}") from None
+    return tuple(_wrap_like(list(final), vals))
+
+
+class _TracedRange:
+    """range() whose bounds include traced scalars (reference: the loop
+    transformer turns ``for i in range(n)`` into a while over an index)."""
+
+    def __init__(self, *args):
+        a = [_raw(x) for x in args]
+        if len(a) == 1:
+            self.start, self.stop, self.step = 0, a[0], 1
+        elif len(a) == 2:
+            self.start, self.stop, self.step = a[0], a[1], 1
+        else:
+            self.start, self.stop, self.step = a
+
+
+def maybe_range(*args):
+    if any(_is_tracer(x) or isinstance(x, Tensor) for x in args):
+        return _TracedRange(*args)
+    return range(*(int(_raw(x)) for x in args))
+
+
+def convert_for(iterable, body_fn, names, vals):
+    """``for tgt in iterable: body``. body_fn(tgt, *carry) -> carry.
+
+    python iterable -> eager loop; _TracedRange -> lax.fori_loop;
+    traced/concrete-under-trace Tensor -> lax.scan over the leading axis."""
+    vals = tuple(vals)
+    if isinstance(iterable, _TracedRange):
+        r = iterable
+        n = jnp.maximum(0, -(-(jnp.asarray(r.stop) - r.start) // r.step))
+        init = tuple(_match_carry(_unwrap_tree(list(vals)),
+                                  _unwrap_tree(list(vals)), names))
+        init = tuple(_dtype_fixpoint(
+            lambda carry: tuple(_unwrap_tree(list(body_fn(
+                Tensor(jnp.asarray(r.start)),
+                *_wrap_like(list(carry), list(vals)))))), list(init)))
+
+        def b(k, carry):
+            i = jnp.asarray(r.start) + k * jnp.asarray(r.step)
+            out = body_fn(Tensor(i), *_wrap_like(list(carry), list(vals)))
+            return tuple(_match_carry(_unwrap_tree(list(out)), carry, names))
+
+        try:
+            final = jax.lax.fori_loop(0, n, b, init)
+        except TypeError as e:
+            raise Dy2StaticError(
+                f"dy2static: tensor-dependent 'for' over range could not be "
+                f"lowered (carried locals {list(names)} must keep a fixed "
+                f"shape/dtype/structure across iterations): {e}") from None
+        return tuple(_wrap_like(list(final), list(vals)))
+
+    if isinstance(iterable, Tensor) and (
+            _is_tracer(iterable) or _tree_has_tracer(vals)):
+        xs = _raw(iterable)
+        if xs.ndim == 0:
+            raise Dy2StaticError(
+                "dy2static: cannot iterate a 0-d tensor in a traced 'for'")
+        init = tuple(_match_carry(_unwrap_tree(list(vals)),
+                                  _unwrap_tree(list(vals)), names))
+        init = tuple(_dtype_fixpoint(
+            lambda carry: tuple(_unwrap_tree(list(body_fn(
+                Tensor(xs[0]), *_wrap_like(list(carry), list(vals)))))),
+            list(init)))
+
+        def step(carry, row):
+            out = body_fn(Tensor(row), *_wrap_like(list(carry), list(vals)))
+            return tuple(_match_carry(_unwrap_tree(list(out)), carry,
+                                      names)), None
+
+        try:
+            final, _ = jax.lax.scan(step, init, xs)
+        except TypeError as e:
+            raise Dy2StaticError(
+                f"dy2static: tensor-dependent 'for' over a tensor could not "
+                f"be lowered (carried locals {list(names)} must keep a fixed "
+                f"shape/dtype/structure across iterations): {e}") from None
+        return tuple(_wrap_like(list(final), list(vals)))
+
+    if isinstance(iterable, Tensor):
+        it = [Tensor(row) for row in _raw(iterable)]
+    else:
+        it = iterable
+    try:
+        iter(it)
+    except TypeError:
+        raise Dy2StaticError(
+            f"dy2static: cannot iterate object of type "
+            f"{type(iterable).__name__} in a converted 'for' loop") from None
+    for item in it:
+        vals = tuple(body_fn(item, *vals))
+    return vals
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    """``a and b`` preserving short-circuit for concrete values
+    (reference: logical_transformer.py -> convert_logical_and)."""
+    a = lhs_fn()
+    if not _is_tracer(a):
+        return rhs_fn() if _scalar_bool(a) else a
+    b = rhs_fn()
+    return Tensor(jnp.logical_and(jnp.reshape(_raw(a), ()),
+                                  jnp.reshape(_raw(b), ())))
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    a = lhs_fn()
+    if not _is_tracer(a):
+        return a if _scalar_bool(a) else rhs_fn()
+    b = rhs_fn()
+    return Tensor(jnp.logical_or(jnp.reshape(_raw(a), ()),
+                                 jnp.reshape(_raw(b), ())))
+
+
+def convert_logical_not(x):
+    if not _is_tracer(x):
+        return not _scalar_bool(x)
+    return Tensor(jnp.logical_not(jnp.reshape(_raw(x), ())))
+
+
+# --------------------------------------------------------------------------
+# convert_call: recursively transform user callees
+# (reference: convert_call_func.py convert_call)
+# --------------------------------------------------------------------------
+_SKIP_MODULE_PREFIXES = ("jax", "numpy", "paddle_tpu", "builtins", "math",
+                         "functools", "itertools", "operator", "np")
+_call_cache = {}
+
+
+def convert_call(f):
+    """Return a dy2static-transformed version of a user function so that
+    tensor-dependent control flow inside *callees* also lowers; framework,
+    numpy and jax callables pass through untouched."""
+    try:
+        key = f.__func__ if inspect.ismethod(f) else f
+        if key in _call_cache:
+            out = _call_cache[key]
+        else:
+            out = _transform_or_passthrough(key)
+            _call_cache[key] = out
+        if inspect.ismethod(f):
+            return functools.partial(out, f.__self__) if out is not key else f
+        return out
+    except Exception:
+        return f
+
+
+def _transform_or_passthrough(f):
+    if not isinstance(f, types.FunctionType):
+        return f
+    if getattr(f, "__dy2static_transformed__", False):
+        return f
+    mod = getattr(f, "__module__", "") or ""
+    if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
+        return f
+    try:
+        return convert_to_static(f)
+    except Exception:
+        return f
+
+
+# --------------------------------------------------------------------------
+# AST analysis helpers
+# --------------------------------------------------------------------------
+def _collect_stores(nodes):
+    """Names bound (simple Name targets) anywhere in the statement list —
+    the loop-carry / branch-output set (reference: loop_transformer.py
+    NameVisitor get_loop_var_names)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and node.id not in out:
+                out.append(node.id)
+
+        def visit_Subscript(self, node):
+            # x[i] = v rebinds x's storage: carry the BASE name so the
+            # functional update stays inside the lax arm/loop
+            if isinstance(node.ctx, ast.Store):
+                base = node.value
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id not in out:
+                    out.append(base.id)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            # own scope; function values can't ride XLA carries, so inner
+            # defs are recreated in place rather than carried
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _has_attr_store(nodes):
+    """Object-attribute assignment (self.x = v) inside a tensor-dependent
+    construct can't ride an XLA carry; detect it so the construct stays
+    Python with a clear traced-guard instead of leaking a tracer."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Attribute(self, node):
+            if isinstance(node.ctx, ast.Store):
+                self.found = True
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+def _has(nodes, *kinds):
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, kinds):
+                return True
+    return False
+
+
+def _has_toplevel_loop_escape(body):
+    """True if `body` contains Return/Break/Continue not nested inside a
+    deeper loop (for break/continue) — i.e. escapes *this* construct."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_For(self, node):
+            for s in ast.walk(node):
+                if isinstance(s, ast.Return):
+                    self.found = True
+
+        visit_While = visit_For
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for n in body:
+        v.visit(n)
+    return v.found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst(attr, *args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr=attr, ctx=ast.Load()),
+        args=list(args), keywords=[])
+
+
+def _ld_call(n):
+    return _jst("ld", ast.Constant(n),
+                ast.Call(func=_name("locals"), args=[], keywords=[]))
+
+
+def _const_tuple(names):
+    return ast.Tuple(elts=[ast.Constant(n) for n in names], ctx=ast.Load())
+
+
+def _lambda0(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _fn_def(name, params, body, returns_names):
+    body = list(body)
+    body.append(ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in returns_names], ctx=ast.Load())))
+    node = ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p) for p in params],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=body, decorator_list=[], returns=None)
+    node.type_params = []   # py3.12+ ast requires the field
+    return node
+
+
+def _sets_flag(nodes, brk, cont):
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name) and t.id in (brk, cont):
+                        return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# pass 1: break/continue -> flag variables + guards
+# (reference: break_continue_transformer.py)
+# --------------------------------------------------------------------------
+class _BreakContinueLowering(ast.NodeTransformer):
+    """Within each loop body: ``break`` -> ``__brk_i = True``, ``continue``
+    -> ``__cont_i = True``; every statement after a flag-setting statement is
+    guarded by ``if not (__brk_i or __cont_i):``; the loop condition gains
+    ``and not __brk_i``. The guards are ordinary ifs, which pass 2 then
+    lowers when the flags are tensors."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _lower_body(self, body, brk, cont):
+        """Rewrite one loop body's statement list with flag guards."""
+        def rewrite(stmts):
+            out = []
+            for i, s in enumerate(stmts):
+                s2, sets_flag = self._rewrite_stmt(s, brk, cont)
+                out.append(s2)
+                if sets_flag and i + 1 < len(stmts):
+                    rest = rewrite(stmts[i + 1:])
+                    guard = ast.UnaryOp(
+                        op=ast.Not(),
+                        operand=ast.BoolOp(op=ast.Or(), values=[
+                            _name(brk), _name(cont)]))
+                    out.append(ast.If(test=guard, body=rest, orelse=[]))
+                    break
+            return out
+        return rewrite(body)
+
+    def _rewrite_stmt(self, s, brk, cont):
+        """Returns (new_stmt, may_set_flag). Descends into If statements
+        (whose branches may break/continue) but NOT into nested loops —
+        those get their own flags via generic visitation later."""
+        if isinstance(s, ast.Break):
+            return ast.Assign(targets=[_name(brk, ast.Store())],
+                              value=ast.Constant(True)), True
+        if isinstance(s, ast.Continue):
+            return ast.Assign(targets=[_name(cont, ast.Store())],
+                              value=ast.Constant(True)), True
+        if isinstance(s, ast.If):
+            nb = self._lower_body(s.body, brk, cont)
+            no = self._lower_body(s.orelse, brk, cont)
+            return ast.If(test=s.test, body=nb, orelse=no or []), \
+                _sets_flag(nb + no, brk, cont)
+        return s, False
+
+    def _transform_loop(self, node):
+        self.generic_visit(node)   # inner loops first
+        direct = self._direct_break_continue(node.body)
+        if not direct:
+            return node
+        self._uid += 1
+        brk = f"__dy2s_brk_{self._uid}"
+        cont = f"__dy2s_cont_{self._uid}"
+        new_body = [ast.Assign(targets=[_name(cont, ast.Store())],
+                               value=ast.Constant(False))]
+        new_body += self._lower_body(node.body, brk, cont)
+        # both flags init'd BEFORE the loop too: they ride the XLA loop
+        # carry, which needs a defined value at entry
+        init = [ast.Assign(targets=[_name(brk, ast.Store())],
+                           value=ast.Constant(False)),
+                ast.Assign(targets=[_name(cont, ast.Store())],
+                           value=ast.Constant(False))]
+        # python for/while-else runs iff the loop did NOT break: hoist the
+        # else body behind a flag guard so the semantics survive lowering
+        tail = []
+        orelse = node.orelse
+        if orelse:
+            tail = [ast.If(test=ast.UnaryOp(op=ast.Not(),
+                                            operand=_name(brk)),
+                           body=orelse, orelse=[])]
+            orelse = []
+        if isinstance(node, ast.While):
+            new_test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_name(brk)), node.test])
+            loop = ast.While(test=new_test, body=new_body, orelse=orelse)
+            return init + [loop] + tail
+        # For: wrap the body so iterations after break are no-ops
+        guarded = [ast.If(
+            test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+            body=new_body, orelse=[])]
+        loop = ast.For(target=node.target, iter=node.iter, body=guarded,
+                       orelse=orelse)
+        return init + [loop] + tail
+
+    def _direct_break_continue(self, body):
+        """break/continue belonging to THIS loop (not a nested one)."""
+        class V(ast.NodeVisitor):
+            found = False
+
+            def visit_Break(self, n):
+                self.found = True
+
+            def visit_Continue(self, n):
+                self.found = True
+
+            def visit_For(self, n):
+                pass
+
+            def visit_While(self, n):
+                pass
+
+            def visit_FunctionDef(self, n):
+                pass
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+        v = V()
+        for s in body:
+            v.visit(s)
+        return v.found
+
+    def visit_While(self, node):
+        return self._transform_loop(node)
+
+    def visit_For(self, node):
+        return self._transform_loop(node)
+
+
+# --------------------------------------------------------------------------
+# pass 2: control flow -> runtime converter calls
+# (reference: ifelse_transformer.py / loop_transformer.py /
+#  logical_transformer.py / call_transformer.py)
+# --------------------------------------------------------------------------
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def _uid_next(self):
+        self._uid += 1
+        return self._uid
+
+    # ---- logical operators ------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        attr = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        expr = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            expr = _jst(attr, _lambda0(v), _lambda0(expr))
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst("convert_logical_not", node.operand)
+        return node
+
+    # ---- calls ------------------------------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("locals", "globals", "super",
+                                                "range", "print", "len",
+                                                "isinstance", "enumerate",
+                                                "zip"):
+            return node
+        node.func = _jst("convert_call", f)
+        return node
+
+    # ---- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_toplevel_loop_escape(node.body) or \
+                _has_toplevel_loop_escape(node.orelse):
+            return self._if_with_return(node)
+        if _has_attr_store(node.body + node.orelse):
+            node.test = _jst("assert_not_traced", node.test,
+                             ast.Constant("an 'if' whose branch assigns an "
+                                          "object attribute"))
+            return node
+        uid = self._uid_next()
+        assigned = _collect_stores(node.body + node.orelse)
+        if not assigned:
+            # pure side-effect-free branches still need lowering under
+            # trace; carry nothing, return nothing
+            assigned = []
+        tf = _fn_def(f"__dy2s_tf_{uid}", assigned, node.body, assigned)
+        ff = _fn_def(f"__dy2s_ff_{uid}", assigned,
+                     node.orelse or [ast.Pass()], assigned)
+        call = _jst("convert_ifelse", node.test,
+                    _name(tf.name), _name(ff.name),
+                    _const_tuple(assigned),
+                    ast.Tuple(elts=[_ld_call(n) for n in assigned],
+                              ctx=ast.Load()))
+        if assigned:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[_name(n, ast.Store()) for n in assigned],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [tf, ff, assign]
+
+    def _if_with_return(self, node):
+        """Both arms end in ``return expr`` -> value-select; anything else
+        with an escaping return stays Python with a clear traced-guard
+        (reference: return_transformer.py handles the general case with
+        return-flag lowering; the guard names the restructure)."""
+        body, orelse = node.body, node.orelse
+        if (len(body) >= 1 and isinstance(body[-1], ast.Return)
+                and orelse and isinstance(orelse[-1], ast.Return)
+                and not _has(body[:-1] + orelse[:-1], ast.Return)
+                and body[-1].value is not None
+                and orelse[-1].value is not None):
+            uid = self._uid_next()
+            tf = _fn_def(f"__dy2s_rtf_{uid}", [], body[:-1], [])
+            tf.body[-1] = ast.Return(value=ast.Tuple(
+                elts=[body[-1].value], ctx=ast.Load()))
+            ff = _fn_def(f"__dy2s_rff_{uid}", [], orelse[:-1], [])
+            ff.body[-1] = ast.Return(value=ast.Tuple(
+                elts=[orelse[-1].value], ctx=ast.Load()))
+            call = _jst("convert_ifelse", node.test,
+                        _name(tf.name), _name(ff.name),
+                        _const_tuple(["<return value>"]),
+                        ast.Tuple(elts=[], ctx=ast.Load()))
+            ret = ast.Return(value=ast.Subscript(
+                value=call, slice=ast.Constant(0), ctx=ast.Load()))
+            return [tf, ff, ret]
+        node.test = _jst("assert_not_traced", node.test,
+                         ast.Constant("an 'if' whose branch contains an "
+                                      "early 'return'"))
+        return node
+
+    # ---- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_toplevel_loop_escape(node.body) or node.orelse or \
+                _has_attr_store(node.body):
+            what = "a 'while' with an 'else' clause" if node.orelse else (
+                "a 'while' whose body assigns an object attribute"
+                if _has_attr_store(node.body)
+                else "a 'while' whose body contains 'return'")
+            node.test = _jst("assert_not_traced", node.test, ast.Constant(what))
+            return node
+        uid = self._uid_next()
+        carried = _collect_stores(node.body)
+        if not carried:
+            # nothing carried: a tensor-cond loop that changes no locals is
+            # either infinite or dead; keep python semantics with a guard
+            node.test = _jst("assert_not_traced", node.test,
+                             ast.Constant("a 'while' that assigns no locals"))
+            return node
+        cf = _fn_def(f"__dy2s_wc_{uid}", carried, [], [])
+        cf.body = [ast.Return(value=node.test)]
+        bf = _fn_def(f"__dy2s_wb_{uid}", carried, node.body, carried)
+        call = _jst("convert_while", _name(cf.name), _name(bf.name),
+                    _const_tuple(carried),
+                    ast.Tuple(elts=[_ld_call(n) for n in carried],
+                              ctx=ast.Load()))
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=call)
+        return [cf, bf, assign]
+
+    # ---- for --------------------------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if _has_toplevel_loop_escape(node.body) or node.orelse or \
+                _has_attr_store(node.body):
+            what = "a 'for' with an 'else' clause" if node.orelse else (
+                "a 'for' whose body assigns an object attribute"
+                if _has_attr_store(node.body)
+                else "a 'for' whose body contains 'return'")
+            node.iter = _jst("assert_not_traced", node.iter, ast.Constant(what))
+            return node
+        uid = self._uid_next()
+        carried = _collect_stores(node.body)
+        # the loop target is rebound each iteration, not carried
+        tgt_names = _collect_stores(
+            [ast.Assign(targets=[node.target], value=ast.Constant(0))])
+        carried = [n for n in carried if n not in tgt_names]
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range":
+            it = _jst("maybe_range", *it.args)
+        # body_fn(target, *carried)
+        if isinstance(node.target, ast.Name):
+            params = [node.target.id] + carried
+            prelude = []
+        else:
+            params = ["__dy2s_item"] + carried
+            prelude = [ast.Assign(targets=[node.target],
+                                  value=_name("__dy2s_item"))]
+        bf = _fn_def(f"__dy2s_fb_{uid}", params, prelude + node.body, carried)
+        call = _jst("convert_for", it, _name(bf.name),
+                    _const_tuple(carried),
+                    ast.Tuple(elts=[_ld_call(n) for n in carried],
+                              ctx=ast.Load()))
+        if carried:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[_name(n, ast.Store()) for n in carried],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [bf, assign]
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+def convert_to_static(fn):
+    """Source -> AST -> (break/continue lowering, control-flow rewrite) ->
+    recompiled function. Closure variables are materialized as globals of the
+    transformed function (reference: program_translator.py transforms to a
+    temp file + exec; same trade-off: closure cells are snapshotted)."""
+    if getattr(fn, "__dy2static_transformed__", False):
+        return fn
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise Dy2StaticError(f"cannot transform {fn!r}: not a function def")
+    # constructs the rewrite cannot preserve -> plain ValueError so
+    # maybe_transform falls back to raw tracing with a warning
+    for sub in ast.walk(fdef):
+        if isinstance(sub, (ast.Yield, ast.YieldFrom, ast.Await)):
+            raise ValueError("generator/async function")
+        if isinstance(sub, (ast.Global, ast.Nonlocal)):
+            raise ValueError("global/nonlocal declaration")
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "super" and not sub.args:
+            raise ValueError("zero-argument super() needs its class cell")
+    fdef.decorator_list = []
+    fdef.body = _apply_passes(fdef.body)
+    fdef.name = fn.__name__ + "__dy2static"
+    mod = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    glb = dict(fn.__globals__)
+    glb["_jst"] = _module()
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    ns = {}
+    exec(code, glb, ns)
+    new = ns[fdef.name]
+    new = functools.wraps(fn)(new)
+    new.__defaults__ = fn.__defaults__
+    new.__kwdefaults__ = fn.__kwdefaults__
+    new.__dy2static_transformed__ = True
+    return new
+
+
+def _apply_passes(body):
+    holder = ast.Module(body=body, type_ignores=[])
+    holder = _BreakContinueLowering().visit(holder)
+    holder = _ControlFlowTransformer().visit(holder)
+    return holder.body
+
+
+def _module():
+    import paddle_tpu.jit.dy2static as m
+    return m
+
+
+def maybe_transform(fn):
+    """Best-effort entry used by @to_static: transform when source is
+    available; fall back to the raw function (plain tracing) otherwise."""
+    from . import ProgramTranslator
+    if not ProgramTranslator.enable_to_static:
+        return fn
+    try:
+        return convert_to_static(fn)
+    except Dy2StaticError:
+        raise
+    except Exception as e:  # source unavailable, exotic syntax, ...
+        warnings.warn(f"dy2static: falling back to plain tracing for "
+                      f"{getattr(fn, '__qualname__', fn)}: {e}")
+        return fn

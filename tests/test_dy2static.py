@@ -1,0 +1,456 @@
+"""dy2static: unmodified Paddle-style Python with tensor-dependent control
+flow compiles under @to_static.
+
+Reference suites: test_ifelse_basic.py / test_loop.py /
+test_break_continue.py / test_logical.py under
+python/paddle/fluid/tests/unittests/dygraph_to_static/ — same behavioral
+contract, lowered to lax.cond / while_loop / scan instead of ProgramDesc
+ConditionalBlock / While ops.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import Dy2StaticError, convert_to_static
+
+
+def _t(a, dtype="float32"):
+    return paddle.to_tensor(np.asarray(a, dtype=dtype))
+
+
+# ------------------------------------------------------------------ if/else
+def test_tensor_if_else():
+    def fn(x):
+        if x.mean() > 0:
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    st = to_static(fn)
+    xp = _t([1.0, 2.0])
+    xn = _t([-1.0, -2.0])
+    np.testing.assert_allclose(st(xp).numpy(), xp.numpy() + 1)
+    np.testing.assert_allclose(st(xn).numpy(), xn.numpy() - 1)
+
+
+def test_tensor_if_no_else():
+    def fn(x):
+        y = x * 2
+        if x.sum() > 100:
+            y = y + 100
+        return y
+
+    st = to_static(fn)
+    x = _t([1.0, 2.0])
+    np.testing.assert_allclose(st(x).numpy(), [2.0, 4.0])
+    big = _t([200.0, 1.0])
+    np.testing.assert_allclose(st(big).numpy(), [500.0, 102.0])
+
+
+def test_nested_tensor_if():
+    def fn(x):
+        if x.mean() > 0:
+            if x.max() > 10:
+                y = x * 3
+            else:
+                y = x * 2
+        else:
+            y = -x
+        return y
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([20.0])).numpy(), [60.0])
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(st(_t([-3.0])).numpy(), [3.0])
+
+
+def test_if_both_branches_return():
+    def fn(x):
+        if x.sum() > 0:
+            return x * 10
+        else:
+            return x * -1
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([2.0])).numpy(), [20.0])
+    np.testing.assert_allclose(st(_t([-2.0])).numpy(), [2.0])
+
+
+def test_if_branch_mismatch_raises():
+    def fn(x):
+        if x.sum() > 0:
+            y = x + 1          # y undefined on the false path
+        return y
+
+    st = to_static(fn)
+    with pytest.raises(Dy2StaticError):
+        st(_t([1.0]))
+
+
+def test_python_bool_if_stays_eager():
+    calls = []
+
+    def fn(x, flag=True):
+        if flag:                    # python bool: plain python branch
+            calls.append("t")
+            y = x + 1
+        else:
+            calls.append("f")
+            y = x - 1
+        return y
+
+    out = convert_to_static(fn)(_t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    assert calls == ["t"]           # false branch never executed
+
+
+# ------------------------------------------------------------------ logical
+def test_logical_and_or_not():
+    def fn(x):
+        if x.mean() > 0 and x.max() < 10:
+            y = x + 1
+        elif not (x.min() > -5):
+            y = x - 1
+        else:
+            y = x * 0
+        return y
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [2.0])    # and-true
+    np.testing.assert_allclose(st(_t([-9.0])).numpy(), [-10.0])  # not-branch
+    np.testing.assert_allclose(st(_t([-1.0])).numpy(), [0.0])   # else
+
+
+def test_short_circuit_preserved_for_python_values():
+    def fn(x, lst=None):
+        if lst is not None and len(lst) > 0:
+            return x + 1
+        return x
+
+    # lst is None: the rhs (len(None)) must never evaluate
+    out = convert_to_static(fn)(_t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [1.0])
+
+
+# ------------------------------------------------------------------- while
+def test_tensor_while():
+    def fn(x):
+        while x.sum() < 100:
+            x = x * 2
+        return x
+
+    st = to_static(fn)
+    got = st(_t([3.0])).numpy()
+    want = np.array([3.0])
+    while want.sum() < 100:
+        want = want * 2
+    np.testing.assert_allclose(got, want)
+
+
+def test_while_multi_carry():
+    def fn(x):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.zeros_like(x)
+        while i < 5:
+            s = s + x * i.astype("float32")
+            i = i + 1
+        return s
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0, 2.0])).numpy(),
+                               [10.0, 20.0])   # (0+1+2+3+4)
+
+
+def test_while_with_break():
+    def fn(x):
+        i = paddle.to_tensor(np.int32(0))
+        while i < 100:
+            x = x + 1
+            if x.sum() > 10:
+                break
+            i = i + 1
+        return x
+
+    st = to_static(fn)
+    got = st(_t([0.0])).numpy()
+    np.testing.assert_allclose(got, [11.0])
+
+
+def test_while_shape_change_raises():
+    def fn(x):
+        while x.sum() < 100:
+            x = paddle.concat([x, x])
+        return x
+
+    st = to_static(fn)
+    with pytest.raises(Dy2StaticError):
+        st(_t([3.0]))
+
+
+# --------------------------------------------------------------------- for
+def test_for_python_range_unrolls():
+    def fn(x):
+        s = paddle.zeros_like(x)
+        for i in range(4):
+            s = s + x * float(i)
+        return s
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [6.0])
+
+
+def test_for_traced_range():
+    def fn(x, n):
+        s = paddle.zeros_like(x)
+        for i in range(n):
+            s = s + x + i.astype("float32")
+        return s
+
+    st = to_static(fn)
+    got = st(_t([10.0]), paddle.to_tensor(np.int32(3))).numpy()
+    np.testing.assert_allclose(got, [33.0])    # 3*10 + (0+1+2)
+
+
+def test_for_over_tensor_rows():
+    def fn(xs):
+        s = paddle.zeros([2])
+        for row in xs:
+            s = s + row
+        return s
+
+    st = to_static(fn)
+    xs = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    np.testing.assert_allclose(st(xs).numpy(), [9.0, 12.0])
+
+
+def test_for_with_continue():
+    def fn(xs):
+        s = paddle.zeros([])
+        for row in xs:
+            if row.sum() < 0:
+                continue
+            s = s + row.sum()
+        return s
+
+    st = to_static(fn)
+    xs = _t([[1.0], [-5.0], [3.0]])
+    np.testing.assert_allclose(st(xs).numpy(), 4.0)
+
+
+def test_for_with_break():
+    def fn(xs):
+        s = paddle.zeros([])
+        for row in xs:
+            if s > 3:
+                break
+            s = s + row.sum()
+        return s
+
+    st = to_static(fn)
+    xs = _t([[1.0], [3.0], [100.0]])
+    np.testing.assert_allclose(st(xs).numpy(), 4.0)
+
+
+# --------------------------------------------------- clear unsupported errors
+def test_return_in_loop_clear_error():
+    def fn(x):
+        while x.sum() < 100:
+            x = x * 2
+            if x.sum() > 50:
+                return x
+        return x
+
+    st = to_static(fn)
+    with pytest.raises(Dy2StaticError, match="return"):
+        st(_t([3.0]))
+
+
+# ------------------------------------------------------------- convert_call
+def test_helper_function_transformed_recursively():
+    def helper(v):
+        if v.mean() > 0:
+            return v * 2
+        else:
+            return v * -3
+
+    def fn(x):
+        return helper(x) + 1
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [3.0])
+    np.testing.assert_allclose(st(_t([-1.0])).numpy(), [4.0])
+
+
+# ---------------------------------------------------------- Layer + jit.save
+class _GatedNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    @to_static
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:
+            out = paddle.nn.functional.relu(h)
+        else:
+            out = h * 0.1
+        return out
+
+
+def test_layer_forward_with_tensor_if():
+    net = _GatedNet()
+    x = _t(np.random.RandomState(0).randn(2, 4))
+    got = net(x).numpy()
+    # reproduce eagerly
+    h = net.fc(x)
+    want = (np.maximum(h.numpy(), 0) if h.numpy().mean() > 0
+            else h.numpy() * 0.1)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_jit_save_load_dy2static_model(tmp_path):
+    from paddle_tpu.static import InputSpec
+    net = _GatedNet()
+    net.eval()
+    x = _t(np.random.RandomState(1).randn(3, 4))
+    want = net(x).numpy()
+    path = str(tmp_path / "gated")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- review-finding regressions
+def test_python_container_truthiness():
+    def fn(x, opts=None, idx=None):
+        opts = opts or {"scale": 2.0}
+        if not idx:
+            x = x * opts["scale"]
+        if idx and x.sum() > 0:
+            x = x + 1
+        return x
+
+    st = convert_to_static(fn)
+    np.testing.assert_allclose(st(_t([3.0])).numpy(), [6.0])
+    np.testing.assert_allclose(st(_t([3.0]), idx=[1]).numpy(), [4.0])
+
+
+def test_bool_tensor_int_arithmetic():
+    x = _t([1.0, -1.0])
+    got = ((x > 0) * 3).numpy()
+    np.testing.assert_allclose(got, [3.0, 0.0])
+    np.testing.assert_allclose(((x > 0) + 1).numpy(), [2.0, 1.0])
+
+
+def test_int_scalar_keeps_int_dtype():
+    i = paddle.to_tensor(np.int32(5))
+    assert "int32" in str((i + 1).dtype)
+    assert "float32" in str((i + 1.5).dtype)
+
+
+def test_concrete_cond_traced_carry_unrolls():
+    def fn(x):
+        i = 0
+        while i < 3:               # python cond: unrolled, shape may change
+            x = paddle.concat([x, x])
+            i = i + 1
+        return x
+
+    st = to_static(fn)
+    assert st(_t([1.0])).shape[0] == 8
+
+
+def test_static_method_bound_once():
+    net = _GatedNet()
+    assert net.forward is net.forward     # cached in instance dict
+
+
+def test_not_to_static_factory_form():
+    from paddle_tpu.jit import not_to_static
+
+    @not_to_static()
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert f.__dy2static_transformed__
+
+
+def test_carry_dtype_promotion():
+    def fn(x):
+        s = 0
+        while x.sum() < 3:
+            s = s + x.mean()
+            x = x + 1
+        return s
+
+    st = to_static(fn)
+    got = st(_t([0.5])).numpy()
+    # eager: 0 + 0.5 (x->1.5) + 1.5 (x->2.5) + 2.5 (x->3.5) = 4.5
+    np.testing.assert_allclose(got, 4.5)
+
+
+def test_subscript_store_in_branch_carried():
+    def fn(x):
+        if x.sum() > 100:
+            x[0] = 0.0
+        return x * 1.0
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0, 2.0])).numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(st(_t([200.0, 2.0])).numpy(), [0.0, 2.0])
+
+
+def test_attr_store_in_branch_clear_error():
+    class Box:
+        pass
+
+    b = Box()
+
+    def fn(x):
+        if x.sum() > 0:
+            b.hits = 1
+        return x
+
+    convert_to_static(fn)(_t([1.0]))             # eager: plain python
+    assert b.hits == 1
+    with pytest.raises(Dy2StaticError, match="attribute"):
+        to_static(fn)(_t([1.0]))                 # traced: named error
+
+
+def test_for_else_with_break_semantics():
+    hits = []
+
+    def fn(vals):
+        for v in vals:
+            if v == 2:
+                break
+        else:
+            hits.append("else")
+        return vals
+
+    st = convert_to_static(fn)
+    st([1, 2, 3])
+    assert hits == []          # break taken: else must NOT run
+    st([5, 6])
+    assert hits == ["else"]    # exhausted: else runs
+
+
+# -------------------------------------------------------- translator switch
+def test_program_translator_disable():
+    from paddle_tpu.jit import ProgramTranslator
+    ProgramTranslator.get_instance().enable(False)
+    try:
+        def fn(x):
+            if x.mean() > 0:
+                return x + 1
+            else:
+                return x - 1
+        st = to_static(fn)
+        with pytest.raises(Exception):
+            st(_t([1.0]))      # plain tracing: tracer-bool error
+    finally:
+        ProgramTranslator.get_instance().enable(True)
