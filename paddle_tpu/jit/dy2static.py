@@ -25,6 +25,7 @@ import inspect
 import textwrap
 import types
 import warnings
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -285,8 +286,10 @@ def maybe_range(*args):
     return range(*(int(_raw(x)) for x in args))
 
 
-def convert_for(iterable, body_fn, names, vals):
+def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
     """``for tgt in iterable: body``. body_fn(tgt, *carry) -> carry.
+    Returns ``(tgt_last, *carry)`` — python leaks the loop target into the
+    enclosing scope, so the caller rebinds it (tgt0 = its pre-loop value).
 
     python iterable -> eager loop; _TracedRange -> lax.fori_loop;
     traced/concrete-under-trace Tensor -> lax.scan over the leading axis."""
@@ -313,7 +316,10 @@ def convert_for(iterable, body_fn, names, vals):
                 f"dy2static: tensor-dependent 'for' over range could not be "
                 f"lowered (carried locals {list(names)} must keep a fixed "
                 f"shape/dtype/structure across iterations): {e}") from None
-        return tuple(_wrap_like(list(final), list(vals)))
+        # loop target leaks (python semantics); n==0 edge yields `start`
+        last = Tensor(jnp.asarray(r.start)
+                      + jnp.maximum(n - 1, 0) * jnp.asarray(r.step))
+        return (last,) + tuple(_wrap_like(list(final), list(vals)))
 
     if isinstance(iterable, Tensor) and (
             _is_tracer(iterable) or _tree_has_tracer(vals)):
@@ -340,7 +346,8 @@ def convert_for(iterable, body_fn, names, vals):
                 f"dy2static: tensor-dependent 'for' over a tensor could not "
                 f"be lowered (carried locals {list(names)} must keep a fixed "
                 f"shape/dtype/structure across iterations): {e}") from None
-        return tuple(_wrap_like(list(final), list(vals)))
+        last = Tensor(xs[-1]) if xs.shape[0] else tgt0
+        return (last,) + tuple(_wrap_like(list(final), list(vals)))
 
     if isinstance(iterable, Tensor):
         it = [Tensor(row) for row in _raw(iterable)]
@@ -352,9 +359,11 @@ def convert_for(iterable, body_fn, names, vals):
         raise Dy2StaticError(
             f"dy2static: cannot iterate object of type "
             f"{type(iterable).__name__} in a converted 'for' loop") from None
+    tgt = tgt0
     for item in it:
+        tgt = item
         vals = tuple(body_fn(item, *vals))
-    return vals
+    return (tgt,) + vals
 
 
 def convert_logical_and(lhs_fn, rhs_fn):
@@ -389,7 +398,10 @@ def convert_logical_not(x):
 # --------------------------------------------------------------------------
 _SKIP_MODULE_PREFIXES = ("jax", "numpy", "paddle_tpu", "builtins", "math",
                          "functools", "itertools", "operator", "np")
-_call_cache = {}
+# weak keys: per-call inner functions / temporary Layers must not be pinned
+# alive by the cache (reference convert_call_func keeps a module-level dict;
+# traces are jit-cached so a missed cache entry only costs at trace time)
+_call_cache = weakref.WeakKeyDictionary()
 
 
 def convert_call(f):
@@ -398,11 +410,14 @@ def convert_call(f):
     numpy and jax callables pass through untouched."""
     try:
         key = f.__func__ if inspect.ismethod(f) else f
-        if key in _call_cache:
+        try:
             out = _call_cache[key]
-        else:
+        except (KeyError, TypeError):
             out = _transform_or_passthrough(key)
-            _call_cache[key] = out
+            try:
+                _call_cache[key] = out
+            except TypeError:
+                pass   # unhashable/unweakrefable: skip caching
         if inspect.ismethod(f):
             return functools.partial(out, f.__self__) if out is not key else f
         return out
@@ -492,10 +507,16 @@ def _has_attr_store(nodes):
 
 
 def _has(nodes, *kinds):
-    for n in nodes:
-        for sub in ast.walk(n):
-            if isinstance(sub, kinds):
-                return True
+    """Like ast.walk-any, but does NOT descend into nested function/lambda
+    scopes (generated __dy2s_* defs contain their own Returns)."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, kinds):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
     return False
 
 
@@ -533,9 +554,12 @@ def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
 
+_JST = "__dy2s_jst__"   # injected helper-module name; must not collide
+
+
 def _jst(attr, *args):
     return ast.Call(
-        func=ast.Attribute(value=_name("_jst"), attr=attr, ctx=ast.Load()),
+        func=ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load()),
         args=list(args), keywords=[])
 
 
@@ -864,27 +888,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
                 and it.func.id == "range":
             it = _jst("maybe_range", *it.args)
-        # body_fn(target, *carried)
+        # body_fn(target, *carried); the loop target LEAKS into the
+        # enclosing scope in python, so convert_for returns (last, *carry)
+        # and we rebind it (simple-Name targets; tuple targets discard)
         if isinstance(node.target, ast.Name):
             params = [node.target.id] + carried
             prelude = []
+            out_names = [node.target.id]
+            tgt0 = _ld_call(node.target.id)
         else:
             params = ["__dy2s_item"] + carried
             prelude = [ast.Assign(targets=[node.target],
                                   value=_name("__dy2s_item"))]
+            out_names = [f"__dy2s_last_{uid}"]
+            tgt0 = ast.Constant(None)
         bf = _fn_def(f"__dy2s_fb_{uid}", params, prelude + node.body, carried)
         call = _jst("convert_for", it, _name(bf.name),
                     _const_tuple(carried),
                     ast.Tuple(elts=[_ld_call(n) for n in carried],
-                              ctx=ast.Load()))
-        if carried:
-            assign = ast.Assign(
-                targets=[ast.Tuple(
-                    elts=[_name(n, ast.Store()) for n in carried],
-                    ctx=ast.Store())],
-                value=call)
-        else:
-            assign = ast.Expr(value=call)
+                              ctx=ast.Load()),
+                    tgt0)
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in out_names + carried],
+                ctx=ast.Store())],
+            value=call)
         return [bf, assign]
 
 
@@ -920,14 +948,17 @@ def convert_to_static(fn):
     ast.fix_missing_locations(mod)
     code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
                    mode="exec")
-    glb = dict(fn.__globals__)
-    glb["_jst"] = _module()
+    # chain to the LIVE module globals (late rebinding / monkeypatching of
+    # module-level helpers keeps working); only the injected helper module
+    # and the closure-cell snapshot live in the overlay
+    extra = {_JST: _module()}
     if fn.__closure__:
         for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
             try:
-                glb[name] = cell.cell_contents
+                extra[name] = cell.cell_contents
             except ValueError:
                 pass
+    glb = _ChainGlobals(fn.__globals__, extra)
     ns = {}
     exec(code, glb, ns)
     new = ns[fdef.name]
@@ -945,9 +976,28 @@ def _apply_passes(body):
     return holder.body
 
 
+class _ChainGlobals(dict):
+    """exec globals overlay: generated names resolve here, everything else
+    falls through to the function's live module globals (CPython honors
+    __missing__ on dict subclasses for LOAD_GLOBAL)."""
+
+    def __init__(self, base, extra):
+        super().__init__(extra)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
+
+
 def _module():
     import paddle_tpu.jit.dy2static as m
     return m
+
+
+# one transform per underlying function object, shared by every Layer
+# instance / StaticFunction binding (deepcopied encoder stacks would
+# otherwise re-parse the same source N times)
+_transform_cache = weakref.WeakKeyDictionary()
 
 
 def maybe_transform(fn):
@@ -957,10 +1007,19 @@ def maybe_transform(fn):
     if not ProgramTranslator.enable_to_static:
         return fn
     try:
-        return convert_to_static(fn)
+        return _transform_cache[fn]
+    except (KeyError, TypeError):
+        pass
+    try:
+        out = convert_to_static(fn)
     except Dy2StaticError:
         raise
     except Exception as e:  # source unavailable, exotic syntax, ...
         warnings.warn(f"dy2static: falling back to plain tracing for "
                       f"{getattr(fn, '__qualname__', fn)}: {e}")
-        return fn
+        out = fn
+    try:
+        _transform_cache[fn] = out
+    except TypeError:
+        pass
+    return out

@@ -856,7 +856,7 @@ def make_train_step(cfg: GPTSpmdConfig, plan: MeshPlan, mesh=None,
             params = {k: (v[perm] if k in _BLOCK_LEAVES else v)
                       for k, v in params.items()}
         params = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            lambda p, s: _put_global(p, NamedSharding(mesh, s)),
             params, specs, is_leaf=lambda x: isinstance(x, P))
 
         def init_state(params):
@@ -868,6 +868,22 @@ def make_train_step(cfg: GPTSpmdConfig, plan: MeshPlan, mesh=None,
         return params, state
 
     return step_fn, init_fn, mesh
+
+
+def _put_global(x, sharding):
+    """Place a host-replicated value onto a (possibly multi-process) mesh.
+
+    Single-controller: plain device_put. Multi-controller (jax.distributed,
+    the DCN path): the sharding spans non-addressable devices, so each
+    process contributes its addressable shards from the identical host copy
+    (reference role: broadcast-from-rank-0 parameter init in
+    fleet/meta_parallel — here every host derives the same init from the
+    same seed, so no broadcast is needed)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    host = np.asarray(x)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
 
 
 def _global_grad_sq(grads, specs, plan):

@@ -1,0 +1,101 @@
+"""Worker for the HYBRID multi-process distributed test (VERDICT r4 next #3).
+
+The DCN-shaped proof behind the FleetExecutor descope: the flagship
+make_train_step hybrid plans run over a 2-process global mesh whose device
+array is reordered so a MODEL axis — pp (pipeline send/recv) in plan 1,
+mp (tensor-parallel allreduce) in plan 2 — crosses the process boundary,
+not just dp. The reference does this with brpc p2p across pods
+(fleet/meta_parallel/pp_utils/p2p_communication.py:286, ProcessGroupHeter);
+here the single-controller SPMD program spans both processes and XLA's
+cross-host collectives carry the axis.
+
+Invoked as: dist_hybrid_worker.py <process_id> <num_processes> <port> <out>
+num_processes=1 produces the single-process golden on the same 8 devices.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+    n_local = 8 // nproc
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_local}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nproc)
+    os.environ["PADDLE_TRAINER_ID"] = str(pid)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.distributed.init_parallel_env()
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
+    from paddle_tpu.parallel.gpt_spmd import AXES
+
+    devs = np.asarray(jax.devices())
+    results = {"process_count": jax.process_count()}
+
+    def global_arr(np_val, mesh, spec):
+        np_val = np.asarray(np_val)
+        sh = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(np_val.shape, sh,
+                                            lambda idx: np_val[idx])
+
+    def run(plan, mesh, tag):
+        cfg = GPTSpmdConfig(vocab_size=64 * plan.mp, max_seq_len=64,
+                            hidden=16 * plan.mp, layers=2 * plan.pp,
+                            heads=plan.mp * 2, ffn=32 * plan.mp,
+                            remat=False, fused_ce_chunks=4)
+        B = 4 * plan.dp * plan.sharding * plan.microbatches
+        S = 16 * plan.sp
+        step_fn, init_fn, _ = make_train_step(cfg, plan, mesh=mesh,
+                                              learning_rate=1e-3)
+        params, state = init_fn(jax.random.key(0))
+        rng = np.random.RandomState(0)
+        data_spec = P(("dp", "sharding"), "sp")
+        toks = global_arr(rng.randint(0, cfg.vocab_size, (B, S)),
+                          mesh, data_spec)
+        labs = global_arr(rng.randint(0, cfg.vocab_size, (B, S)),
+                          mesh, data_spec)
+        lr = global_arr(np.float32(1e-3), mesh, P())
+        losses = []
+        for _ in range(3):
+            loss, params, state = step_fn(params, state, toks, labs, lr)
+            losses.append(float(np.asarray(jax.device_get(loss))))
+        results[tag] = losses
+
+    # plan 1: dp2 x pp2 x mp2 with the PIPELINE axis crossing the process
+    # boundary — device array reordered so pp is the slowest-varying axis
+    # (pp stage 0 = devices 0-3 = process 0; stage 1 = process 1)
+    plan1 = MeshPlan(dp=2, pp=2, mp=2, microbatches=2)
+    arr1 = devs.reshape(plan1.pp, plan1.dp, plan1.mp).transpose(1, 0, 2)
+    mesh1 = Mesh(arr1.reshape(plan1.dp, plan1.pp, 1, 1, plan1.mp), AXES)
+    run(plan1, mesh1, "dp2_pp2_mp2_pp_cross")
+
+    # plan 2: dp4 x mp2 with the TENSOR-PARALLEL allreduce crossing the
+    # boundary (mp group spans both processes)
+    plan2 = MeshPlan(dp=4, mp=2)
+    arr2 = devs.reshape(plan2.mp, plan2.dp).transpose(1, 0)
+    mesh2 = Mesh(arr2.reshape(plan2.dp, 1, 1, 1, plan2.mp), AXES)
+    run(plan2, mesh2, "dp4_mp2_mp_cross")
+
+    with open(out_path, "w") as f:
+        json.dump(results, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -439,6 +439,55 @@ def test_for_else_with_break_semantics():
     assert hits == ["else"]    # exhausted: else runs
 
 
+def test_loop_target_leaks_after_for():
+    def fn(x):
+        for i in range(3):
+            x = x + i
+        return x * i            # python: i leaks as 2
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [8.0])   # (1+0+1+2)*2
+
+
+def test_loop_target_leaks_traced_iterable():
+    def fn(xs):
+        s = paddle.zeros([2])
+        for row in xs:
+            s = s + row
+        return s + row          # last row leaks
+
+    st = to_static(fn)
+    xs = _t([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(st(xs).numpy(), [7.0, 10.0])
+
+
+def test_elif_chain_all_return():
+    def fn(x):
+        if x.mean() > 1:
+            return x + 1
+        elif x.mean() > 0:
+            return x + 2
+        else:
+            return x - 1
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([3.0])).numpy(), [4.0])
+    np.testing.assert_allclose(st(_t([0.5])).numpy(), [2.5])
+    np.testing.assert_allclose(st(_t([-1.0])).numpy(), [-2.0])
+
+
+def test_monkeypatched_global_seen():
+    import tests_dy2s_helper_mod as helper_mod
+    st = convert_to_static(helper_mod.entry)
+    assert float(st(_t([1.0]))[0]) == 2.0
+    orig = helper_mod.helper
+    try:
+        helper_mod.helper = lambda v: v * 10
+        assert float(st(_t([1.0]))[0]) == 10.0     # live global rebinding
+    finally:
+        helper_mod.helper = orig
+
+
 # -------------------------------------------------------- translator switch
 def test_program_translator_disable():
     from paddle_tpu.jit import ProgramTranslator

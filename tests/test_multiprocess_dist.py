@@ -37,19 +37,19 @@ def _scrubbed_env():
     return env
 
 
-def _run_workers(nproc, tmpdir):
+def _run_workers(nproc, tmpdir, worker=WORKER, prefix="worker", timeout=300):
     port = _free_port()
     procs, outs = [], []
     for pid in range(nproc):
-        out = os.path.join(tmpdir, f"worker_{nproc}_{pid}.json")
+        out = os.path.join(tmpdir, f"{prefix}_{nproc}_{pid}.json")
         outs.append(out)
         procs.append(subprocess.Popen(
-            [sys.executable, WORKER, str(pid), str(nproc), str(port), out],
+            [sys.executable, worker, str(pid), str(nproc), str(port), out],
             env=_scrubbed_env(),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
     results = []
     for p, out in zip(procs, outs):
-        stdout, stderr = p.communicate(timeout=300)
+        stdout, stderr = p.communicate(timeout=timeout)
         assert p.returncode == 0, \
             f"worker rc={p.returncode}\nstdout:{stdout[-2000:]}\nstderr:{stderr[-4000:]}"
         with open(out) as f:
@@ -97,3 +97,43 @@ def test_dp_loss_matches_single_process_golden(runs):
         np.testing.assert_allclose(r["losses"], golden["losses"], rtol=1e-6)
     # and training actually progressed
     assert golden["losses"][-1] < golden["losses"][0]
+
+
+# --------------------------------------------------------------------------
+# HYBRID plans across the process boundary (VERDICT r4 next #3): the
+# flagship train step with pp (plan 1) / mp (plan 2) axes spanning both
+# processes — the single-controller DCN claim behind the FleetExecutor
+# descope, now executed rather than asserted.
+# --------------------------------------------------------------------------
+HYBRID_WORKER = os.path.join(HERE, "dist_hybrid_worker.py")
+
+
+@pytest.fixture(scope="module")
+def hybrid_runs(tmp_path_factory):
+    tmpdir = str(tmp_path_factory.mktemp("dist_hybrid"))
+    kw = dict(worker=HYBRID_WORKER, prefix="hybrid", timeout=900)
+    golden = _run_workers(1, tmpdir, **kw)[0]
+    two = _run_workers(2, tmpdir, **kw)
+    return golden, two
+
+
+def test_hybrid_pp_across_process_boundary(hybrid_runs):
+    """dp2 x pp2 x mp2 with pipeline stage 1 living entirely on process 1:
+    3-step loss trajectory must match the single-process golden."""
+    golden, two = hybrid_runs
+    assert [r["process_count"] for r in two] == [2, 2]
+    for r in two:
+        np.testing.assert_allclose(r["dp2_pp2_mp2_pp_cross"],
+                                   golden["dp2_pp2_mp2_pp_cross"], rtol=1e-5)
+    assert golden["dp2_pp2_mp2_pp_cross"][-1] < \
+        golden["dp2_pp2_mp2_pp_cross"][0]
+
+
+def test_hybrid_mp_across_process_boundary(hybrid_runs):
+    """dp4 x mp2 with each tensor-parallel pair split across the two
+    processes: the mp allreduce rides the host boundary every step."""
+    golden, two = hybrid_runs
+    for r in two:
+        np.testing.assert_allclose(r["dp4_mp2_mp_cross"],
+                                   golden["dp4_mp2_mp_cross"], rtol=1e-5)
+    assert golden["dp4_mp2_mp_cross"][-1] < golden["dp4_mp2_mp_cross"][0]

@@ -1,0 +1,10 @@
+"""Helper module for test_dy2static.test_monkeypatched_global_seen: the
+transformed function must resolve module globals LIVE, not from a snapshot."""
+
+
+def helper(v):
+    return v + 1
+
+
+def entry(x):
+    return helper(x)
