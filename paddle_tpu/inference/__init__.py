@@ -194,9 +194,15 @@ class Predictor:
         # param/buffer leaf, then the user inputs
         n_state = len(self._params) + len(self._buffers)
         in_avals = self._exported.in_avals[n_state:]
-        # the exported calling convention flattens pytrees; user-facing input
-        # names are positional (feed order == input_spec order at save time)
-        self._input_names = [f"input_{i}" for i in range(len(in_avals))]
+        # user-facing input names: the REAL names saved with the artifact
+        # (jit.save feed_names), falling back to positional input_{i} for
+        # legacy artifacts — keeps Predictor / load_inference_model /
+        # Executor.run agreeing on one name set
+        saved = payload.get("feed_names")
+        if saved and len(saved) == len(in_avals):
+            self._input_names = list(saved)
+        else:
+            self._input_names = [f"input_{i}" for i in range(len(in_avals))]
         self._inputs = {n: _Handle(n, tuple(a.shape), str(a.dtype))
                         for n, a in zip(self._input_names, in_avals)}
         self._output_names = []

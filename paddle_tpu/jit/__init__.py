@@ -185,20 +185,29 @@ def save(layer, path, input_spec=None, **configs):
                          "InputSpec or example Tensors), or a forward "
                          "decorated @to_static(input_spec=...)")
     exp, params, buffers = _export_layer(layer, input_spec)
+    # persist REAL feed names so Executor.run can match feeds exactly
+    # (reference: the pruned ProgramDesc carries feed_target_names)
+    from ..static import InputSpec as _IS
+    feed_names = []
+    for i, s in enumerate(input_spec):
+        n = s.name if isinstance(s, _IS) else getattr(s, "name", None)
+        feed_names.append(n or f"input_{i}")
     with open(path + MODEL_SUFFIX, "wb") as f:
         f.write(exp.serialize())
-    _save({"params": params, "buffers": buffers}, path + PARAMS_SUFFIX)
+    _save({"params": params, "buffers": buffers, "feed_names": feed_names},
+          path + PARAMS_SUFFIX)
 
 
 class TranslatedLayer(Layer):
     """A deserialized AOT program + weights, callable like the original
     Layer (inference only — the exported program is the eval-mode forward)."""
 
-    def __init__(self, exported, params, buffers):
+    def __init__(self, exported, params, buffers, feed_names=None):
         super().__init__()
         self._exported = exported
         self._param_tree = params
         self._buffer_tree = buffers
+        self._feed_names = feed_names   # saved input names (None: old artifact)
 
     def forward(self, *inputs):
         raw = tuple(a._data if isinstance(a, Tensor) else a for a in inputs)
@@ -225,7 +234,8 @@ def load(path, **configs):
     payload = _load(path + PARAMS_SUFFIX, return_numpy=True)
     as_jnp = lambda tree: {n: jnp.asarray(v) for n, v in tree.items()}
     return TranslatedLayer(exp, as_jnp(payload["params"]),
-                           as_jnp(payload["buffers"]))
+                           as_jnp(payload["buffers"]),
+                           feed_names=payload.get("feed_names"))
 
 
 def not_to_static(fn=None):
@@ -242,7 +252,98 @@ def not_to_static(fn=None):
 
 
 class TracedLayer:
-    pass
+    """Trace a dygraph Layer once into a static Program (captured jaxpr) +
+    frozen eval-mode weights; run it program-style or export it.
+
+    Reference: fluid/dygraph/jit.py:1388 TracedLayer (trace via the dygraph
+    Tracer into a ProgramDesc + Executor). Here the program IS the captured
+    jaxpr (static.Program.capture); weights are baked in as consts."""
+
+    def __init__(self, layer, program, input_specs):
+        self._layer = layer
+        self._program = program
+        self._input_specs = input_specs
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (dygraph_outputs, traced_layer), reference-style."""
+        from ..static import InputSpec, Program
+
+        inputs = list(inputs)
+        out = layer(*inputs)
+
+        params, buffers = functional_state(layer)
+
+        def pure(*raw):
+            o, _ = functional_call(layer, params, buffers,
+                                   args=tuple(Tensor(a) for a in raw),
+                                   train=False)
+            o = unwrap(o)
+            return o if isinstance(o, (tuple, list)) else (o,)
+
+        specs = [InputSpec.from_tensor(t, name=f"input_{i}")
+                 for i, t in enumerate(inputs)]
+        prog = Program.capture(pure, *specs)
+        return out, TracedLayer(layer, prog, specs)
+
+    def __call__(self, inputs):
+        raw = [t._data if isinstance(t, Tensor) else t for t in inputs]
+        outs = self._program.run_captured(*raw)
+        return [Tensor(o, stop_gradient=True) for o in outs]
+
+    @property
+    def program(self):
+        return self._program
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        """Execution strategies are XLA-owned; accepted for API parity."""
+
+    def save_inference_model(self, path, feed=None, fetch=None, **configs):
+        """Export for Predictor/Executor serving; `feed`/`fetch` are index
+        filters over the traced inputs/outputs (reference semantics).
+        `feed` may PERMUTE the inputs (the exported program takes them in
+        the declared feed order); dropping inputs needs graph pruning the
+        traced program does not do — a subset raises."""
+        specs = self._input_specs
+        layer = self._layer
+        if feed is not None:
+            if sorted(feed) != list(range(len(specs))):
+                raise ValueError(
+                    f"TracedLayer.save_inference_model: feed={feed} must be "
+                    f"a permutation of all {len(specs)} traced inputs; "
+                    f"dropping an input would need program pruning — "
+                    f"re-trace the layer with the inputs you want instead")
+            specs = [specs[i] for i in feed]
+        if feed is not None or fetch is not None:
+            layer = _SliceAdapter(layer, feed, fetch)
+        save(layer, path, input_spec=list(specs))
+
+
+class _SliceAdapter(Layer):
+    """Feed-permuting / fetch-slicing wrapper used by
+    TracedLayer.save_inference_model. The base layer is a REGISTERED
+    sublayer so its parameters ride the export payload and eval-mode
+    switching reaches it."""
+
+    def __init__(self, base, feed, fetch):
+        super().__init__()
+        self.base = base
+        self._feed = feed
+        self._fetch = fetch
+
+    def forward(self, *args):
+        if self._feed is not None:
+            # args arrive in feed order; restore the original positions
+            orig = [None] * len(args)
+            for pos, idx in enumerate(self._feed):
+                orig[idx] = args[pos]
+            args = tuple(orig)
+        out = self.base(*args)
+        if self._fetch is None:
+            return out
+        out = out if isinstance(out, (tuple, list)) else [out]
+        picked = [out[i] for i in self._fetch]
+        return picked[0] if len(picked) == 1 else picked
 
 
 class ProgramTranslator:

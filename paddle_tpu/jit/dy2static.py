@@ -287,13 +287,20 @@ def maybe_range(*args):
 
 
 def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
-    """``for tgt in iterable: body``. body_fn(tgt, *carry) -> carry.
-    Returns ``(tgt_last, *carry)`` — python leaks the loop target into the
-    enclosing scope, so the caller rebinds it (tgt0 = its pre-loop value).
+    """``for tgt in iterable: body``. body_fn(tgt, *carry) -> (tgt, *carry)
+    — the body returns the target's FINAL binding too, because python leaks
+    the target (including body reassignments of it) into the enclosing
+    scope. Returns ``(tgt_last, *carry)``; tgt0 = the target's pre-loop
+    value, leaked back on zero iterations.
 
     python iterable -> eager loop; _TracedRange -> lax.fori_loop;
     traced/concrete-under-trace Tensor -> lax.scan over the leading axis."""
     vals = tuple(vals)
+
+    def split(out):
+        out = list(out)
+        return out[0], tuple(out[1:])
+
     if isinstance(iterable, _TracedRange):
         r = iterable
         n = jnp.maximum(0, -(-(jnp.asarray(r.stop) - r.start) // r.step))
@@ -302,24 +309,30 @@ def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
         init = tuple(_dtype_fixpoint(
             lambda carry: tuple(_unwrap_tree(list(body_fn(
                 Tensor(jnp.asarray(r.start)),
-                *_wrap_like(list(carry), list(vals)))))), list(init)))
+                *_wrap_like(list(carry), list(vals)))))[1:]), list(init)))
+        # target slot rides the carry so body reassignments of it leak;
+        # zero-trip edge leaks `start` (documented divergence from python's
+        # keep-old-value, which an XLA carry cannot express)
+        t0 = jnp.asarray(r.start)
 
         def b(k, carry):
+            tslot, rest = carry[0], carry[1:]
             i = jnp.asarray(r.start) + k * jnp.asarray(r.step)
-            out = body_fn(Tensor(i), *_wrap_like(list(carry), list(vals)))
-            return tuple(_match_carry(_unwrap_tree(list(out)), carry, names))
+            out = body_fn(Tensor(i), *_wrap_like(list(rest), list(vals)))
+            tlast, crest = split(_unwrap_tree(list(out)))
+            return (jax.lax.convert_element_type(jnp.asarray(tlast),
+                                                 tslot.dtype),) + \
+                tuple(_match_carry(list(crest), rest, names))
 
         try:
-            final = jax.lax.fori_loop(0, n, b, init)
+            final = jax.lax.fori_loop(0, n, b, (t0,) + init)
         except TypeError as e:
             raise Dy2StaticError(
                 f"dy2static: tensor-dependent 'for' over range could not be "
                 f"lowered (carried locals {list(names)} must keep a fixed "
                 f"shape/dtype/structure across iterations): {e}") from None
-        # loop target leaks (python semantics); n==0 edge yields `start`
-        last = Tensor(jnp.asarray(r.start)
-                      + jnp.maximum(n - 1, 0) * jnp.asarray(r.step))
-        return (last,) + tuple(_wrap_like(list(final), list(vals)))
+        return (Tensor(final[0]),) + tuple(
+            _wrap_like(list(final[1:]), list(vals)))
 
     if isinstance(iterable, Tensor) and (
             _is_tracer(iterable) or _tree_has_tracer(vals)):
@@ -331,22 +344,23 @@ def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
                                   _unwrap_tree(list(vals)), names))
         init = tuple(_dtype_fixpoint(
             lambda carry: tuple(_unwrap_tree(list(body_fn(
-                Tensor(xs[0]), *_wrap_like(list(carry), list(vals)))))),
+                Tensor(xs[0]), *_wrap_like(list(carry), list(vals)))))[1:]),
             list(init)))
 
         def step(carry, row):
             out = body_fn(Tensor(row), *_wrap_like(list(carry), list(vals)))
-            return tuple(_match_carry(_unwrap_tree(list(out)), carry,
-                                      names)), None
+            tlast, crest = split(_unwrap_tree(list(out)))
+            return tuple(_match_carry(list(crest), carry, names)), tlast
 
         try:
-            final, _ = jax.lax.scan(step, init, xs)
+            final, t_hist = jax.lax.scan(step, init, xs)
         except TypeError as e:
             raise Dy2StaticError(
                 f"dy2static: tensor-dependent 'for' over a tensor could not "
                 f"be lowered (carried locals {list(names)} must keep a fixed "
                 f"shape/dtype/structure across iterations): {e}") from None
-        last = Tensor(xs[-1]) if xs.shape[0] else tgt0
+        last = Tensor(jax.tree.map(lambda h: h[-1], t_hist)) \
+            if xs.shape[0] else tgt0
         return (last,) + tuple(_wrap_like(list(final), list(vals)))
 
     if isinstance(iterable, Tensor):
@@ -361,8 +375,7 @@ def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
             f"{type(iterable).__name__} in a converted 'for' loop") from None
     tgt = tgt0
     for item in it:
-        tgt = item
-        vals = tuple(body_fn(item, *vals))
+        tgt, vals = split(body_fn(item, *vals))
     return (tgt,) + vals
 
 
@@ -400,8 +413,12 @@ _SKIP_MODULE_PREFIXES = ("jax", "numpy", "paddle_tpu", "builtins", "math",
                          "functools", "itertools", "operator", "np")
 # weak keys: per-call inner functions / temporary Layers must not be pinned
 # alive by the cache (reference convert_call_func keeps a module-level dict;
-# traces are jit-cached so a missed cache entry only costs at trace time)
+# traces are jit-cached so a missed cache entry only costs at trace time).
+# A weak entry only works if the VALUE doesn't reference the key, so
+# passthrough results are stored as a sentinel and transformed functions
+# drop their functools.wraps __wrapped__ back-reference.
 _call_cache = weakref.WeakKeyDictionary()
+_PASSTHROUGH = object()
 
 
 def convert_call(f):
@@ -415,9 +432,11 @@ def convert_call(f):
         except (KeyError, TypeError):
             out = _transform_or_passthrough(key)
             try:
-                _call_cache[key] = out
+                _call_cache[key] = _PASSTHROUGH if out is key else out
             except TypeError:
                 pass   # unhashable/unweakrefable: skip caching
+        if out is _PASSTHROUGH:
+            out = key
         if inspect.ismethod(f):
             return functools.partial(out, f.__self__) if out is not key else f
         return out
@@ -896,13 +915,18 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             prelude = []
             out_names = [node.target.id]
             tgt0 = _ld_call(node.target.id)
+            tgt_ret = node.target.id
         else:
             params = ["__dy2s_item"] + carried
             prelude = [ast.Assign(targets=[node.target],
                                   value=_name("__dy2s_item"))]
             out_names = [f"__dy2s_last_{uid}"]
             tgt0 = ast.Constant(None)
-        bf = _fn_def(f"__dy2s_fb_{uid}", params, prelude + node.body, carried)
+            tgt_ret = "__dy2s_item"
+        # body returns (target, *carried): python leaks the target's final
+        # binding, including reassignments inside the body
+        bf = _fn_def(f"__dy2s_fb_{uid}", params, prelude + node.body,
+                     [tgt_ret] + carried)
         call = _jst("convert_for", it, _name(bf.name),
                     _const_tuple(carried),
                     ast.Tuple(elts=[_ld_call(n) for n in carried],
@@ -963,6 +987,7 @@ def convert_to_static(fn):
     exec(code, glb, ns)
     new = ns[fdef.name]
     new = functools.wraps(fn)(new)
+    del new.__wrapped__   # a back-ref to fn would defeat the weak caches
     new.__defaults__ = fn.__defaults__
     new.__kwdefaults__ = fn.__kwdefaults__
     new.__dy2static_transformed__ = True
@@ -1007,7 +1032,8 @@ def maybe_transform(fn):
     if not ProgramTranslator.enable_to_static:
         return fn
     try:
-        return _transform_cache[fn]
+        out = _transform_cache[fn]
+        return fn if out is _PASSTHROUGH else out
     except (KeyError, TypeError):
         pass
     try:
@@ -1019,7 +1045,7 @@ def maybe_transform(fn):
                       f"{getattr(fn, '__qualname__', fn)}: {e}")
         out = fn
     try:
-        _transform_cache[fn] = out
+        _transform_cache[fn] = _PASSTHROUGH if out is fn else out
     except TypeError:
         pass
     return out

@@ -13,6 +13,7 @@ import numpy as np
 from ..core import dtype as _dt
 from ..core.tensor import Tensor
 from ..jit import to_static  # noqa: F401
+from ..nn.param_attr import ParamAttr
 
 
 class InputSpec:
@@ -242,15 +243,30 @@ class Executor:
         # execute it paddle-style with the feed dict in feed-name order
         if callable(program):
             feed = feed or {}
-            # natural sort: input_10 after input_2
-            import re as _re
+            saved = getattr(program, "_feed_names", None)
+            if saved:
+                # exact-name matching against the artifact's saved inputs;
+                # mismatch is a LOUD error, never a silent reorder
+                missing = [n for n in saved if n not in feed]
+                extra = sorted(k for k in feed if k not in saved)
+                if missing or extra:
+                    raise KeyError(
+                        f"Executor.run: feed keys {sorted(feed)} do not "
+                        f"match the program's saved inputs {saved} "
+                        f"(missing: {missing}, unexpected: {extra})")
+                ordered = saved
+            else:
+                # legacy artifact without names: natural sort
+                # (input_10 after input_2)
+                import re as _re
 
-            def _key(k):
-                m = _re.search(r"(\d+)$", k)
-                return (k[:m.start()], int(m.group(1))) if m else (k, -1)
+                def _key(k):
+                    m = _re.search(r"(\d+)$", k)
+                    return (k[:m.start()], int(m.group(1))) if m else (k, -1)
 
+                ordered = sorted(feed.keys(), key=_key)
             args = [Tensor(jnp.asarray(np.asarray(feed[k])))
-                    for k in sorted(feed.keys(), key=_key)]
+                    for k in ordered]
             out = program(*args)
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             if return_numpy:
@@ -295,8 +311,22 @@ def name_scope(prefix=None):
     return contextlib.nullcontext()
 
 
-class WeightNormParamAttr:
-    pass
+class WeightNormParamAttr(ParamAttr):
+    """paddle.static.WeightNormParamAttr (reference: fluid/param_attr.py
+    WeightNormParamAttr): ParamAttr that requests the weight-norm
+    g·v/||v|| reparameterization along `dim`. The dygraph path applies it
+    via paddle_tpu.nn.utils.weight_norm; this attr records the request so
+    layer constructors taking param_attr can apply the same hook."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+        self.do_model_average = do_model_average
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
@@ -343,9 +373,12 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     is a TranslatedLayer — call it directly, or use Executor.run with feeds."""
     from ..jit import load as _jit_load
     layer = _jit_load(path_prefix)
-    n_state = len(layer._param_tree) + len(layer._buffer_tree)
-    n_in = len(layer._exported.in_avals) - n_state
-    feed_names = [f"input_{i}" for i in range(max(n_in, 0))]
+    if layer._feed_names:
+        feed_names = list(layer._feed_names)
+    else:   # legacy artifact without saved names
+        n_state = len(layer._param_tree) + len(layer._buffer_tree)
+        n_in = len(layer._exported.in_avals) - n_state
+        feed_names = [f"input_{i}" for i in range(max(n_in, 0))]
     fetch_names = [f"output_{i}"
                    for i in range(len(layer._exported.out_avals))]
     return layer, feed_names, fetch_names

@@ -37,7 +37,15 @@ class FakeData(Dataset):
 
 
 class Cifar10(Dataset):
-    """Reads the standard python-pickle CIFAR-10 archive from data_file."""
+    """Reads the standard python-pickle CIFAR-10 archive from data_file
+    (reference: python/paddle/vision/datasets/cifar.py:30 Cifar10)."""
+
+    _ARCHIVE = "cifar-10-python.tar.gz"
+    _LABEL_KEY = b"labels"
+
+    def _members(self, mode):
+        return ([f"cifar-10-batches-py/data_batch_{i}" for i in range(1, 6)]
+                if mode == "train" else ["cifar-10-batches-py/test_batch"])
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
@@ -46,21 +54,21 @@ class Cifar10(Dataset):
         self.data = []
         self.labels = []
         candidates = [data_file,
-                      os.path.expanduser("~/.cache/paddle/dataset/cifar/cifar-10-python.tar.gz"),
-                      "/root/data/cifar-10-python.tar.gz"]
+                      os.path.expanduser(
+                          f"~/.cache/paddle/dataset/cifar/{self._ARCHIVE}"),
+                      f"/root/data/{self._ARCHIVE}"]
         path = next((p for p in candidates if p and os.path.exists(p)), None)
         if path is None:
             raise FileNotFoundError(
-                "CIFAR-10 archive not found (no network in this environment); "
-                "pass data_file= or use paddle_tpu.vision.datasets.FakeData")
-        names = [f"cifar-10-batches-py/data_batch_{i}" for i in range(1, 6)] \
-            if mode == "train" else ["cifar-10-batches-py/test_batch"]
+                f"{self._ARCHIVE} not found (no network in this "
+                "environment); pass data_file= or use "
+                "paddle_tpu.vision.datasets.FakeData")
         with tarfile.open(path) as tf:
-            for n in names:
+            for n in self._members(mode):
                 with tf.extractfile(n) as f:
                     d = pickle.load(f, encoding="bytes")
                 self.data.append(d[b"data"])
-                self.labels.extend(d[b"labels"])
+                self.labels.extend(d[self._LABEL_KEY])
         self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
 
     def __getitem__(self, idx):
@@ -75,8 +83,15 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    def __init__(self, *a, **kw):
-        raise NotImplementedError("Cifar100 archive loader not wired; use Cifar10/FakeData")
+    """CIFAR-100: same pickle format, one train/test member each, fine
+    labels (reference: vision/datasets/cifar.py:194 Cifar100)."""
+
+    _ARCHIVE = "cifar-100-python.tar.gz"
+    _LABEL_KEY = b"fine_labels"
+
+    def _members(self, mode):
+        return ["cifar-100-python/train" if mode == "train"
+                else "cifar-100-python/test"]
 
 
 class MNIST(Dataset):

@@ -461,6 +461,40 @@ def test_loop_target_leaks_traced_iterable():
     np.testing.assert_allclose(st(xs).numpy(), [7.0, 10.0])
 
 
+def test_loop_target_body_reassignment_leaks():
+    def fn(x):
+        for i in range(3):
+            i = i * 10
+            x = x + i
+        return x * i            # python: i leaks as 20
+
+    st = to_static(fn)
+    # x = 1 + 0 + 10 + 20 = 31; * 20 = 620
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [620.0])
+
+
+def test_convert_call_cache_not_pinning():
+    import gc
+    import weakref as wr
+
+    from paddle_tpu.jit.dy2static import convert_call
+
+    def make():
+        def inner(v):
+            if v.mean() > 0:
+                return v
+            else:
+                return -v
+        return inner
+
+    f = make()
+    convert_call(f)
+    ref = wr.ref(f)
+    del f
+    gc.collect()
+    assert ref() is None        # cache must not keep the function alive
+
+
 def test_elif_chain_all_return():
     def fn(x):
         if x.mean() > 1:
