@@ -69,13 +69,63 @@ def main():
         o.clear_grad()
         return float(loss)           # host fetch = sync
 
-    for _ in range(3):               # warmup: fills the per-op cache
-        eager_step()
     n = int(os.environ.get("BENCH_EAGER_STEPS", 20))
-    t0 = time.perf_counter()
-    for _ in range(n):
-        loss_val = eager_step()
-    eager_ms = (time.perf_counter() - t0) / n * 1000
+
+    def time_rung(step, warmup=3, iters=n):
+        for _ in range(warmup):      # warmup fills the per-op cache
+            step()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            val = step()
+        return (time.perf_counter() - t0) / iters * 1000, val
+
+    eager_ms, loss_val = time_rung(eager_step)
+
+    # ---- model-shaped rungs (VERDICT r4 next #4): conv/BN and attention
+    # dispatch through the executable cache, not just matmul+relu. Fewer
+    # iters: per-op eager on a tunneled TPU pays per-op round-trips.
+    n_model = int(os.environ.get("BENCH_EAGER_MODEL_STEPS", max(n // 4, 5)))
+
+    class ResBlock(nn.Layer):
+        def __init__(self, ch):
+            super().__init__()
+            self.c1 = nn.Conv2D(ch, ch, 3, padding=1)
+            self.b1 = nn.BatchNorm2D(ch)
+            self.c2 = nn.Conv2D(ch, ch, 3, padding=1)
+            self.b2 = nn.BatchNorm2D(ch)
+
+        def forward(self, t):
+            h = paddle.nn.functional.relu(self.b1(self.c1(t)))
+            return paddle.nn.functional.relu(t + self.b2(self.c2(h)))
+
+    paddle.seed(1)
+    rb = ResBlock(32)
+    rb_opt = opt.SGD(0.01, parameters=rb.parameters())
+    img = paddle.to_tensor(rng.rand(16, 32, 16, 16).astype("float32"))
+
+    def resnet_step():
+        loss = rb(img).mean()
+        loss.backward()
+        rb_opt.step()
+        rb_opt.clear_grad()
+        return float(loss)
+
+    resnet_ms, _ = time_rung(resnet_step, iters=n_model)
+
+    paddle.seed(2)
+    tl = nn.TransformerEncoderLayer(d_model=128, nhead=4,
+                                    dim_feedforward=256, dropout=0.0)
+    tl_opt = opt.SGD(0.01, parameters=tl.parameters())
+    seq = paddle.to_tensor(rng.rand(8, 64, 128).astype("float32"))
+
+    def transformer_step():
+        loss = tl(seq).mean()
+        loss.backward()
+        tl_opt.step()
+        tl_opt.clear_grad()
+        return float(loss)
+
+    transformer_ms, _ = time_rung(transformer_step, iters=n_model)
 
     # jit reference: identical math, one compiled program
     params = {i: (l.weight._data, l.bias._data)
@@ -120,6 +170,9 @@ def main():
         "extra": {"jit_step_ms": round(jit_ms, 2),
                   "eager_over_jit": round(eager_ms / jit_ms, 1),
                   "backend": backend, "steps": n, "loss": loss_val,
+                  "rungs": {"resnet_block_ms": round(resnet_ms, 2),
+                            "transformer_layer_ms": round(transformer_ms, 2),
+                            "model_steps": n_model},
                   "cache": dict(_CACHE_STATS)},
     }))
 

@@ -93,8 +93,20 @@ class Tensor:
         return self.size
 
     # -- conversion ---------------------------------------------------------
-    def numpy(self):
-        return np.asarray(self._data)
+    def numpy(self, force_int64=False):
+        """Host copy. `force_int64=True` (or FLAGS_int64_numpy_boundary)
+        upcasts integer arrays to int64 at the numpy boundary — the escape
+        hatch for the documented on-device int64→int32 policy, for
+        consumers that np.save/type-check against reference-written int64
+        state. Device layout is untouched."""
+        a = np.asarray(self._data)
+        if a.dtype == np.int32 and not force_int64:
+            from ..framework import flags as _flags
+            force_int64 = bool(_flags._FLAGS.get(
+                "FLAGS_int64_numpy_boundary", False))
+        if force_int64 and a.dtype == np.int32:
+            return a.astype(np.int64)
+        return a
 
     def item(self):
         return self._data.item()

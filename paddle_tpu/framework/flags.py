@@ -30,6 +30,12 @@ _FLAGS = {
     # directory to have XLA executables serialized there and reloaded by
     # later processes, skipping compilation.
     "FLAGS_compilation_cache_dir": "",
+    # int64 boundary policy escape hatch (PARITY dtype-policy section): on
+    # device, int64 requests canonicalize to int32 (x64 off, TPU-native
+    # widths). Consumers that np.save/type-check against reference-written
+    # int64 state set this to get int64 back at the NUMPY boundary only
+    # (per-call form: Tensor.numpy(force_int64=True)).
+    "FLAGS_int64_numpy_boundary": False,
 }
 
 

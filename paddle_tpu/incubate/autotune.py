@@ -31,6 +31,10 @@ _config = {"kernel": {"enable": True, "tuning_range": [1, 10]},
 _block_cache = {}
 _disk_cache = {}
 _disk_loaded = False
+# geometries whose in-memory entry is a static FALLBACK, not a measured
+# winner: excluded from every disk write so they can never shadow shipped
+# tuned entries in a future process (ADVICE r4)
+_fallback_keys = set()
 _CACHE_ENV = "PADDLE_TPU_AUTOTUNE_CACHE"
 
 
@@ -95,7 +99,8 @@ def _save_disk_cache():
             # entries into the user cache, where they would shadow future
             # shipped updates)
             merged = _read_cache_file(path)
-            merged.update(_block_cache)
+            merged.update({k: v for k, v in _block_cache.items()
+                           if k not in _fallback_keys})
             with open(path, "w") as f:
                 json.dump({json.dumps(list(k)): list(v)
                            for k, v in merged.items()}, f)
@@ -124,14 +129,20 @@ def lookup_flash_blocks(B, H, S, D, causal):
     return _disk_cache.get(key)
 
 
-def record_flash_blocks(H, S, D, causal, blocks):
+def record_flash_blocks(H, S, D, causal, blocks, persist=True):
     """Record an externally-measured (block_q, block_k) winner for a
     geometry (tools/profile_step.py's sweep) and persist it to the env-path
-    cache if configured."""
+    cache if configured. persist=False keeps the entry in-memory only —
+    used for static FALLBACK results, which must never shadow shipped
+    tuned entries at the next load (ADVICE r4)."""
     import jax
     key = (jax.default_backend(), H, S, D, bool(causal))
     _block_cache[key] = tuple(blocks)
-    _save_disk_cache()
+    if persist:
+        _fallback_keys.discard(key)
+        _save_disk_cache()
+    else:
+        _fallback_keys.add(key)
 
 
 def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
@@ -173,9 +184,12 @@ def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
             continue
         if dt < best_dt:
             best, best_dt = (b, b), dt
-    if best is None:
+    fallback = best is None
+    if fallback:
         from ..ops.pallas.flash_attention import _auto_block
         b = _auto_block(S)           # always divides S (never poisons cache)
         best = (b, b)
-    record_flash_blocks(H, S, D, causal, best)
+    # fallbacks stay in-memory only: a persisted fallback would override the
+    # shipped tuned table for this geometry on every future load (ADVICE r4)
+    record_flash_blocks(H, S, D, causal, best, persist=not fallback)
     return best

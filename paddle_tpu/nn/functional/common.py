@@ -209,11 +209,29 @@ def _interp_axis_nearest(a, ax, out_s, align_corners):
     return jnp.take(a, idx, axis=ax)
 
 
+_INTERP_MODE_RANKS = {
+    # reference interpolate checks (nn/functional/common.py:interpolate):
+    # mode -> allowed spatial ranks
+    "linear": (1,), "bilinear": (2,), "bicubic": (2,),
+    "trilinear": (3,), "nearest": (2, 3), "area": (1, 2, 3),
+}
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
     cf = data_format.startswith("NC")
     nd = len(tuple(x.shape)) - 2
     spatial_in = tuple(x.shape)[2:] if cf else tuple(x.shape)[1:-1]
+    if size is None and scale_factor is None:
+        raise ValueError(
+            "(InvalidArgument) interpolate: one of size or scale_factor "
+            "must be set.")
+    allowed = _INTERP_MODE_RANKS.get(mode)
+    if allowed is not None and nd not in allowed:
+        raise ValueError(
+            f"(InvalidArgument) interpolate: mode '{mode}' expects a "
+            f"{'/'.join(str(r + 2) + '-D' for r in allowed)} input, got "
+            f"{nd + 2}-D.")
     # one shared output-size computation for every mode: scalar size
     # broadcasts to all spatial axes; a wrong-length list is a loud error
     if size is not None:

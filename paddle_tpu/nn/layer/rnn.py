@@ -148,14 +148,17 @@ class RNN(Layer):
                 if self.is_reverse else inputs
             mask = sequence_mask(sequence_length, maxlen=T, dtype="bool")
             outs, states = [], initial_states
-            prev = None
-            for t in range(T):
+            prev = initial_states   # zero-length rows keep their INITIAL
+            for t in range(T):      # state (ADVICE r4: not one padded step)
                 x_t = inputs_eff[t] if self.time_major else inputs_eff[:, t]
                 o, states = self.cell(x_t, states)
                 valid = mask[:, t]                           # (B,) bool
                 o = _mask_rows(o, valid)
-                if prev is not None:
-                    states = _select_states(valid, states, prev)
+                if prev is None:
+                    # no explicit initial state: the cell's default is
+                    # zeros, so finished rows hold zeros at step 0 too
+                    prev = _zeros_like_states(states)
+                states = _select_states(valid, states, prev)
                 prev = states
                 outs.append(o)
             out = stack(outs, axis=seq_axis)
@@ -180,6 +183,16 @@ def _mask_rows(o, valid):
         vb = v.reshape((-1,) + (1,) * (a.ndim - 1))
         return jnp.where(vb, a, jnp.zeros_like(a))
     return apply_op(fn, o, valid)
+
+
+def _zeros_like_states(states):
+    from ...core.tensor import apply_op
+
+    def z(s):
+        return apply_op(lambda a: jnp.zeros_like(a), s)
+    if isinstance(states, (tuple, list)):
+        return type(states)(_zeros_like_states(s) for s in states)
+    return z(states)
 
 
 def _select_states(valid, new, old):

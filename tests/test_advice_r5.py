@@ -1,0 +1,113 @@
+"""Round-5 fixes: ADVICE r4 items + the int64 numpy-boundary guard
+(VERDICT r4 weak #8 / next #8)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ---- ADVICE r4 #1: interpolate argument validation
+def test_interpolate_requires_size_or_scale():
+    x = paddle.to_tensor(np.random.rand(1, 3, 8, 8).astype("float32"))
+    with pytest.raises(ValueError, match="size or scale_factor"):
+        paddle.nn.functional.interpolate(x)
+
+
+def test_interpolate_mode_rank_mismatch():
+    x5 = paddle.to_tensor(np.random.rand(1, 3, 4, 8, 8).astype("float32"))
+    with pytest.raises(ValueError, match="bilinear"):
+        paddle.nn.functional.interpolate(x5, size=[2, 4, 4], mode="bilinear")
+    x3 = paddle.to_tensor(np.random.rand(1, 3, 8).astype("float32"))
+    with pytest.raises(ValueError, match="trilinear"):
+        paddle.nn.functional.interpolate(x3, size=4, mode="trilinear")
+    # valid combos still work
+    out = paddle.nn.functional.interpolate(x5, size=[2, 4, 4],
+                                           mode="trilinear")
+    assert tuple(out.shape) == (1, 3, 2, 4, 4)
+
+
+# ---- ADVICE r4 #2: zero-length rows keep their initial state
+def test_rnn_zero_length_holds_initial_state():
+    paddle.seed(3)
+    cell = nn.GRUCell(4, 5)
+    rnn = nn.RNN(cell)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(3, 6, 4).astype("float32"))
+    init = paddle.to_tensor(np.random.RandomState(1)
+                            .rand(3, 5).astype("float32"))
+    seq_len = paddle.to_tensor(np.asarray([6, 0, 3], np.int32))
+    out, final = rnn(x, initial_states=init, sequence_length=seq_len)
+    # row 1 has length 0: final state must equal its initial state
+    np.testing.assert_allclose(final.numpy()[1], init.numpy()[1], rtol=1e-6)
+    # and its outputs are all zeros
+    np.testing.assert_allclose(out.numpy()[1], np.zeros((6, 5)), atol=0)
+
+
+def test_rnn_zero_length_no_initial_state_zero():
+    paddle.seed(4)
+    cell = nn.GRUCell(4, 5)
+    rnn = nn.RNN(cell)
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .rand(2, 4, 4).astype("float32"))
+    seq_len = paddle.to_tensor(np.asarray([4, 0], np.int32))
+    _, final = rnn(x, sequence_length=seq_len)
+    # default initial state is zeros: the zero-length row holds zeros
+    np.testing.assert_allclose(final.numpy()[1], np.zeros(5), atol=0)
+
+
+# ---- ADVICE r4 #3: tuner fallbacks never persist to the disk cache
+def test_autotune_fallback_not_persisted(tmp_path, monkeypatch):
+    from paddle_tpu.incubate import autotune as at
+
+    cache = str(tmp_path / "blocks.json")
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", cache)
+    at.record_flash_blocks(8, 1024, 64, True, (256, 256), persist=False)
+    import os
+    assert not os.path.exists(cache)       # in-memory only
+    # measured winners DO persist
+    at.record_flash_blocks(8, 2048, 64, True, (512, 512), persist=True)
+    assert os.path.exists(cache)
+    import json
+    data = json.load(open(cache))
+    keys = [tuple(json.loads(k)) for k in data]
+    assert all(k[2] != 1024 for k in keys)   # fallback geometry absent
+
+
+# ---- int64 numpy-boundary escape hatch
+def test_numpy_force_int64():
+    t = paddle.to_tensor(np.asarray([1, 2, 3], np.int64))
+    assert t.numpy().dtype == np.int32              # documented device policy
+    assert t.numpy(force_int64=True).dtype == np.int64
+    paddle.set_flags({"FLAGS_int64_numpy_boundary": True})
+    try:
+        assert t.numpy().dtype == np.int64
+    finally:
+        paddle.set_flags({"FLAGS_int64_numpy_boundary": False})
+    # floats untouched by the flag
+    f = paddle.to_tensor(np.asarray([1.0], np.float32))
+    assert f.numpy(force_int64=True).dtype == np.float32
+
+
+def test_checkpoint_roundtrip_reference_int64_state(tmp_path):
+    """A reference-written state_dict holding int64 arrays loads, applies,
+    and round-trips; the boundary guard recovers int64 for type-checking
+    consumers."""
+    import pickle
+
+    ref_state = {"steps": np.asarray([100], np.int64),
+                 "emb": np.random.RandomState(0).rand(4, 3).astype("float32")}
+    p = str(tmp_path / "ref_state.pkl")
+    with open(p, "wb") as f:
+        pickle.dump(ref_state, f)
+
+    with open(p, "rb") as f:
+        loaded = pickle.load(f)
+    t = paddle.to_tensor(loaded["steps"])
+    assert "int32" in str(t.dtype)                  # canonicalized on device
+    back = t.numpy(force_int64=True)
+    assert back.dtype == np.int64 and back[0] == 100
+    # paddle.save/load round-trip preserves the recovered int64 payload
+    paddle.save({"steps": back}, str(tmp_path / "rt.pdparams"))
+    rt = paddle.load(str(tmp_path / "rt.pdparams"), return_numpy=True)
+    assert rt["steps"].dtype == np.int64 and rt["steps"][0] == 100
