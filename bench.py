@@ -43,6 +43,10 @@ import numpy as np
 METRIC = "gpt350m_train_mfu_1chip"
 UNIT = "MFU (fraction of v5e bf16 peak)"
 
+# --profile artifacts directory (set by main from argv; run_config reads the
+# global so its signature stays stable for the ladder tests)
+_PROFILE_DIR = None
+
 
 def emit(value, vs_baseline, extra=None, error=None):
     rec = {"metric": METRIC, "value": value, "unit": UNIT,
@@ -180,8 +184,14 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
     from paddle_tpu.parallel import GPTSpmdConfig, MeshPlan, make_train_step
 
     # GPT-350M-class: fits one v5e chip (16GB) with AdamW f32 states.
+    # BENCH_LAYERS/HIDDEN/HEADS/VOCAB shrink the model for the CI smoke test
+    # of the --profile pipeline (defaults are the flagship config).
     cfg = GPTSpmdConfig(
-        vocab_size=50304, max_seq_len=S, hidden=1024, layers=24, heads=16,
+        vocab_size=int(os.environ.get("BENCH_VOCAB", 50304)),
+        max_seq_len=S,
+        hidden=int(os.environ.get("BENCH_HIDDEN", 1024)),
+        layers=int(os.environ.get("BENCH_LAYERS", 24)),
+        heads=int(os.environ.get("BENCH_HEADS", 16)),
         param_dtype="bfloat16" if on_tpu else "float32",
         compute_dtype="bfloat16" if on_tpu else "float32",
         remat={"none": False, "full": True, "dots": "dots",
@@ -224,22 +234,65 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         loss, params, state = dispatch(params, state, toks, labs, lr)
         loss_val = float(loss)          # host fetch = true device sync
 
+    prof = None
+    profile_paths = {}
+    if _PROFILE_DIR:
+        from paddle_tpu.profiler import (Profiler, RecordEvent,
+                                         TracerEventType)
+        os.makedirs(_PROFILE_DIR, exist_ok=True)
+        tl_path = os.path.join(_PROFILE_DIR, "step_timeline.jsonl")
+        if os.path.exists(tl_path):
+            os.remove(tl_path)          # one run per artifact set
+        profile_paths = {"timeline": tl_path,
+                         "attribution": os.path.join(_PROFILE_DIR,
+                                                     "attribution.md")}
+        prof = Profiler(timer_only=True, timeline=tl_path)
+        prof.start()
+
     # Timed loop: EVERY dispatch's last-step loss is fetched to the host,
     # but the fetch of dispatch i overlaps dispatch i+1 — one deep. The
     # timer stops only after the LAST loss reaches the host, which
     # transitively requires every step to have finished.
     t0 = time.perf_counter()
     prev = None
-    for _ in range(n_dispatch):
-        loss, params, state = dispatch(params, state, toks, labs, lr)
-        if prev is not None:
+    if prof is None:
+        for _ in range(n_dispatch):
+            loss, params, state = dispatch(params, state, toks, labs, lr)
+            if prev is not None:
+                loss_val = float(prev)
+            prev = loss
+        loss_val = float(prev)
+    else:
+        # profiled variant: one Forward span per dispatch (dispatch + the
+        # overlapped host fetch), one profiler step + JSONL record per
+        # dispatch. The span bookkeeping is O(µs) against ~100ms dispatches.
+        for _ in range(n_dispatch):
+            with RecordEvent(f"bench.dispatch(x{scan_k} steps)",
+                             TracerEventType.Forward):
+                loss, params, state = dispatch(params, state, toks, labs, lr)
+                if prev is not None:
+                    loss_val = float(prev)
+            prev = loss
+            prof.step(num_samples=B * S * scan_k)
+        with RecordEvent("bench.final_loss_fetch", TracerEventType.Forward):
             loss_val = float(prev)
-        prev = loss
-    loss_val = float(prev)
     dt = time.perf_counter() - t0
+
+    if prof is not None:
+        prof.stop()
+        report = prof.analyze(device="tpu-v5e" if on_tpu else "cpu")
+        with open(profile_paths["attribution"], "w") as f:
+            f.write(report.render() + "\n\n")
+            f.write(f"config: B={B} S={S} remat={remat} scan_k={scan_k} "
+                    f"fused_ce={fused_ce} backend={jax.default_backend()}\n"
+                    f"note: the train step is ONE fused XLA program, so "
+                    f"host attribution lands in the Forward dispatch span; "
+                    f"per-op rows appear for eager workloads.\n")
 
     total_steps = n_dispatch * scan_k
     tokens_per_sec = B * S * total_steps / dt
+    extra_profile = {"profile_artifacts": profile_paths} if profile_paths \
+        else {}
     # model flops/token: 6N (fwd+bwd matmul params) + causal attention term
     # 6 * L * S * H (QK^T and AV, fwd+bwd, x0.5 causal). Remat recompute is
     # NOT counted (standard MFU convention).
@@ -260,11 +313,31 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
                   "backend": jax.default_backend(),
                   "n_steps": total_steps, "scan_k": scan_k,
                   "step_ms": round(1000 * dt / total_steps, 1),
-                  "loss": loss_val},
+                  "loss": loss_val, **extra_profile},
     }
 
 
-def main():
+def _parse_args(argv):
+    """Minimal flag parsing (--profile / --steps N / --profile-dir D). Env
+    vars stay the primary config surface; argv is additive so the driver's
+    `python bench.py` invocation is unchanged."""
+    import argparse
+    p = argparse.ArgumentParser(description="flagship GPT train bench")
+    p.add_argument("--profile", action="store_true",
+                   help="attach the profiler; write step-timeline JSONL + "
+                        "MFU attribution next to the BENCH json")
+    p.add_argument("--profile-dir", default="./bench_profile",
+                   help="artifact directory for --profile")
+    p.add_argument("--steps", type=int, default=None,
+                   help="override the number of timed train steps")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    global _PROFILE_DIR
+    args = _parse_args(argv or [])
+    if args.profile:
+        _PROFILE_DIR = args.profile_dir
     init_budget = float(os.environ.get("BENCH_INIT_BUDGET_S", 600))
     backend = probe_backend(init_budget)
     on_tpu = backend == "tpu"
@@ -275,7 +348,8 @@ def main():
     assert jax.default_backend() == backend
     wd.cancel()
 
-    n_steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
+    n_steps = args.steps if args.steps is not None else \
+        int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
     S = int(os.environ.get("BENCH_S", 1024 if on_tpu else 128))
     scan_k = int(os.environ.get("BENCH_K", 10 if on_tpu else 1))
 
@@ -401,6 +475,8 @@ def main():
 
 if __name__ == "__main__":
     try:
-        main()
+        main(sys.argv[1:])
+    except SystemExit:      # argparse --help / usage error, not a bench fail
+        raise
     except BaseException as e:                               # noqa: BLE001
         emit_failure(f"{type(e).__name__}: {str(e)[:600]}")

@@ -1,0 +1,34 @@
+"""Version shims over the installed jax.
+
+The codebase targets the current jax spellings `jax.shard_map(...,
+check_vma=)` and `jax.lax.axis_size(name)`. Older installs (<=0.4.x) only
+ship `jax.experimental.shard_map.shard_map(..., check_rep=)` — same
+semantics, pre-rename — and spell the axis size as `lax.psum(1, name)`
+(which constant-folds to a python int inside a manual region). Rather than
+sprinkling try/except at every call site (manual collectives, gpt_spmd,
+ring attention, pipeline compile, graft entry), install adapters under the
+modern names when they are missing. Idempotent; a no-op on jax versions
+that already expose them.
+"""
+import jax
+
+
+def install():
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+        def shard_map(f, *args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _exp_shard_map(f, *args, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+
+install()

@@ -9,6 +9,8 @@ knows the VJP of every primitive.
 """
 import contextlib
 
+from ..profiler import _tracer as _TRACER
+
 _grad_enabled = True
 
 
@@ -143,6 +145,22 @@ def _apply_hooks(tensor, g, create_graph):
 
 def run_backward(tensor, grad=None, retain_graph=False, create_graph=False,
                  capture=None, accumulate_leaf_grads=True):
+    """Tape walk wrapped in a Backward phase span (reference: the Backward
+    TracerEventType RunBackward stamps); see _run_backward_impl."""
+    if not _TRACER.enabled:
+        return _run_backward_impl(tensor, grad, retain_graph, create_graph,
+                                  capture, accumulate_leaf_grads)
+    rec = _TRACER.begin("backward", "Backward")
+    try:
+        return _run_backward_impl(tensor, grad, retain_graph, create_graph,
+                                  capture, accumulate_leaf_grads)
+    finally:
+        _TRACER.end(rec)
+
+
+def _run_backward_impl(tensor, grad=None, retain_graph=False,
+                       create_graph=False, capture=None,
+                       accumulate_leaf_grads=True):
     """Generic reverse sweep from `tensor`.
 
     create_graph: cotangents flow as Tensors and every vjp call is recorded

@@ -18,6 +18,7 @@ from . import autograd as ag
 from . import dtype as _dt
 from .autograd import Node
 from .device import default_device
+from ..profiler import _tracer as _TRACER
 
 
 def _is_traced(x) -> bool:
@@ -603,9 +604,53 @@ def _add_op_context(e, fn, name, args):
         sig = ", ".join(
             f"Tensor{tuple(a.shape)}:{a.dtype}" if isinstance(a, Tensor)
             else type(a).__name__ for a in args)
-        e.add_note(f"  [operator < {opname} > error] inputs: ({sig})")
+        note = f"  [operator < {opname} > error] inputs: ({sig})"
+        if hasattr(e, "add_note"):
+            e.add_note(note)
+        else:                       # PEP 678 backport for python < 3.11
+            e.__notes__ = getattr(e, "__notes__", []) + [note]
     except Exception:                                        # noqa: BLE001
         pass
+
+
+def _prof_begin_op(fn, name, args, kwargs):
+    """Operator span for one apply_op dispatch: input shapes/dtypes in the
+    attrs, and (when with_flops) the callable + abstract avals so
+    Profiler.analyze() can re-trace the op and price it on the roofline.
+    Only ever called while the tracer is RECORD — the CLOSED-state cost of
+    profiling is the single `_TRACER.enabled` check at the apply_op top."""
+    shapes, dtypes, tensor_idx, avals, statics = [], [], [], [], []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            d = a._data
+            shapes.append(tuple(int(s) for s in d.shape))
+            dtypes.append(str(d.dtype))
+            tensor_idx.append(i)
+            avals.append(jax.ShapeDtypeStruct(d.shape, d.dtype))
+        else:
+            statics.append((i, a))
+    attrs = {"input_shapes": shapes, "input_dtypes": dtypes}
+    opname = name or getattr(fn, "__qualname__", None) \
+        or getattr(fn, "__name__", "op")
+    # variant: digest of the op's non-tensor identity (closure cells,
+    # defaults, static args, kwargs). Two `split` lambdas share a code
+    # object and input shapes but close over different sections — without
+    # this, analyze() would price both from one roofline estimate.
+    okey = _op_cache_key(fn, args, kwargs, ())
+    if okey is not None:
+        attrs["variant"] = f"{hash(okey) & 0xffffffff:08x}"
+    ref = None
+    if _TRACER.with_flops:
+        # one ref per (op, shapes, variant) bucket per window — refs pin
+        # the callable + its closures, so per-event refs would grow host
+        # memory without bound on long always-on profiled runs. Ops with
+        # unhashable identity (okey None) dedup on name+shapes alone:
+        # their variants alias in analyze(), but memory stays bounded.
+        dedup = (opname, tuple(shapes), tuple(dtypes), attrs.get("variant"))
+        if _TRACER.ref_once(dedup):
+            ref = (fn, tuple(tensor_idx), tuple(avals), tuple(statics),
+                   len(args), kwargs)
+    return _TRACER.begin(opname, "Operator", attrs, ref)
 
 
 def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
@@ -614,6 +659,7 @@ def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
     `fn` operates on raw jax arrays. Non-Tensor args pass through unchanged.
     Returns Tensor or tuple-of-Tensor mirroring fn's output structure.
     """
+    rec = _prof_begin_op(fn, name, args, kwargs) if _TRACER.enabled else None
     try:
         if _nan_check_enabled():
             outs = _apply_op_inner(fn, *args, n_outputs=n_outputs, name=name,
@@ -624,6 +670,9 @@ def apply_op(fn, *args, n_outputs=None, name="", **kwargs):
     except Exception as e:
         _add_op_context(e, fn, name, args)
         raise
+    finally:
+        if rec is not None:
+            _TRACER.end(rec)
 
 
 def _apply_op_inner(fn, *args, n_outputs=None, name="", **kwargs):
@@ -642,10 +691,14 @@ def _apply_op_inner(fn, *args, n_outputs=None, name="", **kwargs):
         runner = _EAGER_CACHE.get((key, need_grad))
         if runner is None:
             _CACHE_STATS["misses"] += 1
+            if _TRACER.enabled:
+                _TRACER.note("cache", "miss")
             runner = _build_cached_op(fn, args, kwargs, diff_idx, need_grad)
             _EAGER_CACHE[(key, need_grad)] = runner
         else:
             _CACHE_STATS["hits"] += 1
+            if _TRACER.enabled:
+                _TRACER.note("cache", "hit")
         td = tuple(d for d, a in zip(datas, args) if isinstance(a, Tensor))
         if not need_grad:
             return _wrap_out(runner(td), stop_gradient=True)
@@ -668,6 +721,8 @@ def _apply_op_inner(fn, *args, n_outputs=None, name="", **kwargs):
             o._node = node
         return outs
     _CACHE_STATS["bypass"] += 1
+    if _TRACER.enabled:
+        _TRACER.note("cache", "bypass")
 
     if not need_grad:
         out = fn(*datas, **kwargs)

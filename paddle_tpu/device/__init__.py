@@ -6,6 +6,38 @@ from ..core.device import (  # noqa: F401
 )
 
 
+def op_cache_stats():
+    """Public view of the eager per-op executable cache (core/tensor.py)
+    — the stats device.cuda exposes for HBM, for the dispatch cache:
+    {hits, misses, bypass, size, hit_rate}. `size` is the number of cached
+    compiled-op runners; `bypass` counts dispatches whose op identity was
+    unhashable (correct but uncached)."""
+    from ..core import tensor as _t
+    total = _t._CACHE_STATS["hits"] + _t._CACHE_STATS["misses"]
+    return {
+        "hits": _t._CACHE_STATS["hits"],
+        "misses": _t._CACHE_STATS["misses"],
+        "bypass": _t._CACHE_STATS["bypass"],
+        "size": len(_t._EAGER_CACHE),
+        "hit_rate": (_t._CACHE_STATS["hits"] / total) if total else 0.0,
+    }
+
+
+def reset_op_cache_stats():
+    """Zero the eager-cache counters (cached executables stay)."""
+    from ..core import tensor as _t
+    for k in _t._CACHE_STATS:
+        _t._CACHE_STATS[k] = 0
+
+
+def clear_op_cache():
+    """Drop every cached eager-op executable AND zero the counters (the
+    dispatch-cache analogue of device.cuda.empty_cache)."""
+    from ..core import tensor as _t
+    _t._EAGER_CACHE.clear()
+    reset_op_cache_stats()
+
+
 def get_all_custom_device_type():
     return ["tpu"]
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor, apply_op
+from ..profiler import _tracer as _TRACER
 from . import env
 
 
@@ -162,6 +163,44 @@ def _axis_of(group, default_kind="dp"):
 
 def _in_trace(x):
     return isinstance(x, jax.core.Tracer)
+
+
+def _traced_collective(fn):
+    """Communication span per collective call (reference: the Communication
+    TracerEventType the C++ profiler stamps on c_* ops): collective kind,
+    payload bytes over every tensor argument, group size. Zero-cost while
+    the tracer is CLOSED (single `enabled` check)."""
+    kind = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _TRACER.enabled:
+            return fn(*args, **kwargs)
+        group = kwargs.get("group")
+        if group is None:
+            group = next((a for a in args if isinstance(a, Group)), None)
+        nbytes = 0
+        for a in args:
+            items = a if isinstance(a, (list, tuple)) else (a,)
+            for t in items:
+                if isinstance(t, Tensor):
+                    d = t._data
+                    try:
+                        nbytes += int(d.size) * d.dtype.itemsize
+                    except Exception:                        # noqa: BLE001
+                        pass
+        try:
+            gsz = group.nranks if group is not None else env.get_world_size()
+        except Exception:                                    # noqa: BLE001
+            gsz = None
+        rec = _TRACER.begin(f"comm.{kind}", "Communication",
+                            {"collective": kind, "payload_bytes": nbytes,
+                             "group_size": gsz})
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _TRACER.end(rec)
+    return wrapper
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +353,7 @@ def _eager_axis_op(data, axis_name, per_shard_fn, out_spec_fn=None):
     return jax.jit(run)(data)
 
 
+@_traced_collective
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
     if group is None and not _in_trace(tensor._data) \
             and jax.process_count() > 1 \
@@ -349,6 +389,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_strea
     return tensor
 
 
+@_traced_collective
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     ax = _axis_of(group)
     if ax is None:
@@ -365,6 +406,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     return out
 
 
+@_traced_collective
 def all_gather_concat(tensor, group=None, concat_axis=0):
     """Gather shards and concat along concat_axis (TP activation gather)."""
     ax = _axis_of(group, "mp")
@@ -374,6 +416,7 @@ def all_gather_concat(tensor, group=None, concat_axis=0):
         lambda x: jax.lax.all_gather(x, ax, axis=concat_axis, tiled=True), tensor)
 
 
+@_traced_collective
 def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     ax = _axis_of(group, "sharding")
@@ -405,6 +448,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM, group=No
     return apply_op(fn, src)
 
 
+@_traced_collective
 def broadcast(tensor, src=0, group=None, sync_op=True):
     if group is None and not _in_trace(tensor._data) \
             and jax.process_count() > 1 \
@@ -435,6 +479,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op, group, sync_op)
 
 
+@_traced_collective
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis_of(group)
     if ax is None or tensor_list is None:
@@ -446,6 +491,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_traced_collective
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     ax = _axis_of(group, "ep")
     if isinstance(in_tensor_list, (list, tuple)):
@@ -465,6 +511,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return out
 
 
+@_traced_collective
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     ax = _axis_of(group, "ep")
@@ -478,6 +525,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     return out
 
 
+@_traced_collective
 def send(tensor, dst=0, group=None, sync_op=True):
     """P2P send: on a mesh this is a collective_permute to `dst` along the
     live 'pp' axis (reference: send_v2 op). Must be paired with recv in the
@@ -490,6 +538,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return apply_op(lambda x: jax.lax.ppermute(x, ax, perm), tensor)
 
 
+@_traced_collective
 def recv(tensor, src=0, group=None, sync_op=True):
     ax = _axis_of(group, "pp")
     if ax is None:
@@ -501,6 +550,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_traced_collective
 def p2p_shift(tensor, shift=1, group=None):
     """Ring shift along the live pp/sp axis (ring attention, 1F1B p2p)."""
     ax = _axis_of(group, "pp") or _axis_of(group, "sp")
@@ -511,6 +561,7 @@ def p2p_shift(tensor, shift=1, group=None):
     return apply_op(lambda x: jax.lax.ppermute(x, ax, perm), tensor)
 
 
+@_traced_collective
 def barrier(group=None):
     """Synchronize. Eager single-controller: drain outstanding work on every
     device the group spans (the reference's stream-sync semantics). Inside a

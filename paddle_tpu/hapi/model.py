@@ -16,6 +16,7 @@ import numpy as np
 from ..core import random as _rng
 from ..core.tensor import Tensor
 from ..nn.layer.layers import functional_call, functional_state
+from ..profiler import _tracer as _TRACER
 from .callbacks import CallbackList, ProgBarLogger
 from ..metric import Metric
 
@@ -211,13 +212,32 @@ class Model:
             self._opt_state = self._optimizer.functional_state(params)
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         seed = _rng.next_key()
-        loss, new_params, new_buffers, self._opt_state, outs = step_fn(
-            params, buffers, self._opt_state, lr, seed, in_raw, lab_raw)
-        self._write_back(new_params, new_buffers)
+        # phase spans (reference: the Forward/Backward/Optimization
+        # TracerEventTypes the dygraph adapter stamps). The fused jit step
+        # IS fwd+bwd+opt in one XLA program, so the dispatch plus the loss
+        # host-fetch (the true device sync) lands in one Forward-typed span
+        # whose attrs say so; the eager write-back is the Optimization part
+        # that remains on the host.
+        rec = _TRACER.begin(
+            "Model.train_batch.fused_step", "Forward",
+            {"fused": "forward+backward+optimizer (single jit dispatch)"}) \
+            if _TRACER.enabled else None
+        try:
+            loss, new_params, new_buffers, self._opt_state, outs = step_fn(
+                params, buffers, self._opt_state, lr, seed, in_raw, lab_raw)
+            loss_val = float(np.asarray(loss))
+        finally:
+            _TRACER.end(rec)
+        rec = _TRACER.begin("Model.train_batch.write_back", "Optimization") \
+            if _TRACER.enabled else None
+        try:
+            self._write_back(new_params, new_buffers)
+        finally:
+            _TRACER.end(rec)
         if isinstance(self._optimizer._lr, object) and hasattr(self._optimizer._lr, "step"):
             pass  # schedulers step per epoch by callback; per-step via user
         metrics_out = self._update_metrics(outs, lab_raw)
-        return [float(np.asarray(loss))], metrics_out
+        return [loss_val], metrics_out
 
     def _train_batch_pp(self, in_raw, lab_raw, mesh):
         """Pipeline-parallel Model.fit path: the network must be a fleet
@@ -249,8 +269,14 @@ class Model:
                 self.network, mesh, microbatches=micro,
                 schedule=self._strategy.get("schedule", "1f1b"))
         params, buffers = functional_state(self.network)
-        loss, grads, new_buffers = self._pp_step(params, buffers,
-                                                 in_raw[0], lab_raw[0])
+        rec = _TRACER.begin("Model.train_batch.pipeline_step", "Forward",
+                            {"fused": "1f1b pipeline (single jit dispatch)"}) \
+            if _TRACER.enabled else None
+        try:
+            loss, grads, new_buffers = self._pp_step(params, buffers,
+                                                     in_raw[0], lab_raw[0])
+        finally:
+            _TRACER.end(rec)
         named = dict(self.network.named_parameters())
         for n, g in grads.items():
             p = named[n]
@@ -273,7 +299,12 @@ class Model:
             self._eval_fn = self._build_eval_step()
         params, buffers = functional_state(self.network)
         seed = _rng.next_key()
-        loss, outs = self._eval_fn(params, buffers, seed, in_raw, lab_raw)
+        rec = _TRACER.begin("Model.eval_batch", "Forward") \
+            if _TRACER.enabled else None
+        try:
+            loss, outs = self._eval_fn(params, buffers, seed, in_raw, lab_raw)
+        finally:
+            _TRACER.end(rec)
         metrics_out = self._update_metrics(outs, lab_raw)
         return ([float(np.asarray(loss))] if loss is not None else []), metrics_out
 

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..core.random import _default_generator
 from ..core.tensor import Tensor, to_tensor
+from ..profiler import _tracer as _TRACER
 from .worker import (WorkerInfo, collate, get_worker_info, numpy_collate,
                      worker_loop)
 
@@ -280,6 +281,27 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        """Batch iterator, with one Dataloader profiler span per produced
+        batch (reference: the Dataloader TracerEventType stamped by
+        dataloader_iter.py). With background workers the span measures the
+        time the training loop WAITS on data — the dataloader-bound phase
+        of the step — not worker-side compute."""
+        it = self._base_iter()
+        while True:
+            rec = _TRACER.begin("DataLoader.next", "Dataloader") \
+                if _TRACER.enabled else None
+            try:
+                batch = next(it)
+            except StopIteration:
+                _TRACER.cancel(rec)
+                return
+            except BaseException:
+                _TRACER.cancel(rec)
+                raise
+            _TRACER.end(rec)
+            yield batch
+
+    def _base_iter(self):
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return

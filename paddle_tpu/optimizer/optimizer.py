@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..profiler import _tracer as _TRACER
 from .lr import LRScheduler
 
 
@@ -70,6 +71,19 @@ class Optimizer:
         return self._parameters
 
     def step(self):
+        """Eager parameter update, stamped as an Optimization phase span
+        (reference: the Optimization TracerEventType on optimizer ops)."""
+        if not _TRACER.enabled:
+            return self._step_impl()
+        rec = _TRACER.begin(f"Optimizer.step.{type(self).__name__}",
+                            "Optimization",
+                            {"n_params": len(self._parameters)})
+        try:
+            return self._step_impl()
+        finally:
+            _TRACER.end(rec)
+
+    def _step_impl(self):
         params_grads = [(p, p.grad) for p in self._parameters
                         if not p.stop_gradient and p._grad_data is not None]
         if self._grad_clip is not None:

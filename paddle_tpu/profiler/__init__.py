@@ -9,12 +9,16 @@ events merge into a tree (event_node.cc) exported as chrome-trace JSON
 
 TPU-native mapping:
 - host tracer  -> in-process span recorder (this file; RecordEvent spans
-  with nesting tracked per thread)
+  with nesting tracked per thread), auto-fed by the framework: apply_op
+  emits Operator spans, distributed/collective.py Communication spans,
+  io.DataLoader Dataloader spans, hapi/optimizer/autograd the
+  Forward/Backward/Optimization phase spans
 - CUPTI tracer -> jax.profiler XPlane capture (start_trace/stop_trace),
   viewable in TensorBoard/XProf — device-side kernel timelines come from
   the XLA runtime, the role CUPTI plays for CUDA
 - chrome-trace logger -> export_chrome_tracing handler over the host spans
-- profiler_statistic  -> summary() aggregation table
+- profiler_statistic  -> statistic.py summary views + the roofline
+  attribution join against cost_model/analytical.py (Profiler.analyze)
 """
 import contextlib
 import json
@@ -23,10 +27,14 @@ import threading
 import time
 
 import jax
+import numpy as np
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "TracerEventType", "SortedKeys", "SummaryView",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result"]
+
+STEP_TIMELINE_SCHEMA = "paddle_tpu.step_timeline.v1"
 
 
 class ProfilerTarget:
@@ -66,40 +74,112 @@ def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 # ---------------------------------------------------------------- host tracer
 
+def _live_bytes():
+    """Live device bytes right now (the MemoryView sample). jax.live_arrays
+    enumerates every jax.Array the process holds a reference to."""
+    try:
+        return int(sum(a.size * a.dtype.itemsize for a in jax.live_arrays()))
+    except Exception:                                        # noqa: BLE001
+        return None
+
+
 class _HostTracer:
     """Span recorder (the host_tracer.cc role). Spans: dicts with name,
-    thread id, start/end (ns), nesting depth."""
+    thread id, start/end (ns), nesting depth, optional attrs (shapes,
+    payload bytes, cache outcome), optional memory samples, and an
+    in-memory `_ref` (fn + avals) for analyze-time roofline re-trace.
+
+    The `enabled` attribute IS the hot-path guard: instrumentation sites
+    check it before building any span metadata, so a CLOSED profiler costs
+    one attribute load per op."""
 
     def __init__(self):
         self.enabled = False
+        self.sample_memory = False
+        self.with_flops = True
         self.events = []
         self._lock = threading.Lock()
         self._tls = threading.local()
+        self._ref_seen = set()
 
-    def _depth(self):
-        return getattr(self._tls, "depth", 0)
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
 
-    def begin(self, name, event_type):
+    def begin(self, name, event_type, attrs=None, ref=None):
         if not self.enabled:
             return None
+        st = self._stack()
         rec = {"name": name, "type": event_type,
                "tid": threading.get_ident(),
                "ts": time.perf_counter_ns(), "dur": None,
-               "depth": self._depth()}
-        self._tls.depth = self._depth() + 1
+               "depth": len(st)}
+        if attrs is not None:
+            rec["attrs"] = attrs
+        if ref is not None:
+            rec["_ref"] = ref
+        if self.sample_memory:
+            rec["mem0"] = _live_bytes()
+        st.append(rec)
         return rec
 
     def end(self, rec):
         if rec is None:
             return
-        self._tls.depth = max(self._depth() - 1, 0)
+        st = self._stack()
+        if st and st[-1] is rec:
+            st.pop()
+        elif rec in st:                   # unbalanced nesting: drop through
+            st.remove(rec)
         rec["dur"] = time.perf_counter_ns() - rec["ts"]
+        if self.sample_memory:
+            rec["mem1"] = _live_bytes()
         with self._lock:
             self.events.append(rec)
+
+    def cancel(self, rec):
+        """Abandon an open span without recording it (e.g. the DataLoader
+        span opened around a `next` that raised StopIteration)."""
+        if rec is None:
+            return
+        st = self._stack()
+        if st and st[-1] is rec:
+            st.pop()
+        elif rec in st:
+            st.remove(rec)
+
+    def note(self, key, value):
+        """Attach a key to the innermost open span on this thread (used by
+        apply_op to mark the eager-cache outcome from inside the dispatch)."""
+        st = self._stack()
+        if st:
+            st[-1].setdefault("attrs", {})[key] = value
+
+    def mark(self):
+        with self._lock:
+            return len(self.events)
+
+    def since(self, idx):
+        with self._lock:
+            return list(self.events[idx:])
+
+    def ref_once(self, key):
+        """True the first time `key` is seen this window — callers attach
+        the heavyweight analyze-ref only then (one per op bucket, not one
+        per dispatch)."""
+        with self._lock:
+            if key in self._ref_seen:
+                return False
+            self._ref_seen.add(key)
+            return True
 
     def drain(self):
         with self._lock:
             ev, self.events = self.events, []
+            self._ref_seen.clear()
         return ev
 
 
@@ -123,14 +203,15 @@ class RecordEvent:
     python surface profiler/utils.py RecordEvent). Also forwards to
     jax.profiler.TraceAnnotation so spans show up inside XPlane captures."""
 
-    def __init__(self, name, event_type=TracerEventType.PythonOp):
+    def __init__(self, name, event_type=TracerEventType.PythonOp, attrs=None):
         self.name = name
         self.event_type = event_type
+        self.attrs = attrs
         self._rec = None
         self._ann = None
 
     def begin(self):
-        self._rec = _tracer.begin(self.name, self.event_type)
+        self._rec = _tracer.begin(self.name, self.event_type, self.attrs)
         if _tracer.enabled:
             self._ann = jax.profiler.TraceAnnotation(self.name)
             self._ann.__enter__()
@@ -153,23 +234,58 @@ class RecordEvent:
 
 # ------------------------------------------------------------- trace handlers
 
+def _json_safe_attrs(rec):
+    attrs = rec.get("attrs")
+    if not attrs:
+        return None
+    out = {}
+    for k, v in attrs.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(v)
+    return out
+
+
 def export_chrome_tracing(dir_name, worker_name=None):
     """Returns an on_trace_ready handler writing chrome://tracing JSON
-    (reference: chrometracing_logger.cc)."""
+    (reference: chrometracing_logger.cc).
+
+    Exports the LAST RECORD WINDOW only (an empty window exports as empty —
+    never silently the cumulative history), and maps each (thread, nesting
+    depth) to its own tid lane with thread_name metadata so nested spans
+    render stacked instead of flattened."""
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
         path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
                             ".paddle_trace.json")
+        window = prof._window_events
+        if window is None:          # profiler stopped without ever recording
+            window = prof._events
+        pid = os.getpid()
+        lanes = {}                  # (tid, depth) -> lane id
         events = []
-        for e in getattr(prof, "_window_events", None) or prof._events:
-            events.append({
-                "name": e["name"], "cat": e["type"], "ph": "X",
-                "pid": os.getpid(), "tid": e["tid"],
-                "ts": e["ts"] / 1000.0, "dur": (e["dur"] or 0) / 1000.0,
-            })
+        for e in window:
+            lane_key = (e["tid"], e["depth"])
+            lane = lanes.setdefault(lane_key, len(lanes))
+            ev = {"name": e["name"], "cat": e["type"], "ph": "X",
+                  "pid": pid, "tid": lane,
+                  "ts": e["ts"] / 1000.0, "dur": (e["dur"] or 0) / 1000.0}
+            attrs = _json_safe_attrs(e)
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+        meta = []
+        for (tid, depth), lane in sorted(lanes.items(), key=lambda kv: kv[1]):
+            meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                         "tid": lane,
+                         "args": {"name": f"thread {tid} · depth {depth}"}})
+            meta.append({"ph": "M", "name": "thread_sort_index", "pid": pid,
+                         "tid": lane, "args": {"sort_index": lane}})
         with open(path, "w") as f:
-            json.dump({"traceEvents": events,
+            json.dump({"traceEvents": meta + events,
                        "displayTimeUnit": "ms"}, f)
         prof._exported_path = path
     return handler
@@ -192,11 +308,17 @@ class Profiler:
     """Scheduler-windowed profiler (reference: profiler.py:340).
 
     targets defaults to host + device. timer_only=True skips the device
-    XPlane capture (benchmark mode, reference semantics)."""
+    XPlane capture (benchmark mode, reference semantics).
+    profile_memory=True samples live device bytes at span boundaries
+    (MemoryView). with_flops=True (default) lets apply_op attach the op
+    callable + abstract shapes so analyze() can price each op with the
+    analytical roofline. timeline=<path> appends one JSONL record per
+    recorded step (phase durations, op digest, cache stats, memory peak)
+    — the artifact tools/perf_report.py renders."""
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False):
+                 with_flops=True, timeline=None):
         if callable(scheduler):
             self._scheduler = scheduler
         elif isinstance(scheduler, (tuple, list)):
@@ -207,16 +329,22 @@ class Profiler:
             self._scheduler = None  # always on
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        self._profile_memory = bool(profile_memory)
+        self._with_flops = bool(with_flops)
+        self._timeline_path = timeline
         self._log_dir = "./profiler_log"
         self._step = 0
         self._state = ProfilerState.CLOSED
         self._device_active = False
         self._events = []
         self._step_times = []
+        self._step_samples = []
         self._last_t = None
         self._step_rec = None
         self._exported_path = None
         self._window_events = None
+        self._step_mark = 0
+        self._cache_mark = None
 
     # ------------------------------------------------------------ lifecycle
     def _target_state(self):
@@ -224,11 +352,16 @@ class Profiler:
             return ProfilerState.RECORD
         return self._scheduler(self._step)
 
+    def _recording(self):
+        return self._state in (ProfilerState.RECORD,
+                               ProfilerState.RECORD_AND_RETURN)
+
     def _transition(self, new):
-        recording = self._state in (ProfilerState.RECORD,
-                                    ProfilerState.RECORD_AND_RETURN)
+        recording = self._recording()
         want = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         if want and not recording:
+            _tracer.sample_memory = self._profile_memory
+            _tracer.with_flops = self._with_flops
             _tracer.enabled = True
             if not self._timer_only:
                 try:
@@ -242,6 +375,7 @@ class Profiler:
 
     def _collect(self):
         _tracer.enabled = False
+        _tracer.sample_memory = False
         window = _tracer.drain()
         self._events.extend(window)       # cumulative, for statistics()
         self._window_events = window      # this window only, for export
@@ -257,13 +391,20 @@ class Profiler:
         self._open_step_span()
 
     def stop(self):
+        # timeline records are written per step() call only — stop() closes
+        # a partial window that has no step duration to report
         self._close_step_span()
-        if self._state in (ProfilerState.RECORD,
-                           ProfilerState.RECORD_AND_RETURN):
+        if self._recording():
             self._collect()
         self._state = ProfilerState.CLOSED
 
     def _open_step_span(self):
+        self._step_mark = _tracer.mark()
+        if self._timeline_path is not None and self._recording():
+            from ..core.tensor import _CACHE_STATS
+            self._cache_mark = dict(_CACHE_STATS)
+        else:
+            self._cache_mark = None
         self._step_rec = _tracer.begin(f"ProfileStep#{self._step}",
                                        TracerEventType.ProfileStep)
 
@@ -273,10 +414,13 @@ class Profiler:
 
     def step(self, num_samples=None):
         now = time.perf_counter()
-        if self._last_t is not None:
-            self._step_times.append(now - self._last_t)
+        dt = now - self._last_t if self._last_t is not None else None
+        if dt is not None:
+            self._step_times.append(dt)
+            self._step_samples.append(num_samples)
         self._last_t = now
         self._close_step_span()
+        self._write_timeline_record(dt, num_samples)
         self._step += 1
         self._transition(self._target_state())
         self._open_step_span()
@@ -289,18 +433,59 @@ class Profiler:
         self.stop()
         return False
 
+    # ----------------------------------------------------------- timeline
+    def _write_timeline_record(self, dt, num_samples):
+        """One JSONL record for the step that just closed (only while the
+        window was recording) — the durable perf evidence a dead TPU grant
+        cannot take with it."""
+        if self._timeline_path is None or not self._recording():
+            return
+        from . import statistic as _stat
+        window = _tracer.since(self._step_mark)
+        step_events = [e for e in window
+                       if e["type"] != TracerEventType.ProfileStep]
+        rec = {
+            "schema": STEP_TIMELINE_SCHEMA,
+            "step": self._step,
+            "step_ms": None if dt is None else round(dt * 1e3, 4),
+            "phases": _stat.phase_durations_ms(step_events),
+            "ops": _stat.op_digest(step_events, top=8),
+            "num_samples": num_samples,
+        }
+        if self._cache_mark is not None:
+            from ..core.tensor import _CACHE_STATS
+            rec["cache"] = {k: _CACHE_STATS[k] - self._cache_mark.get(k, 0)
+                            for k in ("hits", "misses", "bypass")}
+        mem = [m for e in step_events
+               for m in (e.get("mem0"), e.get("mem1")) if m is not None]
+        rec["mem_peak_bytes"] = max(mem) if mem else None
+        os.makedirs(os.path.dirname(os.path.abspath(self._timeline_path)),
+                    exist_ok=True)
+        with open(self._timeline_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
     # ------------------------------------------------------------ reporting
     def step_info(self, unit=None):
+        """Last-10-steps digest. `unit` labels throughput: with
+        step(num_samples=...) provided, ips = samples/s in that unit
+        (reference: profiler.py step_info's `unit`); else steps/s."""
         if not self._step_times:
             return ""
-        import numpy as np
         arr = np.asarray(self._step_times[-10:])
+        pairs = [(t, s) for t, s in zip(self._step_times[-10:],
+                                        self._step_samples[-10:])
+                 if s is not None]
+        if unit and pairs:
+            ips = sum(s for _, s in pairs) / sum(t for t, _ in pairs)
+            return (f"avg step {arr.mean() * 1000:.2f} ms, "
+                    f"ips {ips:.2f} {unit}/s")
+        # without num_samples the only honest rate is steps/s — a unit
+        # label here would caption steps/s as e.g. images/s
         return (f"avg step {arr.mean() * 1000:.2f} ms, "
                 f"ips {1.0 / arr.mean():.2f} steps/s")
 
     def statistics(self):
         """Aggregate spans by name (reference: profiler_statistic.py)."""
-        import numpy as np
         by_name = {}
         for e in self._events:
             by_name.setdefault(e["name"], []).append(e["dur"] or 0)
@@ -314,25 +499,36 @@ class Profiler:
         return rows
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        rows = self.statistics()
-        if not rows:
-            print(self.step_info())
+                time_unit="ms", views=None):
+        """Print the summary tables (reference: profiler.py summary /
+        profiler_statistic.py _build_table). `views`: a SummaryView value
+        or list of them; default prints OverView + OperatorView (+
+        DistributedView / MemoryView when comm spans / memory samples
+        exist)."""
+        from . import statistic as _stat
+        text = _stat.build_summary(self._events, sorted_by=sorted_by,
+                                   views=views, time_unit=time_unit)
+        if text:
+            print(text)
+        elif not self._step_times:
             return
-        width = max((len(r["name"]) for r in rows), default=4)
-        print(f"{'Name':<{width}}  {'Calls':>6}  {'Total(ms)':>10}  "
-              f"{'Avg(ms)':>9}  {'Max(ms)':>9}  {'Min(ms)':>9}")
-        for r in rows:
-            print(f"{r['name']:<{width}}  {r['calls']:>6}  "
-                  f"{r['total_ms']:>10.3f}  {r['avg_ms']:>9.3f}  "
-                  f"{r['max_ms']:>9.3f}  {r['min_ms']:>9.3f}")
         if self._step_times:
             print(self.step_info())
+
+    def analyze(self, device=None, top_k=3):
+        """Join recorded host spans against the analytical roofline
+        (cost_model/analytical.py): per-op achieved vs roofline time, the
+        top-k MFU gap contributors, phase breakdown, and coverage of the
+        recorded compute span time. Returns statistic.AnalyzeReport."""
+        from . import statistic as _stat
+        return _stat.analyze(self._events, step_times=self._step_times,
+                             device=device, top_k=top_k)
 
 
 class SortedKeys:
     """reference: profiler/profiler_statistic.py SortedKeys — summary sort
-    orders."""
+    orders. Host spans only (XLA owns the device timeline), so the GPU*
+    keys alias their CPU counterparts."""
     CPUTotal = 0
     CPUAvg = 1
     CPUMax = 2

@@ -1,0 +1,84 @@
+"""CI guard for the perf-evidence pipeline: `bench.py --profile --steps 2`
+on CPU must emit a schema-valid step-timeline JSONL + attribution report,
+and tools/perf_report.py must render both — so the artifacts a dead TPU
+grant leaves behind can never silently rot."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+import perf_report  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def bench_artifacts(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("benchprof"))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               BENCH_B="2", BENCH_S="64", BENCH_LAYERS="2",
+               BENCH_HIDDEN="64", BENCH_HEADS="4", BENCH_VOCAB="512",
+               BENCH_INIT_BUDGET_S="120")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"),
+         "--profile", "--steps", "2", "--profile-dir", out_dir],
+        capture_output=True, text=True, timeout=480, cwd=_ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    return out_dir, json.loads(line)
+
+
+def test_bench_profile_emits_metric_and_artifacts(bench_artifacts):
+    out_dir, rec = bench_artifacts
+    assert "error" not in rec, rec
+    assert rec["metric"] == "gpt350m_train_mfu_1chip"
+    assert rec["value"] > 0
+    arts = rec["extra"]["profile_artifacts"]
+    assert os.path.exists(arts["timeline"])
+    assert os.path.exists(arts["attribution"])
+    assert os.path.dirname(arts["timeline"]) == out_dir
+
+
+def test_timeline_jsonl_schema_valid(bench_artifacts):
+    out_dir, rec = bench_artifacts
+    records = perf_report.load_timeline(out_dir)   # raises on any violation
+    assert len(records) == 2                       # one record per step
+    for r in records:
+        assert perf_report.validate_record(r) == []
+        assert r["schema"] == perf_report.SCHEMA
+        assert "Forward" in r["phases"]            # the dispatch span
+        assert r["step_ms"] is None or r["step_ms"] > 0
+
+
+def test_attribution_report_names_phases(bench_artifacts):
+    out_dir, rec = bench_artifacts
+    text = open(os.path.join(out_dir, "attribution.md")).read()
+    assert "MFU attribution" in text
+    assert "Forward" in text
+    assert "config: B=2 S=64" in text
+
+
+def test_perf_report_renders_and_compares(bench_artifacts):
+    out_dir, rec = bench_artifacts
+    records = perf_report.load_timeline(out_dir)
+    md = perf_report.render(records, title="smoke")
+    assert "phase breakdown" in md and "avg step" in md
+    cmp_md = perf_report.render_compare(records, records, "a", "b")
+    assert "avg step ms" in cmp_md and "+0.0%" in cmp_md
+
+
+def test_validate_record_catches_rot():
+    good = {"schema": perf_report.SCHEMA, "step": 0, "step_ms": 1.0,
+            "phases": {"Forward": 1.0}, "ops": [], "num_samples": None,
+            "mem_peak_bytes": None}
+    assert perf_report.validate_record(good) == []
+    assert perf_report.validate_record({}) != []
+    bad = dict(good, phases={"Forward": -1.0})
+    assert perf_report.validate_record(bad) != []
+    bad = dict(good, ops=[{"name": "x"}])       # missing calls/total_ms
+    assert perf_report.validate_record(bad) != []
+    bad = dict(good, schema="other.v9")
+    assert perf_report.validate_record(bad) != []

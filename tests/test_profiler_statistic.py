@@ -1,0 +1,324 @@
+"""Observability layer: auto-instrumented spans, statistic views, roofline
+attribution, step-timeline JSONL (reference: test_profiler_statistic.py)."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 TracerEventType, export_chrome_tracing,
+                                 load_profiler_result, make_scheduler)
+from paddle_tpu.profiler import statistic as stat
+
+
+# ------------------------------------------------------- scheduler edge cases
+
+def test_scheduler_repeat_expiry_stays_closed():
+    sched = make_scheduler(closed=1, record=1, repeat=2)
+    states = [sched(i) for i in range(8)]
+    assert states[1] == ProfilerState.RECORD_AND_RETURN
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    assert all(s == ProfilerState.CLOSED for s in states[4:])
+
+
+def test_scheduler_skip_first_shifts_whole_cycle():
+    sched = make_scheduler(closed=1, record=2, skip_first=3)
+    assert [sched(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+    assert sched(3) == ProfilerState.CLOSED       # cycle pos 0
+    assert sched(4) == ProfilerState.RECORD
+    assert sched(5) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_scheduler_record_1_degenerate_window():
+    # record=1, no closed/ready: EVERY step is its own flushing window
+    sched = make_scheduler(record=1, repeat=3)
+    assert [sched(i) for i in range(3)] == \
+        [ProfilerState.RECORD_AND_RETURN] * 3
+    assert sched(3) == ProfilerState.CLOSED       # repeat exhausted
+
+
+# ------------------------------------------------------- operator auto-spans
+
+def test_apply_op_emits_operator_spans_with_shapes_and_cache():
+    prof = Profiler(timer_only=True)
+    with prof:
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        y = x * 2.0
+        _ = y * 2.0          # same op identity again -> cache hit
+    ops = [e for e in prof._events if e["type"] == TracerEventType.Operator
+           and e["name"] == "multiply"]
+    assert len(ops) >= 2
+    attrs = ops[0]["attrs"]
+    assert (4, 8) in attrs["input_shapes"]
+    assert "float32" in attrs["input_dtypes"]
+    outcomes = [e["attrs"].get("cache") for e in ops]
+    assert "hit" in outcomes     # at least the repeat dispatch hit
+
+
+def test_closed_profiler_records_nothing():
+    from paddle_tpu.profiler import _tracer
+    before = len(_tracer.events)
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    _ = x + 1.0
+    assert len(_tracer.events) == before
+    assert not _tracer.enabled
+
+
+def test_communication_and_dataloader_spans():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    ds = TensorDataset([x])
+    prof = Profiler(timer_only=True)
+    with prof:
+        for (batch,) in DataLoader(ds, batch_size=4):
+            pass
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        dist.all_reduce(t)
+    comm = [e for e in prof._events
+            if e["type"] == TracerEventType.Communication]
+    dl = [e for e in prof._events
+          if e["type"] == TracerEventType.Dataloader]
+    assert comm and comm[0]["attrs"]["collective"] == "all_reduce"
+    assert comm[0]["attrs"]["payload_bytes"] == 16
+    assert len(dl) == 2          # one span per produced batch, none extra
+
+
+def test_phase_spans_backward_and_optimizer():
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    prof = Profiler(timer_only=True)
+    with prof:
+        loss = net(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    types = {e["type"] for e in prof._events}
+    assert TracerEventType.Backward in types
+    assert TracerEventType.Optimization in types
+
+
+# --------------------------------------------------- nested depth vs threads
+
+def test_nested_depth_across_threads():
+    prof = Profiler(timer_only=True)
+    barrier = threading.Barrier(2)
+
+    def work(tag):
+        barrier.wait()
+        with RecordEvent(f"outer_{tag}"):
+            with RecordEvent(f"inner_{tag}"):
+                pass
+
+    with prof:
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    by_name = {e["name"]: e for e in prof._events}
+    for tag in (0, 1):
+        outer, inner = by_name[f"outer_{tag}"], by_name[f"inner_{tag}"]
+        assert outer["depth"] == 0 and inner["depth"] == 1
+        assert outer["tid"] == inner["tid"]
+    assert by_name["outer_0"]["tid"] != by_name["outer_1"]["tid"]
+
+
+# --------------------------------------------------------- chrome trace fixes
+
+def test_chrome_trace_empty_window_exports_empty(tmp_path):
+    """An empty RECORD window must export as an empty trace — never fall
+    back to the cumulative event history (the `or prof._events` bug)."""
+    d = str(tmp_path / "trace")
+    prof = Profiler(scheduler=None, timer_only=True)
+    prof._events = [{"name": "stale", "type": "UserDefined", "tid": 1,
+                     "ts": 0, "dur": 10, "depth": 0}]
+    prof._window_events = []
+    export_chrome_tracing(d)(prof)
+    data = load_profiler_result(prof._exported_path)
+    assert data["traceEvents"] == []
+
+
+def test_chrome_trace_valid_window_scoped_with_depth_lanes(tmp_path):
+    d = str(tmp_path / "trace")
+    sched = make_scheduler(closed=1, record=1, repeat=2)
+    prof = Profiler(scheduler=sched, timer_only=True,
+                    on_trace_ready=export_chrome_tracing(d))
+    prof.start()                      # step0: CLOSED
+    with RecordEvent("closed_work"):
+        pass
+    prof.step()                       # step1: RECORD_AND_RETURN
+    with RecordEvent("outer"):
+        with RecordEvent("inner"):
+            pass
+    prof.step()                       # flush -> export
+    prof.stop()
+    data = load_profiler_result(prof._exported_path)
+    spans = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert "outer" in names and "inner" in names
+    assert "closed_work" not in names          # window-scoped
+    assert all(e["ph"] == "X" for e in spans)
+    by_name = {e["name"]: e for e in spans}
+    # depth-derived lanes: nested span rides a different tid lane
+    assert by_name["outer"]["tid"] != by_name["inner"]["tid"]
+    meta = [e for e in data["traceEvents"] if e.get("ph") == "M"]
+    assert any(m["name"] == "thread_name" for m in meta)
+    json.dumps(data)                           # round-trips as valid JSON
+
+
+# ----------------------------------------------------------- step_info(unit)
+
+def test_step_info_honors_unit_and_samples():
+    prof = Profiler(timer_only=True)
+    prof.start()
+    for _ in range(3):
+        prof.step(num_samples=32)
+    prof.stop()
+    out = prof.step_info(unit="images")
+    assert "images/s" in out and "avg step" in out
+    # throughput must reflect num_samples, not bare steps/s
+    plain = prof.step_info()
+    assert "steps/s" in plain
+
+
+# ------------------------------------------------------------ cache stat API
+
+def test_public_op_cache_stats_api():
+    import paddle_tpu.device as device
+    device.reset_op_cache_stats()
+    x = paddle.to_tensor(np.ones((3, 3), np.float32))
+    _ = x + x
+    _ = x + x
+    s = device.op_cache_stats()
+    assert s["hits"] + s["misses"] + s["bypass"] >= 2
+    assert 0.0 <= s["hit_rate"] <= 1.0
+    assert s["size"] >= 0
+    device.reset_op_cache_stats()
+    s2 = device.op_cache_stats()
+    assert s2["hits"] == s2["misses"] == s2["bypass"] == 0
+
+
+# ----------------------------------------------------- views + attribution
+
+def _eager_transformer_step():
+    paddle.seed(0)
+    net = nn.TransformerEncoderLayer(d_model=32, nhead=4,
+                                     dim_feedforward=64)
+    opt = paddle.optimizer.SGD(0.01, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(2, 8, 32).astype("float32"))
+    out = net(x)
+    loss = (out ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def test_summary_views_render(capsys):
+    prof = Profiler(timer_only=True, profile_memory=True)
+    with prof:
+        _eager_transformer_step()
+        prof.step()
+    prof.summary()
+    out = capsys.readouterr().out
+    assert "Overview Summary" in out
+    assert "Operator Summary" in out
+    assert "Memory Summary" in out
+    assert "avg step" in out
+
+
+def test_analyze_roofline_attribution_covers_compute():
+    prof = Profiler(timer_only=True)
+    with prof:
+        _eager_transformer_step()
+        prof.step()
+    rep = prof.analyze(top_k=3)
+    assert rep.rows, "no operator rows recorded"
+    # acceptance: roofline attribution covers >=90% of recorded compute
+    assert rep.coverage >= 0.9, f"coverage {rep.coverage}"
+    assert len(rep.top_gaps) == 3
+    for r in rep.top_gaps:
+        assert r["gap_ms"] is not None and r["roofline_ms"] is not None
+    matmul_rows = [r for r in rep.rows
+                   if r["flops"] and r["roofline_ms"] is not None]
+    assert matmul_rows, "no FLOP-carrying rows priced"
+    md = rep.render()
+    assert "top MFU gap contributors" in md and "roofline" in md
+
+
+def test_analyze_phase_rows_sum_to_step_time_hapi_fit(tmp_path):
+    """End-to-end: fit 3 steps under the profiler -> analyze() phases
+    account for the bulk of wall time and never exceed it."""
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import TensorDataset
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(0.05, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    rng = np.random.RandomState(0)
+    ds = TensorDataset([paddle.to_tensor(rng.rand(12, 8).astype("float32")),
+                        paddle.to_tensor(rng.rand(12, 4).astype("float32"))])
+    tl = str(tmp_path / "fit.jsonl")
+    prof = Profiler(timer_only=True, timeline=tl)
+    prof.start()
+    from paddle_tpu.io import DataLoader
+    for xb, yb in DataLoader(ds, batch_size=4):
+        model.train_batch([xb], [yb])
+        prof.step()
+    prof.stop()
+    rep = prof.analyze()
+    assert "Forward" in rep.phases and "Optimization" in rep.phases
+    phase_sum = sum(rep.phases.values())
+    assert rep.step_ms_total > 0
+    # phases are non-overlapping unions inside the step wall time
+    assert phase_sum <= rep.step_ms_total * 1.05
+    assert phase_sum >= rep.step_ms_total * 0.5, \
+        f"phases {rep.phases} vs wall {rep.step_ms_total}"
+    # the timeline JSONL recorded one schema-valid record per step
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import perf_report
+    records = perf_report.load_timeline(tl)
+    assert len(records) == 3
+    assert all(perf_report.validate_record(r) == [] for r in records)
+
+
+def test_analyze_prices_same_shape_different_closure_separately():
+    """Two `split` lambdas share a code object and input shape but close
+    over different sections — each must get its own roofline estimate,
+    and the heavyweight analyze-ref must attach once per bucket, not once
+    per dispatch."""
+    prof = Profiler(timer_only=True)
+    with prof:
+        x = paddle.to_tensor(np.ones((10, 64), np.float32))
+        for _ in range(3):
+            a, b = paddle.split(x, [2, 8])
+    split_evs = [e for e in prof._events
+                 if e["type"] == TracerEventType.Operator
+                 and "split" in e["name"]]
+    assert len(split_evs) == 6
+    variants = {(e["attrs"] or {}).get("variant") for e in split_evs}
+    assert len(variants) == 2, variants
+    assert sum(e.get("_ref") is not None for e in split_evs) == 2
+    rep = prof.analyze()
+    split_rows = [r for r in rep.rows if "split" in r["name"]]
+    assert len(split_rows) == 2
+    priced = {r["bytes"] for r in split_rows if r["bytes"] is not None}
+    assert len(priced) == 2, f"2-row and 8-row sections priced alike: {priced}"
+
+
+def test_statistic_interval_union_and_intersection():
+    a = [(0, 10), (5, 15), (20, 30)]
+    assert stat._union_ns(a) == 25
+    b = [(8, 22)]
+    assert stat._intersect_ns(a, b) == 9       # (8,15) + (20,22)
+    assert stat._intersect_ns([], b) == 0
