@@ -49,6 +49,16 @@ class DistributedStrategy:
         self.sync_batch_norm = False
         self.a_sync = False
         self.a_sync_configs = {}
+        # PS sparse-table tier selection (reference: TableParameter
+        # table_class in ps.proto). "MemorySparseTable" = in-memory striped
+        # hash (native/src/ps_table.cc); "SSDSparseTable" = disk tier
+        # (distributed/ps/disk_table.py) with ssd_path/hot_capacity/
+        # compact_ratio knobs. Consumed by
+        # PSContext.create_table_from_strategy.
+        self.sparse_table_configs = {"table_class": "MemorySparseTable",
+                                     "shard_num": 1, "ssd_path": None,
+                                     "hot_capacity": 4096,
+                                     "compact_ratio": 0.5}
         self.auto = False
         self.semi_auto = False
         self.without_graph_optimization = True

@@ -38,9 +38,37 @@ from ...core.tensor import Tensor
 __all__ = ["SparseTable", "AsyncCommunicator", "SparseEmbedding",
            "sparse_embedding", "PSContext", "shard_for", "merge_by_key",
            "PSServer", "PSClient", "DistributedSparseTable",
-           "DeviceEmbeddingCache", "CachedEmbedding"]
+           "DeviceEmbeddingCache", "CachedEmbedding",
+           "GraphTable", "DistGraphClient", "DiskSparseTable",
+           "TABLE_TYPES", "register_table_type", "make_table",
+           "PSServerError"]
 
 SparseTable = native.SparseTable
+
+# Table registry (reference: the table_class field of TableParameter in
+# ps.proto — "MemorySparseTable", "SSDSparseTable", ... resolved by name).
+# DistributedStrategy.sparse_table_configs["table_class"] selects from here;
+# DiskSparseTable registers itself at the bottom of this module.
+TABLE_TYPES = {}
+
+
+def register_table_type(name, cls):
+    TABLE_TYPES[name] = cls
+    return cls
+
+
+def make_table(dim, table_class="MemorySparseTable", rule="adagrad", lr=0.05,
+               init_range=0.01, seed=0, **table_kwargs):
+    """Instantiate a registered table type (the CreateTable dispatch of the
+    reference's PSServer). Extra kwargs go to the concrete class — e.g.
+    `path`/`hot_capacity` for SSDSparseTable."""
+    try:
+        cls = TABLE_TYPES[table_class]
+    except KeyError:
+        raise ValueError(f"unknown table_class {table_class!r}; registered: "
+                         f"{sorted(TABLE_TYPES)}") from None
+    return cls(dim, rule=rule, lr=lr, init_range=init_range, seed=seed,
+               **table_kwargs)
 
 
 def shard_for(keys, num_shards):
@@ -184,15 +212,38 @@ class PSContext:
         self._comms = {}
 
     def create_table(self, name, dim, rule="adagrad", lr=0.05,
-                     init_range=0.01, seed=0, async_push=True):
-        t = SparseTable(dim, rule=rule, lr=lr, init_range=init_range,
-                        seed=seed)
+                     init_range=0.01, seed=0, async_push=True,
+                     table_class="MemorySparseTable", **table_kwargs):
+        t = make_table(dim, table_class=table_class, rule=rule, lr=lr,
+                       init_range=init_range, seed=seed, **table_kwargs)
         self._tables[name] = t
         if async_push:
             c = AsyncCommunicator(t)
             c.start()
             self._comms[name] = c
         return t
+
+    def create_table_from_strategy(self, name, dim, strategy, **overrides):
+        """Table type + tier knobs from
+        DistributedStrategy.sparse_table_configs (reference: the
+        TableParameter block the strategy carries into TheOnePS)."""
+        cfg = dict(getattr(strategy, "sparse_table_configs", None) or {})
+        cfg.update(overrides)
+        cfg.pop("shard_num", None)   # sharding is the RPC layer's concern
+        table_class = cfg.pop("table_class", "MemorySparseTable")
+        ssd_path = cfg.pop("ssd_path", None)
+        if table_class == "SSDSparseTable":
+            if ssd_path:
+                cfg["path"] = ssd_path
+            if not cfg.get("path"):
+                raise ValueError(
+                    "sparse_table_configs['ssd_path'] must point at the "
+                    "value-log file when table_class='SSDSparseTable'")
+        else:
+            cfg.pop("path", None)
+            cfg.pop("hot_capacity", None)
+            cfg.pop("compact_ratio", None)
+        return self.create_table(name, dim, table_class=table_class, **cfg)
 
     def table(self, name):
         return self._tables[name]
@@ -244,6 +295,12 @@ class PSContext:
         self._tables.clear()
 
 
-from .rpc import DistributedSparseTable, PSClient, PSServer  # noqa: E402,F401
+from .rpc import (DistGraphClient, DistributedSparseTable,  # noqa: E402,F401
+                  PSClient, PSServer, PSServerError)
+from .graph_table import GraphTable  # noqa: E402,F401
+from .disk_table import DiskSparseTable  # noqa: E402,F401
 from .device_cache import (CachedEmbedding,  # noqa: E402,F401
                            DeviceEmbeddingCache)
+
+register_table_type("MemorySparseTable", SparseTable)
+register_table_type("SSDSparseTable", DiskSparseTable)

@@ -1,20 +1,32 @@
-"""Cross-host PS transport (VERDICT r2 missing #5).
+"""Cross-host PS transport (VERDICT r2 missing #5, graph verbs r5 #3).
 
 Reference: the brpc client/server pair
 (paddle/fluid/distributed/ps/service/brpc_ps_client.cc, brpc_ps_server.cc)
-that moves sparse keys/rows between trainer and pserver hosts.
+that moves sparse keys/rows between trainer and pserver hosts, plus the
+graph service verbs (graph_brpc_client/server.cc) behind fleet's
+DistGraphClient.
 
 TPU-native replacement: a length-prefixed binary TCP protocol around the
-native C++ table (native/src/ps_table.cc). The server is IO-bound (the
-table ops are C++); one thread per connection is plenty for the host-side
-embedding path — the device never blocks on this, pulls overlap the next
-batch via the AsyncCommunicator. Keys route to servers by `shard_for`
-(feasign % n_shards, the reference's routing).
+native C++ table (native/src/ps_table.cc) and the numpy GraphTable
+(graph_table.py). The server is IO-bound (the table ops are C++/vectorized
+numpy); one thread per connection is plenty for the host-side embedding and
+sampling paths — the device never blocks on this. Keys route to servers by
+`shard_for` (feasign % n_shards, the reference's routing); graph node ids
+route by the same rule.
 
-Wire format (little-endian):
-  request:  u8 op | u32 n | u32 dim | n*i64 keys | [n*dim*f32 grads if PUSH]
-  response: u32 n | n*dim*f32 values   (PULL)
-            u32 0                      (PUSH/PING ack)
+Wire format (little-endian; full spec in docs/ps_graph.md):
+  header:   u8 op | u32 n | u32 aux        (aux = dim for sparse ops,
+                                             sample_size k for GSAMPLE,
+                                             0 otherwise)
+  PULL:     hdr | n*i64 keys           -> u32 n | n*dim*f32 values
+  PUSH:     hdr | n*i64 | n*dim*f32    -> u32 0
+  PING/STOP hdr                        -> u32 0
+  GSAMPLE:  hdr | i32 seed | u8 weighted | u16 tlen | tlen etype | n*i64
+            -> u32 total | n*i32 counts | total*i64 neighbors
+  GFEAT:    hdr | u16 tlen | tlen ntype | n*i64
+            -> u32 feat_dim | n*feat_dim*f32
+  GDEGREE:  hdr | u16 tlen | tlen etype | n*i64
+            -> u32 n | n*i64 degrees
 """
 import socket
 import struct
@@ -23,7 +35,20 @@ import threading
 import numpy as np
 
 OP_PULL, OP_PUSH, OP_PING, OP_STOP = 0, 1, 2, 3
+OP_GSAMPLE, OP_GFEAT, OP_GDEGREE = 4, 5, 6
 _HDR = struct.Struct("<BII")
+_GS = struct.Struct("<iBH")       # seed | weighted | edge-type length
+_TL = struct.Struct("<H")         # type-name length
+_U32 = struct.Struct("<I")
+# a response whose leading u32 is the sentinel carries `u32 len | len bytes`
+# of error text instead of payload — serving errors (unknown edge type, no
+# graph on this server, bad shapes) reach the caller as PSServerError with
+# the real cause, and the connection stays usable
+_ERR = 0xFFFFFFFF
+
+
+class PSServerError(RuntimeError):
+    """A server-side serving error relayed over the wire verbatim."""
 
 
 def _recv_exact(sock, n):
@@ -39,11 +64,12 @@ def _recv_exact(sock, n):
 
 
 class PSServer:
-    """Serves one table shard over TCP. `port=0` picks a free port
-    (exposed as .port after start)."""
+    """Serves one shard — a sparse `table`, a `graph` GraphTable, or both —
+    over TCP. `port=0` picks a free port (exposed as .port after start)."""
 
-    def __init__(self, table, host="127.0.0.1", port=0):
+    def __init__(self, table=None, host="127.0.0.1", port=0, graph=None):
         self.table = table
+        self.graph = graph
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -69,31 +95,77 @@ class PSServer:
     def _serve(self, conn):
         try:
             while True:
-                op, n, dim = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                op, n, aux = _HDR.unpack(_recv_exact(conn, _HDR.size))
                 if op == OP_STOP:
                     self._stop.set()
                     try:
                         self._sock.close()
                     finally:
-                        conn.sendall(struct.pack("<I", 0))
+                        conn.sendall(_U32.pack(0))
                     return
                 if op == OP_PING:
-                    conn.sendall(struct.pack("<I", 0))
+                    conn.sendall(_U32.pack(0))
                     continue
-                keys = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
-                if op == OP_PULL:
-                    vals = self.table.pull(keys)
-                    conn.sendall(struct.pack("<I", n) + vals.tobytes())
-                elif op == OP_PUSH:
-                    grads = np.frombuffer(
-                        _recv_exact(conn, 4 * n * dim),
-                        np.float32).reshape(n, dim)
-                    self.table.push(keys, grads)
-                    conn.sendall(struct.pack("<I", 0))
+                if op in (OP_PULL, OP_PUSH):
+                    handler = self._serve_sparse
+                elif op in (OP_GSAMPLE, OP_GFEAT, OP_GDEGREE):
+                    handler = self._serve_graph
+                else:
+                    raise ConnectionError(f"unknown op {op}")
+                try:
+                    # handlers consume the FULL request body before any
+                    # table/graph work, so a serving error leaves the
+                    # stream in sync and we can answer with an error frame
+                    # instead of killing the connection
+                    resp = handler(conn, op, n, aux)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:  # noqa: BLE001 — relayed to caller
+                    msg = f"{type(e).__name__}: {e}".encode()[:65536]
+                    resp = _U32.pack(_ERR) + _U32.pack(len(msg)) + msg
+                conn.sendall(resp)
         except (ConnectionError, OSError):
             pass
         finally:
             conn.close()
+
+    def _serve_sparse(self, conn, op, n, dim):
+        keys = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+        if op == OP_PULL:
+            if self.table is None:
+                raise PSServerError("this server carries no sparse table")
+            vals = self.table.pull(keys)
+            return _U32.pack(n) + vals.tobytes()
+        grads = np.frombuffer(_recv_exact(conn, 4 * n * dim),
+                              np.float32).reshape(n, dim)
+        if self.table is None:
+            raise PSServerError("this server carries no sparse table")
+        self.table.push(keys, grads)
+        return _U32.pack(0)
+
+    def _serve_graph(self, conn, op, n, aux):
+        if op == OP_GSAMPLE:
+            seed, weighted, tlen = _GS.unpack(_recv_exact(conn, _GS.size))
+        else:
+            (tlen,) = _TL.unpack(_recv_exact(conn, _TL.size))
+        tname = _recv_exact(conn, tlen).decode() if tlen else ""
+        ids = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
+        if self.graph is None:
+            raise PSServerError("this server carries no graph table")
+        if op == OP_GSAMPLE:
+            nbrs, counts = self.graph.sample_neighbors(
+                ids, sample_size=int(aux) if aux else -1, edge_type=tname,
+                strategy="weighted" if weighted else "uniform",
+                seed=None if seed < 0 else seed)
+            return (_U32.pack(int(nbrs.size))
+                    + np.ascontiguousarray(counts, np.int32).tobytes()
+                    + np.ascontiguousarray(nbrs, np.int64).tobytes())
+        if op == OP_GFEAT:
+            rows = self.graph.pull_features(ids, node_type=tname)
+            return (_U32.pack(rows.shape[1])
+                    + np.ascontiguousarray(rows, np.float32).tobytes())
+        deg = self.graph.node_degree(ids, edge_type=tname)
+        return _U32.pack(n) + np.ascontiguousarray(deg, np.int64).tobytes()
 
     def shutdown(self):
         self._stop.set()
@@ -103,14 +175,14 @@ class PSServer:
             pass
 
 
-class PSClient:
-    """Routes pull/push over the shard servers (reference: brpc_ps_client's
-    per-shard request fan-out). Thread-safe per-endpoint via one lock each
-    (requests are serialized per connection, pipelined across shards)."""
+class ShardClientBase:
+    """Per-endpoint connection pool shared by the sparse and graph clients:
+    one lazy socket + lock per shard server (requests serialized per
+    connection, pipelined across shards), framing-desync recovery by
+    dropping a half-consumed socket."""
 
-    def __init__(self, endpoints, dim):
+    def __init__(self, endpoints):
         self.endpoints = list(endpoints)
-        self.dim = int(dim)
         self._socks = [None] * len(self.endpoints)
         self._locks = [threading.Lock() for _ in self.endpoints]
 
@@ -122,20 +194,16 @@ class PSClient:
             self._socks[i] = s
         return self._socks[i]
 
-    def _request(self, i, op, keys, grads=None):
+    def _exchange(self, i, msg, reader):
+        """Send one framed request to shard i, parse the reply with
+        `reader(sock)` under the per-shard lock."""
         with self._locks[i]:
             try:
                 s = self._sock(i)
-                msg = _HDR.pack(op, keys.size, self.dim) + keys.tobytes()
-                if grads is not None:
-                    msg += grads.tobytes()
                 s.sendall(msg)
-                (n,) = struct.unpack("<I", _recv_exact(s, 4))
-                if op == OP_PULL:
-                    return np.frombuffer(
-                        _recv_exact(s, 4 * n * self.dim),
-                        np.float32).reshape(n, self.dim)
-                return None
+                return reader(s)
+            except PSServerError:
+                raise   # error frame fully consumed: stream still in sync
             except Exception:
                 # a half-consumed socket would desynchronize the framing for
                 # every later request: drop it so the next call reconnects
@@ -150,8 +218,55 @@ class PSClient:
     def _route(self, keys):
         from . import shard_for
         keys = np.ascontiguousarray(keys, np.int64).reshape(-1)
-        owner = shard_for(keys, len(self.endpoints))
-        return keys, owner
+        return keys, shard_for(keys, len(self.endpoints))
+
+    def _ack(self, s):
+        (n,) = _U32.unpack(_recv_exact(s, 4))
+        if n == _ERR:
+            (ln,) = _U32.unpack(_recv_exact(s, 4))
+            raise PSServerError(_recv_exact(s, ln).decode())
+        return n
+
+    def ping(self):
+        for i in range(len(self.endpoints)):
+            self._exchange(i, _HDR.pack(OP_PING, 0, 0), self._ack)
+        return True
+
+    def stop_servers(self):
+        for i in range(len(self.endpoints)):
+            try:
+                self._exchange(i, _HDR.pack(OP_STOP, 0, 0), self._ack)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                s.close()
+        self._socks = [None] * len(self.endpoints)
+
+
+class PSClient(ShardClientBase):
+    """Routes sparse pull/push over the shard servers (reference:
+    brpc_ps_client's per-shard request fan-out)."""
+
+    def __init__(self, endpoints, dim):
+        super().__init__(endpoints)
+        self.dim = int(dim)
+
+    def _request(self, i, op, keys, grads=None):
+        msg = _HDR.pack(op, keys.size, self.dim) + keys.tobytes()
+        if grads is not None:
+            msg += grads.tobytes()
+
+        def reader(s):
+            n = self._ack(s)
+            if op == OP_PULL:
+                return np.frombuffer(_recv_exact(s, 4 * n * self.dim),
+                                     np.float32).reshape(n, self.dim)
+            return None
+
+        return self._exchange(i, msg, reader)
 
     def pull(self, keys):
         keys, owner = self._route(keys)
@@ -172,23 +287,106 @@ class PSClient:
                 self._request(i, OP_PUSH, np.ascontiguousarray(keys[m]),
                               np.ascontiguousarray(grads[m]))
 
-    def ping(self):
-        for i in range(len(self.endpoints)):
-            self._request(i, OP_PING, np.empty(0, np.int64))
-        return True
 
-    def stop_servers(self):
-        for i in range(len(self.endpoints)):
-            try:
-                self._request(i, OP_STOP, np.empty(0, np.int64))
-            except (ConnectionError, OSError):
-                pass
+class DistGraphClient(ShardClientBase):
+    """Client half of the distributed GraphTable (reference: fleet
+    DistGraphClient over graph_brpc_client.cc): node ids route to their
+    owner shard, per-shard results reassemble into query order. This object
+    is accepted directly by `paddle_tpu.geometric.sample_neighbors` /
+    `incubate.operators.graph_sample_neighbors` in place of the local
+    (row, colptr) CSC pair."""
 
-    def close(self):
-        for s in self._socks:
-            if s is not None:
-                s.close()
-        self._socks = [None] * len(self.endpoints)
+    def sample_neighbors(self, ids, sample_size=-1, edge_type="",
+                         strategy="uniform", seed=None):
+        """(neighbors int64 concat in query order, counts int32)."""
+        ids, owner = self._route(np.asarray(
+            ids.numpy() if hasattr(ids, "numpy") else ids))
+        counts = np.zeros(ids.size, np.int32)
+        per_node = [None] * ids.size
+        k = 0 if sample_size is None or sample_size <= 0 else int(sample_size)
+        for i in range(len(self.endpoints)):
+            m = owner == i
+            if not m.any():
+                continue
+            sub = np.ascontiguousarray(ids[m])
+            # decorrelate shards under an explicit seed, keep determinism
+            sseed = -1 if seed is None else (int(seed) + i) % (2 ** 31)
+            msg = (_HDR.pack(OP_GSAMPLE, sub.size, k)
+                   + _GS.pack(sseed, 1 if strategy == "weighted" else 0,
+                              len(edge_type.encode()))
+                   + edge_type.encode() + sub.tobytes())
+
+            def reader(s, nsub=sub.size):
+                total = self._ack(s)
+                cnts = np.frombuffer(_recv_exact(s, 4 * nsub), np.int32)
+                nbrs = np.frombuffer(_recv_exact(s, 8 * total), np.int64)
+                return cnts, nbrs
+            cnts, nbrs = self._exchange(i, msg, reader)
+            pos = np.nonzero(m)[0]
+            parts = np.split(nbrs, np.cumsum(cnts)[:-1]) if cnts.size else []
+            for p, c, part in zip(pos, cnts, parts):
+                counts[p] = c
+                per_node[p] = part
+        chunks = [p for p in per_node if p is not None and p.size]
+        neighbors = np.concatenate(chunks) if chunks else np.zeros(0, np.int64)
+        return neighbors, counts
+
+    def pull_features(self, ids, node_type=""):
+        """(n, feat_dim) float32 rows in query order. A shard with no rows
+        for the node type answers feat_dim=0 and its nodes come back zero
+        (partial feature loads never crash serving); shards that DO hold
+        rows must agree on the dim."""
+        ids, owner = self._route(np.asarray(
+            ids.numpy() if hasattr(ids, "numpy") else ids))
+        shard_rows = []
+        fd = 0
+        for i in range(len(self.endpoints)):
+            m = owner == i
+            if not m.any():
+                continue
+            sub = np.ascontiguousarray(ids[m])
+            msg = (_HDR.pack(OP_GFEAT, sub.size, 0)
+                   + _TL.pack(len(node_type.encode()))
+                   + node_type.encode() + sub.tobytes())
+
+            def reader(s, nsub=sub.size):
+                d = self._ack(s)
+                return np.frombuffer(_recv_exact(s, 4 * nsub * d),
+                                     np.float32).reshape(nsub, d)
+            rows = self._exchange(i, msg, reader)
+            if rows.shape[1]:
+                if fd and rows.shape[1] != fd:
+                    raise ValueError(
+                        f"graph shards disagree on feature dim for node "
+                        f"type {node_type!r}: {fd} vs {rows.shape[1]}")
+                fd = rows.shape[1]
+            shard_rows.append((m, rows))
+        out = np.zeros((ids.size, fd), np.float32)
+        for m, rows in shard_rows:
+            if rows.shape[1]:
+                out[m] = rows
+        return out
+
+    def node_degree(self, ids, edge_type=""):
+        """Out-degree per queried node (int64), resolved on the owner
+        shard."""
+        ids, owner = self._route(np.asarray(
+            ids.numpy() if hasattr(ids, "numpy") else ids))
+        out = np.zeros(ids.size, np.int64)
+        for i in range(len(self.endpoints)):
+            m = owner == i
+            if not m.any():
+                continue
+            sub = np.ascontiguousarray(ids[m])
+            msg = (_HDR.pack(OP_GDEGREE, sub.size, 0)
+                   + _TL.pack(len(edge_type.encode()))
+                   + edge_type.encode() + sub.tobytes())
+
+            def reader(s, nsub=sub.size):
+                n = self._ack(s)
+                return np.frombuffer(_recv_exact(s, 8 * n), np.int64)
+            out[m] = self._exchange(i, msg, reader)
+        return out
 
 
 class DistributedSparseTable:

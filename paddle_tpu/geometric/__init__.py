@@ -117,7 +117,12 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
                      return_eids=False, perm_buffer=None, name=None):
     """reference: geometric/sampling/neighbors.py sample_neighbors —
-    same op as incubate.graph_sample_neighbors."""
+    same op as incubate.graph_sample_neighbors.
+
+    Distributed path: pass a `distributed.ps.DistGraphClient` (or a local
+    `GraphTable`) as `row` with `colptr=None` and sampling runs server-side
+    on the node-id-sharded GraphTable; returns the same (neighbors, counts)
+    Tensors as the local CSC path."""
     from ..incubate.operators import graph_sample_neighbors
     return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
                                   sample_size=sample_size,

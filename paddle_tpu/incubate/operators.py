@@ -34,7 +34,20 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
                            name=None):
     """Uniform neighbor sampling over a CSC graph (reference:
     graph_sample_neighbors.py; kernel phi/kernels/gpu/
-    graph_sample_neighbors_kernel.cu). Host-side numpy sampling."""
+    graph_sample_neighbors_kernel.cu). Host-side numpy sampling.
+
+    When `row` is a distributed graph handle — a
+    `distributed.ps.DistGraphClient` over sharded graph servers, or a local
+    `distributed.ps.GraphTable` shard — sampling is served by the graph
+    store (`colptr` is ignored; pass None)."""
+    if hasattr(row, "sample_neighbors") and not isinstance(row, Tensor):
+        if return_eids:
+            raise ValueError(
+                "return_eids is not supported on the distributed GraphTable "
+                "path: edge ids are not tracked by the sharded store")
+        nb, cnt = row.sample_neighbors(input_nodes, sample_size=sample_size)
+        return (Tensor(jnp.asarray(np.ascontiguousarray(nb, np.int64))),
+                Tensor(jnp.asarray(np.ascontiguousarray(cnt, np.int32))))
     rown, colp, nodes = _np(row), _np(colptr), _np(input_nodes).reshape(-1)
     # np.random's GLOBAL stream: each call draws a fresh sample and
     # np.random.seed / paddle.seed-driven pipelines stay reproducible

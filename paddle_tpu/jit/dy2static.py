@@ -73,6 +73,10 @@ class _Undefined:
     __bool__ = __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = _die
     __rmul__ = __truediv__ = __getitem__ = __call__ = __iter__ = _die
     __neg__ = __lt__ = __le__ = __gt__ = __ge__ = _die
+    # eq/ne/hash must die too: object defaults would let `x == y` silently
+    # return an identity bool (and `x in {...}` hash) instead of the curated
+    # read-before-assignment error
+    __eq__ = __ne__ = __hash__ = _die
 
 
 UNDEF = _Undefined()

@@ -97,6 +97,7 @@ def _declare(lib):
         "ptn_pstable_assign": (None, [P, ctypes.POINTER(I64), I64,
                                       ctypes.POINTER(ctypes.c_float),
                                       ctypes.POINTER(ctypes.c_float)]),
+        "ptn_pstable_erase": (None, [P, ctypes.POINTER(I64), I64]),
         "ptn_pstable_size": (I64, [P]),
         "ptn_pstable_save": (I32, [P, S]),
         "ptn_pstable_load": (I32, [P, S]),
@@ -368,6 +369,12 @@ class SparseTable:
         _lib.ptn_pstable_assign(
             self._h, kp, arr.size,
             v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), sp)
+
+    def erase(self, keys):
+        """Drop rows entirely (SSD-tier hot-cache eviction): erased keys
+        re-init deterministically on next pull unless reloaded first."""
+        arr, kp = self._keys_ptr(keys)
+        _lib.ptn_pstable_erase(self._h, kp, arr.size)
 
     def __len__(self):
         return int(_lib.ptn_pstable_size(self._h))

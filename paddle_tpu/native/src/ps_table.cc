@@ -30,6 +30,7 @@ struct Shard {
   std::mutex mu;
   std::unordered_map<int64_t, size_t> index;  // key -> row offset
   std::vector<float> rows;                    // row_width per entry
+  std::vector<int64_t> slot_keys;             // key at rows offset i*row_width
 };
 
 struct Table {
@@ -63,6 +64,7 @@ struct Table {
     for (int i = 0; i < dim; i++) r[i] = u(gen);
     for (int i = dim; i < row_width(); i++) r[i] = 0.f;
     s.index.emplace(key, off);
+    s.slot_keys.push_back(key);
     return r;
   }
 };
@@ -169,6 +171,31 @@ void ptn_pstable_assign(void* tp, const int64_t* keys, int64_t n,
     memcpy(r, vals + i * t->dim, t->dim * sizeof(float));
     if (state != nullptr && t->slot > 0)
       memcpy(r + t->dim, state + i * t->slot, t->slot * sizeof(float));
+  }
+}
+
+// Remove rows (for the SSD tier's LRU hot-cache eviction: spilled rows
+// leave the in-memory table so hot capacity is a real bound). Swap-remove:
+// the last row fills the hole, O(1) per key via the slot_keys back-map.
+void ptn_pstable_erase(void* tp, const int64_t* keys, int64_t n) {
+  auto* t = (Table*)tp;
+  const int w = t->row_width();
+  for (int64_t i = 0; i < n; i++) {
+    Shard& s = t->shard_of(keys[i]);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.index.find(keys[i]);
+    if (it == s.index.end()) continue;
+    size_t off = it->second;
+    size_t last = s.rows.size() - w;
+    if (off != last) {
+      memcpy(s.rows.data() + off, s.rows.data() + last, w * sizeof(float));
+      int64_t moved = s.slot_keys.back();
+      s.slot_keys[off / w] = moved;
+      s.index[moved] = off;
+    }
+    s.rows.resize(last);
+    s.slot_keys.pop_back();
+    s.index.erase(it);
   }
 }
 

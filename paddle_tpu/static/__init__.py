@@ -259,12 +259,23 @@ class Executor:
                 # legacy artifact without names: natural sort
                 # (input_10 after input_2)
                 import re as _re
+                import warnings as _warnings
 
                 def _key(k):
                     m = _re.search(r"(\d+)$", k)
                     return (k[:m.start()], int(m.group(1))) if m else (k, -1)
 
                 ordered = sorted(feed.keys(), key=_key)
+                _warnings.warn(
+                    f"Executor.run: artifact "
+                    f"{type(program).__name__!r} was saved without feed "
+                    f"names (_feed_names); feeds are being bound by "
+                    f"NATURAL-SORTED key order {ordered} — a silent "
+                    f"reorder hazard if your feed names do not sort like "
+                    f"the original input order. Re-export the model with "
+                    f"paddle.jit.save (which records input names) to get "
+                    f"exact-name matching.",
+                    DeprecationWarning, stacklevel=2)
             args = [Tensor(jnp.asarray(np.asarray(feed[k])))
                     for k in ordered]
             out = program(*args)

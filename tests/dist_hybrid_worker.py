@@ -93,6 +93,14 @@ def main():
     mesh2 = Mesh(arr2.reshape(plan2.dp, 1, 1, 1, plan2.mp), AXES)
     run(plan2, mesh2, "dp4_mp2_mp_cross")
 
+    # plan 3: dp4 x sharding2 with the ZeRO-2 SHARDING axis crossing the
+    # boundary — each reduce-scatter/all-gather pair {devs[d], devs[d+4]}
+    # spans both processes (sharding is the slowest-varying axis)
+    plan3 = MeshPlan(dp=4, sharding=2)
+    arr3 = devs.reshape(plan3.sharding, plan3.dp).transpose(1, 0)
+    mesh3 = Mesh(arr3.reshape(plan3.dp, 1, plan3.sharding, 1, 1), AXES)
+    run(plan3, mesh3, "dp4_sharding2_sharding_cross")
+
     with open(out_path, "w") as f:
         json.dump(results, f)
 

@@ -1,0 +1,70 @@
+"""Forked graph-shard server for tests/test_graph_table.py.
+
+One process = one PS graph shard (the reference's graph pserver role,
+common_graph_table behind graph_brpc_server). Every worker builds the SAME
+deterministic demo graph and keeps only its node stripe (GraphTable filters
+by the `node % num_shards` sharding rule internally), so the parent needs
+to ship no data — just fork, read back the endpoint, and sample.
+
+Invoked as: graph_ps_worker.py <shard_id> <num_shards> <endpoint_file>
+Port is OS-assigned (bind port 0) and published atomically through
+<endpoint_file>; the server runs until a client sends OP_STOP.
+"""
+import os
+import sys
+import time
+
+
+def build_demo_shard(shard_id, num_shards, n_nodes=32, seed=7):
+    """Two-community graph with node features that encode the community:
+    nodes [0, n/2) are class 0, the rest class 1; each node gets 6
+    intra-community edges (weight 1.0) and 1 cross edge (weight 0.1), so
+    weighted sampling prefers same-community neighbors and a 1-layer GNN
+    over sampled neighborhoods is learnable. Identical on every shard —
+    GraphTable keeps the owned stripe."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps import GraphTable
+
+    rng = np.random.RandomState(seed)
+    half = n_nodes // 2
+    src, dst, w = [], [], []
+    for u in range(n_nodes):
+        comm = u // half
+        peers = rng.choice(np.arange(comm * half, (comm + 1) * half),
+                           size=6, replace=False)
+        for v in peers:
+            src.append(u)
+            dst.append(int(v))
+            w.append(1.0)
+        other = rng.randint((1 - comm) * half, (2 - comm) * half)
+        src.append(u)
+        dst.append(int(other))
+        w.append(0.1)
+    labels = (np.arange(n_nodes) // half).astype(np.int64)
+    feats = (labels[:, None] * 2.0 - 1.0) * np.ones((n_nodes, 8)) \
+        + rng.randn(n_nodes, 8) * 0.3
+    g = GraphTable(shard_id=shard_id, num_shards=num_shards, seed=seed)
+    g.add_edges(src, dst, weights=w)
+    g.set_node_features(np.arange(n_nodes), feats.astype(np.float32))
+    g.build()
+    return g, labels
+
+
+def main():
+    shard_id, num_shards, ep_file = (int(sys.argv[1]), int(sys.argv[2]),
+                                     sys.argv[3])
+    from paddle_tpu.distributed.ps import PSServer
+
+    graph, _ = build_demo_shard(shard_id, num_shards)
+    server = PSServer(graph=graph)
+    tmp = ep_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(server.endpoint)
+    os.replace(tmp, ep_file)            # atomic publish
+    while not server._stop.is_set():
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,3 +94,28 @@ def test_in_process_tuning_wins_over_disk(clean_cache):
     clean_cache()
     autotune.record_flash_blocks(16, 1024, 64, True, (128, 128))
     assert autotune.lookup_flash_blocks(8, 16, 1024, 64, True) == (128, 128)
+
+
+def test_shipped_table_is_committed_or_reported():
+    """Tie the PERF_NOTES shipped-table promise to the tree (ISSUE 2
+    satellite / VERDICT r5 weak #3): this flips green the moment an
+    on-chip sweep commits ops/pallas/flash_blocks_tuned.json; until then
+    it skips WITH the reason, so the gap is visible in every run instead
+    of drifting silently."""
+    import paddle_tpu.ops as ops_pkg
+    path = os.path.join(os.path.dirname(ops_pkg.__file__), "pallas",
+                        "flash_blocks_tuned.json")
+    if not os.path.exists(path):
+        pytest.skip(
+            "ops/pallas/flash_blocks_tuned.json is NOT committed yet — "
+            "docs/PERF_NOTES.md promises a shipped flash-block table once "
+            "an on-chip sweep runs (tools/profile_step.py); the shipped "
+            "autotune tier is serving nothing")
+    with open(path) as f:
+        data = json.load(f)
+    assert data, "shipped table exists but is empty"
+    for key, blocks in data.items():
+        parsed = json.loads(key)          # JSON-list keys, like the cache
+        assert isinstance(parsed, list) and len(parsed) in (5, 6)
+        bq, bkv = blocks
+        assert bq > 0 and bkv > 0 and bq % 8 == 0 and bkv % 8 == 0

@@ -537,3 +537,28 @@ def test_program_translator_disable():
             st(_t([1.0]))      # plain tracing: tracer-bool error
     finally:
         ProgramTranslator.get_instance().enable(True)
+
+
+# ------------------------------------------------- undefined-local equality
+def test_undefined_local_eq_hash_curated_error():
+    """`==`/`!=`/hash on a local that is unbound when tensor-dependent
+    control flow starts must raise the curated read-before-assignment
+    error — object-identity defaults used to silently return a bool
+    (ISSUE 2 satellite)."""
+    def fn(x):
+        if x.sum() > 0:
+            y = x + 1
+        else:
+            if y == 3:                  # y compared before any assignment
+                y = x
+            y = x - 1
+        return y
+
+    with pytest.raises(Dy2StaticError, match="read before assignment"):
+        to_static(fn)(_t([1.0]))
+
+    from paddle_tpu.jit.dy2static import UNDEF
+    for bad in (lambda: UNDEF == 3, lambda: UNDEF != 3, lambda: hash(UNDEF),
+                lambda: UNDEF in {1: "a"}, lambda: 3 == UNDEF):
+        with pytest.raises(Dy2StaticError, match="read before assignment"):
+            bad()

@@ -1,0 +1,352 @@
+"""Distributed GraphTable: sharded CSR store, server-side sampling verbs,
+and the DistGraphClient path behind geometric.sample_neighbors.
+
+Mirrors the reference's graph-engine suites (test_graph_node.py /
+dist_graph tests over common_graph_table + graph_brpc service): unit tests
+run against in-process shards; the multi-process tests fork 2 REAL server
+processes (the dist-test pattern of test_multiprocess_dist.py: forked
+workers, OS-assigned ports published through files, hard timeouts) and
+train a small GNN off the sharded graph — the acceptance path of ISSUE 2.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import geometric
+from paddle_tpu.distributed.ps import (DistGraphClient, GraphTable, PSServer,
+                                       PSServerError, shard_for)
+from graph_ps_worker import build_demo_shard
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "graph_ps_worker.py")
+
+
+def _toy_graph(num_shards=1, shard_id=0):
+    g = GraphTable(shard_id=shard_id, num_shards=num_shards)
+    src = [0, 0, 0, 1, 1, 2]
+    dst = [1, 2, 3, 0, 2, 0]
+    g.add_edges(src, dst, weights=[1.0, 1.0, 8.0, 1.0, 1.0, 1.0])
+    g.set_node_features(np.arange(4),
+                        np.arange(12, dtype=np.float32).reshape(4, 3))
+    g.build()
+    return g
+
+
+# ---------------------------------------------------------------- local unit
+def test_build_degree_and_features():
+    g = _toy_graph()
+    np.testing.assert_array_equal(g.node_degree([0, 1, 2, 3]), [3, 2, 1, 0])
+    np.testing.assert_allclose(g.pull_features([2, 0]),
+                               [[6, 7, 8], [0, 1, 2]])
+    # unknown node: zero features, zero degree — never a crash
+    assert g.node_degree([99])[0] == 0
+    np.testing.assert_allclose(g.pull_features([99]), [[0, 0, 0]])
+    assert g.num_edges() == 6
+
+
+def test_sample_uniform_without_replacement():
+    g = _toy_graph()
+    nbrs, cnts = g.sample_neighbors([0, 1, 3], sample_size=2, seed=11)
+    np.testing.assert_array_equal(cnts, [2, 2, 0])
+    a, b = np.split(nbrs, np.cumsum(cnts)[:-1])[:2]
+    assert set(a) <= {1, 2, 3} and len(set(a)) == 2   # no replacement
+    assert set(b) <= {0, 2} and len(set(b)) == 2
+    # sample_size <= 0 means the full neighbor list
+    all_nb, all_cnt = g.sample_neighbors([0], sample_size=-1)
+    np.testing.assert_array_equal(sorted(all_nb), [1, 2, 3])
+    np.testing.assert_array_equal(all_cnt, [3])
+
+
+def test_sample_weighted_biases_toward_heavy_edges():
+    g = _toy_graph()   # edge 0->3 carries weight 8 of 10
+    hits = sum(g.sample_neighbors([0], 1, strategy="weighted", seed=s)[0][0]
+               == 3 for s in range(100))
+    assert hits > 60, f"weighted sampling not biased: {hits}/100"
+    uni = sum(g.sample_neighbors([0], 1, strategy="uniform", seed=s)[0][0]
+              == 3 for s in range(100))
+    assert uni < 60, f"uniform sampling biased: {uni}/100"
+
+
+def test_typed_edges_and_typed_features():
+    g = GraphTable()
+    g.add_edges([0, 1], [1, 0], edge_type="follows")
+    g.add_edges([0, 0], [10, 11], edge_type="buys")
+    g.set_node_features([10, 11], np.ones((2, 2), np.float32),
+                        node_type="item")
+    g.build()
+    assert g.edge_types() == ["buys", "follows"]
+    np.testing.assert_array_equal(g.node_degree([0], "buys"), [2])
+    np.testing.assert_array_equal(g.node_degree([0], "follows"), [1])
+    np.testing.assert_allclose(g.pull_features([10], node_type="item"),
+                               [[1, 1]])
+    with pytest.raises(KeyError, match="unknown edge type"):
+        g.sample_neighbors([0], 1, edge_type="rates")
+
+
+def test_incremental_add_edges_after_build():
+    """add_edges after build() must KEEP the already-built edges of that
+    type (they fold back into the rebuild), not silently drop them."""
+    g = GraphTable()
+    g.add_edges([0], [1], weights=[1.0])
+    g.build()
+    g.add_edges([0, 2], [5, 6], weights=[1.0, 1.0])
+    g.build()
+    nbrs, cnts = g.sample_neighbors([0, 2], sample_size=-1)
+    np.testing.assert_array_equal(cnts, [2, 1])
+    assert set(nbrs[:2]) == {1, 5} and nbrs[2] == 6
+
+
+def test_mixed_weighted_unweighted_chunks_is_loud():
+    """One chunk with weights + one without would silently degrade
+    weighted sampling to uniform — must raise at build()."""
+    g = GraphTable()
+    g.add_edges([0], [1], weights=[2.0])
+    g.add_edges([0], [2])                   # forgot weights
+    with pytest.raises(ValueError, match="some add_edges calls passed"):
+        g.build()
+
+
+def test_shards_partition_by_node_id():
+    """Feeding the full edge list to every shard keeps disjoint stripes
+    whose union is the whole graph (the shard-oblivious loader contract)."""
+    full = _toy_graph()
+    shards = [_toy_graph(num_shards=2, shard_id=i) for i in range(2)]
+    for node in range(4):
+        owner = int(shard_for([node], 2)[0])
+        np.testing.assert_array_equal(
+            shards[owner].node_degree([node]), full.node_degree([node]))
+        np.testing.assert_array_equal(
+            shards[1 - owner].node_degree([node]), [0])
+        np.testing.assert_allclose(
+            shards[owner].pull_features([node]), full.pull_features([node]))
+
+
+# ---------------------------------------------------------- RPC, in-process
+@pytest.fixture
+def graph_cluster_inproc():
+    shards = [_toy_graph(num_shards=2, shard_id=i) for i in range(2)]
+    servers = [PSServer(graph=s) for s in shards]
+    client = DistGraphClient([s.endpoint for s in servers])
+    yield client
+    client.close()
+    for s in servers:
+        s.shutdown()
+
+
+def test_rpc_sample_matches_local(graph_cluster_inproc):
+    client = graph_cluster_inproc
+    full = _toy_graph()
+    nbrs, cnts = client.sample_neighbors([0, 1, 2, 3], sample_size=-1)
+    np.testing.assert_array_equal(cnts, full.node_degree([0, 1, 2, 3]))
+    parts = np.split(nbrs, np.cumsum(cnts)[:-1])
+    lnbrs, lcnts = full.sample_neighbors([0, 1, 2, 3], sample_size=-1)
+    lparts = np.split(lnbrs, np.cumsum(lcnts)[:-1])
+    for p, lp in zip(parts, lparts):
+        assert set(p) == set(lp)
+
+
+def test_rpc_sample_deterministic_under_seed(graph_cluster_inproc):
+    client = graph_cluster_inproc
+    a = client.sample_neighbors([0, 1, 2], 2, seed=5)
+    b = client.sample_neighbors([0, 1, 2], 2, seed=5)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_rpc_features_and_degree_route_by_owner(graph_cluster_inproc):
+    client = graph_cluster_inproc
+    np.testing.assert_allclose(client.pull_features(np.arange(4)),
+                               np.arange(12, dtype=np.float32).reshape(4, 3))
+    np.testing.assert_array_equal(client.node_degree([3, 2, 1, 0]),
+                                  [0, 1, 2, 3])
+
+
+def test_geometric_sample_neighbors_accepts_graph_handles(
+        graph_cluster_inproc):
+    """geometric.sample_neighbors / incubate graph_sample_neighbors route
+    through a DistGraphClient (and a local GraphTable) in place of the
+    (row, colptr) CSC pair."""
+    client = graph_cluster_inproc
+    nb, cnt = geometric.sample_neighbors(client, None,
+                                         paddle.to_tensor([0, 1]),
+                                         sample_size=2)
+    assert int(cnt.numpy().sum()) == int(nb.shape[0]) == 4
+    # local-table handle works the same way
+    nb2, cnt2 = geometric.sample_neighbors(_toy_graph(), None, [0, 1],
+                                           sample_size=2)
+    assert int(cnt2.numpy().sum()) == int(nb2.shape[0]) == 4
+    with pytest.raises(ValueError, match="return_eids"):
+        geometric.sample_neighbors(client, None, [0], sample_size=1,
+                                   return_eids=True)
+
+
+def test_server_errors_relay_without_killing_the_connection(
+        graph_cluster_inproc):
+    """A serving error (unknown edge type) comes back as PSServerError
+    carrying the real cause, and the SAME connection keeps serving."""
+    client = graph_cluster_inproc
+    with pytest.raises(PSServerError, match="unknown edge type 'rates'"):
+        client.sample_neighbors([0], 1, edge_type="rates")
+    # stream stayed in sync: the next request on the same socket works
+    np.testing.assert_array_equal(client.node_degree([0]), [3])
+
+
+def test_graph_verb_to_sparse_only_server_is_loud():
+    from paddle_tpu import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    from paddle_tpu.distributed.ps import SparseTable
+    table = SparseTable(4, rule="sgd", lr=1.0)
+    server = PSServer(table=table)
+    client = DistGraphClient([server.endpoint])
+    try:
+        with pytest.raises(PSServerError, match="no graph table"):
+            client.node_degree([0])
+    finally:
+        client.close()
+        server.shutdown()
+        table.destroy()
+
+
+def test_pull_features_with_featureless_shard():
+    """A shard holding no rows for the node type answers feat_dim=0; its
+    nodes come back zero instead of crashing the reassembly."""
+    shards = [_toy_graph(num_shards=2, shard_id=i) for i in range(2)]
+    bare = GraphTable(shard_id=1, num_shards=2)
+    bare.add_edges([1], [0])
+    bare.build()                            # shard 1: edges, NO features
+    servers = [PSServer(graph=shards[0]), PSServer(graph=bare)]
+    client = DistGraphClient([s.endpoint for s in servers])
+    try:
+        rows = client.pull_features(np.arange(4))
+        np.testing.assert_allclose(
+            rows[::2], np.arange(12, dtype=np.float32).reshape(4, 3)[::2])
+        np.testing.assert_allclose(rows[1::2], 0.0)   # odd ids: bare shard
+    finally:
+        client.close()
+        for s in servers:
+            s.shutdown()
+
+
+def test_one_server_can_serve_sparse_and_graph():
+    from paddle_tpu import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    from paddle_tpu.distributed.ps import PSClient, SparseTable
+    table = SparseTable(4, rule="sgd", lr=1.0)
+    server = PSServer(table=table, graph=_toy_graph())
+    sparse = PSClient([server.endpoint], 4)
+    graph = DistGraphClient([server.endpoint])
+    try:
+        before = sparse.pull(np.array([1, 2], np.int64))
+        sparse.push(np.array([1, 2], np.int64), np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(sparse.pull(np.array([1, 2], np.int64)),
+                                   before - 1.0, rtol=1e-5)
+        np.testing.assert_array_equal(graph.node_degree([0]), [3])
+    finally:
+        sparse.close()
+        graph.stop_servers()
+        table.destroy()
+
+
+# ------------------------------------------------- forked server processes
+def _scrubbed_env():
+    env = dict(os.environ)
+    for k in list(env):
+        if (k.startswith(("TPU_", "LIBTPU", "PJRT_", "AXON_", "PALLAS_AXON_"))
+                or k in ("JAX_PLATFORM_NAME", "XLA_FLAGS", "JAX_PLATFORMS")):
+            env.pop(k)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(HERE)
+    return env
+
+
+@pytest.fixture(scope="module")
+def forked_graph_cluster(tmp_path_factory):
+    """2 REAL graph-server processes, endpoints published through files
+    (OS-assigned ports — the dist-test pattern, no port races)."""
+    tmpdir = str(tmp_path_factory.mktemp("graph_ps"))
+    nshard = 2
+    ep_files = [os.path.join(tmpdir, f"ep_{i}") for i in range(nshard)]
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), str(nshard), ep_files[i]],
+        env=_scrubbed_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(nshard)]
+    endpoints = []
+    try:
+        deadline = time.time() + 120
+        for i, ep in enumerate(ep_files):
+            while not os.path.exists(ep):
+                if procs[i].poll() is not None:
+                    _, err = procs[i].communicate()
+                    raise RuntimeError(f"graph worker {i} died:\n{err[-4000:]}")
+                if time.time() > deadline:
+                    raise TimeoutError(f"graph worker {i} never published "
+                                       f"its endpoint")
+                time.sleep(0.05)
+            with open(ep) as f:
+                endpoints.append(f.read().strip())
+        client = DistGraphClient(endpoints)
+        client.ping()
+        yield client
+    finally:
+        try:
+            client.stop_servers()
+            client.close()
+        except Exception:
+            pass
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_forked_cluster_serves_the_sharded_graph(forked_graph_cluster):
+    client = forked_graph_cluster
+    full, _ = build_demo_shard(0, 1)       # unsharded golden
+    ids = np.arange(32)
+    np.testing.assert_array_equal(client.node_degree(ids),
+                                  full.node_degree(ids))
+    np.testing.assert_allclose(client.pull_features(ids),
+                               full.pull_features(ids), rtol=1e-6)
+    nbrs, cnts = client.sample_neighbors(ids, sample_size=-1)
+    np.testing.assert_array_equal(cnts, full.node_degree(ids))
+
+
+def test_gnn_trains_over_sharded_graph(forked_graph_cluster):
+    """ISSUE 2 acceptance: a small GNN trains via
+    geometric.sample_neighbors against 2 real graph-server processes —
+    mean-aggregated sampled-neighbor features + self features through a
+    linear head learn the community label."""
+    client = forked_graph_cluster
+    _, labels = build_demo_shard(0, 1)
+    head = nn.Linear(16, 2)
+    opt = paddle.optimizer.Adam(5e-2, parameters=head.parameters())
+    lf = nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+
+    losses = []
+    for step in range(12):
+        batch = rng.choice(32, size=16, replace=False)
+        nb, cnt = geometric.sample_neighbors(client, None, batch,
+                                             sample_size=4)
+        cnt_np = cnt.numpy()
+        assert (cnt_np > 0).all()          # demo graph: min out-degree 7
+        x_self = paddle.to_tensor(client.pull_features(batch))
+        x_nb = paddle.to_tensor(client.pull_features(nb.numpy()))
+        seg = np.repeat(np.arange(batch.size), cnt_np)
+        agg = geometric.segment_mean(x_nb, paddle.to_tensor(seg))
+        h = paddle.concat([x_self, agg], axis=-1)
+        loss = lf(head(h), paddle.to_tensor(labels[batch]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses

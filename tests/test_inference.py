@@ -278,3 +278,32 @@ def test_quantized_deploy_roundtrip(tmp_path):
     pred.run()
     got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_executor_legacy_feed_fallback_warns_loudly(tmp_path):
+    """An artifact saved WITHOUT feed names falls back to natural-sorted
+    feed keys — that silent-reorder hazard must now announce itself with a
+    DeprecationWarning naming the artifact and the assumption (ISSUE 2
+    satellite)."""
+    import warnings
+
+    net = _small_net()
+    path = str(tmp_path / "legacy")
+    paddle.static.save_inference_model(
+        path, [InputSpec([None, 8], "float32")], net)
+    prog, feeds, fetches = paddle.static.load_inference_model(path)
+    exe = paddle.static.Executor()
+    x = np.random.RandomState(4).rand(3, 8).astype("float32")
+
+    # modern artifact: exact-name matching, NO deprecation chatter
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        want = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches)[0]
+
+    # legacy artifact (pre-feed-names save): loud, named fallback
+    prog._feed_names = None
+    with pytest.warns(DeprecationWarning,
+                      match="NATURAL-SORTED.*TranslatedLayer"
+                            "|TranslatedLayer.*NATURAL-SORTED"):
+        got = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(got, want)

@@ -137,3 +137,17 @@ def test_hybrid_mp_across_process_boundary(hybrid_runs):
         np.testing.assert_allclose(r["dp4_mp2_mp_cross"],
                                    golden["dp4_mp2_mp_cross"], rtol=1e-5)
     assert golden["dp4_mp2_mp_cross"][-1] < golden["dp4_mp2_mp_cross"][0]
+
+
+def test_hybrid_sharding_across_process_boundary(hybrid_runs):
+    """dp4 x sharding2 (ZeRO-2) with each sharding pair split across the
+    two processes: the grad reduce-scatter and param all-gather cross the
+    host boundary every step; 3-step losses must match the 1-process
+    golden."""
+    golden, two = hybrid_runs
+    for r in two:
+        np.testing.assert_allclose(r["dp4_sharding2_sharding_cross"],
+                                   golden["dp4_sharding2_sharding_cross"],
+                                   rtol=1e-5)
+    assert golden["dp4_sharding2_sharding_cross"][-1] < \
+        golden["dp4_sharding2_sharding_cross"][0]
