@@ -330,7 +330,58 @@ def _parse_args(argv):
                    help="artifact directory for --profile")
     p.add_argument("--steps", type=int, default=None,
                    help="override the number of timed train steps")
+    p.add_argument("--decode", action="store_true",
+                   help="decode-throughput rung: steady-state tokens/sec "
+                        "through the serving engine's single decode "
+                        "executable instead of the train ladder")
     return p.parse_args(argv)
+
+
+def run_decode_bench(on_tpu, n_steps=None):
+    """Serving-engine decode rung: S slots advance one token per step
+    through the one compiled decode executable; reports steady-state
+    decode tokens/sec (warmup excluded) plus the compile-once counters.
+    Model/size come from BENCH_DECODE_* envs so the CI smoke can shrink it."""
+    import jax
+
+    import paddle_tpu  # noqa: F401  (registers the framework)
+    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.text.models import gpt_125m, gpt_tiny
+
+    model_name = os.environ.get("BENCH_DECODE_MODEL",
+                                "gpt_125m" if on_tpu else "gpt_tiny")
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", 8 if on_tpu else 2))
+    max_len = int(os.environ.get("BENCH_DECODE_MAXLEN",
+                                 1024 if on_tpu else 64))
+    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT",
+                                    128 if on_tpu else 8))
+    steps = n_steps or int(os.environ.get("BENCH_DECODE_STEPS",
+                                          64 if on_tpu else 8))
+    model = {"gpt_125m": gpt_125m, "gpt_tiny": gpt_tiny}[model_name]()
+    model.eval()
+    engine = GenerationEngine(model, slots=slots, max_len=max_len)
+    rng = np.random.RandomState(0)
+    for s in range(slots):
+        engine.prefill(s, rng.randint(0, model.cfg.vocab_size, prompt_len))
+    engine.decode()                     # compile + warm the decode step
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        last = engine.decode()
+    _ = int(last[0])                    # host sync: data-dependent fetch
+    dt = time.perf_counter() - t0
+    tok_s = slots * steps / dt
+    return {
+        "value": tok_s,
+        "vs_baseline": 0.0,             # first decode rung IS the baseline
+        "extra": {"metric_name": "decode_tokens_per_s",
+                  "model": model_name, "slots": slots, "max_len": max_len,
+                  "prompt_len": prompt_len, "steps": steps,
+                  "step_ms": round(1000 * dt / steps, 2),
+                  "trace_counts": {
+                      "decode": engine.trace_counts["decode"],
+                      "prefill": dict(engine.trace_counts["prefill"])},
+                  "backend": jax.default_backend()},
+    }
 
 
 def main(argv=None):
@@ -347,6 +398,19 @@ def main(argv=None):
     import jax
     assert jax.default_backend() == backend
     wd.cancel()
+
+    if args.decode:
+        global METRIC, UNIT
+        METRIC, UNIT = "gpt_decode_tokens_per_s", "decode tokens/sec"
+        wd = start_watchdog(float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
+                            "decode rung")
+        try:
+            result = run_decode_bench(on_tpu, n_steps=args.steps)
+            emit(result["value"], result["vs_baseline"],
+                 extra=result["extra"])
+        finally:
+            wd.cancel()
+        return
 
     n_steps = args.steps if args.steps is not None else \
         int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))

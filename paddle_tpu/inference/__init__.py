@@ -241,6 +241,53 @@ class Predictor:
     def get_output_handle(self, name):
         return self._outputs[name]
 
+    # -- generation entry point (serving/) ----------------------------------
+    def _generation_scheduler(self, **engine_kwargs):
+        """Lazily build the serving engine + scheduler from the `.gencfg`
+        sidecar `serving.save_for_generation` wrote next to the artifact.
+        The params already loaded for the one-shot path are reused — one
+        weight copy serves both run() and generate()."""
+        if getattr(self, "_gen_sched", None) is not None:
+            return self._gen_sched
+        from ..serving.engine import load_generation_model
+        model = load_generation_model(self._config.prog_file(), self._params)
+        if model is None:
+            raise RuntimeError(
+                "this artifact has no generation sidecar; save it with "
+                "paddle_tpu.serving.save_for_generation to enable "
+                "Predictor.generate")
+        from ..serving import GenerationEngine, Scheduler
+        sched_keys = ("max_queue", "default_max_new_tokens",
+                      "default_timeout_s", "metrics_path")
+        sched_kwargs = {k: engine_kwargs.pop(k) for k in sched_keys
+                        if k in engine_kwargs}
+        engine = GenerationEngine(model, **engine_kwargs)
+        self._gen_sched = Scheduler(engine, **sched_kwargs)
+        return self._gen_sched
+
+    def generate(self, input_ids, max_new_tokens=32, **engine_kwargs):
+        """Generate continuations for a batch of prompts (list of
+        token-id lists, or a [B, S] int array) through the continuous-
+        batching engine. Returns list-of-lists of generated ids.
+        Engine/scheduler knobs (slots, max_len, decode_strategy,
+        temperature, top_k, top_p, eos_token_id, max_queue, ...) pass
+        through on the FIRST call; later calls reuse the built engine."""
+        from ..serving import QueueFullError
+        prompts = [list(map(int, np.asarray(p).reshape(-1)))
+                   for p in input_ids]
+        sched = self._generation_scheduler(**engine_kwargs)
+        handles = []
+        for p in prompts:
+            while True:
+                try:
+                    handles.append(sched.submit(
+                        p, max_new_tokens=max_new_tokens))
+                    break
+                except QueueFullError:
+                    sched.step()   # drain a slot's worth, then retry
+        sched.run_until_idle()
+        return [h.tokens for h in handles]
+
     def clear_intermediate_tensor(self):
         pass
 

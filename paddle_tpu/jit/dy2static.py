@@ -36,8 +36,8 @@ from ..core.tensor import Tensor
 __all__ = [
     "Dy2StaticError", "convert_to_static", "convert_call",
     "convert_ifelse", "convert_while", "convert_for", "convert_logical_and",
-    "convert_logical_or", "convert_logical_not", "maybe_range",
-    "assert_not_traced", "ld",
+    "convert_logical_or", "convert_logical_not", "convert_list_append",
+    "maybe_range", "assert_not_traced", "ld",
 ]
 
 
@@ -142,6 +142,91 @@ def assert_not_traced(value, construct):
 
 
 # --------------------------------------------------------------------------
+# early-return support: the generated flag/value slot names, plus the UNDEF
+# materialization that lets the value slot ride an XLA carry before any
+# return has executed (reference: return_transformer.py's RETURN_NO_VALUE
+# placeholder — here the placeholder adopts the real return value's aval,
+# discovered by abstract evaluation, so carries stay shape-stable)
+# --------------------------------------------------------------------------
+_RET_FLAG = "__dy2s_ret0"
+_RET_VALUE = "__dy2s_rv0"
+
+
+def _friendly(names):
+    """Generated return-slot names -> readable tags in error messages."""
+    return ["<return value>" if n == _RET_VALUE else
+            "<return flag>" if n == _RET_FLAG else n for n in names]
+
+
+def _materialize_rv(names, vals, probe_fns):
+    """For each generated return-value slot still UNDEF on entry to a
+    tensor-dependent construct, abstractly evaluate the arms/body to find
+    the aval the slot gets on the returning path and substitute zeros of
+    that aval. Sound ONLY for the generated slot: every read is guarded by
+    the return flag, so the placeholder is unobservable — user locals keep
+    the curated read-before-assignment error instead."""
+    vals = list(vals)
+    idxs = [i for i, n in enumerate(names)
+            if n == _RET_VALUE and isinstance(vals[i], _Undefined)]
+    if not idxs:
+        return vals
+    for fn in probe_fns:
+        def probe(ops):
+            out = fn(*_wrap_like(list(ops), vals))
+            return _unwrap_tree(list(out))
+        try:
+            outs = jax.eval_shape(probe, _unwrap_tree(list(vals)))
+        except Exception:                                    # noqa: BLE001
+            continue          # the real lowering will name the problem
+        for i in list(idxs):
+            o = outs[i] if i < len(outs) else None
+            if o is not None and not isinstance(o, _Undefined) \
+                    and hasattr(o, "shape"):
+                vals[i] = Tensor(jnp.zeros(o.shape, o.dtype))
+                idxs.remove(i)
+        if not idxs:
+            break
+    return vals
+
+
+# depth counter: >0 exactly while a loop body/cond is being traced for
+# lax.while_loop / fori_loop / scan (single-threaded: tracing is)
+_lax_loop_depth = 0
+
+
+class _lax_loop_scope:
+    def __enter__(self):
+        global _lax_loop_depth
+        _lax_loop_depth += 1
+
+    def __exit__(self, *exc):
+        global _lax_loop_depth
+        _lax_loop_depth -= 1
+        return False
+
+
+def convert_list_append(seq, item):
+    """`x.append(item)` rewritten by the transpiler. A python list cannot
+    ride an XLA loop carry (its length is structure, not data), so an
+    append reached while a tensor-dependent loop is being lowered gets the
+    curated error; everywhere else — eager code, unrolled concrete-bound
+    loops — it is a plain append."""
+    if isinstance(seq, list):
+        if _lax_loop_depth > 0:
+            raise Dy2StaticError(
+                "dy2static: list mutation (list.append) inside a "
+                "tensor-dependent loop cannot be lowered to XLA control "
+                "flow — a loop carry needs a fixed structure, and appending "
+                "changes the list's length every iteration. Preallocate a "
+                "tensor and index-assign into it, or collect values with "
+                "paddle.concat/stack outside the loop")
+        return seq.append(item)
+    # custom objects: .append is an ordinary method call — keep the
+    # recursive convert_call treatment the generic rewrite would have given
+    return convert_call(seq.append)(item)
+
+
+# --------------------------------------------------------------------------
 # runtime converters (reference: dy2static/convert_operators.py)
 # --------------------------------------------------------------------------
 def convert_ifelse(pred, true_fn, false_fn, names, vals):
@@ -154,6 +239,7 @@ def convert_ifelse(pred, true_fn, false_fn, names, vals):
         fn = true_fn if _scalar_bool(pred) else false_fn
         return fn(*vals)
 
+    vals = _materialize_rv(names, vals, (true_fn, false_fn))
     operands = _unwrap_tree(list(vals))
 
     def arm(fn):
@@ -168,7 +254,7 @@ def convert_ifelse(pred, true_fn, false_fn, names, vals):
     except TypeError as e:
         raise Dy2StaticError(
             f"dy2static: the two branches of a tensor-dependent 'if' "
-            f"produced mismatched values for locals {list(names)} "
+            f"produced mismatched values for locals {_friendly(names)} "
             f"(each branch must leave every assigned local with the same "
             f"shape/dtype; a local assigned on only one branch stays "
             f"<undefined> on the other): {e}") from None
@@ -243,30 +329,32 @@ def _dtype_fixpoint(raw_body, init):
 
 
 def _lax_while(cond_fn, body_fn, names, vals):
-    init = [jnp.asarray(d) if not isinstance(d, _Undefined) else d
-            for d in _unwrap_tree(vals)]
-    # strip weak types so body outputs can be cast to a stable aval
-    init = [jax.lax.convert_element_type(d, d.dtype)
-            if not isinstance(d, _Undefined) else d for d in init]
-    init = _dtype_fixpoint(
-        lambda carry: tuple(_unwrap_tree(list(
-            body_fn(*_wrap_like(list(carry), vals))))), init)
+    with _lax_loop_scope():
+        vals = _materialize_rv(names, vals, (body_fn,))
+        init = [jnp.asarray(d) if not isinstance(d, _Undefined) else d
+                for d in _unwrap_tree(vals)]
+        # strip weak types so body outputs can be cast to a stable aval
+        init = [jax.lax.convert_element_type(d, d.dtype)
+                if not isinstance(d, _Undefined) else d for d in init]
+        init = _dtype_fixpoint(
+            lambda carry: tuple(_unwrap_tree(list(
+                body_fn(*_wrap_like(list(carry), vals))))), init)
 
-    def c(carry):
-        out = cond_fn(*_wrap_like(list(carry), vals))
-        return jnp.reshape(_raw(out), ())
+        def c(carry):
+            out = cond_fn(*_wrap_like(list(carry), vals))
+            return jnp.reshape(_raw(out), ())
 
-    def b(carry):
-        out = body_fn(*_wrap_like(list(carry), vals))
-        return tuple(_match_carry(_unwrap_tree(list(out)), carry, names))
+        def b(carry):
+            out = body_fn(*_wrap_like(list(carry), vals))
+            return tuple(_match_carry(_unwrap_tree(list(out)), carry, names))
 
-    try:
-        final = jax.lax.while_loop(c, b, tuple(init))
-    except TypeError as e:
-        raise Dy2StaticError(
-            f"dy2static: tensor-dependent 'while' could not be lowered "
-            f"(carried locals {list(names)} must keep a fixed "
-            f"shape/dtype/structure across iterations): {e}") from None
+        try:
+            final = jax.lax.while_loop(c, b, tuple(init))
+        except TypeError as e:
+            raise Dy2StaticError(
+                f"dy2static: tensor-dependent 'while' could not be lowered "
+                f"(carried locals {_friendly(names)} must keep a fixed "
+                f"shape/dtype/structure across iterations): {e}") from None
     return tuple(_wrap_like(list(final), vals))
 
 
@@ -307,34 +395,40 @@ def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
 
     if isinstance(iterable, _TracedRange):
         r = iterable
-        n = jnp.maximum(0, -(-(jnp.asarray(r.stop) - r.start) // r.step))
-        init = tuple(_match_carry(_unwrap_tree(list(vals)),
-                                  _unwrap_tree(list(vals)), names))
-        init = tuple(_dtype_fixpoint(
-            lambda carry: tuple(_unwrap_tree(list(body_fn(
-                Tensor(jnp.asarray(r.start)),
-                *_wrap_like(list(carry), list(vals)))))[1:]), list(init)))
-        # target slot rides the carry so body reassignments of it leak;
-        # zero-trip edge leaks `start` (documented divergence from python's
-        # keep-old-value, which an XLA carry cannot express)
-        t0 = jnp.asarray(r.start)
+        with _lax_loop_scope():
+            n = jnp.maximum(0, -(-(jnp.asarray(r.stop) - r.start) // r.step))
+            vals = tuple(_materialize_rv(
+                names, list(vals),
+                (lambda *c: list(body_fn(Tensor(jnp.asarray(r.start)),
+                                         *c))[1:],)))
+            init = tuple(_match_carry(_unwrap_tree(list(vals)),
+                                      _unwrap_tree(list(vals)), names))
+            init = tuple(_dtype_fixpoint(
+                lambda carry: tuple(_unwrap_tree(list(body_fn(
+                    Tensor(jnp.asarray(r.start)),
+                    *_wrap_like(list(carry), list(vals)))))[1:]), list(init)))
+            # target slot rides the carry so body reassignments of it leak;
+            # zero-trip edge leaks `start` (documented divergence from
+            # python's keep-old-value, which an XLA carry cannot express)
+            t0 = jnp.asarray(r.start)
 
-        def b(k, carry):
-            tslot, rest = carry[0], carry[1:]
-            i = jnp.asarray(r.start) + k * jnp.asarray(r.step)
-            out = body_fn(Tensor(i), *_wrap_like(list(rest), list(vals)))
-            tlast, crest = split(_unwrap_tree(list(out)))
-            return (jax.lax.convert_element_type(jnp.asarray(tlast),
-                                                 tslot.dtype),) + \
-                tuple(_match_carry(list(crest), rest, names))
+            def b(k, carry):
+                tslot, rest = carry[0], carry[1:]
+                i = jnp.asarray(r.start) + k * jnp.asarray(r.step)
+                out = body_fn(Tensor(i), *_wrap_like(list(rest), list(vals)))
+                tlast, crest = split(_unwrap_tree(list(out)))
+                return (jax.lax.convert_element_type(jnp.asarray(tlast),
+                                                     tslot.dtype),) + \
+                    tuple(_match_carry(list(crest), rest, names))
 
-        try:
-            final = jax.lax.fori_loop(0, n, b, (t0,) + init)
-        except TypeError as e:
-            raise Dy2StaticError(
-                f"dy2static: tensor-dependent 'for' over range could not be "
-                f"lowered (carried locals {list(names)} must keep a fixed "
-                f"shape/dtype/structure across iterations): {e}") from None
+            try:
+                final = jax.lax.fori_loop(0, n, b, (t0,) + init)
+            except TypeError as e:
+                raise Dy2StaticError(
+                    f"dy2static: tensor-dependent 'for' over range could "
+                    f"not be lowered (carried locals {_friendly(names)} "
+                    f"must keep a fixed shape/dtype/structure across "
+                    f"iterations): {e}") from None
         return (Tensor(final[0]),) + tuple(
             _wrap_like(list(final[1:]), list(vals)))
 
@@ -344,25 +438,32 @@ def convert_for(iterable, body_fn, names, vals, tgt0=UNDEF):
         if xs.ndim == 0:
             raise Dy2StaticError(
                 "dy2static: cannot iterate a 0-d tensor in a traced 'for'")
-        init = tuple(_match_carry(_unwrap_tree(list(vals)),
-                                  _unwrap_tree(list(vals)), names))
-        init = tuple(_dtype_fixpoint(
-            lambda carry: tuple(_unwrap_tree(list(body_fn(
-                Tensor(xs[0]), *_wrap_like(list(carry), list(vals)))))[1:]),
-            list(init)))
+        with _lax_loop_scope():
+            vals = tuple(_materialize_rv(
+                names, list(vals),
+                (lambda *c: list(body_fn(Tensor(xs[0]), *c))[1:],)))
+            init = tuple(_match_carry(_unwrap_tree(list(vals)),
+                                      _unwrap_tree(list(vals)), names))
+            init = tuple(_dtype_fixpoint(
+                lambda carry: tuple(_unwrap_tree(list(body_fn(
+                    Tensor(xs[0]),
+                    *_wrap_like(list(carry), list(vals)))))[1:]),
+                list(init)))
 
-        def step(carry, row):
-            out = body_fn(Tensor(row), *_wrap_like(list(carry), list(vals)))
-            tlast, crest = split(_unwrap_tree(list(out)))
-            return tuple(_match_carry(list(crest), carry, names)), tlast
+            def step(carry, row):
+                out = body_fn(Tensor(row),
+                              *_wrap_like(list(carry), list(vals)))
+                tlast, crest = split(_unwrap_tree(list(out)))
+                return tuple(_match_carry(list(crest), carry, names)), tlast
 
-        try:
-            final, t_hist = jax.lax.scan(step, init, xs)
-        except TypeError as e:
-            raise Dy2StaticError(
-                f"dy2static: tensor-dependent 'for' over a tensor could not "
-                f"be lowered (carried locals {list(names)} must keep a fixed "
-                f"shape/dtype/structure across iterations): {e}") from None
+            try:
+                final, t_hist = jax.lax.scan(step, init, xs)
+            except TypeError as e:
+                raise Dy2StaticError(
+                    f"dy2static: tensor-dependent 'for' over a tensor could "
+                    f"not be lowered (carried locals {_friendly(names)} "
+                    f"must keep a fixed shape/dtype/structure across "
+                    f"iterations): {e}") from None
         last = Tensor(jax.tree.map(lambda h: h[-1], t_hist)) \
             if xs.shape[0] else tgt0
         return (last,) + tuple(_wrap_like(list(final), list(vals)))
@@ -630,6 +731,87 @@ def _sets_flag(nodes, brk, cont):
 
 
 # --------------------------------------------------------------------------
+# pass 0: early returns -> return flag + value slot
+# (reference: return_transformer.py / early_return_transformer.py)
+# --------------------------------------------------------------------------
+class _EarlyReturnLowering:
+    """``return expr`` inside an if/loop becomes ``__dy2s_rv0 = expr;
+    __dy2s_ret0 = True`` (plus ``break`` inside loops, which pass 1 then
+    lowers through the existing flag machinery); statements that may run
+    after a conditional return are guarded by the flag, and the function
+    ends with one ``return __dy2s_rv0``. Statically-dead continuations
+    (both if-arms return) are dropped so the tracer never has to select
+    between a return value and nothing."""
+
+    def transform(self, body):
+        if not self._has_construct_return(body):
+            return body
+        body = list(body)
+        if not body or not isinstance(body[-1], ast.Return):
+            body.append(ast.Return(value=ast.Constant(None)))
+        out, _may, _always = self._block(body, in_loop=False)
+        return ([self._assign(_RET_FLAG, ast.Constant(False))] + out +
+                [ast.Return(value=_name(_RET_VALUE))])
+
+    @staticmethod
+    def _has_construct_return(body):
+        return any(isinstance(s, (ast.If, ast.For, ast.While))
+                   and _has([s], ast.Return) for s in body)
+
+    @staticmethod
+    def _assign(name, value):
+        return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+    def _block(self, stmts, in_loop):
+        """Returns (new_stmts, may_return, always_returns)."""
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(self._assign(_RET_VALUE,
+                                        s.value or ast.Constant(None)))
+                out.append(self._assign(_RET_FLAG, ast.Constant(True)))
+                if in_loop:
+                    out.append(ast.Break())
+                return out, True, True      # rest is unreachable
+            if isinstance(s, ast.If) and _has([s], ast.Return):
+                nb, m1, a1 = self._block(s.body, in_loop)
+                no, m2, a2 = (self._block(s.orelse, in_loop)
+                              if s.orelse else ([], False, False))
+                out.append(ast.If(test=s.test, body=nb, orelse=no))
+                if a1 and a2:
+                    return out, True, True  # every path returned
+                if m1 or m2:
+                    return self._guard_rest(out, stmts[i + 1:], in_loop)
+                continue
+            if isinstance(s, (ast.For, ast.While)) and _has([s], ast.Return):
+                nb, _m, _a = self._block(s.body, True)
+                if isinstance(s, ast.While):
+                    out.append(ast.While(test=s.test, body=nb,
+                                         orelse=s.orelse))
+                else:
+                    out.append(ast.For(target=s.target, iter=s.iter,
+                                       body=nb, orelse=s.orelse))
+                return self._guard_rest(out, stmts[i + 1:], in_loop)
+            out.append(s)
+        return out, False, False
+
+    def _guard_rest(self, out, rest_stmts, in_loop):
+        """After a construct that may have returned: inside a loop, break
+        out (pass 1 turns it into the carry flag); at function level, run
+        the continuation only when the flag is still False."""
+        rest, _mr, ar = (self._block(rest_stmts, in_loop)
+                         if rest_stmts else ([], False, False))
+        if in_loop:
+            out.append(ast.If(test=_name(_RET_FLAG), body=[ast.Break()],
+                              orelse=rest))
+        else:
+            out.append(ast.If(
+                test=ast.UnaryOp(op=ast.Not(), operand=_name(_RET_FLAG)),
+                body=rest or [ast.Pass()], orelse=[]))
+        return out, True, ar
+
+
+# --------------------------------------------------------------------------
 # pass 1: break/continue -> flag variables + guards
 # (reference: break_continue_transformer.py)
 # --------------------------------------------------------------------------
@@ -789,6 +971,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                                 "isinstance", "enumerate",
                                                 "zip"):
             return node
+        if isinstance(f, ast.Attribute) and f.attr == "append" \
+                and len(node.args) == 1 and not node.keywords:
+            # route through the list-mutation guard: curated error when a
+            # python list is appended inside a lax-lowered loop body
+            return _jst("convert_list_append", f.value, node.args[0])
         node.func = _jst("convert_call", f)
         return node
 
@@ -999,6 +1186,7 @@ def convert_to_static(fn):
 
 
 def _apply_passes(body):
+    body = _EarlyReturnLowering().transform(body)
     holder = ast.Module(body=body, type_ignores=[])
     holder = _BreakContinueLowering().visit(holder)
     holder = _ControlFlowTransformer().visit(holder)

@@ -3,17 +3,21 @@
 The attention core routes through F.scaled_dot_product_attention, which uses
 the Pallas flash-attention kernel when eligible — replacing the reference's
 fused_attention_op.cu CUDA path.
+
+Decode caching comes in two flavours:
+  - the reference's growing `Cache` (concat one token per step) — kept for
+    API parity, but every step changes the cache shape, so XLA recompiles
+    per generated token;
+  - `StaticDecodeCache` (serving/kv_cache.py) — preallocated buffers
+    written via dynamic_update_slice at a per-slot position, so the decode
+    step keeps one set of avals and compiles once. This is the path the
+    serving engine uses (docs/serving.md).
 """
 import collections
 
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor, apply_op
-
-
-def _np_dtype_of(t):
-    d = getattr(t, "dtype", None)
-    return d if d is not None else jnp.float32
 from .. import functional as F
 from .common import Dropout, Linear
 from .container import LayerList
@@ -21,23 +25,43 @@ from .layers import Layer
 from .norm import LayerNorm
 
 
+def _np_dtype_of(t):
+    d = getattr(t, "dtype", None)
+    return d if d is not None else jnp.float32
+
+
 def _convert_attention_mask(attn_mask, dtype):
+    """Bool masks become additive float masks (True = keep); float masks
+    pass through in the compute dtype."""
     if attn_mask is None:
         return None
-    if attn_mask.dtype == jnp.bool_:
-        return apply_op(
-            lambda m: jnp.where(m, 0.0, jnp.finfo(jnp.float32).min).astype(dtype),
-            attn_mask)
-    return attn_mask.astype(dtype)
+    if attn_mask.dtype != jnp.bool_:
+        return attn_mask.astype(dtype)
+    neg = jnp.finfo(jnp.float32).min
+    return apply_op(
+        lambda m: jnp.where(m, 0.0, neg).astype(dtype), attn_mask)
+
+
+def _sublayer(x, norm, pre_norm, dropout, fn):
+    """One residual sublayer in either norm convention: pre-norm runs the
+    LayerNorm on the way in, post-norm on the way out (reference keeps the
+    same two orderings inline in every forward; here the wiring lives
+    once)."""
+    y = fn(norm(x) if pre_norm else x)
+    y = x + dropout(y)
+    return y if pre_norm else norm(y)
 
 
 class MultiHeadAttention(Layer):
-    """reference: nn/layer/transformer.py MultiHeadAttention, incl. the
-    Cache/StaticCache protocol for autoregressive decode (gen_cache +
-    (out, new_cache) returns when a cache is passed)."""
+    """reference: nn/layer/transformer.py MultiHeadAttention. Cache
+    protocol: gen_cache() -> Cache/StaticCache, and forward returns
+    (out, new_cache) whenever a cache is passed. StaticDecodeCache is the
+    TPU-native third type (fixed-shape decode, see module docstring)."""
 
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    StaticDecodeCache = collections.namedtuple(
+        "StaticDecodeCache", ["k", "v", "pos"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None):
@@ -54,57 +78,80 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    def _heads(self, t, proj):
+        """Project and split into [batch, seq, heads, head_dim]."""
+        return proj(t).reshape(
+            [t.shape[0], -1, self.num_heads, self.head_dim])
+
     def _kv(self, key, value):
-        B = key.shape[0]
-        k = self.k_proj(key).reshape([B, -1, self.num_heads, self.head_dim])
-        v = self.v_proj(value).reshape([B, -1, self.num_heads, self.head_dim])
-        return k, v
+        return self._heads(key, self.k_proj), self._heads(value, self.v_proj)
 
     def gen_cache(self, key, value=None, type=None):
-        """reference MultiHeadAttention.gen_cache: type=StaticCache projects
-        (key, value) once for cross-attention; the DEFAULT type is Cache —
-        with value given it seeds a GROWING cache from pre-projected k/v
-        (UniLM-style prefix, no re-projection); value=None gives an empty
-        growing Cache."""
+        """reference MultiHeadAttention.gen_cache semantics: StaticCache
+        projects (key, value) once for cross-attention; the default Cache
+        either seeds a growing cache from pre-projected k/v (UniLM-style
+        prefix) or starts empty when value is None."""
         if type is self.StaticCache:
-            k, v = self._kv(key, value if value is not None else key)
-            return self.StaticCache(k, v)
+            return self.StaticCache(*self._kv(key, value if value is not None
+                                              else key))
         if value is not None:
             return self.Cache(key, value)   # pre-projected k/v seed
-        B = key.shape[0]
-        import jax.numpy as jnp
-        from ...core.tensor import Tensor
-        empty = Tensor(jnp.zeros((B, 0, self.num_heads, self.head_dim),
-                                 _np_dtype_of(key)))
+        empty = Tensor(jnp.zeros(
+            (key.shape[0], 0, self.num_heads, self.head_dim),
+            _np_dtype_of(key)))
         return self.Cache(empty, empty)
+
+    def gen_static_decode_cache(self, batch, max_len, dtype=None):
+        """Preallocated fixed-shape decode cache: [batch, max_len, heads,
+        head_dim] zeros + per-slot positions at 0."""
+        from ...serving import kv_cache as _kvc
+        raw = _kvc.alloc_kv(batch, max_len, self.num_heads, self.head_dim,
+                            dtype or _np_dtype_of(self.k_proj.weight))
+        return self.StaticDecodeCache(
+            Tensor(raw.k), Tensor(raw.v),
+            Tensor(jnp.zeros((batch,), jnp.int32)))
+
+    def _decode_step(self, q, key, value, cache):
+        """Static-cache path: write the incoming tokens' k/v at each
+        slot's position, attend over the full buffer under the position
+        mask (attn_mask is implied by the positions — causal within the
+        written prefix)."""
+        from ...serving import kv_cache as _kvc
+        k_new, v_new = self._kv(key, value)
+        k_buf = apply_op(_kvc.write, cache.k, k_new, cache.pos)
+        v_buf = apply_op(_kvc.write, cache.v, v_new, cache.pos)
+        ctx = apply_op(_kvc.attend, q, k_buf, v_buf, cache.pos)
+        out = self.out_proj(ctx.reshape([q.shape[0], -1, self.embed_dim]))
+        return out, self.StaticDecodeCache(k_buf, v_buf,
+                                           cache.pos + q.shape[1])
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
-        B = query.shape[0]
-        q = self.q_proj(query).reshape([B, -1, self.num_heads, self.head_dim])
-        new_cache = None
+        q = self._heads(query, self.q_proj)
+
+        if isinstance(cache, self.StaticDecodeCache):
+            return self._decode_step(q, key, value, cache)
+
         if isinstance(cache, self.StaticCache):
-            k, v = cache.k, cache.v
-            new_cache = cache          # reference returns (out, cache) for
-                                       # EVERY non-None cache, static too
+            # cross-attention: k/v were projected once at gen_cache time.
+            # Like the reference, EVERY non-None cache round-trips.
+            k, v, out_cache = cache.k, cache.v, cache
         elif isinstance(cache, self.Cache):
-            k_new, v_new = self._kv(key, value)
             from ...tensor.manipulation import concat
-            k = concat([cache.k, k_new], axis=1)
-            v = concat([cache.v, v_new], axis=1)
-            new_cache = self.Cache(k, v)
+            fresh = self._kv(key, value)
+            k = concat([cache.k, fresh[0]], axis=1)
+            v = concat([cache.v, fresh[1]], axis=1)
+            out_cache = self.Cache(k, v)
         else:
             k, v = self._kv(key, value)
-        mask = _convert_attention_mask(attn_mask, q.dtype)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=mask, dropout_p=self.dropout,
-            training=self.training)
-        out = out.reshape([B, -1, self.embed_dim])
-        out = self.out_proj(out)
-        if new_cache is not None:
-            return out, new_cache
-        return out
+            out_cache = None
+
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=_convert_attention_mask(attn_mask, q.dtype),
+            dropout_p=self.dropout, training=self.training)
+        out = self.out_proj(ctx.reshape([query.shape[0], -1, self.embed_dim]))
+        return out if out_cache is None else (out, out_cache)
 
 
 class TransformerEncoderLayer(Layer):
@@ -127,30 +174,23 @@ class TransformerEncoderLayer(Layer):
         self.dropout_act = Dropout(act_dropout)
         self.activation = getattr(F, activation)
 
+    def _ffn(self, h):
+        return self.linear2(self.dropout_act(self.activation(self.linear1(h))))
+
     def forward(self, src, src_mask=None, cache=None):
-        residual = src
-        if self.normalize_before:
-            src = self.norm1(src)
-        src = self.self_attn(src, src, src, src_mask)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
-        residual = src
-        if self.normalize_before:
-            src = self.norm2(src)
-        src = self.linear2(self.dropout_act(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
-        return src
+        pre = self.normalize_before
+        src = _sublayer(src, self.norm1, pre, self.dropout1,
+                        lambda h: self.self_attn(h, h, h, src_mask))
+        return _sublayer(src, self.norm2, pre, self.dropout2, self._ffn)
 
 
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None):
         super().__init__()
         import copy
-        self.layers = LayerList([encoder_layer] +
-                                [copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+        self.layers = LayerList(
+            [encoder_layer] + [copy.deepcopy(encoder_layer)
+                               for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
 
@@ -158,9 +198,7 @@ class TransformerEncoder(Layer):
         out = src
         for layer in self.layers:
             out = layer(out, src_mask)
-        if self.norm is not None:
-            out = self.norm(out)
-        return out
+        return out if self.norm is None else self.norm(out)
 
 
 class TransformerDecoderLayer(Layer):
@@ -172,9 +210,11 @@ class TransformerDecoderLayer(Layer):
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
-                                            weight_attr=weight_attr, bias_attr=bias_attr)
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
         self.cross_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
-                                             weight_attr=weight_attr, bias_attr=bias_attr)
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
         self.norm1 = LayerNorm(d_model)
@@ -186,37 +226,26 @@ class TransformerDecoderLayer(Layer):
         self.dropout_act = Dropout(act_dropout)
         self.activation = getattr(F, activation)
 
+    def _ffn(self, h):
+        return self.linear2(self.dropout_act(self.activation(self.linear1(h))))
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
-        tgt = residual + self.dropout1(tgt)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
-        tgt = residual + self.dropout2(tgt)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm3(tgt)
-        tgt = self.linear2(self.dropout_act(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
-        return tgt
+        pre = self.normalize_before
+        tgt = _sublayer(tgt, self.norm1, pre, self.dropout1,
+                        lambda h: self.self_attn(h, h, h, tgt_mask))
+        tgt = _sublayer(tgt, self.norm2, pre, self.dropout2,
+                        lambda h: self.cross_attn(h, memory, memory,
+                                                  memory_mask))
+        return _sublayer(tgt, self.norm3, pre, self.dropout3, self._ffn)
 
 
 class TransformerDecoder(Layer):
     def __init__(self, decoder_layer, num_layers, norm=None):
         super().__init__()
         import copy
-        self.layers = LayerList([decoder_layer] +
-                                [copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+        self.layers = LayerList(
+            [decoder_layer] + [copy.deepcopy(decoder_layer)
+                               for _ in range(num_layers - 1)])
         self.num_layers = num_layers
         self.norm = norm
 
@@ -224,9 +253,7 @@ class TransformerDecoder(Layer):
         out = tgt
         for layer in self.layers:
             out = layer(out, memory, tgt_mask, memory_mask)
-        if self.norm is not None:
-            out = self.norm(out)
-        return out
+        return out if self.norm is None else self.norm(out)
 
 
 class Transformer(Layer):
@@ -243,17 +270,21 @@ class Transformer(Layer):
         else:
             enc_layer = TransformerEncoderLayer(
                 d_model, nhead, dim_feedforward, dropout, activation,
-                attn_dropout, act_dropout, normalize_before, weight_attr, bias_attr)
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
             enc_norm = LayerNorm(d_model) if normalize_before else None
-            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers, enc_norm)
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              enc_norm)
         if custom_decoder is not None:
             self.decoder = custom_decoder
         else:
             dec_layer = TransformerDecoderLayer(
                 d_model, nhead, dim_feedforward, dropout, activation,
-                attn_dropout, act_dropout, normalize_before, weight_attr, bias_attr)
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
             dec_norm = LayerNorm(d_model) if normalize_before else None
-            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers, dec_norm)
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              dec_norm)
 
     def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
         memory = self.encoder(src, src_mask)
@@ -261,7 +292,6 @@ class Transformer(Layer):
 
     @staticmethod
     def generate_square_subsequent_mask(length):
-        from ...tensor.creation import Tensor as _T
         mask = jnp.where(jnp.tril(jnp.ones((length, length), bool)),
                          0.0, jnp.finfo(jnp.float32).min)
         return Tensor(mask)

@@ -1,5 +1,22 @@
-"""LR schedulers (reference: python/paddle/optimizer/lr.py)."""
+"""LR schedulers (reference: python/paddle/optimizer/lr.py).
+
+Semantics are deliberately reference-exact (the update rules ARE the API
+contract) and attribute names are state_dict keys, so checkpoints written
+by the reference load here unchanged. The arithmetic is expressed through
+the shared helpers below rather than the reference's inline forms.
+"""
+import bisect
 import math
+
+
+def _lerp(a, b, frac):
+    """Linear blend from a (frac=0) to b (frac=1)."""
+    return a + (b - a) * frac
+
+
+def _cos_ramp(frac):
+    """Cosine half-wave from 0 (frac=0) to 1 (frac=1)."""
+    return (1 - math.cos(math.pi * frac)) / 2
 
 
 class LRScheduler:
@@ -14,10 +31,7 @@ class LRScheduler:
         return self.last_lr
 
     def step(self, epoch=None):
-        if epoch is None:
-            self.last_epoch += 1
-        else:
-            self.last_epoch = epoch
+        self.last_epoch = self.last_epoch + 1 if epoch is None else epoch
         self.last_lr = self.get_lr()
 
     def get_lr(self):
@@ -25,7 +39,8 @@ class LRScheduler:
 
     def state_dict(self):
         return {k: v for k, v in self.__dict__.items()
-                if not k.startswith("_") and isinstance(v, (int, float, bool, str, list))}
+                if not k.startswith("_")
+                and isinstance(v, (int, float, bool, str, list))}
 
     def set_state_dict(self, state_dict):
         self.__dict__.update(state_dict)
@@ -43,8 +58,9 @@ class NoamDecay(LRScheduler):
 
     def get_lr(self):
         step = max(self.last_epoch, 1)
-        return self.base_lr * (self.d_model ** -0.5) * min(
-            step ** -0.5, step * self.warmup_steps ** -1.5)
+        ramp_up = step * self.warmup_steps ** -1.5
+        decay = step ** -0.5
+        return self.base_lr * self.d_model ** -0.5 * min(decay, ramp_up)
 
 
 class PiecewiseDecay(LRScheduler):
@@ -54,10 +70,9 @@ class PiecewiseDecay(LRScheduler):
         super().__init__(values[0], last_epoch, verbose)
 
     def get_lr(self):
-        for i, b in enumerate(self.boundaries):
-            if self.last_epoch < b:
-                return self.values[i]
-        return self.values[len(self.boundaries)]
+        # value i applies while last_epoch < boundaries[i]
+        return self.values[bisect.bisect_right(self.boundaries,
+                                               self.last_epoch)]
 
 
 class NaturalExpDecay(LRScheduler):
@@ -88,22 +103,22 @@ class PolynomialDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        step = self.last_epoch
+        step, horizon = self.last_epoch, self.decay_steps
         if self.cycle:
-            div = math.ceil(step / self.decay_steps) if step > 0 else 1
-            decay_steps = self.decay_steps * div
+            # horizon stretches to the next multiple of decay_steps
+            horizon *= math.ceil(step / self.decay_steps) if step > 0 else 1
         else:
-            decay_steps = self.decay_steps
-            step = min(step, decay_steps)
-        return (self.base_lr - self.end_lr) * \
-            (1 - step / decay_steps) ** self.power + self.end_lr
+            step = min(step, horizon)
+        remaining = (1 - step / horizon) ** self.power
+        return (self.base_lr - self.end_lr) * remaining + self.end_lr
 
 
 class LinearWarmup(LRScheduler):
     def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
                  last_epoch=-1, verbose=False):
-        self.lr_sched = learning_rate if isinstance(learning_rate, LRScheduler) else None
-        self.final_lr = learning_rate if not isinstance(learning_rate, LRScheduler) else None
+        wraps = isinstance(learning_rate, LRScheduler)
+        self.lr_sched = learning_rate if wraps else None
+        self.final_lr = None if wraps else learning_rate
         self.warmup_steps = warmup_steps
         self.start_lr = start_lr
         self.end_lr = end_lr
@@ -113,10 +128,11 @@ class LinearWarmup(LRScheduler):
         if self.last_epoch < self.warmup_steps:
             return (self.end_lr - self.start_lr) * \
                 self.last_epoch / self.warmup_steps + self.start_lr
-        if self.lr_sched is not None:
-            self.lr_sched.last_epoch = self.last_epoch - self.warmup_steps
-            return self.lr_sched.get_lr()
-        return self.final_lr
+        if self.lr_sched is None:
+            return self.final_lr
+        # the wrapped schedule runs on warmup-relative epochs
+        self.lr_sched.last_epoch = self.last_epoch - self.warmup_steps
+        return self.lr_sched.get_lr()
 
 
 class ExponentialDecay(LRScheduler):
@@ -136,8 +152,8 @@ class MultiStepDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        n = sum(1 for m in self.milestones if m <= self.last_epoch)
-        return self.base_lr * self.gamma ** n
+        passed = sum(1 for m in self.milestones if m <= self.last_epoch)
+        return self.base_lr * self.gamma ** passed
 
 
 class StepDecay(LRScheduler):
@@ -191,13 +207,11 @@ class ReduceOnPlateau(LRScheduler):
     def _is_better(self, current, best):
         """Reference lr.py _is_better: 'rel' scales the threshold by best,
         'abs' uses it directly."""
-        if self.mode == "min" and self.threshold_mode == "rel":
-            return current < best - best * self.threshold
+        margin = best * self.threshold if self.threshold_mode == "rel" \
+            else self.threshold
         if self.mode == "min":
-            return current < best - self.threshold
-        if self.threshold_mode == "rel":
-            return current > best + best * self.threshold
-        return current > best + self.threshold
+            return current < best - margin
+        return current > best + margin
 
     def step(self, metrics, epoch=None):
         """Reference ReduceOnPlateau.step: metrics is a required positional
@@ -205,42 +219,43 @@ class ReduceOnPlateau(LRScheduler):
         as in the reference); while cooling down, metrics are IGNORED
         entirely (only the counter decrements); the lr change is gated by
         epsilon so sub-epsilon reductions are skipped."""
-        if epoch is None:
-            self.last_epoch = self.last_epoch + 1
-        else:
-            self.last_epoch = epoch
-        current = float(metrics.item() if hasattr(metrics, "item") else metrics)
+        self.last_epoch = self.last_epoch + 1 if epoch is None else epoch
+        current = float(metrics.item() if hasattr(metrics, "item")
+                        else metrics)
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             return
         if self.best is None or self._is_better(current, self.best):
             self.best = current
             self.num_bad_epochs = 0
-        else:
-            self.num_bad_epochs += 1
-        if self.num_bad_epochs > self.patience:
-            self.cooldown_counter = self.cooldown
-            self.num_bad_epochs = 0
-            new_lr = max(self.last_lr * self.factor, self.min_lr)
-            if self.last_lr - new_lr > self.epsilon:
-                self.last_lr = new_lr
+            return
+        self.num_bad_epochs += 1
+        if self.num_bad_epochs <= self.patience:
+            return
+        self.cooldown_counter = self.cooldown
+        self.num_bad_epochs = 0
+        new_lr = max(self.last_lr * self.factor, self.min_lr)
+        if self.last_lr - new_lr > self.epsilon:
+            self.last_lr = new_lr
 
 
 class CosineAnnealingDecay(LRScheduler):
-    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1, verbose=False):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1,
+                 verbose=False):
         self.T_max = T_max
         self.eta_min = eta_min
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        return self.eta_min + (self.base_lr - self.eta_min) * \
-            (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2
+        frac = self.last_epoch / self.T_max
+        return _lerp(self.base_lr, self.eta_min, _cos_ramp(frac))
 
 
 class OneCycleLR(LRScheduler):
     def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
-                 end_learning_rate=0.0001, phase_pct=0.3, anneal_strategy="cos",
-                 three_phase=False, last_epoch=-1, verbose=False):
+                 end_learning_rate=0.0001, phase_pct=0.3,
+                 anneal_strategy="cos", three_phase=False, last_epoch=-1,
+                 verbose=False):
         self.max_lr = max_learning_rate
         self.total_steps = total_steps
         self.initial_lr = max_learning_rate / divide_factor
@@ -252,18 +267,17 @@ class OneCycleLR(LRScheduler):
         step = self.last_epoch
         up_steps = int(self.total_steps * self.phase_pct)
         if step <= up_steps:
-            pct = step / max(up_steps, 1)
-            return self.initial_lr + (self.max_lr - self.initial_lr) * \
-                (1 - math.cos(math.pi * pct)) / 2
-        pct = (step - up_steps) / max(self.total_steps - up_steps, 1)
-        return self.end_lr + (self.max_lr - self.end_lr) * \
-            (1 + math.cos(math.pi * pct)) / 2
+            frac = step / max(up_steps, 1)
+            return _lerp(self.initial_lr, self.max_lr, _cos_ramp(frac))
+        frac = (step - up_steps) / max(self.total_steps - up_steps, 1)
+        return _lerp(self.max_lr, self.end_lr, _cos_ramp(frac))
 
 
 class CyclicLR(LRScheduler):
     def __init__(self, base_learning_rate, max_learning_rate, step_size_up,
                  step_size_down=None, mode="triangular", exp_gamma=1.0,
-                 scale_fn=None, scale_mode="cycle", last_epoch=-1, verbose=False):
+                 scale_fn=None, scale_mode="cycle", last_epoch=-1,
+                 verbose=False):
         self.max_lr = max_learning_rate
         self.step_up = step_size_up
         self.step_down = step_size_down or step_size_up
@@ -271,17 +285,18 @@ class CyclicLR(LRScheduler):
         self.exp_gamma = exp_gamma
         super().__init__(base_learning_rate, last_epoch, verbose)
 
-    def get_lr(self):
-        cycle_len = self.step_up + self.step_down
-        pos = self.last_epoch % cycle_len
-        if pos < self.step_up:
-            pct = pos / self.step_up
-        else:
-            pct = 1 - (pos - self.step_up) / self.step_down
-        scale = 1.0
-        cycle = self.last_epoch // cycle_len
+    def _amplitude_scale(self, cycle):
         if self.mode == "triangular2":
-            scale = 1 / (2 ** cycle)
-        elif self.mode == "exp_range":
-            scale = self.exp_gamma ** self.last_epoch
+            return 1 / (2 ** cycle)
+        if self.mode == "exp_range":
+            return self.exp_gamma ** self.last_epoch
+        return 1.0
+
+    def get_lr(self):
+        span = self.step_up + self.step_down
+        pos = self.last_epoch % span
+        rising = pos < self.step_up
+        pct = pos / self.step_up if rising \
+            else 1 - (pos - self.step_up) / self.step_down
+        scale = self._amplitude_scale(self.last_epoch // span)
         return self.base_lr + (self.max_lr - self.base_lr) * pct * scale

@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — TPU-native generation & serving engine.
+
+The reference deploy story stops at a one-shot Predictor (SURVEY §2.7);
+this package is the generation tier above it, built from the two ideas
+that turn a compiled decoder into a serving engine:
+
+  kv_cache.py  — static-shape preallocated KV cache (one decode
+                 executable, ever; vLLM's preallocation insight)
+  sampling.py  — greedy / temperature / top-k / top-p token selection
+  engine.py    — prefill/decode split: length-bucketed prefill
+                 executables feed the single decode executable
+  scheduler.py — iteration-level (continuous) batching à la Orca:
+                 per-slot eos retirement and mid-flight refill, queue
+                 caps, deadlines, graceful drain, serving metrics
+
+`inference.Predictor.generate` and `bench.py --decode` ride the same
+engine. See docs/serving.md.
+"""
+from . import kv_cache, sampling  # noqa: F401
+from .engine import EngineConfig, GenerationEngine, save_for_generation  # noqa: F401
+from .scheduler import (  # noqa: F401
+    QueueFullError, Request, RequestHandle, Scheduler, ServingConfig,
+)
+
+__all__ = [
+    "kv_cache", "sampling", "EngineConfig", "GenerationEngine",
+    "save_for_generation", "Scheduler", "ServingConfig", "Request",
+    "RequestHandle", "QueueFullError",
+]

@@ -1,0 +1,288 @@
+"""Prefill/decode split: the executable layer of the serving engine.
+
+Generation has two phases with opposite shapes: prefill consumes a whole
+prompt (long S, once per request) and decode consumes one token (S=1,
+every step, every slot). Compiling them separately is what keeps the hot
+step hot:
+
+  - ONE decode executable per (model, slot-config): all S slots advance
+    one token through the static cache; its avals never change, so after
+    the first call XLA replays the same executable forever. A python-side
+    trace counter (incremented only when jax actually retraces) is the
+    compile-once proof the tests assert on.
+  - a LADDER of prefill executables, one per prompt-length bucket:
+    prompts are right-padded to the nearest bucket, so arbitrary lengths
+    compile at most `len(buckets)` times instead of once per length.
+    Prefill writes the prompt's K/V straight into the chosen slot's rows
+    of the global cache and returns the first generated token.
+
+The engine is deliberately model-functional: it freezes the Layer's
+params once (`functional_state`) and traces `GPT.forward(cache=...)`
+through `functional_call`, so the same eager model object serves both
+training and serving without a second weight copy.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import functional_call, functional_state
+from ..profiler import RecordEvent, TracerEventType
+from . import kv_cache as kvc
+from . import sampling
+
+__all__ = ["EngineConfig", "GenerationEngine", "save_for_generation"]
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024)
+GENCFG_SUFFIX = ".gencfg"
+
+
+class EngineConfig:
+    """Slot/bucket/strategy knobs for one GenerationEngine."""
+
+    def __init__(self, slots=4, max_len=256, prefill_buckets=None,
+                 decode_strategy="greedy", temperature=1.0, top_k=0,
+                 top_p=1.0, eos_token_id=None, seed=0):
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        # the ladder always ends in a max_len-sized bucket so every prompt
+        # the cache can hold has a prefill executable
+        buckets = prefill_buckets or (
+            [b for b in DEFAULT_BUCKETS if b < max_len] + [max_len])
+        self.prefill_buckets = tuple(sorted(int(b) for b in buckets))
+        self.decode_strategy = decode_strategy
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = eos_token_id
+        self.seed = int(seed)
+
+
+class GenerationEngine:
+    """Owns the global static cache + the prefill/decode executables for
+    one model. Slot lifecycle (who occupies which slot, retirement,
+    refill) belongs to scheduler.Scheduler; this layer only computes."""
+
+    def __init__(self, model, config=None, **kwargs):
+        from ..text.models.gpt import GPT, GPTForGeneration
+        if isinstance(model, GPTForGeneration):
+            model = model.gpt
+        if not isinstance(model, GPT):
+            raise TypeError("GenerationEngine serves GPT-family models; got "
+                            f"{type(model).__name__}")
+        self.config = config or EngineConfig(**kwargs)
+        if self.config.max_len > model.cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len={self.config.max_len} exceeds the model's "
+                f"max_position_embeddings={model.cfg.max_position_embeddings}")
+        self._model = model
+        self._params, self._buffers = functional_state(model)
+        cfg = model.cfg
+        self._cache = kvc.alloc_cache(
+            cfg.num_layers, self.config.slots, self.config.max_len,
+            cfg.num_heads, cfg.hidden_size // cfg.num_heads,
+            self._params["wte.weight"].dtype)
+        self._rng = jax.random.key(self.config.seed)
+        self._last_tokens = np.zeros((self.config.slots,), np.int32)
+        # trace counters: the python bodies below run ONLY when jax traces,
+        # so these counts are the number of compilations, not of calls.
+        self.trace_counts = {"decode": 0, "prefill": {}}
+        self._decode = jax.jit(self._decode_fn)
+        self._prefill = {}   # bucket -> jitted fn
+
+    # -- functional forward -------------------------------------------------
+    def _run_model(self, params, layers_k, layers_v, pos, ids):
+        """GPT cached forward over raw arrays -> (logits, new k/v lists)."""
+        cache = kvc.DecodeCache(
+            tuple(kvc.LayerKV(Tensor(k), Tensor(v))
+                  for k, v in zip(layers_k, layers_v)),
+            Tensor(pos))
+        out, _ = functional_call(
+            self._model, params, self._buffers, args=(Tensor(ids),),
+            kwargs={"cache": cache}, train=False)
+        logits, new_cache = out
+        return (logits._data,
+                [l.k._data for l in new_cache.layers],
+                [l.v._data for l in new_cache.layers])
+
+    def _select(self, logits, key):
+        c = self.config
+        return sampling.select_tokens(
+            logits, key=key, strategy=c.decode_strategy,
+            temperature=c.temperature, top_k=c.top_k, top_p=c.top_p)
+
+    # -- decode: ONE executable --------------------------------------------
+    def _decode_fn(self, params, gk, gv, pos, tokens, key):
+        self.trace_counts["decode"] += 1     # trace-time only
+        logits, nk, nv = self._run_model(params, gk, gv, pos, tokens[:, None])
+        nxt = self._select(logits[:, 0, :], key)
+        # free slots keep decoding garbage harmlessly; clamp so their
+        # position (and the wpe lookup) stays in-bounds forever
+        return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
+
+    # -- prefill: one executable per bucket ---------------------------------
+    def _make_prefill(self, bucket):
+        def prefill_fn(params, gk, gv, pos, slot, ids, length, key):
+            self.trace_counts["prefill"][bucket] = \
+                self.trace_counts["prefill"].get(bucket, 0) + 1
+            # run the prompt through a fresh local single-slot cache sized
+            # to the bucket, then splice the rows into the global buffers
+            local_pos = jnp.zeros((1,), jnp.int32)
+            cfg = self._model.cfg
+            fresh = [kvc.alloc_kv(1, bucket, cfg.num_heads,
+                                  cfg.hidden_size // cfg.num_heads, k.dtype)
+                     for k in gk]
+            lk = [f.k for f in fresh]
+            lv = [f.v for f in fresh]
+            logits, nk, nv = self._run_model(params, lk, lv, local_pos,
+                                             ids[None, :])
+            slot = slot.astype(jnp.int32)
+            gk = [jax.lax.dynamic_update_slice(g, n, (slot, 0, 0, 0))
+                  for g, n in zip(gk, nk)]
+            gv = [jax.lax.dynamic_update_slice(g, n, (slot, 0, 0, 0))
+                  for g, n in zip(gv, nv)]
+            pos = jax.lax.dynamic_update_slice(
+                pos, length[None].astype(pos.dtype), (slot,))
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                keepdims=False)
+            first_token = self._select(last[None, :], key)[0]
+            return first_token, gk, gv, pos
+        return jax.jit(prefill_fn)
+
+    def bucket_for(self, length):
+        for b in self.config.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest prefill bucket "
+            f"{self.config.prefill_buckets[-1]} (max_len="
+            f"{self.config.max_len})")
+
+    def _next_key(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # -- public compute API -------------------------------------------------
+    def prefill(self, slot, prompt_ids):
+        """Write `prompt_ids` (1-D ints) into `slot`'s cache rows; returns
+        the first generated token (host int)."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        headroom = self.config.max_len - prompt.size
+        if headroom < 1:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no decode headroom "
+                f"(max_len={self.config.max_len})")
+        bucket = self.bucket_for(prompt.size)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:prompt.size] = prompt
+        if bucket not in self._prefill:
+            self._prefill[bucket] = self._make_prefill(bucket)
+        with RecordEvent("serving::prefill", TracerEventType.UserDefined,
+                         {"bucket": bucket, "length": int(prompt.size),
+                          "slot": int(slot)}):
+            first, gk, gv, pos = self._prefill[bucket](
+                self._params, [l.k for l in self._cache.layers],
+                [l.v for l in self._cache.layers],
+                self._cache.pos, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded), jnp.asarray(prompt.size, jnp.int32),
+                self._next_key())
+        self._set_cache(gk, gv, pos)
+        first = int(first)
+        self._last_tokens[int(slot)] = np.int32(first)
+        return first
+
+    def decode(self):
+        """Advance every slot one token; returns np.int32 [slots]."""
+        with RecordEvent("serving::decode_step",
+                         TracerEventType.UserDefined,
+                         {"slots": self.config.slots}):
+            tokens = self._last_tokens
+            nxt, gk, gv, pos = self._decode(
+                self._params, [l.k for l in self._cache.layers],
+                [l.v for l in self._cache.layers], self._cache.pos,
+                jnp.asarray(tokens), self._next_key())
+        self._set_cache(gk, gv, pos)
+        out = np.asarray(nxt, np.int32)
+        self._last_tokens = out.copy()
+        return out
+
+    def _set_cache(self, gk, gv, pos):
+        self._cache = kvc.DecodeCache(
+            tuple(kvc.LayerKV(k, v) for k, v in zip(gk, gv)), pos)
+
+    def set_slot_token(self, slot, token):
+        """Feed `token` as slot's next decode input (after prefill, or to
+        overwrite a retired slot's lane with a harmless value)."""
+        self._last_tokens[int(slot)] = np.int32(token)
+
+    def reset_slot(self, slot):
+        """Mark a slot free: pos=0 so stale K/V rows are invisible."""
+        pos = np.asarray(self._cache.pos, np.int32).copy()
+        pos[int(slot)] = 0
+        self._cache = kvc.DecodeCache(self._cache.layers,
+                                      jnp.asarray(pos))
+        self._last_tokens[int(slot)] = np.int32(0)
+
+    def slot_positions(self):
+        return np.asarray(self._cache.pos, np.int32)
+
+    @property
+    def slots(self):
+        return self.config.slots
+
+    @property
+    def max_prompt_len(self):
+        """Longest prompt prefill can serve AND still decode one token."""
+        return min(self.config.prefill_buckets[-1], self.config.max_len - 1)
+
+
+def save_for_generation(model, path, input_spec=None):
+    """jit.save the model's plain forward AND persist its GPTConfig next to
+    the artifact (`path.gencfg`), so a cold `inference.Predictor` can
+    rebuild the cached-forward Layer and serve `generate` — the
+    generation analogue of save_inference_model."""
+    from ..jit import save as jit_save
+    from ..static import InputSpec
+    from ..text.models.gpt import GPT, GPTForGeneration
+    if isinstance(model, GPTForGeneration):
+        model = model.gpt
+    if not isinstance(model, GPT):
+        raise TypeError("save_for_generation expects a GPT/GPTForGeneration")
+    if input_spec is None:
+        # batch stays symbolic; the sequence dim must be concrete (the
+        # causal-attention trace compares sequence sizes, which symbolic
+        # dims cannot answer). The one-shot run() path serves full-length
+        # inputs; generate() rebuilds the Layer and is length-free.
+        input_spec = [InputSpec([None, model.cfg.max_position_embeddings],
+                                "int64", name="input_ids")]
+    jit_save(model, path, input_spec=input_spec)
+    cfg = {k: getattr(model.cfg, k) for k in (
+        "vocab_size", "max_position_embeddings", "hidden_size", "num_layers",
+        "num_heads", "intermediate_size", "hidden_dropout",
+        "attention_dropout", "initializer_range", "tie_embeddings")}
+    with open(path + GENCFG_SUFFIX, "w") as f:
+        json.dump({"model_family": "gpt", "config": cfg}, f)
+
+
+def load_generation_model(prog_file, params):
+    """Rebuild the eager GPT from a `.gencfg` sidecar + a loaded params
+    dict (raw arrays keyed by state_dict names). Returns None when the
+    artifact was not saved via save_for_generation."""
+    base = prog_file[:-len(".pdmodel")] if prog_file.endswith(".pdmodel") \
+        else prog_file
+    gencfg = base + GENCFG_SUFFIX
+    if not os.path.exists(gencfg):
+        return None
+    with open(gencfg) as f:
+        meta = json.load(f)
+    from ..text.models.gpt import GPT, GPTConfig
+    model = GPT(GPTConfig(**meta["config"]))
+    model.eval()
+    state = {n: Tensor(v) for n, v in params.items()}
+    model.set_state_dict(state)
+    return model

@@ -1,0 +1,95 @@
+"""Static-shape KV cache for autoregressive decode on TPU.
+
+The reference inference stack (and `MultiHeadAttention.Cache`) grows the
+decode cache by concatenating one token per step — every step changes the
+cache shape, which on an XLA backend means one fresh compilation per
+generated token. This module is the TPU-native replacement: buffers are
+preallocated at `[slots, max_len, heads, head_dim]` and every write is a
+`lax.dynamic_update_slice` at a per-slot position index, so the avals of
+the single-token decode step never change and it compiles exactly once
+(vLLM's preallocated-block insight [SOSP '23], collapsed to one block per
+slot — slot reuse, not paging, is what continuous batching needs).
+
+Two consumption tiers share these helpers:
+  - raw jnp functions (`write`, `attend`, `alloc_kv`) used inside the
+    serving engine's jitted prefill/decode executables;
+  - the `DecodeCache` pytree-of-Tensors used by the eager Layer forwards
+    (`GPT.forward(..., cache=...)`, `MultiHeadAttention` static cache),
+    which route the same functions through `apply_op` so the eager
+    executable cache replays them without retracing.
+"""
+import collections
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LayerKV", "DecodeCache", "alloc_kv", "alloc_cache", "write",
+           "attend", "cache_map", "advance"]
+
+# One transformer layer's key/value buffers: [slots, max_len, heads, head_dim]
+LayerKV = collections.namedtuple("LayerKV", ["k", "v"])
+
+# Whole-model cache: `layers` is a tuple of LayerKV, `pos` is an int32
+# [slots] vector — the number of tokens already written per slot. Slots are
+# independent: continuous batching retires/refills them individually, so
+# positions need not agree across rows.
+DecodeCache = collections.namedtuple("DecodeCache", ["layers", "pos"])
+
+
+def alloc_kv(slots, max_len, num_heads, head_dim, dtype=jnp.float32):
+    """Zeros for one layer's preallocated K/V pair."""
+    shape = (slots, max_len, num_heads, head_dim)
+    return LayerKV(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def alloc_cache(num_layers, slots, max_len, num_heads, head_dim,
+                dtype=jnp.float32):
+    """Zeros for a whole model: num_layers LayerKV buffers + pos=0."""
+    layers = tuple(alloc_kv(slots, max_len, num_heads, head_dim, dtype)
+                   for _ in range(num_layers))
+    return DecodeCache(layers, jnp.zeros((slots,), jnp.int32))
+
+
+def write(buf, new, pos):
+    """Write `new` [S, T, h, d] into `buf` [S, L, h, d] at per-slot start
+    positions `pos` [S] (clamped in-bounds by dynamic_update_slice). Shapes
+    are static: T is the prefill bucket length or 1 for decode."""
+    def one(row, add, p):
+        return jax.lax.dynamic_update_slice(row, add.astype(row.dtype),
+                                            (p, 0, 0))
+    return jax.vmap(one)(buf, new, pos.astype(jnp.int32))
+
+
+def attend(q, k_buf, v_buf, pos, scale=None):
+    """Masked attention of `q` [S, T, h, d] against the full preallocated
+    buffers [S, L, h, d], where the T query tokens sit at positions
+    `pos + 0..T-1` of their slot. Key index j is visible to query i iff
+    j <= pos + i — causal within the prompt, full-history for decode.
+
+    A dense softmax over the padded length L: at T=1 this is a matvec (the
+    decode step is bandwidth-bound on the cache read either way), and for
+    prefill the bucket ladder bounds L. No flash kernel needed — there is
+    no S^2 materialization risk at decode shapes."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    L = k_buf.shape[1]
+    T = q.shape[1]
+    # [S, T, L] visibility: key j <= pos + i
+    limit = pos.astype(jnp.int32)[:, None] + jnp.arange(T, dtype=jnp.int32)
+    visible = jnp.arange(L, dtype=jnp.int32)[None, None, :] <= limit[:, :, None]
+    scores = jnp.einsum("sthd,slhd->shtl", q, k_buf) * scale
+    scores = jnp.where(visible[:, None, :, :], scores,
+                       jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("shtl,slhd->sthd", probs, v_buf)
+
+
+def advance(pos, n):
+    """New position vector after writing n tokens to every slot."""
+    return pos + jnp.asarray(n, pos.dtype)
+
+
+def cache_map(fn, cache):
+    """Apply `fn` to every k/v leaf of a DecodeCache (pos untouched)."""
+    return DecodeCache(
+        tuple(LayerKV(fn(l.k), fn(l.v)) for l in cache.layers), cache.pos)

@@ -1,0 +1,57 @@
+"""Token-selection strategies for generation (greedy, temperature,
+top-k, top-p), as pure jnp functions usable both eagerly (through
+`apply_op`) and inside the serving engine's jitted decode executable.
+
+Reference capability: PaddleNLP `generation_utils.py` sampling — same
+knobs, but every branch here keeps static shapes (filters are masks over
+the full vocab, never a gather to a shrunken tensor) so the decode step
+stays one executable across strategy parameters."""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["select_tokens"]
+
+
+def _mask_top_k(logits, k):
+    """Keep the k largest logits per row, -inf elsewhere (static shape)."""
+    kth = jnp.sort(logits, axis=-1)[..., -int(k)][..., None]
+    return jnp.where(logits < kth, jnp.finfo(logits.dtype).min, logits)
+
+
+def _mask_top_p(logits, p):
+    """Nucleus filter: keep the smallest prefix of the probability-sorted
+    vocab whose mass reaches p; always keeps the argmax."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # exclusive cumulative mass BEFORE each token: token enters the nucleus
+    # while the mass of strictly-better tokens is still < p
+    csum = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = csum < p
+    # map the per-rank keep decision back to vocab order via the threshold
+    # logit of the last kept rank (ties keep both — harmless)
+    n_keep = jnp.maximum(keep_sorted.sum(-1), 1)
+    thresh = jnp.take_along_axis(sorted_logits, (n_keep - 1)[..., None],
+                                 axis=-1)
+    return jnp.where(logits < thresh, jnp.finfo(logits.dtype).min, logits)
+
+
+def select_tokens(logits, key=None, strategy="greedy", temperature=1.0,
+                  top_k=0, top_p=1.0):
+    """logits [..., V] -> token ids [...] (int32).
+
+    greedy: argmax. sampling: temperature-scaled categorical, optionally
+    restricted by top-k and/or top-p masks. `strategy` and the knobs are
+    python values (jit-static); only logits/key are traced."""
+    if strategy == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if strategy != "sampling":
+        raise ValueError(f"unknown decode strategy: {strategy!r}")
+    if key is None:
+        raise ValueError("sampling needs a PRNG key")
+    scaled = logits / jnp.maximum(jnp.asarray(temperature, logits.dtype),
+                                  1e-6)
+    if top_k and int(top_k) > 0:
+        scaled = _mask_top_k(scaled, int(top_k))
+    if top_p is not None and float(top_p) < 1.0:
+        scaled = _mask_top_p(scaled, float(top_p))
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

@@ -1,0 +1,319 @@
+"""Iteration-level (continuous) batching scheduler — the robustness tier.
+
+Orca's [OSDI '22] observation: batching at REQUEST granularity strands
+decode slots behind the longest member of the batch. Scheduling at
+ITERATION granularity — one decode step at a time — lets a slot whose
+sequence hit eos retire immediately and hand its lane to a queued
+request while the other slots keep decoding. This module implements
+that loop over a GenerationEngine:
+
+  submit() -> bounded admission queue (QueueFullError past the cap,
+              deadline expiry while queued -> TIMEOUT)
+  step()   -> retire finished slots (eos / max_new_tokens / deadline),
+              refill free slots from the queue (prefill = TTFT),
+              advance every occupied slot one token (decode)
+  drain()  -> stop admitting, run until in-flight work finishes
+
+Observability: every step appends a JSONL record (queue depth, active
+slots, tokens emitted) and every request completion appends a summary
+(TTFT, decode rate, status); the same figures feed profiler spans and
+the `native` stat counters, and `tools/serve_report.py` renders the
+file. The step loop is synchronous by design — the engine's decode is
+one executable replay, so a thread adds latency, not throughput.
+"""
+import collections
+import itertools
+import json
+import threading
+import time
+
+from .. import native
+from ..profiler import RecordEvent, TracerEventType
+
+__all__ = ["ServingConfig", "Scheduler", "Request", "RequestHandle",
+           "QueueFullError"]
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+DONE = "DONE"
+TIMEOUT = "TIMEOUT"
+REJECTED = "REJECTED"
+
+_COUNTERS = ("serving.admitted", "serving.completed", "serving.rejected",
+             "serving.timeout", "serving.tokens")
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — backpressure, caller should retry."""
+
+
+class ServingConfig:
+    def __init__(self, max_queue=64, default_max_new_tokens=32,
+                 default_timeout_s=None, metrics_path=None):
+        self.max_queue = int(max_queue)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.default_timeout_s = default_timeout_s
+        self.metrics_path = metrics_path
+
+
+class Request:
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, deadline, submitted_at):
+        self.id = next(Request._ids)
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline          # absolute clock value or None
+        self.submitted_at = submitted_at
+        self.status = QUEUED
+        self.tokens = []                  # generated tokens, stream order
+        self.slot = None
+        self.first_token_at = None        # TTFT timestamp
+        self.finished_at = None
+        self._done = threading.Event()
+
+
+class RequestHandle:
+    """Caller-facing view of one request: a live token stream + terminal
+    status. `tokens` is append-only in generation order, so a streaming
+    client can poll it while the scheduler runs."""
+
+    def __init__(self, req, clock):
+        self._req = req
+        self._clock = clock
+
+    @property
+    def request_id(self):
+        return self._req.id
+
+    @property
+    def status(self):
+        return self._req.status
+
+    @property
+    def tokens(self):
+        return list(self._req.tokens)
+
+    def done(self):
+        return self._req.status in (DONE, TIMEOUT, REJECTED)
+
+    def result(self, timeout=None):
+        """Block until terminal; returns the token list. TIMEOUT requests
+        return their partial output (status tells the caller)."""
+        if not self._req._done.wait(timeout):
+            raise TimeoutError(f"request {self._req.id} still "
+                               f"{self._req.status}")
+        return self.tokens
+
+    @property
+    def ttft_s(self):
+        r = self._req
+        if r.first_token_at is None:
+            return None
+        return r.first_token_at - r.submitted_at
+
+
+class Scheduler:
+    def __init__(self, engine, config=None, clock=time.monotonic, **kwargs):
+        self.engine = engine
+        self.config = config or ServingConfig(**kwargs)
+        self._clock = clock
+        self._queue = collections.deque()
+        self._slots = [None] * engine.slots   # Request or None
+        self._draining = False
+        self._steps = 0
+        self._decode_tokens = 0
+        self._decode_time_s = 0.0
+        self._completed = []
+        self.counts = dict.fromkeys(_COUNTERS, 0)
+        self._metrics_f = (open(self.config.metrics_path, "a")
+                           if self.config.metrics_path else None)
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, timeout_s=None):
+        prompt = [int(t) for t in prompt]
+        now = self._clock()
+        max_new = self.config.default_max_new_tokens \
+            if max_new_tokens is None else max_new_tokens
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        timeout = timeout_s if timeout_s is not None \
+            else self.config.default_timeout_s
+        req = Request(prompt, max_new,
+                      now + timeout if timeout is not None else None, now)
+        handle = RequestHandle(req, self._clock)
+        if self._draining:
+            self._finish(req, REJECTED, "serving.rejected")
+            raise QueueFullError("scheduler is draining")
+        if len(self._queue) >= self.config.max_queue:
+            self._finish(req, REJECTED, "serving.rejected")
+            raise QueueFullError(
+                f"admission queue full ({self.config.max_queue})")
+        if not prompt:
+            self._finish(req, REJECTED, "serving.rejected")
+            raise ValueError("empty prompt")
+        if len(prompt) > self.engine.max_prompt_len or \
+                len(prompt) + max_new > self.engine.config.max_len:
+            # validate against what prefill can actually serve — a request
+            # admitted past these limits would blow up inside step() and
+            # strand itself with no terminal status
+            self._finish(req, REJECTED, "serving.rejected")
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the engine limits (max prompt "
+                f"{self.engine.max_prompt_len}, cache max_len "
+                f"{self.engine.config.max_len})")
+        self._queue.append(req)
+        self._count("serving.admitted")
+        return handle
+
+    # -- the iteration loop --------------------------------------------------
+    def step(self):
+        """One scheduling iteration. Returns True while work remains."""
+        now = self._clock()
+        self._expire_queued(now)
+        self._retire(now)
+        self._refill(now)
+        active = [r for r in self._slots if r is not None]
+        if active:
+            t0 = self._clock()
+            tokens = self.engine.decode()
+            dt = self._clock() - t0
+            self._decode_time_s += dt
+            for slot, req in enumerate(self._slots):
+                if req is not None:
+                    req.tokens.append(int(tokens[slot]))
+                    self._decode_tokens += 1
+                    self._count("serving.tokens")
+        self._steps += 1
+        self._write_step_record(now, len(active))
+        return bool(self._queue or any(s is not None for s in self._slots))
+
+    def drain(self, max_steps=100000):
+        """Graceful drain: no new admissions, finish what's in flight."""
+        self._draining = True
+        for _ in range(max_steps):
+            if not self.step():
+                break
+        self.close()
+
+    def run_until_idle(self, max_steps=100000):
+        for _ in range(max_steps):
+            if not self.step():
+                return
+
+    def close(self):
+        if self._metrics_f:
+            self._metrics_f.close()
+            self._metrics_f = None
+
+    # -- phases ---------------------------------------------------------------
+    def _expire_queued(self, now):
+        kept = collections.deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, TIMEOUT, "serving.timeout")
+            else:
+                kept.append(req)
+        self._queue = kept
+
+    def _retire(self, now):
+        eos = self.engine.config.eos_token_id
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            finished = (
+                len(req.tokens) >= req.max_new_tokens
+                or (eos is not None and req.tokens and req.tokens[-1] == eos)
+            )
+            timed_out = req.deadline is not None and now > req.deadline
+            if finished or timed_out:
+                with RecordEvent("serving::retire",
+                                 TracerEventType.UserDefined,
+                                 {"slot": slot, "request": req.id,
+                                  "tokens": len(req.tokens),
+                                  "timeout": timed_out}):
+                    self.engine.reset_slot(slot)
+                self._slots[slot] = None
+                self._finish(req, TIMEOUT if timed_out else DONE,
+                             "serving.timeout" if timed_out
+                             else "serving.completed")
+
+    def _refill(self, now):
+        eos = self.engine.config.eos_token_id
+        for slot, occupant in enumerate(self._slots):
+            if occupant is not None:
+                continue
+            # a request that completes AT prefill (max_new_tokens=1, or an
+            # instant eos) retires here, before decode could overrun it —
+            # and frees the slot for the next queued request immediately
+            while self._queue and self._slots[slot] is None:
+                req = self._queue.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    self._finish(req, TIMEOUT, "serving.timeout")
+                    continue
+                first = self.engine.prefill(slot, req.prompt)
+                req.slot = slot
+                req.status = RUNNING
+                req.first_token_at = self._clock()
+                req.tokens.append(first)
+                self._decode_tokens += 1
+                self._count("serving.tokens")
+                if req.max_new_tokens <= 1 or \
+                        (eos is not None and first == eos):
+                    self.engine.reset_slot(slot)
+                    self._finish(req, DONE, "serving.completed")
+                else:
+                    self._slots[slot] = req
+
+    def _finish(self, req, status, counter):
+        req.status = status
+        req.finished_at = self._clock()
+        self._count(counter)
+        if status in (DONE, TIMEOUT):
+            self._completed.append(req)
+            self._write_request_record(req)
+        req._done.set()
+
+    def _count(self, name):
+        self.counts[name] += 1
+        native.stat_add(name, 1)
+
+    # -- metrics ---------------------------------------------------------------
+    def metrics(self):
+        occupied = sum(1 for s in self._slots if s is not None)
+        ttfts = [r.first_token_at - r.submitted_at for r in self._completed
+                 if r.first_token_at is not None]
+        return {
+            "steps": self._steps,
+            "queue_depth": len(self._queue),
+            "slot_occupancy": occupied / max(self.engine.slots, 1),
+            "tokens_generated": self._decode_tokens,
+            "decode_tokens_per_s": (
+                self._decode_tokens / self._decode_time_s
+                if self._decode_time_s > 0 else 0.0),
+            "ttft_s_mean": sum(ttfts) / len(ttfts) if ttfts else None,
+            "requests": dict(self.counts),
+        }
+
+    def _write_step_record(self, now, active):
+        if not self._metrics_f:
+            return
+        self._metrics_f.write(json.dumps({
+            "kind": "step", "step": self._steps, "t": now,
+            "queue_depth": len(self._queue), "active_slots": active,
+            "tokens_generated": self._decode_tokens}) + "\n")
+        self._metrics_f.flush()
+
+    def _write_request_record(self, req):
+        if not self._metrics_f:
+            return
+        decode_s = (req.finished_at - req.first_token_at
+                    if req.first_token_at else None)
+        self._metrics_f.write(json.dumps({
+            "kind": "request", "request_id": req.id, "status": req.status,
+            "prompt_len": len(req.prompt), "tokens": len(req.tokens),
+            "ttft_s": (req.first_token_at - req.submitted_at
+                       if req.first_token_at else None),
+            "decode_s": decode_s}) + "\n")
+        self._metrics_f.flush()

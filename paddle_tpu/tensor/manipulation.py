@@ -8,7 +8,15 @@ from ..core.tensor import Tensor, apply_op
 def _shape_arg(shape):
     if isinstance(shape, Tensor):
         return tuple(int(s) for s in shape.numpy().reshape(-1))
-    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+    def one(s):
+        if isinstance(s, Tensor):
+            return int(s._data)
+        try:
+            return int(s)
+        except Exception:   # export symbolic dim (shape-polymorphic save):
+            return s        # int() is inconclusive; jnp takes it verbatim
+    return tuple(one(s) for s in shape)
 
 
 def reshape(x, shape, name=None):

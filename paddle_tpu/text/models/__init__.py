@@ -1,5 +1,8 @@
 """NLP model zoo: GPT / BERT / ERNIE (TPU-native flagship models)."""
-from .gpt import GPT, GPTConfig, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b, gpt_6p7b  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPT, GPTConfig, GPTForGeneration, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b,
+    gpt_6p7b,
+)
 from .bert import Bert, BertConfig, BertForPretraining  # noqa: F401
 from .ernie import (  # noqa: F401
     Ernie, ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification,

@@ -48,11 +48,24 @@ class GPTAttention(Layer):
         self.out_proj.weight.split_axis = 0  # row-parallel over mp
         self.dropout = cfg.attention_dropout
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        """Train/prefill-uncached path when cache is None. With a
+        `serving.kv_cache.LayerKV` cache (+ per-slot `pos`), the projected
+        k/v are written into the preallocated buffers at pos via
+        dynamic_update_slice and attention runs over the full static
+        buffer — the single-token decode step keeps one set of avals and
+        compiles once (docs/serving.md)."""
         B, S, H = x.shape
         qkv = self.qkv(x)  # B,S,3H
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # B,S,h,d
+        if cache is not None:
+            from ...serving import kv_cache as _kvc
+            k_buf = apply_op(_kvc.write, cache.k, k, pos)
+            v_buf = apply_op(_kvc.write, cache.v, v, pos)
+            out = apply_op(_kvc.attend, q, k_buf, v_buf, pos)
+            out = out.reshape([B, S, H])
+            return self.out_proj(out), _kvc.LayerKV(k_buf, v_buf)
         out = F.scaled_dot_product_attention(
             q, k, v, dropout_p=self.dropout, is_causal=True,
             training=self.training)
@@ -84,7 +97,12 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            attn_out, new_cache = self.attn(self.ln1(x), cache=cache, pos=pos)
+            x = x + self.dropout(attn_out)
+            x = x + self.dropout(self.mlp(self.ln2(x)))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return x
@@ -106,21 +124,54 @@ class GPT(Layer):
             self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
                                   weight_attr=init, bias_attr=False)
 
-    def forward(self, input_ids):
+    def gen_cache(self, batch, max_len, dtype=None):
+        """Preallocated static decode cache (serving/kv_cache.py): one
+        [batch, max_len, heads, head_dim] K/V pair per block, pos=0.
+        max_len must not exceed max_position_embeddings (the position
+        table is the other static buffer)."""
+        from ...serving import kv_cache as _kvc
+        if max_len > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"gen_cache max_len={max_len} exceeds "
+                f"max_position_embeddings={self.cfg.max_position_embeddings}")
+        dtype = dtype or self.wte.weight.dtype
+        raw = _kvc.alloc_cache(self.cfg.num_layers, batch, max_len,
+                               self.cfg.num_heads,
+                               self.cfg.hidden_size // self.cfg.num_heads,
+                               dtype)
+        return _kvc.DecodeCache(
+            tuple(_kvc.LayerKV(Tensor(l.k), Tensor(l.v)) for l in raw.layers),
+            Tensor(raw.pos))
+
+    def forward(self, input_ids, cache=None):
         B, S = input_ids.shape
         from ...tensor.creation import arange
+        if cache is not None:
+            from ...serving import kv_cache as _kvc
+            pos = cache.pos
+            positions = apply_op(
+                lambda p, ids: p.astype(jnp.int32)[:, None]
+                + jnp.arange(ids.shape[1], dtype=jnp.int32),
+                pos, input_ids)
+            x = self.drop(self.wte(input_ids) + self.wpe(positions))
+            new_layers = []
+            for blk, lkv in zip(self.blocks, cache.layers):
+                x, new_lkv = blk(x, cache=lkv, pos=pos)
+                new_layers.append(new_lkv)
+            logits = self._head(self.ln_f(x))
+            return logits, _kvc.DecodeCache(tuple(new_layers), pos + S)
         pos = arange(0, S, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         for blk in self.blocks:
             x = blk(x)
-        x = self.ln_f(x)
+        return self._head(self.ln_f(x))
+
+    def _head(self, x):
         if self.cfg.tie_embeddings:
-            logits = apply_op(lambda h, w: jnp.einsum("bsh,vh->bsv", h, w),
-                              x, self.wte.weight)
-        else:
-            logits = self.lm_head(x)
-        return logits
+            return apply_op(lambda h, w: jnp.einsum("bsh,vh->bsv", h, w),
+                            x, self.wte.weight)
+        return self.lm_head(x)
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)
@@ -130,6 +181,93 @@ class GPT(Layer):
     def num_params(self):
         import numpy as np
         return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+class GPTForGeneration(Layer):
+    """Autoregressive decoding head over a GPT (reference capability:
+    PaddleNLP GPTForGeneration / generation_utils). `use_cache=True` runs
+    the static-cache decode path — prefill writes the prompt's K/V once,
+    then each step is a fixed-shape single-token forward; `use_cache=False`
+    recomputes the full forward per token (the parity oracle, and the only
+    mode the reference's growing cache could offer without per-token
+    recompiles)."""
+
+    def __init__(self, gpt: GPT):
+        super().__init__()
+        self.gpt = gpt
+
+    def forward(self, input_ids, **kwargs):
+        return self.generate(input_ids, **kwargs)
+
+    def _select(self, logits, strategy, temperature, top_k, top_p):
+        from ...core.random import next_key
+        from ...serving import sampling as _sampling
+        key = next_key() if strategy == "sampling" else None
+        return apply_op(
+            lambda lg: _sampling.select_tokens(
+                lg, key=key, strategy=strategy, temperature=temperature,
+                top_k=top_k, top_p=top_p), logits)
+
+    def generate(self, input_ids, max_new_tokens=20, decode_strategy="greedy",
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 use_cache=True, max_cache_len=None):
+        """input_ids [B, S] -> (generated_ids [B, max_new_tokens] int32,
+        lengths [B] int32). Rows that hit eos are padded with eos; lengths
+        count tokens up to and including it. Stops early once every row
+        is done."""
+        import numpy as np
+        B, S = input_ids.shape
+        limit = max_cache_len or S + max_new_tokens
+        if S + max_new_tokens > limit or \
+                S + max_new_tokens > self.gpt.cfg.max_position_embeddings:
+            # position lookups/cache writes past the table CLAMP under XLA
+            # (silently wrong tokens), so over-length requests must raise
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_position_embeddings="
+                f"{self.gpt.cfg.max_position_embeddings}"
+                + (f" / max_cache_len={max_cache_len}" if max_cache_len
+                   else ""))
+        picked = []
+        if use_cache:
+            cache = self.gpt.gen_cache(B, limit)
+            logits, cache = self.gpt(input_ids, cache=cache)
+            nxt = self._select(logits[:, -1], decode_strategy, temperature,
+                               top_k, top_p)
+        else:
+            ids = input_ids
+            logits = self.gpt(ids)
+            nxt = self._select(logits[:, -1], decode_strategy, temperature,
+                               top_k, top_p)
+        done = np.zeros((B,), bool)
+        for _ in range(max_new_tokens):
+            step_tokens = np.asarray(nxt.numpy(), np.int32)
+            if eos_token_id is not None:
+                step_tokens = np.where(done, eos_token_id, step_tokens)
+                done |= step_tokens == eos_token_id
+            picked.append(step_tokens)
+            if len(picked) == max_new_tokens or \
+                    (eos_token_id is not None and done.all()):
+                break
+            tok = Tensor(jnp.asarray(step_tokens)[:, None])
+            if use_cache:
+                logits, cache = self.gpt(tok, cache=cache)
+                nxt = self._select(logits[:, 0], decode_strategy, temperature,
+                                   top_k, top_p)
+            else:
+                from ...tensor.manipulation import concat
+                ids = concat([ids, tok.astype(ids.dtype)], axis=1)
+                logits = self.gpt(ids)
+                nxt = self._select(logits[:, -1], decode_strategy,
+                                   temperature, top_k, top_p)
+        out = np.stack(picked, axis=1)
+        if eos_token_id is None:
+            lengths = np.full((B,), out.shape[1], np.int32)
+        else:
+            hit = out == eos_token_id
+            first = np.where(hit.any(1), hit.argmax(1) + 1, out.shape[1])
+            lengths = first.astype(np.int32)
+        return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(lengths))
 
 
 def gpt_tiny(**kw):

@@ -254,8 +254,21 @@ def test_for_with_break():
     np.testing.assert_allclose(st(xs).numpy(), 4.0)
 
 
-# --------------------------------------------------- clear unsupported errors
-def test_return_in_loop_clear_error():
+# ------------------------------------------------------------ early returns
+# (reference: return_transformer.py — the __return__ flag + value ride the
+# same carry machinery as break/continue)
+def test_early_return_in_if():
+    def fn(x):
+        if x.sum() > 0:
+            return x * 10
+        return x - 1
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([2.0])).numpy(), [20.0])
+    np.testing.assert_allclose(st(_t([-2.0])).numpy(), [-3.0])
+
+
+def test_early_return_in_while_loop():
     def fn(x):
         while x.sum() < 100:
             x = x * 2
@@ -264,8 +277,62 @@ def test_return_in_loop_clear_error():
         return x
 
     st = to_static(fn)
-    with pytest.raises(Dy2StaticError, match="return"):
+    # 3 -> 6 -> 12 -> 24 -> 48 -> 96: the in-loop return fires at 96
+    np.testing.assert_allclose(st(_t([3.0])).numpy(), [96.0])
+    # 60: one doubling then the return path
+    np.testing.assert_allclose(st(_t([60.0])).numpy(), [120.0])
+
+
+def test_early_return_in_for_loop():
+    def fn(x):
+        s = x * 0
+        for _ in range(10):
+            s = s + x
+            if s.sum() > 5:
+                return s
+        return s - 1
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([2.0])).numpy(), [6.0])
+    # never crosses the threshold: falls through to the trailing return
+    np.testing.assert_allclose(st(_t([0.1])).numpy(), [0.0], atol=1e-6)
+
+
+def test_early_return_statements_after_skipped():
+    def fn(x):
+        if x.sum() > 0:
+            return x + 100
+        x = x * 2          # must not run on the returning path
+        return x
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [101.0])
+    np.testing.assert_allclose(st(_t([-1.0])).numpy(), [-2.0])
+
+
+# --------------------------------------------------- clear unsupported errors
+def test_list_append_in_traced_loop_clear_error():
+    def fn(x):
+        out = []
+        while x.sum() < 100:
+            x = x * 2
+            out.append(x)
+        return x
+
+    st = to_static(fn)
+    with pytest.raises(Dy2StaticError, match="list mutation"):
         st(_t([3.0]))
+
+
+def test_list_append_in_unrolled_loop_still_works():
+    def fn(x):
+        out = []
+        for i in range(3):
+            out.append(x * i)
+        return out[0] + out[1] + out[2]
+
+    st = to_static(fn)
+    np.testing.assert_allclose(st(_t([1.0])).numpy(), [3.0])
 
 
 # ------------------------------------------------------------- convert_call

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Render (and schema-validate) a serving metrics JSONL file.
+
+The scheduler (`paddle_tpu/serving/scheduler.py`, `metrics_path=`) writes
+two record kinds:
+
+  {"kind": "step", "step", "t", "queue_depth", "active_slots",
+   "tokens_generated"}
+  {"kind": "request", "request_id", "status", "prompt_len", "tokens",
+   "ttft_s", "decode_s"}
+
+`validate_records` is the schema contract the CI smoke test asserts on;
+the CLI renders a human summary: request outcomes, TTFT percentiles,
+decode throughput, queue depth and slot occupancy over the run.
+
+Usage: python tools/serve_report.py serve_metrics.jsonl
+"""
+import json
+import sys
+
+STEP_FIELDS = {"kind": str, "step": int, "t": (int, float),
+               "queue_depth": int, "active_slots": int,
+               "tokens_generated": int}
+REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
+                  "prompt_len": int, "tokens": int,
+                  "ttft_s": (int, float, type(None)),
+                  "decode_s": (int, float, type(None))}
+STATUSES = {"DONE", "TIMEOUT", "REJECTED"}
+
+
+def validate_records(records):
+    """Returns a list of schema violations ([] == valid)."""
+    errors = []
+    for i, rec in enumerate(records):
+        kind = rec.get("kind")
+        if kind not in ("step", "request"):
+            errors.append(f"record {i}: unknown kind {kind!r}")
+            continue
+        schema = STEP_FIELDS if kind == "step" else REQUEST_FIELDS
+        for field, types in schema.items():
+            if field not in rec:
+                errors.append(f"record {i} ({kind}): missing {field!r}")
+            elif not isinstance(rec[field], types):
+                errors.append(
+                    f"record {i} ({kind}): {field!r} has type "
+                    f"{type(rec[field]).__name__}")
+        extra = set(rec) - set(schema)
+        if extra:
+            errors.append(f"record {i} ({kind}): unexpected {sorted(extra)}")
+        if kind == "request" and rec.get("status") not in STATUSES:
+            errors.append(f"record {i}: bad status {rec.get('status')!r}")
+    return errors
+
+
+def load(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _pct(values, q):
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(int(q * (len(vs) - 1) + 0.5), len(vs) - 1)]
+
+
+def summarize(records):
+    steps = [r for r in records if r["kind"] == "step"]
+    reqs = [r for r in records if r["kind"] == "request"]
+    ttfts = [r["ttft_s"] for r in reqs if r["ttft_s"] is not None]
+    decode_s = sum(r["decode_s"] or 0.0 for r in reqs)
+    decode_tokens = sum(max(r["tokens"] - 1, 0) for r in reqs)
+    by_status = {}
+    for r in reqs:
+        by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+    return {
+        "steps": len(steps),
+        "requests": by_status,
+        "ttft_s": {"mean": sum(ttfts) / len(ttfts) if ttfts else None,
+                   "p50": _pct(ttfts, 0.50), "p95": _pct(ttfts, 0.95)},
+        "decode_tokens_per_s": (decode_tokens / decode_s
+                                if decode_s > 0 else None),
+        "queue_depth_max": max((s["queue_depth"] for s in steps), default=0),
+        "mean_active_slots": (sum(s["active_slots"] for s in steps)
+                              / len(steps) if steps else 0.0),
+    }
+
+
+def render(summary):
+    out = ["# serving report", ""]
+    out.append(f"scheduler steps: {summary['steps']}")
+    out.append("requests: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(summary["requests"].items())) or "none")
+    t = summary["ttft_s"]
+    if t["mean"] is not None:
+        out.append(f"TTFT s: mean={t['mean']:.4f} p50={t['p50']:.4f} "
+                   f"p95={t['p95']:.4f}")
+    if summary["decode_tokens_per_s"] is not None:
+        out.append(f"decode throughput: "
+                   f"{summary['decode_tokens_per_s']:.1f} tok/s")
+    out.append(f"max queue depth: {summary['queue_depth_max']}")
+    out.append(f"mean active slots: {summary['mean_active_slots']:.2f}")
+    return "\n".join(out)
+
+
+def main(argv):
+    if len(argv) != 2:
+        print(__doc__)
+        return 2
+    records = load(argv[1])
+    errors = validate_records(records)
+    if errors:
+        print("SCHEMA ERRORS:")
+        for e in errors:
+            print(" ", e)
+        return 1
+    print(render(summarize(records)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
