@@ -59,8 +59,63 @@ def emit(value, vs_baseline, extra=None, error=None):
     sys.stdout.flush()
 
 
-def emit_failure(error):
-    emit(0.0, 0.0, error=error)
+def emit_failure(error, extra=None):
+    emit(0.0, 0.0, extra=extra, error=error)
+
+
+_FR_MODULE = None
+
+
+def _flight_recorder_module():
+    """The flight-recorder module WITHOUT risking a jax import: use the
+    package when paddle_tpu is already loaded; otherwise load the module
+    file standalone (it is stdlib-only by contract) — so a postmortem can
+    be written even when `import jax` is the thing that wedged."""
+    global _FR_MODULE
+    if _FR_MODULE is not None:
+        return _FR_MODULE
+    try:
+        # key on the fully-imported SUBMODULE, never on "paddle_tpu": a
+        # wedge inside `import paddle_tpu` leaves the package partially
+        # initialized in sys.modules with the import lock held — a fresh
+        # package import from the watchdog thread would block behind it
+        fr = sys.modules.get("paddle_tpu.observability.flight_recorder")
+        if fr is None:
+            import importlib.util
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "paddle_tpu", "observability", "flight_recorder.py")
+            spec = importlib.util.spec_from_file_location(
+                "_bench_flight_recorder", path)
+            fr = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(fr)
+        _FR_MODULE = fr
+    except Exception as e:                                   # noqa: BLE001
+        print(f"bench: flight recorder unavailable: {e}", file=sys.stderr)
+    return _FR_MODULE
+
+
+def _postmortem_extra(reason):
+    """Dump a flight-recorder postmortem and return the structured-failure
+    extra: the artifact path + a flat last-metrics snapshot. Never raises
+    — the failure line must go out even if forensics fail (the round-5
+    'value 0.0, zero evidence' record is the bug this fixes)."""
+    fr = _flight_recorder_module()
+    if fr is None:
+        return {}
+    out = {}
+    try:
+        out["postmortem"] = fr.dump_postmortem(reason)
+    except Exception as e:                                   # noqa: BLE001
+        out["postmortem_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    try:
+        mm = sys.modules.get("paddle_tpu.observability.metrics")
+        if mm is not None:
+            out["last_metrics_snapshot"] = mm.flatten_snapshot(
+                mm.registry().snapshot())
+    except Exception:                                        # noqa: BLE001
+        pass
+    return out
 
 
 def _is_oom(e):
@@ -125,10 +180,38 @@ def start_watchdog(seconds, what, on_fire=None):
     """Emit the structured-failure line and hard-exit if `seconds` pass
     before cancel() — covers an in-process wedge after a successful probe
     (the hang releases the GIL: it blocks on socket I/O). `on_fire` lets
-    other benches (bench_eager) emit their own metric's failure record."""
+    other benches (bench_eager) emit their own metric's failure record;
+    it must accept (reason, extra=None) and include `extra` (postmortem
+    path + last metrics) in its record.
+
+    Before the line goes out, the flight recorder dumps a postmortem
+    (thread stacks incl. the wedged one, span ring, metrics snapshot) and
+    its path + the last metrics ride the record's `extra` — a wedged run
+    can no longer end with `value: 0.0` and zero evidence. The forensics
+    themselves run under a second hard timer: if the dump wedges too
+    (e.g. a metrics collector touching the stuck runtime), the bare
+    failure line still goes out — evidence is best-effort, the record is
+    guaranteed."""
     def fire():
-        (on_fire or emit_failure)(f"watchdog: {what} wedged for >{seconds}s")
-        os._exit(0)
+        reason = f"watchdog: {what} wedged for >{seconds}s"
+        emitter = on_fire or emit_failure
+        # exactly ONE record may reach stdout (the one-JSON-line bench
+        # contract): whichever of the two paths below wins this lock emits
+        emit_once = threading.Lock()
+
+        def bare_exit():
+            if emit_once.acquire(blocking=False):
+                emitter(reason)
+                os._exit(0)
+
+        backstop = threading.Timer(20, bare_exit)
+        backstop.daemon = True
+        backstop.start()
+        extra = _postmortem_extra(reason)   # artifact lands on disk here
+        backstop.cancel()
+        if emit_once.acquire(blocking=False):
+            emitter(reason, extra=extra)    # all emitters take extra=
+            os._exit(0)
     t = threading.Timer(seconds, fire)
     t.daemon = True
     t.start()
@@ -288,6 +371,19 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
                     f"note: the train step is ONE fused XLA program, so "
                     f"host attribution lands in the Forward dispatch span; "
                     f"per-op rows appear for eager workloads.\n")
+        # unified-registry artifacts next to the timeline: one JSONL
+        # snapshot (metrics.v1) + the Prometheus text dump, both
+        # schema-validated by tests/test_perf_pipeline.py and rendered/
+        # compared by tools/metrics_report.py
+        from paddle_tpu.observability import metrics as _obs_metrics
+        reg = _obs_metrics.registry()
+        profile_paths["metrics"] = os.path.join(_PROFILE_DIR,
+                                                "metrics.jsonl")
+        reg.write_snapshot(profile_paths["metrics"])
+        profile_paths["metrics_prom"] = os.path.join(_PROFILE_DIR,
+                                                     "metrics.prom")
+        with open(profile_paths["metrics_prom"], "w") as f:
+            f.write(reg.dump_prometheus())
 
     total_steps = n_dispatch * scan_k
     tokens_per_sec = B * S * total_steps / dt
@@ -398,6 +494,27 @@ def main(argv=None):
     import jax
     assert jax.default_backend() == backend
     wd.cancel()
+
+    # from here paddle_tpu will load: keep the last spans + metrics in a
+    # ring so every watchdog/crash path below has forensics to dump
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.observability import flight_recorder as _fr
+    _fr.enable(capacity=int(os.environ.get("BENCH_FR_CAPACITY", 512)),
+               install_signal_handler=True)
+
+    # test hook (tests/test_observability.py): simulate the round-5 wedge —
+    # block inside an open span until the rung watchdog fires, and assert
+    # the failure record points at a real postmortem artifact
+    wedge_s = float(os.environ.get("BENCH_INJECT_WEDGE_S", 0) or 0)
+    if wedge_s:
+        from paddle_tpu.profiler import RecordEvent, TracerEventType
+        with RecordEvent("bench.pre_wedge_setup",
+                         TracerEventType.UserDefined):
+            pass                        # a closed span for the ring
+        start_watchdog(wedge_s, "test-injected wedge")
+        with RecordEvent("bench.wedged_probe", TracerEventType.UserDefined):
+            time.sleep(3600)            # the watchdog ends the process
+        return
 
     if args.decode:
         global METRIC, UNIT
@@ -543,4 +660,7 @@ if __name__ == "__main__":
     except SystemExit:      # argparse --help / usage error, not a bench fail
         raise
     except BaseException as e:                               # noqa: BLE001
-        emit_failure(f"{type(e).__name__}: {str(e)[:600]}")
+        err = f"{type(e).__name__}: {str(e)[:600]}"
+        # probe timeouts / wedges included: the record carries the
+        # flight-recorder artifact + last metrics, never a bare 0.0
+        emit_failure(err, extra=_postmortem_extra(err))

@@ -177,12 +177,17 @@ def main():
     }))
 
 
-def _emit_failure(error):
-    # the one-JSON-line contract holds on failure too (bench.py rule)
-    print(json.dumps({
+def _emit_failure(error, extra=None):
+    # the one-JSON-line contract holds on failure too (bench.py rule);
+    # `extra` carries the watchdog's flight-recorder evidence (postmortem
+    # path + last metrics snapshot) when the failure came from a wedge
+    rec = {
         "metric": "eager_mlp_step_ms", "value": 0.0,
         "unit": "ms per eager train step (fwd+bwd+SGD)",
-        "vs_baseline": 0.0, "error": error}))
+        "vs_baseline": 0.0, "error": error}
+    if extra:
+        rec["extra"] = extra
+    print(json.dumps(rec))
 
 
 _run_wd = None

@@ -59,6 +59,7 @@ from . import quantization  # noqa: F401
 from . import geometric  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import serving  # noqa: F401
+from . import observability  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401

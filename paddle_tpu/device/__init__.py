@@ -11,7 +11,13 @@ def op_cache_stats():
     — the stats device.cuda exposes for HBM, for the dispatch cache:
     {hits, misses, bypass, size, hit_rate}. `size` is the number of cached
     compiled-op runners; `bypass` counts dispatches whose op identity was
-    unhashable (correct but uncached)."""
+    unhashable (correct but uncached).
+
+    These counters are ALSO published to the unified metrics registry as
+    `op_cache_*` gauges (via a snapshot-time collector, so the dispatch
+    hot path is untouched). Reading `core.tensor._CACHE_STATS` directly
+    is deprecated — this function and the registry are the public
+    surfaces."""
     from ..core import tensor as _t
     total = _t._CACHE_STATS["hits"] + _t._CACHE_STATS["misses"]
     return {
@@ -21,6 +27,30 @@ def op_cache_stats():
         "size": len(_t._EAGER_CACHE),
         "hit_rate": (_t._CACHE_STATS["hits"] / total) if total else 0.0,
     }
+
+
+def _collect_op_cache(reg):
+    """Metrics-registry collector: mirror op_cache_stats() into gauges at
+    snapshot time (gauges, not counters, because reset_op_cache_stats()
+    legitimately zeroes the underlying values)."""
+    s = op_cache_stats()
+    reg.gauge("op_cache_hits",
+              "Eager op-cache hits since the last reset").set(s["hits"])
+    reg.gauge("op_cache_misses",
+              "Eager op-cache misses since the last reset").set(s["misses"])
+    reg.gauge("op_cache_bypass",
+              "Uncacheable eager dispatches since the last reset"
+              ).set(s["bypass"])
+    reg.gauge("op_cache_size",
+              "Cached compiled-op runners held right now").set(s["size"])
+    reg.gauge("op_cache_hit_rate",
+              "hits / (hits + misses) since the last reset"
+              ).set(s["hit_rate"])
+
+
+from ..observability import metrics as _metrics  # noqa: E402
+
+_metrics.registry().register_collector(_collect_op_cache)
 
 
 def reset_op_cache_stats():

@@ -27,15 +27,37 @@ Wire format (little-endian; full spec in docs/ps_graph.md):
             -> u32 feat_dim | n*feat_dim*f32
   GDEGREE:  hdr | u16 tlen | tlen etype | n*i64
             -> u32 n | n*i64 degrees
+
+Trace propagation (ISSUE 4): when the caller has an active trace
+(observability.tracecontext — a running profiler window sets one), the
+client sets bit 0x80 in the op byte and appends the 24-byte trace
+context `16B trace_id | 8B client_span_id` right after the header. The
+server strips the flag, reads the context, and parents its handler span
+under the REMOTE client span, so per-process chrome exports merge into
+one causally-linked timeline (merge_chrome_traces). Unflagged frames are
+served unchanged — old clients interoperate.
+
+Metrics: both halves report to the unified registry — per-verb latency
+histograms (`ps_client_request_seconds` / `ps_server_request_seconds`),
+per-verb byte counters, a connection-pool gauge, and in-band error
+counts (`ps_errors_total{side=...}`).
 """
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
+from ...observability import metrics as _metrics
+from ...observability import tracecontext as _tc
+from ...profiler import TracerEventType, _tracer
+
 OP_PULL, OP_PUSH, OP_PING, OP_STOP = 0, 1, 2, 3
 OP_GSAMPLE, OP_GFEAT, OP_GDEGREE = 4, 5, 6
+_OP_NAMES = {OP_PULL: "PULL", OP_PUSH: "PUSH", OP_PING: "PING",
+             OP_STOP: "STOP", OP_GSAMPLE: "GSAMPLE", OP_GFEAT: "GFEAT",
+             OP_GDEGREE: "GDEGREE"}
 _HDR = struct.Struct("<BII")
 _GS = struct.Struct("<iBH")       # seed | weighted | edge-type length
 _TL = struct.Struct("<H")         # type-name length
@@ -46,9 +68,54 @@ _U32 = struct.Struct("<I")
 # the real cause, and the connection stays usable
 _ERR = 0xFFFFFFFF
 
+# RPC-fabric metrics (module-level families: every client/server in the
+# process reports into the same labeled series)
+_M_CLIENT_SECONDS = _metrics.histogram(
+    "ps_client_request_seconds",
+    "PS RPC client round-trip latency per verb", labelnames=("verb",))
+_M_SERVER_SECONDS = _metrics.histogram(
+    "ps_server_request_seconds",
+    "PS RPC server handler time per verb", labelnames=("verb",))
+_M_CLIENT_BYTES = _metrics.counter(
+    "ps_client_bytes_total",
+    "PS RPC client wire bytes per verb and direction",
+    labelnames=("verb", "direction"))
+_M_SERVER_BYTES = _metrics.counter(
+    "ps_server_bytes_total",
+    "PS RPC server wire bytes per verb and direction",
+    labelnames=("verb", "direction"))
+_M_POOL = _metrics.gauge(
+    "ps_client_pool_connections",
+    "Open PS client pool sockets in this process")
+_M_ERRORS = _metrics.counter(
+    "ps_errors_total",
+    "In-band PS error frames, by which side observed them",
+    labelnames=("side",))
+
 
 class PSServerError(RuntimeError):
     """A server-side serving error relayed over the wire verbatim."""
+
+
+class _MeteredSock:
+    """Socket proxy that counts wire bytes both ways — the client byte
+    metrics stay exact without touching any reader closure."""
+
+    __slots__ = ("_s", "sent_bytes", "recv_bytes")
+
+    def __init__(self, s):
+        self._s = s
+        self.sent_bytes = 0
+        self.recv_bytes = 0
+
+    def sendall(self, data):
+        self._s.sendall(data)
+        self.sent_bytes += len(data)
+
+    def recv_into(self, buf, nbytes=0):
+        r = self._s.recv_into(buf, nbytes)
+        self.recv_bytes += r
+        return r
 
 
 def _recv_exact(sock, n):
@@ -93,18 +160,27 @@ class PSServer:
                              daemon=True).start()
 
     def _serve(self, conn):
+        mconn = _MeteredSock(conn)      # request/response bytes per verb
         try:
             while True:
-                op, n, aux = _HDR.unpack(_recv_exact(conn, _HDR.size))
+                b0 = mconn.recv_bytes
+                op, n, aux = _HDR.unpack(_recv_exact(mconn, _HDR.size))
+                rctx = None
+                if op & _tc.WIRE_FLAG:
+                    # trace context rides the frame: strip the flag, read
+                    # the 24 ctx bytes, parent our span under the caller's
+                    op &= ~_tc.WIRE_FLAG
+                    rctx = _tc.unpack_ctx(
+                        _recv_exact(mconn, _tc.CTX_WIRE_BYTES))
                 if op == OP_STOP:
                     self._stop.set()
                     try:
                         self._sock.close()
                     finally:
-                        conn.sendall(_U32.pack(0))
+                        mconn.sendall(_U32.pack(0))
                     return
                 if op == OP_PING:
-                    conn.sendall(_U32.pack(0))
+                    mconn.sendall(_U32.pack(0))
                     continue
                 if op in (OP_PULL, OP_PUSH):
                     handler = self._serve_sparse
@@ -112,18 +188,41 @@ class PSServer:
                     handler = self._serve_graph
                 else:
                     raise ConnectionError(f"unknown op {op}")
+                verb = _OP_NAMES[op]
+                span = _tracer.begin(f"ps.server::{verb}",
+                                     TracerEventType.Communication,
+                                     attrs={"n": int(n)})
+                if span is not None and rctx is not None:
+                    # cross-process parenting: the remote client span is
+                    # this span's parent, in the caller's trace
+                    span["trace"], span["parent"] = rctx
+                t0 = time.perf_counter()
                 try:
                     # handlers consume the FULL request body before any
                     # table/graph work, so a serving error leaves the
                     # stream in sync and we can answer with an error frame
                     # instead of killing the connection
-                    resp = handler(conn, op, n, aux)
+                    resp = handler(mconn, op, n, aux)
                 except (ConnectionError, OSError):
+                    _tracer.cancel(span)
                     raise
                 except Exception as e:  # noqa: BLE001 — relayed to caller
                     msg = f"{type(e).__name__}: {e}".encode()[:65536]
                     resp = _U32.pack(_ERR) + _U32.pack(len(msg)) + msg
-                conn.sendall(resp)
+                    _M_ERRORS.labels(side="server").inc()
+                    if span is not None:
+                        span.setdefault("attrs", {})["error"] = msg.decode(
+                            errors="replace")[:200]
+                finally:
+                    _M_SERVER_SECONDS.labels(verb=verb).observe(
+                        time.perf_counter() - t0)
+                if span is not None and span.get("dur") is None:
+                    _tracer.end(span)
+                _M_SERVER_BYTES.labels(verb=verb, direction="in").inc(
+                    mconn.recv_bytes - b0)
+                _M_SERVER_BYTES.labels(verb=verb, direction="out").inc(
+                    len(resp))
+                mconn.sendall(resp)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -192,28 +291,65 @@ class ShardClientBase:
             s = socket.create_connection((host, int(port)), timeout=30)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
+            _M_POOL.inc()
         return self._socks[i]
+
+    def _drop_sock(self, i):
+        if self._socks[i] is not None:
+            try:
+                self._socks[i].close()
+            except OSError:
+                pass
+            self._socks[i] = None
+            _M_POOL.dec()
 
     def _exchange(self, i, msg, reader):
         """Send one framed request to shard i, parse the reply with
-        `reader(sock)` under the per-shard lock."""
-        with self._locks[i]:
-            try:
-                s = self._sock(i)
-                s.sendall(msg)
-                return reader(s)
-            except PSServerError:
-                raise   # error frame fully consumed: stream still in sync
-            except Exception:
-                # a half-consumed socket would desynchronize the framing for
-                # every later request: drop it so the next call reconnects
-                if self._socks[i] is not None:
-                    try:
-                        self._socks[i].close()
-                    except OSError:
-                        pass
-                    self._socks[i] = None
-                raise
+        `reader(sock)` under the per-shard lock.
+
+        This is the fabric's single choke point, so the observability
+        riders live here: a `ps.client::<verb>` span whose id travels in
+        the frame when a trace is active (the 0x80 header-flag path), the
+        per-verb latency histogram, and exact sent/received byte counts
+        (received metered through a counting socket proxy so the reader
+        closures stay untouched)."""
+        verb = _OP_NAMES.get(msg[0] & ~_tc.WIRE_FLAG, str(msg[0]))
+        span = _tracer.begin(f"ps.client::{verb}",
+                             TracerEventType.Communication,
+                             attrs={"shard": i,
+                                    "endpoint": self.endpoints[i]})
+        trace_id = _tc.current_trace_id()
+        if trace_id is not None:
+            span_id = span["span_id"] if span is not None \
+                else _tc.new_span_id()
+            msg = (bytes((msg[0] | _tc.WIRE_FLAG,)) + msg[1:_HDR.size]
+                   + _tc.pack_ctx(trace_id, span_id) + msg[_HDR.size:])
+        t0 = time.perf_counter()
+        try:
+            with self._locks[i]:
+                try:
+                    s = _MeteredSock(self._sock(i))
+                    s.sendall(msg)
+                    out = reader(s)
+                except PSServerError:
+                    # error frame fully consumed: stream still in sync
+                    _M_ERRORS.labels(side="client").inc()
+                    raise
+                except Exception:
+                    # a half-consumed socket would desynchronize the framing
+                    # for every later request: drop it so the next call
+                    # reconnects
+                    self._drop_sock(i)
+                    raise
+            _M_CLIENT_BYTES.labels(verb=verb, direction="sent").inc(
+                s.sent_bytes)
+            _M_CLIENT_BYTES.labels(verb=verb, direction="recv").inc(
+                s.recv_bytes)
+            return out
+        finally:
+            _M_CLIENT_SECONDS.labels(verb=verb).observe(
+                time.perf_counter() - t0)
+            _tracer.end(span)
 
     def _route(self, keys):
         from . import shard_for
@@ -240,10 +376,8 @@ class ShardClientBase:
                 pass
 
     def close(self):
-        for s in self._socks:
-            if s is not None:
-                s.close()
-        self._socks = [None] * len(self.endpoints)
+        for i in range(len(self._socks)):
+            self._drop_sock(i)
 
 
 class PSClient(ShardClientBase):

@@ -17,14 +17,22 @@ import os
 import pickle
 import queue
 import threading
+import time
 
 import numpy as np
 
 from ..core.random import _default_generator
 from ..core.tensor import Tensor, to_tensor
+from ..observability import metrics as _metrics
 from ..profiler import _tracer as _TRACER
 from .worker import (WorkerInfo, collate, get_worker_info, numpy_collate,
                      worker_loop)
+
+# unified-registry view of the Dataloader span: how long the training
+# loop blocks waiting for each batch (the dataloader-bound step phase)
+_DL_WAIT = _metrics.histogram(
+    "dataloader_wait_seconds",
+    "Time the training loop blocks waiting for the next batch")
 
 
 class Dataset:
@@ -288,8 +296,12 @@ class DataLoader:
         of the step — not worker-side compute."""
         it = self._base_iter()
         while True:
+            # per-batch (not per-op) cost: also feed the flight-recorder
+            # ring when one is attached, so a postmortem shows whether the
+            # loop was waiting on data when the process wedged
             rec = _TRACER.begin("DataLoader.next", "Dataloader") \
-                if _TRACER.enabled else None
+                if (_TRACER.enabled or _TRACER.ring is not None) else None
+            t0 = time.perf_counter()
             try:
                 batch = next(it)
             except StopIteration:
@@ -298,6 +310,7 @@ class DataLoader:
             except BaseException:
                 _TRACER.cancel(rec)
                 raise
+            _DL_WAIT.observe(time.perf_counter() - t0)
             _TRACER.end(rec)
             yield batch
 

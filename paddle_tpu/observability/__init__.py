@@ -1,0 +1,62 @@
+"""paddle_tpu.observability — the unified metrics/trace/postmortem substrate.
+
+ISSUE 4's tentpole: PR 1 (profiler spans), PR 2 (PS RPC fabric) and PR 3
+(serving counters) each grew private ad-hoc counters and JSONL formats,
+and a wedged run could still die without evidence. This package is the
+one substrate they all report through:
+
+  metrics.py         — Counter/Gauge/Histogram registry with label sets,
+                       consistent snapshots, JSONL (metrics.v1) +
+                       Prometheus text exposition; zero-cost when
+                       disabled. Rendered/compared by
+                       tools/metrics_report.py.
+  tracecontext.py    — trace/span ids, thread+process propagation scope,
+                       the 24-byte wire context the PS RPC frames carry,
+                       and merge_chrome_traces() for one causally-linked
+                       multi-process timeline.
+  flight_recorder.py — bounded ring of recent spans + watchdog + SIGTERM
+                       hook; dumps thread stacks, the span ring, and a
+                       metrics snapshot to a postmortem artifact
+                       (postmortem.v1) on hang/crash.
+
+Producers already wired in: serving scheduler (queue depth, slot
+occupancy, admission/timeout/reject counts, tokens, TTFT), PS RPC client
+and server (per-verb latency/bytes, pool size, in-band errors),
+io.DataLoader (wait-time histogram), device op-cache (hits/misses via a
+collector), and live/peak device bytes (collector below).
+
+All three submodules are stdlib-only: importable before (or without)
+jax, which is what lets bench.py write a postmortem for a wedged
+backend init.
+"""
+import sys
+
+from . import flight_recorder, metrics, tracecontext  # noqa: F401
+from .flight_recorder import dump_postmortem  # noqa: F401
+from .metrics import registry  # noqa: F401
+from .tracecontext import merge_chrome_traces, trace_scope  # noqa: F401
+
+__all__ = ["metrics", "tracecontext", "flight_recorder", "registry",
+           "dump_postmortem", "trace_scope", "merge_chrome_traces"]
+
+
+def _collect_live_bytes(reg):
+    """Snapshot-time collector: live device bytes now + the peak observed
+    across snapshots (the HBM high-water proxy `jax.live_arrays` can
+    answer). Touches jax only if the process already imported it — a
+    metrics snapshot must never trigger backend init."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        live = int(sum(a.size * a.dtype.itemsize for a in jax.live_arrays()))
+    except Exception:                                        # noqa: BLE001
+        return
+    reg.gauge("live_device_bytes",
+              "Bytes of device arrays the process currently holds").set(live)
+    reg.gauge("live_device_bytes_peak",
+              "High-water mark of live_device_bytes across snapshots"
+              ).set_to_max(live)
+
+
+registry().register_collector(_collect_live_bytes)

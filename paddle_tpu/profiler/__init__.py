@@ -29,6 +29,12 @@ import time
 import jax
 import numpy as np
 
+from ..observability.tracecontext import (
+    clear_trace as _clear_trace, current_trace_id as _current_trace_id,
+    ensure_trace as _ensure_trace, new_span_id as _new_span_id,
+    process_trace_id as _process_trace_id,
+)
+
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "TracerEventType", "SortedKeys", "SummaryView",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
@@ -91,32 +97,56 @@ class _HostTracer:
 
     The `enabled` attribute IS the hot-path guard: instrumentation sites
     check it before building any span metadata, so a CLOSED profiler costs
-    one attribute load per op."""
+    one attribute load per op.
+
+    Thread safety (serving scheduler workers hammer this from several
+    threads at once): every thread owns its own nesting stack in
+    `_stacks` (keyed by thread id — a plain dict entry each thread
+    mutates alone, readable cross-thread by the flight recorder's
+    postmortem dump), span/parent ids are assigned FROM that per-thread
+    stack so a span's parent is always a span of the same thread, and
+    the shared `events` list is only ever touched under `_lock`.
+
+    Trace context: every span carries a fresh 8-byte `span_id`, its
+    same-thread `parent` span id, and the current `trace` id
+    (observability.tracecontext) — the fields the PS RPC fabric
+    propagates cross-process and export_chrome_tracing emits.
+
+    Flight recorder: when `ring` is attached (observability.
+    flight_recorder), closed spans are ALSO pushed there — including
+    spans recorded while the profiler is CLOSED, so a postmortem always
+    has recent history."""
 
     def __init__(self):
         self.enabled = False
         self.sample_memory = False
         self.with_flops = True
         self.events = []
+        self.ring = None                 # FlightRecorder, when enabled
         self._lock = threading.Lock()
-        self._tls = threading.local()
+        self._stacks = {}                # thread id -> open-span stack
         self._ref_seen = set()
 
     def _stack(self):
-        st = getattr(self._tls, "stack", None)
+        tid = threading.get_ident()
+        st = self._stacks.get(tid)
         if st is None:
-            st = []
-            self._tls.stack = st
+            st = self._stacks.setdefault(tid, [])
         return st
 
     def begin(self, name, event_type, attrs=None, ref=None):
-        if not self.enabled:
+        if not self.enabled and self.ring is None:
             return None
         st = self._stack()
         rec = {"name": name, "type": event_type,
                "tid": threading.get_ident(),
                "ts": time.perf_counter_ns(), "dur": None,
-               "depth": len(st)}
+               "depth": len(st),
+               "span_id": _new_span_id(),
+               "parent": st[-1]["span_id"] if st else None,
+               "trace": _current_trace_id()}
+        if not self.enabled:             # ring-only span: keep it out of
+            rec["_fr_only"] = True       # the profiler's window events
         if attrs is not None:
             rec["attrs"] = attrs
         if ref is not None:
@@ -134,9 +164,16 @@ class _HostTracer:
             st.pop()
         elif rec in st:                   # unbalanced nesting: drop through
             st.remove(rec)
+        if not st:                        # evict: dead threads must not
+            self._stacks.pop(threading.get_ident(), None)  # leak entries
         rec["dur"] = time.perf_counter_ns() - rec["ts"]
         if self.sample_memory:
             rec["mem1"] = _live_bytes()
+        ring = self.ring
+        if ring is not None:
+            ring.record_span(rec)
+        if rec.pop("_fr_only", False):
+            return
         with self._lock:
             self.events.append(rec)
 
@@ -150,6 +187,8 @@ class _HostTracer:
             st.pop()
         elif rec in st:
             st.remove(rec)
+        if not st:
+            self._stacks.pop(threading.get_ident(), None)
 
     def note(self, key, value):
         """Attach a key to the innermost open span on this thread (used by
@@ -212,7 +251,7 @@ class RecordEvent:
 
     def begin(self):
         self._rec = _tracer.begin(self.name, self.event_type, self.attrs)
-        if _tracer.enabled:
+        if self._rec is not None and _tracer.enabled:
             self._ann = jax.profiler.TraceAnnotation(self.name)
             self._ann.__enter__()
 
@@ -255,7 +294,13 @@ def export_chrome_tracing(dir_name, worker_name=None):
     Exports the LAST RECORD WINDOW only (an empty window exports as empty —
     never silently the cumulative history), and maps each (thread, nesting
     depth) to its own tid lane with thread_name metadata so nested spans
-    render stacked instead of flattened."""
+    render stacked instead of flattened.
+
+    Every span's args carry its trace_id/span_id/parent_span_id, and the
+    file's otherData carries clock_sync_ns (wall-clock epoch minus this
+    process's perf_counter origin) — the two ingredients
+    observability.merge_chrome_traces needs to fold the per-process
+    exports of a distributed run into one causally-linked timeline."""
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
@@ -273,9 +318,15 @@ def export_chrome_tracing(dir_name, worker_name=None):
             ev = {"name": e["name"], "cat": e["type"], "ph": "X",
                   "pid": pid, "tid": lane,
                   "ts": e["ts"] / 1000.0, "dur": (e["dur"] or 0) / 1000.0}
-            attrs = _json_safe_attrs(e)
-            if attrs:
-                ev["args"] = attrs
+            args = _json_safe_attrs(e) or {}
+            if e.get("span_id"):
+                args["span_id"] = e["span_id"]
+            if e.get("parent"):
+                args["parent_span_id"] = e["parent"]
+            if e.get("trace"):
+                args["trace_id"] = e["trace"]
+            if args:
+                ev["args"] = args
             events.append(ev)
         meta = []
         for (tid, depth), lane in sorted(lanes.items(), key=lambda kv: kv[1]):
@@ -286,7 +337,11 @@ def export_chrome_tracing(dir_name, worker_name=None):
                          "tid": lane, "args": {"sort_index": lane}})
         with open(path, "w") as f:
             json.dump({"traceEvents": meta + events,
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "otherData": {
+                           "clock_sync_ns":
+                               time.time_ns() - time.perf_counter_ns(),
+                           "pid": pid}}, f)
         prof._exported_path = path
     return handler
 
@@ -386,6 +441,16 @@ class Profiler:
             self._on_trace_ready(self)
 
     def start(self):
+        # one trace id for everything this window records — and for every
+        # PS RPC issued under it, in every process it reaches. If WE set
+        # it, stop() clears it: post-window RPCs must not keep paying the
+        # propagation bytes for span ids no export will contain, and the
+        # next window gets a fresh trace (one trace id per causal unit).
+        # ownership keys on the PROCESS default, not current_trace_id():
+        # a thread-local trace_scope would mask the process slot and leave
+        # the id ensure_trace() installs here uncleared forever
+        self._owns_trace = _process_trace_id() is None
+        _ensure_trace()
         self._last_t = time.perf_counter()
         self._transition(self._target_state())
         self._open_step_span()
@@ -397,6 +462,9 @@ class Profiler:
         if self._recording():
             self._collect()
         self._state = ProfilerState.CLOSED
+        if getattr(self, "_owns_trace", False):
+            _clear_trace()
+            self._owns_trace = False
 
     def _open_step_span(self):
         self._step_mark = _tracer.mark()

@@ -28,6 +28,7 @@ import threading
 import time
 
 from .. import native
+from ..observability import metrics as _metrics
 from ..profiler import RecordEvent, TracerEventType
 
 __all__ = ["ServingConfig", "Scheduler", "Request", "RequestHandle",
@@ -39,8 +40,30 @@ DONE = "DONE"
 TIMEOUT = "TIMEOUT"
 REJECTED = "REJECTED"
 
+# DEPRECATED counter surface: the per-instance `Scheduler.counts` dict and
+# the free-standing `native.stat_*` names below are kept for callers that
+# already read them, but the source of truth is now the unified metrics
+# registry (paddle_tpu.observability.metrics) — the families registered
+# here, exported via registry().snapshot()/dump_prometheus() and rendered
+# by tools/metrics_report.py.
 _COUNTERS = ("serving.admitted", "serving.completed", "serving.rejected",
              "serving.timeout", "serving.tokens")
+
+_M_REQUESTS = _metrics.counter(
+    "serving_requests_total",
+    "Serving requests by terminal/admission status",
+    labelnames=("status",))
+_M_TOKENS = _metrics.counter(
+    "serving_tokens_total", "Tokens emitted by the serving engine")
+_M_QUEUE_DEPTH = _metrics.gauge(
+    "serving_queue_depth", "Admission-queue depth after the last step")
+_M_OCCUPANCY = _metrics.gauge(
+    "serving_slot_occupancy",
+    "Fraction of decode slots occupied after the last step")
+_M_TTFT = _metrics.histogram(
+    "serving_ttft_seconds", "Time to first token per completed request")
+_M_DECODE_SECONDS = _metrics.histogram(
+    "serving_decode_step_seconds", "Wall time of one engine decode step")
 
 
 class QueueFullError(RuntimeError):
@@ -180,12 +203,16 @@ class Scheduler:
             tokens = self.engine.decode()
             dt = self._clock() - t0
             self._decode_time_s += dt
+            _M_DECODE_SECONDS.observe(dt)
             for slot, req in enumerate(self._slots):
                 if req is not None:
                     req.tokens.append(int(tokens[slot]))
                     self._decode_tokens += 1
                     self._count("serving.tokens")
         self._steps += 1
+        _M_QUEUE_DEPTH.set(len(self._queue))
+        _M_OCCUPANCY.set(sum(1 for s in self._slots if s is not None)
+                         / max(self.engine.slots, 1))
         self._write_step_record(now, len(active))
         return bool(self._queue or any(s is not None for s in self._slots))
 
@@ -270,12 +297,20 @@ class Scheduler:
         req.status = status
         req.finished_at = self._clock()
         self._count(counter)
+        if req.first_token_at is not None:
+            _M_TTFT.observe(req.first_token_at - req.submitted_at)
         if status in (DONE, TIMEOUT):
             self._completed.append(req)
             self._write_request_record(req)
         req._done.set()
 
     def _count(self, name):
+        # registry first (the unified surface), then the deprecated
+        # per-instance dict + native stat mirror for existing readers
+        if name == "serving.tokens":
+            _M_TOKENS.inc()
+        else:
+            _M_REQUESTS.labels(status=name.split(".", 1)[1]).inc()
         self.counts[name] += 1
         native.stat_add(name, 1)
 

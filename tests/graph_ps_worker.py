@@ -9,6 +9,13 @@ to ship no data — just fork, read back the endpoint, and sample.
 Invoked as: graph_ps_worker.py <shard_id> <num_shards> <endpoint_file>
 Port is OS-assigned (bind port 0) and published atomically through
 <endpoint_file>; the server runs until a client sends OP_STOP.
+
+With PTN_TRACE_EXPORT_DIR set, the worker records its handler spans
+under a Profiler and exports a chrome trace there on shutdown — the
+server half of the cross-process trace-merge test: requests arriving
+with a trace context (rpc wire flag 0x80) yield `ps.server::*` spans
+parented under the REMOTE client span, so the per-process exports merge
+into one causally-linked timeline.
 """
 import os
 import sys
@@ -56,6 +63,15 @@ def main():
                                      sys.argv[3])
     from paddle_tpu.distributed.ps import PSServer
 
+    prof = None
+    trace_dir = os.environ.get("PTN_TRACE_EXPORT_DIR")
+    if trace_dir:
+        from paddle_tpu.profiler import Profiler, export_chrome_tracing
+        prof = Profiler(timer_only=True,
+                        on_trace_ready=export_chrome_tracing(
+                            trace_dir, worker_name=f"ps_shard{shard_id}"))
+        prof.start()
+
     graph, _ = build_demo_shard(shard_id, num_shards)
     server = PSServer(graph=graph)
     tmp = ep_file + ".tmp"
@@ -64,6 +80,9 @@ def main():
     os.replace(tmp, ep_file)            # atomic publish
     while not server._stop.is_set():
         time.sleep(0.05)
+    if prof is not None:
+        time.sleep(0.2)                 # let in-flight handler spans close
+        prof.stop()                     # collect + export the chrome trace
 
 
 if __name__ == "__main__":

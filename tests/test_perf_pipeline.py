@@ -1,7 +1,13 @@
 """CI guard for the perf-evidence pipeline: `bench.py --profile --steps 2`
 on CPU must emit a schema-valid step-timeline JSONL + attribution report,
 and tools/perf_report.py must render both — so the artifacts a dead TPU
-grant leaves behind can never silently rot."""
+grant leaves behind can never silently rot.
+
+ISSUE 4 extends the same guard to the unified metrics registry: the run
+also leaves a metrics-snapshot JSONL (paddle_tpu.metrics.v1) and a
+Prometheus text dump, both schema-validated here, and
+tools/metrics_report.py --compare (the counter-regression gate) is
+exercised against them."""
 import json
 import os
 import subprocess
@@ -11,6 +17,7 @@ import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_ROOT, "tools"))
+import metrics_report  # noqa: E402
 import perf_report  # noqa: E402
 
 
@@ -68,6 +75,65 @@ def test_perf_report_renders_and_compares(bench_artifacts):
     assert "phase breakdown" in md and "avg step" in md
     cmp_md = perf_report.render_compare(records, records, "a", "b")
     assert "avg step ms" in cmp_md and "+0.0%" in cmp_md
+
+
+def test_metrics_snapshot_artifact_schema_valid(bench_artifacts):
+    """The unified registry's JSONL snapshot rides the --profile artifact
+    set and must stay schema-valid (paddle_tpu.metrics.v1)."""
+    out_dir, rec = bench_artifacts
+    arts = rec["extra"]["profile_artifacts"]
+    assert os.path.exists(arts["metrics"])
+    snaps = metrics_report.load_snapshots(arts["metrics"])  # raises on rot
+    assert all(metrics_report.validate_snapshot(s) == [] for s in snaps)
+    names = {m["name"] for m in snaps[-1]["metrics"]}
+    # the migrated producers register on import — a bench process must
+    # carry at least the op-cache and live-memory families
+    for expected in ("op_cache_hits", "op_cache_misses",
+                     "live_device_bytes", "serving_tokens_total",
+                     "dataloader_wait_seconds"):
+        assert expected in names, f"{expected} missing from {names}"
+
+
+def test_metrics_prometheus_dump_valid(bench_artifacts):
+    out_dir, rec = bench_artifacts
+    path = rec["extra"]["profile_artifacts"]["metrics_prom"]
+    assert os.path.exists(path)
+    text = open(path).read()
+    errs = metrics_report.validate_prometheus(text)
+    assert errs == [], errs
+    assert "# TYPE op_cache_hits gauge" in text
+
+
+def test_metrics_report_compare_gates_regressions(bench_artifacts, tmp_path):
+    """The CI regression gate: --compare of a run against itself passes;
+    a failure counter that grew past the threshold exits nonzero."""
+    out_dir, rec = bench_artifacts
+    mpath = rec["extra"]["profile_artifacts"]["metrics"]
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    ok = subprocess.run(cli + ["--compare", mpath, mpath],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    # inject a grown failure counter into a copy: the gate must trip
+    snap = metrics_report.load_snapshots(mpath)[-1]
+
+    def with_counter(value):
+        doc = json.loads(json.dumps(snap))
+        doc["metrics"].append({
+            "name": "probe_timeouts_total", "type": "counter", "help": "",
+            "labelnames": [], "samples": [{"labels": {}, "value": value}]})
+        return doc
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    with open(a, "w") as f:
+        f.write(json.dumps(with_counter(1)) + "\n")
+    with open(b, "w") as f:
+        f.write(json.dumps(with_counter(10)) + "\n")
+    bad = subprocess.run(cli + ["--compare", a, b],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "probe_timeouts_total" in bad.stdout
+    assert "REGRESSIONS" in bad.stdout
 
 
 def test_validate_record_catches_rot():

@@ -1,0 +1,276 @@
+"""Render / validate / compare unified-registry metrics snapshots.
+
+The metrics registry (paddle_tpu.observability.metrics) writes
+schema-versioned JSONL snapshots (`paddle_tpu.metrics.v1`) and Prometheus
+text dumps — `bench.py --profile` leaves both next to its step timeline.
+This tool is the offline half: it renders a snapshot as a table, schema-
+validates files (the CI guard in tests/test_perf_pipeline.py), and diffs
+two runs with a REGRESSION mode for CI:
+
+  python tools/metrics_report.py RUN/metrics.jsonl
+  python tools/metrics_report.py --compare A.jsonl B.jsonl \
+         [--max-regress-pct 25]
+
+`--compare` exits nonzero when a counter regressed by more than the
+threshold. Direction matters and is decided per counter name:
+
+  - FAILURE counters (name matches error|reject|timeout|miss|drop|
+    failure): regression = the count GREW past the threshold,
+  - all other counters (work done: tokens, requests, bytes, hits):
+    regression = the count SHRANK past the threshold.
+
+Small-count noise is ignored via --min-delta (absolute floor, default 1).
+
+Stdlib-only, no live backend needed — like tools/perf_report.py, the
+artifacts must outlive the TPU grant that produced them.
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+SCHEMA = "paddle_tpu.metrics.v1"
+_TYPES = ("counter", "gauge", "histogram")
+_FAIL_PAT = re.compile(
+    r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure", re.I)
+
+
+# ------------------------------------------------------------- validation
+
+def validate_snapshot(rec):
+    """Return a list of schema violations ([] == valid)."""
+    errs = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not dict"]
+    if rec.get("schema") != SCHEMA:
+        errs.append(f"schema={rec.get('schema')!r}, want {SCHEMA!r}")
+    for field, types in (("ts", (int, float)), ("pid", int),
+                         ("metrics", list)):
+        if not isinstance(rec.get(field), types):
+            errs.append(f"{field}={rec.get(field)!r} invalid")
+    for m in rec.get("metrics") or []:
+        if not isinstance(m, dict):
+            errs.append(f"metric row {m!r} not a dict")
+            continue
+        name = m.get("name")
+        if not isinstance(name, str) or not name:
+            errs.append(f"metric name {name!r} invalid")
+        if m.get("type") not in _TYPES:
+            errs.append(f"{name}: type={m.get('type')!r} invalid")
+        if not isinstance(m.get("samples"), list):
+            errs.append(f"{name}: samples missing")
+            continue
+        for s in m["samples"]:
+            labels = s.get("labels")
+            if not isinstance(labels, dict):
+                errs.append(f"{name}: sample labels {labels!r} invalid")
+            if m.get("type") == "histogram":
+                missing = [k for k in ("buckets", "sum", "count")
+                           if k not in s]
+                if missing:
+                    errs.append(f"{name}: histogram sample missing {missing}")
+                    continue
+                counts = list(s["buckets"].values())
+                if counts != sorted(counts):
+                    errs.append(f"{name}: buckets not cumulative")
+                if "+Inf" not in s["buckets"]:
+                    errs.append(f"{name}: no +Inf bucket")
+                elif s["buckets"]["+Inf"] != s["count"]:
+                    errs.append(f"{name}: +Inf bucket != count")
+            else:
+                if not isinstance(s.get("value"), (int, float)):
+                    errs.append(f"{name}: value {s.get('value')!r} invalid")
+                elif m.get("type") == "counter" and s["value"] < 0:
+                    errs.append(f"{name}: negative counter {s['value']}")
+    return errs
+
+
+def load_snapshots(path):
+    """Parse + validate a JSONL snapshot stream; ValueError on any invalid
+    record."""
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i + 1}: not JSON: {e}") from None
+            errs = validate_snapshot(rec)
+            if errs:
+                raise ValueError(f"{path}:{i + 1}: " + "; ".join(errs))
+            records.append(rec)
+    if not records:
+        raise ValueError(f"{path}: empty snapshot stream")
+    return records
+
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+(?: [0-9.]+)?$")
+
+
+def validate_prometheus(text):
+    """Basic text-exposition lint: every line is a comment, blank, or a
+    parseable sample; every sample's family has a # TYPE."""
+    errs = []
+    typed = set()
+    for i, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in _TYPES:
+                errs.append(f"line {i + 1}: bad TYPE line {line!r}")
+            else:
+                typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue
+        if not _PROM_SAMPLE.match(line):
+            errs.append(f"line {i + 1}: unparseable sample {line!r}")
+            continue
+        fam = re.split(r"[{ ]", line, 1)[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", fam)
+        if fam not in typed and base not in typed:
+            errs.append(f"line {i + 1}: sample {fam!r} has no # TYPE")
+    return errs
+
+
+# -------------------------------------------------------------- rendering
+
+def flatten(rec, kinds=("counter", "gauge")):
+    """{ 'name{k=v}': value } for scalar metrics of one snapshot."""
+    out = {}
+    for m in rec.get("metrics", []):
+        if m["type"] not in kinds:
+            continue
+        for s in m["samples"]:
+            labels = s.get("labels") or {}
+            key = m["name"]
+            if labels:
+                key += "{" + ",".join(f"{k}={labels[k]}"
+                                      for k in sorted(labels)) + "}"
+            out[key] = s["value"]
+    return out
+
+
+def _counter_keys(rec):
+    return set(flatten(rec, kinds=("counter",)))
+
+
+def _hist_rows(rec):
+    rows = []
+    for m in rec.get("metrics", []):
+        if m["type"] != "histogram":
+            continue
+        for s in m["samples"]:
+            if not s["count"]:
+                continue
+            labels = s.get("labels") or {}
+            key = m["name"] + ("{" + ",".join(
+                f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+                if labels else "")
+            rows.append((key, s["count"], s["sum"] / s["count"]))
+    return rows
+
+
+def render(records, title="metrics report"):
+    """Markdown table of the LAST snapshot (+ how many snapshots seen)."""
+    last = records[-1]
+    lines = [f"# {title}", "",
+             f"snapshots: {len(records)}  ·  pid {last['pid']}  ·  "
+             f"ts {last['ts']:.3f}"]
+    flat = flatten(last)
+    if flat:
+        lines += ["", "## counters & gauges", "", "| metric | value |",
+                  "|---|---|"]
+        for k in sorted(flat):
+            v = flat[k]
+            lines.append(f"| {k} | {v:g} |")
+    hist = _hist_rows(last)
+    if hist:
+        lines += ["", "## histograms", "",
+                  "| metric | count | mean |", "|---|---|---|"]
+        for key, count, mean in sorted(hist):
+            lines.append(f"| {key} | {count} | {mean:.6g} |")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------- comparison
+
+def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
+    """[(key, a, b, pct, why)] counter regressions of B against A."""
+    a, b = flatten(a_rec, ("counter",)), flatten(b_rec, ("counter",))
+    regressions = []
+    for key in sorted(set(a) | set(b)):
+        va, vb = a.get(key, 0.0), b.get(key, 0.0)
+        delta = vb - va
+        if abs(delta) < min_delta:
+            continue
+        pct = (delta / va * 100.0) if va else float("inf")
+        if _FAIL_PAT.search(key):
+            if delta > 0 and (va == 0 or pct > max_regress_pct):
+                regressions.append((key, va, vb, pct,
+                                    "failure counter grew"))
+        else:
+            if delta < 0 and -pct > max_regress_pct:
+                regressions.append((key, va, vb, pct,
+                                    "work counter shrank"))
+    return regressions
+
+
+def render_compare(a_recs, b_recs, a_name, b_name, max_regress_pct=25.0,
+                   min_delta=1.0):
+    """(markdown, regressions) between the last snapshots of two runs."""
+    a, b = a_recs[-1], b_recs[-1]
+    fa, fb = flatten(a), flatten(b)
+    lines = [f"# metrics comparison: {a_name} vs {b_name}", "",
+             "| metric | A | B | delta |", "|---|---|---|---|"]
+    for key in sorted(set(fa) | set(fb)):
+        va, vb = fa.get(key, 0.0), fb.get(key, 0.0)
+        d = f"{100.0 * (vb - va) / va:+.1f}%" if va else \
+            ("-" if vb == va else "new")
+        lines.append(f"| {key} | {va:g} | {vb:g} | {d} |")
+    regs = compare_counters(a, b, max_regress_pct=max_regress_pct,
+                            min_delta=min_delta)
+    if regs:
+        lines += ["", f"## REGRESSIONS (> {max_regress_pct:g}%)", ""]
+        for key, va, vb, pct, why in regs:
+            pct_s = "inf" if pct == float("inf") else f"{pct:+.1f}%"
+            lines.append(f"- **{key}**: {va:g} -> {vb:g} ({pct_s}) — {why}")
+    else:
+        lines += ["", "no counter regressions"]
+    return "\n".join(lines), regs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run", nargs="?", help="metrics .jsonl to render")
+    p.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                   help="diff two snapshot streams; exit 1 on counter "
+                        "regressions past --max-regress-pct")
+    p.add_argument("--max-regress-pct", type=float, default=25.0)
+    p.add_argument("--min-delta", type=float, default=1.0,
+                   help="ignore counter moves smaller than this (absolute)")
+    args = p.parse_args(argv)
+    if args.compare:
+        a_path, b_path = args.compare
+        md, regs = render_compare(
+            load_snapshots(a_path), load_snapshots(b_path),
+            os.path.basename(a_path), os.path.basename(b_path),
+            max_regress_pct=args.max_regress_pct,
+            min_delta=args.min_delta)
+        print(md)
+        return 1 if regs else 0
+    if not args.run:
+        p.error("give a metrics .jsonl, or --compare A B")
+    records = load_snapshots(args.run)
+    print(render(records, title=f"metrics report: {args.run}"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -354,7 +354,11 @@ def main():
     if one is not None:
         wd = bench.start_watchdog(
             280, "in-process jax backend init",
-            on_fire=lambda err: print(f"| {one} | fail: {err} |", flush=True))
+            on_fire=lambda err, extra=None: print(
+                f"| {one} | fail: {err}"
+                + (f" (postmortem: {extra.get('postmortem')})"
+                   if extra and extra.get("postmortem") else "")
+                + " |", flush=True))
         import jax
         assert jax.default_backend() == backend
         wd.cancel()
