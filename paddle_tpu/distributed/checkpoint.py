@@ -15,10 +15,21 @@ gather, no full-array host copy for sharded params). Loading reassembles
 only when needed: if the target mesh/spec matches a chunk layout, chunks
 device_put directly; otherwise chunks are stitched and re-placed — that IS
 the converter, shapes permitting any source/target degree combination.
+
+Crash safety (ISSUE 5): saves go through the shared commit protocol
+(framework/ckpt_commit.py) — files land in a hidden tempdir, get
+sha256-manifested and fsynced, and rename atomically onto `path`; the
+parent directory's `LATEST` pointer updates only after the rename, and
+`keep=K` garbage-collects older sibling checkpoints. `load_state_dict`
+verifies digests and, pointed at a checkpoint ROOT (a directory holding
+a LATEST pointer) or at a checkpoint that fails verification, falls back
+to the newest sibling that verifies — a torn save is never loaded and a
+mid-save SIGKILL costs at most the interrupted checkpoint.
 """
 import json
 import os
 import re
+import warnings
 
 import numpy as np
 
@@ -27,8 +38,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
+from ..framework import ckpt_commit as _commit
+from ..framework.ckpt_commit import CheckpointCorruptError  # noqa: F401
 
-__all__ = ["save_state_dict", "load_state_dict", "convert_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "convert_state_dict",
+           "CheckpointCorruptError"]
 
 
 def _spec_to_list(spec):
@@ -41,47 +55,55 @@ def _sanitize(name):
     return re.sub(r"[^A-Za-z0-9_.\-]", "_", name)
 
 
-def save_state_dict(state_dict, path):
-    """Write a sharded checkpoint. state_dict: {name: Tensor|array}."""
-    os.makedirs(path, exist_ok=True)
-    meta = {}
-    for name, t in state_dict.items():
-        arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
-        fname = _sanitize(name)
-        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
-                 "spec": [], "chunks": []}
-        sharding = getattr(arr, "sharding", None)
-        spec = getattr(sharding, "spec", None)
-        if spec is not None:
-            entry["spec"] = _spec_to_list(spec)
-        # one file per distinct device shard (replicas deduped by index)
-        seen = set()
-        idx = 0
-        shards = getattr(arr, "addressable_shards", None)
-        if shards:
-            for sh in shards:
-                key = tuple((s.start, s.stop) for s in
-                            _norm_index(sh.index, arr.shape))
-                if key in seen:
-                    continue
-                seen.add(key)
-                data = np.asarray(jax.device_get(sh.data))
-                if data.dtype == jnp.bfloat16:
-                    data = data.astype(np.float32)
-                fn = f"{fname}.{idx}.npy"
-                np.save(os.path.join(path, fn), data)
-                entry["chunks"].append({"file": fn, "index": [list(k) for
-                                                              k in key]})
-                idx += 1
-        else:
-            data = np.asarray(arr)
-            np.save(os.path.join(path, f"{fname}.0.npy"), data)
-            entry["chunks"].append(
-                {"file": f"{fname}.0.npy",
-                 "index": [[0, s] for s in arr.shape]})
-        meta[name] = entry
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+def save_state_dict(state_dict, path, keep=None):
+    """Write a sharded checkpoint via the atomic-commit protocol.
+    state_dict: {name: Tensor|array}. `keep=K` retains only the newest K
+    committed checkpoints in path's parent directory (retention GC,
+    never the one just written)."""
+    path = os.path.abspath(path)
+    with _commit.atomic_commit(path) as tmp:
+        meta = {}
+        for name, t in state_dict.items():
+            arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            fname = _sanitize(name)
+            entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                     "spec": [], "chunks": []}
+            sharding = getattr(arr, "sharding", None)
+            spec = getattr(sharding, "spec", None)
+            if spec is not None:
+                entry["spec"] = _spec_to_list(spec)
+            # one file per distinct device shard (replicas deduped by index)
+            seen = set()
+            idx = 0
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                for sh in shards:
+                    key = tuple((s.start, s.stop) for s in
+                                _norm_index(sh.index, arr.shape))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    data = np.asarray(jax.device_get(sh.data))
+                    if data.dtype == jnp.bfloat16:
+                        data = data.astype(np.float32)
+                    fn = f"{fname}.{idx}.npy"
+                    np.save(os.path.join(tmp, fn), data)
+                    entry["chunks"].append({"file": fn,
+                                            "index": [list(k) for k in key]})
+                    idx += 1
+            else:
+                data = np.asarray(arr)
+                np.save(os.path.join(tmp, f"{fname}.0.npy"), data)
+                entry["chunks"].append(
+                    {"file": f"{fname}.0.npy",
+                     "index": [[0, s] for s in arr.shape]})
+            meta[name] = entry
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+    root, base = os.path.dirname(path), os.path.basename(path)
+    _commit.update_latest(root, base)
+    if keep is not None:
+        _commit.gc_old(root, keep, protect={base}, same_lineage_as=base)
 
 
 def _norm_index(index, shape):
@@ -108,9 +130,53 @@ def _assemble(path, entry):
     return arr
 
 
+def _resolve_checkpoint(path):
+    """Map `path` (a checkpoint dir OR a root with a LATEST pointer) to a
+    VERIFIED checkpoint dir, falling back to the newest valid sibling
+    when the preferred one is torn. Raises CheckpointCorruptError when a
+    corruption was detected and nothing valid remains."""
+    path = os.path.abspath(path)
+    if os.path.exists(os.path.join(path, "meta.json")):
+        if _commit.read_manifest(path) is None:
+            return path          # pre-manifest checkpoint: load as-is
+        try:
+            _commit.verify_dir(path)
+            return path
+        except CheckpointCorruptError as e:
+            # fallback stays within the SAME checkpoint family: a sibling
+            # from another lineage (model vs opt) holds different tensors
+            # and must never be silently substituted
+            root, base = os.path.dirname(path), os.path.basename(path)
+            fallback = _commit.find_valid(root, exclude={base},
+                                          same_lineage_as=base)
+            if fallback is None:
+                raise
+            warnings.warn(f"{e}; falling back to {fallback}",
+                          RuntimeWarning, stacklevel=3)
+            return fallback
+    resolved, latest_name = _commit.resolve_valid(path)
+    if latest_name is not None:
+        if resolved is None:
+            raise CheckpointCorruptError(
+                f"{path}: LATEST points at {latest_name!r} which is torn "
+                f"or missing, and no sibling checkpoint of its lineage "
+                f"verifies")
+        if os.path.basename(resolved) != latest_name:
+            warnings.warn(
+                f"{os.path.join(path, latest_name)} is torn or missing; "
+                f"falling back to {resolved}", RuntimeWarning, stacklevel=3)
+        return resolved
+    if resolved is not None:
+        return resolved
+    return path                   # let the meta.json open raise cleanly
+
+
 def load_state_dict(path, mesh=None, return_numpy=False):
     """Load a sharded checkpoint; re-places per stored spec onto `mesh`
-    (any shape — re-slicing across meshes is automatic)."""
+    (any shape — re-slicing across meshes is automatic). `path` may be a
+    checkpoint dir or a ROOT holding several — digests are verified and
+    torn checkpoints skipped in favor of the newest valid one."""
+    path = _resolve_checkpoint(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     out = {}

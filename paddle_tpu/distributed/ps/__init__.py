@@ -41,7 +41,7 @@ __all__ = ["SparseTable", "AsyncCommunicator", "SparseEmbedding",
            "DeviceEmbeddingCache", "CachedEmbedding",
            "GraphTable", "DistGraphClient", "DiskSparseTable",
            "TABLE_TYPES", "register_table_type", "make_table",
-           "PSServerError"]
+           "PSServerError", "PSUnavailableError", "RetryPolicy"]
 
 SparseTable = native.SparseTable
 
@@ -103,6 +103,8 @@ class AsyncCommunicator:
         self._running = False
         self._inflight = 0                  # pushed but not yet in the table
         self._cv = threading.Condition()
+        self._push_error = None             # first background push failure
+        self._lost = 0                      # gradient batches dropped by it
 
     def start(self):
         self._running = True
@@ -127,10 +129,21 @@ class AsyncCommunicator:
             # flush at the merge threshold, or whenever the queue runs dry
             # (so flush()/barrier callers never wait on a partial window)
             if pending and (len(pending) >= self._merge or self._q.empty()):
-                self._flush(pending)
-                with self._cv:
-                    self._inflight -= len(pending)
-                    self._cv.notify_all()
+                try:
+                    self._flush(pending)
+                except Exception as e:              # noqa: BLE001
+                    # a push failure (e.g. PSUnavailableError) must not
+                    # kill the pusher thread — that would strand every
+                    # later flush() in a silent 30s timeout. Record it;
+                    # the next flush()/barrier raises it to the trainer.
+                    with self._cv:
+                        if self._push_error is None:
+                            self._push_error = e
+                        self._lost += len(pending)
+                finally:
+                    with self._cv:
+                        self._inflight -= len(pending)
+                        self._cv.notify_all()
                 pending = []
 
     def _flush(self, items):
@@ -141,11 +154,26 @@ class AsyncCommunicator:
 
     def flush(self, timeout=30.0):
         """Block until every queued gradient landed in the table (barrier
-        before eval/save)."""
+        before eval/save). Never silently lossy: a timeout raises
+        TimeoutError carrying the unflushed count (`e.unflushed`), and a
+        background push failure is re-raised here with how many gradient
+        batches it dropped."""
         with self._cv:
-            if not self._cv.wait_for(lambda: self._inflight == 0,
-                                     timeout=timeout):
-                raise TimeoutError("AsyncCommunicator flush timed out")
+            done = self._cv.wait_for(lambda: self._inflight == 0,
+                                     timeout=timeout)
+            err, lost = self._push_error, self._lost
+            self._push_error, self._lost = None, 0
+            unflushed = self._inflight
+        if err is not None:
+            raise RuntimeError(
+                f"AsyncCommunicator background push failed; {lost} queued "
+                f"gradient batch(es) were dropped") from err
+        if not done:
+            e = TimeoutError(
+                f"AsyncCommunicator flush timed out with {unflushed} "
+                f"gradient batch(es) still queued")
+            e.unflushed = unflushed
+            raise e
 
     def stop(self):
         self._stop.set()
@@ -296,7 +324,8 @@ class PSContext:
 
 
 from .rpc import (DistGraphClient, DistributedSparseTable,  # noqa: E402,F401
-                  PSClient, PSServer, PSServerError)
+                  PSClient, PSServer, PSServerError, PSUnavailableError,
+                  RetryPolicy)
 from .graph_table import GraphTable  # noqa: E402,F401
 from .disk_table import DiskSparseTable  # noqa: E402,F401
 from .device_cache import (CachedEmbedding,  # noqa: E402,F401

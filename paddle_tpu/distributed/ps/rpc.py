@@ -37,11 +37,33 @@ under the REMOTE client span, so per-process chrome exports merge into
 one causally-linked timeline (merge_chrome_traces). Unflagged frames are
 served unchanged — old clients interoperate.
 
+Self-healing (ISSUE 5): `_exchange` is a retry loop, not a single shot.
+Transport failures (reset/refused/timeout — real or injected via
+observability.faults sites `ps.rpc.connect`/`ps.rpc.send`) reconnect and
+retry with exponential backoff + jitter under a bounded attempt count
+and an optional per-verb deadline (RetryPolicy). Idempotent verbs
+(PULL/PING/GSAMPLE/GFEAT/GDEGREE) retry as-is; PUSH becomes safe to
+retry through a client-assigned request id (bit 0x40 in the op byte +
+`u64 client_id | u64 seq` after the header) that the server remembers in
+a bounded LRU and dedups — a replayed PUSH whose first copy WAS applied
+(reply lost on the wire) answers OK without touching the table, so
+gradients land exactly once. Per-shard circuit breakers open after N
+consecutive transport failures, fast-fail while open
+(`PSUnavailableError`, a ConnectionError), and half-open a single probe
+after a cooldown. Frames without the 0x40 rider are served unchanged.
+
 Metrics: both halves report to the unified registry — per-verb latency
 histograms (`ps_client_request_seconds` / `ps_server_request_seconds`),
-per-verb byte counters, a connection-pool gauge, and in-band error
-counts (`ps_errors_total{side=...}`).
+per-verb byte counters, a connection-pool gauge, in-band error counts
+(`ps_errors_total{side=...}`, which also counts client connect
+failures), retry counts (`ps_retries_total{verb=...}`), and breaker
+state (`ps_breaker_state{endpoint=...}`: 0 closed / 1 open / 2
+half-open).
 """
+import collections
+import itertools
+import os
+import random
 import socket
 import struct
 import threading
@@ -49,6 +71,7 @@ import time
 
 import numpy as np
 
+from ...observability import faults as _faults
 from ...observability import metrics as _metrics
 from ...observability import tracecontext as _tc
 from ...profiler import TracerEventType, _tracer
@@ -62,6 +85,17 @@ _HDR = struct.Struct("<BII")
 _GS = struct.Struct("<iBH")       # seed | weighted | edge-type length
 _TL = struct.Struct("<H")         # type-name length
 _U32 = struct.Struct("<I")
+# op-byte flag: a PUSH retry-dedup id rides the frame — `u64 client_id |
+# u64 seq` right after the header (after the 0x80 trace ctx when both
+# are set). The id is fixed across retries of one logical push.
+REQID_FLAG = 0x40
+_REQID = struct.Struct("<QQ")
+_OP_MASK = ~(_tc.WIRE_FLAG | REQID_FLAG) & 0xFF
+# verbs the retry loop may replay without a dedup id (read-only or
+# harmlessly repeatable); PUSH joins them via the REQID rider
+_IDEMPOTENT_OPS = frozenset((OP_PULL, OP_PING, OP_GSAMPLE, OP_GFEAT,
+                             OP_GDEGREE))
+_PUSH_SEEN_CAP = 65536            # server-side dedup LRU entries
 # a response whose leading u32 is the sentinel carries `u32 len | len bytes`
 # of error text instead of payload — serving errors (unknown edge type, no
 # graph on this server, bad shapes) reach the caller as PSServerError with
@@ -91,10 +125,123 @@ _M_ERRORS = _metrics.counter(
     "ps_errors_total",
     "In-band PS error frames, by which side observed them",
     labelnames=("side",))
+_M_RETRIES = _metrics.counter(
+    "ps_retries_total",
+    "PS RPC client attempts beyond the first, per verb",
+    labelnames=("verb",))
+_M_BREAKER = _metrics.gauge(
+    "ps_breaker_state",
+    "Per-shard circuit breaker state (0 closed, 1 open, 2 half-open)",
+    labelnames=("endpoint",))
 
 
 class PSServerError(RuntimeError):
     """A server-side serving error relayed over the wire verbatim."""
+
+
+class PSUnavailableError(ConnectionError):
+    """A shard stayed dark: retries exhausted, the per-verb deadline
+    passed, or its circuit breaker is open."""
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name)
+    return float(raw) if raw else default
+
+
+class RetryPolicy:
+    """Backoff schedule + bounds for the `_exchange` retry loop.
+
+    `deadline_s` caps one logical request's total wall time; it can be a
+    float (every verb) or a {verb: seconds} dict (per-verb deadlines —
+    e.g. a tight PULL budget with a looser GSAMPLE one). Env defaults:
+    PTN_PS_RETRY_MAX (attempts, 5), PTN_PS_RETRY_BASE_S (0.05),
+    PTN_PS_RETRY_DEADLINE_S (unset = unbounded)."""
+
+    def __init__(self, max_attempts=None, base_delay_s=None,
+                 max_delay_s=2.0, jitter=0.5, deadline_s=None, seed=None):
+        self.max_attempts = max(1, int(
+            max_attempts if max_attempts is not None
+            else _env_float("PTN_PS_RETRY_MAX", 5)))
+        self.base_delay_s = (base_delay_s if base_delay_s is not None
+                             else _env_float("PTN_PS_RETRY_BASE_S", 0.05))
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        if deadline_s is None:
+            deadline_s = _env_float("PTN_PS_RETRY_DEADLINE_S", 0.0) or None
+        self.deadline_s = deadline_s
+        self._rng = random.Random(seed)
+
+    def deadline_for(self, verb):
+        if isinstance(self.deadline_s, dict):
+            return self.deadline_s.get(verb)
+        return self.deadline_s
+
+    def backoff(self, attempt):
+        """Sleep before retry number `attempt` (1-based): exponential,
+        capped, with subtractive jitter so synchronized clients fan out."""
+        d = min(self.base_delay_s * (2.0 ** (attempt - 1)), self.max_delay_s)
+        return d * (1.0 - self.jitter * self._rng.random())
+
+
+class _Breaker:
+    """Per-shard circuit breaker: CLOSED -> (N consecutive transport
+    failures) -> OPEN (fast-fail) -> cooldown -> HALF_OPEN (one probe) ->
+    CLOSED on success / OPEN on failure."""
+
+    _STATES = {"closed": 0, "open": 1, "half-open": 2}
+
+    def __init__(self, threshold, cooldown_s, endpoint,
+                 clock=time.monotonic):
+        self._threshold = max(1, int(threshold))
+        self._cooldown = float(cooldown_s)
+        self._clock = clock
+        self.endpoint = endpoint
+        self.state = "closed"
+        self._fails = 0
+        self._open_until = 0.0
+        self._probe_expires = 0.0
+        self._lock = threading.Lock()
+        _M_BREAKER.labels(endpoint=endpoint).set(0)
+
+    def _set(self, state):
+        self.state = state
+        _M_BREAKER.labels(endpoint=self.endpoint).set(self._STATES[state])
+
+    def allow(self):
+        """May a request go out now? Grants one probe per cooldown while
+        not closed. A probe that never reports back (an exception outside
+        the transport classes escaped the retry loop) expires after a
+        cooldown and a new probe is granted — half-open can never become
+        a permanent dark state."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            now = self._clock()
+            if self.state == "open" and now >= self._open_until:
+                self._set("half-open")
+                self._probe_expires = now + self._cooldown
+                return True
+            if self.state == "half-open" and now >= self._probe_expires:
+                self._probe_expires = now + self._cooldown
+                return True
+            return False              # open and cooling, or probe in flight
+
+    def ok(self):
+        with self._lock:
+            self._fails = 0
+            if self.state != "closed":
+                self._set("closed")
+
+    def fail(self):
+        """Record a transport failure; returns True when the breaker is
+        (now) open, so callers can stop retrying."""
+        with self._lock:
+            self._fails += 1
+            if self.state == "half-open" or self._fails >= self._threshold:
+                self._open_until = self._clock() + self._cooldown
+                self._set("open")
+            return self.state == "open"
 
 
 class _MeteredSock:
@@ -137,6 +284,10 @@ class PSServer:
     def __init__(self, table=None, host="127.0.0.1", port=0, graph=None):
         self.table = table
         self.graph = graph
+        # PUSH dedup: (client_id, seq) of pushes already APPLIED, bounded
+        # LRU shared across connections (a retry arrives on a NEW socket)
+        self._push_seen = collections.OrderedDict()
+        self._push_seen_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -169,9 +320,14 @@ class PSServer:
                 if op & _tc.WIRE_FLAG:
                     # trace context rides the frame: strip the flag, read
                     # the 24 ctx bytes, parent our span under the caller's
-                    op &= ~_tc.WIRE_FLAG
                     rctx = _tc.unpack_ctx(
                         _recv_exact(mconn, _tc.CTX_WIRE_BYTES))
+                reqid = None
+                if op & REQID_FLAG:
+                    # PUSH retry-dedup id (client_id, seq)
+                    reqid = _REQID.unpack(
+                        _recv_exact(mconn, _REQID.size))
+                op &= _OP_MASK
                 if op == OP_STOP:
                     self._stop.set()
                     try:
@@ -202,7 +358,7 @@ class PSServer:
                     # table/graph work, so a serving error leaves the
                     # stream in sync and we can answer with an error frame
                     # instead of killing the connection
-                    resp = handler(mconn, op, n, aux)
+                    resp = handler(mconn, op, n, aux, reqid)
                 except (ConnectionError, OSError):
                     _tracer.cancel(span)
                     raise
@@ -228,7 +384,44 @@ class PSServer:
         finally:
             conn.close()
 
-    def _serve_sparse(self, conn, op, n, dim):
+    def _push_begin(self, reqid):
+        """Claim a push id: ('dup', None) when it was already APPLIED,
+        ('wait', event) when another thread is applying it right now,
+        ('mine', event) when this thread owns the apply. The in-progress
+        sentinel closes the check-then-act race where a client-timeout
+        retry lands while the original apply is still running — the
+        retry must wait, not re-apply."""
+        with self._push_seen_lock:
+            st = self._push_seen.get(reqid)
+            if st is True:
+                self._push_seen.move_to_end(reqid)
+                return "dup", None
+            if st is not None:
+                return "wait", st
+            ev = threading.Event()
+            self._push_seen[reqid] = ev
+            return "mine", ev
+
+    def _push_end(self, reqid, ev, applied):
+        with self._push_seen_lock:
+            if applied:
+                self._push_seen[reqid] = True
+                self._push_seen.move_to_end(reqid)
+                if len(self._push_seen) > _PUSH_SEEN_CAP:
+                    # trim APPLIED markers only — evicting a live
+                    # in-progress Event would reopen the double-apply
+                    # race it exists to close
+                    for key in list(self._push_seen.keys()):
+                        if len(self._push_seen) <= _PUSH_SEEN_CAP:
+                            break
+                        if self._push_seen[key] is True:
+                            del self._push_seen[key]
+            else:
+                # a FAILED apply releases the id: the retry may land it
+                self._push_seen.pop(reqid, None)
+        ev.set()
+
+    def _serve_sparse(self, conn, op, n, dim, reqid=None):
         keys = np.frombuffer(_recv_exact(conn, 8 * n), np.int64)
         if op == OP_PULL:
             if self.table is None:
@@ -239,10 +432,26 @@ class PSServer:
                               np.float32).reshape(n, dim)
         if self.table is None:
             raise PSServerError("this server carries no sparse table")
-        self.table.push(keys, grads)
+        # dedup AFTER the body is consumed (stream stays in sync)
+        if reqid is None:
+            self.table.push(keys, grads)
+            return _U32.pack(0)
+        while True:
+            state, ev = self._push_begin(reqid)
+            if state == "dup":
+                return _U32.pack(0)
+            if state == "mine":
+                break
+            ev.wait(timeout=30)   # re-check: applied -> dup, failed -> mine
+        try:
+            self.table.push(keys, grads)
+        except BaseException:
+            self._push_end(reqid, ev, applied=False)
+            raise
+        self._push_end(reqid, ev, applied=True)
         return _U32.pack(0)
 
-    def _serve_graph(self, conn, op, n, aux):
+    def _serve_graph(self, conn, op, n, aux, reqid=None):
         if op == OP_GSAMPLE:
             seed, weighted, tlen = _GS.unpack(_recv_exact(conn, _GS.size))
         else:
@@ -278,18 +487,70 @@ class ShardClientBase:
     """Per-endpoint connection pool shared by the sparse and graph clients:
     one lazy socket + lock per shard server (requests serialized per
     connection, pipelined across shards), framing-desync recovery by
-    dropping a half-consumed socket."""
+    dropping a half-consumed socket, and the self-healing layer: retry
+    policy + per-shard circuit breakers (see `_exchange`).
 
-    def __init__(self, endpoints):
+    Timeouts: `connect_timeout_s` bounds the TCP connect (env
+    PTN_PS_CONNECT_TIMEOUT_S, default 30); `request_timeout_s` is the
+    per-request socket timeout once connected (env
+    PTN_PS_REQUEST_TIMEOUT_S, default 30 — matching the pre-retry
+    fabric, so a hung-but-connected server always surfaces; 0 = block
+    forever) — a timed-out request is a transport failure and goes
+    through the retry path like any reset."""
+
+    def __init__(self, endpoints, connect_timeout_s=None,
+                 request_timeout_s=None, retry=None, breaker_threshold=None,
+                 breaker_cooldown_s=None):
         self.endpoints = list(endpoints)
         self._socks = [None] * len(self.endpoints)
         self._locks = [threading.Lock() for _ in self.endpoints]
+        self._connect_timeout = (
+            connect_timeout_s if connect_timeout_s is not None
+            else _env_float("PTN_PS_CONNECT_TIMEOUT_S", 30.0))
+        if request_timeout_s is None:
+            request_timeout_s = _env_float(
+                "PTN_PS_REQUEST_TIMEOUT_S", 30.0) or None
+        elif request_timeout_s == 0:
+            request_timeout_s = None
+        self._request_timeout = request_timeout_s
+        self.retry = retry if retry is not None else RetryPolicy()
+        thr = (breaker_threshold if breaker_threshold is not None
+               else _env_float("PTN_PS_BREAKER_THRESHOLD", 5))
+        cool = (breaker_cooldown_s if breaker_cooldown_s is not None
+                else _env_float("PTN_PS_BREAKER_COOLDOWN_S", 1.0))
+        self._breakers = [_Breaker(thr, cool, ep) for ep in self.endpoints]
+        # PUSH dedup identity: unique per client instance AND per pid —
+        # re-randomized after a fork, or parent and child would emit
+        # colliding (client_id, seq) pairs and the server would silently
+        # drop one side's gradients as duplicates. The seq is assigned
+        # once per logical push, BEFORE the retry loop.
+        self._push_ident = None          # (pid, client_id, counter)
+        self._push_ident_lock = threading.Lock()
 
-    def _sock(self, i):
+    def _next_push_reqid(self):
+        with self._push_ident_lock:
+            if self._push_ident is None or \
+                    self._push_ident[0] != os.getpid():
+                self._push_ident = (os.getpid(),
+                                    struct.unpack("<Q", os.urandom(8))[0],
+                                    itertools.count(1))
+            _, client_id, counter = self._push_ident
+            return client_id, next(counter)
+
+    def _sock(self, i, connect_timeout=None):
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=30)
+            try:
+                _faults.fire("ps.rpc.connect")
+                s = socket.create_connection(
+                    (host, int(port)),
+                    timeout=self._connect_timeout if connect_timeout is None
+                    else connect_timeout)
+            except OSError:
+                _M_ERRORS.labels(side="client").inc()
+                raise
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(self._request_timeout)
             self._socks[i] = s
             _M_POOL.inc()
         return self._socks[i]
@@ -305,50 +566,148 @@ class ShardClientBase:
 
     def _exchange(self, i, msg, reader):
         """Send one framed request to shard i, parse the reply with
-        `reader(sock)` under the per-shard lock.
+        `reader(sock)` under the per-shard lock — retrying transport
+        failures until the verb's budget runs out.
 
-        This is the fabric's single choke point, so the observability
-        riders live here: a `ps.client::<verb>` span whose id travels in
-        the frame when a trace is active (the 0x80 header-flag path), the
-        per-verb latency histogram, and exact sent/received byte counts
-        (received metered through a counting socket proxy so the reader
-        closures stay untouched)."""
-        verb = _OP_NAMES.get(msg[0] & ~_tc.WIRE_FLAG, str(msg[0]))
+        This is the fabric's single choke point, so both the
+        observability riders and the self-healing live here: a
+        `ps.client::<verb>` span whose id travels in the frame when a
+        trace is active (the 0x80 header-flag path), the per-verb latency
+        histogram, exact sent/received byte counts, the PUSH dedup id
+        (0x40 rider, fixed across retries), the retry loop
+        (reconnect-on-retry, exponential backoff + jitter, bounded
+        attempts, per-verb deadline), and the shard's circuit breaker.
+        An exhausted budget or an open breaker surfaces as
+        PSUnavailableError; a PSServerError reply counts as fabric
+        HEALTH (the server answered) and is never retried."""
+        op = msg[0]
+        verb = _OP_NAMES.get(op, str(op))
+        breaker = self._breakers[i]
+        if not breaker.allow():
+            raise PSUnavailableError(
+                f"shard {i} ({self.endpoints[i]}) circuit breaker is open")
         span = _tracer.begin(f"ps.client::{verb}",
                              TracerEventType.Communication,
                              attrs={"shard": i,
                                     "endpoint": self.endpoints[i]})
+        # riders: trace ctx (0x80) then PUSH dedup id (0x40); the wire
+        # frame is built ONCE so retries replay the identical bytes —
+        # the dedup guarantee depends on the seq not changing
+        flags, riders = 0, b""
         trace_id = _tc.current_trace_id()
         if trace_id is not None:
             span_id = span["span_id"] if span is not None \
                 else _tc.new_span_id()
-            msg = (bytes((msg[0] | _tc.WIRE_FLAG,)) + msg[1:_HDR.size]
-                   + _tc.pack_ctx(trace_id, span_id) + msg[_HDR.size:])
-        t0 = time.perf_counter()
+            flags |= _tc.WIRE_FLAG
+            riders += _tc.pack_ctx(trace_id, span_id)
+        if op == OP_PUSH:
+            flags |= REQID_FLAG
+            riders += _REQID.pack(*self._next_push_reqid())
+        if flags:
+            msg = (bytes((op | flags,)) + msg[1:_HDR.size] + riders
+                   + msg[_HDR.size:])
+        retryable = op in _IDEMPOTENT_OPS or op == OP_PUSH
+        deadline_s = self.retry.deadline_for(verb)
+        deadline = (time.monotonic() + deadline_s) if deadline_s else None
+        attempt = 0
+        last_exc = None
         try:
-            with self._locks[i]:
+            while True:
+                if deadline is not None and last_exc is not None \
+                        and time.monotonic() >= deadline:
+                    # the deadline expired DURING backoff: give up on the
+                    # real failure we already counted — no synthetic
+                    # attempt, no extra breaker.fail(), no ~0s histogram
+                    # sample
+                    raise PSUnavailableError(
+                        f"shard {i} ({self.endpoints[i]}) unavailable "
+                        f"after {attempt} attempt(s) for {verb}: deadline "
+                        f"exhausted") from last_exc
+                attempt += 1
+                # per-ATTEMPT latency: one histogram sample per wire
+                # round-trip, backoff sleeps excluded — chaos must not
+                # masquerade as server latency in the comparisons
+                t0 = time.perf_counter()
                 try:
-                    s = _MeteredSock(self._sock(i))
-                    s.sendall(msg)
-                    out = reader(s)
+                    try:
+                        with self._locks[i]:
+                            try:
+                                _faults.fire("ps.rpc.send")
+                                # the deadline bounds BLOCKING attempts
+                                # too: the CONNECT and this attempt's
+                                # socket timeout both shrink to the
+                                # remaining budget
+                                left = None
+                                if deadline is not None:
+                                    left = deadline - time.monotonic()
+                                    if left <= 0:
+                                        raise socket.timeout(
+                                            f"{verb} deadline exhausted")
+                                raw = self._sock(
+                                    i, connect_timeout=None if left is None
+                                    else min(left, self._connect_timeout))
+                                if left is not None:
+                                    raw.settimeout(
+                                        min(left, self._request_timeout)
+                                        if self._request_timeout else left)
+                                s = _MeteredSock(raw)
+                                s.sendall(msg)
+                                # reply-lost window (the PUSH-dedup case)
+                                _faults.fire("ps.rpc.send")
+                                out = reader(s)
+                            except PSServerError:
+                                # error frame fully consumed: stream in sync
+                                _M_ERRORS.labels(side="client").inc()
+                                raise
+                            except Exception:
+                                # a half-consumed socket would desynchronize
+                                # the framing for every later request: drop
+                                # it so the next attempt reconnects
+                                self._drop_sock(i)
+                                raise
+                            finally:
+                                # the shrunken per-attempt timeout must not
+                                # outlive the attempt — a kept socket (e.g.
+                                # after a PSServerError reply) would time
+                                # out later healthy requests spuriously
+                                if deadline is not None and \
+                                        self._socks[i] is not None:
+                                    try:
+                                        self._socks[i].settimeout(
+                                            self._request_timeout)
+                                    except OSError:
+                                        pass
+                    finally:
+                        _M_CLIENT_SECONDS.labels(verb=verb).observe(
+                            time.perf_counter() - t0)
+                    _M_CLIENT_BYTES.labels(verb=verb, direction="sent").inc(
+                        s.sent_bytes)
+                    _M_CLIENT_BYTES.labels(verb=verb, direction="recv").inc(
+                        s.recv_bytes)
+                    breaker.ok()
+                    if span is not None and attempt > 1:
+                        span.setdefault("attrs", {})["attempts"] = attempt
+                    return out
                 except PSServerError:
-                    # error frame fully consumed: stream still in sync
-                    _M_ERRORS.labels(side="client").inc()
+                    breaker.ok()          # the shard answered: fabric fine
                     raise
-                except Exception:
-                    # a half-consumed socket would desynchronize the framing
-                    # for every later request: drop it so the next call
-                    # reconnects
-                    self._drop_sock(i)
-                    raise
-            _M_CLIENT_BYTES.labels(verb=verb, direction="sent").inc(
-                s.sent_bytes)
-            _M_CLIENT_BYTES.labels(verb=verb, direction="recv").inc(
-                s.recv_bytes)
-            return out
+                except (ConnectionError, OSError) as e:
+                    last_exc = e
+                    now_open = breaker.fail()
+                    out_of_budget = (
+                        not retryable
+                        or attempt >= self.retry.max_attempts
+                        or now_open
+                        or (deadline is not None
+                            and time.monotonic() >= deadline))
+                    if out_of_budget:
+                        raise PSUnavailableError(
+                            f"shard {i} ({self.endpoints[i]}) unavailable "
+                            f"after {attempt} attempt(s) for {verb}: "
+                            f"{type(e).__name__}: {e}") from e
+                    _M_RETRIES.labels(verb=verb).inc()
+                    time.sleep(self.retry.backoff(attempt))
         finally:
-            _M_CLIENT_SECONDS.labels(verb=verb).observe(
-                time.perf_counter() - t0)
             _tracer.end(span)
 
     def _route(self, keys):
@@ -384,8 +743,8 @@ class PSClient(ShardClientBase):
     """Routes sparse pull/push over the shard servers (reference:
     brpc_ps_client's per-shard request fan-out)."""
 
-    def __init__(self, endpoints, dim):
-        super().__init__(endpoints)
+    def __init__(self, endpoints, dim, **kwargs):
+        super().__init__(endpoints, **kwargs)
         self.dim = int(dim)
 
     def _request(self, i, op, keys, grads=None):
@@ -527,9 +886,9 @@ class DistributedSparseTable:
     """SparseTable-compatible facade over PSClient, so SparseEmbedding and
     the AsyncCommunicator work unchanged against remote shards."""
 
-    def __init__(self, endpoints, dim):
+    def __init__(self, endpoints, dim, **kwargs):
         self.dim = int(dim)
-        self.client = PSClient(endpoints, dim)
+        self.client = PSClient(endpoints, dim, **kwargs)
 
     def pull(self, keys):
         return self.client.pull(keys)

@@ -286,6 +286,15 @@ class Predictor:
                 except QueueFullError:
                     sched.step()   # drain a slot's worth, then retry
         sched.run_until_idle()
+        # the scheduler degrades gracefully for SERVING callers (per-
+        # request status), but this batch API has no consumer watching
+        # handle.status — a decode failure must be loud, not a silently
+        # truncated generation
+        failed = [h for h in handles if h.status == "ERROR"]
+        if failed:
+            raise RuntimeError(
+                f"decode failed for {len(failed)}/{len(handles)} "
+                f"request(s): {failed[0].error}")
         return [h.tokens for h in handles]
 
     def clear_intermediate_tensor(self):

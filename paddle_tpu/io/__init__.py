@@ -23,6 +23,7 @@ import numpy as np
 
 from ..core.random import _default_generator
 from ..core.tensor import Tensor, to_tensor
+from ..observability import faults as _faults
 from ..observability import metrics as _metrics
 from ..profiler import _tracer as _TRACER
 from .worker import (WorkerInfo, collate, get_worker_info, numpy_collate,
@@ -303,6 +304,7 @@ class DataLoader:
                 if (_TRACER.enabled or _TRACER.ring is not None) else None
             t0 = time.perf_counter()
             try:
+                _faults.fire("dataloader.next")   # chaos hook (ISSUE 5)
                 batch = next(it)
             except StopIteration:
                 _TRACER.cancel(rec)

@@ -18,6 +18,12 @@ one substrate they all report through:
                        hook; dumps thread stacks, the span ring, and a
                        metrics snapshot to a postmortem artifact
                        (postmortem.v1) on hang/crash.
+  faults.py          — deterministic fault injection: named sites on the
+                       failure-prone paths (PS RPC, checkpoint commit,
+                       serving decode, DataLoader) armed via env/API to
+                       raise/delay/drop/truncate with seeded triggers;
+                       every fired fault is a metric + a span
+                       (docs/robustness.md).
 
 Producers already wired in: serving scheduler (queue depth, slot
 occupancy, admission/timeout/reject counts, tokens, TTFT), PS RPC client
@@ -31,13 +37,14 @@ backend init.
 """
 import sys
 
-from . import flight_recorder, metrics, tracecontext  # noqa: F401
+from . import faults, flight_recorder, metrics, tracecontext  # noqa: F401
 from .flight_recorder import dump_postmortem  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracecontext import merge_chrome_traces, trace_scope  # noqa: F401
 
-__all__ = ["metrics", "tracecontext", "flight_recorder", "registry",
-           "dump_postmortem", "trace_scope", "merge_chrome_traces"]
+__all__ = ["metrics", "tracecontext", "flight_recorder", "faults",
+           "registry", "dump_postmortem", "trace_scope",
+           "merge_chrome_traces"]
 
 
 def _collect_live_bytes(reg):

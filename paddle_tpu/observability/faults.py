@@ -1,0 +1,248 @@
+"""Deterministic fault injection — the chaos half of the robustness layer.
+
+Reference: the reference framework's PS stack is *tested* against worker
+churn (brpc connection resets, pserver restarts, mid-job kills) but the
+faults themselves come from flaky CI hardware. This module makes them a
+first-class, seedable input instead: named SITES on the framework's
+failure-prone paths can be armed to raise, delay, drop a connection, or
+truncate a file — with probability, every-Nth-call, and max-fire
+triggers — so the retry/breaker/checkpoint machinery is proven by tests
+that replay the exact same fault schedule every run.
+
+Site catalogue (the call sites live next to the operation they break):
+
+  ps.rpc.connect       ShardClientBase._sock, before the TCP connect
+  ps.rpc.send          ShardClientBase._exchange — fires twice per
+                       attempt: before the request is sent (request
+                       lost) and after it is sent, before the reply is
+                       read (reply lost — the PUSH-dedup-critical case)
+  checkpoint.write     ckpt_commit.atomic_commit, after the data files
+                       are written but BEFORE the manifest/rename commit
+                       (`truncate` mode tears a data file first)
+  serving.decode_step  GenerationEngine.decode, before the executable
+  dataloader.next      io.DataLoader.__iter__, before each batch
+
+Arming, in-process:
+
+    from paddle_tpu.observability import faults
+    faults.arm("ps.rpc.send", mode="drop", p=0.05, seed=7)
+
+or across processes via the environment (parsed at import, the channel
+forked trainers use):
+
+    PTN_FAULTS="ps.rpc.send=drop:p=0.05:seed=7;checkpoint.write=delay:delay=30"
+
+Zero-cost when disarmed: `fire(site)` is one function call and one empty-
+dict check. Every fired fault increments
+`faults_injected_total{site,mode}` and emits a `fault::<site>` span into
+whatever tracer/flight-recorder ring is attached (discovered through
+sys.modules — this module stays stdlib-only + metrics, importable before
+jax).
+"""
+import os
+import random
+import sys
+import threading
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
+           "disarm", "disarm_all", "armed", "fire", "load_env"]
+
+# the documented catalogue; arm() accepts any name so tests can add sites
+SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
+         "serving.decode_step", "dataloader.next")
+
+ENV_VAR = "PTN_FAULTS"
+MODES = ("raise", "delay", "drop", "truncate")
+
+_M_INJECTED = _metrics.counter(
+    "faults_injected_total", "Injected faults fired, by site and mode",
+    labelnames=("site", "mode"))
+
+
+class FaultInjected(RuntimeError):
+    """Default exception for `raise` mode (sites that retry on specific
+    exception types arm a matching `exc` instead)."""
+
+
+class FaultSpec:
+    """One armed site: trigger rule + fault mode + deterministic RNG.
+
+    Trigger: fires when `nth` divides the site's call count, OR (if
+    nth == 0) when the seeded RNG draws below `p`. `max_fires` bounds the
+    total; afterwards the site goes quiet (but stays armed, keeping the
+    call counter deterministic)."""
+
+    __slots__ = ("site", "mode", "p", "nth", "delay_s", "max_fires", "seed",
+                 "exc", "calls", "fires", "_rng", "_lock")
+
+    def __init__(self, site, mode, p=1.0, nth=0, delay_s=0.05,
+                 max_fires=None, seed=0, exc=None):
+        if mode not in MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; want {MODES}")
+        self.site = site
+        self.mode = mode
+        self.p = float(p)
+        self.nth = int(nth)
+        self.delay_s = float(delay_s)
+        self.max_fires = None if max_fires is None else int(max_fires)
+        self.seed = int(seed)
+        self.exc = exc
+        self.calls = 0
+        self.fires = 0
+        # decorrelate sites under one seed, keep each site reproducible
+        self._rng = random.Random(f"{self.seed}:{site}")
+        self._lock = threading.Lock()
+
+    def _should_fire(self):
+        with self._lock:
+            self.calls += 1
+            if self.max_fires is not None and self.fires >= self.max_fires:
+                return False
+            if self.nth > 0:
+                hit = self.calls % self.nth == 0
+            else:
+                hit = self._rng.random() < self.p
+            if hit:
+                self.fires += 1
+            return hit
+
+    def _exception(self):
+        if self.exc is not None:
+            return self.exc(f"[fault-injection] {self.site}") \
+                if isinstance(self.exc, type) else self.exc
+        if self.mode == "drop":
+            return ConnectionResetError(
+                f"[fault-injection] dropped connection at {self.site}")
+        return FaultInjected(f"[fault-injection] raised at {self.site}")
+
+    def __repr__(self):
+        return (f"FaultSpec({self.site!r}, {self.mode!r}, p={self.p}, "
+                f"nth={self.nth}, fires={self.fires}/{self.max_fires})")
+
+
+_specs = {}                      # site -> [FaultSpec]; empty == disarmed
+_specs_lock = threading.Lock()
+
+
+def arm(site, mode="raise", **kwargs):
+    """Arm `site` with one more spec (specs STACK — e.g. a drop and a
+    delay can both ride `ps.rpc.send`; they trigger independently).
+    Returns the FaultSpec."""
+    spec = FaultSpec(site, mode, **kwargs)
+    with _specs_lock:
+        _specs.setdefault(site, []).append(spec)
+    return spec
+
+
+def disarm(site):
+    """Remove every spec armed on `site`."""
+    with _specs_lock:
+        _specs.pop(site, None)
+
+
+def disarm_all():
+    with _specs_lock:
+        _specs.clear()
+
+
+def armed(site=None):
+    """The list of specs armed on `site`, or a {site: [specs]} copy when
+    site is None."""
+    with _specs_lock:
+        if site is not None:
+            return list(_specs.get(site, ()))
+        return {k: list(v) for k, v in _specs.items()}
+
+
+def _emit_span(site, spec):
+    """`fault::<site>` into the host tracer / flight-recorder ring, if the
+    profiler package is loaded (sys.modules only — never an import)."""
+    mod = sys.modules.get("paddle_tpu.profiler")
+    tracer = getattr(mod, "_tracer", None)
+    if tracer is None:
+        return
+    try:
+        span = tracer.begin(f"fault::{site}", mod.TracerEventType.UserDefined,
+                            attrs={"mode": spec.mode, "fire": spec.fires,
+                                   "call": spec.calls})
+        tracer.end(span)
+    except Exception:                                        # noqa: BLE001
+        pass                      # observability must never add a failure
+
+
+def fire(site):
+    """The injection point. Returns None when the site is quiet; when an
+    armed spec fires:
+
+      raise/drop -> raises (spec.exc, or ConnectionResetError for drop)
+      delay      -> sleeps spec.delay_s, then keeps evaluating (a delay
+                    can precede a drop or a truncate)
+      truncate   -> returns the spec; the CALL SITE performs the tear
+                    (only file writers interpret this mode)
+
+    Stacked specs on one site trigger independently, evaluated in arm
+    order. When BOTH a truncate and a delay fire on one call, the
+    truncate spec is returned regardless of arm order — the caller must
+    see the tear, not the sleep.
+    """
+    if not _specs:
+        return None
+    specs = _specs.get(site)
+    if not specs:
+        return None
+    fired = None
+    for spec in specs:
+        if not spec._should_fire():
+            continue
+        _M_INJECTED.labels(site=site, mode=spec.mode).inc()
+        _emit_span(site, spec)
+        if spec.mode == "delay":
+            time.sleep(spec.delay_s)
+            if fired is None:
+                fired = spec
+        elif spec.mode == "truncate":
+            fired = spec          # outranks delay for the caller
+        else:
+            raise spec._exception()
+    return fired
+
+
+def load_env(value=None):
+    """Parse `PTN_FAULTS` (or an explicit string) and arm the sites it
+    names. Format, `;`-separated:
+
+        site=mode[:p=0.05][:nth=3][:delay=0.2][:max=1][:seed=7]
+
+    Returns the list of armed FaultSpecs (empty when unset)."""
+    raw = os.environ.get(ENV_VAR, "") if value is None else value
+    out = []
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, *opts = part.split(":")
+        site, _, mode = head.partition("=")
+        if not site or not mode:
+            raise ValueError(f"bad {ENV_VAR} entry {part!r}: want "
+                             f"site=mode[:key=val...]")
+        kwargs = {}
+        keymap = {"p": ("p", float), "nth": ("nth", int),
+                  "delay": ("delay_s", float), "max": ("max_fires", int),
+                  "seed": ("seed", int)}
+        for opt in opts:
+            k, _, v = opt.partition("=")
+            if k not in keymap:
+                raise ValueError(f"bad {ENV_VAR} option {opt!r} in {part!r}")
+            name, conv = keymap[k]
+            kwargs[name] = conv(v)
+        out.append(arm(site, mode=mode, **kwargs))
+    return out
+
+
+# forked workers inherit the env: arming happens at import, before any
+# framework subsystem can hit a site
+if os.environ.get(ENV_VAR):
+    load_env()

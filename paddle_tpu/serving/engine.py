@@ -30,6 +30,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer.layers import functional_call, functional_state
+from ..observability import faults as _faults
 from ..profiler import RecordEvent, TracerEventType
 from . import kv_cache as kvc
 from . import sampling
@@ -198,6 +199,9 @@ class GenerationEngine:
 
     def decode(self):
         """Advance every slot one token; returns np.int32 [slots]."""
+        # chaos hook: an injected raise here exercises the scheduler's
+        # quarantine/reprobe path without touching the executable
+        _faults.fire("serving.decode_step")
         with RecordEvent("serving::decode_step",
                          TracerEventType.UserDefined,
                          {"slots": self.config.slots}):

@@ -14,6 +14,17 @@ that loop over a GenerationEngine:
               advance every occupied slot one token (decode)
   drain()  -> stop admitting, run until in-flight work finishes
 
+Graceful degradation (ISSUE 5): a decode-step exception fails ONLY the
+requests that were in flight on the affected slots — each gets terminal
+status ERROR (its future unblocks, `handle.error` carries the cause) —
+and the scheduler keeps running: the slots are quarantined, ONE probe
+slot is released to the next refill, and a successful decode step lifts
+the quarantine entirely (reprobe-then-reopen). Queued requests are
+untouched. The scheduler can therefore never wedge on a poisoned
+executable; it degrades to one-slot throughput until the engine proves
+itself healthy again. `serving_decode_failures_total` counts the events
+and failed requests land in `serving_requests_total{status="error"}`.
+
 Observability: every step appends a JSONL record (queue depth, active
 slots, tokens emitted) and every request completion appends a summary
 (TTFT, decode rate, status); the same figures feed profiler spans and
@@ -39,6 +50,7 @@ RUNNING = "RUNNING"
 DONE = "DONE"
 TIMEOUT = "TIMEOUT"
 REJECTED = "REJECTED"
+ERROR = "ERROR"
 
 # DEPRECATED counter surface: the per-instance `Scheduler.counts` dict and
 # the free-standing `native.stat_*` names below are kept for callers that
@@ -47,7 +59,7 @@ REJECTED = "REJECTED"
 # here, exported via registry().snapshot()/dump_prometheus() and rendered
 # by tools/metrics_report.py.
 _COUNTERS = ("serving.admitted", "serving.completed", "serving.rejected",
-             "serving.timeout", "serving.tokens")
+             "serving.timeout", "serving.tokens", "serving.error")
 
 _M_REQUESTS = _metrics.counter(
     "serving_requests_total",
@@ -64,6 +76,10 @@ _M_TTFT = _metrics.histogram(
     "serving_ttft_seconds", "Time to first token per completed request")
 _M_DECODE_SECONDS = _metrics.histogram(
     "serving_decode_step_seconds", "Wall time of one engine decode step")
+_M_DECODE_FAILURES = _metrics.counter(
+    "serving_decode_failures_total",
+    "Engine decode/prefill calls that raised; each fails only the "
+    "affected requests")
 
 
 class QueueFullError(RuntimeError):
@@ -90,6 +106,7 @@ class Request:
         self.submitted_at = submitted_at
         self.status = QUEUED
         self.tokens = []                  # generated tokens, stream order
+        self.error = None                 # cause string for status ERROR
         self.slot = None
         self.first_token_at = None        # TTFT timestamp
         self.finished_at = None
@@ -117,12 +134,18 @@ class RequestHandle:
     def tokens(self):
         return list(self._req.tokens)
 
+    @property
+    def error(self):
+        """The decode failure that killed this request (status ERROR)."""
+        return self._req.error
+
     def done(self):
-        return self._req.status in (DONE, TIMEOUT, REJECTED)
+        return self._req.status in (DONE, TIMEOUT, REJECTED, ERROR)
 
     def result(self, timeout=None):
-        """Block until terminal; returns the token list. TIMEOUT requests
-        return their partial output (status tells the caller)."""
+        """Block until terminal; returns the token list. TIMEOUT and
+        ERROR requests return their partial output (status/`error` tell
+        the caller)."""
         if not self._req._done.wait(timeout):
             raise TimeoutError(f"request {self._req.id} still "
                                f"{self._req.status}")
@@ -143,6 +166,8 @@ class Scheduler:
         self._clock = clock
         self._queue = collections.deque()
         self._slots = [None] * engine.slots   # Request or None
+        self._quarantined = set()             # slots held out after a failure
+        self._decode_failures = 0
         self._draining = False
         self._steps = 0
         self._decode_tokens = 0
@@ -200,15 +225,22 @@ class Scheduler:
         active = [r for r in self._slots if r is not None]
         if active:
             t0 = self._clock()
-            tokens = self.engine.decode()
-            dt = self._clock() - t0
-            self._decode_time_s += dt
-            _M_DECODE_SECONDS.observe(dt)
-            for slot, req in enumerate(self._slots):
-                if req is not None:
-                    req.tokens.append(int(tokens[slot]))
-                    self._decode_tokens += 1
-                    self._count("serving.tokens")
+            try:
+                tokens = self.engine.decode()
+            except Exception as e:                       # noqa: BLE001
+                self._on_decode_failure(e)
+            else:
+                dt = self._clock() - t0
+                self._decode_time_s += dt
+                _M_DECODE_SECONDS.observe(dt)
+                for slot, req in enumerate(self._slots):
+                    if req is not None:
+                        req.tokens.append(int(tokens[slot]))
+                        self._decode_tokens += 1
+                        self._count("serving.tokens")
+                # a healthy step is the reprobe proof: reopen every
+                # quarantined slot for the next refill
+                self._quarantined.clear()
         self._steps += 1
         _M_QUEUE_DEPTH.set(len(self._queue))
         _M_OCCUPANCY.set(sum(1 for s in self._slots if s is not None)
@@ -233,6 +265,61 @@ class Scheduler:
         if self._metrics_f:
             self._metrics_f.close()
             self._metrics_f = None
+
+    def _fail_engine_request(self, slot, req, cause):
+        """Terminal-ERROR one request after an engine failure: slot
+        reset (broken engines must not block cleanup), future unblocked,
+        error cause attached."""
+        try:
+            self.engine.reset_slot(slot)
+        except Exception:                                # noqa: BLE001
+            pass
+        self._slots[slot] = None
+        req.error = cause
+        self._finish(req, ERROR, "serving.error")
+
+    def _quarantine_all_but_probe(self):
+        """The reprobe protocol, shared by the decode and prefill
+        failure paths: EVERY slot is quarantined (free ones too —
+        otherwise a half-empty engine would refill a whole batch into
+        the next failing step), exactly one probe slot rejoins
+        immediately, and the next SUCCESSFUL decode step releases the
+        rest."""
+        self._quarantined = set(range(self.engine.slots))
+        self._quarantined.discard(min(self._quarantined))
+
+    def _on_decode_failure(self, exc):
+        """Contain a decode-step exception: error out ONLY the in-flight
+        requests, quarantine their slots, release one probe slot. The
+        queue and the step loop are untouched — the scheduler degrades
+        instead of wedging."""
+        self._decode_failures += 1
+        _M_DECODE_FAILURES.inc()
+        cause = f"{type(exc).__name__}: {exc}"
+        with RecordEvent("serving::decode_failure",
+                         TracerEventType.UserDefined,
+                         {"error": cause[:200],
+                          "failures": self._decode_failures}):
+            for slot, req in enumerate(self._slots):
+                if req is not None:
+                    self._fail_engine_request(slot, req, cause)
+        self._quarantine_all_but_probe()
+
+    def _on_prefill_failure(self, slot, req, exc):
+        """A prefill exception fails ONLY the request being placed — it
+        gets a terminal ERROR (its future unblocks, never leaks) and the
+        quarantine protocol engages exactly as for a decode failure, so
+        a broken engine degrades to one errored request per step instead
+        of escaping step() with a raw exception."""
+        self._decode_failures += 1
+        _M_DECODE_FAILURES.inc()
+        cause = f"{type(exc).__name__}: {exc}"
+        with RecordEvent("serving::prefill_failure",
+                         TracerEventType.UserDefined,
+                         {"slot": slot, "request": req.id,
+                          "error": cause[:200]}):
+            self._fail_engine_request(slot, req, cause)
+        self._quarantine_all_but_probe()
 
     # -- phases ---------------------------------------------------------------
     def _expire_queued(self, now):
@@ -269,17 +356,22 @@ class Scheduler:
     def _refill(self, now):
         eos = self.engine.config.eos_token_id
         for slot, occupant in enumerate(self._slots):
-            if occupant is not None:
+            if occupant is not None or slot in self._quarantined:
                 continue
             # a request that completes AT prefill (max_new_tokens=1, or an
             # instant eos) retires here, before decode could overrun it —
             # and frees the slot for the next queued request immediately
-            while self._queue and self._slots[slot] is None:
+            while self._queue and self._slots[slot] is None \
+                    and slot not in self._quarantined:
                 req = self._queue.popleft()
                 if req.deadline is not None and now > req.deadline:
                     self._finish(req, TIMEOUT, "serving.timeout")
                     continue
-                first = self.engine.prefill(slot, req.prompt)
+                try:
+                    first = self.engine.prefill(slot, req.prompt)
+                except Exception as e:                   # noqa: BLE001
+                    self._on_prefill_failure(slot, req, e)
+                    break
                 req.slot = slot
                 req.status = RUNNING
                 req.first_token_at = self._clock()
@@ -299,7 +391,7 @@ class Scheduler:
         self._count(counter)
         if req.first_token_at is not None:
             _M_TTFT.observe(req.first_token_at - req.submitted_at)
-        if status in (DONE, TIMEOUT):
+        if status in (DONE, TIMEOUT, ERROR):
             self._completed.append(req)
             self._write_request_record(req)
         req._done.set()

@@ -1,0 +1,703 @@
+"""Fault-injection harness + self-healing fabric (ISSUE 5).
+
+The registry itself (deterministic triggers, env arming, stacking), the
+PS RPC retry/dedup/breaker machinery under injected drops and delays
+(recovery must be BIT-EXACT vs the fault-free run), serving decode
+degradation (quarantine + reprobe instead of a wedged scheduler), the
+AsyncCommunicator lossless-flush contract, and the metrics_report
+failure-class treatment of retry counters. The chaos smoke at the
+bottom is the tier-1 guard: a short training loop with low-probability
+faults armed must land on the fault-free table state exactly.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (AsyncCommunicator, PSClient,
+                                       PSServer, PSUnavailableError,
+                                       RetryPolicy, SparseTable)
+from paddle_tpu.observability import faults, metrics
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import metrics_report  # noqa: E402
+
+DIM = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+def _counter_value(name, **labels):
+    flat = metrics.flatten_snapshot(metrics.registry().snapshot(),
+                                    kinds=("counter",))
+    key = name
+    if labels:
+        key += "{" + ",".join(f"{k}={labels[k]}"
+                              for k in sorted(labels)) + "}"
+    return flat.get(key, 0.0)
+
+
+# ---------------------------------------------------------------- registry
+
+def test_spec_probability_is_seed_deterministic():
+    a = faults.FaultSpec("x.site", "delay", p=0.3, seed=5)
+    b = faults.FaultSpec("x.site", "delay", p=0.3, seed=5)
+    seq_a = [a._should_fire() for _ in range(200)]
+    seq_b = [b._should_fire() for _ in range(200)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)
+    c = faults.FaultSpec("x.site", "delay", p=0.3, seed=6)
+    assert [c._should_fire() for _ in range(200)] != seq_a
+
+
+def test_nth_trigger_and_max_fires():
+    faults.arm("t.nth", "raise", nth=3)
+    fired = []
+    for i in range(1, 10):
+        try:
+            faults.fire("t.nth")
+            fired.append(False)
+        except faults.FaultInjected:
+            fired.append(True)
+    assert [i for i, f in zip(range(1, 10), fired) if f] == [3, 6, 9]
+
+    faults.disarm_all()
+    faults.arm("t.max", "raise", nth=2, max_fires=1)
+    hits = 0
+    for _ in range(8):
+        try:
+            faults.fire("t.max")
+        except faults.FaultInjected:
+            hits += 1
+    assert hits == 1
+
+
+def test_disarmed_site_is_quiet_and_free():
+    assert faults.fire("never.armed") is None
+    faults.arm("other.site", "raise")
+    assert faults.fire("never.armed") is None
+
+
+def test_env_parsing_and_stacking():
+    specs = faults.load_env(
+        "ps.rpc.send=drop:p=0.25:seed=7;ps.rpc.send=delay:delay=0.01;"
+        "checkpoint.write=truncate:nth=2:max=1")
+    assert len(specs) == 3
+    send = faults.armed("ps.rpc.send")
+    assert [s.mode for s in send] == ["drop", "delay"]
+    assert send[0].p == 0.25 and send[0].seed == 7
+    assert send[1].delay_s == 0.01
+    ck = faults.armed("checkpoint.write")[0]
+    assert (ck.mode, ck.nth, ck.max_fires) == ("truncate", 2, 1)
+    with pytest.raises(ValueError):
+        faults.load_env("justasite")
+    with pytest.raises(ValueError):
+        faults.load_env("a.site=raise:bogus=1")
+
+
+def test_truncate_outranks_delay_when_both_fire():
+    """truncate + delay stacked on one site (the SIGKILL-window combo):
+    the caller must receive the truncate spec regardless of arm order."""
+    faults.arm("t.combo", "truncate")
+    faults.arm("t.combo", "delay", delay_s=0.0)
+    assert faults.fire("t.combo").mode == "truncate"
+    faults.disarm_all()
+    faults.arm("t.combo", "delay", delay_s=0.0)
+    faults.arm("t.combo", "truncate")
+    assert faults.fire("t.combo").mode == "truncate"
+
+
+def test_fired_fault_counts_in_registry():
+    before = _counter_value("faults_injected_total", site="t.metric",
+                            mode="delay")
+    faults.arm("t.metric", "delay", delay_s=0.0)
+    faults.fire("t.metric")
+    after = _counter_value("faults_injected_total", site="t.metric",
+                           mode="delay")
+    assert after == before + 1
+
+
+# ------------------------------------------------------------ PS self-heal
+
+def _fast_retry(**kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("base_delay_s", 0.001)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(**kw)
+
+
+def _cluster(n=2, **client_kw):
+    servers = [PSServer(SparseTable(DIM, rule="sgd", lr=1.0, seed=s))
+               for s in range(n)]
+    client_kw.setdefault("retry", _fast_retry())
+    client = PSClient([s.endpoint for s in servers], DIM, **client_kw)
+    return servers, client
+
+
+def _teardown(servers, client):
+    client.close()
+    for s in servers:
+        s.shutdown()
+
+
+def _workload(client, steps=6):
+    """Deterministic pull/push loop; returns the final pulled rows."""
+    keys = np.array([0, 1, 2, 3, 10, 11], np.int64)
+    for step in range(steps):
+        rows = client.pull(keys)
+        grads = (rows * 0.1 + step).astype(np.float32)
+        client.push(keys, grads)
+    return client.pull(keys)
+
+
+def test_injected_drops_recover_bit_exact():
+    servers, client = _cluster()
+    want = _workload(client)
+    _teardown(servers, client)
+
+    r0 = _counter_value("ps_retries_total", verb="PULL") + \
+        _counter_value("ps_retries_total", verb="PUSH")
+    faults.arm("ps.rpc.send", "drop", p=0.15, seed=3)
+    servers, client = _cluster()
+    try:
+        got = _workload(client)
+    finally:
+        faults.disarm_all()
+        _teardown(servers, client)
+    np.testing.assert_array_equal(got, want)
+    r1 = _counter_value("ps_retries_total", verb="PULL") + \
+        _counter_value("ps_retries_total", verb="PUSH")
+    assert r1 > r0, "the fault schedule must have forced at least one retry"
+
+
+def test_push_dedup_applies_exactly_once():
+    """Reply-lost PUSH: the server applied it, the client retries it, the
+    dedup id must keep the gradient from landing twice."""
+    servers, client = _cluster(n=1)
+    try:
+        keys = np.array([42], np.int64)
+        before = client.pull(keys)
+        # fire #2 is the post-send window of the first PUSH attempt
+        faults.arm("ps.rpc.send", "drop", nth=2, max_fires=1)
+        client.push(keys, np.ones((1, DIM), np.float32))
+        faults.disarm_all()
+        after = client.pull(keys)
+        # sgd lr=1.0: exactly ONE application decrements by exactly 1.0
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+    finally:
+        faults.disarm_all()
+        _teardown(servers, client)
+
+
+def test_push_dedup_concurrent_retry_waits_for_inflight_apply():
+    """Check-then-act race: a client-timeout retry arriving while the
+    ORIGINAL apply is still running server-side must wait on the
+    in-progress sentinel, not apply again."""
+
+    class _SlowTable:
+        def __init__(self, inner):
+            self.inner, self.dim = inner, inner.dim
+            self.pushes = 0
+
+        def pull(self, keys):
+            return self.inner.pull(keys)
+
+        def push(self, keys, grads):
+            self.pushes += 1
+            time.sleep(0.4)          # longer than the client's timeout
+            self.inner.push(keys, grads)
+
+    slow = _SlowTable(SparseTable(DIM, rule="sgd", lr=1.0, seed=0))
+    server = PSServer(slow)
+    probe = PSClient([server.endpoint], DIM)          # no timeout
+    client = PSClient([server.endpoint], DIM, request_timeout_s=0.15,
+                      retry=_fast_retry(max_attempts=5, base_delay_s=0.01))
+    try:
+        keys = np.array([7], np.int64)
+        before = probe.pull(keys)
+        try:
+            client.push(keys, np.ones((1, DIM), np.float32))
+        except PSUnavailableError:
+            pass                     # budget may expire; the apply may not
+        time.sleep(1.0)              # let every server thread settle
+        after = probe.pull(keys)
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+        assert slow.pushes == 1      # the retries never re-applied
+    finally:
+        probe.close()
+        client.close()
+        server.shutdown()
+
+
+def test_server_error_restores_pooled_socket_timeout():
+    """A PSServerError reply keeps the socket; the deadline-shrunken
+    per-attempt timeout must not leak onto it."""
+    from paddle_tpu.distributed.ps.rpc import PSServerError
+    server = PSServer(table=None)    # PULL raises a serving error
+    client = PSClient([server.endpoint], DIM,
+                      retry=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                                        deadline_s=30.0, seed=0))
+    try:
+        with pytest.raises(PSServerError):
+            client.pull(np.array([1], np.int64))
+        assert client._socks[0] is not None          # socket was kept
+        assert client._socks[0].gettimeout() == client._request_timeout
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_push_identity_rerandomizes_across_fork():
+    """Parent and forked child must never emit colliding (client_id,
+    seq) pairs — the dedup LRU would silently drop real gradients."""
+    c = PSClient(["127.0.0.1:1"], DIM)
+    cid1, seq1 = c._next_push_reqid()
+    assert (cid1, seq1)[1] == 1
+    # simulate a fork: the cached identity carries a foreign pid
+    pid, cid, ctr = c._push_ident
+    c._push_ident = (pid - 1, cid, ctr)
+    cid2, seq2 = c._next_push_reqid()
+    assert cid2 != cid1          # fresh 64-bit id (collision p ~ 2^-64)
+    assert seq2 == 1             # and a fresh sequence
+    c.close()
+
+
+def test_push_seen_trim_never_evicts_inflight_sentinel(monkeypatch):
+    """LRU overflow must only evict APPLIED markers — evicting a live
+    in-progress Event reopens the double-apply race."""
+    from paddle_tpu.distributed.ps import rpc as rpc_mod
+    monkeypatch.setattr(rpc_mod, "_PUSH_SEEN_CAP", 3)
+    server = PSServer(SparseTable(DIM, rule="sgd", lr=1.0, seed=0))
+    try:
+        state, ev = server._push_begin(("inflight", 0))
+        assert state == "mine"
+        for i in range(6):
+            st, e2 = server._push_begin(("done", i))
+            assert st == "mine"
+            server._push_end(("done", i), e2, applied=True)
+        assert server._push_seen[("inflight", 0)] is ev   # survived
+        assert sum(1 for v in server._push_seen.values()
+                   if v is True) <= 3
+    finally:
+        server.shutdown()
+
+
+def test_breaker_opens_then_half_open_probe_recovers():
+    servers, client = _cluster(
+        n=1, retry=_fast_retry(max_attempts=1),
+        breaker_threshold=2, breaker_cooldown_s=0.1)
+    try:
+        endpoint = servers[0].endpoint
+        gauge = metrics.registry().gauge("ps_breaker_state",
+                                         labelnames=("endpoint",))
+        faults.arm("ps.rpc.send", "drop", max_fires=2)
+        with pytest.raises(PSUnavailableError):
+            client.ping()                       # failure 1
+        with pytest.raises(PSUnavailableError):
+            client.ping()                       # failure 2 -> OPEN
+        assert gauge.labels(endpoint=endpoint).value == 1
+        with pytest.raises(PSUnavailableError, match="breaker is open"):
+            client.ping()                       # fast-fail, no socket work
+        time.sleep(0.15)                        # cooldown elapses
+        assert client.ping()                    # half-open probe succeeds
+        assert gauge.labels(endpoint=endpoint).value == 0
+    finally:
+        faults.disarm_all()
+        _teardown(servers, client)
+
+
+def test_connect_failure_counts_and_surfaces_cleanly():
+    before = _counter_value("ps_errors_total", side="client")
+    client = PSClient(["127.0.0.1:1"], DIM, connect_timeout_s=0.2,
+                      retry=_fast_retry(max_attempts=2))
+    t0 = time.monotonic()
+    with pytest.raises(PSUnavailableError):
+        client.ping()
+    assert time.monotonic() - t0 < 5.0
+    assert _counter_value("ps_errors_total", side="client") >= before + 2
+    client.close()
+
+
+def test_timeout_knobs_env_and_kwargs(monkeypatch):
+    monkeypatch.delenv("PTN_PS_CONNECT_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("PTN_PS_REQUEST_TIMEOUT_S", raising=False)
+    c = PSClient(["127.0.0.1:1"], DIM)
+    # the pre-retry fabric's 30s socket timeout is the default — a hung
+    # server must surface, not block forever
+    assert c._request_timeout == 30.0 and c._connect_timeout == 30.0
+    c.close()
+    c = PSClient(["127.0.0.1:1"], DIM, request_timeout_s=0)
+    assert c._request_timeout is None        # 0 opts into blocking
+    c.close()
+    monkeypatch.setenv("PTN_PS_CONNECT_TIMEOUT_S", "1.5")
+    monkeypatch.setenv("PTN_PS_REQUEST_TIMEOUT_S", "2.5")
+    c = PSClient(["127.0.0.1:1"], DIM)
+    assert c._connect_timeout == 1.5
+    assert c._request_timeout == 2.5
+    c.close()
+    # kwargs win over env
+    c = PSClient(["127.0.0.1:1"], DIM, connect_timeout_s=0.5,
+                 request_timeout_s=0.75)
+    assert c._connect_timeout == 0.5
+    assert c._request_timeout == 0.75
+    c.close()
+
+
+def test_deadline_bounds_a_wedged_shard():
+    """A server that accepts but never replies must not hang a caller
+    whose verb carries a deadline — the remaining budget becomes the
+    attempt's socket timeout."""
+    import socket as socketlib
+    lsock = socketlib.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    host, port = lsock.getsockname()
+    client = PSClient(
+        [f"{host}:{port}"], DIM,
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                          deadline_s=0.3, seed=0))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(PSUnavailableError):
+            client.ping()
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        client.close()
+        lsock.close()
+
+
+def test_deadline_expiry_during_backoff_counts_one_failure():
+    """A deadline that lapses while SLEEPING between retries must not
+    register a second breaker failure — one real fault, one count."""
+    servers, client = _cluster(
+        n=1, retry=RetryPolicy(max_attempts=5, base_delay_s=0.2,
+                               jitter=0.0, deadline_s=0.05, seed=0),
+        breaker_threshold=10)
+    try:
+        faults.arm("ps.rpc.send", "drop", max_fires=1)
+        with pytest.raises(PSUnavailableError, match="deadline exhausted"):
+            client.ping()
+        assert client._breakers[0]._fails == 1
+    finally:
+        faults.disarm_all()
+        _teardown(servers, client)
+
+
+def test_deadline_bounds_connect_time():
+    """The per-verb deadline clamps the TCP connect timeout too — a
+    blackholed shard cannot consume the full connect_timeout."""
+    client = PSClient(
+        ["10.255.255.1:9", ], DIM, connect_timeout_s=5.0,
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                          deadline_s=0.3, seed=0))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(PSUnavailableError):
+            client.ping()
+        assert time.monotonic() - t0 < 4.0
+    finally:
+        client.close()
+
+
+def test_per_verb_deadline():
+    policy = RetryPolicy(deadline_s={"PULL": 0.5, "PUSH": 2.0})
+    assert policy.deadline_for("PULL") == 0.5
+    assert policy.deadline_for("PUSH") == 2.0
+    assert policy.deadline_for("GSAMPLE") is None
+    flat = RetryPolicy(deadline_s=1.0)
+    assert flat.deadline_for("PULL") == 1.0
+
+
+# ------------------------------------------------------- chaos train smoke
+
+def test_chaos_smoke_converges_to_fault_free_state():
+    """Tier-1 chaos guard: drop(p=0.05) + delay(p=0.05) armed on the PS
+    send path, a short embedding training loop must land BIT-EXACTLY on
+    the fault-free final table state (retries are invisible to the
+    math; PUSH dedup keeps gradients exactly-once)."""
+    servers, client = _cluster()
+    want = _workload(client, steps=8)
+    _teardown(servers, client)
+
+    faults.arm("ps.rpc.send", "drop", p=0.05, seed=3)
+    faults.arm("ps.rpc.send", "delay", p=0.05, delay_s=0.002, seed=4)
+    servers, client = _cluster()
+    try:
+        got = _workload(client, steps=8)
+    finally:
+        faults.disarm_all()
+        _teardown(servers, client)
+    np.testing.assert_array_equal(got, want)
+
+
+SERVER_SCRIPT = r"""
+import sys
+sys.path.insert(0, sys.argv[3])
+from paddle_tpu.distributed.ps import PSServer, SparseTable
+srv = PSServer(SparseTable(4, rule="sgd", lr=1.0, seed=int(sys.argv[2])))
+with open(sys.argv[1], "w") as f:
+    f.write(srv.endpoint)
+import time
+while not srv._stop.is_set():
+    time.sleep(0.1)
+"""
+
+
+def _forked_cluster(tmp_path, tag):
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(faults.ENV_VAR, None)   # faults are CLIENT-side in this test
+    procs, endpoints = [], []
+    for seed in range(2):
+        ep_file = str(tmp_path / f"ep_{tag}_{seed}.txt")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT, ep_file, str(seed), repo],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        endpoints.append(ep_file)
+    eps = []
+    for ep_file in endpoints:
+        for _ in range(200):
+            if os.path.exists(ep_file) and open(ep_file).read().strip():
+                break
+            time.sleep(0.1)
+        eps.append(open(ep_file).read().strip())
+    return procs, eps
+
+
+def test_two_forked_server_chaos_run_bit_exact(tmp_path):
+    """The acceptance run: real server processes, drop+delay armed on the
+    client's PS send path at p=0.05 — the training loop's final table
+    state must equal the fault-free run's exactly."""
+    finals = []
+    for tag, with_faults in (("clean", False), ("chaos", True)):
+        procs, eps = _forked_cluster(tmp_path, tag)
+        client = PSClient(eps, DIM, retry=_fast_retry())
+        try:
+            if with_faults:
+                faults.arm("ps.rpc.send", "drop", p=0.05, seed=3)
+                faults.arm("ps.rpc.send", "delay", p=0.05, delay_s=0.002,
+                           seed=4)
+            finals.append(_workload(client, steps=6))
+        finally:
+            faults.disarm_all()
+            client.stop_servers()
+            client.close()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:       # noqa: BLE001
+                    p.kill()
+    np.testing.assert_array_equal(finals[0], finals[1])
+
+
+# ------------------------------------------------------ serving degradation
+
+class _StubConfig:
+    eos_token_id = None
+    max_len = 64
+
+
+class _StubEngine:
+    """Minimal engine contract for Scheduler: decode() runs through the
+    real fault site semantics."""
+
+    def __init__(self, slots=2):
+        self.config = _StubConfig()
+        self.slots = slots
+        self.max_prompt_len = 8
+        self.resets = []
+
+    def prefill(self, slot, prompt):
+        return 1
+
+    def decode(self):
+        faults.fire("serving.decode_step")
+        return np.full((self.slots,), 2, np.int32)
+
+    def reset_slot(self, slot):
+        self.resets.append(slot)
+
+
+def test_decode_failure_fails_only_inflight_and_reprobes():
+    from paddle_tpu.serving.scheduler import DONE, ERROR, Scheduler
+    eng = _StubEngine(slots=2)
+    s = Scheduler(eng, max_queue=8, default_max_new_tokens=3)
+    h1 = s.submit([1, 2])
+    h2 = s.submit([3, 4])
+    h3 = s.submit([5, 6])
+    fail_before = _counter_value("serving_decode_failures_total")
+    faults.arm("serving.decode_step", "raise", max_fires=1)
+    s.step()            # both slots prefill, decode raises
+    assert h1.status == ERROR and h2.status == ERROR
+    assert h1.done() and h2.done()
+    assert "fault-injection" in h1.error
+    assert h1.tokens == [1]                  # partial output survives
+    assert _counter_value("serving_decode_failures_total") == fail_before + 1
+    # quarantine: one probe slot released, the other held out
+    assert len(s._quarantined) == 1
+    s.step()            # probe slot serves h3; success lifts quarantine
+    assert s._quarantined == set()
+    s.run_until_idle()
+    assert h3.status == DONE
+    assert h3.tokens == [1, 2, 2]
+    assert s.counts["serving.error"] == 2
+    assert s.counts["serving.completed"] == 1
+
+
+def test_decode_failure_quarantines_free_slots_too():
+    """With free slots at failure time, the refill must still be limited
+    to ONE probe — not a whole batch fed into the next failing step."""
+    from paddle_tpu.serving.scheduler import ERROR, Scheduler
+    eng = _StubEngine(slots=4)
+    s = Scheduler(eng, max_queue=16, default_max_new_tokens=3)
+    h1 = s.submit([1, 2])                    # ONE request, 3 slots free
+    faults.arm("serving.decode_step", "raise", max_fires=1)
+    s.step()                                 # h1 prefills; decode raises
+    assert h1.status == ERROR
+    assert len(s._quarantined) == eng.slots - 1
+    later = [s.submit([9, 9]) for _ in range(6)]
+    s.step()                                 # only the probe slot refills
+    assert sum(1 for q in later if q.status != "QUEUED") == 1
+    s.run_until_idle()
+    assert all(q.done() for q in later)
+
+
+def test_prefill_failure_contained_and_scheduler_continues():
+    """A prefill exception fails only the request being placed; the
+    scheduler keeps running and later requests still complete."""
+    from paddle_tpu.serving.scheduler import DONE, ERROR, Scheduler
+
+    class _PrefillOnceBroken(_StubEngine):
+        def __init__(self, slots=2):
+            super().__init__(slots)
+            self.fail_next_prefill = True
+
+        def prefill(self, slot, prompt):
+            if self.fail_next_prefill:
+                self.fail_next_prefill = False
+                raise RuntimeError("prefill boom")
+            return 1
+
+    eng = _PrefillOnceBroken(slots=2)
+    s = Scheduler(eng, max_queue=8, default_max_new_tokens=2)
+    h1 = s.submit([1, 2])
+    h2 = s.submit([3, 4])
+    s.run_until_idle()
+    assert h1.status == ERROR and "prefill boom" in h1.error
+    assert h1.done()                          # the future never leaks
+    assert h2.status == DONE
+    assert s.counts["serving.error"] == 1
+
+
+def test_predictor_generate_is_loud_on_decode_failure():
+    """The batch API has no consumer of handle.status — a decode failure
+    must raise, never return silently truncated generations."""
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.serving.scheduler import Scheduler
+    eng = _StubEngine(slots=2)
+    sched = Scheduler(eng, max_queue=8, default_max_new_tokens=3)
+    pred = Predictor.__new__(Predictor)
+    pred._generation_scheduler = lambda **kw: sched
+    faults.arm("serving.decode_step", "raise", max_fires=1)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        Predictor.generate(pred, [[1, 2], [3, 4]], max_new_tokens=3)
+    faults.disarm_all()
+    # healthy engine: same call path succeeds
+    sched2 = Scheduler(_StubEngine(slots=2), max_queue=8,
+                       default_max_new_tokens=3)
+    pred._generation_scheduler = lambda **kw: sched2
+    out = Predictor.generate(pred, [[1, 2]], max_new_tokens=3)
+    assert out == [[1, 2, 2]]
+
+
+def test_decode_failure_never_wedges_drain():
+    from paddle_tpu.serving.scheduler import Scheduler
+    eng = _StubEngine(slots=2)
+    s = Scheduler(eng, max_queue=8, default_max_new_tokens=2)
+    handles = [s.submit([i]) for i in range(5)]
+    faults.arm("serving.decode_step", "raise", nth=2)   # every 2nd step
+    s.drain(max_steps=200)
+    assert all(h.done() for h in handles)
+
+
+# --------------------------------------------------- communicator lossless
+
+class _BlockingTable:
+    def __init__(self):
+        import threading
+        self.release = threading.Event()
+        self.dim = DIM
+
+    def push(self, keys, grads):
+        self.release.wait(10)
+
+
+class _FailingTable:
+    dim = DIM
+
+    def push(self, keys, grads):
+        raise ConnectionError("shard dark")
+
+
+def test_flush_timeout_reports_unflushed_count():
+    t = _BlockingTable()
+    comm = AsyncCommunicator(t, merge_batches=1)
+    comm.start()
+    comm.push_sparse(np.array([1], np.int64), np.ones((1, DIM), np.float32))
+    with pytest.raises(TimeoutError) as ei:
+        comm.flush(timeout=0.2)
+    assert ei.value.unflushed >= 1
+    t.release.set()
+    comm.flush(timeout=5.0)          # drains cleanly once unblocked
+    comm.stop()
+
+
+def test_flush_surfaces_background_push_failure():
+    comm = AsyncCommunicator(_FailingTable(), merge_batches=1)
+    comm.start()
+    comm.push_sparse(np.array([1], np.int64), np.ones((1, DIM), np.float32))
+    with pytest.raises(RuntimeError, match="dropped") as ei:
+        comm.flush(timeout=5.0)
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    comm.stop()
+
+
+# ----------------------------------------------------- metrics_report gate
+
+def _snap(**counters):
+    mets = []
+    for name, samples in counters.items():
+        mets.append({"name": name, "type": "counter", "help": "",
+                     "labelnames": sorted({k for s, _ in samples
+                                           for k in s}),
+                     "samples": [{"labels": labels, "value": v}
+                                 for labels, v in samples]})
+    return {"schema": metrics_report.SCHEMA, "ts": 0.0, "pid": 1,
+            "metrics": mets}
+
+
+def test_retries_are_failure_class_in_compare():
+    a = _snap(ps_retries_total=[({"verb": "PULL"}, 2.0)],
+              serving_tokens_total=[({}, 100.0)])
+    b = _snap(ps_retries_total=[({"verb": "PULL"}, 40.0)],
+              serving_tokens_total=[({}, 100.0)])
+    regs = metrics_report.compare_counters(a, b)
+    assert len(regs) == 1
+    key, _, _, _, why = regs[0]
+    assert key.startswith("ps_retries_total")
+    assert why == "failure counter grew"
+    # and the same growth in a work counter is NOT a regression
+    a2 = _snap(serving_tokens_total=[({}, 2.0)])
+    b2 = _snap(serving_tokens_total=[({}, 40.0)])
+    assert metrics_report.compare_counters(a2, b2) == []

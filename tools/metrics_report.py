@@ -15,7 +15,11 @@ two runs with a REGRESSION mode for CI:
 threshold. Direction matters and is decided per counter name:
 
   - FAILURE counters (name matches error|reject|timeout|miss|drop|
-    failure): regression = the count GREW past the threshold,
+    failure|retr(y|ies)|fault|breaker): regression = the count GREW past
+    the threshold — `ps_retries_total` and friends are failure-CLASS
+    evidence (each one is a transport fault the fabric absorbed), so a
+    run that suddenly retries more is a regression even when it still
+    converges,
   - all other counters (work done: tokens, requests, bytes, hits):
     regression = the count SHRANK past the threshold.
 
@@ -33,7 +37,8 @@ import sys
 SCHEMA = "paddle_tpu.metrics.v1"
 _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
-    r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure", re.I)
+    r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
+    r"|fault|breaker", re.I)
 
 
 # ------------------------------------------------------------- validation
