@@ -430,6 +430,11 @@ def _parse_args(argv):
                    help="decode-throughput rung: steady-state tokens/sec "
                         "through the serving engine's single decode "
                         "executable instead of the train ladder")
+    p.add_argument("--serve-load", action="store_true",
+                   help="traffic-replay rung: tools/load_harness.py "
+                        "shared-prefix mixture through the paged engine, "
+                        "with the dense per-slot engine raced at the same "
+                        "KV memory budget for the concurrency comparison")
     return p.parse_args(argv)
 
 
@@ -480,6 +485,64 @@ def run_decode_bench(on_tpu, n_steps=None):
     }
 
 
+def run_serve_load_bench(on_tpu, n_requests=None):
+    """Serving load rung: the deterministic traffic-replay harness
+    (tools/load_harness.py) at a shared-prefix mixture, paged engine vs
+    the dense per-slot engine AT THE SAME KV MEMORY BUDGET. The metric is
+    the paged engine's replay tokens/sec; extra carries both summaries
+    (p50/p99 TTFT, peak concurrency, prefix hits, preemptions) plus the
+    compile-once counters, and vs_baseline is the paged/dense concurrency
+    ratio — >1.0 is the paged-KV win."""
+    import jax
+
+    import paddle_tpu  # noqa: F401  (registers the framework)
+    from paddle_tpu.text import models as _models
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_harness
+
+    model_name = os.environ.get("BENCH_SERVE_MODEL",
+                                "gpt_125m" if on_tpu else "gpt_tiny")
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 4 if on_tpu else 3))
+    max_len = int(os.environ.get("BENCH_SERVE_MAXLEN",
+                                 512 if on_tpu else 64))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 16 if on_tpu else 8))
+    requests = n_requests or int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                                64 if on_tpu else 12))
+    budget = slots * max_len
+    num_blocks = budget // block
+    paged_slots = int(os.environ.get("BENCH_SERVE_PAGED_SLOTS",
+                                     min(2 * slots, num_blocks - 1)))
+    model = getattr(_models, model_name)()
+    model.eval()
+    traffic = load_harness.TrafficConfig(
+        users=int(os.environ.get("BENCH_SERVE_USERS", 8)),
+        requests=requests,
+        rate_rps=float(os.environ.get("BENCH_SERVE_RPS", 500.0)),
+        prefix_len=int(os.environ.get("BENCH_SERVE_PREFIX", 2 * block)),
+        max_new_tokens=int(os.environ.get("BENCH_SERVE_MAXNEW", 4)),
+        seed=0)
+    results = {}
+    for kind, n_slots in (("dense", slots), ("paged", paged_slots)):
+        results[kind] = load_harness.run_harness(
+            model, kind, traffic, slots=n_slots, max_len=max_len,
+            block_size=block, num_blocks=num_blocks)
+    paged, dense = results["paged"], results["dense"]
+    ratio = (paged["max_concurrent"] / dense["max_concurrent"]
+             if dense["max_concurrent"] else 0.0)
+    return {
+        "value": paged["tokens_per_s"] or 0.0,
+        "vs_baseline": round(ratio, 3),     # paged/dense concurrency ratio
+        "extra": {"metric_name": "serve_load_tokens_per_s",
+                  "model": model_name, "kv_memory_tokens": budget,
+                  "paged": paged, "dense": dense,
+                  "paged_beats_dense_concurrency":
+                      paged["max_concurrent"] > dense["max_concurrent"],
+                  "backend": jax.default_backend()},
+    }
+
+
 def main(argv=None):
     global _PROFILE_DIR
     args = _parse_args(argv or [])
@@ -523,6 +586,19 @@ def main(argv=None):
                             "decode rung")
         try:
             result = run_decode_bench(on_tpu, n_steps=args.steps)
+            emit(result["value"], result["vs_baseline"],
+                 extra=result["extra"])
+        finally:
+            wd.cancel()
+        return
+
+    if args.serve_load:
+        METRIC = "gpt_serve_load_tokens_per_s"
+        UNIT = "replay decode tokens/sec (paged engine)"
+        wd = start_watchdog(float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
+                            "serve-load rung")
+        try:
+            result = run_serve_load_bench(on_tpu)
             emit(result["value"], result["vs_baseline"],
                  extra=result["extra"])
         finally:

@@ -145,6 +145,41 @@ def record_flash_blocks(H, S, D, causal, blocks, persist=True):
         _fallback_keys.add(key)
 
 
+def commit_shipped_table(entries, backend="tpu", path=None):
+    """Commit measured (block_q, block_k) winners into the SHIPPED table
+    (`ops/pallas/flash_blocks_tuned.json`) — the path on-chip sweep
+    results (tools/profile_step.py) take into the tree, using the exact
+    cache serialization `lookup_flash_blocks` reads back.
+
+    entries: {(H, S, D, causal): (block_q, block_k)}. Existing shipped
+    entries for other geometries are preserved (load-then-merge). The
+    in-process disk cache is invalidated so the committing process sees
+    its own commit."""
+    global _disk_loaded
+    path = path or _SHIPPED_PATH
+    merged = _read_cache_file(path)
+    for (H, S, D, causal), blocks in entries.items():
+        bq, bk = int(blocks[0]), int(blocks[1])
+        if bq <= 0 or bk <= 0 or bq % 8 or bk % 8:
+            raise ValueError(f"blocks {blocks} must be positive multiples "
+                             f"of 8 (TPU sublane alignment)")
+        if S % bq or S % bk:
+            raise ValueError(f"blocks {blocks} do not tile S={S}")
+        if causal and bq != bk:
+            # the kernel requires square blocks under causal masking;
+            # committing a non-square pair would ship an entry the
+            # runtime guard silently ignores — reject it here instead
+            raise ValueError(f"causal entries need square blocks, got "
+                             f"{blocks}")
+        merged[(backend, int(H), int(S), int(D), bool(causal))] = (bq, bk)
+    with open(path, "w") as f:
+        json.dump({json.dumps(list(k)): list(v)
+                   for k, v in sorted(merged.items())}, f, indent=1)
+    _disk_cache.clear()
+    _disk_loaded = False
+    return path
+
+
 def autotune_flash_blocks(B, H, S, D, causal=True, dtype="bfloat16",
                           candidates=(128, 256, 512), n_iters=3):
     """Measure each candidate square block on the live backend and cache the

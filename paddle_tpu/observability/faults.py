@@ -20,6 +20,11 @@ Site catalogue (the call sites live next to the operation they break):
                        are written but BEFORE the manifest/rename commit
                        (`truncate` mode tears a data file first)
   serving.decode_step  GenerationEngine.decode, before the executable
+  serving.block_alloc  serving.blocks.BlockPool.alloc, before the free-
+                       list pop — armed with exc=BlockAllocError it
+                       simulates pool exhaustion (the scheduler's
+                       preemption path); default raise exercises the
+                       contained-prefill-failure path
   dataloader.next      io.DataLoader.__iter__, before each batch
 
 Arming, in-process:
@@ -52,7 +57,7 @@ __all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
 
 # the documented catalogue; arm() accepts any name so tests can add sites
 SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
-         "serving.decode_step", "dataloader.next")
+         "serving.decode_step", "serving.block_alloc", "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

@@ -73,9 +73,15 @@ def _pallas_flash_bhsd(q, k, v, causal, scale, mask=None, dropout_rate=0.0,
         B, H, S, D = q.shape
         hit = lookup_flash_blocks(B, H, S, D, causal)
         if hit:
-            bq, bk = hit
+            bq, bk = int(hit[0]), int(hit[1])
+            # a tuned entry must actually tile this call (a stale or
+            # hand-edited table row that doesn't divide S, or breaks the
+            # causal square-block requirement, would raise mid-forward);
+            # fall back to the kernel's static default instead
+            if S % bq or S % bk or (causal and bq != bk):
+                bq = bk = None
     except Exception:                                        # noqa: BLE001
-        pass
+        bq = bk = None
     return flash_attention(q, k, v, mask=mask, causal=causal, sm_scale=scale,
                            dropout_rate=dropout_rate,
                            dropout_seed=dropout_seed,

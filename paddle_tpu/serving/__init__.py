@@ -1,29 +1,44 @@
 """paddle_tpu.serving — TPU-native generation & serving engine.
 
 The reference deploy story stops at a one-shot Predictor (SURVEY §2.7);
-this package is the generation tier above it, built from the two ideas
-that turn a compiled decoder into a serving engine:
+this package is the generation tier above it, built from the ideas that
+turn a compiled decoder into a serving engine:
 
-  kv_cache.py  — static-shape preallocated KV cache (one decode
-                 executable, ever; vLLM's preallocation insight)
-  sampling.py  — greedy / temperature / top-k / top-p token selection
-  engine.py    — prefill/decode split: length-bucketed prefill
-                 executables feed the single decode executable
-  scheduler.py — iteration-level (continuous) batching à la Orca:
-                 per-slot eos retirement and mid-flight refill, queue
-                 caps, deadlines, graceful drain, serving metrics
+  kv_cache.py     — static-shape preallocated KV cache (one decode
+                    executable, ever; vLLM's preallocation insight)
+  blocks.py       — paged KV: fixed-size block pool + per-slot block
+                    tables, refcounted for copy-on-write sharing
+  prefix_cache.py — shared system-prompt blocks, keyed on prompt-token
+                    hash, LRU-evicted under allocation pressure
+  sampling.py     — greedy / temperature / top-k / top-p token selection
+  engine.py       — prefill/decode split: length-bucketed prefill
+                    executables feed the single decode executable
+                    (dense GenerationEngine + PagedGenerationEngine)
+  scheduler.py    — SLO-aware continuous batching: priority classes,
+                    deadline/priority preemption that frees blocks back
+                    to the pool, watermark load shedding, queue caps,
+                    graceful drain, serving metrics
 
-`inference.Predictor.generate` and `bench.py --decode` ride the same
-engine. See docs/serving.md.
+`inference.Predictor.generate`, `bench.py --decode/--serve-load` and
+`tools/load_harness.py` ride the same engines. See docs/serving.md.
 """
-from . import kv_cache, sampling  # noqa: F401
-from .engine import EngineConfig, GenerationEngine, save_for_generation  # noqa: F401
+from . import blocks, kv_cache, prefix_cache, sampling  # noqa: F401
+from .blocks import BlockAllocError, BlockPool  # noqa: F401
+from .engine import (  # noqa: F401
+    EngineConfig, GenerationEngine, PagedEngineConfig, PagedGenerationEngine,
+    save_for_generation,
+)
+from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
-    QueueFullError, Request, RequestHandle, Scheduler, ServingConfig,
+    LoadShedError, QueueFullError, Request, RequestHandle, Scheduler,
+    ServingConfig,
 )
 
 __all__ = [
-    "kv_cache", "sampling", "EngineConfig", "GenerationEngine",
-    "save_for_generation", "Scheduler", "ServingConfig", "Request",
-    "RequestHandle", "QueueFullError",
+    "kv_cache", "blocks", "prefix_cache", "sampling",
+    "BlockAllocError", "BlockPool", "PrefixCache",
+    "EngineConfig", "GenerationEngine", "PagedEngineConfig",
+    "PagedGenerationEngine", "save_for_generation",
+    "Scheduler", "ServingConfig", "Request", "RequestHandle",
+    "QueueFullError", "LoadShedError",
 ]

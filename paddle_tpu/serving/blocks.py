@@ -1,0 +1,195 @@
+"""Paged KV memory: fixed-size block pool + block-table attention.
+
+The PR 3 cache (`kv_cache.py`) preallocates one dense
+`[slots, max_len, heads, head_dim]` buffer per layer — one implicit
+max_len-sized block per slot. At scale that layout fragments: every slot
+reserves its worst case, so concurrency is bounded by
+`budget // max_len` even when the live requests are short, and two
+requests sharing a system prompt store its K/V twice. This module is the
+real PagedAttention shape [SOSP '23]: K/V live in a pool of fixed-size
+blocks (`[num_blocks, block_size, heads, head_dim]` per layer), each
+slot owns a small int32 *block table* mapping logical block index ->
+physical block id, and attention gathers the slot's blocks back into a
+contiguous view before running the exact same masked math as the dense
+path — token-exact by construction, and the avals (pool, tables, pos)
+never change shape, so the decode step still compiles exactly once.
+
+Two halves:
+
+  - device (pure jnp, used inside the jitted executables): `alloc_pools`,
+    `write` (scatter new tokens into their blocks), `gather`, `attend`
+    (gather + `kv_cache.attend`).
+  - host (the allocator): `BlockPool` — free list + per-block refcounts,
+    so the prefix cache can share blocks copy-on-write across requests
+    (a shared block is never written; sharing is full-block-granular).
+    `serving.block_alloc` is a fault-injection site, and pool occupancy
+    is exported through the metrics registry.
+
+Block id 0 is RESERVED as the garbage block: unallocated table entries
+point at it, so stray writes from right-padded prefill tails land there
+harmlessly and the gather for masked positions reads it invisibly.
+"""
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..observability import faults as _faults
+from ..observability import metrics as _metrics
+from . import kv_cache as kvc
+
+__all__ = ["BlockAllocError", "BlockPool", "PagedLayerKV",
+           "PagedDecodeCache", "alloc_pools", "write", "gather", "attend",
+           "blocks_for_tokens", "GARBAGE_BLOCK"]
+
+GARBAGE_BLOCK = 0
+
+_M_POOL_TOTAL = _metrics.gauge(
+    "serving_block_pool_blocks_total",
+    "Allocatable KV blocks in the live engine's pool (garbage block "
+    "excluded)")
+_M_POOL_IN_USE = _metrics.gauge(
+    "serving_block_pool_blocks_in_use",
+    "KV blocks currently referenced (request tables + prefix cache)")
+
+
+class BlockAllocError(RuntimeError):
+    """Block pool exhausted — allocation pressure, the scheduler's cue to
+    evict prefix-cache entries or preempt a victim request."""
+
+
+# One layer's paged K/V: [num_blocks, block_size, heads, head_dim] pools.
+PagedLayerKV = collections.namedtuple("PagedLayerKV", ["k", "v"])
+
+# Whole-model paged cache: `layers` tuple of PagedLayerKV, `tables` int32
+# [slots, max_blocks_per_slot] physical block ids (0 == garbage), `pos`
+# int32 [slots] tokens written per slot — same role as DecodeCache.pos.
+PagedDecodeCache = collections.namedtuple(
+    "PagedDecodeCache", ["layers", "tables", "pos"])
+
+
+def blocks_for_tokens(n_tokens, block_size):
+    """Logical blocks needed to hold n_tokens."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+def alloc_pools(num_layers, num_blocks, block_size, num_heads, head_dim,
+                dtype=jnp.float32):
+    """Zeroed K/V pools for a whole model: one PagedLayerKV per layer."""
+    shape = (num_blocks, block_size, num_heads, head_dim)
+    return tuple(PagedLayerKV(jnp.zeros(shape, dtype),
+                              jnp.zeros(shape, dtype))
+                 for _ in range(num_layers))
+
+
+def write(pool, new, tables, pos):
+    """Scatter `new` [S, T, h, d] token K/V into `pool`
+    [N, block_size, h, d] at logical positions `pos + 0..T-1` of each
+    slot, routed through `tables` [S, max_blocks]. Positions past the
+    table (right-padded prefill tails) and unallocated logical blocks
+    land in the garbage block. Shapes are static — same trace for every
+    call."""
+    T = new.shape[1]
+    bs = pool.shape[1]
+    nb = tables.shape[1]
+    positions = pos.astype(jnp.int32)[:, None] \
+        + jnp.arange(T, dtype=jnp.int32)[None, :]          # [S, T]
+    lb = positions // bs
+    off = positions % bs
+    phys = jnp.take_along_axis(tables.astype(jnp.int32),
+                               jnp.minimum(lb, nb - 1), axis=1)
+    phys = jnp.where(lb < nb, phys, GARBAGE_BLOCK)
+    return pool.at[phys, off].set(new.astype(pool.dtype))
+
+
+def gather(pool, tables):
+    """Rebuild each slot's contiguous [S, max_blocks*block_size, h, d]
+    K/V view from the pool via its block table (one XLA gather)."""
+    S, nb = tables.shape
+    g = pool[tables.astype(jnp.int32)]        # [S, nb, bs, h, d]
+    return g.reshape(S, nb * pool.shape[1], pool.shape[2], pool.shape[3])
+
+
+def attend(q, k_pool, v_pool, tables, pos, scale=None):
+    """Block-table attention: gather the slot's blocks into the dense
+    layout, then run the exact dense masked attention (`kv_cache.attend`)
+    — token-exact vs the per-slot dense path because the gathered view
+    reproduces it elementwise and masked positions contribute exact
+    zeros."""
+    return kvc.attend(q, gather(k_pool, tables), gather(v_pool, tables),
+                      pos, scale)
+
+
+class BlockPool:
+    """Host-side allocator over physical block ids 1..num_blocks-1
+    (id 0 is the reserved garbage block). Refcounted: a block is returned
+    to the free list when its last reference drops — the prefix cache
+    holds one reference per cached block, each request's table row holds
+    one per entry, which is what makes copy-on-write sharing safe (shared
+    blocks are simply never written; writers always own fresh blocks)."""
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (one is reserved "
+                             "as the garbage block)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(self.num_blocks - 1, GARBAGE_BLOCK, -1))
+        self._refs = np.zeros((self.num_blocks,), np.int32)
+        self._export()
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def capacity(self):
+        """Allocatable blocks (garbage block excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def available(self):
+        return len(self._free)
+
+    @property
+    def in_use(self):
+        return self.capacity - len(self._free)
+
+    def refcount(self, block_id):
+        return int(self._refs[block_id])
+
+    def _export(self):
+        _M_POOL_TOTAL.set(self.capacity)
+        _M_POOL_IN_USE.set(self.in_use)
+
+    # -- alloc / ref / unref ------------------------------------------------
+    def alloc(self, n=1):
+        """Allocate n blocks (each with refcount 1). Raises
+        BlockAllocError when the pool cannot serve all n — all-or-nothing,
+        so a half-allocated request never strands blocks."""
+        _faults.fire("serving.block_alloc")
+        if n > len(self._free):
+            raise BlockAllocError(
+                f"block pool exhausted: want {n}, have {len(self._free)} "
+                f"free of {self.capacity}")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        self._export()
+        return out
+
+    def ref(self, block_id):
+        """Take one more reference on an allocated block (prefix-cache
+        sharing)."""
+        if block_id == GARBAGE_BLOCK or self._refs[block_id] < 1:
+            raise ValueError(f"ref of unallocated block {block_id}")
+        self._refs[block_id] += 1
+
+    def unref(self, block_id):
+        """Drop one reference; the block returns to the free list at
+        zero."""
+        if block_id == GARBAGE_BLOCK:
+            return
+        if self._refs[block_id] < 1:
+            raise ValueError(f"unref of free block {block_id}")
+        self._refs[block_id] -= 1
+        if self._refs[block_id] == 0:
+            self._free.append(int(block_id))
+        self._export()

@@ -32,10 +32,13 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import functional_call, functional_state
 from ..observability import faults as _faults
 from ..profiler import RecordEvent, TracerEventType
+from . import blocks
 from . import kv_cache as kvc
 from . import sampling
+from .prefix_cache import PrefixCache
 
-__all__ = ["EngineConfig", "GenerationEngine", "save_for_generation"]
+__all__ = ["EngineConfig", "GenerationEngine", "PagedEngineConfig",
+           "PagedGenerationEngine", "save_for_generation"]
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024)
 GENCFG_SUFFIX = ".gencfg"
@@ -81,18 +84,23 @@ class GenerationEngine:
                 f"max_position_embeddings={model.cfg.max_position_embeddings}")
         self._model = model
         self._params, self._buffers = functional_state(model)
-        cfg = model.cfg
-        self._cache = kvc.alloc_cache(
-            cfg.num_layers, self.config.slots, self.config.max_len,
-            cfg.num_heads, cfg.hidden_size // cfg.num_heads,
-            self._params["wte.weight"].dtype)
         self._rng = jax.random.key(self.config.seed)
         self._last_tokens = np.zeros((self.config.slots,), np.int32)
         # trace counters: the python bodies below run ONLY when jax traces,
         # so these counts are the number of compilations, not of calls.
         self.trace_counts = {"decode": 0, "prefill": {}}
+        self._alloc_state()                    # cache layout hook
         self._decode = jax.jit(self._decode_fn)
         self._prefill = {}   # bucket -> jitted fn
+
+    def _alloc_state(self):
+        """Allocate the KV memory layout — dense per-slot buffers here;
+        PagedGenerationEngine overrides with the block pool."""
+        cfg = self._model.cfg
+        self._cache = kvc.alloc_cache(
+            cfg.num_layers, self.config.slots, self.config.max_len,
+            cfg.num_heads, cfg.hidden_size // cfg.num_heads,
+            self._params["wte.weight"].dtype)
 
     # -- functional forward -------------------------------------------------
     def _run_model(self, params, layers_k, layers_v, pos, ids):
@@ -243,6 +251,273 @@ class GenerationEngine:
     def max_prompt_len(self):
         """Longest prompt prefill can serve AND still decode one token."""
         return min(self.config.prefill_buckets[-1], self.config.max_len - 1)
+
+    @property
+    def kv_memory_tokens(self):
+        """Token capacity of the KV memory this engine reserves — the
+        budget figure the load harness equalizes across layouts."""
+        return self.config.slots * self.config.max_len
+
+
+class PagedEngineConfig(EngineConfig):
+    """EngineConfig plus the paged-pool knobs.
+
+    block_size: tokens per KV block (the paging granularity; prefix
+    sharing is full-block-granular, so smaller blocks share more but
+    gather more). num_blocks: total pool size INCLUDING the reserved
+    garbage block — `num_blocks * block_size` is the MEMORY the pool
+    reserves (what `kv_memory_tokens` reports and the load harness
+    equalizes against a dense engine's `slots * max_len`), while
+    `(num_blocks - 1) * block_size` is the ALLOCATABLE capacity (block 0
+    is never handed out). Budget comparisons at equal reserved memory
+    are therefore conservative for paged by one block. Defaults to full
+    provisioning plus the garbage block (every slot could hold max_len);
+    the interesting deployments undersubscribe it and let the scheduler
+    preempt."""
+
+    def __init__(self, block_size=16, num_blocks=None,
+                 enable_prefix_cache=True, **kwargs):
+        super().__init__(**kwargs)
+        self.block_size = int(block_size)
+        self.max_blocks_per_slot = -(-self.max_len // self.block_size)
+        self.num_blocks = int(num_blocks) if num_blocks is not None else \
+            1 + self.slots * self.max_blocks_per_slot
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must leave at least one "
+                             "allocatable block beyond the garbage block")
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+
+
+class PagedGenerationEngine(GenerationEngine):
+    """GenerationEngine over the paged block pool (serving/blocks.py).
+
+    Same public contract as the dense engine — prefill/decode/reset_slot,
+    compile-once trace counters — plus block accounting: `block_pool`
+    (refcounted allocator), `prefix_cache` (shared system-prompt blocks),
+    and `ensure_slot_capacity` for the scheduler's preemption loop. The
+    decode executable's avals (pools, tables, pos, tokens) never change,
+    so it still compiles exactly once; prefill compiles per SUFFIX
+    bucket — a prefix-cache hit shortens the suffix, it never adds an
+    executable."""
+
+    def __init__(self, model, config=None, **kwargs):
+        config = config or PagedEngineConfig(**kwargs)
+        super().__init__(model, config)
+
+    def _alloc_state(self):
+        cfg = self._model.cfg
+        c = self.config
+        self._pool = blocks.alloc_pools(
+            cfg.num_layers, c.num_blocks, c.block_size, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads,
+            self._params["wte.weight"].dtype)
+        # pos lives host-side (np): the block math (ensure_slot_capacity,
+        # once per slot per decode step) must not pay a device fetch each
+        # read — ONE transfer per decode/prefill return refreshes it
+        self._pos = np.zeros((c.slots,), np.int32)
+        self._tables = np.zeros((c.slots, c.max_blocks_per_slot), np.int32)
+        self._slot_active = np.zeros((c.slots,), bool)
+        self.block_pool = blocks.BlockPool(c.num_blocks, c.block_size)
+        self.prefix_cache = PrefixCache(self.block_pool, c.block_size) \
+            if c.enable_prefix_cache else None
+        self.last_prefill_stats = {}
+
+    # -- block accounting ----------------------------------------------------
+    def _alloc_blocks(self, n):
+        """Pool alloc with prefix-cache eviction as the pressure valve:
+        only when eviction cannot cover the shortfall does
+        BlockAllocError escape to the scheduler (whose next lever is
+        preemption)."""
+        try:
+            return self.block_pool.alloc(n)
+        except blocks.BlockAllocError:
+            if self.prefix_cache is not None:
+                short = n - self.block_pool.available
+                if self.prefix_cache.evict(short) >= short:
+                    return self.block_pool.alloc(n)
+            raise
+
+    def ensure_slot_capacity(self, slot):
+        """Make sure `slot` can absorb its next decode write (the token
+        K/V lands at position pos[slot]). Allocates at most one block;
+        raises BlockAllocError under pressure — the scheduler preempts
+        and retries."""
+        slot = int(slot)
+        if not self._slot_active[slot]:
+            return
+        lb = int(self._pos[slot]) // self.config.block_size
+        if lb >= self.config.max_blocks_per_slot:
+            return                      # at the max_len clamp boundary
+        if self._tables[slot, lb] == blocks.GARBAGE_BLOCK:
+            self._tables[slot, lb] = self._alloc_blocks(1)[0]
+
+    def ensure_decode_capacity(self):
+        for s in range(self.config.slots):
+            self.ensure_slot_capacity(s)
+
+    @property
+    def kv_memory_tokens(self):
+        """Reserved pool memory in tokens (garbage block included — this
+        is the footprint figure comparable to dense `slots * max_len`)."""
+        return self.config.num_blocks * self.config.block_size
+
+    @property
+    def kv_usable_tokens(self):
+        """Allocatable capacity: the reserve minus the garbage block."""
+        return (self.config.num_blocks - 1) * self.config.block_size
+
+    # -- functional forward (paged) -----------------------------------------
+    def _run_model_paged(self, params, pool_k, pool_v, tables, pos, ids):
+        cache = blocks.PagedDecodeCache(
+            tuple(blocks.PagedLayerKV(Tensor(k), Tensor(v))
+                  for k, v in zip(pool_k, pool_v)),
+            Tensor(tables), Tensor(pos))
+        out, _ = functional_call(
+            self._model, params, self._buffers, args=(Tensor(ids),),
+            kwargs={"cache": cache}, train=False)
+        logits, new_cache = out
+        return (logits._data,
+                [l.k._data for l in new_cache.layers],
+                [l.v._data for l in new_cache.layers])
+
+    # -- decode: ONE executable ---------------------------------------------
+    def _decode_fn(self, params, pk, pv, tables, pos, tokens, key):
+        self.trace_counts["decode"] += 1     # trace-time only
+        logits, nk, nv = self._run_model_paged(params, pk, pv, tables, pos,
+                                               tokens[:, None])
+        nxt = self._select(logits[:, 0, :], key)
+        return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
+
+    # -- prefill: one executable per SUFFIX bucket ---------------------------
+    def _make_prefill(self, bucket):
+        nb = self.config.max_blocks_per_slot
+
+        def prefill_fn(params, pk, pv, tables, pos, slot, ids, length,
+                       start, key):
+            self.trace_counts["prefill"][bucket] = \
+                self.trace_counts["prefill"].get(bucket, 0) + 1
+            slot = slot.astype(jnp.int32)
+            # the slot's table row drives both the scatter of the new
+            # suffix K/V and the gather over the (possibly shared) prefix
+            # blocks; `start` = tokens already resident (prefix hit)
+            row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
+            logits, npk, npv = self._run_model_paged(
+                params, pk, pv, row, start[None], ids[None, :])
+            pos = jax.lax.dynamic_update_slice(
+                pos, (start + length)[None].astype(pos.dtype), (slot,))
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                keepdims=False)
+            first_token = self._select(last[None, :], key)[0]
+            return first_token, npk, npv, pos
+        return jax.jit(prefill_fn)
+
+    # -- public compute API --------------------------------------------------
+    def prefill(self, slot, prompt_ids):
+        """Place `prompt_ids` into `slot`: match the prefix cache, alloc
+        private blocks for the remainder, run the SUFFIX through the
+        bucket executable (writes scatter into this slot's blocks), and
+        return the first generated token. `last_prefill_stats` records
+        the prefix hit for the scheduler's request metrics."""
+        slot = int(slot)
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.config.max_len - prompt.size < 1:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no decode headroom "
+                f"(max_len={self.config.max_len})")
+        if self._slot_active[slot]:
+            self.reset_slot(slot)
+        plen = int(prompt.size)
+        bs = self.config.block_size
+        toks = [int(t) for t in prompt]
+        # record=False: the hit/miss counters tick only when this prefill
+        # STICKS — a BlockAllocError below means the scheduler will retry
+        # and a per-attempt count would inflate the gated hit rate
+        shared_ids, nshared = ([], 0) if self.prefix_cache is None \
+            else self.prefix_cache.match(toks, record=False)
+        n_priv = blocks.blocks_for_tokens(plen, bs) - nshared // bs
+        try:
+            priv = self._alloc_blocks(n_priv) if n_priv else []
+        except blocks.BlockAllocError:
+            for b in shared_ids:          # give back the matched refs
+                self.block_pool.unref(b)
+            raise
+        row = np.zeros((self.config.max_blocks_per_slot,), np.int32)
+        row[:len(shared_ids)] = shared_ids
+        row[len(shared_ids):len(shared_ids) + n_priv] = priv
+        self._tables[slot] = row
+        self._slot_active[slot] = True
+
+        suffix = prompt[nshared:]
+        bucket = self.bucket_for(suffix.size)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:suffix.size] = suffix
+        if bucket not in self._prefill:
+            self._prefill[bucket] = self._make_prefill(bucket)
+        with RecordEvent("serving::prefill", TracerEventType.UserDefined,
+                         {"bucket": bucket, "length": plen,
+                          "slot": slot, "prefix_hit_tokens": nshared,
+                          "paged": True}):
+            first, pk, pv, pos = self._prefill[bucket](
+                self._params, [l.k for l in self._pool],
+                [l.v for l in self._pool], jnp.asarray(self._tables),
+                jnp.asarray(self._pos), jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded), jnp.asarray(suffix.size, jnp.int32),
+                jnp.asarray(nshared, jnp.int32), self._next_key())
+        self._pool = tuple(blocks.PagedLayerKV(k, v)
+                           for k, v in zip(pk, pv))
+        self._pos = np.array(pos, np.int32)   # owned, writable copy
+        if self.prefix_cache is not None:
+            # the prompt's fully-written blocks become shareable; the
+            # matched prefix chain is already registered (touch only)
+            self.prefix_cache.insert(toks, row, (plen // bs) * bs)
+            self.prefix_cache.record_lookup(nshared > 0)
+        self.last_prefill_stats = {
+            "prefix_hit_tokens": nshared, "blocks_allocated": n_priv,
+            "suffix_bucket": bucket}
+        first = int(first)
+        self._last_tokens[slot] = np.int32(first)
+        return first
+
+    def decode(self):
+        """Advance every slot one token; returns np.int32 [slots]. Active
+        slots are guaranteed a writable block first (BlockAllocError
+        under pressure — callers driving the engine directly see it; the
+        scheduler pre-grows per slot so it can preempt instead)."""
+        _faults.fire("serving.decode_step")
+        self.ensure_decode_capacity()
+        with RecordEvent("serving::decode_step",
+                         TracerEventType.UserDefined,
+                         {"slots": self.config.slots, "paged": True}):
+            tokens = self._last_tokens
+            nxt, pk, pv, pos = self._decode(
+                self._params, [l.k for l in self._pool],
+                [l.v for l in self._pool], jnp.asarray(self._tables),
+                jnp.asarray(self._pos), jnp.asarray(tokens),
+                self._next_key())
+        self._pool = tuple(blocks.PagedLayerKV(k, v)
+                           for k, v in zip(pk, pv))
+        self._pos = np.array(pos, np.int32)   # owned, writable copy
+        out = np.asarray(nxt, np.int32)
+        self._last_tokens = out.copy()
+        return out
+
+    def reset_slot(self, slot):
+        """Free the slot: every table entry drops the request's
+        reference (blocks return to the pool unless the prefix cache
+        still holds them), pos=0 hides whatever remains."""
+        slot = int(slot)
+        for b in self._tables[slot]:
+            if b != blocks.GARBAGE_BLOCK:
+                self.block_pool.unref(int(b))
+        self._tables[slot] = blocks.GARBAGE_BLOCK
+        self._slot_active[slot] = False
+        self._pos[slot] = 0
+        self._last_tokens[slot] = np.int32(0)
+
+    def slot_positions(self):
+        return self._pos.copy()
 
 
 def save_for_generation(model, path, input_spec=None):

@@ -60,6 +60,14 @@ def write(buf, new, pos):
     return jax.vmap(one)(buf, new, pos.astype(jnp.int32))
 
 
+# Finite large-negative mask fill (same constant as the Pallas flash
+# kernel). jnp.finfo(dtype).min is NOT safe here: softmax subtracts the
+# row max, and finfo.min minus any positive max overflows to -inf — and
+# an all-masked row of -inf turns into exp(nan). A finite constant keeps
+# every intermediate finite.
+_MASK_VALUE = -1e30
+
+
 def attend(q, k_buf, v_buf, pos, scale=None):
     """Masked attention of `q` [S, T, h, d] against the full preallocated
     buffers [S, L, h, d], where the T query tokens sit at positions
@@ -69,7 +77,16 @@ def attend(q, k_buf, v_buf, pos, scale=None):
     A dense softmax over the padded length L: at T=1 this is a matvec (the
     decode step is bandwidth-bound on the cache read either way), and for
     prefill the bucket ladder bounds L. No flash kernel needed — there is
-    no S^2 materialization risk at decode shapes."""
+    no S^2 materialization risk at decode shapes.
+
+    Padded-region hygiene: positions >= pos hold whatever was last
+    written there (stale retired-request K/V, scatter garbage in the
+    paged pool's garbage block — possibly inf/NaN). Masked scores are
+    filled with a finite large-negative constant, probabilities are
+    forced to EXACT zero outside the visible region (a softmax tail of
+    exp(-large) times a NaN value row would otherwise be 0*NaN = NaN),
+    and fully-masked rows emit exact zeros via a `where` on the output.
+    """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     L = k_buf.shape[1]
@@ -78,10 +95,21 @@ def attend(q, k_buf, v_buf, pos, scale=None):
     limit = pos.astype(jnp.int32)[:, None] + jnp.arange(T, dtype=jnp.int32)
     visible = jnp.arange(L, dtype=jnp.int32)[None, None, :] <= limit[:, :, None]
     scores = jnp.einsum("sthd,slhd->shtl", q, k_buf) * scale
-    scores = jnp.where(visible[:, None, :, :], scores,
-                       jnp.finfo(scores.dtype).min)
+    scores = jnp.where(visible[:, None, :, :], scores, _MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("shtl,slhd->sthd", probs, v_buf)
+    # exact zeros off-mask (and makes paged-gather attention bit-identical
+    # to the dense path — extra gathered-but-masked positions contribute
+    # exactly nothing)
+    probs = jnp.where(visible[:, None, :, :], probs, 0.0)
+    # a zero probability is not enough against inf/NaN garbage in V
+    # (0*inf == NaN): zero the value rows no query of this call can see.
+    # Positions <= pos+T-1 are real writes (history or this call's own),
+    # so this touches only never-visible garbage.
+    ever_visible = jnp.arange(L, dtype=jnp.int32)[None, :] <= limit[:, -1:]
+    v_buf = jnp.where(ever_visible[:, :, None, None], v_buf, 0.0)
+    out = jnp.einsum("shtl,slhd->sthd", probs, v_buf)
+    any_visible = visible.any(axis=-1)                     # [S, T]
+    return jnp.where(any_visible[:, :, None, None], out, 0.0)
 
 
 def advance(pos, n):

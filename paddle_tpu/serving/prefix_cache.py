@@ -1,0 +1,184 @@
+"""Shared prefix cache: hash-addressed KV blocks, refcounted, COW-safe.
+
+Millions of users means millions of requests opening with the same system
+prompt; prefilling it per request burns both compute (the prefill
+executable re-runs the same tokens) and memory (the pool stores the same
+K/V N times). This cache makes full blocks of prompt K/V content-
+addressable, vLLM-style: block k of a prompt is keyed by the hash of the
+ENTIRE token prefix `prompt[0 : (k+1)*block_size]` — chaining the key
+over everything before the block, so two prompts share block k iff they
+agree on every token up to its end.
+
+Sharing protocol (the copy-on-write invariant):
+
+  - `match(prompt)` walks the chain and returns the longest run of cached
+    blocks, taking one pool reference per block ON BEHALF of the caller —
+    the request's table row now co-owns them. Matching is capped at
+    `len(prompt) - 1` tokens so at least one suffix token always runs
+    through the model (the logits that produce the first generated token).
+  - shared blocks are never written: sharing is full-block-granular, so a
+    request's writable region starts exactly at the first private block —
+    the "copy" in copy-on-write is avoided by alignment rather than
+    performed.
+  - `insert(prompt, table_row, upto_tokens)` registers the request's own
+    fully-written blocks after its prefill, taking one cache-owned
+    reference each, so the blocks outlive the request.
+  - `evict(n)` drops least-recently-used entries whose blocks have no
+    other owner (refcount == 1, the cache's own), returning blocks to
+    the pool — called by the engine when an allocation comes up short,
+    before the scheduler resorts to preemption.
+
+Hit/miss counters (per prefill lookup) and the resident-block gauge feed
+the unified metrics registry; `tools/metrics_report.py --compare` treats
+a prefix-hit-rate drop as a failure-class regression.
+"""
+import hashlib
+
+from ..observability import metrics as _metrics
+from .blocks import GARBAGE_BLOCK
+
+__all__ = ["PrefixCache", "prefix_key"]
+
+_M_HITS = _metrics.counter(
+    "serving_prefix_cache_hits_total",
+    "Prefill lookups that reused at least one cached prefix block")
+_M_MISSES = _metrics.counter(
+    "serving_prefix_cache_misses_total",
+    "Prefill lookups that reused no cached prefix block")
+_M_BLOCKS = _metrics.gauge(
+    "serving_prefix_cache_blocks", "KV blocks resident in the prefix cache")
+_M_EVICTED = _metrics.counter(
+    "serving_prefix_cache_evicted_total",
+    "Prefix blocks evicted back to the pool under allocation pressure")
+
+
+def prefix_key(tokens):
+    """Stable content hash of a token prefix (the chain key)."""
+    h = hashlib.sha1()
+    for t in tokens:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.hexdigest()
+
+
+class PrefixCache:
+    def __init__(self, pool, block_size):
+        self.pool = pool
+        self.block_size = int(block_size)
+        self._entries = {}        # key -> block id
+        self._lru = {}            # key -> last-use sequence number
+        self._parent = {}         # key -> chain-parent key (None at k=0)
+        self._children = {}       # key -> cached direct children count
+        self._seq = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def evictable(self):
+        """Blocks reclaimable on demand (refcount == 1: only the cache
+        holds them). Capacity probes — e.g. the scheduler's
+        shed_pool_free watermark — must treat these as free, else a warm
+        cache reads as a full pool and sheds traffic an eviction would
+        trivially serve."""
+        return sum(1 for blk in self._entries.values()
+                   if self.pool.refcount(blk) == 1)
+
+    def _touch(self, key):
+        self._seq += 1
+        self._lru[key] = self._seq
+
+    # -- lookup --------------------------------------------------------------
+    def match(self, prompt, record=True):
+        """Longest cached block chain covering a strict prefix of
+        `prompt`. Returns (block_ids, n_tokens) with one pool reference
+        taken per returned block (owned by the caller's table row).
+        n_tokens is always a multiple of block_size and <= len(prompt)-1.
+
+        record=False skips the hit/miss counters — callers whose
+        placement can fail-and-retry (BlockAllocError -> preempt ->
+        re-prefill) count via `record_lookup` once the placement
+        actually sticks, so pressure retries cannot inflate the
+        CI-gated hit rate."""
+        bs = self.block_size
+        usable = (len(prompt) - 1) // bs      # full blocks, 1 token spared
+        ids = []
+        for k in range(usable):
+            key = prefix_key(prompt[:(k + 1) * bs])
+            blk = self._entries.get(key)
+            if blk is None:
+                break
+            ids.append(blk)
+            self._touch(key)
+        for b in ids:
+            self.pool.ref(b)
+        if record:
+            self.record_lookup(bool(ids))
+        return ids, len(ids) * bs
+
+    def record_lookup(self, hit):
+        """Count one prefill lookup toward the hit-rate metrics."""
+        (_M_HITS if hit else _M_MISSES).inc()
+
+    # -- registration --------------------------------------------------------
+    def insert(self, prompt, table_row, upto_tokens):
+        """Register the fully-written blocks of `prompt` (logical blocks
+        whose every position < upto_tokens) from the request's table row.
+        Already-cached chains keep their existing block (the duplicate
+        stays request-private); newly cached blocks gain one cache-owned
+        reference."""
+        bs = self.block_size
+        prev_key = None
+        for k in range(int(upto_tokens) // bs):
+            blk = int(table_row[k])
+            if blk == GARBAGE_BLOCK:
+                continue
+            key = prefix_key(prompt[:(k + 1) * bs])
+            if key in self._entries:
+                self._touch(key)
+                prev_key = key
+                continue
+            self.pool.ref(blk)
+            self._entries[key] = blk
+            self._parent[key] = prev_key
+            if prev_key is not None:
+                self._children[prev_key] = \
+                    self._children.get(prev_key, 0) + 1
+            self._touch(key)
+            prev_key = key
+        _M_BLOCKS.set(len(self._entries))
+
+    # -- eviction ------------------------------------------------------------
+    def evict(self, n_blocks):
+        """Free up to n_blocks LRU entries nobody else references
+        (refcount == 1: only the cache's own). Eviction is LEAF-first —
+        an entry with a cached child is skipped, because `match` walks
+        chains from block 0 and an evicted head would orphan its tail
+        (still resident, never matchable again). Returns how many blocks
+        went back to the pool."""
+        if n_blocks <= 0:
+            return 0
+        freed = 0
+        progress = True
+        while freed < n_blocks and progress:
+            progress = False
+            for key in sorted(self._lru, key=self._lru.get):
+                if freed >= n_blocks:
+                    break
+                blk = self._entries.get(key)
+                if blk is None or self.pool.refcount(blk) != 1 \
+                        or self._children.get(key, 0) > 0:
+                    continue
+                self.pool.unref(blk)
+                parent = self._parent.pop(key, None)
+                if parent is not None and parent in self._children:
+                    self._children[parent] -= 1
+                    if self._children[parent] <= 0:
+                        del self._children[parent]
+                self._children.pop(key, None)
+                del self._entries[key]
+                del self._lru[key]
+                freed += 1
+                progress = True     # a freed leaf may expose its parent
+        if freed:
+            _M_EVICTED.inc(freed)
+            _M_BLOCKS.set(len(self._entries))
+        return freed

@@ -1,4 +1,4 @@
-"""Iteration-level (continuous) batching scheduler — the robustness tier.
+"""Iteration-level (continuous) batching scheduler — the SLO tier.
 
 Orca's [OSDI '22] observation: batching at REQUEST granularity strands
 decode slots behind the longest member of the batch. Scheduling at
@@ -8,11 +8,26 @@ request while the other slots keep decoding. This module implements
 that loop over a GenerationEngine:
 
   submit() -> bounded admission queue (QueueFullError past the cap,
-              deadline expiry while queued -> TIMEOUT)
+              LoadShedError past the shed watermark for sheddable
+              priority classes, deadline expiry while queued -> TIMEOUT)
   step()   -> retire finished slots (eos / max_new_tokens / deadline),
-              refill free slots from the queue (prefill = TTFT),
+              refill free slots from the queue by (priority, arrival)
+              (prefill = TTFT), grow paged slots' block tables —
+              preempting victims under allocation pressure — then
               advance every occupied slot one token (decode)
   drain()  -> stop admitting, run until in-flight work finishes
+
+SLO classes (ISSUE 6): every request carries a priority class
+(interactive=0 < standard=1 < batch=2). The queue serves the best
+(priority, arrival) first; admission load-sheds sheddable classes past a
+queue watermark (or when the block pool runs dry) instead of letting
+them rot to a deadline timeout; and when a paged engine cannot allocate
+a block, the scheduler PREEMPTS a victim — the worst (priority, deadline
+slack) occupant — frees its blocks back to the pool, and requeues it in
+recompute style: the victim's prompt+generated-so-far become its restart
+prompt, so its delivered token stream continues seamlessly (and, under
+greedy decoding, bit-identically). `serving_preempted_total` and
+`serving_shed_total` count the events.
 
 Graceful degradation (ISSUE 5): a decode-step exception fails ONLY the
 requests that were in flight on the affected slots — each gets terminal
@@ -27,10 +42,11 @@ and failed requests land in `serving_requests_total{status="error"}`.
 
 Observability: every step appends a JSONL record (queue depth, active
 slots, tokens emitted) and every request completion appends a summary
-(TTFT, decode rate, status); the same figures feed profiler spans and
-the `native` stat counters, and `tools/serve_report.py` renders the
-file. The step loop is synchronous by design — the engine's decode is
-one executable replay, so a thread adds latency, not throughput.
+(TTFT, decode rate, status, priority, preemption count, prefix-cache
+hit); the same figures feed profiler spans and the `native` stat
+counters, and `tools/serve_report.py` renders the file. The step loop is
+synchronous by design — the engine's decode is one executable replay, so
+a thread adds latency, not throughput.
 """
 import collections
 import itertools
@@ -41,9 +57,10 @@ import time
 from .. import native
 from ..observability import metrics as _metrics
 from ..profiler import RecordEvent, TracerEventType
+from .blocks import BlockAllocError
 
 __all__ = ["ServingConfig", "Scheduler", "Request", "RequestHandle",
-           "QueueFullError"]
+           "QueueFullError", "LoadShedError", "PRIORITIES"]
 
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
@@ -51,6 +68,12 @@ DONE = "DONE"
 TIMEOUT = "TIMEOUT"
 REJECTED = "REJECTED"
 ERROR = "ERROR"
+SHED = "SHED"
+
+# SLO priority classes: LOWER is better. Admission shedding applies to
+# classes >= ServingConfig.shed_priority; preemption victims are picked
+# worst-class-first, most-deadline-slack-first within a class.
+PRIORITIES = {"interactive": 0, "standard": 1, "batch": 2}
 
 # DEPRECATED counter surface: the per-instance `Scheduler.counts` dict and
 # the free-standing `native.stat_*` names below are kept for callers that
@@ -59,7 +82,8 @@ ERROR = "ERROR"
 # here, exported via registry().snapshot()/dump_prometheus() and rendered
 # by tools/metrics_report.py.
 _COUNTERS = ("serving.admitted", "serving.completed", "serving.rejected",
-             "serving.timeout", "serving.tokens", "serving.error")
+             "serving.timeout", "serving.tokens", "serving.error",
+             "serving.shed", "serving.preempted")
 
 _M_REQUESTS = _metrics.counter(
     "serving_requests_total",
@@ -80,37 +104,72 @@ _M_DECODE_FAILURES = _metrics.counter(
     "serving_decode_failures_total",
     "Engine decode/prefill calls that raised; each fails only the "
     "affected requests")
+_M_SHED = _metrics.counter(
+    "serving_shed_total",
+    "Requests load-shed at admission (queue/pool watermark)")
+_M_PREEMPTED = _metrics.counter(
+    "serving_preempted_total",
+    "Preemptions under allocation pressure (victim requeued or errored)")
 
 
 class QueueFullError(RuntimeError):
     """Admission queue at capacity — backpressure, caller should retry."""
 
 
+class LoadShedError(QueueFullError):
+    """Request shed at admission by the SLO watermark — the system chose
+    to fail this (sheddable-class) request fast rather than queue it past
+    its useful life. Terminal status SHED."""
+
+
 class ServingConfig:
     def __init__(self, max_queue=64, default_max_new_tokens=32,
-                 default_timeout_s=None, metrics_path=None):
+                 default_timeout_s=None, metrics_path=None,
+                 shed_watermark=None, shed_priority=2,
+                 shed_pool_free=None):
         self.max_queue = int(max_queue)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_timeout_s = default_timeout_s
         self.metrics_path = metrics_path
+        # load shedding: None disables. shed_watermark is a queue-depth
+        # threshold; shed_pool_free a block-pool free-fraction floor.
+        # Classes >= shed_priority are sheddable.
+        self.shed_watermark = None if shed_watermark is None \
+            else int(shed_watermark)
+        self.shed_priority = int(shed_priority)
+        self.shed_pool_free = None if shed_pool_free is None \
+            else float(shed_pool_free)
 
 
 class Request:
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens, deadline, submitted_at):
+    def __init__(self, prompt, max_new_tokens, deadline, submitted_at,
+                 priority=1):
         self.id = next(Request._ids)
-        self.prompt = list(prompt)
+        self.prompt = list(prompt)        # ORIGINAL prompt, never mutated
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline          # absolute clock value or None
         self.submitted_at = submitted_at
+        self.priority = int(priority)
         self.status = QUEUED
         self.tokens = []                  # generated tokens, stream order
         self.error = None                 # cause string for status ERROR
         self.slot = None
+        self.preempted = 0                # times evicted and requeued
+        self.prefix_hit = False           # prefill reused cached blocks
+        self._exec_prompt = None          # recompute prompt after preempt
         self.first_token_at = None        # TTFT timestamp
         self.finished_at = None
         self._done = threading.Event()
+
+    @property
+    def exec_prompt(self):
+        """What prefill actually runs: the original prompt, or — after a
+        preemption — prompt + everything already generated, so the
+        delivered stream continues where it left off."""
+        return self._exec_prompt if self._exec_prompt is not None \
+            else self.prompt
 
 
 class RequestHandle:
@@ -139,8 +198,22 @@ class RequestHandle:
         """The decode failure that killed this request (status ERROR)."""
         return self._req.error
 
+    @property
+    def priority(self):
+        return self._req.priority
+
+    @property
+    def preempted(self):
+        """How many times the request was evicted and requeued."""
+        return self._req.preempted
+
+    @property
+    def prefix_hit(self):
+        """Whether prefill reused shared prefix-cache blocks."""
+        return self._req.prefix_hit
+
     def done(self):
-        return self._req.status in (DONE, TIMEOUT, REJECTED, ERROR)
+        return self._req.status in (DONE, TIMEOUT, REJECTED, ERROR, SHED)
 
     def result(self, timeout=None):
         """Block until terminal; returns the token list. TIMEOUT and
@@ -178,17 +251,23 @@ class Scheduler:
                            if self.config.metrics_path else None)
 
     # -- admission -----------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=None, timeout_s=None):
+    def submit(self, prompt, max_new_tokens=None, timeout_s=None,
+               priority="standard"):
         prompt = [int(t) for t in prompt]
         now = self._clock()
         max_new = self.config.default_max_new_tokens \
             if max_new_tokens is None else max_new_tokens
         if max_new < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        prio = PRIORITIES.get(priority, priority)
+        if not isinstance(prio, int):
+            raise ValueError(f"unknown priority {priority!r}; want one of "
+                             f"{sorted(PRIORITIES)} or an int class")
         timeout = timeout_s if timeout_s is not None \
             else self.config.default_timeout_s
         req = Request(prompt, max_new,
-                      now + timeout if timeout is not None else None, now)
+                      now + timeout if timeout is not None else None, now,
+                      priority=prio)
         handle = RequestHandle(req, self._clock)
         if self._draining:
             self._finish(req, REJECTED, "serving.rejected")
@@ -211,9 +290,41 @@ class Scheduler:
                 f"exceeds the engine limits (max prompt "
                 f"{self.engine.max_prompt_len}, cache max_len "
                 f"{self.engine.config.max_len})")
+        shed_why = self._should_shed(prio)
+        if shed_why:
+            _M_SHED.inc()
+            self._finish(req, SHED, "serving.shed")
+            raise LoadShedError(
+                f"load shed (priority class {prio}): {shed_why}")
         self._queue.append(req)
         self._count("serving.admitted")
         return handle
+
+    def _should_shed(self, prio):
+        """SLO admission control: sheddable classes are failed FAST past
+        the watermark instead of queueing to a certain deadline death.
+        Returns the reason string, or None to admit."""
+        c = self.config
+        if prio < c.shed_priority:
+            return None
+        if c.shed_watermark is not None and \
+                len(self._queue) >= c.shed_watermark:
+            return (f"queue depth {len(self._queue)} >= watermark "
+                    f"{c.shed_watermark}")
+        pool = getattr(self.engine, "block_pool", None)
+        if c.shed_pool_free is not None and pool is not None and \
+                pool.capacity > 0:
+            # blocks held only by the prefix cache are evictable on
+            # demand — count them as free, or a warm cache would read as
+            # a full pool and shed traffic forever on an idle system
+            cache = getattr(self.engine, "prefix_cache", None)
+            free = pool.available + (cache.evictable()
+                                     if cache is not None else 0)
+            if free / pool.capacity < c.shed_pool_free:
+                return (f"block pool free fraction "
+                        f"{free / pool.capacity:.3f} < "
+                        f"{c.shed_pool_free}")
+        return None
 
     # -- the iteration loop --------------------------------------------------
     def step(self):
@@ -222,6 +333,7 @@ class Scheduler:
         self._expire_queued(now)
         self._retire(now)
         self._refill(now)
+        self._grow_paged_slots(now)
         active = [r for r in self._slots if r is not None]
         if active:
             t0 = self._clock()
@@ -243,10 +355,14 @@ class Scheduler:
                 self._quarantined.clear()
         self._steps += 1
         _M_QUEUE_DEPTH.set(len(self._queue))
-        _M_OCCUPANCY.set(sum(1 for s in self._slots if s is not None)
-                         / max(self.engine.slots, 1))
+        _M_OCCUPANCY.set(self.active_slots() / max(self.engine.slots, 1))
         self._write_step_record(now, len(active))
         return bool(self._queue or any(s is not None for s in self._slots))
+
+    def active_slots(self):
+        """Occupied decode slots right now (the concurrency figure the
+        load harness tracks)."""
+        return sum(1 for s in self._slots if s is not None)
 
     def drain(self, max_steps=100000):
         """Graceful drain: no new admissions, finish what's in flight."""
@@ -321,6 +437,98 @@ class Scheduler:
             self._fail_engine_request(slot, req, cause)
         self._quarantine_all_but_probe()
 
+    # -- SLO machinery: preemption ------------------------------------------
+    def _pick_victim(self, worse_than=None, exclude=()):
+        """The preemption victim: worst priority class first, most
+        deadline slack within a class (no deadline == infinite slack —
+        batch work yields before anything on a clock). `worse_than`
+        restricts to classes strictly below the given priority."""
+        best, best_key = None, None
+        now = self._clock()
+        for slot, req in enumerate(self._slots):
+            if req is None or slot in exclude:
+                continue
+            if worse_than is not None and req.priority <= worse_than:
+                continue
+            slack = float("inf") if req.deadline is None \
+                else req.deadline - now
+            key = (req.priority, slack)
+            if best is None or key > best_key:
+                best, best_key = slot, key
+        return best
+
+    def _preempt(self, slot, reason):
+        """Evict `slot`'s request, freeing its blocks back to the pool
+        (engine.reset_slot drops every table reference), and requeue it
+        recompute-style: prompt+generated-so-far becomes the restart
+        prompt, keeping the delivered stream intact. A victim whose
+        restart no longer fits the engine is failed loudly instead of
+        silently truncated."""
+        req = self._slots[slot]
+        try:
+            self.engine.reset_slot(slot)
+        except Exception:                                # noqa: BLE001
+            pass
+        self._slots[slot] = None
+        req.slot = None
+        req.preempted += 1
+        self._count("serving.preempted")
+        with RecordEvent("serving::preempt", TracerEventType.UserDefined,
+                         {"slot": slot, "request": req.id,
+                          "priority": req.priority,
+                          "tokens": len(req.tokens),
+                          "reason": reason}):
+            pass
+        remaining = req.max_new_tokens - len(req.tokens)
+        if remaining < 1:                  # raced its own completion
+            self._finish(req, DONE, "serving.completed")
+            return
+        resume = req.prompt + req.tokens
+        if len(resume) > self.engine.max_prompt_len or \
+                len(resume) + remaining > self.engine.config.max_len:
+            req.error = (f"preempted ({reason}) and the restart prompt "
+                         f"({len(resume)} tokens) exceeds the engine "
+                         f"limits")
+            self._finish(req, ERROR, "serving.error")
+            return
+        req._exec_prompt = resume
+        req.status = QUEUED
+        self._queue.append(req)            # keeps its original arrival
+                                           # order within its class
+
+    def _grow_paged_slots(self, now):
+        """Paged engines allocate decode blocks lazily: before the step,
+        every occupied slot must own the block its next token lands in.
+        Allocation pressure is resolved by preemption over the occupants
+        of the growing request's class AND WORSE — including the growing
+        slot itself, so when everything better is running, the request
+        with the worst (priority, deadline slack) yields. A
+        strictly-better-class occupant is never evicted to feed a worse
+        one; decode() below never sees BlockAllocError."""
+        ensure = getattr(self.engine, "ensure_slot_capacity", None)
+        if ensure is None:
+            return
+        for slot in range(len(self._slots)):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            for _ in range(len(self._slots) + 1):
+                if self._slots[slot] is None:
+                    break                   # preempted itself below
+                try:
+                    ensure(slot)
+                    break
+                except BlockAllocError:
+                    # worse_than=priority-1 keeps classes >= the growing
+                    # request's own; the growing slot is a candidate too
+                    victim = self._pick_victim(
+                        worse_than=req.priority - 1)
+                    if victim is None:      # unreachable: slot qualifies
+                        victim = slot
+                    self._preempt(victim, "allocation pressure")
+                    if victim == slot:
+                        break
+
     # -- phases ---------------------------------------------------------------
     def _expire_queued(self, now):
         kept = collections.deque()
@@ -353,37 +561,78 @@ class Scheduler:
                              "serving.timeout" if timed_out
                              else "serving.completed")
 
+    def _pop_next(self, now):
+        """Best queued request by (priority class, arrival order),
+        finishing expired ones along the way."""
+        while self._queue:
+            best = min(self._queue, key=lambda r: (r.priority, r.id))
+            self._queue.remove(best)
+            if best.deadline is not None and now > best.deadline:
+                self._finish(best, TIMEOUT, "serving.timeout")
+                continue
+            return best
+        return None
+
     def _refill(self, now):
-        eos = self.engine.config.eos_token_id
-        for slot, occupant in enumerate(self._slots):
-            if occupant is not None or slot in self._quarantined:
+        for slot in range(len(self._slots)):
+            if self._slots[slot] is not None or slot in self._quarantined:
                 continue
             # a request that completes AT prefill (max_new_tokens=1, or an
             # instant eos) retires here, before decode could overrun it —
             # and frees the slot for the next queued request immediately
-            while self._queue and self._slots[slot] is None \
+            while self._slots[slot] is None \
                     and slot not in self._quarantined:
-                req = self._queue.popleft()
-                if req.deadline is not None and now > req.deadline:
-                    self._finish(req, TIMEOUT, "serving.timeout")
-                    continue
-                try:
-                    first = self.engine.prefill(slot, req.prompt)
-                except Exception as e:                   # noqa: BLE001
-                    self._on_prefill_failure(slot, req, e)
+                req = self._pop_next(now)
+                if req is None:
+                    return
+                outcome = self._try_place(slot, req)
+                if outcome == "stop":
+                    return
+                if outcome == "failed":
                     break
-                req.slot = slot
-                req.status = RUNNING
-                req.first_token_at = self._clock()
-                req.tokens.append(first)
-                self._decode_tokens += 1
-                self._count("serving.tokens")
-                if req.max_new_tokens <= 1 or \
-                        (eos is not None and first == eos):
-                    self.engine.reset_slot(slot)
-                    self._finish(req, DONE, "serving.completed")
-                else:
-                    self._slots[slot] = req
+
+    def _try_place(self, slot, req):
+        """Prefill `req` into `slot`. Allocation pressure preempts a
+        strictly-lower-priority victim and retries; with no victim the
+        request is requeued untouched and refill stops for this step
+        ("stop"). Other prefill exceptions engage the quarantine protocol
+        ("failed"). Returns "placed" on success."""
+        for _ in range(len(self._slots) + 1):
+            try:
+                first = self.engine.prefill(slot, req.exec_prompt)
+            except BlockAllocError:
+                victim = self._pick_victim(worse_than=req.priority,
+                                           exclude=(slot,))
+                if victim is None:
+                    self._queue.append(req)     # retry next step
+                    return "stop"
+                self._preempt(victim, "admission pressure")
+                continue
+            except Exception as e:               # noqa: BLE001
+                self._on_prefill_failure(slot, req, e)
+                return "failed"
+            break
+        else:
+            self._queue.append(req)
+            return "stop"
+        req.slot = slot
+        req.status = RUNNING
+        if req.first_token_at is None:
+            req.first_token_at = self._clock()
+        stats = getattr(self.engine, "last_prefill_stats", None) or {}
+        if stats.get("prefix_hit_tokens", 0) > 0:
+            req.prefix_hit = True
+        req.tokens.append(first)
+        self._decode_tokens += 1
+        self._count("serving.tokens")
+        eos = self.engine.config.eos_token_id
+        if len(req.tokens) >= req.max_new_tokens or \
+                (eos is not None and first == eos):
+            self.engine.reset_slot(slot)
+            self._finish(req, DONE, "serving.completed")
+        else:
+            self._slots[slot] = req
+        return "placed"
 
     def _finish(self, req, status, counter):
         req.status = status
@@ -391,7 +640,7 @@ class Scheduler:
         self._count(counter)
         if req.first_token_at is not None:
             _M_TTFT.observe(req.first_token_at - req.submitted_at)
-        if status in (DONE, TIMEOUT, ERROR):
+        if status in (DONE, TIMEOUT, ERROR, SHED):
             self._completed.append(req)
             self._write_request_record(req)
         req._done.set()
@@ -401,6 +650,8 @@ class Scheduler:
         # per-instance dict + native stat mirror for existing readers
         if name == "serving.tokens":
             _M_TOKENS.inc()
+        elif name == "serving.preempted":
+            _M_PREEMPTED.inc()
         else:
             _M_REQUESTS.labels(status=name.split(".", 1)[1]).inc()
         self.counts[name] += 1
@@ -408,10 +659,10 @@ class Scheduler:
 
     # -- metrics ---------------------------------------------------------------
     def metrics(self):
-        occupied = sum(1 for s in self._slots if s is not None)
+        occupied = self.active_slots()
         ttfts = [r.first_token_at - r.submitted_at for r in self._completed
                  if r.first_token_at is not None]
-        return {
+        out = {
             "steps": self._steps,
             "queue_depth": len(self._queue),
             "slot_occupancy": occupied / max(self.engine.slots, 1),
@@ -422,6 +673,13 @@ class Scheduler:
             "ttft_s_mean": sum(ttfts) / len(ttfts) if ttfts else None,
             "requests": dict(self.counts),
         }
+        pool = getattr(self.engine, "block_pool", None)
+        if pool is not None:
+            out["blocks_in_use"] = pool.in_use
+            out["blocks_total"] = pool.capacity
+            pc = getattr(self.engine, "prefix_cache", None)
+            out["prefix_cache_blocks"] = len(pc) if pc is not None else 0
+        return out
 
     def _write_step_record(self, now, active):
         if not self._metrics_f:
@@ -440,6 +698,8 @@ class Scheduler:
         self._metrics_f.write(json.dumps({
             "kind": "request", "request_id": req.id, "status": req.status,
             "prompt_len": len(req.prompt), "tokens": len(req.tokens),
+            "priority": req.priority, "preempted": req.preempted,
+            "prefix_hit": req.prefix_hit,
             "ttft_s": (req.first_token_at - req.submitted_at
                        if req.first_token_at else None),
             "decode_s": decode_s}) + "\n")

@@ -48,17 +48,28 @@ class GPTAttention(Layer):
         self.out_proj.weight.split_axis = 0  # row-parallel over mp
         self.dropout = cfg.attention_dropout
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         """Train/prefill-uncached path when cache is None. With a
         `serving.kv_cache.LayerKV` cache (+ per-slot `pos`), the projected
         k/v are written into the preallocated buffers at pos via
         dynamic_update_slice and attention runs over the full static
         buffer — the single-token decode step keeps one set of avals and
-        compiles once (docs/serving.md)."""
+        compiles once (docs/serving.md). With `tables` given, the cache
+        is a `serving.blocks.PagedLayerKV` pool instead: writes scatter
+        into the slot's physical blocks and attention gathers them back
+        through the block table — same avals forever, same compile-once
+        property."""
         B, S, H = x.shape
         qkv = self.qkv(x)  # B,S,3H
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # B,S,h,d
+        if cache is not None and tables is not None:
+            from ...serving import blocks as _blk
+            k_pool = apply_op(_blk.write, cache.k, k, tables, pos)
+            v_pool = apply_op(_blk.write, cache.v, v, tables, pos)
+            out = apply_op(_blk.attend, q, k_pool, v_pool, tables, pos)
+            out = out.reshape([B, S, H])
+            return self.out_proj(out), _blk.PagedLayerKV(k_pool, v_pool)
         if cache is not None:
             from ...serving import kv_cache as _kvc
             k_buf = apply_op(_kvc.write, cache.k, k, pos)
@@ -97,9 +108,10 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         if cache is not None:
-            attn_out, new_cache = self.attn(self.ln1(x), cache=cache, pos=pos)
+            attn_out, new_cache = self.attn(self.ln1(x), cache=cache,
+                                            pos=pos, tables=tables)
             x = x + self.dropout(attn_out)
             x = x + self.dropout(self.mlp(self.ln2(x)))
             return x, new_cache
@@ -148,6 +160,10 @@ class GPT(Layer):
         from ...tensor.creation import arange
         if cache is not None:
             from ...serving import kv_cache as _kvc
+            # a paged cache (serving.blocks.PagedDecodeCache) carries its
+            # block tables alongside the pools; the dense DecodeCache has
+            # no `tables` field — same forward, two memory layouts
+            tables = getattr(cache, "tables", None)
             pos = cache.pos
             positions = apply_op(
                 lambda p, ids: p.astype(jnp.int32)[:, None]
@@ -156,9 +172,13 @@ class GPT(Layer):
             x = self.drop(self.wte(input_ids) + self.wpe(positions))
             new_layers = []
             for blk, lkv in zip(self.blocks, cache.layers):
-                x, new_lkv = blk(x, cache=lkv, pos=pos)
+                x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables)
                 new_layers.append(new_lkv)
             logits = self._head(self.ln_f(x))
+            if tables is not None:
+                from ...serving import blocks as _blk
+                return logits, _blk.PagedDecodeCache(tuple(new_layers),
+                                                     tables, pos + S)
             return logits, _kvc.DecodeCache(tuple(new_layers), pos + S)
         pos = arange(0, S, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)

@@ -37,18 +37,40 @@ def test_autotune_picks_a_valid_block_and_caches(tmp_path, monkeypatch):
 
 
 def test_tuned_blocks_feed_the_flash_entry(monkeypatch):
-    """ops.flash_attention consults the cache: a poisoned entry with an
-    invalid block must surface as the kernel's block-divisibility error,
-    proving the value was actually used."""
+    """ops.flash_attention consults the cache: a valid tuned entry is
+    passed through to the kernel, while a poisoned entry (stale disk
+    table: blocks that don't divide S, or a non-square causal pair)
+    falls back to the kernel default instead of raising mid-forward
+    (ISSUE 6 satellite: the block-table fix)."""
+    import importlib
+
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.ops.flash_attention import _pallas_flash_bhsd
+    fa_mod = importlib.import_module("paddle_tpu.ops.flash_attention")
+    pallas_mod = importlib.import_module(
+        "paddle_tpu.ops.pallas.flash_attention")
 
+    seen = {}
+
+    def fake_flash(q, k, v, block_q=None, block_k=None, **kw):
+        seen["blocks"] = (block_q, block_k)
+        return q
+
+    monkeypatch.setattr(pallas_mod, "flash_attention", fake_flash)
     autotune._block_cache.clear()
     key = (jax.default_backend(), 2, 256, 64, True)
-    autotune._block_cache[key] = (96, 96)       # 256 % 96 != 0
     q = jnp.ones((1, 2, 256, 64), jnp.float32)
-    with pytest.raises(ValueError, match="multiple of block"):
-        _pallas_flash_bhsd(q, q, q, True, 0.125)
+
+    autotune._block_cache[key] = (128, 128)     # valid: divides S=256
+    fa_mod._pallas_flash_bhsd(q, q, q, True, 0.125)
+    assert seen["blocks"] == (128, 128)
+
+    autotune._block_cache[key] = (96, 96)       # poisoned: 256 % 96 != 0
+    fa_mod._pallas_flash_bhsd(q, q, q, True, 0.125)
+    assert seen["blocks"] == (None, None)       # fell back, no raise
+
+    autotune._block_cache[key] = (128, 256)     # causal needs square blocks
+    fa_mod._pallas_flash_bhsd(q, q, q, True, 0.125)
+    assert seen["blocks"] == (None, None)
     autotune._block_cache.clear()

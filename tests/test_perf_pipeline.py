@@ -136,6 +136,55 @@ def test_metrics_report_compare_gates_regressions(bench_artifacts, tmp_path):
     assert "REGRESSIONS" in bad.stdout
 
 
+def _snapshot_with(counters):
+    """Minimal valid paddle_tpu.metrics.v1 snapshot with given counter
+    name->value pairs."""
+    return {"schema": metrics_report.SCHEMA, "ts": 1.0, "pid": 1,
+            "metrics": [
+                {"name": n, "type": "counter", "help": "", "labelnames": [],
+                 "samples": [{"labels": {}, "value": v}]}
+                for n, v in counters.items()]}
+
+
+def test_metrics_compare_flags_shed_preempt_and_prefix_rate(tmp_path):
+    """ISSUE 6 gate: shed/preempt counter growth and a prefix-cache
+    hit-RATE drop are failure-class regressions, even when the absolute
+    hit count grew with traffic."""
+    a = _snapshot_with({"serving_shed_total": 1,
+                        "serving_preempted_total": 2,
+                        "serving_prefix_cache_hits_total": 80,
+                        "serving_prefix_cache_misses_total": 20,
+                        "serving_tokens_total": 1000})
+    b = _snapshot_with({"serving_shed_total": 10,
+                        "serving_preempted_total": 9,
+                        "serving_prefix_cache_hits_total": 100,  # grew...
+                        "serving_prefix_cache_misses_total": 100,  # rate 0.5
+                        "serving_tokens_total": 1000})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, _, _, _, w in regs}
+    assert why["serving_shed_total"] == "failure counter grew"
+    assert why["serving_preempted_total"] == "failure counter grew"
+    assert why["serving_prefix_cache_misses_total"] == "failure counter grew"
+    assert why["serving_prefix_cache_hit_rate"] == "hit rate dropped"
+    # identical runs stay clean, and the CLI exit code reflects the gate
+    assert metrics_report.compare_counters(a, a) == []
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_prefix_cache_hit_rate" in bad.stdout
+    # a pure traffic-growth run (rate intact) passes the rate rule
+    c = _snapshot_with({"serving_prefix_cache_hits_total": 800,
+                        "serving_prefix_cache_misses_total": 200,
+                        "serving_tokens_total": 9000})
+    assert not any(w == "hit rate dropped" for *_, w in
+                   metrics_report.compare_counters(a, c))
+
+
 def test_validate_record_catches_rot():
     good = {"schema": perf_report.SCHEMA, "step": 0, "step_ms": 1.0,
             "phases": {"Forward": 1.0}, "ops": [], "num_samples": None,

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Deterministic traffic-replay load harness for the serving engine.
+
+Synthesizes a reproducible consumer-traffic trace — N synthetic users,
+Poisson arrivals, a shared-prefix mixture (every user opens with one of
+a small pool of "system prompts", the workload the prefix cache exists
+for), and a priority-class mix — and replays it through a Scheduler over
+either KV layout:
+
+  dense  GenerationEngine        (one max_len reservation per slot)
+  paged  PagedGenerationEngine   (block pool + prefix cache + preemption)
+
+The replay reports p50/p99 TTFT, decode tokens/sec, peak concurrency,
+shed/preempt/reject tallies and the prefix-cache hit rate; the same
+figures are exported through the unified metrics registry
+(`serving_load_*` gauges ride next to the scheduler's own counters and
+histograms) and an optional registry snapshot (paddle_tpu.metrics.v1
+JSONL) is written for `tools/metrics_report.py`.
+
+Determinism: the TRACE is fully seeded (numpy RandomState). With
+`virtual_step_s` set, time itself is virtual — the scheduler runs on a
+monotonic counter the harness advances by a fixed amount per step, so
+arrivals, shedding, preemption and peak concurrency are bit-reproducible
+across hosts (the tier-1 paged-vs-dense win assertion runs this mode).
+Without it, the wall clock drives arrivals — the honest-throughput mode
+`bench.py --serve-load` uses.
+
+Usage:
+  python tools/load_harness.py --engine paged --users 8 --requests 32
+  python tools/load_harness.py --engine both --metrics-out run/metrics.jsonl
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:          # script-mode: make paddle_tpu importable
+    sys.path.insert(0, _ROOT)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import serve_report  # noqa: E402  (sibling tool: shared percentile calc)
+
+__all__ = ["TrafficConfig", "VirtualClock", "synth_trace", "replay",
+           "build_engine", "run_harness", "percentile"]
+
+
+class TrafficConfig:
+    """Knobs of the synthetic trace. `prefix_pool` shared system prompts
+    of `prefix_len` tokens are dealt round-robin to `users`; each request
+    appends a random suffix of suffix_min..suffix_max tokens."""
+
+    def __init__(self, users=8, requests=32, rate_rps=200.0, prefix_pool=2,
+                 prefix_len=16, suffix_min=2, suffix_max=8,
+                 max_new_tokens=4, priority_weights=(1, 2, 1),
+                 timeout_s=None, seed=0):
+        self.users = int(users)
+        self.requests = int(requests)
+        self.rate_rps = float(rate_rps)
+        self.prefix_pool = int(prefix_pool)
+        self.prefix_len = int(prefix_len)
+        self.suffix_min = int(suffix_min)
+        self.suffix_max = int(suffix_max)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority_weights = tuple(priority_weights)
+        self.timeout_s = timeout_s
+        self.seed = int(seed)
+
+
+class VirtualClock:
+    """Deterministic time: starts at 0, advances only when told."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def synth_trace(cfg, vocab):
+    """The deterministic request trace: a list of dicts with arrival
+    time `t` (seconds from start, Poisson via seeded exponential
+    inter-arrivals), `prompt`, `priority`, `max_new`, `user`."""
+    rng = np.random.RandomState(cfg.seed)
+    prefixes = [rng.randint(0, vocab, cfg.prefix_len).tolist()
+                for _ in range(max(cfg.prefix_pool, 1))]
+    w = np.asarray(cfg.priority_weights, np.float64)
+    w = w / w.sum()
+    items = []
+    t = 0.0
+    for i in range(cfg.requests):
+        t += float(rng.exponential(1.0 / cfg.rate_rps))
+        user = i % cfg.users
+        prompt = list(prefixes[user % len(prefixes)])
+        n_suffix = int(rng.randint(cfg.suffix_min, cfg.suffix_max + 1))
+        prompt += rng.randint(0, vocab, n_suffix).tolist()
+        items.append({
+            "t": t, "user": user, "prompt": prompt,
+            "priority": int(rng.choice(len(w), p=w)),
+            "max_new": cfg.max_new_tokens,
+        })
+    return items
+
+
+# one percentile convention across the serving tools: serve_report owns it
+percentile = serve_report._pct
+
+
+def replay(sched, trace, timeout_s=None, virtual_clock=None,
+           virtual_step_s=0.01, max_steps=200000):
+    """Drive `sched` through `trace`. Submissions happen when the
+    scheduler's clock passes each item's arrival time; sheds/rejections
+    are tallied, everything else runs to a terminal status. Returns the
+    summary dict."""
+    from paddle_tpu.serving import LoadShedError, QueueFullError
+
+    wall0 = time.monotonic()
+    now = (lambda: virtual_clock()) if virtual_clock is not None \
+        else (lambda: time.monotonic() - wall0)
+    handles = []
+    shed = rejected = 0
+    next_i = 0
+    max_concurrent = 0
+    steps = 0
+    while True:
+        while next_i < len(trace) and trace[next_i]["t"] <= now():
+            it = trace[next_i]
+            next_i += 1
+            try:
+                handles.append(sched.submit(
+                    it["prompt"], max_new_tokens=it["max_new"],
+                    timeout_s=timeout_s, priority=it["priority"]))
+            except LoadShedError:
+                shed += 1
+            except QueueFullError:
+                rejected += 1
+        more = sched.step()
+        steps += 1
+        max_concurrent = max(max_concurrent, sched.active_slots())
+        if virtual_clock is not None:
+            virtual_clock.advance(virtual_step_s)
+        if next_i >= len(trace) and not more:
+            break
+        if steps >= max_steps:
+            raise RuntimeError(f"replay did not converge in {max_steps} "
+                               f"steps")
+    wall_s = time.monotonic() - wall0
+
+    by_status = {}
+    for h in handles:
+        by_status[h.status] = by_status.get(h.status, 0) + 1
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+    m = sched.metrics()
+    summary = {
+        "requests": len(trace),
+        "submitted": len(handles),
+        "by_status": by_status,
+        "shed": shed,
+        "rejected": rejected,
+        "preempted": m["requests"].get("serving.preempted", 0),
+        "prefix_hits": sum(1 for h in handles if h.prefix_hit),
+        "max_concurrent": max_concurrent,
+        "steps": steps,
+        "wall_s": round(wall_s, 4),
+        "tokens": m["tokens_generated"],
+        "tokens_per_s": round(m["tokens_generated"] / wall_s, 2)
+        if wall_s > 0 else None,
+        "ttft_p50_s": percentile(ttfts, 0.50),
+        "ttft_p99_s": percentile(ttfts, 0.99),
+    }
+    _export_registry(summary)
+    return summary
+
+
+def _export_registry(summary):
+    """Publish the replay headline figures as serving_load_* gauges in
+    the unified registry (next to the scheduler's own histograms)."""
+    from paddle_tpu.observability import metrics as _metrics
+    g = {
+        "serving_load_ttft_p50_seconds":
+            ("Replay p50 time-to-first-token", summary["ttft_p50_s"]),
+        "serving_load_ttft_p99_seconds":
+            ("Replay p99 time-to-first-token", summary["ttft_p99_s"]),
+        "serving_load_tokens_per_s":
+            ("Replay decode throughput", summary["tokens_per_s"]),
+        "serving_load_max_concurrent":
+            ("Replay peak concurrent in-flight requests",
+             summary["max_concurrent"]),
+    }
+    for name, (help_, value) in g.items():
+        if value is not None:
+            _metrics.gauge(name, help_).set(float(value))
+
+
+def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
+                 prefix_cache=True):
+    """A serving engine of either KV layout over `model`."""
+    from paddle_tpu.serving import GenerationEngine, PagedGenerationEngine
+    if kind == "dense":
+        return GenerationEngine(model, slots=slots, max_len=max_len)
+    if kind == "paged":
+        return PagedGenerationEngine(
+            model, slots=slots, max_len=max_len, block_size=block_size,
+            num_blocks=num_blocks, enable_prefix_cache=prefix_cache)
+    raise ValueError(f"unknown engine kind {kind!r} (want dense|paged)")
+
+
+def run_harness(model, kind, traffic, slots, max_len, block_size=8,
+                num_blocks=None, prefix_cache=True, max_queue=256,
+                shed_watermark=None, virtual_step_s=None,
+                metrics_out=None):
+    """Build engine+scheduler, replay `traffic`, return the summary
+    (annotated with the engine's KV budget and compile counters)."""
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.serving import Scheduler
+
+    engine = build_engine(model, kind, slots, max_len,
+                          block_size=block_size, num_blocks=num_blocks,
+                          prefix_cache=prefix_cache)
+    vclock = VirtualClock() if virtual_step_s is not None else None
+    sched = Scheduler(engine, max_queue=max_queue,
+                      shed_watermark=shed_watermark,
+                      clock=(vclock if vclock is not None
+                             else time.monotonic))
+    trace = synth_trace(traffic, model.cfg.vocab_size)
+    summary = replay(sched, trace, timeout_s=traffic.timeout_s,
+                     virtual_clock=vclock,
+                     virtual_step_s=virtual_step_s or 0.01)
+    summary["engine"] = kind
+    summary["kv_memory_tokens"] = engine.kv_memory_tokens
+    summary["slots"] = engine.slots
+    summary["trace_counts"] = {
+        "decode": engine.trace_counts["decode"],
+        "prefill": dict(engine.trace_counts["prefill"])}
+    if kind == "paged":
+        summary["blocks_total"] = engine.block_pool.capacity
+        pc = engine.prefix_cache
+        summary["prefix_cache_blocks"] = len(pc) if pc is not None else 0
+    if metrics_out:
+        _metrics.registry().write_snapshot(metrics_out)
+        summary["metrics_snapshot"] = metrics_out
+    return summary
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--engine", default="both",
+                   choices=("dense", "paged", "both"))
+    p.add_argument("--model", default="gpt_tiny")
+    p.add_argument("--users", type=int, default=8)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate-rps", type=float, default=200.0)
+    p.add_argument("--prefix-pool", type=int, default=2)
+    p.add_argument("--prefix-len", type=int, default=16)
+    p.add_argument("--max-new", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--slots", type=int, default=4,
+                   help="dense slot count; paged gets --paged-slots")
+    p.add_argument("--paged-slots", type=int, default=None,
+                   help="paged slot count (default: sized to the same KV "
+                        "budget as dense)")
+    p.add_argument("--max-len", type=int, default=64)
+    p.add_argument("--block-size", type=int, default=8)
+    p.add_argument("--timeout-s", type=float, default=None)
+    p.add_argument("--shed-watermark", type=int, default=None)
+    p.add_argument("--virtual-step-s", type=float, default=None,
+                   help="run on a deterministic virtual clock (this many "
+                        "virtual seconds per scheduler step)")
+    p.add_argument("--metrics-out", default=None,
+                   help="write a metrics-registry JSONL snapshot here")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.text import models as _models
+    model = getattr(_models, args.model)()
+    model.eval()
+    traffic = TrafficConfig(
+        users=args.users, requests=args.requests, rate_rps=args.rate_rps,
+        prefix_pool=args.prefix_pool, prefix_len=args.prefix_len,
+        max_new_tokens=args.max_new, timeout_s=args.timeout_s,
+        seed=args.seed)
+
+    budget = args.slots * args.max_len           # dense KV budget, tokens
+    num_blocks = budget // args.block_size       # same budget in blocks
+    paged_slots = args.paged_slots or min(
+        2 * args.slots, max(args.slots + 1, num_blocks - 1))
+    kinds = ("dense", "paged") if args.engine == "both" else (args.engine,)
+    out = {}
+    for kind in kinds:
+        out[kind] = run_harness(
+            model, kind, traffic,
+            slots=args.slots if kind == "dense" else paged_slots,
+            max_len=args.max_len, block_size=args.block_size,
+            num_blocks=num_blocks, shed_watermark=args.shed_watermark,
+            virtual_step_s=args.virtual_step_s,
+            metrics_out=args.metrics_out
+            if kind == kinds[-1] else None)
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,12 @@ SCHEMA = "paddle_tpu.metrics.v1"
 _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
-    r"|fault|breaker", re.I)
+    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt", re.I)
+
+# hits/misses counter pairs whose RATIO is the SLO signal: a hit-rate
+# drop past the threshold is a failure-class regression even when the
+# absolute hit count grew (e.g. more traffic, worse prefix sharing)
+_RATE_PAT = re.compile(r"^(?P<base>.*_)hits_total(?P<labels>\{.*\})?$")
 
 
 # ------------------------------------------------------------- validation
@@ -206,8 +211,31 @@ def render(records, title="metrics report"):
 
 # ------------------------------------------------------------- comparison
 
+def _hit_rates(flat):
+    """{base: rate} for every X_hits_total/X_misses_total counter pair
+    with at least one event."""
+    rates = {}
+    for key, hits in flat.items():
+        m = _RATE_PAT.match(key)
+        if not m:
+            continue
+        miss_key = m.group("base") + "misses_total" + (m.group("labels")
+                                                       or "")
+        misses = flat.get(miss_key)
+        if misses is None or hits + misses <= 0:
+            continue
+        rates[m.group("base") + "hit_rate"] = hits / (hits + misses)
+    return rates
+
+
 def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
-    """[(key, a, b, pct, why)] counter regressions of B against A."""
+    """[(key, a, b, pct, why)] counter regressions of B against A.
+
+    Three regression classes: failure counters that grew (shed/preempt/
+    retry/... — each one is absorbed damage), work counters that shrank,
+    and hits/misses RATIOS that dropped (prefix-cache hit rate et al —
+    the miss counter growing would fire the failure rule, but a rate
+    comparison stays meaningful when B simply served more traffic)."""
     a, b = flatten(a_rec, ("counter",)), flatten(b_rec, ("counter",))
     regressions = []
     for key in sorted(set(a) | set(b)):
@@ -224,6 +252,14 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
             if delta < 0 and -pct > max_regress_pct:
                 regressions.append((key, va, vb, pct,
                                     "work counter shrank"))
+    ra, rb = _hit_rates(a), _hit_rates(b)
+    for key in sorted(set(ra) & set(rb)):
+        va, vb = ra[key], rb[key]
+        if va <= 0:
+            continue
+        pct = (vb - va) / va * 100.0
+        if vb < va and -pct > max_regress_pct:
+            regressions.append((key, va, vb, pct, "hit rate dropped"))
     return regressions
 
 

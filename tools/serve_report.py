@@ -7,7 +7,14 @@ two record kinds:
   {"kind": "step", "step", "t", "queue_depth", "active_slots",
    "tokens_generated"}
   {"kind": "request", "request_id", "status", "prompt_len", "tokens",
-   "ttft_s", "decode_s"}
+   "priority", "preempted", "prefix_hit", "ttft_s", "decode_s"}
+
+The per-request SLO fields (ISSUE 6): `priority` is the request's class
+(0=interactive, 1=standard, 2=batch), `preempted` how many times it was
+evicted and requeued under allocation pressure, `prefix_hit` whether its
+prefill reused shared prefix-cache blocks. Terminal statuses now include
+ERROR (engine failure contained to the request) and SHED (failed fast at
+admission by the SLO watermark).
 
 `validate_records` is the schema contract the CI smoke test asserts on;
 the CLI renders a human summary: request outcomes, TTFT percentiles,
@@ -22,10 +29,11 @@ STEP_FIELDS = {"kind": str, "step": int, "t": (int, float),
                "queue_depth": int, "active_slots": int,
                "tokens_generated": int}
 REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
-                  "prompt_len": int, "tokens": int,
+                  "prompt_len": int, "tokens": int, "priority": int,
+                  "preempted": int, "prefix_hit": bool,
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
-STATUSES = {"DONE", "TIMEOUT", "REJECTED"}
+STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
 
 def validate_records(records):
@@ -73,16 +81,28 @@ def summarize(records):
     by_status = {}
     for r in reqs:
         by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+    # hit rate over requests that actually PREFILLED (ttft set): queued
+    # timeouts/sheds never did a cache lookup and would deflate the rate
+    served = [r for r in reqs if r["ttft_s"] is not None]
     return {
         "steps": len(steps),
         "requests": by_status,
         "ttft_s": {"mean": sum(ttfts) / len(ttfts) if ttfts else None,
-                   "p50": _pct(ttfts, 0.50), "p95": _pct(ttfts, 0.95)},
+                   "p50": _pct(ttfts, 0.50), "p95": _pct(ttfts, 0.95),
+                   "p99": _pct(ttfts, 0.99)},
         "decode_tokens_per_s": (decode_tokens / decode_s
                                 if decode_s > 0 else None),
         "queue_depth_max": max((s["queue_depth"] for s in steps), default=0),
         "mean_active_slots": (sum(s["active_slots"] for s in steps)
                               / len(steps) if steps else 0.0),
+        "max_active_slots": max((s["active_slots"] for s in steps),
+                                default=0),
+        "prefix_hit_rate": (sum(1 for r in served if r["prefix_hit"])
+                            / len(served) if served else None),
+        "preemptions": sum(r["preempted"] for r in reqs),
+        "by_priority": {
+            p: sum(1 for r in reqs if r["priority"] == p)
+            for p in sorted({r["priority"] for r in reqs})},
     }
 
 
@@ -99,7 +119,15 @@ def render(summary):
         out.append(f"decode throughput: "
                    f"{summary['decode_tokens_per_s']:.1f} tok/s")
     out.append(f"max queue depth: {summary['queue_depth_max']}")
-    out.append(f"mean active slots: {summary['mean_active_slots']:.2f}")
+    out.append(f"mean active slots: {summary['mean_active_slots']:.2f} "
+               f"(max {summary['max_active_slots']})")
+    if summary["prefix_hit_rate"] is not None:
+        out.append(f"prefix-cache hit rate: "
+                   f"{summary['prefix_hit_rate']:.2f}")
+    if summary["preemptions"]:
+        out.append(f"preemptions: {summary['preemptions']}")
+    out.append("priority mix: " + ", ".join(
+        f"class{p}={n}" for p, n in summary["by_priority"].items()))
     return "\n".join(out)
 
 
