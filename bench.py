@@ -487,12 +487,15 @@ def run_decode_bench(on_tpu, n_steps=None):
 
 def run_serve_load_bench(on_tpu, n_requests=None):
     """Serving load rung: the deterministic traffic-replay harness
-    (tools/load_harness.py) at a shared-prefix mixture, paged engine vs
-    the dense per-slot engine AT THE SAME KV MEMORY BUDGET. The metric is
-    the paged engine's replay tokens/sec; extra carries both summaries
-    (p50/p99 TTFT, peak concurrency, prefix hits, preemptions) plus the
-    compile-once counters, and vs_baseline is the paged/dense concurrency
-    ratio — >1.0 is the paged-KV win."""
+    (tools/load_harness.py) at a shared-prefix mixture — dense, paged,
+    and speculative-decode engines AT THE SAME KV MEMORY BUDGET. The
+    metric is the paged engine's replay tokens/sec; extra carries every
+    arm's summary (tokens/sec, p50/p99 TTFT, peak concurrency, prefix
+    hits, preemptions, and the spec arm's acceptance rate) plus the
+    compile-once counters — ASSERTED bounded here, so a rung that quietly
+    recompiles per step cannot report a throughput number — and
+    vs_baseline is the paged/dense concurrency ratio (>1.0 is the
+    paged-KV win)."""
     import jax
 
     import paddle_tpu  # noqa: F401  (registers the framework)
@@ -523,12 +526,29 @@ def run_serve_load_bench(on_tpu, n_requests=None):
         prefix_len=int(os.environ.get("BENCH_SERVE_PREFIX", 2 * block)),
         max_new_tokens=int(os.environ.get("BENCH_SERVE_MAXNEW", 4)),
         seed=0)
+    gamma = int(os.environ.get("BENCH_SERVE_GAMMA", 3))
+    draft_layers = int(os.environ.get("BENCH_SERVE_DRAFT_LAYERS", 1))
+    attention_impl = os.environ.get("BENCH_SERVE_ATTEND", "gather")
     results = {}
-    for kind, n_slots in (("dense", slots), ("paged", paged_slots)):
+    for kind, n_slots in (("dense", slots), ("paged", paged_slots),
+                          ("spec", paged_slots)):
         results[kind] = load_harness.run_harness(
             model, kind, traffic, slots=n_slots, max_len=max_len,
-            block_size=block, num_blocks=num_blocks)
-    paged, dense = results["paged"], results["dense"]
+            block_size=block, num_blocks=num_blocks, gamma=gamma,
+            draft_layers=draft_layers, attention_impl=attention_impl)
+    paged, dense, spec = results["paged"], results["dense"], results["spec"]
+    # compile-count discipline, asserted per arm: ONE decode executable
+    # (dense/paged) or ONE draft-decode + ONE verify executable (spec) —
+    # a rung that recompiles per step must fail, not report throughput
+    compile_bounds = {
+        "dense": dense["trace_counts"]["decode"] == 1,
+        "paged": paged["trace_counts"]["decode"] == 1,
+        "spec": (spec["trace_counts"]["spec_verify"] == 1
+                 and spec["trace_counts"]["draft_decode"] == 1
+                 and spec["trace_counts"]["decode"] == 0),
+    }
+    assert all(compile_bounds.values()), \
+        f"decode compile counts unbounded: {compile_bounds}"
     ratio = (paged["max_concurrent"] / dense["max_concurrent"]
              if dense["max_concurrent"] else 0.0)
     return {
@@ -536,7 +556,11 @@ def run_serve_load_bench(on_tpu, n_requests=None):
         "vs_baseline": round(ratio, 3),     # paged/dense concurrency ratio
         "extra": {"metric_name": "serve_load_tokens_per_s",
                   "model": model_name, "kv_memory_tokens": budget,
-                  "paged": paged, "dense": dense,
+                  "paged": paged, "dense": dense, "spec": spec,
+                  "spec_acceptance_rate": spec["spec_acceptance_rate"],
+                  "spec_gamma": gamma,
+                  "attention_impl": attention_impl,
+                  "compile_bounds": compile_bounds,
                   "paged_beats_dense_concurrency":
                       paged["max_concurrent"] > dense["max_concurrent"],
                   "backend": jax.default_backend()},

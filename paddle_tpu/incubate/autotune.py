@@ -20,14 +20,21 @@ _config = {"kernel": {"enable": True, "tuning_range": [1, 10]},
            "dataloader": {"enable": False},
            "layout": {"enable": False}}
 
-# (backend, H, S, D, causal) -> (block_q, block_k).  Batch size is NOT part
-# of the key: tiling is set by the (S, D, causal) geometry, so a winner tuned
-# at one B serves every batch size (and per-B retuning would be dead weight).
+# Two kernels share the table. Flash keys are UNTAGGED (the original
+# format): (backend, H, S, D, causal) -> (block_q, block_k). Paged-
+# attention keys lead with a kernel tag: ("paged", backend, H,
+# padded_len, D, block_size) -> (q_tile, head_tile) caps. Batch size is
+# NOT part of either key: tiling is set by the geometry, so a winner
+# tuned at one B serves every batch size (and per-B retuning would be
+# dead weight). The tag check runs BEFORE the legacy-6-tuple collapse,
+# so old flash caches keep parsing and old frameworks reading a new file
+# simply never look tagged keys up.
 # _block_cache holds entries tuned IN THIS PROCESS (these get persisted to
 # the env-path file); _disk_cache holds entries loaded from the shipped file
 # and the env-path file (read-only — never written back, so a framework
 # upgrade that improves flash_blocks_tuned.json is never shadowed by a stale
 # frozen copy in the user cache).
+_KERNEL_TAGS = ("paged",)
 _block_cache = {}
 _disk_cache = {}
 _disk_loaded = False
@@ -75,8 +82,10 @@ def _read_cache_file(path):
                 out = {}
                 for k, v in json.load(f).items():
                     key = tuple(json.loads(k))
-                    if len(key) == 6:      # legacy (backend,B,H,S,D,causal)
-                        key = key[:1] + key[2:]
+                    if not (key and key[0] in _KERNEL_TAGS):
+                        # untagged == flash
+                        if len(key) == 6:  # legacy (backend,B,H,S,D,causal)
+                            key = key[:1] + key[2:]
                     out[key] = tuple(v)
                 return out
         except (OSError, ValueError):
@@ -129,6 +138,41 @@ def lookup_flash_blocks(B, H, S, D, causal):
     return _disk_cache.get(key)
 
 
+def lookup_paged_blocks(H, padded_len, D, block_size):
+    """Tuned (q_tile, head_tile) CAPS for the paged-attention kernel's
+    geometry, or None. Same caches and enable knob as the flash lookup.
+
+    The fall-back-don't-raise contract (PR 6, extended here): a stale or
+    hand-poisoned shipped entry that is not a pair of positive ints is
+    treated as absent — the kernel then tiles with its own defaults —
+    because an exception from a table lookup inside a traced forward is
+    the worst possible place to learn the table rotted. Values are caps,
+    not exact tiles: the kernel clamps each to the largest divisor of
+    the live extent, so an entry tuned for one prefill bucket serves
+    every bucket (and the T=1 decode shape) without retuning."""
+    import jax
+    global _disk_loaded
+    if not kernel_tuning_enabled():
+        return None
+    key = ("paged", jax.default_backend(), int(H), int(padded_len), int(D),
+           int(block_size))
+    entry = _block_cache.get(key)
+    if entry is None:
+        if not _disk_loaded:
+            _disk_cache.update(_load_disk_cache())
+            _disk_loaded = True
+        entry = _disk_cache.get(key)
+    if entry is None:
+        return None
+    try:
+        qt, ht = int(entry[0]), int(entry[1])
+    except (TypeError, ValueError, IndexError):
+        return None                 # rotted entry: fall back, don't raise
+    if qt < 1 or ht < 1:
+        return None
+    return (qt, ht)
+
+
 def record_flash_blocks(H, S, D, causal, blocks, persist=True):
     """Record an externally-measured (block_q, block_k) winner for a
     geometry (tools/profile_step.py's sweep) and persist it to the env-path
@@ -145,20 +189,39 @@ def record_flash_blocks(H, S, D, causal, blocks, persist=True):
         _fallback_keys.add(key)
 
 
-def commit_shipped_table(entries, backend="tpu", path=None):
-    """Commit measured (block_q, block_k) winners into the SHIPPED table
+def commit_shipped_table(entries, backend="tpu", path=None, kernel="flash"):
+    """Commit measured winners into the SHIPPED table
     (`ops/pallas/flash_blocks_tuned.json`) — the path on-chip sweep
     results (tools/profile_step.py) take into the tree, using the exact
-    cache serialization `lookup_flash_blocks` reads back.
+    cache serialization the lookups read back.
 
-    entries: {(H, S, D, causal): (block_q, block_k)}. Existing shipped
-    entries for other geometries are preserved (load-then-merge). The
+    kernel="flash": entries {(H, S, D, causal): (block_q, block_k)}.
+    kernel="paged": entries {(H, padded_len, D, block_size):
+    (q_tile, head_tile)} — the paged-attention kernel's tile caps,
+    served back by `lookup_paged_blocks`. Existing shipped entries for
+    other geometries/kernels are preserved (load-then-merge). The
     in-process disk cache is invalidated so the committing process sees
     its own commit."""
     global _disk_loaded
+    if kernel not in ("flash",) + _KERNEL_TAGS:
+        raise ValueError(f"unknown kernel {kernel!r}; want 'flash' or one "
+                         f"of {_KERNEL_TAGS}")
     path = path or _SHIPPED_PATH
     merged = _read_cache_file(path)
-    for (H, S, D, causal), blocks in entries.items():
+    for key, blocks in entries.items():
+        if kernel == "paged":
+            H, L, D, bs = key
+            qt, ht = int(blocks[0]), int(blocks[1])
+            if qt < 1 or ht < 1:
+                raise ValueError(f"paged tile caps {blocks} must be "
+                                 f"positive ints")
+            if int(L) % int(bs):
+                raise ValueError(f"padded_len {L} is not a multiple of "
+                                 f"block_size {bs}")
+            merged[("paged", backend, int(H), int(L), int(D), int(bs))] = \
+                (qt, ht)
+            continue
+        H, S, D, causal = key
         bq, bk = int(blocks[0]), int(blocks[1])
         if bq <= 0 or bk <= 0 or bq % 8 or bk % 8:
             raise ValueError(f"blocks {blocks} must be positive multiples "

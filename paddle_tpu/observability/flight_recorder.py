@@ -40,6 +40,12 @@ __all__ = ["FlightRecorder", "POSTMORTEM_SCHEMA", "enable", "get",
 
 POSTMORTEM_SCHEMA = "paddle_tpu.postmortem.v1"
 DEFAULT_DIR_ENV = "PADDLE_TPU_POSTMORTEM_DIR"
+# Bounded dump retention: each successful dump sweeps the directory down
+# to the newest KEEP artifacts, so a crash-looping or watchdog-happy
+# process can never grow ./postmortem without bound (ISSUE 7 hygiene —
+# PR 6 shipped a 1046-line dump into the tree). 0 disables the sweep.
+DEFAULT_KEEP_ENV = "PADDLE_TPU_POSTMORTEM_KEEP"
+DEFAULT_KEEP = 20
 
 
 def _tracer():
@@ -95,9 +101,12 @@ def _compact_span(rec):
 class FlightRecorder:
     """One ring + one watchdog thread + the dump path."""
 
-    def __init__(self, capacity=512, dir=None):
+    def __init__(self, capacity=512, dir=None, keep_dumps=None):
         self.ring = collections.deque(maxlen=int(capacity))
         self.dir = dir or os.environ.get(DEFAULT_DIR_ENV, "./postmortem")
+        if keep_dumps is None:
+            keep_dumps = int(os.environ.get(DEFAULT_KEEP_ENV, DEFAULT_KEEP))
+        self.keep_dumps = max(0, int(keep_dumps))
         self.last_dump_path = None
         self._baseline = None               # flattened metrics at enable()
         self._enabled = False
@@ -285,7 +294,49 @@ class FlightRecorder:
             json.dump(doc, f, indent=1)
         os.replace(tmp, path)               # atomic: no torn artifacts
         self.last_dump_path = path
+        self._sweep_old_dumps(keep=path)
         return path
+
+    def _sweep_old_dumps(self, keep=None):
+        """Retention: unlink the oldest postmortem artifacts (and any
+        stale .tmp torn by a crash mid-write) past `keep_dumps`, newest
+        first by mtime. Best-effort like everything on the dump path —
+        a sweep failure must never cost the dump that triggered it."""
+        if self.keep_dumps <= 0:
+            return
+        try:
+            entries = []
+            now = time.time()
+            for name in os.listdir(self.dir):
+                full = os.path.join(self.dir, name)
+                if name.startswith("postmortem_") and name.endswith(".tmp") \
+                        and full != (keep or "") + ".tmp":
+                    # torn artifact from a crash — but only if STALE: a
+                    # fresh .tmp may be another process's in-flight dump,
+                    # and unlinking it would make that os.replace raise
+                    try:
+                        if now - os.path.getmtime(full) > 60.0:
+                            os.unlink(full)
+                    except OSError:
+                        pass
+                    continue
+                if not (name.startswith("postmortem_")
+                        and name.endswith(".json")):
+                    continue
+                try:
+                    entries.append((os.path.getmtime(full), full))
+                except OSError:
+                    continue
+            entries.sort(reverse=True)      # newest first
+            for _, full in entries[self.keep_dumps:]:
+                if full == keep:
+                    continue                # never sweep the fresh dump
+                try:
+                    os.unlink(full)
+                except OSError:
+                    pass
+        except OSError:
+            pass
 
 
 _recorder = None

@@ -7,3 +7,4 @@ kernels. Everything else is left to XLA fusion, which covers what the
 reference's 211 IR fusion passes do by hand.
 """
 from .flash_attention import flash_attention  # noqa: F401
+from .paged_attention import paged_attention  # noqa: F401

@@ -1,0 +1,201 @@
+"""Paged attention as a Pallas TPU kernel: block tables consumed IN-kernel.
+
+The PR 6 paged path (`serving/blocks.py`) is token-exact but pays for it
+in HBM traffic: `attend` first *gathers* every slot's physical blocks
+into a dense `[slots, max_len, heads, head_dim]` view (a full write +
+re-read of the padded KV), then runs the dense masked softmax over it.
+At decode shapes that is tolerable; at long-prompt shapes the gather IS
+the memory bill — O(slots x max_len) written and read again per layer
+per step, regardless of how many tokens are live.
+
+This kernel removes the dense view entirely. The per-slot block tables
+ride into the kernel as *scalar-prefetch* operands
+(`pltpu.PrefetchScalarGridSpec`), so the BlockSpec index map of the K/V
+pool can walk the table: grid step (slot, head-tile, q-tile, kv-block)
+DMAs exactly ONE physical pool block — `tables[slot, kv_block]` — into
+VMEM and folds it into an online-softmax accumulator (the flash
+recurrence, `flash_attention.py`). K/V stream through VMEM once; nothing
+is materialized per-slot in HBM.
+
+Masking is identical to `kv_cache.attend` (the exactness oracle the
+tier-1 tests assert against, in interpret mode):
+
+  * key position j is visible to query i iff j <= pos[slot] + i;
+  * masked scores are filled with the same finite -1e30 (never -inf:
+    fully-masked rows must exp to zero, not NaN);
+  * probabilities off-mask are exact zeros, and V rows no query of this
+    tile can ever see are zeroed before the PV product — the garbage
+    block (physical block 0) legitimately holds inf/NaN scatter junk
+    and 0*inf == NaN would leak through an unguarded matmul;
+  * rows with no visible key emit exact zeros.
+
+Blocks whose first key position is past the tile's last visible query
+position are predicated off with `pl.when` — for a slot at position p
+only ceil((p+T)/block_size) of the table's entries cost MXU work (the
+index map clamps their DMA to whatever the table holds, which for
+unallocated entries is the garbage block).
+
+Tiling knobs (`q_tile`, `head_tile`) are CAPS served through the
+`incubate.autotune` shipped-table machinery (`lookup_paged_blocks`,
+keyed on (heads, padded_len, head_dim, block_size)): the effective tile
+is the largest divisor of the live extent not exceeding the cap, so a
+stale shipped entry can never raise mid-forward — it degrades to a
+smaller tile (the same fall-back-don't-raise contract the flash lookup
+got in PR 6).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_attention", "DEFAULT_Q_TILE", "DEFAULT_HEAD_TILE"]
+
+# Conservative VMEM-minded caps (see docs/PERF_NOTES.md for the pricing):
+# a (q_tile, head_tile, D) f32 query tile + accumulator + two
+# (q_tile, head_tile, 128) softmax-stat tiles stay under ~1 MB at
+# D<=128, leaving the budget to the streamed K/V blocks. Shipped tuned
+# entries (ops/pallas/flash_blocks_tuned.json, kernel="paged") override.
+DEFAULT_Q_TILE = 128
+DEFAULT_HEAD_TILE = 4
+_LANE = 128           # TPU lane width for the softmax-stat scratch
+_MASK_VALUE = -1e30   # same finite fill as kv_cache.attend / flash
+
+
+def _largest_divisor_leq(n, cap):
+    """Largest divisor of n that is <= cap (>=1 always)."""
+    cap = max(1, min(int(cap), int(n)))
+    for d in range(cap, 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, bs, tq, hq, nb, scale):
+    s = pl.program_id(0)
+    qi = pl.program_id(2)
+    j = pl.program_id(3)          # kv block — innermost: the online scan
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _MASK_VALUE)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    p0 = pos_ref[s]
+    # highest query position of this tile; keys past it are invisible to
+    # every row, so the whole block's MXU work is predicated off
+    q_hi = p0 + (qi + 1) * tq - 1
+    run = (j * bs) <= q_hi
+
+    @pl.when(run)
+    def _body():
+        qblk = q_ref[0]           # (tq, hq, D)
+        kblk = k_ref[0]           # (bs, hq, D) — ONE physical pool block
+        vblk = v_ref[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 0) + qi * tq
+        cols = jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 1) + j * bs
+        visible = cols <= p0 + rows
+        # V rows no query of this tile ever sees may hold inf/NaN scatter
+        # junk (the garbage block): a zero probability is not enough
+        # against 0*inf == NaN, zero the rows themselves
+        ever = (jax.lax.iota(jnp.int32, bs) + j * bs) <= q_hi
+        for hh in range(hq):
+            qh = qblk[:, hh, :]
+            kh = kblk[:, hh, :]
+            vh = jnp.where(ever[:, None], vblk[:, hh, :], 0.0)
+            sc = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            sc = jnp.where(visible, sc, _MASK_VALUE)
+            m_prev = m_ref[:, hh, :1]                         # (tq, 1)
+            l_prev = l_ref[:, hh, :1]
+            m_cur = jnp.max(sc, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(sc - m_new)
+            # fully-masked rows: m_new == _MASK_VALUE makes p == 1
+            p = jnp.where(sc <= _MASK_VALUE * 0.5, 0.0, p)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_ref[:, hh, :] = acc_ref[:, hh, :] * alpha + pv
+            m_ref[:, hh, :] = jnp.broadcast_to(m_new, (tq, _LANE))
+            l_ref[:, hh, :] = jnp.broadcast_to(l_new, (tq, _LANE))
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]                                   # (tq, hq, 1)
+        l_safe = jnp.where(l == 0.0, 1.0, l)                  # all-masked: 0
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, tables, pos, scale=None,
+                    q_tile=None, head_tile=None, interpret=None):
+    """Block-table attention without the dense gather.
+
+    q: [S, T, H, D] query tokens sitting at positions pos..pos+T-1 of
+    their slot; k_pool/v_pool: [N, block_size, H, D] physical pools;
+    tables: [S, max_blocks] int32 physical block ids (0 == garbage);
+    pos: [S] int32 tokens already resident per slot. Returns
+    [S, T, H, D] — numerically the online-softmax evaluation of exactly
+    the same masked attention `blocks.attend` (gather + dense) computes.
+
+    q_tile/head_tile are caps (tuned via the shipped autotune table);
+    the effective tile is the largest divisor of T / H under the cap.
+    On non-TPU backends the kernel runs in Pallas interpret mode.
+    """
+    S, T, H, D = q.shape
+    N, bs = k_pool.shape[0], k_pool.shape[1]
+    nb = tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if q_tile is None or head_tile is None:
+        from ...incubate import autotune as _autotune
+        tuned = _autotune.lookup_paged_blocks(H, nb * bs, D, bs)
+        if tuned is not None:
+            q_tile = tuned[0] if q_tile is None else q_tile
+            head_tile = tuned[1] if head_tile is None else head_tile
+    tq = _largest_divisor_leq(T, q_tile or DEFAULT_Q_TILE)
+    hq = _largest_divisor_leq(H, head_tile or DEFAULT_HEAD_TILE)
+    nh, nq = H // hq, T // tq
+
+    tables = tables.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+
+    def q_index(s, h, qi, j, tables_ref, pos_ref):
+        return (s, qi, h, 0)
+
+    def kv_index(s, h, qi, j, tables_ref, pos_ref):
+        # THE block-table walk: this grid step's K/V block is whatever
+        # physical block the slot's table maps logical block j to
+        return (tables_ref[s, j], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                    # tables, pos
+        grid=(S, nh, nq, nb),
+        in_specs=[
+            pl.BlockSpec((1, tq, hq, D), q_index),
+            pl.BlockSpec((1, bs, hq, D), kv_index),
+            pl.BlockSpec((1, bs, hq, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, tq, hq, D), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((tq, hq, D), jnp.float32),      # acc
+            pltpu.VMEM((tq, hq, _LANE), jnp.float32),  # running max
+            pltpu.VMEM((tq, hq, _LANE), jnp.float32),  # running sum
+        ],
+    )
+    kernel = functools.partial(_kernel, bs=bs, tq=tq, hq=hq, nb=nb,
+                               scale=float(scale))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, T, H, D), q.dtype),
+        interpret=interpret,
+    )(tables, pos, q, k_pool, v_pool)

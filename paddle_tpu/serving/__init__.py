@@ -11,9 +11,14 @@ turn a compiled decoder into a serving engine:
   prefix_cache.py — shared system-prompt blocks, keyed on prompt-token
                     hash, LRU-evicted under allocation pressure
   sampling.py     — greedy / temperature / top-k / top-p token selection
+                    + the speculative accept/resample rule
   engine.py       — prefill/decode split: length-bucketed prefill
                     executables feed the single decode executable
-                    (dense GenerationEngine + PagedGenerationEngine)
+                    (dense GenerationEngine + PagedGenerationEngine,
+                    gather or in-kernel Pallas paged attention)
+  spec_decode.py  — speculative multi-token decode: draft proposals +
+                    one fixed-shape verify forward per round, greedy
+                    output bit-identical to the one-token loop
   scheduler.py    — SLO-aware continuous batching: priority classes,
                     deadline/priority preemption that frees blocks back
                     to the pool, watermark load shedding, queue caps,
@@ -22,7 +27,7 @@ turn a compiled decoder into a serving engine:
 `inference.Predictor.generate`, `bench.py --decode/--serve-load` and
 `tools/load_harness.py` ride the same engines. See docs/serving.md.
 """
-from . import blocks, kv_cache, prefix_cache, sampling  # noqa: F401
+from . import blocks, kv_cache, prefix_cache, sampling, spec_decode  # noqa: F401,E501
 from .blocks import BlockAllocError, BlockPool  # noqa: F401
 from .engine import (  # noqa: F401
     EngineConfig, GenerationEngine, PagedEngineConfig, PagedGenerationEngine,
@@ -33,12 +38,16 @@ from .scheduler import (  # noqa: F401
     LoadShedError, QueueFullError, Request, RequestHandle, Scheduler,
     ServingConfig,
 )
+from .spec_decode import (  # noqa: F401
+    SpecDecodeConfig, SpeculativeEngine, truncated_draft,
+)
 
 __all__ = [
-    "kv_cache", "blocks", "prefix_cache", "sampling",
+    "kv_cache", "blocks", "prefix_cache", "sampling", "spec_decode",
     "BlockAllocError", "BlockPool", "PrefixCache",
     "EngineConfig", "GenerationEngine", "PagedEngineConfig",
     "PagedGenerationEngine", "save_for_generation",
+    "SpecDecodeConfig", "SpeculativeEngine", "truncated_draft",
     "Scheduler", "ServingConfig", "Request", "RequestHandle",
     "QueueFullError", "LoadShedError",
 ]

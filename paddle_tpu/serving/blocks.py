@@ -30,6 +30,7 @@ point at it, so stray writes from right-padded prefill tails land there
 harmlessly and the gather for masked positions reads it invisibly.
 """
 import collections
+import contextlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -40,6 +41,7 @@ from . import kv_cache as kvc
 
 __all__ = ["BlockAllocError", "BlockPool", "PagedLayerKV",
            "PagedDecodeCache", "alloc_pools", "write", "gather", "attend",
+           "attend_kernel", "attention_impl", "current_attention_impl",
            "blocks_for_tokens", "GARBAGE_BLOCK"]
 
 GARBAGE_BLOCK = 0
@@ -118,6 +120,46 @@ def attend(q, k_pool, v_pool, tables, pos, scale=None):
     zeros."""
     return kvc.attend(q, gather(k_pool, tables), gather(v_pool, tables),
                       pos, scale)
+
+
+def attend_kernel(q, k_pool, v_pool, tables, pos, scale=None):
+    """Block-table attention via the Pallas paged-attention kernel: the
+    block table is walked IN-kernel (scalar-prefetch index maps), so the
+    dense per-slot view is never materialized — same masking semantics
+    as `attend`, online-softmax numerics (float-equal, not bit-equal;
+    tile caps served through `incubate.autotune.lookup_paged_blocks`).
+    Runs in interpret mode off-TPU, so CPU tier-1 can assert exactness
+    against the gather path."""
+    from ..ops.pallas.paged_attention import paged_attention
+    return paged_attention(q, k_pool, v_pool, tables, pos, scale=scale)
+
+
+# Which attend implementation GPTAttention traces for paged caches:
+# "gather" (the bit-exact dense-view oracle) or "kernel" (the in-kernel
+# block-table walk). A module-level flag read at TRACE time: the engines
+# wrap every executable call in `attention_impl(...)` so each engine's
+# executables bake in its configured impl, and the two impls are distinct
+# function objects so the eager op-cache can never replay the wrong one.
+_ATTEND_IMPL = "gather"
+
+
+def current_attention_impl():
+    return _ATTEND_IMPL
+
+
+@contextlib.contextmanager
+def attention_impl(impl):
+    """Scope the paged-attend implementation for code traced inside."""
+    global _ATTEND_IMPL
+    if impl not in ("gather", "kernel"):
+        raise ValueError(f"unknown paged attention impl {impl!r} "
+                         f"(want 'gather' or 'kernel')")
+    prev = _ATTEND_IMPL
+    _ATTEND_IMPL = impl
+    try:
+        yield
+    finally:
+        _ATTEND_IMPL = prev
 
 
 class BlockPool:

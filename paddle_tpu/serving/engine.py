@@ -253,6 +253,14 @@ class GenerationEngine:
         return min(self.config.prefill_buckets[-1], self.config.max_len - 1)
 
     @property
+    def decode_write_tokens(self):
+        """KV positions one decode step writes per slot — 1 for the
+        one-token loop; the speculative engine overrides with its
+        γ+1-token verify window so slot growth provisions the whole
+        write."""
+        return 1
+
+    @property
     def kv_memory_tokens(self):
         """Token capacity of the KV memory this engine reserves — the
         budget figure the load harness equalizes across layouts."""
@@ -276,7 +284,8 @@ class PagedEngineConfig(EngineConfig):
     preempt."""
 
     def __init__(self, block_size=16, num_blocks=None,
-                 enable_prefix_cache=True, **kwargs):
+                 enable_prefix_cache=True, attention_impl="gather",
+                 **kwargs):
         super().__init__(**kwargs)
         self.block_size = int(block_size)
         self.max_blocks_per_slot = -(-self.max_len // self.block_size)
@@ -286,6 +295,13 @@ class PagedEngineConfig(EngineConfig):
             raise ValueError("num_blocks must leave at least one "
                              "allocatable block beyond the garbage block")
         self.enable_prefix_cache = bool(enable_prefix_cache)
+        # "gather" = dense-view oracle; "kernel" = Pallas in-kernel
+        # block-table walk (ops/pallas/paged_attention.py) — validated
+        # here so a typo fails at config time, not mid-trace
+        if attention_impl not in ("gather", "kernel"):
+            raise ValueError(f"attention_impl must be 'gather' or "
+                             f"'kernel', got {attention_impl!r}")
+        self.attention_impl = attention_impl
 
 
 class PagedGenerationEngine(GenerationEngine):
@@ -337,19 +353,28 @@ class PagedGenerationEngine(GenerationEngine):
                     return self.block_pool.alloc(n)
             raise
 
-    def ensure_slot_capacity(self, slot):
-        """Make sure `slot` can absorb its next decode write (the token
-        K/V lands at position pos[slot]). Allocates at most one block;
-        raises BlockAllocError under pressure — the scheduler preempts
-        and retries."""
+    def ensure_slot_capacity(self, slot, tokens=None):
+        """Make sure `slot` can absorb its next decode write (`tokens`
+        K/V entries landing at positions pos[slot]..pos+tokens-1;
+        defaults to the engine's per-step write width). Allocation is
+        all-or-nothing across the needed blocks; raises BlockAllocError
+        under pressure — the scheduler preempts and retries. Positions
+        past max_len need no block (the write scatters them into the
+        garbage block)."""
         slot = int(slot)
         if not self._slot_active[slot]:
             return
-        lb = int(self._pos[slot]) // self.config.block_size
-        if lb >= self.config.max_blocks_per_slot:
-            return                      # at the max_len clamp boundary
-        if self._tables[slot, lb] == blocks.GARBAGE_BLOCK:
-            self._tables[slot, lb] = self._alloc_blocks(1)[0]
+        if tokens is None:
+            tokens = self.decode_write_tokens
+        bs = self.config.block_size
+        first = int(self._pos[slot]) // bs
+        last = (int(self._pos[slot]) + int(tokens) - 1) // bs
+        last = min(last, self.config.max_blocks_per_slot - 1)
+        need = [lb for lb in range(first, last + 1)
+                if self._tables[slot, lb] == blocks.GARBAGE_BLOCK]
+        if need:
+            for lb, b in zip(need, self._alloc_blocks(len(need))):
+                self._tables[slot, lb] = b
 
     def ensure_decode_capacity(self):
         for s in range(self.config.slots):
@@ -458,7 +483,9 @@ class PagedGenerationEngine(GenerationEngine):
         with RecordEvent("serving::prefill", TracerEventType.UserDefined,
                          {"bucket": bucket, "length": plen,
                           "slot": slot, "prefix_hit_tokens": nshared,
-                          "paged": True}):
+                          "paged": True,
+                          "attend": self.config.attention_impl}), \
+                blocks.attention_impl(self.config.attention_impl):
             first, pk, pv, pos = self._prefill[bucket](
                 self._params, [l.k for l in self._pool],
                 [l.v for l in self._pool], jnp.asarray(self._tables),
@@ -489,7 +516,9 @@ class PagedGenerationEngine(GenerationEngine):
         self.ensure_decode_capacity()
         with RecordEvent("serving::decode_step",
                          TracerEventType.UserDefined,
-                         {"slots": self.config.slots, "paged": True}):
+                         {"slots": self.config.slots, "paged": True,
+                          "attend": self.config.attention_impl}), \
+                blocks.attention_impl(self.config.attention_impl):
             tokens = self._last_tokens
             nxt, pk, pv, pos = self._decode(
                 self._params, [l.k for l in self._pool],

@@ -9,7 +9,7 @@ stays one executable across strategy parameters."""
 import jax
 import jax.numpy as jnp
 
-__all__ = ["select_tokens"]
+__all__ = ["select_tokens", "greedy_verify"]
 
 
 def _mask_top_k(logits, k):
@@ -55,3 +55,36 @@ def select_tokens(logits, key=None, strategy="greedy", temperature=1.0,
     if top_p is not None and float(top_p) < 1.0:
         scaled = _mask_top_p(scaled, float(top_p))
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def greedy_verify(logits, window):
+    """The speculative-decode accept/resample rule, greedy case — pure
+    jnp, runs inside the jitted verify executable.
+
+    `window` [S, W] is the verify input [t0, d1..d_{W-1}]: t0 the last
+    committed token, d_i the draft proposals. `logits` [S, W, V] are the
+    target logits over that window — position i's row is the target's
+    distribution for the token FOLLOWING window[i] (causal attention
+    makes it depend only on the committed prefix plus window[:i+1]).
+
+    Greedy accept/resample: draft d_{i+1} is accepted iff it equals the
+    target argmax at position i AND every earlier draft was accepted; at
+    the first mismatch the target's own argmax is emitted instead
+    (the "resample" of the standard rule collapses to argmax under a
+    point-mass target distribution), and a fully-accepted window earns
+    the bonus token from position W-1. The emitted stream is therefore
+    BIT-IDENTICAL to the one-token greedy loop, whatever the draft does
+    — the draft only decides how many loop iterations one verify buys.
+
+    Returns (choices [S, W], n_accepted [S], last [S]): the emitted
+    tokens are choices[s, :n_accepted[s] + 1] (accepted drafts equal the
+    target choices at their positions, so choices doubles as the output
+    buffer), and `last` = choices[s, n_accepted[s]] — correction or
+    bonus — is the next round's t0.
+    """
+    choices = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [S, W]
+    match = (choices[:, :-1] == window[:, 1:]).astype(jnp.int32)
+    accepted = jnp.cumprod(match, axis=1)        # 1 while the run holds
+    n_acc = accepted.sum(axis=1).astype(jnp.int32)               # [S]
+    last = jnp.take_along_axis(choices, n_acc[:, None], axis=1)[:, 0]
+    return choices, n_acc, last

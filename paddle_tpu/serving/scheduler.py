@@ -54,6 +54,8 @@ import json
 import threading
 import time
 
+import numpy as np
+
 from .. import native
 from ..observability import metrics as _metrics
 from ..profiler import RecordEvent, TracerEventType
@@ -110,6 +112,12 @@ _M_SHED = _metrics.counter(
 _M_PREEMPTED = _metrics.counter(
     "serving_preempted_total",
     "Preemptions under allocation pressure (victim requeued or errored)")
+_M_SPEC_PROPOSED = _metrics.counter(
+    "serving_spec_proposed_total",
+    "Draft tokens proposed to the speculative verifier (occupied slots)")
+_M_SPEC_ACCEPTED = _metrics.counter(
+    "serving_spec_accepted_total",
+    "Draft tokens the speculative verifier accepted (occupied slots)")
 
 
 class QueueFullError(RuntimeError):
@@ -158,6 +166,8 @@ class Request:
         self.slot = None
         self.preempted = 0                # times evicted and requeued
         self.prefix_hit = False           # prefill reused cached blocks
+        self.spec_proposed = 0            # draft tokens proposed for us
+        self.spec_accepted = 0            # ... and accepted by verify
         self._exec_prompt = None          # recompute prompt after preempt
         self.first_token_at = None        # TTFT timestamp
         self.finished_at = None
@@ -170,6 +180,15 @@ class Request:
         delivered stream continues where it left off."""
         return self._exec_prompt if self._exec_prompt is not None \
             else self.prompt
+
+    def finished(self, eos_token_id):
+        """THE completion predicate — the single definition shared by
+        retire, prefill-time completion, and the multi-token window
+        append loop, so the stop rule (max_new_tokens / eos) can never
+        drift between the one-token and speculative paths."""
+        return (len(self.tokens) >= self.max_new_tokens
+                or (eos_token_id is not None and bool(self.tokens)
+                    and self.tokens[-1] == eos_token_id))
 
 
 class RequestHandle:
@@ -212,6 +231,16 @@ class RequestHandle:
         """Whether prefill reused shared prefix-cache blocks."""
         return self._req.prefix_hit
 
+    @property
+    def spec_proposed(self):
+        """Draft tokens proposed for this request (speculative engines)."""
+        return self._req.spec_proposed
+
+    @property
+    def spec_accepted(self):
+        """Draft tokens the verifier accepted for this request."""
+        return self._req.spec_accepted
+
     def done(self):
         return self._req.status in (DONE, TIMEOUT, REJECTED, ERROR, SHED)
 
@@ -245,6 +274,8 @@ class Scheduler:
         self._steps = 0
         self._decode_tokens = 0
         self._decode_time_s = 0.0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._completed = []
         self.counts = dict.fromkeys(_COUNTERS, 0)
         self._metrics_f = (open(self.config.metrics_path, "a")
@@ -337,19 +368,43 @@ class Scheduler:
         active = [r for r in self._slots if r is not None]
         if active:
             t0 = self._clock()
+            # a speculative engine advances each slot by a whole verify
+            # window per step; everything else stays a 1-wide window
+            decode_many = getattr(self.engine, "decode_many", None)
             try:
-                tokens = self.engine.decode()
+                if decode_many is not None:
+                    toks, counts = decode_many()
+                else:
+                    toks = np.asarray(self.engine.decode()).reshape(-1, 1)
+                    counts = np.ones((toks.shape[0],), np.int32)
             except Exception as e:                       # noqa: BLE001
                 self._on_decode_failure(e)
             else:
                 dt = self._clock() - t0
                 self._decode_time_s += dt
                 _M_DECODE_SECONDS.observe(dt)
+                proposed = toks.shape[1] - 1     # γ for spec, 0 otherwise
+                eos = self.engine.config.eos_token_id
                 for slot, req in enumerate(self._slots):
-                    if req is not None:
-                        req.tokens.append(int(tokens[slot]))
+                    if req is None:
+                        continue
+                    if proposed:
+                        accepted = int(counts[slot]) - 1
+                        req.spec_proposed += proposed
+                        req.spec_accepted += accepted
+                        self._spec_proposed += proposed
+                        self._spec_accepted += accepted
+                        _M_SPEC_PROPOSED.inc(proposed)
+                        _M_SPEC_ACCEPTED.inc(accepted)
+                    # append the slot's emitted run, truncating where the
+                    # one-token loop would have stopped (eos / max_new) —
+                    # the delivered stream stays bit-identical to it
+                    for j in range(int(counts[slot])):
+                        req.tokens.append(int(toks[slot, j]))
                         self._decode_tokens += 1
                         self._count("serving.tokens")
+                        if req.finished(eos):
+                            break
                 # a healthy step is the reprobe proof: reopen every
                 # quarantined slot for the next refill
                 self._quarantined.clear()
@@ -544,10 +599,7 @@ class Scheduler:
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
-            finished = (
-                len(req.tokens) >= req.max_new_tokens
-                or (eos is not None and req.tokens and req.tokens[-1] == eos)
-            )
+            finished = req.finished(eos)
             timed_out = req.deadline is not None and now > req.deadline
             if finished or timed_out:
                 with RecordEvent("serving::retire",
@@ -625,9 +677,7 @@ class Scheduler:
         req.tokens.append(first)
         self._decode_tokens += 1
         self._count("serving.tokens")
-        eos = self.engine.config.eos_token_id
-        if len(req.tokens) >= req.max_new_tokens or \
-                (eos is not None and first == eos):
+        if req.finished(self.engine.config.eos_token_id):
             self.engine.reset_slot(slot)
             self._finish(req, DONE, "serving.completed")
         else:
@@ -673,6 +723,11 @@ class Scheduler:
             "ttft_s_mean": sum(ttfts) / len(ttfts) if ttfts else None,
             "requests": dict(self.counts),
         }
+        if self._spec_proposed:
+            out["spec_proposed"] = self._spec_proposed
+            out["spec_accepted"] = self._spec_accepted
+            out["spec_acceptance_rate"] = (
+                self._spec_accepted / self._spec_proposed)
         pool = getattr(self.engine, "block_pool", None)
         if pool is not None:
             out["blocks_in_use"] = pool.in_use
@@ -700,6 +755,8 @@ class Scheduler:
             "prompt_len": len(req.prompt), "tokens": len(req.tokens),
             "priority": req.priority, "preempted": req.preempted,
             "prefix_hit": req.prefix_hit,
+            "spec_proposed": req.spec_proposed,
+            "spec_accepted": req.spec_accepted,
             "ttft_s": (req.first_token_at - req.submitted_at
                        if req.first_token_at else None),
             "decode_s": decode_s}) + "\n")

@@ -67,7 +67,13 @@ class GPTAttention(Layer):
             from ...serving import blocks as _blk
             k_pool = apply_op(_blk.write, cache.k, k, tables, pos)
             v_pool = apply_op(_blk.write, cache.v, v, tables, pos)
-            out = apply_op(_blk.attend, q, k_pool, v_pool, tables, pos)
+            # trace-time dispatch (serving.blocks.attention_impl):
+            # "gather" rebuilds the dense view (bit-exact oracle),
+            # "kernel" walks the block table inside the Pallas kernel —
+            # distinct function objects, so executables can never mix
+            attend = _blk.attend_kernel \
+                if _blk.current_attention_impl() == "kernel" else _blk.attend
+            out = apply_op(attend, q, k_pool, v_pool, tables, pos)
             out = out.reshape([B, S, H])
             return self.out_proj(out), _blk.PagedLayerKV(k_pool, v_pool)
         if cache is not None:

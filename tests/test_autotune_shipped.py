@@ -117,5 +117,12 @@ def test_shipped_table_is_committed_or_reported():
     for key, blocks in data.items():
         parsed = json.loads(key)          # JSON-list keys, like the cache
         assert isinstance(parsed, list) and len(parsed) in (5, 6)
+        if parsed[0] == "paged":
+            # paged-attention tile CAPS ("paged", backend, H, L, D, bs):
+            # positive ints, clamped to divisors at call time — no
+            # 8-alignment contract (head_tile counts heads, not lanes)
+            qt, ht = blocks
+            assert qt > 0 and ht > 0
+            continue
         bq, bkv = blocks
         assert bq > 0 and bkv > 0 and bq % 8 == 0 and bkv % 8 == 0

@@ -325,6 +325,42 @@ def test_flight_recorder_watchdog_fires_and_dumps(tmp_path):
         fr.disable()
 
 
+def test_flight_recorder_dump_retention_is_bounded(tmp_path):
+    """ISSUE 7 hygiene: the postmortem directory can never grow without
+    bound — each dump sweeps down to the newest keep_dumps artifacts
+    (plus stale .tmp torn by a crash mid-write), and the dump that
+    triggered the sweep always survives it."""
+    fr = flight_recorder.FlightRecorder(capacity=4, dir=str(tmp_path),
+                                        keep_dumps=3)
+    last = None
+    for i in range(7):
+        last = fr.dump(f"retention test {i}")
+        time.sleep(0.01)            # distinct mtimes for the sort
+    dumps = [f for f in os.listdir(str(tmp_path)) if f.endswith(".json")]
+    assert len(dumps) == 3
+    assert os.path.basename(last) in dumps
+    # a STALE torn .tmp from a crashed writer is swept on the next dump;
+    # a fresh one (possibly another process's in-flight dump) survives
+    stale = os.path.join(str(tmp_path), "postmortem_1_1.json.tmp")
+    open(stale, "w").close()
+    os.utime(stale, (time.time() - 120, time.time() - 120))
+    fresh = os.path.join(str(tmp_path), "postmortem_2_2.json.tmp")
+    open(fresh, "w").close()
+    fr.dump("after torn tmp")
+    names = os.listdir(str(tmp_path))
+    assert not os.path.exists(stale)
+    assert os.path.exists(fresh)
+    assert len([n for n in names if n.endswith(".json")]) == 3
+    # keep_dumps=0 disables the sweep entirely
+    fr0 = flight_recorder.FlightRecorder(capacity=4,
+                                         dir=str(tmp_path / "unbounded"),
+                                         keep_dumps=0)
+    for i in range(4):
+        fr0.dump(f"u {i}")
+        time.sleep(0.01)
+    assert len(os.listdir(str(tmp_path / "unbounded"))) == 4
+
+
 def test_flight_recorder_standalone_sigterm_dump(tmp_path):
     """The zero-evidence guarantee must hold even when paddle_tpu/jax
     never imported: load flight_recorder.py STANDALONE in a subprocess,

@@ -1,0 +1,260 @@
+"""Pallas paged-attention kernel (ISSUE 7 tentpole a): the in-kernel
+block-table walk must be exact against the gather path in interpret
+mode, hold the PR 6 NaN regressions without the dense view, serve its
+tile caps through the shipped autotune table with the
+fall-back-don't-raise contract, and drive the paged engine token-exactly
+behind the `attention_impl="kernel"` flag with compile counts intact.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.incubate import autotune
+from paddle_tpu.ops.pallas.paged_attention import (
+    _largest_divisor_leq, paged_attention)
+from paddle_tpu.serving import GenerationEngine, PagedGenerationEngine
+from paddle_tpu.serving import blocks as blk
+from paddle_tpu.text.models import gpt_tiny
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    m = gpt_tiny()
+    m.eval()
+    return m
+
+
+def _paged_state(seed, S, bs, nb, N, H=4, D=8, poison_garbage=False):
+    """A valid paged KV state: every slot's table is filled with real
+    blocks front-to-garbage-back, so any pos within the allocated run is
+    backed (the engine invariant: blocks are allocated+written before
+    they become visible)."""
+    rng = np.random.RandomState(seed)
+    kp = rng.randn(N, bs, H, D).astype(np.float32)
+    vp = rng.randn(N, bs, H, D).astype(np.float32)
+    if poison_garbage:
+        kp[blk.GARBAGE_BLOCK] = np.nan
+        vp[blk.GARBAGE_BLOCK] = np.inf
+    # distinct physical blocks 1..N-1 dealt to slots round-robin
+    perm = rng.permutation(np.arange(1, N))
+    tables = np.zeros((S, nb), np.int32)
+    flat = iter(perm)
+    for s in range(S):
+        for j in range(nb):
+            tables[s, j] = next(flat)
+    return jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(tables)
+
+
+def _assert_matches_gather(q, kp, vp, tables, pos, **kw):
+    want = np.asarray(blk.attend(q, kp, vp, tables, pos))
+    got = np.asarray(paged_attention(q, kp, vp, tables, pos, **kw))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------- kernel exactness
+def test_kernel_matches_gather_across_block_boundaries():
+    """Decode shape (T=1) at positions crossing every boundary of the
+    block ladder — including pos exactly at a block edge and one short
+    of it."""
+    bs, nb = 4, 6
+    S = 7
+    kp, vp, tables = _paged_state(0, S, bs, nb, N=S * nb + 1)
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(S, 1, 4, 8).astype(np.float32))
+    # 0, edge-1, edge, edge+1, mid, last-1, last
+    pos = jnp.asarray([0, 3, 4, 5, 13, 22, 23], jnp.int32)
+    _assert_matches_gather(q, kp, vp, tables, pos)
+
+
+def test_kernel_matches_gather_prefill_shapes():
+    """Multi-token windows (prefill buckets / spec verify windows) with
+    ragged per-slot occupancy."""
+    bs, nb = 4, 8
+    S = 3
+    kp, vp, tables = _paged_state(2, S, bs, nb, N=S * nb + 1)
+    rng = np.random.RandomState(3)
+    for T in (2, 8, 16):
+        q = jnp.asarray(rng.randn(S, T, 4, 8).astype(np.float32))
+        pos = jnp.asarray([0, 5, nb * bs - T], jnp.int32)   # ragged
+        _assert_matches_gather(q, kp, vp, tables, pos)
+
+
+def test_kernel_poisoned_garbage_block_stays_finite():
+    """The PR 6 NaN regression, in-kernel: the garbage block holds
+    inf/NaN scatter junk; masked probabilities and the never-visible V
+    rows must keep every output finite AND equal to the gather path."""
+    bs, nb = 4, 4
+    S = 2
+    kp, vp, tables = _paged_state(4, S, bs, nb, N=S * nb + 1,
+                                  poison_garbage=True)
+    # tail table entries point at the (poisoned) garbage block — the
+    # unallocated-logical-block layout prefill actually produces
+    tables = np.asarray(tables).copy()
+    tables[0, 2:] = blk.GARBAGE_BLOCK
+    tables[1, 1:] = blk.GARBAGE_BLOCK
+    tables = jnp.asarray(tables)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(S, 2, 4, 8).astype(np.float32))
+    pos = jnp.asarray([6, 2], jnp.int32)     # writes stay inside owned blocks
+    _assert_matches_gather(q, kp, vp, tables, pos)
+
+
+def test_kernel_all_masked_rows_emit_zeros():
+    """A slot with no visible key (pos<0 models a hole) emits exact
+    zeros even over a fully-poisoned pool — the l==0 guard."""
+    bs, nb = 4, 2
+    kp, vp, tables = _paged_state(6, 1, bs, nb, N=3, poison_garbage=True)
+    kp = jnp.asarray(np.full(kp.shape, np.nan, np.float32))
+    vp = jnp.asarray(np.full(vp.shape, np.nan, np.float32))
+    q = jnp.asarray(np.random.RandomState(7).randn(1, 1, 4, 8)
+                    .astype(np.float32))
+    out = np.asarray(paged_attention(q, kp, vp, tables,
+                                     jnp.asarray([-1], jnp.int32)))
+    assert (out == 0.0).all()
+
+
+def test_kernel_tiling_caps_do_not_change_results():
+    """Every (q_tile, head_tile) cap combination — divisor or not — is
+    clamped to a valid tile and yields the same output."""
+    bs, nb = 4, 4
+    S = 2
+    kp, vp, tables = _paged_state(8, S, bs, nb, N=S * nb + 1)
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(S, 6, 4, 8).astype(np.float32))
+    pos = jnp.asarray([1, 9], jnp.int32)
+    want = np.asarray(paged_attention(q, kp, vp, tables, pos,
+                                      q_tile=6, head_tile=4))
+    for qt, ht in ((1, 1), (2, 2), (3, 4), (4, 3), (100, 100)):
+        got = np.asarray(paged_attention(q, kp, vp, tables, pos,
+                                         q_tile=qt, head_tile=ht))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_largest_divisor_clamp():
+    assert _largest_divisor_leq(12, 4) == 4
+    assert _largest_divisor_leq(12, 5) == 4
+    assert _largest_divisor_leq(7, 4) == 1
+    assert _largest_divisor_leq(1, 128) == 1
+    assert _largest_divisor_leq(192, 128) == 96
+
+
+# --------------------------------------------------- autotune integration
+def test_shipped_table_serves_paged_entries(tmp_path, monkeypatch):
+    """commit_shipped_table(kernel='paged') round-trips through
+    lookup_paged_blocks; stale/poisoned entries FALL BACK to None
+    instead of raising (the PR 6 contract, extended to this kernel);
+    flash entries in the same file are untouched."""
+    import jax
+    path = str(tmp_path / "tuned.json")
+    autotune.commit_shipped_table({(4, 64, 8, 4): (128, 2)},
+                                  backend=jax.default_backend(),
+                                  kernel="paged", path=path)
+    autotune.commit_shipped_table({(4, 64, 8, True): (32, 32)},
+                                  backend=jax.default_backend(),
+                                  kernel="flash", path=path)
+    monkeypatch.setattr(autotune, "_SHIPPED_PATH", path)
+    monkeypatch.setattr(autotune, "_disk_loaded", False)
+    monkeypatch.setattr(autotune, "_disk_cache", {})
+    monkeypatch.setattr(autotune, "_block_cache", {})
+    assert autotune.lookup_paged_blocks(4, 64, 8, 4) == (128, 2)
+    assert autotune.lookup_flash_blocks(1, 4, 64, 8, True) == (32, 32)
+    assert autotune.lookup_paged_blocks(4, 128, 8, 4) is None  # other geom
+    # hand-rot the paged entry: lookup falls back, never raises
+    raw = json.load(open(path))
+    for k in list(raw):
+        if json.loads(k)[0] == "paged":
+            raw[k] = [0, -3]
+    json.dump(raw, open(path, "w"))
+    monkeypatch.setattr(autotune, "_disk_loaded", False)
+    monkeypatch.setattr(autotune, "_disk_cache", {})
+    assert autotune.lookup_paged_blocks(4, 64, 8, 4) is None
+
+
+def test_commit_rejects_nonsense_paged_entries(tmp_path):
+    with pytest.raises(ValueError, match="positive"):
+        autotune.commit_shipped_table({(4, 64, 8, 4): (0, 2)},
+                                      kernel="paged",
+                                      path=str(tmp_path / "t.json"))
+    with pytest.raises(ValueError, match="multiple"):
+        autotune.commit_shipped_table({(4, 63, 8, 4): (8, 2)},
+                                      kernel="paged",
+                                      path=str(tmp_path / "t.json"))
+
+
+def test_shipped_file_carries_both_kernels():
+    """The tree's shipped table serves the flash entries it always had
+    AND the new paged tile caps."""
+    cache = autotune._read_cache_file(autotune._SHIPPED_PATH)
+    assert any(k[0] == "paged" for k in cache)
+    assert any(k[0] != "paged" for k in cache)    # untagged flash entries
+    assert cache[("tpu", 12, 1024, 64, True)] == (512, 512)
+    assert cache[("paged", "tpu", 12, 1024, 64, 16)] == (128, 4)
+
+
+# ------------------------------------------------- engine behind the flag
+def test_kernel_engine_token_exact_vs_dense(tiny):
+    """The acceptance bar: attention_impl='kernel' reproduces the dense
+    engine's exact greedy token streams across block-boundary prompt
+    lengths, and still compiles once per executable."""
+    lengths = (1, 7, 8, 9, 17, 31)
+    prompts = [np.random.RandomState(20 + i).randint(0, 1000, n)
+               for i, n in enumerate(lengths)]
+    for i in range(0, len(lengths), 2):
+        pair = prompts[i:i + 2]
+        dense = GenerationEngine(tiny, slots=2, max_len=64)
+        kern = PagedGenerationEngine(tiny, slots=2, max_len=64,
+                                     block_size=8,
+                                     attention_impl="kernel")
+        rows_d = [[dense.prefill(s, p)] for s, p in enumerate(pair)]
+        rows_k = [[kern.prefill(s, p)] for s, p in enumerate(pair)]
+        for _ in range(5):
+            sd, sk = dense.decode(), kern.decode()
+            for s in range(2):
+                rows_d[s].append(int(sd[s]))
+                rows_k[s].append(int(sk[s]))
+        assert rows_k == rows_d, \
+            f"kernel diverged at lengths {[len(p) for p in pair]}"
+        assert kern.trace_counts["decode"] == 1
+
+
+def test_kernel_engine_ragged_occupancy_and_refill(tiny):
+    """Mid-flight retire + refill at a different length (ragged slot
+    occupancy) stays exact under the kernel impl — the scenario where a
+    stale dense view would betray a gather bug."""
+    kern = PagedGenerationEngine(tiny, slots=2, max_len=64, block_size=8,
+                                 attention_impl="kernel")
+    ref = PagedGenerationEngine(tiny, slots=2, max_len=64, block_size=8)
+    for eng in (kern, ref):
+        eng.prefill(0, _p(0, 9))
+        eng.prefill(1, _p(1, 21))
+        for _ in range(3):
+            eng.decode()
+        eng.reset_slot(0)
+        eng.prefill(0, _p(2, 5))
+    rows_k, rows_r = [[], []], [[], []]
+    for _ in range(4):
+        sk, sr = kern.decode(), ref.decode()
+        for s in range(2):
+            rows_k[s].append(int(sk[s]))
+            rows_r[s].append(int(sr[s]))
+    assert rows_k == rows_r
+    assert kern.trace_counts["decode"] == 1
+
+
+def _p(seed, n):
+    return np.random.RandomState(seed).randint(0, 1000, n)
+
+
+def test_config_rejects_unknown_impl(tiny):
+    with pytest.raises(ValueError, match="attention_impl"):
+        PagedGenerationEngine(tiny, slots=1, max_len=32,
+                              attention_impl="fused")

@@ -185,6 +185,37 @@ def test_metrics_compare_flags_shed_preempt_and_prefix_rate(tmp_path):
                    metrics_report.compare_counters(a, c))
 
 
+def test_metrics_compare_flags_spec_acceptance_rate_drop(tmp_path):
+    """ISSUE 7 gate: a spec-decode acceptance-RATE drop is failure-class
+    even when the absolute accepted count grew with traffic — and a
+    traffic-growth run with the rate intact passes."""
+    a = _snapshot_with({"serving_spec_accepted_total": 75,
+                        "serving_spec_proposed_total": 100,
+                        "serving_tokens_total": 500})
+    b = _snapshot_with({"serving_spec_accepted_total": 90,   # grew...
+                        "serving_spec_proposed_total": 300,  # rate 0.30
+                        "serving_tokens_total": 500})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_spec_acceptance_rate") == "hit rate dropped"
+    # the CLI gate exits nonzero on the drop
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_spec_acceptance_rate" in bad.stdout
+    # pure growth at the same rate: clean
+    c = _snapshot_with({"serving_spec_accepted_total": 750,
+                        "serving_spec_proposed_total": 1000,
+                        "serving_tokens_total": 5000})
+    assert not any(w == "hit rate dropped" for *_, w in
+                   metrics_report.compare_counters(a, c))
+
+
 def test_validate_record_catches_rot():
     good = {"schema": perf_report.SCHEMA, "step": 0, "step_ms": 1.0,
             "phases": {"Forward": 1.0}, "ops": [], "num_samples": None,

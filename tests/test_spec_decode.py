@@ -1,0 +1,290 @@
+"""Speculative multi-token decode (ISSUE 7 tentpole b): greedy output
+must be BIT-IDENTICAL to the one-token loop — through the engine, the
+scheduler, mid-stream preemption, and an eos landing inside an accepted
+window — with the compile count bounded (one draft decode executable,
+one fixed-shape verify executable, prefills per bucket) and acceptance
+telemetry flowing through the request records and metrics registry.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (
+    PagedGenerationEngine, Scheduler, SpecDecodeConfig, SpeculativeEngine,
+    truncated_draft,
+)
+from paddle_tpu.serving import sampling
+from paddle_tpu.text.models import GPTForGeneration, gpt_tiny
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+import load_harness  # noqa: E402
+import serve_report  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    m = gpt_tiny()
+    m.eval()
+    return m
+
+
+def _prompt(seed, n, vocab=1000):
+    return np.random.RandomState(seed).randint(0, vocab, n)
+
+
+def _reference_tokens(model, prompt, max_new, eos=None):
+    gen = GPTForGeneration(model)
+    ids = paddle.to_tensor(np.asarray(prompt)[None, :].astype("int64"))
+    out, lengths = gen.generate(ids, max_new_tokens=max_new,
+                                eos_token_id=eos)
+    return list(out.numpy()[0][:int(lengths.numpy()[0])])
+
+
+# ---------------------------------------------------------- verify rule
+def test_greedy_verify_rule():
+    """Unit contract of the accept/resample rule: n_acc = length of the
+    matching run, emitted = choices[:n_acc+1], last = correction or
+    bonus."""
+    V = 10
+    # logits whose argmax per position is [3, 5, 7, 2]
+    argmaxes = np.asarray([[3, 5, 7, 2]])
+    logits = np.zeros((1, 4, V), np.float32)
+    for i, a in enumerate(argmaxes[0]):
+        logits[0, i, a] = 9.0
+    # window [t0, d1, d2, d3] with drafts [3, 5, 9]: d1,d2 accepted, d3
+    # rejected -> correction from position 2 (choice 7)
+    window = np.asarray([[1, 3, 5, 9]], np.int32)
+    choices, n_acc, last = sampling.greedy_verify(
+        jnp.asarray(logits), jnp.asarray(window))
+    assert list(np.asarray(choices)[0]) == [3, 5, 7, 2]
+    assert int(n_acc[0]) == 2 and int(last[0]) == 7
+    # full accept -> bonus token from the final position
+    window = np.asarray([[1, 3, 5, 7]], np.int32)
+    _, n_acc, last = sampling.greedy_verify(
+        jnp.asarray(logits), jnp.asarray(window))
+    assert int(n_acc[0]) == 3 and int(last[0]) == 2
+    # first draft wrong -> nothing accepted, correction is position 0
+    window = np.asarray([[1, 4, 5, 7]], np.int32)
+    _, n_acc, last = sampling.greedy_verify(
+        jnp.asarray(logits), jnp.asarray(window))
+    assert int(n_acc[0]) == 0 and int(last[0]) == 3
+
+
+# ------------------------------------------------------- engine parity
+def _spec_stream(engine, slot_prompts, n_tokens):
+    rows = [[engine.prefill(s, p)] for s, p in enumerate(slot_prompts)]
+    while min(len(r) for r in rows) < n_tokens:
+        toks, n_emit = engine.decode_many()
+        for s in range(len(slot_prompts)):
+            for j in range(int(n_emit[s])):
+                rows[s].append(int(toks[s, j]))
+    return [r[:n_tokens] for r in rows]
+
+
+@pytest.mark.parametrize("gamma", (1, 3, 5))
+def test_spec_stream_bit_identical_to_one_token_loop(tiny, gamma):
+    """The acceptance bar, at several window widths: every emitted token
+    equals the one-token paged loop's (== the Layer-level oracle's)."""
+    prompts = [_prompt(0, 9), _prompt(1, 17)]
+    plain = PagedGenerationEngine(tiny, slots=2, max_len=64, block_size=8)
+    rows_p = [[plain.prefill(s, p)] for s, p in enumerate(prompts)]
+    for _ in range(11):
+        st = plain.decode()
+        for s in range(2):
+            rows_p[s].append(int(st[s]))
+    spec = SpeculativeEngine(tiny, slots=2, max_len=64, block_size=8,
+                             gamma=gamma, draft_layers=1)
+    rows_s = _spec_stream(spec, prompts, 12)
+    assert rows_s == rows_p
+    for s, p in enumerate(prompts):
+        assert rows_s[s] == _reference_tokens(tiny, p, 12)
+    # compile discipline: ONE draft decode, ONE verify, no one-token path
+    assert spec.trace_counts["spec_verify"] == 1
+    assert spec.trace_counts["draft_decode"] == 1
+    assert spec.trace_counts["decode"] == 0
+    assert list(spec.trace_counts["draft_prefill"]) == [32]
+
+
+def test_spec_with_kernel_attention_impl(tiny):
+    """Both tentpoles composed: the verify window runs through the
+    Pallas in-kernel block-table walk and the stream stays exact."""
+    prompts = [_prompt(2, 7), _prompt(3, 12)]
+    spec = SpeculativeEngine(tiny, slots=2, max_len=64, block_size=8,
+                             gamma=3, attention_impl="kernel")
+    rows = _spec_stream(spec, prompts, 8)
+    for s, p in enumerate(prompts):
+        assert rows[s] == _reference_tokens(tiny, p, 8)
+
+
+def test_spec_with_distinct_draft_model(tiny):
+    """A separately-built draft from the same artifact family (same
+    vocab, fewer layers, its own weights) — correctness must not depend
+    on the draft's quality, only the acceptance rate may."""
+    from paddle_tpu.text.models import GPT
+    import dataclasses
+    draft = GPT(dataclasses.replace(tiny.cfg, num_layers=1))
+    draft.eval()                              # random weights: bad draft
+    spec = SpeculativeEngine(tiny, slots=1, max_len=64, block_size=8,
+                             gamma=4, draft=draft)
+    rows = _spec_stream(spec, [_prompt(4, 10)], 9)
+    assert rows[0] == _reference_tokens(tiny, _prompt(4, 10), 9)
+
+
+def test_truncated_draft_shares_target_arrays(tiny):
+    draft = truncated_draft(tiny, 1)
+    assert draft.cfg.num_layers == 1
+    sd, st = draft.state_dict(), tiny.state_dict()
+    assert sd["wte.weight"]._data is st["wte.weight"]._data
+    assert sd["blocks.0.attn.qkv.weight"]._data \
+        is st["blocks.0.attn.qkv.weight"]._data
+    with pytest.raises(ValueError, match="draft_layers"):
+        truncated_draft(tiny, 99)
+
+
+def test_spec_config_validation(tiny):
+    with pytest.raises(ValueError, match="greedy"):
+        SpecDecodeConfig(decode_strategy="sampling")
+    with pytest.raises(ValueError, match="gamma"):
+        SpecDecodeConfig(gamma=0)
+    with pytest.raises(ValueError, match="vocabulary"):
+        from paddle_tpu.text.models import GPT, GPTConfig
+        alien = GPT(GPTConfig(hidden_size=64, num_layers=1, num_heads=2,
+                              vocab_size=77, max_position_embeddings=64))
+        SpeculativeEngine(tiny, slots=1, max_len=32, draft=alien)
+
+
+def test_verify_window_grows_blocks_lazily(tiny):
+    """A gamma+1 window crossing several block boundaries in one step:
+    ensure_slot_capacity provisions every needed block up front
+    (decode_write_tokens wide), and the stream stays exact."""
+    spec = SpeculativeEngine(tiny, slots=1, max_len=64, block_size=2,
+                             gamma=5, draft_layers=1)
+    assert spec.decode_write_tokens == 6     # window == gamma+1
+    rows = _spec_stream(spec, [_prompt(5, 3)], 14)
+    assert rows[0] == _reference_tokens(tiny, _prompt(5, 3), 14)
+
+
+# ------------------------------------------------- scheduler integration
+def test_scheduler_spec_streams_exact_with_preemption(tiny):
+    """Mid-stream preemption under an oversubscribed pool: every request
+    still completes DONE with its exact greedy stream (recompute restart
+    replays through prefill, draft included), and no blocks leak."""
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 1000, 6) for _ in range(4)]
+    eng = SpeculativeEngine(tiny, slots=3, max_len=32, block_size=4,
+                            num_blocks=8, enable_prefix_cache=False,
+                            gamma=3)
+    sched = Scheduler(eng, max_queue=16)
+    hs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+    sched.run_until_idle()
+    assert sched.counts["serving.preempted"] > 0
+    for h, p in zip(hs, prompts):
+        assert h.status == "DONE", (h.status, h.error)
+        assert h.tokens == _reference_tokens(tiny, p, 6)
+        assert h.spec_proposed > 0
+    assert eng.block_pool.in_use == 0
+
+
+def test_eos_inside_accepted_window_truncates_exactly(tiny):
+    """An eos accepted mid-window must end the stream exactly where the
+    one-token loop would — no trailing window tokens leak out."""
+    prompt = _prompt(7, 6)
+    base = _reference_tokens(tiny, prompt, 8)
+    eos = base[3]                    # fourth generated token becomes eos
+    want = _reference_tokens(tiny, prompt, 8, eos=eos)
+    assert len(want) < len(base)     # the eos really truncates
+    eng = SpeculativeEngine(tiny, slots=1, max_len=64, block_size=8,
+                            gamma=4, eos_token_id=eos)
+    sched = Scheduler(eng, max_queue=4)
+    h = sched.submit(prompt, max_new_tokens=8)
+    sched.run_until_idle()
+    assert h.status == "DONE"
+    assert h.tokens == want
+
+
+def test_spec_fields_flow_to_serve_report_and_registry(tiny, tmp_path):
+    """Per-request spec_proposed/spec_accepted ride the JSONL (schema-
+    validated), the summary reports the acceptance rate, and the
+    registry counters tick."""
+    from paddle_tpu.observability import metrics as _metrics
+    metrics = str(tmp_path / "serve_metrics.jsonl")
+    eng = SpeculativeEngine(tiny, slots=2, max_len=64, block_size=8,
+                            gamma=3)
+    sched = Scheduler(eng, max_queue=8, metrics_path=metrics)
+    hs = [sched.submit(_prompt(i, 8), max_new_tokens=6) for i in range(2)]
+    sched.drain()
+    assert all(h.status == "DONE" for h in hs)
+    records = serve_report.load(metrics)
+    assert serve_report.validate_records(records) == []
+    summary = serve_report.summarize(records)
+    assert summary["spec_proposed"] > 0
+    assert 0.0 <= summary["spec_acceptance_rate"] <= 1.0
+    assert "spec-decode acceptance rate" in serve_report.render(summary)
+    m = sched.metrics()
+    assert m["spec_proposed"] == summary["spec_proposed"]
+    snap = {s["name"]: s for s in ({"name": mm["name"]}
+            for mm in _metrics.registry().snapshot()["metrics"])}
+    assert "serving_spec_proposed_total" in snap
+    assert "serving_spec_accepted_total" in snap
+    assert "serving_spec_draft_seconds" in snap
+    assert "serving_spec_verify_seconds" in snap
+
+
+def test_one_token_engines_write_zero_spec_fields(tiny, tmp_path):
+    """The serve_report schema holds for non-speculative engines too:
+    spec fields present and zero."""
+    metrics = str(tmp_path / "m.jsonl")
+    eng = PagedGenerationEngine(tiny, slots=1, max_len=32, block_size=8)
+    sched = Scheduler(eng, max_queue=4, metrics_path=metrics)
+    h = sched.submit(_prompt(0, 4), max_new_tokens=2)
+    sched.drain()
+    assert h.status == "DONE" and h.spec_proposed == 0
+    records = serve_report.load(metrics)
+    assert serve_report.validate_records(records) == []
+    reqs = [r for r in records if r["kind"] == "request"]
+    assert all(r["spec_proposed"] == 0 and r["spec_accepted"] == 0
+               for r in reqs)
+    assert serve_report.summarize(records)["spec_acceptance_rate"] is None
+
+
+def test_serve_report_accepts_pre_spec_records():
+    """Files written before the spec fields landed (PR 3-6 artifacts)
+    must still validate and summarize — absent spec fields read as 0."""
+    old = [{"kind": "request", "request_id": 1, "status": "DONE",
+            "prompt_len": 4, "tokens": 3, "priority": 1, "preempted": 0,
+            "prefix_hit": False, "ttft_s": 0.1, "decode_s": 0.2}]
+    assert serve_report.validate_records(old) == []
+    summary = serve_report.summarize(old)
+    assert summary["spec_proposed"] == 0
+    assert summary["spec_acceptance_rate"] is None
+
+
+# --------------------------------------------------- load-harness arm
+def test_load_harness_spec_arm(tiny):
+    """The harness's spec arm completes the same deterministic trace at
+    the same KV budget as paged, reports an acceptance rate, and keeps
+    the compile counts bounded."""
+    traffic = load_harness.TrafficConfig(
+        users=4, requests=8, rate_rps=500.0, prefix_pool=2, prefix_len=16,
+        suffix_min=2, suffix_max=6, max_new_tokens=4, seed=0)
+    paged = load_harness.run_harness(
+        tiny, "paged", traffic, slots=4, max_len=64, block_size=8,
+        num_blocks=24, virtual_step_s=0.05)
+    spec = load_harness.run_harness(
+        tiny, "spec", traffic, slots=4, max_len=64, block_size=8,
+        num_blocks=24, virtual_step_s=0.05, gamma=3)
+    assert spec["kv_memory_tokens"] == paged["kv_memory_tokens"]
+    assert spec["by_status"] == {"DONE": 8}
+    assert spec["spec_proposed"] > 0
+    assert 0.0 <= spec["spec_acceptance_rate"] <= 1.0
+    assert spec["trace_counts"]["spec_verify"] == 1
+    assert spec["trace_counts"]["draft_decode"] == 1
+    assert spec["trace_counts"]["decode"] == 0
+    assert spec["ttft_p50_s"] is not None
+    assert spec["ttft_p99_s"] >= spec["ttft_p50_s"]

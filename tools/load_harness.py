@@ -9,6 +9,9 @@ either KV layout:
 
   dense  GenerationEngine        (one max_len reservation per slot)
   paged  PagedGenerationEngine   (block pool + prefix cache + preemption)
+  spec   SpeculativeEngine       (paged + speculative multi-token decode:
+                                  draft proposals, one verify forward per
+                                  round, greedy-bit-identical output)
 
 The replay reports p50/p99 TTFT, decode tokens/sec, peak concurrency,
 shed/preempt/reject tallies and the prefix-cache hit rate; the same
@@ -201,22 +204,33 @@ def _export_registry(summary):
 
 
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
-                 prefix_cache=True):
-    """A serving engine of either KV layout over `model`."""
-    from paddle_tpu.serving import GenerationEngine, PagedGenerationEngine
+                 prefix_cache=True, gamma=3, draft_layers=1,
+                 attention_impl="gather"):
+    """A serving engine of any KV/decode layout over `model`."""
+    from paddle_tpu.serving import (GenerationEngine, PagedGenerationEngine,
+                                    SpeculativeEngine)
     if kind == "dense":
         return GenerationEngine(model, slots=slots, max_len=max_len)
     if kind == "paged":
         return PagedGenerationEngine(
             model, slots=slots, max_len=max_len, block_size=block_size,
-            num_blocks=num_blocks, enable_prefix_cache=prefix_cache)
-    raise ValueError(f"unknown engine kind {kind!r} (want dense|paged)")
+            num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
+            attention_impl=attention_impl)
+    if kind == "spec":
+        return SpeculativeEngine(
+            model, slots=slots, max_len=max_len, block_size=block_size,
+            num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
+            attention_impl=attention_impl, gamma=gamma,
+            draft_layers=draft_layers)
+    raise ValueError(f"unknown engine kind {kind!r} "
+                     f"(want dense|paged|spec)")
 
 
 def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 num_blocks=None, prefix_cache=True, max_queue=256,
                 shed_watermark=None, virtual_step_s=None,
-                metrics_out=None):
+                metrics_out=None, gamma=3, draft_layers=1,
+                attention_impl="gather"):
     """Build engine+scheduler, replay `traffic`, return the summary
     (annotated with the engine's KV budget and compile counters)."""
     from paddle_tpu.observability import metrics as _metrics
@@ -224,7 +238,9 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
 
     engine = build_engine(model, kind, slots, max_len,
                           block_size=block_size, num_blocks=num_blocks,
-                          prefix_cache=prefix_cache)
+                          prefix_cache=prefix_cache, gamma=gamma,
+                          draft_layers=draft_layers,
+                          attention_impl=attention_impl)
     vclock = VirtualClock() if virtual_step_s is not None else None
     sched = Scheduler(engine, max_queue=max_queue,
                       shed_watermark=shed_watermark,
@@ -238,12 +254,18 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     summary["kv_memory_tokens"] = engine.kv_memory_tokens
     summary["slots"] = engine.slots
     summary["trace_counts"] = {
-        "decode": engine.trace_counts["decode"],
-        "prefill": dict(engine.trace_counts["prefill"])}
-    if kind == "paged":
+        k: (dict(v) if isinstance(v, dict) else v)
+        for k, v in engine.trace_counts.items()}
+    if kind in ("paged", "spec"):
         summary["blocks_total"] = engine.block_pool.capacity
         pc = engine.prefix_cache
         summary["prefix_cache_blocks"] = len(pc) if pc is not None else 0
+    if kind == "spec":
+        m = sched.metrics()
+        summary["spec_proposed"] = m.get("spec_proposed", 0)
+        summary["spec_accepted"] = m.get("spec_accepted", 0)
+        summary["spec_acceptance_rate"] = m.get("spec_acceptance_rate")
+        summary["gamma"] = engine.config.gamma
     if metrics_out:
         _metrics.registry().write_snapshot(metrics_out)
         summary["metrics_snapshot"] = metrics_out
@@ -253,7 +275,9 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--engine", default="both",
-                   choices=("dense", "paged", "both"))
+                   choices=("dense", "paged", "spec", "both", "all"),
+                   help="'both' = dense+paged; 'all' adds the "
+                        "spec-decode arm")
     p.add_argument("--model", default="gpt_tiny")
     p.add_argument("--users", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
@@ -269,6 +293,14 @@ def main(argv=None):
                         "budget as dense)")
     p.add_argument("--max-len", type=int, default=64)
     p.add_argument("--block-size", type=int, default=8)
+    p.add_argument("--gamma", type=int, default=3,
+                   help="spec arm: draft tokens proposed per round")
+    p.add_argument("--draft-layers", type=int, default=1,
+                   help="spec arm: truncated-draft layer count")
+    p.add_argument("--attention-impl", default="gather",
+                   choices=("gather", "kernel"),
+                   help="paged/spec attend: dense-view gather or the "
+                        "Pallas in-kernel block-table walk")
     p.add_argument("--timeout-s", type=float, default=None)
     p.add_argument("--shed-watermark", type=int, default=None)
     p.add_argument("--virtual-step-s", type=float, default=None,
@@ -291,7 +323,9 @@ def main(argv=None):
     num_blocks = budget // args.block_size       # same budget in blocks
     paged_slots = args.paged_slots or min(
         2 * args.slots, max(args.slots + 1, num_blocks - 1))
-    kinds = ("dense", "paged") if args.engine == "both" else (args.engine,)
+    kinds = {"both": ("dense", "paged"),
+             "all": ("dense", "paged", "spec")}.get(args.engine,
+                                                   (args.engine,))
     out = {}
     for kind in kinds:
         out[kind] = run_harness(
@@ -300,6 +334,8 @@ def main(argv=None):
             max_len=args.max_len, block_size=args.block_size,
             num_blocks=num_blocks, shed_watermark=args.shed_watermark,
             virtual_step_s=args.virtual_step_s,
+            gamma=args.gamma, draft_layers=args.draft_layers,
+            attention_impl=args.attention_impl,
             metrics_out=args.metrics_out
             if kind == kinds[-1] else None)
     print(json.dumps(out, indent=2, sort_keys=True))

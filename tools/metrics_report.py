@@ -40,10 +40,21 @@ _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
     r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt", re.I)
 
-# hits/misses counter pairs whose RATIO is the SLO signal: a hit-rate
-# drop past the threshold is a failure-class regression even when the
-# absolute hit count grew (e.g. more traffic, worse prefix sharing)
-_RATE_PAT = re.compile(r"^(?P<base>.*_)hits_total(?P<labels>\{.*\})?$")
+# counter pairs whose RATIO is the SLO signal: a rate drop past the
+# threshold is a failure-class regression even when the numerator grew
+# (e.g. more traffic, worse prefix sharing / draft acceptance). Each
+# entry: (numerator regex, denominator suffix, denominator-includes-
+# numerator?, rate name suffix).
+#   hits/(hits+misses)    — prefix-cache style hit rate
+#   accepted/proposed     — spec-decode acceptance rate (the ISSUE 7
+#                           gate: a rate drop means the draft rots or
+#                           the verify rule broke, even under growth)
+_RATE_RULES = (
+    (re.compile(r"^(?P<base>.*_)hits_total(?P<labels>\{.*\})?$"),
+     "misses_total", True, "hit_rate"),
+    (re.compile(r"^(?P<base>.*_)accepted_total(?P<labels>\{.*\})?$"),
+     "proposed_total", False, "acceptance_rate"),
+)
 
 
 # ------------------------------------------------------------- validation
@@ -212,19 +223,24 @@ def render(records, title="metrics report"):
 # ------------------------------------------------------------- comparison
 
 def _hit_rates(flat):
-    """{base: rate} for every X_hits_total/X_misses_total counter pair
-    with at least one event."""
+    """{name: rate} for every rate-rule counter pair with at least one
+    event (X_hits/X_misses hit rate, X_accepted/X_proposed acceptance
+    rate)."""
     rates = {}
-    for key, hits in flat.items():
-        m = _RATE_PAT.match(key)
-        if not m:
-            continue
-        miss_key = m.group("base") + "misses_total" + (m.group("labels")
-                                                       or "")
-        misses = flat.get(miss_key)
-        if misses is None or hits + misses <= 0:
-            continue
-        rates[m.group("base") + "hit_rate"] = hits / (hits + misses)
+    for key, num in flat.items():
+        for pat, denom_suffix, denom_adds, rate_suffix in _RATE_RULES:
+            m = pat.match(key)
+            if not m:
+                continue
+            denom_key = m.group("base") + denom_suffix \
+                + (m.group("labels") or "")
+            denom = flat.get(denom_key)
+            if denom is None:
+                continue
+            total = num + denom if denom_adds else denom
+            if total <= 0:
+                continue
+            rates[m.group("base") + rate_suffix] = num / total
     return rates
 
 

@@ -7,14 +7,18 @@ two record kinds:
   {"kind": "step", "step", "t", "queue_depth", "active_slots",
    "tokens_generated"}
   {"kind": "request", "request_id", "status", "prompt_len", "tokens",
-   "priority", "preempted", "prefix_hit", "ttft_s", "decode_s"}
+   "priority", "preempted", "prefix_hit", "spec_proposed",
+   "spec_accepted", "ttft_s", "decode_s"}
 
 The per-request SLO fields (ISSUE 6): `priority` is the request's class
 (0=interactive, 1=standard, 2=batch), `preempted` how many times it was
 evicted and requeued under allocation pressure, `prefix_hit` whether its
-prefill reused shared prefix-cache blocks. Terminal statuses now include
-ERROR (engine failure contained to the request) and SHED (failed fast at
-admission by the SLO watermark).
+prefill reused shared prefix-cache blocks. The spec-decode fields
+(ISSUE 7): `spec_proposed`/`spec_accepted` count the draft tokens a
+speculative engine proposed/had accepted for this request (both 0 on
+one-token engines); the summary reports the run's acceptance rate over
+them. Terminal statuses now include ERROR (engine failure contained to
+the request) and SHED (failed fast at admission by the SLO watermark).
 
 `validate_records` is the schema contract the CI smoke test asserts on;
 the CLI renders a human summary: request outcomes, TTFT percentiles,
@@ -31,8 +35,12 @@ STEP_FIELDS = {"kind": str, "step": int, "t": (int, float),
 REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "prompt_len": int, "tokens": int, "priority": int,
                   "preempted": int, "prefix_hit": bool,
+                  "spec_proposed": int, "spec_accepted": int,
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
+# absent == 0 in files written before the speculative-decode fields
+# landed (ISSUE 7) — historical artifacts must stay gradeable
+OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted"}
 STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
 
@@ -47,7 +55,8 @@ def validate_records(records):
         schema = STEP_FIELDS if kind == "step" else REQUEST_FIELDS
         for field, types in schema.items():
             if field not in rec:
-                errors.append(f"record {i} ({kind}): missing {field!r}")
+                if field not in OPTIONAL_REQUEST_FIELDS:
+                    errors.append(f"record {i} ({kind}): missing {field!r}")
             elif not isinstance(rec[field], types):
                 errors.append(
                     f"record {i} ({kind}): {field!r} has type "
@@ -99,6 +108,12 @@ def summarize(records):
                                 default=0),
         "prefix_hit_rate": (sum(1 for r in served if r["prefix_hit"])
                             / len(served) if served else None),
+        "spec_proposed": sum(r.get("spec_proposed", 0) for r in reqs),
+        "spec_accepted": sum(r.get("spec_accepted", 0) for r in reqs),
+        "spec_acceptance_rate": (
+            sum(r.get("spec_accepted", 0) for r in reqs)
+            / sum(r.get("spec_proposed", 0) for r in reqs)
+            if sum(r.get("spec_proposed", 0) for r in reqs) else None),
         "preemptions": sum(r["preempted"] for r in reqs),
         "by_priority": {
             p: sum(1 for r in reqs if r["priority"] == p)
@@ -124,6 +139,11 @@ def render(summary):
     if summary["prefix_hit_rate"] is not None:
         out.append(f"prefix-cache hit rate: "
                    f"{summary['prefix_hit_rate']:.2f}")
+    if summary["spec_acceptance_rate"] is not None:
+        out.append(f"spec-decode acceptance rate: "
+                   f"{summary['spec_acceptance_rate']:.2f} "
+                   f"({summary['spec_accepted']}/"
+                   f"{summary['spec_proposed']} drafts)")
     if summary["preemptions"]:
         out.append(f"preemptions: {summary['preemptions']}")
     out.append("priority mix: " + ", ".join(
