@@ -260,6 +260,76 @@ def flash_parity_preflight(S, dtype="bfloat16"):
             "flash_parity_ok": bool(fwd_err < 0.05 and grad_err < 0.25)}
 
 
+def _cost_model_predict(step_fn, args, on_tpu, top=8):
+    """Analytical per-op prediction for ONE train step (abstract eval
+    only — no execution, works for TPU shapes on a CPU host). Returns
+    the `cost_model` extra block with predicted totals + per-op rows,
+    and publishes the prediction as a registry gauge IMMEDIATELY, so
+    even a run that wedges in the timed loop leaves its analytical
+    expectation in the postmortem metrics snapshot (the ROADMAP item 1
+    debt: wedged rounds still owe an analytical delta)."""
+    try:
+        from paddle_tpu.cost_model import analytical
+        from paddle_tpu.observability import metrics as _obs_metrics
+        device = "tpu-v5e" if on_tpu else "cpu"
+        report = analytical.estimate(step_fn, *args, device=device)
+        spec = report.device
+        rows = sorted(report.by_op.items(),
+                      key=lambda kv: -spec.roofline_s(kv[1].flops,
+                                                      kv[1].bytes))[:top]
+        per_op = {name: {"predicted_ms": round(
+                             1e3 * spec.roofline_s(c.flops, c.bytes), 4),
+                         "gflop": round(c.flops / 1e9, 3),
+                         "mbytes": round(c.bytes / 1e6, 2)}
+                  for name, c in rows}
+        block = {"device": device,
+                 "predicted_step_ms": round(report.time_ms, 3),
+                 "predicted_gflop": round(report.total_flops / 1e9, 3),
+                 "per_op": per_op,
+                 "has_while": report.has_while}
+        _obs_metrics.gauge(
+            "bench_cost_model_predicted_step_ms",
+            "Analytical roofline prediction for one train step"
+        ).set(block["predicted_step_ms"])
+        return block
+    except Exception as e:                                   # noqa: BLE001
+        # the prediction is evidence, not a dependency — a cost-model
+        # regression must not take the bench down
+        return {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+
+def _cost_model_measure(block, step_ms):
+    """Fold the measured step time into the prediction block and publish
+    the measured/predicted gauges `tools/metrics_report.py --compare`
+    gates on (a ratio that GROWS past the threshold = the analytical
+    model lost contact with the hardware, or the hardware regressed)."""
+    if not block or "predicted_step_ms" not in block:
+        return block
+    from paddle_tpu.observability import metrics as _obs_metrics
+    block["measured_step_ms"] = round(step_ms, 3)
+    pred = block["predicted_step_ms"]
+    ratio = (step_ms / pred) if pred > 0 else 0.0
+    block["measured_vs_predicted"] = round(ratio, 4)
+    # per-op deltas: each op's predicted ms against its share of the
+    # measured step AT THE PREDICTED MIX (the roofline says where the
+    # time should go; the measured total says how much there was).
+    # Shares divide by the FULL predicted total — not the truncated
+    # top-N sum — so a perfect prediction yields zero deltas
+    for r in block["per_op"].values():
+        share = r["predicted_ms"] / pred if pred else 0.0
+        r["measured_share_ms"] = round(share * step_ms, 4)
+        r["delta_ms"] = round(r["measured_share_ms"] - r["predicted_ms"], 4)
+    _obs_metrics.gauge(
+        "bench_cost_model_measured_step_ms",
+        "Measured train-step wall time").set(block["measured_step_ms"])
+    _obs_metrics.gauge(
+        "bench_cost_model_measured_vs_predicted",
+        "Measured / analytically-predicted step time (gap gauge: growth "
+        "past the --compare threshold is a failure-class regression)"
+    ).set(block["measured_vs_predicted"])
+    return block
+
+
 def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
     import jax
     import jax.numpy as jnp
@@ -312,6 +382,11 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         dispatch = step_fn
     n_dispatch = max(1, n_steps // scan_k)
 
+    # analytical expectation for ONE step, published before the timed
+    # loop (a wedged run still leaves its prediction in the postmortem)
+    cost_model = _cost_model_predict(step_fn,
+                                     (params, state, toks, labs, lr), on_tpu)
+
     # warmup: compile + 2 synced dispatches (OOM, if any, surfaces here)
     for _ in range(2):
         loss, params, state = dispatch(params, state, toks, labs, lr)
@@ -360,6 +435,10 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         with RecordEvent("bench.final_loss_fetch", TracerEventType.Forward):
             loss_val = float(prev)
     dt = time.perf_counter() - t0
+    # fold the measurement in BEFORE the profiler's registry snapshot is
+    # written, so the predicted-vs-measured gauges ride the artifact set
+    cost_model = _cost_model_measure(cost_model,
+                                     1000 * dt / (n_dispatch * scan_k))
 
     if prof is not None:
         prof.stop()
@@ -409,7 +488,8 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
                   "backend": jax.default_backend(),
                   "n_steps": total_steps, "scan_k": scan_k,
                   "step_ms": round(1000 * dt / total_steps, 1),
-                  "loss": loss_val, **extra_profile},
+                  "loss": loss_val, "cost_model": cost_model,
+                  **extra_profile},
     }
 
 
@@ -435,6 +515,15 @@ def _parse_args(argv):
                         "shared-prefix mixture through the paged engine, "
                         "with the dense per-slot engine raced at the same "
                         "KV memory budget for the concurrency comparison")
+    p.add_argument("--cold-start", action="store_true",
+                   help="cold-start rung: build a serving artifact, then "
+                        "race a COLD process (empty compile cache, full "
+                        "XLA compilation) against a WARM one (executables "
+                        "deserialized from the persistent compile cache) "
+                        "and report executable-ready + TTFT for both")
+    p.add_argument("--cold-start-child", metavar="ARTIFACT", default=None,
+                   help="(internal) one measured Predictor process of the "
+                        "--cold-start rung")
     return p.parse_args(argv)
 
 
@@ -567,9 +656,131 @@ def run_serve_load_bench(on_tpu, n_requests=None):
     }
 
 
+def run_cold_start_child(artifact):
+    """One measured serving process of the --cold-start rung: build a
+    Predictor over `artifact` (AOT warmup included — against a warm
+    cache that is deserialization, cold it is compilation) and serve one
+    token. Prints ONE JSON line the parent parses; exit code carries
+    success."""
+    import paddle_tpu  # noqa: F401  (registers the framework)
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.observability import metrics as _obs_metrics
+
+    proc_t0 = float(os.environ.get("BENCH_CHILD_T0", 0) or 0)
+    prompt = list(range(1, 1 + int(os.environ.get("BENCH_COLDSTART_PROMPT",
+                                                  4))))
+    t0 = time.perf_counter()
+    pred = create_predictor(Config(artifact + ".pdmodel",
+                                   artifact + ".pdiparams"))
+    ready_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    out = pred.generate([prompt], max_new_tokens=1)
+    ttft_s = time.perf_counter() - t1
+    engine = pred._gen_sched.engine
+    cache = engine.compile_cache
+    _obs_metrics.gauge(
+        "serving_cold_start_ttft_seconds",
+        "Predictor build + first generated token, one process"
+    ).set(ready_s + ttft_s)
+    rec = {
+        "executable_ready_s": round(ready_s, 4),
+        "ttft_s": round(ttft_s, 4),
+        "total_s": round(ready_s + ttft_s, 4),
+        "process_total_s": round(time.time() - proc_t0, 4) if proc_t0
+        else None,
+        "first_token": int(out[0][0]),
+        "trace_counts": {
+            k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in engine.trace_counts.items()},
+        "compile_cache": dict(cache.stats) if cache is not None else None,
+    }
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def run_cold_start_bench(on_tpu):
+    """Cold-start rung: save_for_generation records the serving engine
+    in the artifact sidecar, then the SAME child command runs twice —
+    first against an empty compile cache (cold: every serving executable
+    compiles and commits), then against the populated one (warm: every
+    executable deserializes). value = warm executable-ready seconds;
+    vs_baseline = cold/warm ready ratio (>1 is the cache's win). The
+    warm child's zero-compile contract is ASSERTED, not just reported —
+    a rung whose warm process still compiles must fail."""
+    import tempfile
+
+    import jax
+
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.serving import EngineConfig, save_for_generation
+    from paddle_tpu.text import models as _models
+
+    model_name = os.environ.get("BENCH_COLDSTART_MODEL",
+                                "gpt_125m" if on_tpu else "gpt_tiny")
+    slots = int(os.environ.get("BENCH_COLDSTART_SLOTS", 4 if on_tpu else 2))
+    max_len = int(os.environ.get("BENCH_COLDSTART_MAXLEN",
+                                 256 if on_tpu else 32))
+    workdir = os.environ.get("BENCH_COLDSTART_DIR") or tempfile.mkdtemp(
+        prefix="bench_coldstart_")
+    artifact = os.path.join(workdir, "gpt")
+    model = getattr(_models, model_name)()
+    model.eval()
+    # the artifact records WHAT to serve; the children decide when the
+    # compiling happens — precompile stays False so the parent's caches
+    # cannot leak into the cold child's measurement
+    save_for_generation(model, artifact,
+                        engine_config=EngineConfig(slots=slots,
+                                                   max_len=max_len),
+                        precompile=False)
+
+    def child(tag):
+        env = dict(os.environ, BENCH_CHILD_T0=repr(time.time()))
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cold-start-child", artifact],
+            capture_output=True, text=True, env=env,
+            timeout=float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)))
+        if out.returncode != 0:
+            raise RuntimeError(f"{tag} cold-start child failed: "
+                               f"{out.stderr[-1000:]}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = child("cold")
+    warm = child("warm")
+    # the contract, asserted: a warm restart performs ZERO fresh
+    # compilations for the serving executable set
+    warm_traces = warm["trace_counts"]
+    fresh = warm_traces["decode"] + sum(warm_traces["prefill"].values()) \
+        + warm_traces.get("spec_verify", 0) \
+        + warm_traces.get("draft_decode", 0) \
+        + sum(warm_traces.get("draft_prefill", {}).values())
+    assert fresh == 0, f"warm child traced {warm_traces}"
+    assert warm["compile_cache"]["misses"] == 0, warm["compile_cache"]
+    assert warm["compile_cache"]["hits"] > 0, warm["compile_cache"]
+    assert warm["first_token"] == cold["first_token"], \
+        "warm executable decoded a different token than the cold compile"
+    ratio = cold["executable_ready_s"] / warm["executable_ready_s"] \
+        if warm["executable_ready_s"] else 0.0
+    return {
+        "value": warm["executable_ready_s"],
+        "vs_baseline": round(ratio, 3),   # cold/warm ready-time ratio
+        "extra": {"metric_name": "cold_start_warm_ready_s",
+                  "model": model_name, "slots": slots, "max_len": max_len,
+                  "artifact_dir": workdir,
+                  "cold": cold, "warm": warm,
+                  "warm_beats_cold":
+                      warm["executable_ready_s"]
+                      < cold["executable_ready_s"],
+                  "backend": jax.default_backend()},
+    }
+
+
 def main(argv=None):
     global _PROFILE_DIR
     args = _parse_args(argv or [])
+    if args.cold_start_child:
+        run_cold_start_child(args.cold_start_child)
+        return
     if args.profile:
         _PROFILE_DIR = args.profile_dir
     init_budget = float(os.environ.get("BENCH_INIT_BUDGET_S", 600))
@@ -623,6 +834,20 @@ def main(argv=None):
                             "serve-load rung")
         try:
             result = run_serve_load_bench(on_tpu)
+            emit(result["value"], result["vs_baseline"],
+                 extra=result["extra"])
+        finally:
+            wd.cancel()
+        return
+
+    if args.cold_start:
+        METRIC = "gpt_cold_start_warm_ready_s"
+        UNIT = "seconds to serving-ready (warm-cache process)"
+        wd = start_watchdog(
+            2 * float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
+            "cold-start rung")
+        try:
+            result = run_cold_start_bench(on_tpu)
             emit(result["value"], result["vs_baseline"],
                  extra=result["extra"])
         finally:

@@ -542,10 +542,19 @@ def _build_cached_op(fn, args, kwargs, diff_idx, with_grad):
         return fn(*full, **kwargs)
 
     if not with_grad:
-        @jax.jit
         def run(td):
             return assemble(td)
-        return run
+        from ..framework import compile_cache as _cc
+        if _cc.active() is not None:
+            # persistent tier (content-addressed on the lowering hash):
+            # the trace still happens once per process per op — what the
+            # disk entry skips is the XLA compile. Grad-path runners are
+            # excluded: their vjp-closure outputs don't serialize, so
+            # they stay on plain jit (a transparent miss, by contract).
+            opname = getattr(fn, "__qualname__", None) \
+                or getattr(fn, "__name__", "op")
+            return _cc.cached_jit(run, f"op.{opname}", key_mode="lowering")
+        return jax.jit(run)
 
     @jax.jit
     def run(td):

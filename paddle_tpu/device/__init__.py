@@ -62,10 +62,38 @@ def reset_op_cache_stats():
 
 def clear_op_cache():
     """Drop every cached eager-op executable AND zero the counters (the
-    dispatch-cache analogue of device.cuda.empty_cache)."""
+    dispatch-cache analogue of device.cuda.empty_cache).
+
+    Coherence contract with the persistent tier
+    (framework/compile_cache.py): when a process-global compile cache is
+    attached, clearing the in-memory op cache ALSO invalidates it —
+    every persistent entry committed before this call reads as a miss
+    for the rest of this process and is recommitted by the next compile,
+    so a cleared cache can never resurrect a pre-clear executable (e.g.
+    after an in-process code redefinition). Entries stay on disk for
+    FRESH processes, where content-addressed keys (lowering hash +
+    framework source fingerprint) guarantee they can only hit for
+    byte-identical programs. Engine-private serving caches
+    (EngineConfig.compile_cache_dir) are out of scope — they are not op
+    caches and follow the serving engine's lifecycle."""
     from ..core import tensor as _t
+    from ..framework import compile_cache as _cc
     _t._EAGER_CACHE.clear()
     reset_op_cache_stats()
+    _cc.invalidate_active()
+
+
+def compile_cache_stats():
+    """Stats of the process-global persistent compile cache, or None
+    when no cache is attached: {hits, misses, bypass, corrupt,
+    uncacheable, entries, path}. The same counters feed the metrics
+    registry as compile_cache_{hits,misses}_total."""
+    from ..framework import compile_cache as _cc
+    cache = _cc.active()
+    if cache is None:
+        return None
+    return {**cache.stats, "entries": len(cache.entries()),
+            "path": cache.path}
 
 
 def get_all_custom_device_type():

@@ -30,6 +30,13 @@ _FLAGS = {
     # directory to have XLA executables serialized there and reloaded by
     # later processes, skipping compilation.
     "FLAGS_compilation_cache_dir": "",
+    # first-class persistent executable cache (framework/compile_cache.py):
+    # set to a directory to attach the process-global tier — device-layer op
+    # runners and serving engines without a private cache then serialize
+    # executables there and deserialize them on later runs. Unlike the jax
+    # cache above, entries ride the ckpt_commit atomic protocol (torn-write
+    # safe) and report through compile_cache_{hits,misses}_total.
+    "FLAGS_compile_cache_dir": "",
     # int64 boundary policy escape hatch (PARITY dtype-policy section): on
     # device, int64 requests canonicalize to int32 (x64 off, TPU-native
     # widths). Consumers that np.save/type-check against reference-written
@@ -75,6 +82,11 @@ _load_env()
 
 if _FLAGS["FLAGS_compilation_cache_dir"]:
     enable_compilation_cache()
+
+if _FLAGS["FLAGS_compile_cache_dir"]:
+    # attach is import-light (no jax until the first lookup/compile)
+    from . import compile_cache as _compile_cache
+    _compile_cache.attach(_FLAGS["FLAGS_compile_cache_dir"])
 
 
 def get_flags(flags=None):

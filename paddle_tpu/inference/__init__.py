@@ -65,6 +65,12 @@ class Config:
         # (AnalysisPredictor's pay-analysis-once intent). None = default dir.
         self._compile_cache_dir = None
         self._compile_cache = True
+        # AOT serving warmup: when the artifact's .gencfg records a serving
+        # engine (save_for_generation(engine_config=...)), the Predictor
+        # builds it AT LOAD and precompiles the whole executable set —
+        # against a warm compile cache that is a deserialize, not a
+        # compile, and the first request pays zero compilation.
+        self._aot_warmup = True
 
     def enable_compile_cache(self, path=None):
         self._compile_cache = True
@@ -72,6 +78,14 @@ class Config:
 
     def disable_compile_cache(self):
         self._compile_cache = False
+
+    def enable_aot_warmup(self):
+        self._aot_warmup = True
+
+    def disable_aot_warmup(self):
+        """Skip the load-time engine build/warmup (serving executables
+        then compile lazily on the first generate(), pre-PR-8 style)."""
+        self._aot_warmup = False
 
     # -- model location ----------------------------------------------------
     def set_prog_file(self, path):
@@ -208,6 +222,51 @@ class Predictor:
         self._output_names = []
         self._outputs = {}
 
+        # AOT serving warmup: a .gencfg that records a serving engine is
+        # built NOW (executables deserialize from the artifact's compile
+        # cache when warm), so the first generate() compiles nothing.
+        # Failure degrades to the lazy path — load must never break.
+        self._gen_sched = None
+        self._gen_sched_from_record = False
+        self._serving_meta = self._read_serving_meta()
+        if self._serving_meta and getattr(config, "_aot_warmup", False) \
+                and getattr(config, "_compile_cache", False):
+            import time as _time
+            from ..observability import metrics as _obs_metrics
+            t0 = _time.perf_counter()
+            try:
+                self._generation_scheduler()
+            except Exception as e:                           # noqa: BLE001
+                import warnings
+                # the recorded engine cannot be rebuilt under THIS build
+                # (config/kind skew): drop the record so the lazy path
+                # takes the plain pre-record engine instead of retrying
+                # the same deterministic failure on every generate()
+                self._serving_meta = None
+                warnings.warn(f"AOT serving warmup failed "
+                              f"({type(e).__name__}: {str(e)[:200]}); "
+                              f"falling back to lazy engine build")
+            else:
+                _obs_metrics.gauge(
+                    "predictor_executable_ready_seconds",
+                    "Predictor load-to-serving-ready wall time (AOT "
+                    "warmup included)").set(_time.perf_counter() - t0)
+
+    def _read_serving_meta(self):
+        """The .gencfg 'serving' record (engine kind + config +
+        executable set), or None for pre-recording artifacts."""
+        import json
+
+        from ..serving.engine import GENCFG_SUFFIX
+        base = self._config.prog_file()
+        if base.endswith(".pdmodel"):
+            base = base[:-len(".pdmodel")]
+        try:
+            with open(base + GENCFG_SUFFIX) as f:
+                return json.load(f).get("serving")
+        except (OSError, ValueError):
+            return None
+
     def get_input_names(self):
         return list(self._input_names)
 
@@ -243,13 +302,30 @@ class Predictor:
 
     # -- generation entry point (serving/) ----------------------------------
     def _generation_scheduler(self, **engine_kwargs):
-        """Lazily build the serving engine + scheduler from the `.gencfg`
-        sidecar `serving.save_for_generation` wrote next to the artifact.
-        The params already loaded for the one-shot path are reused — one
-        weight copy serves both run() and generate()."""
+        """Build (or return) the serving engine + scheduler from the
+        `.gencfg` sidecar `serving.save_for_generation` wrote next to
+        the artifact. The params already loaded for the one-shot path
+        are reused — one weight copy serves both run() and generate().
+
+        When the sidecar records a serving engine and no explicit engine
+        kwargs are given, the RECORDED engine (dense/paged/spec, exact
+        config) is rebuilt with the artifact's persistent compile cache
+        attached and `precompile()`d — against a warm cache that is all
+        deserialization, so a restarted Predictor performs zero fresh
+        compilations for the serving set.
+
+        Explicit engine kwargs keep their pre-record contract: they win.
+        A scheduler auto-built from the record is REPLACED when the
+        first generate() carries engine kwargs (the caller asked for a
+        different engine than the artifact recorded); once a
+        kwargs-built scheduler exists, later calls reuse it as before."""
         if getattr(self, "_gen_sched", None) is not None:
-            return self._gen_sched
-        from ..serving.engine import load_generation_model
+            if not engine_kwargs or \
+                    not getattr(self, "_gen_sched_from_record", False):
+                return self._gen_sched
+            self._gen_sched = None     # record-built, caller overrides
+        from ..serving.engine import (default_compile_cache_dir,
+                                      load_generation_model, make_engine)
         model = load_generation_model(self._config.prog_file(), self._params)
         if model is None:
             raise RuntimeError(
@@ -261,8 +337,29 @@ class Predictor:
                       "default_timeout_s", "metrics_path")
         sched_kwargs = {k: engine_kwargs.pop(k) for k in sched_keys
                         if k in engine_kwargs}
-        engine = GenerationEngine(model, **engine_kwargs)
+        meta = getattr(self, "_serving_meta", None)
+        from_record = bool(meta) and not engine_kwargs
+        if from_record:
+            cache_dir = None
+            if getattr(self._config, "_compile_cache", False):
+                cache_dir = self._config._compile_cache_dir or \
+                    default_compile_cache_dir(self._config.prog_file())
+            engine = make_engine(model, meta["engine"], meta["config"],
+                                 compile_cache_dir=cache_dir)
+            if getattr(self._config, "_aot_warmup", False):
+                try:
+                    engine.precompile()
+                except Exception as e:                       # noqa: BLE001
+                    # the engine itself is healthy — serve lazily (the
+                    # executables compile on first use) rather than fail
+                    import warnings
+                    warnings.warn(f"AOT precompile failed "
+                                  f"({type(e).__name__}: {str(e)[:200]});"
+                                  f" serving will compile lazily")
+        else:
+            engine = GenerationEngine(model, **engine_kwargs)
         self._gen_sched = Scheduler(engine, **sched_kwargs)
+        self._gen_sched_from_record = from_record
         return self._gen_sched
 
     def generate(self, input_ids, max_new_tokens=32, **engine_kwargs):

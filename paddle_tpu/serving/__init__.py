@@ -31,7 +31,7 @@ from . import blocks, kv_cache, prefix_cache, sampling, spec_decode  # noqa: F40
 from .blocks import BlockAllocError, BlockPool  # noqa: F401
 from .engine import (  # noqa: F401
     EngineConfig, GenerationEngine, PagedEngineConfig, PagedGenerationEngine,
-    save_for_generation,
+    default_compile_cache_dir, make_engine, save_for_generation,
 )
 from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
@@ -46,7 +46,8 @@ __all__ = [
     "kv_cache", "blocks", "prefix_cache", "sampling", "spec_decode",
     "BlockAllocError", "BlockPool", "PrefixCache",
     "EngineConfig", "GenerationEngine", "PagedEngineConfig",
-    "PagedGenerationEngine", "save_for_generation",
+    "PagedGenerationEngine", "save_for_generation", "make_engine",
+    "default_compile_cache_dir",
     "SpecDecodeConfig", "SpeculativeEngine", "truncated_draft",
     "Scheduler", "ServingConfig", "Request", "RequestHandle",
     "QueueFullError", "LoadShedError",

@@ -21,6 +21,7 @@ params once (`functional_state`) and traces `GPT.forward(cache=...)`
 through `functional_call`, so the same eager model object serves both
 training and serving without a second weight copy.
 """
+import dataclasses
 import json
 import os
 
@@ -29,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..framework import compile_cache as _cc
 from ..nn.layer.layers import functional_call, functional_state
 from ..observability import faults as _faults
 from ..profiler import RecordEvent, TracerEventType
@@ -38,18 +40,31 @@ from . import sampling
 from .prefix_cache import PrefixCache
 
 __all__ = ["EngineConfig", "GenerationEngine", "PagedEngineConfig",
-           "PagedGenerationEngine", "save_for_generation"]
+           "PagedGenerationEngine", "save_for_generation", "make_engine",
+           "default_compile_cache_dir"]
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024)
 GENCFG_SUFFIX = ".gencfg"
+COMPILE_CACHE_DIRNAME = "_compile_cache"
 
 
 class EngineConfig:
-    """Slot/bucket/strategy knobs for one GenerationEngine."""
+    """Slot/bucket/strategy knobs for one GenerationEngine.
+
+    `compile_cache_dir` attaches a PRIVATE persistent executable cache
+    (framework/compile_cache.py) to the engine: prefill/decode (and the
+    speculative engine's draft/verify) executables are served from disk
+    when warm and committed there when cold, so a restarted process
+    skips XLA compilation entirely. None falls back to the process-
+    global cache (`compile_cache.attach`), or to plain jit when neither
+    exists. The path is machine-local and deliberately NOT part of
+    `as_dict()` — a saved artifact records WHAT to compile, each loader
+    decides WHERE the executables live."""
 
     def __init__(self, slots=4, max_len=256, prefill_buckets=None,
                  decode_strategy="greedy", temperature=1.0, top_k=0,
-                 top_p=1.0, eos_token_id=None, seed=0):
+                 top_p=1.0, eos_token_id=None, seed=0,
+                 compile_cache_dir=None):
         self.slots = int(slots)
         self.max_len = int(max_len)
         # the ladder always ends in a max_len-sized bucket so every prompt
@@ -63,6 +78,35 @@ class EngineConfig:
         self.top_p = float(top_p)
         self.eos_token_id = eos_token_id
         self.seed = int(seed)
+        self.compile_cache_dir = compile_cache_dir
+
+    # field names that round-trip through the .gencfg serving record;
+    # seed is INCLUDED (it only feeds RNG key VALUES, but recording it
+    # keeps a rebuilt engine bit-identical to the saved one) while
+    # compile_cache_dir stays machine-local
+    _DICT_FIELDS = ("slots", "max_len", "prefill_buckets",
+                    "decode_strategy", "temperature", "top_k", "top_p",
+                    "eos_token_id", "seed")
+
+    def as_dict(self):
+        """JSON-serializable ctor kwargs: EngineConfig-family configs
+        round-trip through `type(cfg)(**cfg.as_dict())` — the form the
+        `.gencfg` serving record stores."""
+        out = {}
+        for f in self._DICT_FIELDS:
+            v = getattr(self, f)
+            out[f] = list(v) if isinstance(v, tuple) else v
+        return out
+
+    def compile_signature(self):
+        """The static half of the persistent-cache key for this config:
+        every knob that can change a traced program (strategy and
+        sampling parameters are baked into the executables as python
+        closures). Seed is EXCLUDED — it only selects RNG key values,
+        which ride in as runtime inputs."""
+        sig = self.as_dict()
+        sig.pop("seed", None)
+        return sig
 
 
 class GenerationEngine:
@@ -88,10 +132,31 @@ class GenerationEngine:
         self._last_tokens = np.zeros((self.config.slots,), np.int32)
         # trace counters: the python bodies below run ONLY when jax traces,
         # so these counts are the number of compilations, not of calls.
+        # A warm persistent-cache load DESERIALIZES the executable and
+        # never traces — these staying 0 is the zero-fresh-compiles proof.
         self.trace_counts = {"decode": 0, "prefill": {}}
+        self.compile_cache = _cc.CompileCache(self.config.compile_cache_dir) \
+            if self.config.compile_cache_dir else None
         self._alloc_state()                    # cache layout hook
-        self._decode = jax.jit(self._decode_fn)
-        self._prefill = {}   # bucket -> jitted fn
+        self._decode = self._cached(self._decode_fn, "decode")
+        self._prefill = {}   # bucket -> cached-jitted fn
+
+    def _cached(self, fn, name):
+        """cached_jit over the engine's persistent tier (engine-private
+        cache first, process-global cache second, plain jit when
+        neither). The static signature pins model + engine config, so
+        avals alone can never alias two different programs."""
+        return _cc.cached_jit(
+            fn, f"serving.{name}",
+            static_sig=self._compile_signature(),
+            cache=lambda: self.compile_cache)
+
+    def _compile_signature(self):
+        """Model config + engine config, the signature-mode key half
+        shared by every executable of this engine."""
+        return {"model": dataclasses.asdict(self._model.cfg),
+                "engine": type(self).__name__,
+                "config": self.config.compile_signature()}
 
     def _alloc_state(self):
         """Allocate the KV memory layout — dense per-slot buffers here;
@@ -159,7 +224,7 @@ class GenerationEngine:
                                                 keepdims=False)
             first_token = self._select(last[None, :], key)[0]
             return first_token, gk, gv, pos
-        return jax.jit(prefill_fn)
+        return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     def bucket_for(self, length):
         for b in self.config.prefill_buckets:
@@ -173,6 +238,42 @@ class GenerationEngine:
     def _next_key(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _warm_key(self):
+        """A key with `_next_key`'s aval for AOT warmup — warmup must not
+        consume the engine's RNG stream (token streams stay identical
+        with or without a warmup pass)."""
+        return jax.random.key(self.config.seed)
+
+    # -- AOT warmup ----------------------------------------------------------
+    def executable_names(self):
+        """The full serving executable set of this engine — what
+        `save_for_generation` records in the `.gencfg` sidecar and
+        `precompile()` warms."""
+        return ["decode"] + [f"prefill[{b}]"
+                             for b in self.config.prefill_buckets]
+
+    def precompile(self):
+        """AOT-build every serving executable WITHOUT serving a request
+        (lower/compile only — nothing executes, no engine state moves).
+        With a persistent cache attached, warm entries deserialize (zero
+        traces, trace_counts untouched) and cold ones compile and
+        commit, so a later process starts warm. Returns
+        {executable: "hit"|"miss"|"off"}."""
+        gk = [l.k for l in self._cache.layers]
+        gv = [l.v for l in self._cache.layers]
+        pos = self._cache.pos
+        key = self._warm_key()
+        out = {"decode": self._decode.warm(
+            self._params, gk, gv, pos,
+            jnp.zeros((self.config.slots,), jnp.int32), key)}
+        for b in self.config.prefill_buckets:
+            if b not in self._prefill:
+                self._prefill[b] = self._make_prefill(b)
+            out[f"prefill[{b}]"] = self._prefill[b].warm(
+                self._params, gk, gv, pos, jnp.asarray(0, jnp.int32),
+                jnp.zeros((b,), jnp.int32), jnp.asarray(1, jnp.int32), key)
+        return out
 
     # -- public compute API -------------------------------------------------
     def prefill(self, slot, prompt_ids):
@@ -303,6 +404,9 @@ class PagedEngineConfig(EngineConfig):
                              f"'kernel', got {attention_impl!r}")
         self.attention_impl = attention_impl
 
+    _DICT_FIELDS = EngineConfig._DICT_FIELDS + (
+        "block_size", "num_blocks", "enable_prefix_cache", "attention_impl")
+
 
 class PagedGenerationEngine(GenerationEngine):
     """GenerationEngine over the paged block pool (serving/blocks.py).
@@ -391,6 +495,32 @@ class PagedGenerationEngine(GenerationEngine):
         """Allocatable capacity: the reserve minus the garbage block."""
         return (self.config.num_blocks - 1) * self.config.block_size
 
+    # -- AOT warmup ----------------------------------------------------------
+    def precompile(self):
+        """Paged-engine warmup. The attention-impl trace context must
+        wrap the warms exactly as it wraps the live calls — a kernel-
+        config engine warmed outside the context would compile (and
+        commit under the kernel key) the gather program."""
+        pk = [l.k for l in self._pool]
+        pv = [l.v for l in self._pool]
+        tables = jnp.asarray(self._tables)
+        pos = jnp.asarray(self._pos)
+        key = self._warm_key()
+        out = {}
+        with blocks.attention_impl(self.config.attention_impl):
+            out["decode"] = self._decode.warm(
+                self._params, pk, pv, tables, pos,
+                jnp.zeros((self.config.slots,), jnp.int32), key)
+            for b in self.config.prefill_buckets:
+                if b not in self._prefill:
+                    self._prefill[b] = self._make_prefill(b)
+                out[f"prefill[{b}]"] = self._prefill[b].warm(
+                    self._params, pk, pv, tables, pos,
+                    jnp.asarray(0, jnp.int32), jnp.zeros((b,), jnp.int32),
+                    jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
+                    key)
+        return out
+
     # -- functional forward (paged) -----------------------------------------
     def _run_model_paged(self, params, pool_k, pool_v, tables, pos, ids):
         cache = blocks.PagedDecodeCache(
@@ -434,7 +564,7 @@ class PagedGenerationEngine(GenerationEngine):
                                                 keepdims=False)
             first_token = self._select(last[None, :], key)[0]
             return first_token, npk, npv, pos
-        return jax.jit(prefill_fn)
+        return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     # -- public compute API --------------------------------------------------
     def prefill(self, slot, prompt_ids):
@@ -549,11 +679,60 @@ class PagedGenerationEngine(GenerationEngine):
         return self._pos.copy()
 
 
-def save_for_generation(model, path, input_spec=None):
+def default_compile_cache_dir(path):
+    """The persistent executable cache that lives NEXT TO a serving
+    artifact — what artifact-build precompile writes and a cold
+    Predictor loads."""
+    return os.path.join(os.path.dirname(os.path.abspath(path)),
+                        COMPILE_CACHE_DIRNAME)
+
+
+def _engine_kind(config):
+    """"dense" | "paged" | "spec" for an EngineConfig-family instance
+    (most-derived class first)."""
+    from .spec_decode import SpecDecodeConfig
+    if isinstance(config, SpecDecodeConfig):
+        return "spec"
+    if isinstance(config, PagedEngineConfig):
+        return "paged"
+    if isinstance(config, EngineConfig):
+        return "dense"
+    raise TypeError(f"engine_config must be an EngineConfig, got "
+                    f"{type(config).__name__}")
+
+
+def make_engine(model, kind, config_dict, compile_cache_dir=None):
+    """Rebuild an engine from a `.gencfg` serving record: the recorded
+    ctor kwargs plus a machine-local compile-cache dir."""
+    from .spec_decode import SpecDecodeConfig, SpeculativeEngine
+    classes = {"dense": (GenerationEngine, EngineConfig),
+               "paged": (PagedGenerationEngine, PagedEngineConfig),
+               "spec": (SpeculativeEngine, SpecDecodeConfig)}
+    if kind not in classes:
+        raise ValueError(f"unknown serving engine kind {kind!r}; "
+                         f"want one of {sorted(classes)}")
+    engine_cls, cfg_cls = classes[kind]
+    cfg = cfg_cls(compile_cache_dir=compile_cache_dir, **config_dict)
+    return engine_cls(model, cfg)
+
+
+def save_for_generation(model, path, input_spec=None, engine_config=None,
+                        precompile=False, compile_cache_dir=None):
     """jit.save the model's plain forward AND persist its GPTConfig next to
     the artifact (`path.gencfg`), so a cold `inference.Predictor` can
     rebuild the cached-forward Layer and serve `generate` — the
-    generation analogue of save_inference_model."""
+    generation analogue of save_inference_model.
+
+    With `engine_config` (an EngineConfig/PagedEngineConfig/
+    SpecDecodeConfig), the sidecar additionally records the serving
+    engine kind, its config, and the full executable set (decode + every
+    prefill bucket + the speculative draft/verify set), so a Predictor
+    rebuilds the EXACT engine the artifact was built for. With
+    `precompile=True` the whole set is AOT-compiled right now into the
+    artifact's persistent compile cache (`compile_cache_dir`, default a
+    `_compile_cache/` sibling) — a cold Predictor then deserializes
+    executables instead of compiling and is serving in seconds. Returns
+    the precompile report ({executable: hit|miss|off}) or None."""
     from ..jit import save as jit_save
     from ..static import InputSpec
     from ..text.models.gpt import GPT, GPTForGeneration
@@ -573,8 +752,38 @@ def save_for_generation(model, path, input_spec=None):
         "vocab_size", "max_position_embeddings", "hidden_size", "num_layers",
         "num_heads", "intermediate_size", "hidden_dropout",
         "attention_dropout", "initializer_range", "tie_embeddings")}
+    meta = {"model_family": "gpt", "config": cfg}
+    engine = None
+    if precompile and engine_config is None:
+        raise ValueError("precompile=True needs an engine_config: the "
+                         "executable set to AOT-build is derived from it")
+    if engine_config is not None:
+        kind = _engine_kind(engine_config)
+        cache_dir = compile_cache_dir or default_compile_cache_dir(path)
+        if precompile:
+            engine = make_engine(model, kind, engine_config.as_dict(),
+                                 compile_cache_dir=cache_dir)
+            names = engine.executable_names()
+        else:
+            names = _executable_set(kind, engine_config)
+        meta["serving"] = {"engine": kind,
+                           "config": engine_config.as_dict(),
+                           "executables": names}
     with open(path + GENCFG_SUFFIX, "w") as f:
-        json.dump({"model_family": "gpt", "config": cfg}, f)
+        json.dump(meta, f)
+    if engine is not None:
+        return engine.precompile()
+    return None
+
+
+def _executable_set(kind, config):
+    """Executable names for a serving record without building the engine
+    (the precompile=False recording path)."""
+    names = ["decode"] + [f"prefill[{b}]" for b in config.prefill_buckets]
+    if kind == "spec":
+        names += ["draft_decode", "spec_verify"]
+        names += [f"draft_prefill[{b}]" for b in config.prefill_buckets]
+    return names
 
 
 def load_generation_model(prog_file, params):

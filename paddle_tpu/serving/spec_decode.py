@@ -120,6 +120,8 @@ class SpecDecodeConfig(PagedEngineConfig):
             raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.draft_layers = int(draft_layers)
 
+    _DICT_FIELDS = PagedEngineConfig._DICT_FIELDS + ("gamma", "draft_layers")
+
 
 class SpeculativeEngine(PagedGenerationEngine):
     """PagedGenerationEngine whose decode step is a speculative round.
@@ -165,10 +167,25 @@ class SpeculativeEngine(PagedGenerationEngine):
         self.trace_counts["draft_decode"] = 0
         self.trace_counts["spec_verify"] = 0
         self.trace_counts["draft_prefill"] = {}
-        self._draft_decode = jax.jit(self._draft_decode_fn)
-        self._spec_verify = jax.jit(self._spec_verify_fn)
+        # cached through the same persistent tier as the target's
+        # executables; the compile signature now includes the draft's
+        # config (set above), so draft-shape changes can never alias
+        self._draft_decode = self._cached(self._draft_decode_fn,
+                                          "draft_decode")
+        self._spec_verify = self._cached(self._spec_verify_fn, "spec_verify")
         self._draft_prefill = {}
         self.last_spec_stats = {}
+
+    def _compile_signature(self):
+        """The paged signature plus the draft model's config. During
+        `super().__init__` (decode/prefill construction) the draft does
+        not exist yet — those executables run the TARGET model only, so
+        their signature correctly omits it."""
+        sig = super()._compile_signature()
+        draft = getattr(self, "draft_model", None)
+        if draft is not None:
+            sig["draft"] = dataclasses.asdict(draft.cfg)
+        return sig
 
     @property
     def decode_write_tokens(self):
@@ -228,7 +245,38 @@ class SpeculativeEngine(PagedGenerationEngine):
             pos = jax.lax.dynamic_update_slice(
                 pos, length[None].astype(pos.dtype), (slot,))
             return lk, lv, pos
-        return jax.jit(fn)
+        return self._cached(fn, f"draft_prefill[{bucket}]")
+
+    # -- AOT warmup ----------------------------------------------------------
+    def executable_names(self):
+        return super().executable_names() + \
+            ["draft_decode", "spec_verify"] + \
+            [f"draft_prefill[{b}]" for b in self.config.prefill_buckets]
+
+    def precompile(self):
+        """Target set (paged precompile) plus the speculative set: the
+        draft decode/prefill executables and the [slots, γ+1] verify."""
+        out = super().precompile()
+        c = self.config
+        dk = [l.k for l in self._draft_kv]
+        dv = [l.v for l in self._draft_kv]
+        dpos = jnp.asarray(self._draft_pos)
+        out["draft_decode"] = self._draft_decode.warm(
+            self._draft_params, dk, dv, dpos,
+            jnp.zeros((c.slots,), jnp.int32))
+        with blocks.attention_impl(c.attention_impl):
+            out["spec_verify"] = self._spec_verify.warm(
+                self._params, [l.k for l in self._pool],
+                [l.v for l in self._pool], jnp.asarray(self._tables),
+                jnp.asarray(self._pos),
+                jnp.zeros((c.slots, c.gamma + 1), jnp.int32))
+        for b in c.prefill_buckets:
+            if b not in self._draft_prefill:
+                self._draft_prefill[b] = self._make_draft_prefill(b)
+            out[f"draft_prefill[{b}]"] = self._draft_prefill[b].warm(
+                self._draft_params, dk, dv, dpos, jnp.asarray(0, jnp.int32),
+                jnp.zeros((b,), jnp.int32), jnp.asarray(1, jnp.int32))
+        return out
 
     # -- public compute API --------------------------------------------------
     def prefill(self, slot, prompt_ids):

@@ -1,0 +1,444 @@
+"""Persistent compile cache + AOT serving warmup (ISSUE 8).
+
+The load-bearing properties:
+
+  - a process (or engine) restarted against a warm cache performs ZERO
+    fresh compilations for the serving executable set — proven by the
+    engine trace counters staying 0 (they tick only when jax traces)
+    plus compile_cache hits, and by `bench.py --cold-start` reporting a
+    warm process strictly faster to serving-ready than a cold one;
+  - cache corruption in every flavor (torn write via fault injection,
+    SIGKILL inside the commit window, post-commit truncation, version
+    skew) degrades to a miss-and-recompile — never a crash, never a
+    wrong executable;
+  - `device.clear_op_cache()` is coherent across tiers: a cleared
+    in-memory cache cannot resurrect a pre-clear persistent entry.
+
+Crash cases reuse the test_checkpoint.py kill-window pattern and the
+`observability/faults.py` `checkpoint.write` site, which fires inside
+`ckpt_commit.atomic_commit` — the same protocol cache entries commit
+through.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.device as device
+from paddle_tpu.framework import ckpt_commit
+from paddle_tpu.framework import compile_cache as cc
+from paddle_tpu.observability import faults
+from paddle_tpu.serving import EngineConfig, GenerationEngine
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    faults.disarm_all()
+    cc.detach()
+
+
+def _mul_add(x, y):
+    return x * y + 1.0
+
+
+# ------------------------------------------------------------ fundamentals
+
+def test_cached_jit_roundtrip_and_stats(tmp_path):
+    import jax.numpy as jnp
+    cache = cc.CompileCache(str(tmp_path))
+    a, b = jnp.ones((4, 4)), jnp.full((4, 4), 2.0)
+    f1 = cc.cached_jit(_mul_add, "t.f", static_sig={"v": 1}, cache=cache)
+    r1 = np.asarray(f1(a, b))
+    assert cache.stats == {"hits": 0, "misses": 1, "bypass": 0,
+                           "corrupt": 0, "uncacheable": 0}
+    assert len(cache.entries()) == 1
+    # a FRESH CachedFunction (fresh jit, as in a restarted process)
+    # deserializes instead of compiling
+    f2 = cc.cached_jit(_mul_add, "t.f", static_sig={"v": 1}, cache=cache)
+    np.testing.assert_array_equal(np.asarray(f2(a, b)), r1)
+    assert cache.stats["hits"] == 1 and cache.stats["misses"] == 1
+    # a different static signature is a different program
+    f3 = cc.cached_jit(_mul_add, "t.f", static_sig={"v": 2}, cache=cache)
+    assert f3.warm(a, b) == "miss"
+    # a different aval signature too
+    assert f2.warm(jnp.ones((2, 2)), jnp.ones((2, 2))) == "miss"
+    # no cache anywhere: transparently plain jit
+    f4 = cc.cached_jit(_mul_add, "t.f", static_sig={"v": 1})
+    assert f4.warm(a, b) == "off"
+    np.testing.assert_array_equal(np.asarray(f4(a, b)), r1)
+
+
+def test_lowering_mode_is_content_addressed(tmp_path):
+    import jax.numpy as jnp
+    cache = cc.CompileCache(str(tmp_path))
+    a = jnp.ones((3, 3))
+    cc.cached_jit(_mul_add, "op.x", key_mode="lowering", cache=cache)(a, a)
+    before = cache.stats["hits"]
+    # a DIFFERENT python callable with the SAME program content hits
+    other = cc.cached_jit(lambda x, y: x * y + 1.0, "op.x",
+                          key_mode="lowering", cache=cache)
+    other(a, a)
+    assert cache.stats["hits"] == before + 1
+    # a semantically different program misses
+    changed = cc.cached_jit(lambda x, y: x * y + 2.0, "op.x",
+                            key_mode="lowering", cache=cache)
+    assert changed.warm(a, a) == "miss"
+
+
+# ------------------------------------------------- op-cache tier coherence
+
+def test_eager_op_runners_use_persistent_tier(tmp_path):
+    cc.attach(str(tmp_path))
+    device.clear_op_cache()            # drop pre-test runners; stamp is
+    cc.active()._min_ts = 0.0          # reset so this test sees its writes
+    t = paddle.to_tensor(np.arange(6.0, dtype=np.float32))
+    base = dict(cc.active().stats)
+    r = (t * 3.0)
+    np.testing.assert_array_equal(r.numpy(), np.arange(6.0) * 3.0)
+    assert cc.active().stats["misses"] == base["misses"] + 1
+    assert any(e.startswith("op.") for e in cc.active().entries())
+    # a fresh runner for the same op (in-memory cache cleared, stamp
+    # bypassed for entries already re-committed AFTER the clear) hits
+    stamp = cc.active()._min_ts
+    device.clear_op_cache()
+    assert cc.active()._min_ts > stamp
+
+
+def test_clear_op_cache_cannot_resurrect_stale_entry(tmp_path):
+    """Satellite regression: after clear_op_cache(), a persistent entry
+    committed BEFORE the clear must not be served again in this process
+    (in-memory clear + persistent bypass are one coherent operation)."""
+    cc.attach(str(tmp_path))
+    device.clear_op_cache()            # fresh runners; then re-open the
+    cc.active()._min_ts = 0.0          # stamp so this test's writes serve
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    _ = (t + 7.0)
+    stats0 = dict(cc.active().stats)
+    n_entries = len(cc.active().entries())
+    assert n_entries >= 1
+    device.clear_op_cache()
+    _ = (t + 7.0)                      # same op identity, post-clear
+    stats1 = dict(cc.active().stats)
+    # served as a bypass-miss and recompiled — NOT a hit on the old entry
+    assert stats1["hits"] == stats0["hits"]
+    assert stats1["bypass"] > stats0["bypass"]
+    assert stats1["misses"] > stats0["misses"]
+    # the entry was recommitted (fresh timestamp): hits again within the
+    # post-clear epoch
+    t2 = paddle.to_tensor(np.ones(4, np.float32))
+    from paddle_tpu.core import tensor as _ct
+    _ct._EAGER_CACHE.clear()           # in-memory only, no invalidate
+    _ = (t2 + 7.0)
+    assert cc.active().stats["hits"] == stats1["hits"] + 1
+
+
+# --------------------------------------------------------- crash/corruption
+
+def test_injected_torn_write_never_commits(tmp_path):
+    """faults `checkpoint.write` truncate fires inside the entry commit:
+    the store fails CONTAINED (warning, no entry), the call still
+    returns, and the next lookup recompiles."""
+    import jax.numpy as jnp
+    cache = cc.CompileCache(str(tmp_path))
+    a = jnp.ones((4,))
+    faults.arm("checkpoint.write", mode="truncate", nth=1)
+    with pytest.warns(UserWarning, match="commit .* failed|not persisted"):
+        f = cc.cached_jit(_mul_add, "t.torn", cache=cache)
+        out = np.asarray(f(a, a))      # computes fine despite the tear
+    np.testing.assert_array_equal(out, np.ones(4) * 2.0)
+    assert cache.entries() == []
+    assert cache.stats["uncacheable"] == 1
+    faults.disarm_all()
+    # with the fault gone the same program commits and then hits
+    f2 = cc.cached_jit(_mul_add, "t.torn", cache=cache)
+    f2(a, a)
+    assert len(cache.entries()) == 1
+    f3 = cc.cached_jit(_mul_add, "t.torn", cache=cache)
+    assert f3.warm(a, a) == "hit"
+
+
+def test_sigkill_mid_commit_recovers(tmp_path):
+    """Kill -9 inside the commit window (data files written, manifest
+    not): the survivor sees no entry — hidden tempdir only — and
+    recompiles; the stale tempdir is swept by the next commit."""
+    cache_dir = str(tmp_path / "cache")
+    script = f"""
+import os
+import paddle_tpu
+from paddle_tpu.framework import compile_cache as cc
+import jax.numpy as jnp
+cache = cc.CompileCache({cache_dir!r})
+f = cc.cached_jit(lambda x: x * 2.0 + 1.0, "t.kill", cache=cache)
+print("READY", flush=True)
+f(jnp.ones((8,)))                      # commit blocks in the delay window
+print("DONE", flush=True)
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PTN_FAULTS="checkpoint.write=delay:delay=120:max=1")
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True, env=env,
+                            cwd=_ROOT)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        # the child is now compiling, then holds the commit open for
+        # 120s; give the data files time to land, then kill the window
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if any(n.startswith(".") for n in
+                   os.listdir(cache_dir) if os.path.isdir(
+                       os.path.join(cache_dir, n))):
+                break
+            time.sleep(0.1)
+        time.sleep(0.3)                # inside the held-open window
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+    # survivor: nothing committed, lookup is a clean miss + recompile
+    cache = cc.CompileCache(cache_dir)
+    assert cache.entries() == []
+    import jax.numpy as jnp
+    f = cc.cached_jit(lambda x: x * 2.0 + 1.0, "t.kill", cache=cache)
+    out = np.asarray(f(jnp.ones((8,))))
+    np.testing.assert_array_equal(out, np.full(8, 3.0))
+    assert cache.stats == {"hits": 0, "misses": 1, "bypass": 0,
+                           "corrupt": 0, "uncacheable": 0}
+    assert len(cache.entries()) == 1
+    # the dead child's hidden tempdir was swept by the commit
+    assert not any(n.startswith(".") and ".tmp." in n
+                   for n in os.listdir(cache_dir))
+
+
+def test_truncated_entry_recovers(tmp_path):
+    """Post-commit bit rot: a truncated entry file fails manifest
+    verification at load — the entry is deleted and recompiled, the call
+    succeeds."""
+    import jax.numpy as jnp
+    cache = cc.CompileCache(str(tmp_path))
+    a = jnp.ones((5,))
+    cc.cached_jit(_mul_add, "t.rot", cache=cache)(a, a)
+    (entry,) = cache.entries()
+    victim = None
+    for name in os.listdir(str(tmp_path / entry)):
+        if name != ckpt_commit.MANIFEST:
+            victim = os.path.join(str(tmp_path / entry), name)
+            break
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.truncate(size // 2)
+    f2 = cc.cached_jit(_mul_add, "t.rot", cache=cache)
+    with pytest.warns(UserWarning, match="failed verification"):
+        out = np.asarray(f2(a, a))
+    np.testing.assert_array_equal(out, np.full(5, 2.0))
+    assert cache.stats["corrupt"] == 1
+    # recompiled and recommitted: a third function hits cleanly
+    assert len(cache.entries()) == 1
+    f3 = cc.cached_jit(_mul_add, "t.rot", cache=cache)
+    assert f3.warm(a, a) == "hit"
+
+
+def test_version_skew_entry_rejected(tmp_path):
+    """Defense in depth: an entry whose manifest verifies but whose meta
+    names another jax build reads as a miss (deleted + recompiled),
+    never a deserialization of a foreign executable."""
+    import jax.numpy as jnp
+    cache = cc.CompileCache(str(tmp_path))
+    a = jnp.ones((3,))
+    cc.cached_jit(_mul_add, "t.skew", cache=cache)(a, a)
+    (entry,) = cache.entries()
+    full = str(tmp_path / entry)
+    with open(os.path.join(full, cc.ENTRY_META)) as f:
+        meta = json.load(f)
+    meta["jax_version"] = "0.0.0"
+    # recommit THROUGH the protocol so the manifest stays valid — only
+    # the meta lies
+    with ckpt_commit.atomic_commit(full) as tmp:
+        with open(os.path.join(tmp, cc.ENTRY_META), "w") as f:
+            json.dump(meta, f)
+        import shutil
+        for name in os.listdir(full):
+            if name not in (cc.ENTRY_META, ckpt_commit.MANIFEST):
+                shutil.copy2(os.path.join(full, name),
+                             os.path.join(tmp, name))
+    f2 = cc.cached_jit(_mul_add, "t.skew", cache=cache)
+    with pytest.warns(UserWarning, match="failed to load"):
+        out = np.asarray(f2(a, a))
+    np.testing.assert_array_equal(out, np.full(3, 2.0))
+    assert cache.stats["corrupt"] == 1
+
+
+# ------------------------------------------------------ serving AOT warmup
+
+def test_engine_restart_zero_compiles(tmp_path):
+    """The acceptance core, engine-level: a second engine over a warm
+    cache deserializes its whole executable set — trace counters stay 0
+    through precompile AND live serving, and tokens are exact."""
+    from paddle_tpu.text.models import gpt_tiny
+    model = gpt_tiny()
+    model.eval()
+    mk = lambda: EngineConfig(slots=2, max_len=32,  # noqa: E731
+                              compile_cache_dir=str(tmp_path))
+    e1 = GenerationEngine(model, mk())
+    rep = e1.precompile()
+    assert set(rep) == set(e1.executable_names())
+    assert all(v == "miss" for v in rep.values())
+    assert e1.trace_counts["decode"] == 1
+
+    e2 = GenerationEngine(model, mk())
+    rep2 = e2.precompile()
+    assert all(v == "hit" for v in rep2.values()), rep2
+    assert e2.trace_counts["decode"] == 0
+    assert e2.trace_counts["prefill"] == {}
+    assert e2.compile_cache.stats["misses"] == 0
+
+    prompt = np.random.RandomState(3).randint(0, model.cfg.vocab_size, 6)
+    t1 = [e1.prefill(0, prompt)]
+    t2 = [e2.prefill(0, prompt)]
+    for _ in range(4):
+        t1.append(int(e1.decode()[0]))
+        t2.append(int(e2.decode()[0]))
+    assert t1 == t2
+    # the proof the ISSUE names: zero fresh compilations at serve time
+    assert e2.trace_counts["decode"] == 0
+    assert e2.trace_counts["prefill"] == {}
+    assert e2.compile_cache.stats["hits"] >= 2
+
+
+def test_spec_engine_restart_zero_compiles(tmp_path):
+    """The speculative set (draft decode/prefill + the [slots, γ+1]
+    verify) rides the same cache: a restarted spec engine deserializes
+    ALL of it and decodes bit-identically with zero traces."""
+    from paddle_tpu.serving import SpecDecodeConfig, SpeculativeEngine
+    from paddle_tpu.text.models import gpt_tiny
+    model = gpt_tiny()
+    model.eval()
+    mk = lambda: SpecDecodeConfig(  # noqa: E731
+        slots=2, max_len=32, block_size=8, gamma=2, draft_layers=1,
+        compile_cache_dir=str(tmp_path))
+    e1 = SpeculativeEngine(model, mk())
+    rep1 = e1.precompile()
+    assert set(rep1) == set(e1.executable_names())
+    assert all(v == "miss" for v in rep1.values()), rep1
+
+    e2 = SpeculativeEngine(model, mk())
+    rep2 = e2.precompile()
+    assert all(v == "hit" for v in rep2.values()), rep2
+    for k in ("decode", "draft_decode", "spec_verify"):
+        assert e2.trace_counts[k] == 0
+    assert e2.trace_counts["prefill"] == {}
+    assert e2.trace_counts["draft_prefill"] == {}
+
+    prompt = [3, 1, 4, 1, 5]
+    e1.prefill(0, prompt)
+    e2.prefill(0, prompt)
+    t1, _ = e1.decode_many()
+    t2, _ = e2.decode_many()
+    np.testing.assert_array_equal(t1, t2)
+    for k in ("decode", "draft_decode", "spec_verify"):
+        assert e2.trace_counts[k] == 0
+    assert e2.trace_counts["prefill"] == {}
+    assert e2.trace_counts["draft_prefill"] == {}
+    assert e2.compile_cache.stats["misses"] == 0
+
+
+def test_cold_predictor_serves_warm_with_zero_compiles(tmp_path):
+    """Process-restart acceptance: a builder PROCESS precompiles the
+    artifact's executable set; this (restarted) process loads a cold
+    Predictor whose engine never traces — compile_cache hits are the
+    only source of executables — and generates token-exactly."""
+    artifact = str(tmp_path / "gpt")
+    script = f"""
+import paddle_tpu
+from paddle_tpu.serving import EngineConfig, save_for_generation
+from paddle_tpu.text.models import gpt_tiny
+m = gpt_tiny(); m.eval()
+rep = save_for_generation(m, {artifact!r},
+                          engine_config=EngineConfig(slots=2, max_len=32),
+                          precompile=True)
+assert all(v == "miss" for v in rep.values()), rep
+print("BUILT", len(rep), flush=True)
+"""
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=420,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                         cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.startswith("BUILT")
+
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(artifact + ".pdmodel",
+                                   artifact + ".pdiparams"))
+    engine = pred._gen_sched.engine
+    assert engine.trace_counts["decode"] == 0
+    assert engine.trace_counts["prefill"] == {}
+    assert engine.compile_cache.stats["misses"] == 0
+    assert engine.compile_cache.stats["hits"] >= 2
+    got = pred.generate([[5, 6, 7, 8]], max_new_tokens=4)[0]
+    # still zero compiles after serving real requests
+    assert engine.trace_counts["decode"] == 0
+    assert engine.trace_counts["prefill"] == {}
+    # never a wrong executable: token-exact vs a cache-free engine over
+    # the same loaded weights
+    ref = GenerationEngine(engine._model, EngineConfig(slots=2, max_len=32))
+    want = [ref.prefill(0, [5, 6, 7, 8])]
+    for _ in range(3):
+        want.append(int(ref.decode()[0]))
+    assert got == want
+    # explicit engine kwargs still win over the recorded engine: the
+    # auto-built scheduler is replaced, not silently kept
+    got2 = pred.generate([[5, 6]], max_new_tokens=2, slots=3, max_len=16)
+    assert pred._gen_sched.engine.config.slots == 3
+    assert len(got2[0]) == 2
+
+
+def test_gencfg_records_executable_set(tmp_path):
+    """The sidecar carries the serving record even without precompile,
+    so any later loader knows the full executable set."""
+    from paddle_tpu.serving import save_for_generation
+    from paddle_tpu.text.models import gpt_tiny
+    m = gpt_tiny()
+    m.eval()
+    path = str(tmp_path / "gpt")
+    save_for_generation(m, path,
+                        engine_config=EngineConfig(slots=2, max_len=32))
+    with open(path + ".gencfg") as f:
+        meta = json.load(f)
+    assert meta["serving"]["engine"] == "dense"
+    assert meta["serving"]["config"]["slots"] == 2
+    assert "decode" in meta["serving"]["executables"]
+    assert "prefill[32]" in meta["serving"]["executables"]
+    # precompile without an engine_config is a loud error
+    with pytest.raises(ValueError, match="engine_config"):
+        save_for_generation(m, path, precompile=True)
+
+
+def test_bench_cold_start_rung(tmp_path):
+    """`bench.py --cold-start` emits the driver schema, the warm child
+    beats the cold child to serving-ready, and the rung's own
+    zero-compile assertions held (it would have failed otherwise)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_INIT_BUDGET_S="120",
+               BENCH_COLDSTART_DIR=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--cold-start"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "gpt_cold_start_warm_ready_s"
+    assert "error" not in rec, rec
+    extra = rec["extra"]
+    assert extra["warm_beats_cold"] is True
+    assert rec["vs_baseline"] > 1.0
+    assert extra["warm"]["compile_cache"]["misses"] == 0
+    assert extra["warm"]["trace_counts"]["decode"] == 0
+    assert extra["cold"]["compile_cache"]["misses"] >= 2
+    assert extra["warm"]["first_token"] == extra["cold"]["first_token"]

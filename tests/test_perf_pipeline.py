@@ -216,6 +216,105 @@ def test_metrics_compare_flags_spec_acceptance_rate_drop(tmp_path):
                    metrics_report.compare_counters(a, c))
 
 
+def test_bench_emits_cost_model_delta(bench_artifacts):
+    """ISSUE 8 satellite (ROADMAP item 1 debt): every bench run carries
+    the analytical predicted-vs-measured block in extra, and the
+    prediction/measurement gauges ride the metrics artifact so
+    --compare can gate the gap."""
+    out_dir, rec = bench_artifacts
+    cm = rec["extra"]["cost_model"]
+    assert "error" not in cm, cm
+    assert cm["predicted_step_ms"] > 0
+    assert cm["measured_step_ms"] > 0
+    assert cm["measured_vs_predicted"] == pytest.approx(
+        cm["measured_step_ms"] / cm["predicted_step_ms"], rel=1e-3)
+    assert cm["per_op"], "per-op prediction table is empty"
+    for row in cm["per_op"].values():
+        assert row["predicted_ms"] >= 0
+        assert "delta_ms" in row and "measured_share_ms" in row
+    # the gauges landed in the registry snapshot artifact
+    snaps = metrics_report.load_snapshots(
+        rec["extra"]["profile_artifacts"]["metrics"])
+    names = {m["name"] for m in snaps[-1]["metrics"]}
+    for g in ("bench_cost_model_predicted_step_ms",
+              "bench_cost_model_measured_step_ms",
+              "bench_cost_model_measured_vs_predicted"):
+        assert g in names, f"{g} missing from snapshot"
+
+
+def _snapshot_with_gauges(counters=None, gauges=None):
+    metrics = [
+        {"name": n, "type": "counter", "help": "", "labelnames": [],
+         "samples": [{"labels": {}, "value": v}]}
+        for n, v in (counters or {}).items()]
+    metrics += [
+        {"name": n, "type": "gauge", "help": "", "labelnames": [],
+         "samples": [{"labels": {}, "value": v}]}
+        for n, v in (gauges or {}).items()]
+    return {"schema": metrics_report.SCHEMA, "ts": 1.0, "pid": 1,
+            "metrics": metrics}
+
+
+def test_metrics_compare_flags_compile_cache_hit_rate_drop(tmp_path):
+    """ISSUE 8 gate: a persistent compile-cache hit-RATE drop is a
+    failure-class regression (restarts started compiling again) even
+    when the absolute hit count grew with more executables."""
+    a = _snapshot_with({"compile_cache_hits_total": 9,
+                        "compile_cache_misses_total": 1,
+                        "serving_tokens_total": 100})
+    b = _snapshot_with({"compile_cache_hits_total": 10,   # grew...
+                        "compile_cache_misses_total": 10,  # rate 0.9 -> 0.5
+                        "serving_tokens_total": 100})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("compile_cache_hit_rate") == "hit rate dropped"
+    # growth at the same rate passes the rate rule
+    c = _snapshot_with({"compile_cache_hits_total": 90,
+                        "compile_cache_misses_total": 10,
+                        "serving_tokens_total": 1000})
+    assert not any(w == "hit rate dropped" for *_, w in
+                   metrics_report.compare_counters(a, c))
+    # and the CLI gate exits nonzero on the drop
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "compile_cache_hit_rate" in bad.stdout
+
+
+def test_metrics_compare_flags_cost_model_gap_growth(tmp_path):
+    """ISSUE 8 satellite gate: the measured/predicted step-time gauge
+    GROWING past the threshold is failure-class; shrinking (we got
+    faster than the model expected) is not."""
+    a = _snapshot_with_gauges(
+        gauges={"bench_cost_model_measured_vs_predicted": 2.0,
+                "bench_cost_model_predicted_step_ms": 10.0})
+    b = _snapshot_with_gauges(
+        gauges={"bench_cost_model_measured_vs_predicted": 3.5,
+                "bench_cost_model_predicted_step_ms": 10.0})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("bench_cost_model_measured_vs_predicted") == \
+        "measured/predicted gap widened"
+    # improvement or stability: clean
+    assert metrics_report.compare_counters(a, a) == []
+    assert metrics_report.compare_counters(b, a) == []
+    # the CLI gate trips on the widened gap
+    pa, pb = str(tmp_path / "ga.jsonl"), str(tmp_path / "gb.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "gap widened" in bad.stdout
+
+
 def test_validate_record_catches_rot():
     good = {"schema": perf_report.SCHEMA, "step": 0, "step_ms": 1.0,
             "phases": {"Forward": 1.0}, "ops": [], "num_samples": None,

@@ -21,7 +21,13 @@ threshold. Direction matters and is decided per counter name:
     run that suddenly retries more is a regression even when it still
     converges,
   - all other counters (work done: tokens, requests, bytes, hits):
-    regression = the count SHRANK past the threshold.
+    regression = the count SHRANK past the threshold,
+  - rate pairs (X_hits/X_misses incl. the persistent compile cache,
+    spec accepted/proposed): the RATIO dropping past the threshold is
+    failure-class even when the numerator grew with traffic,
+  - gap gauges (bench_cost_model_measured_vs_predicted): the measured/
+    analytically-predicted step-time ratio GROWING past the threshold
+    is failure-class — the hardware regressed or the model lost contact.
 
 Small-count noise is ignored via --min-delta (absolute floor, default 1).
 
@@ -45,7 +51,11 @@ _FAIL_PAT = re.compile(
 # (e.g. more traffic, worse prefix sharing / draft acceptance). Each
 # entry: (numerator regex, denominator suffix, denominator-includes-
 # numerator?, rate name suffix).
-#   hits/(hits+misses)    — prefix-cache style hit rate
+#   hits/(hits+misses)    — prefix-cache style hit rate; the SAME rule
+#                           covers compile_cache_{hits,misses}_total
+#                           (the ISSUE 8 gate: a persistent-cache
+#                           hit-rate drop means restarts started
+#                           compiling again)
 #   accepted/proposed     — spec-decode acceptance rate (the ISSUE 7
 #                           gate: a rate drop means the draft rots or
 #                           the verify rule broke, even under growth)
@@ -54,6 +64,16 @@ _RATE_RULES = (
      "misses_total", True, "hit_rate"),
     (re.compile(r"^(?P<base>.*_)accepted_total(?P<labels>\{.*\})?$"),
      "proposed_total", False, "acceptance_rate"),
+)
+
+# GAUGE rules: gauges whose GROWTH past the threshold is failure-class.
+# bench_cost_model_measured_vs_predicted is the analytical-delta gate
+# (ROADMAP item 1 debt): the bench publishes measured/predicted step
+# time every run — the ratio growing means the step got slower relative
+# to what the roofline says the hardware can do.
+_GAUGE_GROW_RULES = (
+    (re.compile(r"cost_model_measured_vs_predicted(\{.*\})?$"),
+     "measured/predicted gap widened"),
 )
 
 
@@ -276,6 +296,17 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
         pct = (vb - va) / va * 100.0
         if vb < va and -pct > max_regress_pct:
             regressions.append((key, va, vb, pct, "hit rate dropped"))
+    ga, gb = flatten(a_rec, ("gauge",)), flatten(b_rec, ("gauge",))
+    for key in sorted(set(ga) & set(gb)):
+        for pat, why in _GAUGE_GROW_RULES:
+            if not pat.search(key):
+                continue
+            va, vb = ga[key], gb[key]
+            if va <= 0:
+                continue
+            pct = (vb - va) / va * 100.0
+            if vb > va and pct > max_regress_pct:
+                regressions.append((key, va, vb, pct, why))
     return regressions
 
 
