@@ -47,6 +47,13 @@ UNIT = "MFU (fraction of v5e bf16 peak)"
 # global so its signature stays stable for the ladder tests)
 _PROFILE_DIR = None
 
+# --xplane one-shot device-capture controller (observability.deviceprof.
+# OneShotCapture, armed by main; run_config fires it in the first healthy
+# window — past warmup, watchdog quiet). Armed state rides the flight
+# recorder's annotations, so a wedged run's postmortem records the
+# armed-but-unfired capture instead of losing it.
+_XPLANE_CTRL = None
+
 
 def emit(value, vs_baseline, extra=None, error=None):
     rec = {"metric": METRIC, "value": value, "unit": UNIT,
@@ -392,6 +399,23 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         loss, params, state = dispatch(params, state, toks, labs, lr)
         loss_val = float(loss)          # host fetch = true device sync
 
+    # healthy window: compiled, warmed, watchdog quiet — if a one-shot
+    # device capture is armed (--xplane), fire it NOW on one extra
+    # dispatch OUTSIDE the timed loop (the capture must not perturb the
+    # measurement), with a full host sync before the window closes so
+    # every device op of the dispatch lands inside it
+    xplane = _XPLANE_CTRL
+    if xplane is not None and xplane.armed and xplane.start():
+        try:
+            loss, params, state = dispatch(params, state, toks, labs, lr)
+            loss_val = float(loss)      # sync INSIDE the trace window
+        except BaseException as e:
+            # close the trace window before the ladder steps down, or it
+            # would poison every later rung's start_trace
+            xplane.abort(f"{type(e).__name__}: {str(e)[:200]}")
+            raise
+        xplane.stop()
+
     prof = None
     profile_paths = {}
     if _PROFILE_DIR:
@@ -439,6 +463,15 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
     # written, so the predicted-vs-measured gauges ride the artifact set
     cost_model = _cost_model_measure(cost_model,
                                      1000 * dt / (n_dispatch * scan_k))
+
+    deviceprof_block = None
+    if xplane is not None and xplane.captured:
+        # parse + join the capture against the analytical per-op
+        # predictions; the deviceprof_* gauges land in the registry here,
+        # BEFORE the --profile snapshot below is written
+        deviceprof_block = xplane.finalize(
+            cost_model_per_op=(cost_model or {}).get("per_op"),
+            steps=scan_k)
 
     if prof is not None:
         prof.stop()
@@ -489,6 +522,8 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
                   "n_steps": total_steps, "scan_k": scan_k,
                   "step_ms": round(1000 * dt / total_steps, 1),
                   "loss": loss_val, "cost_model": cost_model,
+                  **({"deviceprof": deviceprof_block}
+                     if deviceprof_block else {}),
                   **extra_profile},
     }
 
@@ -506,6 +541,16 @@ def _parse_args(argv):
                    help="artifact directory for --profile")
     p.add_argument("--steps", type=int, default=None,
                    help="override the number of timed train steps")
+    p.add_argument("--xplane", nargs="?", const="__default__", default=None,
+                   metavar="DIR",
+                   help="arm a one-shot device-profile capture "
+                        "(jax.profiler XPlane) that fires in the first "
+                        "healthy window of the train rung — one extra "
+                        "dispatch between warmup and the timed loop — and "
+                        "writes the raw trace + parsed deviceprof.v1 JSONL "
+                        "+ cost-model join report under DIR (default "
+                        "<profile-dir>/xplane); works identically on the "
+                        "CPU backend")
     p.add_argument("--decode", action="store_true",
                    help="decode-throughput rung: steady-state tokens/sec "
                         "through the serving engine's single decode "
@@ -776,7 +821,7 @@ def run_cold_start_bench(on_tpu):
 
 
 def main(argv=None):
-    global _PROFILE_DIR
+    global _PROFILE_DIR, _XPLANE_CTRL
     args = _parse_args(argv or [])
     if args.cold_start_child:
         run_cold_start_child(args.cold_start_child)
@@ -799,6 +844,15 @@ def main(argv=None):
     from paddle_tpu.observability import flight_recorder as _fr
     _fr.enable(capacity=int(os.environ.get("BENCH_FR_CAPACITY", 512)),
                install_signal_handler=True)
+
+    if args.xplane is not None:
+        # arm BEFORE any work: from this point the flight recorder's
+        # annotations carry {state: armed}, so even a wedge before the
+        # healthy window leaves the capture's fate in the postmortem
+        from paddle_tpu.observability import deviceprof as _dp
+        xdir = args.xplane if args.xplane != "__default__" \
+            else os.path.join(args.profile_dir, "xplane")
+        _XPLANE_CTRL = _dp.OneShotCapture(xdir, label="bench")
 
     # test hook (tests/test_observability.py): simulate the round-5 wedge —
     # block inside an open span until the rung watchdog fires, and assert

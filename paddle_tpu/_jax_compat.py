@@ -31,4 +31,49 @@ def install():
         jax.lax.axis_size = axis_size
 
 
+# jax version that first ships the typed XPlane reader
+# jax.profiler.ProfileData (the binding observability.deviceprof prefers
+# when present; the stdlib XSpace wire decoder covers everything older)
+PROFILE_DATA_MIN_JAX = "0.5.1"
+
+
+class ProfileDataUnavailableError(ImportError):
+    """The running jax has no jax.profiler.ProfileData binding."""
+
+
+def profile_data():
+    """A normalized loader over `jax.profiler.ProfileData` across jax
+    versions: returns `load(path) -> ProfileData` resolving the
+    `from_file` / `from_serialized_xspace` API drift, or raises a
+    curated ProfileDataUnavailableError naming the minimum jax version —
+    never a raw ImportError/AttributeError mid-capture (ISSUE 9
+    satellite). Callers that can read raw `.xplane.pb` bytes themselves
+    (observability.deviceprof) catch it and fall back to the stdlib
+    XSpace decoder (`observability/xplane.py`)."""
+    import jaxlib
+
+    versions = (f"installed: jax {jax.__version__}, "
+                f"jaxlib {jaxlib.__version__}")
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        raise ProfileDataUnavailableError(
+            f"jax.profiler.ProfileData requires jax>={PROFILE_DATA_MIN_JAX} "
+            f"({versions}); paddle_tpu.observability.deviceprof falls back "
+            "to its stdlib XSpace decoder automatically — only code that "
+            "insists on the native binding needs a jax upgrade") from None
+    if hasattr(ProfileData, "from_file"):
+        return ProfileData.from_file
+    if hasattr(ProfileData, "from_serialized_xspace"):
+        def load(path):
+            with open(path, "rb") as f:
+                return ProfileData.from_serialized_xspace(f.read())
+        return load
+    raise ProfileDataUnavailableError(
+        "jax.profiler.ProfileData exposes neither from_file nor "
+        f"from_serialized_xspace ({versions}); this jax's reader API has "
+        f"drifted past the shim — jax>={PROFILE_DATA_MIN_JAX} with either "
+        "constructor is required for the native path")
+
+
 install()

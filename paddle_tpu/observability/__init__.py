@@ -24,6 +24,14 @@ one substrate they all report through:
                        raise/delay/drop/truncate with seeded triggers;
                        every fired fault is a metric + a span
                        (docs/robustness.md).
+  xplane.py          — stdlib XSpace (.xplane.pb) wire decoder: the
+                       device-side capture bytes, readable without jax.
+  deviceprof.py      — the device half of the profiler (ISSUE 9):
+                       capture API over jax.profiler.trace, typed
+                       parser to deviceprof.v1 JSONL, the join against
+                       host spans + the analytical cost model, and the
+                       one-shot healthy-window capture orchestration
+                       (bench --xplane / scheduler.capture_decode_steps).
 
 Producers already wired in: serving scheduler (queue depth, slot
 occupancy, admission/timeout/reject counts, tokens, TTFT), PS RPC client
@@ -31,18 +39,23 @@ and server (per-verb latency/bytes, pool size, in-band errors),
 io.DataLoader (wait-time histogram), device op-cache (hits/misses via a
 collector), and live/peak device bytes (collector below).
 
-All three submodules are stdlib-only: importable before (or without)
-jax, which is what lets bench.py write a postmortem for a wedged
-backend init.
+Every submodule is stdlib-only at import time: importable before (or
+without) jax, which is what lets bench.py write a postmortem for a
+wedged backend init and the offline tools parse a device capture next
+to a wedged grant (deviceprof's capture entry points import jax lazily,
+only when a trace is actually started).
 """
 import sys
 
+from . import deviceprof  # noqa: F401
 from . import faults, flight_recorder, metrics, tracecontext  # noqa: F401
+from . import xplane  # noqa: F401
 from .flight_recorder import dump_postmortem  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracecontext import merge_chrome_traces, trace_scope  # noqa: F401
 
 __all__ = ["metrics", "tracecontext", "flight_recorder", "faults",
+           "deviceprof", "xplane",
            "registry", "dump_postmortem", "trace_scope",
            "merge_chrome_traces"]
 

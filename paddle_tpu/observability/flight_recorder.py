@@ -36,7 +36,7 @@ import time
 import traceback
 
 __all__ = ["FlightRecorder", "POSTMORTEM_SCHEMA", "enable", "get",
-           "dump_postmortem", "thread_stacks"]
+           "dump_postmortem", "annotate", "thread_stacks"]
 
 POSTMORTEM_SCHEMA = "paddle_tpu.postmortem.v1"
 DEFAULT_DIR_ENV = "PADDLE_TPU_POSTMORTEM_DIR"
@@ -108,6 +108,7 @@ class FlightRecorder:
             keep_dumps = int(os.environ.get(DEFAULT_KEEP_ENV, DEFAULT_KEEP))
         self.keep_dumps = max(0, int(keep_dumps))
         self.last_dump_path = None
+        self.annotations = {}               # key -> json-safe state note
         self._baseline = None               # flattened metrics at enable()
         self._enabled = False
         self._watchdogs = {}                # token -> (deadline, what, cb)
@@ -162,6 +163,14 @@ class FlightRecorder:
 
     def spans(self):
         return list(self.ring)
+
+    def annotate(self, key, value):
+        """Attach/overwrite a named state note that rides every future
+        postmortem dump — how in-flight orchestration (e.g. an armed
+        deviceprof capture) stays visible when the run wedges before it
+        completes."""
+        with self._lock:
+            self.annotations[key] = _json_safe(value)
 
     # ------------------------------------------------------------- watchdog
     def arm(self, timeout_s, what="operation", on_fire=None):
@@ -270,6 +279,8 @@ class FlightRecorder:
             doc["threads_error"] = repr(e)
         doc["spans"] = self.spans()
         doc["open_spans"] = self.open_spans()
+        with self._lock:
+            doc["annotations"] = dict(self.annotations)
         reg = _registry()
         if reg is not None:
             try:
@@ -371,3 +382,9 @@ def dump_postmortem(reason):
     """One-call postmortem: dumps through the process recorder (enabling
     a bare one on the spot if nothing was set up)."""
     return get().dump(reason)
+
+
+def annotate(key, value):
+    """One-call state note on the process recorder (see
+    FlightRecorder.annotate)."""
+    get().annotate(key, value)

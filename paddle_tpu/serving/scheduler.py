@@ -276,6 +276,8 @@ class Scheduler:
         self._decode_time_s = 0.0
         self._spec_proposed = 0
         self._spec_accepted = 0
+        self._capture = None                  # armed decode-step capture
+        self.last_capture = None              # finalize() summary block
         self._completed = []
         self.counts = dict.fromkeys(_COUNTERS, 0)
         self._metrics_f = (open(self.config.metrics_path, "a")
@@ -358,6 +360,72 @@ class Scheduler:
         return None
 
     # -- the iteration loop --------------------------------------------------
+    def capture_decode_steps(self, steps=1, out_dir="./serving_xplane"):
+        """Arm a one-shot device-profile capture (observability.deviceprof)
+        spanning the next `steps` decode steps, fired only in a HEALTHY
+        window: at least one decode step has already succeeded (the
+        executable is compiled and warm — a capture that spans the first
+        step would record compilation, not serving) and no slot is
+        quarantined by a failure. Artifacts land under `out_dir` (raw
+        .xplane.pb + deviceprof.v1 JSONL + join report); the armed/
+        capturing/reported state rides the flight-recorder annotations,
+        so a wedged serving process leaves the capture's fate in its
+        postmortem. Returns the controller; the parsed summary block is
+        on `scheduler.last_capture` after the window closes."""
+        from ..observability import deviceprof
+        ctrl = deviceprof.OneShotCapture(out_dir, label="serving")
+        self._capture = {"ctrl": ctrl, "steps": max(int(steps), 1),
+                         "remaining": max(int(steps), 1), "wall_s": 0.0}
+        return ctrl
+
+    def _capture_healthy(self):
+        return (self._decode_time_s > 0.0 and not self._quarantined
+                and self._decode_failures == 0)
+
+    def _capture_step_done(self, dt):
+        """One successful decode step closed while a capture is in
+        flight: count it, and close + report the window when the last
+        captured step retires."""
+        cap = self._capture
+        if cap is None or not cap["ctrl"].state == "capturing":
+            return
+        cap["wall_s"] += dt
+        cap["remaining"] -= 1
+        if cap["remaining"] > 0:
+            return
+        ctrl = cap["ctrl"]
+        ctrl.stop()
+        done = cap["steps"] - cap["remaining"]
+        self.last_capture = ctrl.finalize(
+            steps=max(done, 1),
+            wall_step_ms=1000.0 * cap["wall_s"] / max(done, 1))
+        self._capture = None
+
+    def _capture_abort(self, why):
+        """A decode failure while a capture is pending: the capture's
+        fate must never be silent. Mid-window, close it and report the
+        artifacts marked `aborted_by` (gauges are NOT exported — the
+        window is known-sick, --compare must not gate against it).
+        Still-armed, mark the controller failed so the flight-recorder
+        annotation and `last_capture` both carry the reason."""
+        cap = self._capture
+        if cap is None:
+            return
+        ctrl = cap["ctrl"]
+        if ctrl.state == "capturing":
+            ctrl.stop()
+            done = max(cap["steps"] - cap["remaining"], 1)
+            self.last_capture = ctrl.finalize(
+                steps=done,
+                wall_step_ms=(1000.0 * cap["wall_s"] / done)
+                if cap["wall_s"] else None,
+                aborted_by=why)
+        else:
+            ctrl.abort(why)
+            self.last_capture = {"state": ctrl.state, "error": ctrl.error,
+                                 "aborted_by": why}
+        self._capture = None
+
     def step(self):
         """One scheduling iteration. Returns True while work remains."""
         now = self._clock()
@@ -367,6 +435,16 @@ class Scheduler:
         self._grow_paged_slots(now)
         active = [r for r in self._slots if r is not None]
         if active:
+            cap = self._capture
+            if cap is not None and cap["ctrl"].armed \
+                    and self._capture_healthy() \
+                    and not cap["ctrl"].start():
+                # the trace could not open (e.g. another capture is
+                # active): report the dead controller instead of leaving
+                # it armed forever
+                self.last_capture = {"state": cap["ctrl"].state,
+                                     "error": cap["ctrl"].error}
+                self._capture = None
             t0 = self._clock()
             # a speculative engine advances each slot by a whole verify
             # window per step; everything else stays a 1-wide window
@@ -378,9 +456,12 @@ class Scheduler:
                     toks = np.asarray(self.engine.decode()).reshape(-1, 1)
                     counts = np.ones((toks.shape[0],), np.int32)
             except Exception as e:                       # noqa: BLE001
+                self._capture_abort(f"decode failure: "
+                                    f"{type(e).__name__}: {str(e)[:120]}")
                 self._on_decode_failure(e)
             else:
                 dt = self._clock() - t0
+                self._capture_step_done(dt)
                 self._decode_time_s += dt
                 _M_DECODE_SECONDS.observe(dt)
                 proposed = toks.shape[1] - 1     # γ for spec, 0 otherwise

@@ -315,6 +315,52 @@ def test_metrics_compare_flags_cost_model_gap_growth(tmp_path):
     assert "gap widened" in bad.stdout
 
 
+def test_metrics_compare_flags_deviceprof_regressions(tmp_path):
+    """ISSUE 9 gate: the device-profile gauges are failure classes —
+    total device ms/step GROWING past the threshold (the kernels got
+    slower) and per-op efficiency DROPPING past it (an op moved away
+    from its roofline) both trip --compare; improvement stays clean."""
+    a = _snapshot_with_gauges(
+        gauges={"deviceprof_total_device_ms_per_step": 10.0,
+                "deviceprof_min_op_efficiency": 0.8,
+                "deviceprof_device_wall_ratio": 0.5})
+    b = _snapshot_with_gauges(
+        gauges={"deviceprof_total_device_ms_per_step": 20.0,   # grew 2x
+                "deviceprof_min_op_efficiency": 0.3,           # dropped
+                "deviceprof_device_wall_ratio": 0.5})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("deviceprof_total_device_ms_per_step") == \
+        "device time per step grew"
+    assert why.get("deviceprof_min_op_efficiency") == \
+        "per-op device efficiency dropped"
+    # labeled per-op efficiency gauges trip the same drop rule
+    a2 = {"schema": metrics_report.SCHEMA, "ts": 1.0, "pid": 1,
+          "metrics": [{"name": "deviceprof_op_efficiency", "type": "gauge",
+                       "help": "", "labelnames": ["op"],
+                       "samples": [{"labels": {"op": "dot"}, "value": 0.9}]}]}
+    b2 = json.loads(json.dumps(a2))
+    b2["metrics"][0]["samples"][0]["value"] = 0.2
+    regs2 = metrics_report.compare_counters(a2, b2)
+    assert any(k.startswith("deviceprof_op_efficiency{op=dot") and
+               w == "per-op device efficiency dropped"
+               for k, *_, w in regs2), regs2
+    # getting FASTER / more efficient is not a regression
+    assert metrics_report.compare_counters(b, a) == []
+    assert metrics_report.compare_counters(a, a) == []
+    # and the CLI gate exits nonzero on the regressed pair
+    pa, pb = str(tmp_path / "dpa.jsonl"), str(tmp_path / "dpb.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "device time per step grew" in bad.stdout
+    assert "per-op device efficiency dropped" in bad.stdout
+
+
 def test_validate_record_catches_rot():
     good = {"schema": perf_report.SCHEMA, "step": 0, "step_ms": 1.0,
             "phases": {"Forward": 1.0}, "ops": [], "num_samples": None,

@@ -27,7 +27,11 @@ threshold. Direction matters and is decided per counter name:
     failure-class even when the numerator grew with traffic,
   - gap gauges (bench_cost_model_measured_vs_predicted): the measured/
     analytically-predicted step-time ratio GROWING past the threshold
-    is failure-class — the hardware regressed or the model lost contact.
+    is failure-class — the hardware regressed or the model lost contact,
+  - device-profile gauges (ISSUE 9): `deviceprof_total_device_ms_per_step`
+    GROWING is failure-class (the kernels themselves slowed down), and
+    `deviceprof_op_efficiency{op=...}` / `deviceprof_min_op_efficiency`
+    DROPPING is failure-class (an op moved away from its roofline).
 
 Small-count noise is ignored via --min-delta (absolute floor, default 1).
 
@@ -71,9 +75,24 @@ _RATE_RULES = (
 # (ROADMAP item 1 debt): the bench publishes measured/predicted step
 # time every run — the ratio growing means the step got slower relative
 # to what the roofline says the hardware can do.
+# deviceprof_total_device_ms_per_step (ISSUE 9) is the device-side
+# equivalent: the XPlane capture's per-step device op time growing means
+# the kernels themselves got slower, independent of host overhead.
 _GAUGE_GROW_RULES = (
     (re.compile(r"cost_model_measured_vs_predicted(\{.*\})?$"),
      "measured/predicted gap widened"),
+    (re.compile(r"deviceprof_total_device_ms_per_step(\{.*\})?$"),
+     "device time per step grew"),
+)
+
+# GAUGE rules: gauges whose DROP past the threshold is failure-class.
+# deviceprof_op_efficiency{op=...} / deviceprof_min_op_efficiency
+# (ISSUE 9) carry the per-op predicted-roofline/measured-device ratio
+# from the last capture: a drop means an op moved AWAY from its roofline
+# (kernel regression, layout rot) even if the total still fits budget.
+_GAUGE_DROP_RULES = (
+    (re.compile(r"deviceprof_(?:op|min_op)_efficiency(\{.*\})?$"),
+     "per-op device efficiency dropped"),
 )
 
 
@@ -298,14 +317,15 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
             regressions.append((key, va, vb, pct, "hit rate dropped"))
     ga, gb = flatten(a_rec, ("gauge",)), flatten(b_rec, ("gauge",))
     for key in sorted(set(ga) & set(gb)):
+        va, vb = ga[key], gb[key]
+        if va <= 0:
+            continue
+        pct = (vb - va) / va * 100.0
         for pat, why in _GAUGE_GROW_RULES:
-            if not pat.search(key):
-                continue
-            va, vb = ga[key], gb[key]
-            if va <= 0:
-                continue
-            pct = (vb - va) / va * 100.0
-            if vb > va and pct > max_regress_pct:
+            if pat.search(key) and vb > va and pct > max_regress_pct:
+                regressions.append((key, va, vb, pct, why))
+        for pat, why in _GAUGE_DROP_RULES:
+            if pat.search(key) and vb < va and -pct > max_regress_pct:
                 regressions.append((key, va, vb, pct, why))
     return regressions
 

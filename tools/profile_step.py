@@ -302,21 +302,56 @@ def _experiments(B, S, on_tpu, quick):
         exps.append(("sweep", run_sweep))
 
     def run_xplane():
+        """Device-profile closed loop over the main config (ISSUE 9): the
+        capture API (observability.deviceprof) replaces the old raw
+        jax.profiler.trace dump — the artifact is parsed, JOINED against
+        the analytical cost model, and schema-validated on the spot, so
+        an on-chip session can never again ship an unreadable capture."""
         xdir = os.environ.get("XPLANE")
         if not xdir:
             return
-        import jax
         import jax.numpy as jnp
+        from paddle_tpu.cost_model import analytical
+        from paddle_tpu.observability import deviceprof
         cfg, plan, step_fn, params, state, toks, labs, _ = \
             build(B, S, "dots")
         lr = jnp.float32(2e-4)
         loss, params, state = step_fn(params, state, toks, labs, lr)
         _sync(loss)                                    # compile untraced
-        with jax.profiler.trace(xdir):
-            for _ in range(3):
-                loss, params, state = step_fn(params, state, toks, labs, lr)
-            _sync(loss)
-        print(f"| xplane | trace captured to {xdir} |", flush=True)
+        device = "tpu-v5e" if on_tpu else "cpu"
+        try:
+            report = analytical.estimate(
+                step_fn, params, state, toks, labs, lr, device=device)
+            spec = report.device
+            per_op = {name: 1e3 * spec.roofline_s(c.flops, c.bytes)
+                      for name, c in report.by_op.items()}
+        except Exception as e:                           # noqa: BLE001
+            per_op = None
+            print(f"| xplane cost model | fail: {str(e)[:80]} |", flush=True)
+        steps = 3
+        ctrl = deviceprof.OneShotCapture(xdir, label="profile_step")
+        if not ctrl.start():
+            print(f"| xplane | fail: {ctrl.error} |", flush=True)
+            return
+        for _ in range(steps):
+            loss, params, state = step_fn(params, state, toks, labs, lr)
+        _sync(loss)                     # sync INSIDE the trace window
+        ctrl.stop()
+        block = ctrl.finalize(cost_model_per_op=per_op, steps=steps)
+        if block.get("state") != "reported":
+            print(f"| xplane | fail: {block.get('error', block)} |",
+                  flush=True)
+            return
+        print(f"| xplane | {block['total_device_ms']:.1f} ms device / "
+              f"{steps} steps, ratio {block['device_wall_ratio']}, "
+              f"artifacts {block['jsonl']} + {block['report']} |",
+              flush=True)
+        for row in block["top_ops"][:5]:
+            eff = row["efficiency"]
+            eff_s = f"{eff:.3f}" if eff is not None else "-"
+            print(f"| xplane op {row['op'][:40]} | "
+                  f"{row['measured_ms_per_step']:.3f} ms/step, "
+                  f"eff {eff_s} |", flush=True)
 
     if os.environ.get("XPLANE"):
         exps.append(("xplane", run_xplane))

@@ -3,10 +3,16 @@
 # grant is healthy. Each step is independently wall-clock bounded and
 # writes to /tmp/tpu_capture/. Run from /root/repo with the DEFAULT env
 # (JAX_PLATFORMS=axon).
+#
+# The exit code is nonzero when any evidence-bearing step failed — in
+# particular the XPlane parse: an unreadable/empty device capture used
+# to be swallowed by `|| true` and shipped as an empty xplane_top_ops.md
+# (ISSUE 9 satellite); now the failure reason is printed AND propagated.
 set -u
 OUT=${1:-/tmp/tpu_capture}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+FAIL=0
 
 echo "== probe =="
 if ! timeout 150 python -c "import jax; print(jax.default_backend())" \
@@ -16,23 +22,40 @@ if ! timeout 150 python -c "import jax; print(jax.default_backend())" \
 fi
 cat "$OUT/probe.txt"
 
-echo "== bench (ladder, scan-K) =="
+# bench with the device-profile closed loop armed: ONE command now yields
+# the MFU number AND the raw .xplane.pb AND the parsed deviceprof.v1
+# JSONL AND the cost-model join report (bench.py --xplane fires the
+# capture in the first healthy window, past warmup; a wedged run's
+# postmortem records the armed-but-unfired capture)
+echo "== bench (ladder, scan-K, xplane armed) =="
 BENCH_INIT_BUDGET_S=300 timeout 2400 python bench.py \
+    --xplane "$OUT/xplane" \
     > "$OUT/bench.json" 2> "$OUT/bench.err"
 cat "$OUT/bench.json"
 
 echo "== profile sweep =="
 BENCH_INIT_BUDGET_S=300 PROFILE_EXP_BUDGET_S=600 \
-    XPLANE="$OUT/xplane" \
+    XPLANE="$OUT/xplane_sweep" \
     PADDLE_TPU_AUTOTUNE_CACHE="$OUT/flash_blocks.json" \
     timeout 7200 python -u tools/profile_step.py \
     > "$OUT/profile.md" 2> "$OUT/profile.err"
 cat "$OUT/profile.md"
 
 echo "== xplane summary =="
-timeout 600 python tools/xplane_summary.py "$OUT/xplane" \
-    > "$OUT/xplane_top_ops.md" 2>&1 || true
-cat "$OUT/xplane_top_ops.md"
+summarize() {  # summarize <trace-dir> <out-md>: nonzero + reason on rot
+    if ! timeout 600 python tools/xplane_summary.py "$1" \
+            > "$2" 2>&1; then
+        echo "XPLANE PARSE FAILED for $1:"
+        cat "$2"
+        FAIL=1
+    else
+        cat "$2"
+    fi
+}
+summarize "$OUT/xplane" "$OUT/xplane_top_ops.md"
+if [ -d "$OUT/xplane_sweep" ]; then
+    summarize "$OUT/xplane_sweep" "$OUT/xplane_sweep_top_ops.md"
+fi
 
 # eager LAST: per-op dispatch is the most wedge-prone workload (r4 session 3:
 # it wedged the grant before the profile sweep could run) and its number is
@@ -43,4 +66,8 @@ BENCH_INIT_BUDGET_S=300 BENCH_RUNG_BUDGET_S=600 timeout 1200 \
     > "$OUT/bench_eager.json" 2> "$OUT/bench_eager.err"
 cat "$OUT/bench_eager.json"
 
+if [ "$FAIL" -ne 0 ]; then
+    echo "== done WITH FAILURES (see above); artifacts in $OUT =="
+    exit 1
+fi
 echo "== done; artifacts in $OUT =="

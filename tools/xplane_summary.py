@@ -1,87 +1,89 @@
-"""Summarize a jax.profiler.trace capture: top ops by device time.
+"""Summarize a jax.profiler trace capture: top ops by device time.
 
-Usage: python tools/xplane_summary.py /tmp/xplane_gpt [top_n]
+Usage:
+  python tools/xplane_summary.py TRACE_DIR_OR_FILE [top_n]
+         [--jsonl OUT.jsonl] [--join-steps K]
 
-Walks the newest .xplane.pb under the trace dir with
-jax.profiler.ProfileData, aggregates event durations per op name on the
-device planes (TPU/CPU XLA ops), and prints a markdown table — the
-"name the top-5 time consumers" deliverable of VERDICT r3 item 2
-without needing TensorBoard in the zero-egress environment.
+Thin CLI over the typed parser in
+`paddle_tpu/observability/deviceprof.py` (ISSUE 9): finds the newest
+`.xplane.pb` under a trace dir, parses it through the hardened
+plane/line normalization (never the python tracer lane), prints the
+per-op markdown table, and optionally appends the schema-validated
+`paddle_tpu.deviceprof.v1` record to a JSONL stream.
+
+The parser modules are loaded STANDALONE by file path (they are
+stdlib-only by contract) — this tool never imports jax or paddle_tpu,
+so it can read a capture from a box whose backend is wedged (the
+on-chip runbook case tools/tpu_capture.sh scripts).
+
+Exit is NONZERO with the reason on any failure — an empty or host-only
+capture can no longer produce a silently empty xplane_top_ops.md
+(ISSUE 9 satellite; the `|| true` that swallowed this is gone from
+tpu_capture.sh).
 """
-import collections
-import glob
+import argparse
+import importlib.util
 import os
 import sys
 
-
-def find_xplane(root):
-    cands = glob.glob(os.path.join(root, "**", "*.xplane.pb"),
-                      recursive=True)
-    if not cands:
-        raise SystemExit(f"no .xplane.pb under {root}")
-    return max(cands, key=os.path.getmtime)
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def summarize(path, top_n=20):
-    from jax.profiler import ProfileData
-    data = ProfileData.from_file(path)
+def _load_standalone(name, *relpath):
+    path = os.path.join(_ROOT, *relpath)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
-    def aggregate(plane):
-        # TPU device planes carry PARALLEL hierarchy lines over the same
-        # nanoseconds (Steps / XLA Modules / XLA Ops / Framework Ops /
-        # Framework Name Scope): summing across lines multi-counts time,
-        # so pick exactly ONE line — 'XLA Ops' when present, else the
-        # line with the largest total duration
-        def line_total(ln):
-            return sum(max(ev.duration_ns, 0) for ev in ln.events)
 
-        lines = [ln for ln in plane.lines if line_total(ln) > 0]
-        if not lines:
-            return collections.Counter(), collections.Counter()
-        xla_ops = [ln for ln in lines
-                   if (ln.name or "").lower() == "xla ops"]
-        line = xla_ops[0] if xla_ops else max(lines, key=line_total)
-        agg = collections.Counter()
-        calls = collections.Counter()
-        for ev in line.events:
-            ns = ev.duration_ns
-            if ns <= 0:
-                continue
-            agg[ev.name] += ns
-            calls[ev.name] += 1
-        return agg, calls
+def load_deviceprof():
+    """The parser, without importing paddle_tpu (or jax)."""
+    mod = sys.modules.get("paddle_tpu.observability.deviceprof")
+    if mod is not None:
+        return mod
+    return _load_standalone("_xplane_summary_deviceprof",
+                            "paddle_tpu", "observability", "deviceprof.py")
 
-    planes = list(data.planes)
-    device = [p for p in planes if any(
-        t in p.name.lower() for t in ("tpu", "gpu", "/device"))]
-    if not device:
-        # CPU-backend capture: the host plane IS the device plane
-        device = [p for p in planes if "cpu" in p.name.lower()]
-    rows = []
-    for plane in device:
-        agg, calls = aggregate(plane)
-        if agg:
-            rows.append((plane.name, agg, calls))
-    if not rows:
-        raise SystemExit(f"no device events in {path} "
-                         "(host-only trace? capture with real execution)")
-    for plane_name, agg, calls in rows:
-        total = sum(agg.values())
-        print(f"\n## {plane_name} — {total / 1e6:.2f} ms total device time\n")
-        print("| op | calls | ms | % |")
-        print("|---|---|---|---|")
-        for name, ns in agg.most_common(top_n):
-            print(f"| {name[:70]} | {calls[name]} | {ns / 1e6:.3f} | "
-                  f"{100 * ns / total:.1f} |")
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", nargs="?", default="/tmp/xplane_gpt",
+                   help="trace dir (newest .xplane.pb wins) or a .pb file")
+    p.add_argument("top_n", nargs="?", type=int, default=20)
+    p.add_argument("--jsonl", default=None, metavar="OUT",
+                   help="also append the schema-validated deviceprof.v1 "
+                        "record here")
+    p.add_argument("--join-steps", type=int, default=None, metavar="K",
+                   help="the capture spans K steps: adds per-step device "
+                        "time to the record (cost-model predictions need "
+                        "the in-process pipeline, bench.py --xplane)")
+    args = p.parse_args(argv)
+
+    dp = load_deviceprof()
+    try:
+        if os.path.isdir(args.path):
+            path = dp.find_xplane(args.path)
+        elif os.path.isfile(args.path):
+            path = args.path
+        else:
+            raise dp.CaptureError(
+                f"no trace at {args.path} (capture never ran?)")
+        rec = dp.parse_xplane(path)
+        if args.join_steps:
+            dp.join_cost_model(rec, None, steps=args.join_steps)
+        print(dp.render_record(rec, top=args.top_n))
+        if args.jsonl:
+            dp.write_record(rec, args.jsonl)
+            print(f"\n(record appended to {args.jsonl})")
+    except dp.CaptureError as e:
+        print(f"xplane_summary FAILED: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"xplane_summary FAILED (schema): {e}", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/xplane_gpt"
-    top = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    if os.path.isdir(root):
-        path = find_xplane(root)
-    elif os.path.isfile(root):
-        path = root
-    else:
-        raise SystemExit(f"no trace at {root} (capture never ran?)")
-    summarize(path, top)
+    sys.exit(main())
