@@ -560,6 +560,13 @@ def _parse_args(argv):
                         "shared-prefix mixture through the paged engine, "
                         "with the dense per-slot engine raced at the same "
                         "KV memory budget for the concurrency comparison")
+    p.add_argument("--serve-dist", action="store_true",
+                   help="multi-host serving rung: forked prefill+decode "
+                        "worker pools behind the router (KV bundles "
+                        "handed off over the PS RPC fabric) raced against "
+                        "ONE single-process paged scheduler at the same "
+                        "allocatable KV budget — tokens/sec, p50/p99 "
+                        "TTFT, and handoff bytes per arm")
     p.add_argument("--cold-start", action="store_true",
                    help="cold-start rung: build a serving artifact, then "
                         "race a COLD process (empty compile cache, full "
@@ -697,6 +704,183 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                   "compile_bounds": compile_bounds,
                   "paged_beats_dense_concurrency":
                       paged["max_concurrent"] > dense["max_concurrent"],
+                  "backend": jax.default_backend()},
+    }
+
+
+def run_serve_dist_bench(on_tpu, n_requests=None):
+    """Multi-host serving rung (ISSUE 10): the same traffic through (a)
+    ONE paged scheduler in this process and (b) a forked 1-prefill +
+    N-decode worker fleet behind the router, at EQUAL allocatable KV
+    budget (the single process gets the fleet's summed usable blocks).
+    Metric = the distributed arm's replay tokens/sec; vs_baseline =
+    dist/single tokens-per-sec ratio (the disaggregation overhead
+    figure — expect <1 off-chip, where RPC+adoption costs are not
+    amortized by real accelerator prefill times). Extra carries both
+    arms' p50/p99 TTFT, handoff bytes, and the compile-once counters;
+    the streams of the two arms are ASSERTED identical, so the rung can
+    never trade correctness for throughput."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    import jax
+
+    import paddle_tpu
+    from paddle_tpu.serving import (PagedEngineConfig,
+                                    PagedGenerationEngine, Scheduler,
+                                    ServingConfig)
+    from paddle_tpu.serving.distributed import DistFrontend
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_report
+
+    model_name = os.environ.get("BENCH_DIST_MODEL",
+                                "gpt_125m" if on_tpu else "gpt_tiny")
+    seed = int(os.environ.get("BENCH_DIST_SEED", 2024))
+    slots = int(os.environ.get("BENCH_DIST_SLOTS", 4 if on_tpu else 2))
+    max_len = int(os.environ.get("BENCH_DIST_MAXLEN",
+                                 512 if on_tpu else 64))
+    block = int(os.environ.get("BENCH_DIST_BLOCK", 16 if on_tpu else 8))
+    n_decode = int(os.environ.get("BENCH_DIST_DECODE_WORKERS", 2))
+    requests = n_requests or int(os.environ.get("BENCH_DIST_REQUESTS",
+                                                32 if on_tpu else 8))
+    max_new = int(os.environ.get("BENCH_DIST_MAXNEW", 16 if on_tpu else 6))
+    prompt_len = int(os.environ.get("BENCH_DIST_PROMPT",
+                                    64 if on_tpu else 8))
+    worker_cfg = {"slots": slots, "max_len": max_len, "block_size": block}
+    per_worker = PagedEngineConfig(**worker_cfg)
+    # equal ALLOCATABLE budget: each worker reserves its own garbage
+    # block, so the single process gets the summed usable blocks + one
+    single_blocks = n_decode * (per_worker.num_blocks - 1) + 1
+    budget_tokens = n_decode * (per_worker.num_blocks - 1) * block
+
+    rng = np.random.RandomState(0)
+    paddle_tpu.seed(seed)
+    from paddle_tpu.text import models as _models
+    model = getattr(_models, model_name)()
+    model.eval()
+    vocab = model.cfg.vocab_size
+    prompts = [rng.randint(0, vocab, prompt_len).tolist()
+               for _ in range(requests)]
+
+    def _summary(ttfts, tokens_total, wall_s, extra):
+        out = {"tokens_per_s": tokens_total / wall_s if wall_s else 0.0,
+               "tokens_total": tokens_total, "wall_s": round(wall_s, 4),
+               "ttft_p50_s": serve_report._pct(ttfts, 0.50),
+               "ttft_p99_s": serve_report._pct(ttfts, 0.99),
+               "requests_done": len(ttfts)}
+        out.update(extra)
+        return out
+
+    # ---- arm 1: single process ------------------------------------------
+    engine = PagedGenerationEngine(model, PagedEngineConfig(
+        slots=n_decode * slots, max_len=max_len, block_size=block,
+        num_blocks=single_blocks))
+    sched = Scheduler(engine, ServingConfig(
+        max_queue=max(64, requests),
+        default_max_new_tokens=max_new))
+    t0 = time.perf_counter()
+    handles = [sched.submit(p) for p in prompts]
+    while sched.step():
+        pass
+    single_wall = time.perf_counter() - t0
+    single_streams = [h.tokens for h in handles]
+    single = _summary(
+        [h.ttft_s for h in handles if h.ttft_s is not None],
+        sum(len(t) for t in single_streams), single_wall,
+        {"kv_memory_tokens": engine.kv_usable_tokens,
+         "trace_counts": {"decode": engine.trace_counts["decode"]},
+         "handoff_bytes": 0})
+
+    # ---- arm 2: forked prefill + decode pools ---------------------------
+    workdir = tempfile.mkdtemp(prefix="bench_serve_dist_")
+    procs, ep_files = [], []
+    roles = ["prefill"] + ["decode"] * n_decode
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", jax.default_backend())
+    for i, role in enumerate(roles):
+        ep = os.path.join(workdir, f"ep_{i}")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.serving.distributed.worker_main",
+             "--role", role, "--engine", "paged",
+             "--model", model_name, "--seed", str(seed),
+             "--index", str(i),
+             "--engine-config", _json.dumps(worker_cfg),
+             "--serving-config", _json.dumps(
+                 {"max_queue": max(64, requests),
+                  "default_max_new_tokens": max_new}),
+             "--endpoint-file", ep],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+        ep_files.append(ep)
+    fe = None
+    try:
+        endpoints = []
+        for proc, ep in zip(procs, ep_files):
+            deadline = time.time() + 300
+            while not os.path.exists(ep):
+                if proc.poll() is not None:
+                    _, err = proc.communicate()
+                    raise RuntimeError(
+                        f"serve-dist worker died:\n{err[-4000:]}")
+                if time.time() > deadline:
+                    raise TimeoutError("serve-dist worker never "
+                                       "published its endpoint")
+                time.sleep(0.05)
+            with open(ep) as f:
+                endpoints.append(f.read().strip())
+        fe = DistFrontend(endpoints[1:], [endpoints[0]])
+        t0 = time.perf_counter()
+        reqs = [fe.submit(p, max_new=max_new) for p in prompts]
+        fe.run(timeout_s=float(os.environ.get("BENCH_DIST_TIMEOUT_S",
+                                              600)))
+        dist_wall = time.perf_counter() - t0
+        bad = [r for r in reqs if r.status != "DONE"]
+        assert not bad, f"{len(bad)} dist requests not DONE: " \
+                        f"{[(r.key, r.status, r.error) for r in bad[:3]]}"
+        # correctness gate: both arms must emit the SAME greedy streams
+        assert [r.tokens for r in reqs] == single_streams, \
+            "distributed streams diverged from the single-process arm"
+        stats = fe.stats()
+        handoff = sum(s.get("handoff_bytes", 0) for s in stats.values())
+        dist_budget = sum(s.get("kv_usable_tokens", 0)
+                          for s in stats.values()
+                          if s.get("role") == "decode")
+        staged = sum(1 for r in reqs if r.staged)
+        dist = _summary(
+            [r.ttft_s for r in reqs if r.ttft_s is not None],
+            sum(len(r.tokens) for r in reqs), dist_wall,
+            {"kv_memory_tokens": dist_budget, "handoff_bytes": handoff,
+             "staged_requests": staged, "decode_workers": n_decode})
+        assert staged > 0, "no request rode the prefill->decode handoff"
+        assert dist_budget == budget_tokens == single["kv_memory_tokens"]
+    finally:
+        if fe is not None:
+            # stop on EVERY path — a failed assert must not leave the
+            # fleet serving until the per-process wait timeouts expire
+            try:
+                fe.stop_workers()
+            except Exception:                            # noqa: BLE001
+                pass
+            fe.close()
+        for proc in procs:
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    ratio = (dist["tokens_per_s"] / single["tokens_per_s"]
+             if single["tokens_per_s"] else 0.0)
+    return {
+        "value": dist["tokens_per_s"],
+        "vs_baseline": round(ratio, 3),   # dist/single tokens-per-sec
+        "extra": {"metric_name": "serve_dist_tokens_per_s",
+                  "model": model_name, "requests": requests,
+                  "max_new": max_new, "dist": dist, "single": single,
+                  "streams_identical": True,
                   "backend": jax.default_backend()},
     }
 
@@ -888,6 +1072,19 @@ def main(argv=None):
                             "serve-load rung")
         try:
             result = run_serve_load_bench(on_tpu)
+            emit(result["value"], result["vs_baseline"],
+                 extra=result["extra"])
+        finally:
+            wd.cancel()
+        return
+
+    if args.serve_dist:
+        METRIC = "gpt_serve_dist_tokens_per_s"
+        UNIT = "replay decode tokens/sec (distributed worker fleet)"
+        wd = start_watchdog(float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
+                            "serve-dist rung")
+        try:
+            result = run_serve_dist_bench(on_tpu)
             emit(result["value"], result["vs_baseline"],
                  extra=result["extra"])
         finally:

@@ -52,6 +52,14 @@ consecutive transport failures, fast-fail while open
 (`PSUnavailableError`, a ConnectionError), and half-open a single probe
 after a cooldown. Frames without the 0x40 rider are served unchanged.
 
+Extension verbs (ISSUE 10): `register_verb(op, name, idempotent=)` +
+`PSServer(handlers={op: fn})` let other subsystems define verbs on this
+same fabric — the multi-host serving tier's KV-handoff and control verbs
+(serving/distributed/) ride it, inheriting retries, breakers, PUSH-style
+exactly-once via application request keys, trace propagation, and the
+in-band error frames. Extension frames are `hdr | n payload bytes`
+(header n = payload length) answered with `u32 len | len bytes`.
+
 Metrics: both halves report to the unified registry — per-verb latency
 histograms (`ps_client_request_seconds` / `ps_server_request_seconds`),
 per-verb byte counters, a connection-pool gauge, in-band error counts
@@ -81,6 +89,30 @@ OP_GSAMPLE, OP_GFEAT, OP_GDEGREE = 4, 5, 6
 _OP_NAMES = {OP_PULL: "PULL", OP_PUSH: "PUSH", OP_PING: "PING",
              OP_STOP: "STOP", OP_GSAMPLE: "GSAMPLE", OP_GFEAT: "GFEAT",
              OP_GDEGREE: "GDEGREE"}
+
+
+def register_verb(op, name, idempotent=False):
+    """Register an EXTENSION verb on the shared fabric (ISSUE 10: the
+    serving KV-handoff/control verbs ride the same transport as the PS
+    ops, inheriting the retry loop, breakers, trace propagation, byte/
+    latency metrics, and in-band error frames for free).
+
+    `op` must stay below 0x40 so the 0x40/0x80 header-flag riders remain
+    unambiguous. Extension verbs are served by PSServer `handlers` (see
+    PSServer.__init__); `idempotent=True` opts the verb into the client
+    retry loop — extension verbs must make that safe themselves (e.g.
+    dedup by an application-level request key)."""
+    global _IDEMPOTENT_OPS
+    op = int(op)
+    if not 0 <= op < REQID_FLAG:
+        raise ValueError(f"verb op {op} collides with the header flag "
+                         f"bits (must be < {REQID_FLAG:#x})")
+    if _OP_NAMES.get(op, name) != name:
+        raise ValueError(f"verb op {op} already registered as "
+                         f"{_OP_NAMES[op]!r}")
+    _OP_NAMES[op] = name
+    if idempotent:
+        _IDEMPOTENT_OPS = _IDEMPOTENT_OPS | {op}
 _HDR = struct.Struct("<BII")
 _GS = struct.Struct("<iBH")       # seed | weighted | edge-type length
 _TL = struct.Struct("<H")         # type-name length
@@ -281,9 +313,18 @@ class PSServer:
     """Serves one shard — a sparse `table`, a `graph` GraphTable, or both —
     over TCP. `port=0` picks a free port (exposed as .port after start)."""
 
-    def __init__(self, table=None, host="127.0.0.1", port=0, graph=None):
+    def __init__(self, table=None, host="127.0.0.1", port=0, graph=None,
+                 handlers=None):
         self.table = table
         self.graph = graph
+        # extension verbs (register_verb): {op: fn(payload_bytes, aux,
+        # reqid, rctx) -> response payload bytes}. The server consumes
+        # the n-byte body BEFORE dispatch (header n = payload length for
+        # extension verbs), so a raising handler leaves the stream in
+        # sync and answers with an in-band error frame like the built-in
+        # verbs. rctx is the caller's (trace_id, span_id) or None — for
+        # handlers that fan out further RPCs under the same trace.
+        self.handlers = dict(handlers or {})
         # PUSH dedup: (client_id, seq) of pushes already APPLIED, bounded
         # LRU shared across connections (a retry arrives on a NEW socket)
         self._push_seen = collections.OrderedDict()
@@ -293,6 +334,8 @@ class PSServer:
         self._sock.bind((host, port))
         self._sock.listen(16)
         self.host, self.port = self._sock.getsockname()
+        self._conns = set()          # live connection sockets (chaos kill)
+        self._conns_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
@@ -307,11 +350,23 @@ class PSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            if self._stop.is_set():
+                # closing the listener does not interrupt a blocked
+                # accept() on every kernel: a connect racing shutdown
+                # can still be handed to us — refuse it, or a "dead"
+                # server would keep serving one ghost connection
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
     def _serve(self, conn):
         mconn = _MeteredSock(conn)      # request/response bytes per verb
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             while True:
                 b0 = mconn.recv_bytes
@@ -342,9 +397,17 @@ class PSServer:
                     handler = self._serve_sparse
                 elif op in (OP_GSAMPLE, OP_GFEAT, OP_GDEGREE):
                     handler = self._serve_graph
+                elif op in self.handlers:
+                    ext = self.handlers[op]
+
+                    def handler(conn, op, n, aux, reqid, _ext=ext,
+                                _rctx=rctx):
+                        body = _recv_exact(conn, n)   # sync before dispatch
+                        out = _ext(body, aux, reqid, _rctx)
+                        return _U32.pack(len(out)) + out
                 else:
                     raise ConnectionError(f"unknown op {op}")
-                verb = _OP_NAMES[op]
+                verb = _OP_NAMES.get(op, str(op))
                 span = _tracer.begin(f"ps.server::{verb}",
                                      TracerEventType.Communication,
                                      attrs={"n": int(n)})
@@ -382,7 +445,27 @@ class PSServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             conn.close()
+
+    def close_connections(self):
+        """Abruptly sever every live connection (the in-process half of
+        a host-death simulation: peers see resets mid-frame, exactly as
+        if the process were SIGKILLed). `shutdown()` deliberately does
+        NOT do this — established connections normally drain on their
+        own."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _push_begin(self, reqid):
         """Claim a push id: ('dup', None) when it was already APPLIED,

@@ -68,6 +68,12 @@ resurrect a pre-clear persistent entry. Fresh processes see every entry
 again — content-addressed keys (and the source fingerprint) make that
 safe across restarts, which is the entire point of the cache.
 
+Retention (ROADMAP item 5 debt): `FLAGS_compile_cache_max_entries` (or
+`CompileCache(max_entries=)`) caps committed entries per cache dir —
+a `gc_old`-style sweep runs at commit time, evicting least-recently-USED
+first (dir mtime; lookup hits refresh it), never the entry just
+committed. 0 = unlimited (the default).
+
 Observability: `compile_cache_hits_total` / `compile_cache_misses_total`
 counters (the hits/misses rate-rule in tools/metrics_report.py gates a
 hit-rate drop as a failure-class regression), per-executable compile and
@@ -193,14 +199,21 @@ class CompileCache:
     treats ANY verification or deserialization failure as a miss (the
     offending entry is deleted so the next compile recommits it)."""
 
-    def __init__(self, path):
+    def __init__(self, path, max_entries=None):
         self.path = os.path.abspath(str(path))
         os.makedirs(self.path, exist_ok=True)
         # entries committed before this stamp are bypassed (see
         # invalidate()); 0.0 = serve everything
         self._min_ts = 0.0
+        if max_entries is None:
+            # the raw dict, not get_flags(): flags.py attaches the
+            # process-global cache at import time, before its accessors
+            # are defined
+            from .flags import _FLAGS
+            max_entries = _FLAGS.get("FLAGS_compile_cache_max_entries", 0)
+        self.max_entries = int(max_entries or 0)
         self.stats = {"hits": 0, "misses": 0, "bypass": 0, "corrupt": 0,
-                      "uncacheable": 0}
+                      "uncacheable": 0, "evicted": 0}
 
     # -- key --------------------------------------------------------------
     def entry_key(self, name, parts):
@@ -279,6 +292,10 @@ class CompileCache:
             return None
         self.stats["hits"] += 1
         _M_HITS.inc()
+        try:
+            os.utime(full)        # LRU recency: a served entry is "used"
+        except OSError:
+            pass
         return runner
 
     def _read_meta(self, full, digest):
@@ -370,7 +387,33 @@ class CompileCache:
                           f"not persisted")
             self.stats["uncacheable"] += 1
             return False
+        self._sweep_retention(protect=dirname)
         return True
+
+    def _sweep_retention(self, protect=None):
+        """Retention cap (ROADMAP item 5 debt): keep at most
+        `max_entries` committed entries, evicting least-recently-used
+        first (dir mtime — refreshed by both commits and lookup hits),
+        at commit time like `ckpt_commit.gc_old`. The entry just
+        committed is always protected, so the cap can never evict the
+        executable the caller is about to run. 0 = unlimited."""
+        if self.max_entries <= 0:
+            return
+        aged = []
+        for name in self.entries():
+            if name == protect:
+                continue
+            try:
+                aged.append((os.path.getmtime(self._entry_dir(name)), name))
+            except OSError:
+                continue
+        excess = len(aged) + (1 if protect else 0) - self.max_entries
+        if excess <= 0:
+            return
+        aged.sort()
+        for _, name in aged[:excess]:
+            shutil.rmtree(self._entry_dir(name), ignore_errors=True)
+            self.stats["evicted"] += 1
 
 
 # ---------------------------------------------------- process-global tier

@@ -37,6 +37,12 @@ _FLAGS = {
     # cache above, entries ride the ckpt_commit atomic protocol (torn-write
     # safe) and report through compile_cache_{hits,misses}_total.
     "FLAGS_compile_cache_dir": "",
+    # retention cap for compile-cache dirs (ROADMAP item 5 debt): keep at
+    # most this many committed entries per cache directory, sweeping the
+    # least-recently-USED (by dir mtime — lookups touch it) at commit
+    # time. 0 = unlimited. Applies to every CompileCache built without an
+    # explicit max_entries, engine-private and process-global alike.
+    "FLAGS_compile_cache_max_entries": 0,
     # int64 boundary policy escape hatch (PARITY dtype-policy section): on
     # device, int64 requests canonicalize to int32 (x64 off, TPU-native
     # widths). Consumers that np.save/type-check against reference-written

@@ -25,6 +25,18 @@ Site catalogue (the call sites live next to the operation they break):
                        simulates pool exhaustion (the scheduler's
                        preemption path); default raise exercises the
                        contained-prefill-failure path
+  serving.kv_handoff   the multi-host KV handoff path (ISSUE 10): fires
+                       at BOTH ends of a bundle transfer — inside
+                       `pack_kv_bundle` (sender: the prefill worker
+                       about to stream) and `unpack_kv_bundle`
+                       (receiver: the decode worker about to adopt) —
+                       a raise on either end makes the router fall back
+                       to decode-local recompute prefill, which chaos
+                       tests prove bit-exact
+  serving.weight_swap  GenerationEngine.swap_params, before the new
+                       params are validated/committed — a raise rejects
+                       the swap atomically (old weights keep serving,
+                       zero requests dropped)
   dataloader.next      io.DataLoader.__iter__, before each batch
 
 Arming, in-process:
@@ -57,7 +69,8 @@ __all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
 
 # the documented catalogue; arm() accepts any name so tests can add sites
 SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
-         "serving.decode_step", "serving.block_alloc", "dataloader.next")
+         "serving.decode_step", "serving.block_alloc",
+         "serving.kv_handoff", "serving.weight_swap", "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

@@ -22,7 +22,16 @@ turn a compiled decoder into a serving engine:
   scheduler.py    — SLO-aware continuous batching: priority classes,
                     deadline/priority preemption that frees blocks back
                     to the pool, watermark load shedding, queue caps,
-                    graceful drain, serving metrics
+                    graceful drain, serving metrics; staged-KV
+                    placement (multi-host handoff sink) and
+                    between-steps weight hot-swap
+  distributed/    — the multi-host tier (ISSUE 10): tensor-parallel
+                    decode over a mesh, disaggregated prefill/decode
+                    worker pools on the PS RPC fabric with KV-bundle
+                    handoff, SLO-aware router with bit-exact failover,
+                    zero-downtime weight hot-swap. Imported lazily
+                    (`paddle_tpu.serving.distributed`) — single-process
+                    serving never pays for the fabric.
 
 `inference.Predictor.generate`, `bench.py --decode/--serve-load` and
 `tools/load_harness.py` ride the same engines. See docs/serving.md.

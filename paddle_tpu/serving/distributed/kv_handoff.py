@@ -1,0 +1,161 @@
+"""KV-block wire serialization for disaggregated prefill/decode (ISSUE 10).
+
+A prefill worker computes a request's K/V in ITS pool, then streams the
+resident tokens to the decode worker that will run the request to
+completion. What crosses the wire is a *KV bundle*: the per-layer
+[tokens, heads, head_dim] K and V slices of one request (block padding
+stripped — only the `plen` real tokens ship), plus the metadata the
+decode worker needs to adopt them (`first_token`, `plen`, dtype/shape
+header). The decode worker scatters the bundle into freshly allocated
+blocks of its own pool (`engine.adopt_kv`) and decoding continues
+BIT-IDENTICALLY to a local prefill — the bytes are lossless and the
+decode math never knows which host produced the prefix.
+
+Wire layout (little-endian):
+
+    u32 MAGIC ("KVB1") | u32 header_len | header JSON | L * (K | V)
+
+The header carries {v, dtype, layers, tokens, heads, head_dim, meta} and
+pins the exact byte count of the array tail, so ANY truncation or shape
+lie fails `unpack_kv_bundle` with `KVWireError` — which the RPC server
+relays to the sender as an in-band error frame (PSServerError) instead
+of killing the connection, the same degradation contract as every other
+verb on the fabric.
+
+`pack_payload`/`unpack_payload` are the lighter framing the control
+verbs (SUBMIT/POLL/SWAP/STAT/PREFILL) share: a JSON object + an opaque
+binary tail in one length-prefixed payload.
+
+The `serving.kv_handoff` fault site fires on both ends of the transfer
+(sender: worker handoff push; receiver: here, before unpack), so chaos
+tests drive the handoff path — and the router's recompute fallback —
+through the deterministic registry.
+"""
+import json
+import struct
+
+import numpy as np
+
+from ...observability import faults as _faults
+
+__all__ = ["KVWireError", "BUNDLE_VERSION", "pack_kv_bundle",
+           "unpack_kv_bundle", "pack_payload", "unpack_payload"]
+
+BUNDLE_VERSION = 1
+_MAGIC = 0x3142564B                      # "KVB1" little-endian
+_U32 = struct.Struct("<I")
+_HEAD = struct.Struct("<II")             # magic | header_len
+
+
+class KVWireError(ValueError):
+    """A KV bundle failed wire validation (truncated frame, shape or
+    dtype lie, foreign magic) — relayed to the peer as an in-band error
+    frame; never a torn adoption."""
+
+
+def pack_kv_bundle(ks, vs, meta=None):
+    """Serialize one request's per-layer K/V slices.
+
+    ks/vs: sequences of [tokens, heads, head_dim] arrays, one per layer,
+    all sharing shape and dtype (the engine's `extract_kv` output).
+    `meta` is a small JSON-able dict (first_token, plen, request key...)
+    that rides the header verbatim."""
+    _faults.fire("serving.kv_handoff")
+    if len(ks) != len(vs) or not ks:
+        raise KVWireError(
+            f"bundle needs matching non-empty K/V layer lists, got "
+            f"{len(ks)}/{len(vs)}")
+    ks = [np.ascontiguousarray(k) for k in ks]
+    vs = [np.ascontiguousarray(v) for v in vs]
+    shape, dtype = ks[0].shape, ks[0].dtype
+    if len(shape) != 3:
+        raise KVWireError(f"layer K/V must be [tokens, heads, head_dim], "
+                          f"got shape {shape}")
+    for arr in ks + vs:
+        if arr.shape != shape or arr.dtype != dtype:
+            raise KVWireError(
+                f"bundle layers disagree: {arr.shape}/{arr.dtype} vs "
+                f"{shape}/{dtype}")
+    header = json.dumps({
+        "v": BUNDLE_VERSION, "dtype": dtype.name, "layers": len(ks),
+        "tokens": int(shape[0]), "heads": int(shape[1]),
+        "head_dim": int(shape[2]), "meta": dict(meta or {})}).encode()
+    parts = [_HEAD.pack(_MAGIC, len(header)), header]
+    for k, v in zip(ks, vs):
+        parts.append(k.tobytes())
+        parts.append(v.tobytes())
+    return b"".join(parts)
+
+
+def unpack_kv_bundle(buf):
+    """(ks, vs, meta) from `pack_kv_bundle` bytes. Raises KVWireError on
+    anything that does not verify — a truncated tail can never yield a
+    short-but-plausible bundle, because the header pins the exact byte
+    count."""
+    _faults.fire("serving.kv_handoff")
+    buf = memoryview(bytes(buf) if not isinstance(buf, (bytes, bytearray,
+                                                        memoryview))
+                     else buf)
+    if len(buf) < _HEAD.size:
+        raise KVWireError(f"bundle truncated: {len(buf)} bytes is shorter "
+                          f"than the {_HEAD.size}-byte frame head")
+    magic, hlen = _HEAD.unpack_from(buf, 0)
+    if magic != _MAGIC:
+        raise KVWireError(f"bad bundle magic {magic:#x}")
+    if len(buf) < _HEAD.size + hlen:
+        raise KVWireError("bundle truncated inside the header")
+    try:
+        header = json.loads(bytes(buf[_HEAD.size:_HEAD.size + hlen]))
+    except ValueError as e:
+        raise KVWireError(f"bundle header is not JSON: {e}") from None
+    if header.get("v") != BUNDLE_VERSION:
+        raise KVWireError(f"bundle version {header.get('v')!r}, want "
+                          f"{BUNDLE_VERSION}")
+    try:
+        dtype = np.dtype(header["dtype"])
+        layers = int(header["layers"])
+        shape = (int(header["tokens"]), int(header["heads"]),
+                 int(header["head_dim"]))
+    except (KeyError, TypeError, ValueError) as e:
+        raise KVWireError(f"bundle header malformed: {e}") from None
+    if layers < 1 or min(shape) < 1:
+        raise KVWireError(f"bundle header degenerate: layers={layers}, "
+                          f"shape={shape}")
+    per = int(np.prod(shape)) * dtype.itemsize
+    want = _HEAD.size + hlen + layers * 2 * per
+    if len(buf) != want:
+        raise KVWireError(
+            f"bundle truncated or padded: {len(buf)} bytes, header "
+            f"demands {want} ({layers} layers x 2 x {per}B)")
+    ks, vs = [], []
+    off = _HEAD.size + hlen
+    for _ in range(layers):
+        ks.append(np.frombuffer(buf, dtype, count=int(np.prod(shape)),
+                                offset=off).reshape(shape))
+        off += per
+        vs.append(np.frombuffer(buf, dtype, count=int(np.prod(shape)),
+                                offset=off).reshape(shape))
+        off += per
+    return ks, vs, header.get("meta", {})
+
+
+def pack_payload(obj, tail=b""):
+    """`u32 json_len | json | tail` — the framing every serving control
+    verb shares (KVPUT's tail is a KV bundle; the rest are tail-less)."""
+    blob = json.dumps(obj).encode()
+    return _U32.pack(len(blob)) + blob + bytes(tail)
+
+
+def unpack_payload(body):
+    """(obj, tail bytes) from `pack_payload` output."""
+    body = bytes(body)
+    if len(body) < _U32.size:
+        raise KVWireError("payload truncated before the JSON length")
+    (jlen,) = _U32.unpack_from(body, 0)
+    if len(body) < _U32.size + jlen:
+        raise KVWireError("payload truncated inside the JSON head")
+    try:
+        obj = json.loads(body[_U32.size:_U32.size + jlen])
+    except ValueError as e:
+        raise KVWireError(f"payload head is not JSON: {e}") from None
+    return obj, body[_U32.size + jlen:]

@@ -1,0 +1,360 @@
+"""The multi-host serving frontend: placement, streaming, failover.
+
+`DistFrontend` is the router in front of disaggregated prefill and
+decode pools. Per request it:
+
+  1. PLACES: picks the decode worker with the fewest in-flight requests
+     (live workers only — a dead worker's breaker keeps it out), and a
+     prefill worker round-robin;
+  2. PREFILLS REMOTELY: the prefill worker computes the prompt's KV and
+     streams the bundle straight to the chosen decode worker (the
+     router never carries KV bytes — it moves keys, workers move data);
+     any prefill/handoff failure falls back to decode-local recompute
+     prefill, losing only the disaggregation win, never the request;
+  3. SUBMITS + PUMPS: admits on the decode worker and batch-polls the
+     token stream;
+  4. FAILS OVER: when a decode worker goes dark mid-stream
+     (PSUnavailableError — retries exhausted / breaker open, e.g. a
+     SIGKILLed host), every request it carried restarts on a live
+     worker recompute-style: prompt + tokens-received-so-far becomes
+     the restart prompt (the PR 6 preemption rule, lifted across
+     hosts), so under greedy decoding the delivered stream completes
+     BIT-IDENTICALLY to an unkilled run. `serving_failover_total`
+     counts the events (failure-class in metrics_report).
+
+Trace stitching: run the frontend under a profiler window (or a
+`tracecontext.trace_scope`) and every verb frame carries the trace id;
+worker handler spans parent under the router's client spans, the
+prefill->decode KVPUT rides the same id (the worker re-enters the
+caller's scope), and `merge_chrome_traces` renders ONE causally-linked
+timeline across router, prefill, and decode processes.
+"""
+import itertools
+import os
+import threading
+import time
+
+from ...distributed.ps import rpc as _rpc
+from ...observability import metrics as _metrics
+from ..scheduler import DONE, ERROR, QUEUED, RUNNING, SHED, TIMEOUT
+from . import kv_handoff as _kv
+from .worker import (OP_KV_PUT, OP_POLL, OP_PREFILL, OP_STAT, OP_SUBMIT,
+                     OP_SWAP)
+
+__all__ = ["ServingShardClient", "DistFrontend", "DistRequest",
+           "NoWorkersError"]
+
+_M_FAILOVER = _metrics.counter(
+    "serving_failover_total",
+    "Requests re-routed off a dead decode worker mid-stream (each one "
+    "resumed recompute-style on a live worker)")
+
+_TERMINAL = (DONE, TIMEOUT, ERROR, SHED)
+
+
+class NoWorkersError(ConnectionError):
+    """Every decode worker in the pool is dark."""
+
+
+class ServingShardClient(_rpc.ShardClientBase):
+    """JSON-verb client over a pool of serving workers — one instance
+    spans N endpoints with per-endpoint sockets, retries, and breakers
+    (ShardClientBase), like the PS clients span table shards."""
+
+    def _call(self, i, op, obj, tail=b"", aux=0):
+        payload = _kv.pack_payload(obj, tail)
+        msg = _rpc._HDR.pack(op, len(payload), aux) + payload
+
+        def reader(s):
+            n = self._ack(s)
+            obj_out, _ = _kv.unpack_payload(_rpc._recv_exact(s, n))
+            return obj_out
+        return self._exchange(i, msg, reader)
+
+    def prefill(self, i, key, prompt, decode_endpoint=None):
+        return self._call(i, OP_PREFILL, {
+            "key": key, "prompt": [int(t) for t in prompt],
+            "decode_endpoint": decode_endpoint})
+
+    def kv_put(self, i, key, bundle):
+        return self._call(i, OP_KV_PUT, {"key": key}, tail=bundle)
+
+    def submit(self, i, key, prompt, max_new=None, priority="standard",
+               timeout_s=None, use_staged=False):
+        return self._call(i, OP_SUBMIT, {
+            "key": key, "prompt": [int(t) for t in prompt],
+            "max_new": max_new, "priority": priority,
+            "timeout_s": timeout_s, "use_staged": bool(use_staged)})
+
+    def poll(self, i, keys):
+        return self._call(i, OP_POLL, {"keys": list(keys)})
+
+    def swap(self, i, path, version=None, apply_timeout_s=30):
+        return self._call(i, OP_SWAP, {
+            "path": path, "version": version,
+            "apply_timeout_s": apply_timeout_s})
+
+    def stat(self, i):
+        return self._call(i, OP_STAT, {})
+
+
+class DistRequest:
+    """Router-side view of one request: the merged token stream across
+    (possibly several) decode workers."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new, priority, timeout_s=None):
+        self.key = f"r{next(self._ids)}.{os.getpid()}"
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.priority = priority
+        self.timeout_s = timeout_s
+        self.status = QUEUED
+        self.error = None
+        self.worker = None           # decode shard index currently serving
+        self.failovers = 0
+        self.staged = False          # last placement used a handed bundle
+        self.submitted_at = time.monotonic()
+        self.first_token_at = None
+        self._base = []              # tokens from previous (dead) workers
+        self._cur = []               # tokens from the current worker
+        self._wire_key = self.key    # re-keyed per placement attempt
+
+    @property
+    def tokens(self):
+        return self._base + self._cur
+
+    def done(self):
+        return self.status in _TERMINAL
+
+    @property
+    def ttft_s(self):
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class DistFrontend:
+    def __init__(self, decode_endpoints, prefill_endpoints=(),
+                 retry=None, breaker_threshold=2, breaker_cooldown_s=30.0,
+                 request_timeout_s=10.0, connect_timeout_s=5.0):
+        # fast-failing defaults: a dead worker should cost milliseconds
+        # of retries, then its breaker holds it dark while we re-place
+        retry = retry or _rpc.RetryPolicy(max_attempts=2,
+                                          base_delay_s=0.02,
+                                          max_delay_s=0.1)
+        kwargs = dict(retry=retry, breaker_threshold=breaker_threshold,
+                      breaker_cooldown_s=breaker_cooldown_s,
+                      request_timeout_s=request_timeout_s,
+                      connect_timeout_s=connect_timeout_s)
+        self.decode = ServingShardClient(list(decode_endpoints), **kwargs)
+        self.prefill = ServingShardClient(list(prefill_endpoints),
+                                          **kwargs) \
+            if prefill_endpoints else None
+        self._live = set(range(len(self.decode.endpoints)))
+        self._prefill_rr = 0
+        self._inflight = {}          # key -> DistRequest
+        self._lock = threading.Lock()
+
+    # -- placement -----------------------------------------------------------
+    # Locking discipline: `self._lock` guards only the bookkeeping
+    # (_live, _inflight, _prefill_rr) in short critical sections —
+    # NEVER a network round-trip. Blocking RPCs under the lock would
+    # stall pump() (token delivery, failover detection) behind every
+    # admission's retry budget.
+    def live_decode_workers(self):
+        with self._lock:
+            return sorted(self._live)
+
+    def _mark_dead(self, i):
+        with self._lock:
+            self._live.discard(i)
+
+    def _pick_decode(self):
+        """SLO-aware placement: the live worker carrying the fewest
+        in-flight router requests (queue-depth-proportional load
+        balancing without a STAT round-trip per submit)."""
+        with self._lock:
+            if not self._live:
+                raise NoWorkersError("every decode worker is dark")
+            loads = {i: 0 for i in self._live}
+            for req in self._inflight.values():
+                if not req.done() and req.worker in loads:
+                    loads[req.worker] += 1
+            return min(sorted(loads), key=lambda i: loads[i])
+
+    def _remote_prefill(self, req, decode_i, exec_prompt):
+        """Remote prefill + handoff toward `decode_i`. True when the
+        bundle is staged there; False degrades to decode-local
+        recompute (dead prefill pool, chaos on the handoff path...)."""
+        if self.prefill is None:
+            return False
+        target = self.decode.endpoints[decode_i]
+        for _ in range(len(self.prefill.endpoints)):
+            with self._lock:
+                i = self._prefill_rr % len(self.prefill.endpoints)
+                self._prefill_rr += 1
+            try:
+                self.prefill.prefill(i, req._wire_key, exec_prompt,
+                                     decode_endpoint=target)
+                return True
+            except (_rpc.PSUnavailableError, _rpc.PSServerError):
+                continue             # next prefill worker, else fallback
+        return False
+
+    def submit(self, prompt, max_new=16, priority="standard",
+               timeout_s=None):
+        req = DistRequest(prompt, max_new, priority, timeout_s=timeout_s)
+        self._place(req)                 # RPCs happen OUTSIDE the lock
+        with self._lock:
+            self._inflight[req.key] = req
+        return req
+
+    def _place(self, req):
+        """(Re-)place a request on a live decode worker (fresh submits
+        and failover restarts). Does its own fine-grained locking —
+        never called with the frontend lock held."""
+        exec_prompt = req.prompt + req.tokens
+        remaining = req.max_new - len(req.tokens)
+        while True:
+            decode_i = self._pick_decode()   # NoWorkersError when dark
+            staged = self._remote_prefill(req, decode_i, exec_prompt)
+            try:
+                self.decode.submit(
+                    decode_i, req._wire_key, exec_prompt,
+                    max_new=remaining, priority=req.priority,
+                    timeout_s=req.timeout_s, use_staged=staged)
+            except _rpc.PSUnavailableError:
+                self._mark_dead(decode_i)
+                req._wire_key = f"{req.key}.p{req.failovers}" \
+                                f".{decode_i}x"
+                continue
+            req.worker = decode_i
+            req.staged = staged
+            req.status = RUNNING
+            return
+
+    # -- streaming / failover ------------------------------------------------
+    def pump(self):
+        """One poll round: batch-fetch every live request's stream from
+        its worker, merge tokens, finalize terminal ones — and fail over
+        everything a dead worker was carrying. Returns the number of
+        requests still in flight."""
+        with self._lock:
+            by_worker = {}
+            for req in self._inflight.values():
+                if not req.done():
+                    by_worker.setdefault(req.worker, []).append(req)
+        for i, reqs in sorted(by_worker.items()):
+            try:
+                polled = self.decode.poll(
+                    i, [r._wire_key for r in reqs])
+            except (_rpc.PSUnavailableError, ConnectionError):
+                self._mark_dead(i)
+                for req in reqs:
+                    self._failover(req)
+                continue
+            for req in reqs:
+                self._merge(req, polled.get(req._wire_key))
+        with self._lock:
+            return sum(1 for r in self._inflight.values()
+                       if not r.done())
+
+    def _merge(self, req, view):
+        if not view:
+            return
+        status = view.get("status")
+        if status == "UNKNOWN":
+            # worker restarted / lost the key: recompute elsewhere
+            self._failover(req)
+            return
+        req._cur = [int(t) for t in view.get("tokens", [])]
+        if req._cur and req.first_token_at is None:
+            req.first_token_at = time.monotonic()
+        if status in _TERMINAL:
+            if status == ERROR:
+                req.error = view.get("error")
+            req.status = status
+
+    def _failover(self, req):
+        """Restart `req` on a live worker, recompute-style: everything
+        already DELIVERED to the caller is folded into the restart
+        prompt, so the merged greedy stream continues bit-identically.
+        Tokens the dead worker generated but never got polled are simply
+        regenerated — exactly, by determinism."""
+        _M_FAILOVER.inc()
+        req.failovers += 1
+        req._base = req.tokens
+        req._cur = []
+        req._wire_key = f"{req.key}.f{req.failovers}"
+        if req.max_new - len(req._base) < 1:
+            req.status = DONE          # it raced its own completion
+            return
+        try:
+            self._place(req)
+        except NoWorkersError as e:
+            req.status = ERROR
+            req.error = str(e)
+
+    def run(self, timeout_s=120.0, poll_interval_s=0.01):
+        """Pump until every submitted request is terminal (or the
+        timeout lapses); returns the inflight dict."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.pump() == 0:
+                break
+            time.sleep(poll_interval_s)
+        return dict(self._inflight)
+
+    def results(self):
+        return {k: r for k, r in self._inflight.items()}
+
+    # -- control plane -------------------------------------------------------
+    def swap_all(self, path, version=None):
+        """Push a committed checkpoint into every live worker (decode
+        pools first, then prefill — new requests may briefly prefill
+        under old weights, which the recompute fallback already
+        tolerates). Returns {endpoint: reply}."""
+        out = {}
+        for i in self.live_decode_workers():
+            try:
+                out[self.decode.endpoints[i]] = self.decode.swap(
+                    i, path, version)
+            except (_rpc.PSUnavailableError, _rpc.PSServerError) as e:
+                out[self.decode.endpoints[i]] = {"ok": False,
+                                                 "error": str(e)}
+        if self.prefill is not None:
+            for i in range(len(self.prefill.endpoints)):
+                try:
+                    out[self.prefill.endpoints[i]] = self.prefill.swap(
+                        i, path, version)
+                except (_rpc.PSUnavailableError, _rpc.PSServerError) as e:
+                    out[self.prefill.endpoints[i]] = {
+                        "ok": False, "error": str(e)}
+        return out
+
+    def stats(self):
+        out = {}
+        for i in self.live_decode_workers():
+            try:
+                out[self.decode.endpoints[i]] = self.decode.stat(i)
+            except (_rpc.PSUnavailableError, _rpc.PSServerError) as e:
+                out[self.decode.endpoints[i]] = {"error": str(e)}
+        if self.prefill is not None:
+            for i in range(len(self.prefill.endpoints)):
+                try:
+                    out[self.prefill.endpoints[i]] = self.prefill.stat(i)
+                except (_rpc.PSUnavailableError, _rpc.PSServerError) as e:
+                    out[self.prefill.endpoints[i]] = {"error": str(e)}
+        return out
+
+    def stop_workers(self):
+        self.decode.stop_servers()
+        if self.prefill is not None:
+            self.prefill.stop_servers()
+
+    def close(self):
+        self.decode.close()
+        if self.prefill is not None:
+            self.prefill.close()

@@ -1,0 +1,148 @@
+"""Tensor-parallel paged decode: the serving engine over a device mesh.
+
+One chip's HBM bounds both the weights and the KV pool a paged engine
+can hold; tensor parallelism splits BOTH over a mesh's 'mp' axis the
+same way the training stack does (parallel/gpt_spmd.py, reference
+Megatron mp_layers):
+
+  - weights shard by their `split_axis` annotations (qkv/fc1 column-
+    parallel, out_proj/fc2 row-parallel, wte vocab-parallel, norms and
+    wpe replicated) — the annotations the GPT Layer already carries for
+    the fleet runner;
+  - the KV pools shard over the HEADS axis
+    ([num_blocks, block_size, heads/mp, head_dim] per device), so a
+    tp-degree mesh holds a tp-times-larger pool at the same per-device
+    memory — the serving-side win;
+  - block tables, positions and tokens stay replicated (tiny int32).
+
+The decode step itself is the SAME traced program as the single-device
+paged engine (`functional_call` over the same Layer forward — token
+exactness is inherited, not re-proven), partitioned by XLA's SPMD
+partitioner from the input shardings, with `with_sharding_constraint`
+pinning every new-pool output to the heads-sharded layout (the
+`_constrain_pools` hook). Pinning outputs is what preserves the
+compile-exactly-once invariant on a mesh: unpinned outputs could come
+back with a drifted sharding, and re-feeding them would change the
+input shardings — a silent retrace. The per-op collectives (all-reduce
+after attention out-proj and MLP fc2, the Megatron pattern) are
+inserted by the partitioner along the same 'mp' axis the hand-written
+training collectives use.
+
+CPU-testable: the tests run on the 8 virtual host devices
+(`--xla_force_host_platform_device_count`), asserting token-exact
+streams vs the single-device paged engine, a decode trace count of 1,
+and genuinely partitioned pool shards.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine import PagedEngineConfig, PagedGenerationEngine
+
+__all__ = ["TensorParallelEngineConfig", "TensorParallelPagedEngine",
+           "param_partition_specs"]
+
+
+class TensorParallelEngineConfig(PagedEngineConfig):
+    """PagedEngineConfig plus the mesh degree. `tp` devices (from
+    `jax.devices()` order) form a 1-D 'mp' mesh; `num_heads` must divide
+    by it (heads are the sharded attention axis)."""
+
+    def __init__(self, tp=2, **kwargs):
+        super().__init__(**kwargs)
+        self.tp = int(tp)
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+
+    _DICT_FIELDS = PagedEngineConfig._DICT_FIELDS + ("tp",)
+
+
+def param_partition_specs(model):
+    """{param name: PartitionSpec} over the 'mp' axis, derived from the
+    `split_axis` annotations the GPT parameters already carry for the
+    training-side TP runner (qkv.weight axis 1, out_proj.weight axis 0,
+    fc1/fc2 likewise, wte.weight axis 0 = vocab-parallel). Unannotated
+    params replicate."""
+    specs = {}
+    for name, p in model.named_parameters():
+        ax = getattr(p, "split_axis", None)
+        if ax is None:
+            specs[name] = P()
+            continue
+        parts = [None] * p._data.ndim
+        parts[int(ax)] = "mp"
+        specs[name] = P(*parts)
+    return specs
+
+
+class TensorParallelPagedEngine(PagedGenerationEngine):
+    """PagedGenerationEngine whose params and KV pools live sharded over
+    a 1-D 'mp' mesh. Public contract unchanged — prefill/decode/adopt/
+    extract/reset, compile-once trace counters, block accounting all
+    host-side and mesh-oblivious — only array placement differs."""
+
+    def __init__(self, model, config=None, **kwargs):
+        config = config or TensorParallelEngineConfig(**kwargs)
+        if not isinstance(config, TensorParallelEngineConfig):
+            raise TypeError("TensorParallelPagedEngine needs a "
+                            "TensorParallelEngineConfig")
+        devices = jax.devices()
+        if config.tp > len(devices):
+            raise ValueError(
+                f"tp={config.tp} exceeds the {len(devices)} visible "
+                f"devices")
+        if model.cfg.num_heads % config.tp:
+            raise ValueError(
+                f"tp={config.tp} must divide num_heads="
+                f"{model.cfg.num_heads} (heads are the sharded axis)")
+        self._mesh = Mesh(np.asarray(devices[:config.tp]), ("mp",))
+        self._pool_sharding = NamedSharding(
+            self._mesh, P(None, None, "mp", None))
+        self._replicated = NamedSharding(self._mesh, P())
+        super().__init__(model, config)
+
+    # -- placement -----------------------------------------------------------
+    def _alloc_state(self):
+        """Paged state, then mesh placement: params per their
+        `split_axis` specs, pools heads-sharded. Runs before any
+        executable is built, so the FIRST trace already sees the final
+        shardings — no step-one recompile."""
+        super()._alloc_state()
+        specs = param_partition_specs(self._model)
+        self._param_shardings = {
+            name: NamedSharding(self._mesh, specs.get(name, P()))
+            for name in self._params}
+        self._params = {
+            name: jax.device_put(arr, self._param_shardings[name])
+            for name, arr in self._params.items()}
+        self._buffers = {name: jax.device_put(arr, self._replicated)
+                         for name, arr in self._buffers.items()}
+        self._pool = tuple(type(layer)(
+            jax.device_put(layer.k, self._pool_sharding),
+            jax.device_put(layer.v, self._pool_sharding))
+            for layer in self._pool)
+
+    def _constrain_pools(self, pools):
+        """Pin every new-pool output to the heads-sharded layout at
+        trace time — input and output shardings stay identical forever,
+        which is what keeps the decode executable compiled exactly once
+        on a mesh (see module docstring)."""
+        return [jax.lax.with_sharding_constraint(p, self._pool_sharding)
+                for p in pools]
+
+    def _place_param(self, name, arr):
+        """Hot-swapped weights re-apply the original mesh sharding."""
+        return jax.device_put(arr, self._param_shardings[name])
+
+    # -- introspection (what the tests assert) -------------------------------
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def kv_shard_report(self):
+        """Per-device pool placement proof: {device: heads} for layer
+        0's K pool — each of the tp devices must hold heads/tp."""
+        shards = self._pool[0].k.addressable_shards
+        return {str(s.device): int(s.data.shape[2]) for s in shards}

@@ -1,0 +1,371 @@
+"""One multi-host serving HOST: engine + scheduler behind RPC verbs.
+
+A `ServingWorker` wraps a serving engine (paged / tensor-parallel /
+speculative) and exposes it on the PR 5 self-healing PS RPC fabric via
+extension verbs (rpc.register_verb — same wire, same retries, breakers,
+trace propagation, and in-band error frames as the PS ops):
+
+  PREFILL  (prefill role)  run a prompt's prefill, extract its KV
+           bundle, and STREAM it to the target decode worker's staging
+           area (KVPUT) under the caller's trace id; replies with the
+           first token. Keyed by the router's request key, so a
+           retried PREFILL returns the cached result instead of
+           recomputing — exactly-once by construction.
+  KVPUT    (decode role)   stage a KV bundle for a key (idempotent
+           overwrite; a truncated/lying bundle is rejected with an
+           in-band error frame, never adopted torn).
+  SUBMIT   (decode role)   admit a request — from its staged bundle
+           (`use_staged`) or by local recompute prefill. Keyed dedup:
+           a retried SUBMIT of a live key is a no-op.
+  POLL     (decode role)   batch-fetch {status, tokens} for keys — the
+           router's streaming pump.
+  SWAP     (both roles)    zero-downtime weight hot-swap: load a
+           ckpt_commit-committed checkpoint and apply it between decode
+           steps (scheduler.schedule_weight_swap); the reply carries
+           the outcome after application, and the
+           `serving_model_version` gauge flips.
+  STAT     (both roles)    health/placement signals: queue depth,
+           active slots, pool occupancy, model version, handoff bytes.
+
+The decode role runs a background STEP LOOP (continuous batching via
+the existing SLO scheduler); the prefill role serves synchronously from
+its handler threads. One process = one worker is the deployment shape
+(worker_main.py); tests that host several workers IN one process must
+give each its own Layer instance (weights may share arrays) —
+`functional_call` swaps a Layer's params during tracing, so two workers
+tracing through one shared Layer object would race. Faults: `serving.kv_handoff` fires on the handoff
+send path (and inside bundle pack/unpack), `serving.weight_swap` inside
+`engine.swap_params` — both armable across processes via PTN_FAULTS.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+
+from ...distributed.ps import rpc as _rpc
+from ...framework import ckpt_commit as _ckpt
+from ...observability import metrics as _metrics
+from ...observability import tracecontext as _tc
+from ..scheduler import Scheduler, ServingConfig
+from . import kv_handoff as _kv
+
+__all__ = ["ServingWorker", "load_checkpoint_params",
+           "save_swap_checkpoint", "OP_KV_PUT", "OP_PREFILL", "OP_SUBMIT",
+           "OP_POLL", "OP_SWAP", "OP_STAT"]
+
+# extension verbs on the PS fabric (< 0x40; see rpc.register_verb).
+# All are retry-safe: keyed dedup (PREFILL/SUBMIT), idempotent
+# overwrite (KVPUT/SWAP), or read-only (POLL/STAT).
+OP_KV_PUT = 16
+OP_PREFILL = 17
+OP_SUBMIT = 18
+OP_POLL = 19
+OP_SWAP = 20
+OP_STAT = 21
+
+for _op, _name in ((OP_KV_PUT, "KVPUT"), (OP_PREFILL, "PREFILL"),
+                   (OP_SUBMIT, "SUBMIT"), (OP_POLL, "POLL"),
+                   (OP_SWAP, "SWAP"), (OP_STAT, "STAT")):
+    _rpc.register_verb(_op, _name, idempotent=True)
+
+_M_HANDOFF_S = _metrics.histogram(
+    "serving_kv_handoff_seconds",
+    "Wall time of one prefill->decode KV bundle transfer (sender side)")
+_M_HANDOFF_BYTES = _metrics.counter(
+    "serving_kv_handoff_bytes_total",
+    "KV bundle bytes streamed from prefill to decode workers")
+_M_MODEL_VERSION = _metrics.gauge("serving_model_version")
+
+_DONE_CACHE_CAP = 1024               # per-worker keyed-result retention
+
+
+def load_checkpoint_params(path):
+    """Raw {name: np array} weights from a ckpt_commit-committed
+    checkpoint (distributed/checkpoint.py layout) — digest-verified,
+    torn checkpoints fall back per the shared resolution rules. The
+    hot-swap source: only checkpoints that VERIFY can ever reach
+    `engine.swap_params`."""
+    from ...distributed.checkpoint import load_state_dict
+    return load_state_dict(path, return_numpy=True)
+
+
+class ServingWorker:
+    """One serving host process. role='decode' runs the step loop and
+    admits traffic; role='prefill' computes prefills and streams KV
+    bundles to decode workers. Both swap weights and report stats."""
+
+    def __init__(self, model, engine, role="decode", serving_config=None,
+                 host="127.0.0.1", port=0, version=0,
+                 peer_client_kwargs=None, step_interval_s=0.0):
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"role must be 'decode' or 'prefill', "
+                             f"got {role!r}")
+        self.role = role
+        self.model = model
+        self.engine = engine
+        self.version = version
+        self._lock = threading.RLock()       # scheduler/engine guard
+        self._requests = {}                  # key -> RequestHandle
+        self._staged = {}                    # key -> (ks, vs, meta)
+        self._prefill_done = {}              # key -> cached PREFILL reply
+        self._peers = {}                     # endpoint -> client
+        self._peer_kwargs = dict(peer_client_kwargs or {})
+        # an optional decode-step pace (tests use it to hold a kill
+        # window open; production leaves it 0)
+        self.step_interval_s = float(step_interval_s)
+        self.handoff_bytes = 0               # STAT-visible running total
+        self._stop = threading.Event()
+        self.scheduler = Scheduler(engine, serving_config
+                                   or ServingConfig()) \
+            if role == "decode" else None
+        _M_MODEL_VERSION.set(float(version))
+        handlers = {OP_SWAP: self._h_swap, OP_STAT: self._h_stat}
+        if role == "decode":
+            handlers.update({OP_KV_PUT: self._h_kv_put,
+                             OP_SUBMIT: self._h_submit,
+                             OP_POLL: self._h_poll})
+        else:
+            handlers[OP_PREFILL] = self._h_prefill
+        self.server = _rpc.PSServer(host=host, port=port, handlers=handlers)
+        self._loop_thread = None
+        if role == "decode":
+            self._loop_thread = threading.Thread(target=self._step_loop,
+                                                 daemon=True)
+            self._loop_thread.start()
+
+    @property
+    def endpoint(self):
+        return self.server.endpoint
+
+    # -- the decode step loop ------------------------------------------------
+    def _step_loop(self):
+        """Continuous batching: step while there is work, sleep a hair
+        when idle. A pending hot-swap is applied even on an idle host
+        (apply_pending_swap outside step), so swaps never wait for
+        traffic."""
+        while not self._stop.is_set() and not self.server._stop.is_set():
+            with self._lock:
+                self.scheduler.apply_pending_swap()
+                busy = self.scheduler.step()
+            if self.step_interval_s:
+                time.sleep(self.step_interval_s)
+            elif not busy:
+                time.sleep(0.002)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+        for client in self._peers.values():
+            client.close()
+        self.server.shutdown()
+
+    def kill(self):
+        """Host-death simulation for in-process chaos tests: halt the
+        step loop AND sever every live connection mid-frame, so peers
+        observe exactly what a SIGKILLed process would give them —
+        resets, then refused connections. (Real deployments just die;
+        tests that fork worker_main use an actual SIGKILL instead.)"""
+        self._stop.set()
+        self.server.shutdown()
+        self.server.close_connections()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+
+    def serve_until_stopped(self, poll_s=0.05):
+        """Block until a client sends OP_STOP (worker_main's main loop),
+        then drain the step loop."""
+        while not self.server._stop.is_set():
+            time.sleep(poll_s)
+        self.shutdown()
+
+    # -- peers ---------------------------------------------------------------
+    def _peer(self, endpoint):
+        """A (cached) client to another worker — the prefill->decode
+        handoff edge; rides the same retry/breaker fabric as every
+        client."""
+        client = self._peers.get(endpoint)
+        if client is None:
+            from .router import ServingShardClient
+            client = ServingShardClient([endpoint], **self._peer_kwargs)
+            self._peers[endpoint] = client
+        return client
+
+    # -- handlers (run on server connection threads) -------------------------
+    def _h_prefill(self, body, aux, reqid, rctx):
+        obj, _ = _kv.unpack_payload(body)
+        key = obj["key"]
+        cached = self._prefill_done.get(key)
+        if cached is not None:               # retried PREFILL: replay
+            return _kv.pack_payload(dict(cached, cached=True))
+        prompt = [int(t) for t in obj["prompt"]]
+        with self._lock:
+            slot = 0                          # one prefill at a time
+            first = self.engine.prefill(slot, prompt)
+            ks, vs, plen = self.engine.extract_kv(slot)
+            stats = dict(getattr(self.engine, "last_prefill_stats", {}))
+            self.engine.reset_slot(slot)
+        # the handoff: fire the chaos site, then stream the bundle to
+        # the decode worker UNDER THE CALLER'S TRACE so the KVPUT spans
+        # stitch into the router's timeline
+        handoff_bytes = 0
+        target = obj.get("decode_endpoint")
+        if target:
+            # serving.kv_handoff fires inside pack (sender end) and
+            # inside the decode worker's unpack (receiver end)
+            bundle = _kv.pack_kv_bundle(
+                ks, vs, meta={"key": key, "plen": plen,
+                              "first_token": int(first)})
+            t0 = time.perf_counter()
+            scope = _tc.trace_scope(rctx[0]) if rctx is not None else None
+            try:
+                if scope is not None:
+                    scope.__enter__()
+                self._peer(target).kv_put(0, key, bundle)
+            finally:
+                if scope is not None:
+                    scope.__exit__(None, None, None)
+            _M_HANDOFF_S.observe(time.perf_counter() - t0)
+            _M_HANDOFF_BYTES.inc(len(bundle))
+            handoff_bytes = len(bundle)
+            self.handoff_bytes += handoff_bytes
+        result = {"first_token": int(first), "plen": int(plen),
+                  "handoff_bytes": handoff_bytes,
+                  "prefix_hit_tokens": int(
+                      stats.get("prefix_hit_tokens", 0) or 0)}
+        self._prefill_done[key] = result
+        self._trim(self._prefill_done)
+        return _kv.pack_payload(result)
+
+    def _h_kv_put(self, body, aux, reqid, rctx):
+        obj, tail = _kv.unpack_payload(body)
+        ks, vs, meta = _kv.unpack_kv_bundle(tail)   # validates; may raise
+        self._staged[obj["key"]] = (ks, vs, meta)
+        self._trim(self._staged)
+        return _kv.pack_payload({"ok": 1, "bytes": len(tail)})
+
+    def _h_submit(self, body, aux, reqid, rctx):
+        obj, _ = _kv.unpack_payload(body)
+        key = obj["key"]
+        with self._lock:
+            if key in self._requests:        # retried SUBMIT: no-op
+                return _kv.pack_payload({"ok": 1, "dup": True})
+            staged_kv = None
+            if obj.get("use_staged"):
+                staged = self._staged.pop(key, None)
+                if staged is not None:
+                    ks, vs, meta = staged
+                    staged_kv = (ks, vs, int(meta.get("plen", len(ks[0]))),
+                                 int(meta.get("first_token", 0)))
+            handle = self.scheduler.submit(
+                [int(t) for t in obj["prompt"]],
+                max_new_tokens=obj.get("max_new"),
+                timeout_s=obj.get("timeout_s"),
+                priority=obj.get("priority", "standard"),
+                staged_kv=staged_kv)
+            self._requests[key] = handle
+            self._trim_requests()
+        return _kv.pack_payload({"ok": 1,
+                                 "staged": staged_kv is not None})
+
+    def _trim_requests(self):
+        """Bound the handle map like the other keyed caches — but only
+        TERMINAL handles may go (evicting a live key would make POLL
+        answer UNKNOWN and trigger a spurious router failover). Oldest
+        finished requests leave first; live handles always survive."""
+        if len(self._requests) <= _DONE_CACHE_CAP:
+            return
+        for key in [k for k, h in self._requests.items() if h.done()]:
+            if len(self._requests) <= _DONE_CACHE_CAP:
+                break
+            del self._requests[key]
+
+    def _h_poll(self, body, aux, reqid, rctx):
+        obj, _ = _kv.unpack_payload(body)
+        out = {}
+        for key in obj["keys"]:
+            handle = self._requests.get(key)
+            if handle is None:
+                out[key] = {"status": "UNKNOWN", "tokens": []}
+            else:
+                out[key] = {"status": handle.status,
+                            "tokens": [int(t) for t in handle.tokens],
+                            "error": handle.error,
+                            "adopted": handle.adopted}
+        return _kv.pack_payload(out)
+
+    def _h_swap(self, body, aux, reqid, rctx):
+        obj, _ = _kv.unpack_payload(body)
+        version = obj.get("version")
+        params = load_checkpoint_params(obj["path"])
+        if self.scheduler is not None:
+            ev = self.scheduler.schedule_weight_swap(params, version)
+            # the loop applies it between decode steps (idle included)
+            if not ev.wait(timeout=float(obj.get("apply_timeout_s", 30))):
+                raise TimeoutError("weight swap not applied in time")
+            result = dict(getattr(ev, "swap_result", None)
+                          or self.scheduler.last_swap or {})
+        else:
+            with self._lock:
+                try:
+                    n = self.engine.swap_params(params)
+                except Exception as e:                   # noqa: BLE001
+                    result = {"ok": False, "version": version,
+                              "error": f"{type(e).__name__}: {e}"}
+                else:
+                    result = {"ok": True, "version": version, "params": n}
+        if result.get("ok"):
+            self.version = version if version is not None else self.version
+            _M_MODEL_VERSION.set(float(self.version))
+        return _kv.pack_payload(result)
+
+    def _h_stat(self, body, aux, reqid, rctx):
+        out = {"role": self.role, "version": self.version,
+               "endpoint": self.endpoint,
+               "kv_memory_tokens": getattr(self.engine,
+                                           "kv_memory_tokens", 0),
+               "kv_usable_tokens": getattr(self.engine,
+                                           "kv_usable_tokens", 0),
+               "handoff_bytes": self.handoff_bytes,
+               "trace_counts": _jsonable(self.engine.trace_counts)}
+        pool = getattr(self.engine, "block_pool", None)
+        if pool is not None:
+            out["blocks_in_use"] = pool.in_use
+            out["blocks_total"] = pool.capacity
+        if self.scheduler is not None:
+            with self._lock:
+                m = self.scheduler.metrics()
+            out.update({"queue_depth": m["queue_depth"],
+                        "active_slots": int(
+                            m["slot_occupancy"] * self.engine.slots),
+                        "requests": m["requests"],
+                        "tokens_generated": m["tokens_generated"],
+                        "model_version": self.scheduler.model_version})
+        return _kv.pack_payload(out)
+
+    @staticmethod
+    def _trim(cache, cap=_DONE_CACHE_CAP):
+        while len(cache) > cap:
+            cache.pop(next(iter(cache)))
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    return obj
+
+
+def save_swap_checkpoint(state_dict, path):
+    """Commit `state_dict` as a hot-swap source checkpoint (the
+    train->serve edge of the online-learning loop): the shared
+    ckpt_commit protocol, so workers only ever load a verified commit."""
+    from ...distributed.checkpoint import save_state_dict
+    save_state_dict(state_dict, path)
+    return _ckpt.verify_dir(path) is not None

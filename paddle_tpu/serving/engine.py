@@ -333,6 +333,50 @@ class GenerationEngine:
         overwrite a retired slot's lane with a harmless value)."""
         self._last_tokens[int(slot)] = np.int32(token)
 
+    # -- zero-downtime weight hot-swap ---------------------------------------
+    def swap_params(self, new_params):
+        """Replace the serving weights IN PLACE between steps (ISSUE 10:
+        the train->serve online-learning loop). Params are plain inputs
+        to every executable, so swapping the dict is the whole operation:
+        avals are validated to match exactly, which means NO executable
+        retraces or recompiles and no in-flight request is dropped — the
+        next decode step simply runs under the new weights. The swap is
+        atomic: validation (and the `serving.weight_swap` chaos site)
+        happens on a staged copy, and a failure of ANY key leaves the
+        old weights serving untouched. Returns the number of swapped
+        arrays. The eager Layer object is deliberately NOT updated — the
+        engine froze it at construction; training owns it."""
+        _faults.fire("serving.weight_swap")
+        current = self._params
+        missing = sorted(set(current) - set(new_params))
+        if missing:
+            raise ValueError(f"swap params missing {len(missing)} keys "
+                             f"(first: {missing[:3]})")
+        staged = {}
+        for name, old in current.items():
+            arr = new_params[name]
+            if isinstance(arr, Tensor):
+                arr = arr._data
+            arr = jnp.asarray(arr)
+            if tuple(arr.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"swap param {name!r} shape {tuple(arr.shape)} != "
+                    f"serving shape {tuple(old.shape)} — a hot-swap can "
+                    f"only replace values, never architecture")
+            if arr.dtype != old.dtype:
+                arr = arr.astype(old.dtype)   # ckpt round-trips may widen
+            staged[name] = self._place_param(name, arr)
+        # materialize before commit so a device placement error cannot
+        # surface lazily from inside a later decode step
+        jax.block_until_ready(list(staged.values()))
+        self._params = staged                  # the commit point
+        return len(staged)
+
+    def _place_param(self, name, arr):
+        """Device placement hook for swapped-in params — the TP engine
+        overrides to re-apply each param's mesh sharding."""
+        return arr
+
     def reset_slot(self, slot):
         """Mark a slot free: pos=0 so stale K/V rows are invisible."""
         pos = np.asarray(self._cache.pos, np.int32).copy()
@@ -423,6 +467,18 @@ class PagedGenerationEngine(GenerationEngine):
     def __init__(self, model, config=None, **kwargs):
         config = config or PagedEngineConfig(**kwargs)
         super().__init__(model, config)
+        # KV-adopt executables (multi-host handoff sink, ISSUE 10): one
+        # per prefill bucket, compiled on first use and counted like
+        # every other executable
+        self.trace_counts["adopt"] = {}
+        self._adopt = {}
+
+    def _constrain_pools(self, pools):
+        """Trace-time sharding hook on every new-pool output (decode,
+        prefill, adopt). Identity here; the tensor-parallel engine pins
+        the heads-sharded layout so executable input/output shardings
+        stay fixed and the compile-once invariant survives the mesh."""
+        return pools
 
     def _alloc_state(self):
         cfg = self._model.cfg
@@ -541,6 +597,7 @@ class PagedGenerationEngine(GenerationEngine):
         logits, nk, nv = self._run_model_paged(params, pk, pv, tables, pos,
                                                tokens[:, None])
         nxt = self._select(logits[:, 0, :], key)
+        nk, nv = self._constrain_pools(nk), self._constrain_pools(nv)
         return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
 
     # -- prefill: one executable per SUFFIX bucket ---------------------------
@@ -558,6 +615,8 @@ class PagedGenerationEngine(GenerationEngine):
             row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
             logits, npk, npv = self._run_model_paged(
                 params, pk, pv, row, start[None], ids[None, :])
+            npk = self._constrain_pools(npk)
+            npv = self._constrain_pools(npv)
             pos = jax.lax.dynamic_update_slice(
                 pos, (start + length)[None].astype(pos.dtype), (slot,))
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
@@ -662,6 +721,129 @@ class PagedGenerationEngine(GenerationEngine):
         self._last_tokens = out.copy()
         return out
 
+    # -- multi-host KV handoff (ISSUE 10) ------------------------------------
+    def extract_kv(self, slot):
+        """The handoff SOURCE half: read the `pos[slot]` resident tokens
+        of `slot` out of the pool, per layer, as host numpy
+        [plen, heads, head_dim] arrays (block padding stripped — only
+        real tokens ship). Lossless: the bytes a decode worker adopts
+        are bit-identical to what a local prefill would have written,
+        which is what makes cross-host greedy streams exact. Returns
+        (ks, vs, plen)."""
+        slot = int(slot)
+        if not self._slot_active[slot]:
+            raise ValueError(f"slot {slot} holds no request to extract")
+        plen = int(self._pos[slot])
+        if plen < 1:
+            raise ValueError(f"slot {slot} has no resident tokens")
+        bs = self.config.block_size
+        nb = blocks.blocks_for_tokens(plen, bs)
+        row = jnp.asarray(self._tables[slot][:nb], jnp.int32)
+        ks, vs = [], []
+        for layer in self._pool:
+            k = np.asarray(jax.device_get(layer.k[row]))   # [nb, bs, h, d]
+            v = np.asarray(jax.device_get(layer.v[row]))
+            ks.append(np.ascontiguousarray(
+                k.reshape(nb * bs, *k.shape[2:])[:plen]))
+            vs.append(np.ascontiguousarray(
+                v.reshape(nb * bs, *v.shape[2:])[:plen]))
+        return ks, vs, plen
+
+    def adopt_kv(self, slot, ks, vs, plen, first_token):
+        """The handoff SINK half: place a request whose prefill ran on
+        ANOTHER host. Allocates the blocks `plen` tokens need, scatters
+        the per-layer K/V slices into them through one fixed-shape
+        `adopt[bucket]` executable (padded to the prefill-bucket ladder,
+        so adoption compiles at most `len(buckets)` times, ever), and
+        arms the slot exactly as a local prefill would: pos=plen, next
+        decode input = `first_token` (the token the prefill host already
+        emitted). Raises BlockAllocError under pressure — the
+        scheduler's cue to preempt, like prefill."""
+        slot = int(slot)
+        plen = int(plen)
+        cfg = self._model.cfg
+        head_shape = (cfg.num_heads, cfg.hidden_size // cfg.num_heads)
+        if len(ks) != cfg.num_layers or len(vs) != cfg.num_layers:
+            raise ValueError(
+                f"adopt bundle has {len(ks)}/{len(vs)} layers, model has "
+                f"{cfg.num_layers}")
+        for arr in list(ks) + list(vs):
+            if tuple(arr.shape) != (plen,) + head_shape:
+                raise ValueError(
+                    f"adopt layer shape {tuple(arr.shape)} != "
+                    f"{(plen,) + head_shape}")
+        if plen < 1:
+            raise ValueError("empty adopt bundle")
+        if plen > self.max_prompt_len or self.config.max_len - plen < 1:
+            raise ValueError(
+                f"adopted prefix ({plen} tokens) exceeds the engine "
+                f"limits (max prompt {self.max_prompt_len}, max_len "
+                f"{self.config.max_len})")
+        if self._slot_active[slot]:
+            self.reset_slot(slot)
+        bs = self.config.block_size
+        n = blocks.blocks_for_tokens(plen, bs)
+        priv = self._alloc_blocks(n)        # all-or-nothing; may raise
+        row = np.zeros((self.config.max_blocks_per_slot,), np.int32)
+        row[:n] = priv
+        self._tables[slot] = row
+        self._slot_active[slot] = True
+        bucket = self.bucket_for(plen)
+        dtype = self._pool[0].k.dtype
+        pad_ks, pad_vs = [], []
+        for k, v in zip(ks, vs):
+            pk = np.zeros((bucket,) + head_shape, dtype)
+            pv = np.zeros((bucket,) + head_shape, dtype)
+            pk[:plen] = np.asarray(k, dtype)
+            pv[:plen] = np.asarray(v, dtype)
+            pad_ks.append(jnp.asarray(pk))
+            pad_vs.append(jnp.asarray(pv))
+        if bucket not in self._adopt:
+            self._adopt[bucket] = self._make_adopt(bucket)
+        try:
+            with RecordEvent("serving::adopt_kv",
+                             TracerEventType.UserDefined,
+                             {"slot": slot, "tokens": plen,
+                              "bucket": bucket, "blocks": n}), \
+                    blocks.attention_impl(self.config.attention_impl):
+                npk, npv = self._adopt[bucket](
+                    [l.k for l in self._pool], [l.v for l in self._pool],
+                    jnp.asarray(self._tables),
+                    jnp.asarray(slot, jnp.int32), pad_ks, pad_vs)
+        except Exception:
+            self.reset_slot(slot)           # never strand the blocks
+            raise
+        self._pool = tuple(blocks.PagedLayerKV(k, v)
+                           for k, v in zip(npk, npv))
+        self._pos[slot] = plen
+        self._last_tokens[slot] = np.int32(first_token)
+        self.last_prefill_stats = {"prefix_hit_tokens": 0,
+                                   "blocks_allocated": n,
+                                   "suffix_bucket": bucket,
+                                   "adopted": True}
+        return int(first_token)
+
+    def _make_adopt(self, bucket):
+        """One fixed-shape KV-adopt executable per bucket: scatter the
+        padded [bucket, h, d] layer slices into the slot's blocks from
+        position 0 (padding past plen lands in the slot's own blocks
+        beyond pos — invisible, overwritten by decode, exactly like a
+        right-padded local prefill tail)."""
+        nb = self.config.max_blocks_per_slot
+
+        def adopt_fn(pk, pv, tables, slot, new_ks, new_vs):
+            self.trace_counts["adopt"][bucket] = \
+                self.trace_counts["adopt"].get(bucket, 0) + 1
+            slot = slot.astype(jnp.int32)
+            row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
+            zero = jnp.zeros((1,), jnp.int32)
+            npk = [blocks.write(p, k[None], row, zero)
+                   for p, k in zip(pk, new_ks)]
+            npv = [blocks.write(p, v[None], row, zero)
+                   for p, v in zip(pv, new_vs)]
+            return self._constrain_pools(npk), self._constrain_pools(npv)
+        return self._cached(adopt_fn, f"adopt[{bucket}]")
+
     def reset_slot(self, slot):
         """Free the slot: every table entry drops the request's
         reference (blocks return to the pool unless the prefix cache
@@ -688,11 +870,20 @@ def default_compile_cache_dir(path):
 
 
 def _engine_kind(config):
-    """"dense" | "paged" | "spec" for an EngineConfig-family instance
-    (most-derived class first)."""
+    """"dense" | "paged" | "spec" | "tp" for an EngineConfig-family
+    instance (most-derived class first). The TP check consults
+    sys.modules instead of importing: a TensorParallelEngineConfig can
+    only exist if its module was already imported, so classifying a
+    plain dense/paged/spec config never pulls the multi-host tier in
+    (the lazy-import contract of serving/distributed/)."""
+    import sys
     from .spec_decode import SpecDecodeConfig
     if isinstance(config, SpecDecodeConfig):
         return "spec"
+    tp_mod = sys.modules.get("paddle_tpu.serving.distributed.tp")
+    if tp_mod is not None and \
+            isinstance(config, tp_mod.TensorParallelEngineConfig):
+        return "tp"
     if isinstance(config, PagedEngineConfig):
         return "paged"
     if isinstance(config, EngineConfig):
@@ -703,14 +894,20 @@ def _engine_kind(config):
 
 def make_engine(model, kind, config_dict, compile_cache_dir=None):
     """Rebuild an engine from a `.gencfg` serving record: the recorded
-    ctor kwargs plus a machine-local compile-cache dir."""
+    ctor kwargs plus a machine-local compile-cache dir. Only an
+    explicit kind="tp" pays the multi-host tier import."""
     from .spec_decode import SpecDecodeConfig, SpeculativeEngine
     classes = {"dense": (GenerationEngine, EngineConfig),
                "paged": (PagedGenerationEngine, PagedEngineConfig),
                "spec": (SpeculativeEngine, SpecDecodeConfig)}
+    if kind == "tp":
+        from .distributed.tp import (TensorParallelEngineConfig,
+                                     TensorParallelPagedEngine)
+        classes["tp"] = (TensorParallelPagedEngine,
+                         TensorParallelEngineConfig)
     if kind not in classes:
         raise ValueError(f"unknown serving engine kind {kind!r}; "
-                         f"want one of {sorted(classes)}")
+                         f"want one of {sorted(classes) + ['tp']}")
     engine_cls, cfg_cls = classes[kind]
     cfg = cfg_cls(compile_cache_dir=compile_cache_dir, **config_dict)
     return engine_cls(model, cfg)

@@ -118,6 +118,22 @@ _M_SPEC_PROPOSED = _metrics.counter(
 _M_SPEC_ACCEPTED = _metrics.counter(
     "serving_spec_accepted_total",
     "Draft tokens the speculative verifier accepted (occupied slots)")
+_M_ADOPTED = _metrics.counter(
+    "serving_kv_adopted_total",
+    "Requests placed from a handed-off KV bundle instead of a local "
+    "prefill (multi-host disaggregated serving)")
+_M_SWAPS = _metrics.counter(
+    "serving_weight_swaps_total",
+    "Weight hot-swaps applied between decode steps, by outcome",
+    labelnames=("status",))
+_M_SWAP_DROPPED = _metrics.counter(
+    "serving_swap_dropped_requests_total",
+    "Requests failed by a decode step in a swap's probation window — "
+    "zero by construction; any growth is a hot-swap that poisoned the "
+    "engine (failure-class in tools/metrics_report.py)")
+_M_MODEL_VERSION = _metrics.gauge(
+    "serving_model_version",
+    "Model version the engine is currently serving (flips on hot-swap)")
 
 
 class QueueFullError(RuntimeError):
@@ -166,6 +182,8 @@ class Request:
         self.slot = None
         self.preempted = 0                # times evicted and requeued
         self.prefix_hit = False           # prefill reused cached blocks
+        self.adopted = False              # placed from a handed-off bundle
+        self._staged = None               # (ks, vs, plen, first_token)
         self.spec_proposed = 0            # draft tokens proposed for us
         self.spec_accepted = 0            # ... and accepted by verify
         self._exec_prompt = None          # recompute prompt after preempt
@@ -232,6 +250,12 @@ class RequestHandle:
         return self._req.prefix_hit
 
     @property
+    def adopted(self):
+        """Whether the request was placed from a handed-off KV bundle
+        (its prefill ran on another host) instead of a local prefill."""
+        return self._req.adopted
+
+    @property
     def spec_proposed(self):
         """Draft tokens proposed for this request (speculative engines)."""
         return self._req.spec_proposed
@@ -278,6 +302,10 @@ class Scheduler:
         self._spec_accepted = 0
         self._capture = None                  # armed decode-step capture
         self.last_capture = None              # finalize() summary block
+        self._pending_swaps = collections.deque()   # armed hot-swaps
+        self._swap_probation = False          # first step after a swap
+        self.last_swap = None                 # apply_pending_swap summary
+        self.model_version = None
         self._completed = []
         self.counts = dict.fromkeys(_COUNTERS, 0)
         self._metrics_f = (open(self.config.metrics_path, "a")
@@ -285,7 +313,15 @@ class Scheduler:
 
     # -- admission -----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_s=None,
-               priority="standard"):
+               priority="standard", staged_kv=None):
+        """`staged_kv=(ks, vs, plen, first_token)` places the request
+        from a handed-off KV bundle (another host already ran its
+        prefill) instead of computing prefill locally — `prompt` must
+        still be the full prompt: it is the recompute source for
+        preemption and failover restarts, and the staged bundle is
+        silently dropped (local prefill resumes ownership) whenever it
+        cannot be adopted — wrong length, engine without a paged pool,
+        or a bundle that fails adoption for any non-pressure reason."""
         prompt = [int(t) for t in prompt]
         now = self._clock()
         max_new = self.config.default_max_new_tokens \
@@ -329,6 +365,9 @@ class Scheduler:
             self._finish(req, SHED, "serving.shed")
             raise LoadShedError(
                 f"load shed (priority class {prio}): {shed_why}")
+        if staged_kv is not None and hasattr(self.engine, "adopt_kv") \
+                and int(staged_kv[2]) == len(prompt):
+            req._staged = staged_kv
         self._queue.append(req)
         self._count("serving.admitted")
         return handle
@@ -426,8 +465,71 @@ class Scheduler:
                                  "aborted_by": why}
         self._capture = None
 
+    # -- zero-downtime weight hot-swap (ISSUE 10) ----------------------------
+    def schedule_weight_swap(self, params, version=None):
+        """Arm a weight hot-swap: `params` ({name: array}, e.g. a
+        ckpt_commit-verified checkpoint's state dict) replaces the
+        engine's serving weights at the TOP of the next step — strictly
+        BETWEEN decode steps, so every emitted token is computed wholly
+        under one weight set and no request is dropped or retraced.
+        Returns a threading.Event set once the swap was applied (or
+        rejected); the outcome lands in `self.last_swap` and the
+        `serving_weight_swaps_total{status}` counter, and a successful
+        swap flips the `serving_model_version` gauge to `version`.
+        A failed swap (validation, or the `serving.weight_swap` chaos
+        site) keeps the OLD weights serving — in-flight streams never
+        see a half-applied weight set. Swaps armed back-to-back QUEUE
+        and apply in arrival order in the same between-steps window —
+        every caller's event fires, the last swap wins the steady
+        state."""
+        ev = threading.Event()
+        self._pending_swaps.append({"params": params, "version": version,
+                                    "event": ev})
+        return ev
+
+    def apply_pending_swap(self):
+        """Apply every armed hot-swap now, in arrival order (called at
+        the top of every step(); idle worker loops may also call it
+        directly so a swap never waits for traffic). Returns True when
+        at least one swap was processed."""
+        applied = False
+        while True:
+            try:
+                swap = self._pending_swaps.popleft()
+            except IndexError:
+                return applied
+            applied = True
+            with RecordEvent("serving::weight_swap",
+                             TracerEventType.UserDefined,
+                             {"version": swap["version"],
+                              "inflight": self.active_slots()}):
+                try:
+                    n = self.engine.swap_params(swap["params"])
+                except Exception as e:                   # noqa: BLE001
+                    _M_SWAPS.labels(status="failed").inc()
+                    self.last_swap = {
+                        "ok": False, "version": swap["version"],
+                        "error": f"{type(e).__name__}: {e}"}
+                else:
+                    _M_SWAPS.labels(status="ok").inc()
+                    if swap["version"] is not None:
+                        self.model_version = swap["version"]
+                        _M_MODEL_VERSION.set(float(swap["version"]))
+                    # probation: requests a decode failure kills in the
+                    # very next step count as swap-dropped (must stay 0)
+                    self._swap_probation = True
+                    self.last_swap = {"ok": True,
+                                      "version": swap["version"],
+                                      "params": n,
+                                      "inflight": self.active_slots()}
+            # per-swap outcome rides the event: a queued swap's waiter
+            # must not read a LATER swap's last_swap
+            swap["event"].swap_result = dict(self.last_swap)
+            swap["event"].set()
+
     def step(self):
         """One scheduling iteration. Returns True while work remains."""
+        self.apply_pending_swap()
         now = self._clock()
         self._expire_queued(now)
         self._retire(now)
@@ -487,8 +589,10 @@ class Scheduler:
                         if req.finished(eos):
                             break
                 # a healthy step is the reprobe proof: reopen every
-                # quarantined slot for the next refill
+                # quarantined slot for the next refill (and a fresh
+                # hot-swap leaves probation — it did not poison decode)
                 self._quarantined.clear()
+                self._swap_probation = False
         self._steps += 1
         _M_QUEUE_DEPTH.set(len(self._queue))
         _M_OCCUPANCY.set(self.active_slots() / max(self.engine.slots, 1))
@@ -547,6 +651,11 @@ class Scheduler:
         instead of wedging."""
         self._decode_failures += 1
         _M_DECODE_FAILURES.inc()
+        if self._swap_probation:
+            # the first decode step after a hot-swap failed: the swap
+            # took these requests down — the gated tripwire counter
+            _M_SWAP_DROPPED.inc(self.active_slots())
+            self._swap_probation = False
         cause = f"{type(exc).__name__}: {exc}"
         with RecordEvent("serving::decode_failure",
                          TracerEventType.UserDefined,
@@ -628,6 +737,7 @@ class Scheduler:
             self._finish(req, ERROR, "serving.error")
             return
         req._exec_prompt = resume
+        req._staged = None                 # evicted KV is gone: recompute
         req.status = QUEUED
         self._queue.append(req)            # keeps its original arrival
                                            # order within its class
@@ -724,6 +834,34 @@ class Scheduler:
                 if outcome == "failed":
                     break
 
+    def _place_once(self, slot, req):
+        """One placement attempt: adopt the staged KV bundle when the
+        request carries one (multi-host handoff), else local prefill.
+        A bundle that fails adoption for any NON-pressure reason is
+        dropped and the attempt falls back to local prefill in place —
+        a rotted bundle degrades to recompute, never to a failed
+        request. BlockAllocError always escapes (the caller preempts)."""
+        staged = req._staged
+        if staged is None:
+            return self.engine.prefill(slot, req.exec_prompt)
+        try:
+            first = self.engine.adopt_kv(slot, *staged)
+        except BlockAllocError:
+            raise
+        except Exception as e:                           # noqa: BLE001
+            req._staged = None
+            with RecordEvent("serving::adopt_fallback",
+                             TracerEventType.UserDefined,
+                             {"request": req.id,
+                              "error": f"{type(e).__name__}: "
+                                       f"{str(e)[:160]}"}):
+                pass
+            return self.engine.prefill(slot, req.exec_prompt)
+        req._staged = None
+        req.adopted = True
+        _M_ADOPTED.inc()
+        return first
+
     def _try_place(self, slot, req):
         """Prefill `req` into `slot`. Allocation pressure preempts a
         strictly-lower-priority victim and retries; with no victim the
@@ -732,7 +870,7 @@ class Scheduler:
         ("failed"). Returns "placed" on success."""
         for _ in range(len(self._slots) + 1):
             try:
-                first = self.engine.prefill(slot, req.exec_prompt)
+                first = self._place_once(slot, req)
             except BlockAllocError:
                 victim = self._pick_victim(worse_than=req.priority,
                                            exclude=(slot,))
@@ -835,7 +973,7 @@ class Scheduler:
             "kind": "request", "request_id": req.id, "status": req.status,
             "prompt_len": len(req.prompt), "tokens": len(req.tokens),
             "priority": req.priority, "preempted": req.preempted,
-            "prefix_hit": req.prefix_hit,
+            "prefix_hit": req.prefix_hit, "adopted": req.adopted,
             "spec_proposed": req.spec_proposed,
             "spec_accepted": req.spec_accepted,
             "ttft_s": (req.first_token_at - req.submitted_at

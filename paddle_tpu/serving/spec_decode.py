@@ -192,6 +192,21 @@ class SpeculativeEngine(PagedGenerationEngine):
         """A verify forward writes the whole γ+1 window per slot."""
         return self.config.gamma + 1
 
+    def swap_params(self, new_params):
+        """Hot-swap (ISSUE 10) for the speculative pair: the target
+        swaps like any paged engine, then every draft param that SHARED
+        the old target's array (the truncated-draft no-second-copy
+        contract) is re-pointed at the new one — target and draft flip
+        in the same between-steps window, so acceptance never degrades
+        against a stale draft. An independently-weighted draft keeps its
+        own arrays (it only ever affects acceptance rate, not output)."""
+        old_target = dict(self._params)
+        n = super().swap_params(new_params)
+        for name, arr in list(self._draft_params.items()):
+            if name in old_target and arr is old_target[name]:
+                self._draft_params[name] = self._params[name]
+        return n
+
     # -- draft functional forward -------------------------------------------
     def _run_draft(self, params, lk, lv, pos, ids):
         cache = kvc.DecodeCache(

@@ -59,7 +59,7 @@ def test_cached_jit_roundtrip_and_stats(tmp_path):
     f1 = cc.cached_jit(_mul_add, "t.f", static_sig={"v": 1}, cache=cache)
     r1 = np.asarray(f1(a, b))
     assert cache.stats == {"hits": 0, "misses": 1, "bypass": 0,
-                           "corrupt": 0, "uncacheable": 0}
+                           "corrupt": 0, "uncacheable": 0, "evicted": 0}
     assert len(cache.entries()) == 1
     # a FRESH CachedFunction (fresh jit, as in a restarted process)
     # deserializes instead of compiling
@@ -213,7 +213,7 @@ print("DONE", flush=True)
     out = np.asarray(f(jnp.ones((8,))))
     np.testing.assert_array_equal(out, np.full(8, 3.0))
     assert cache.stats == {"hits": 0, "misses": 1, "bypass": 0,
-                           "corrupt": 0, "uncacheable": 0}
+                           "corrupt": 0, "uncacheable": 0, "evicted": 0}
     assert len(cache.entries()) == 1
     # the dead child's hidden tempdir was swept by the commit
     assert not any(n.startswith(".") and ".tmp." in n
@@ -442,3 +442,44 @@ def test_bench_cold_start_rung(tmp_path):
     assert extra["warm"]["trace_counts"]["decode"] == 0
     assert extra["cold"]["compile_cache"]["misses"] >= 2
     assert extra["warm"]["first_token"] == extra["cold"]["first_token"]
+
+
+def test_retention_cap_evicts_lru_by_mtime(tmp_path):
+    """ISSUE 10 satellite (ROADMAP item 5 retention debt): a capped
+    cache keeps at most max_entries committed entries, sweeping
+    least-recently-USED at commit time — lookups refresh recency, the
+    just-committed entry is never evicted, and evicted entries simply
+    recompile (miss, never a crash)."""
+    import jax.numpy as jnp
+    cache = cc.CompileCache(str(tmp_path), max_entries=3)
+    a = jnp.ones((4, 4))
+    fns = [cc.cached_jit(_mul_add, "t.ret", static_sig={"v": i},
+                         cache=cache) for i in range(5)]
+    for i in range(3):
+        fns[i](a, a)
+        time.sleep(0.05)               # distinct mtimes
+    assert len(cache.entries()) == 3
+    # touch v=0 via a warm lookup from a fresh function: it becomes the
+    # most recently USED even though it was committed first
+    f0 = cc.cached_jit(_mul_add, "t.ret", static_sig={"v": 0},
+                       cache=cache)
+    assert f0.warm(a, a) == "hit"
+    time.sleep(0.05)
+    fns[3](a, a)                       # 4th entry: evicts v=1 (LRU)...
+    time.sleep(0.05)
+    fns[4](a, a)                       # 5th: evicts v=2
+    assert len(cache.entries()) == 3
+    assert cache.stats["evicted"] == 2
+    # v=0 survived BECAUSE the lookup refreshed it; v=1/v=2 are gone
+    assert cc.cached_jit(_mul_add, "t.ret", static_sig={"v": 0},
+                         cache=cache).warm(a, a) == "hit"
+    assert cc.cached_jit(_mul_add, "t.ret", static_sig={"v": 1},
+                         cache=cache).warm(a, a) == "miss"
+    # the flag wires the same cap into flag-built caches
+    from paddle_tpu.framework import flags as _flags
+    _flags.set_flags({"FLAGS_compile_cache_max_entries": 7})
+    try:
+        assert cc.CompileCache(str(tmp_path)).max_entries == 7
+    finally:
+        _flags.set_flags({"FLAGS_compile_cache_max_entries": 0})
+    assert cc.CompileCache(str(tmp_path)).max_entries == 0  # unlimited

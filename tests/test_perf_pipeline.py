@@ -373,3 +373,89 @@ def test_validate_record_catches_rot():
     assert perf_report.validate_record(bad) != []
     bad = dict(good, schema="other.v9")
     assert perf_report.validate_record(bad) != []
+
+
+def _snapshot_with_hist(counters, hists):
+    """Valid snapshot with counters plus histogram samples given as
+    {name: {bucket_edge: cumulative_count}} (+Inf must be present)."""
+    rec = _snapshot_with(counters)
+    for name, buckets in hists.items():
+        count = buckets["+Inf"]
+        mean_edge = max((float(e) for e in buckets if e != "+Inf"),
+                        default=1.0)
+        rec["metrics"].append(
+            {"name": name, "type": "histogram", "help": "",
+             "labelnames": [],
+             "samples": [{"labels": {}, "buckets": buckets,
+                          "sum": mean_edge * count, "count": count}]})
+    return rec
+
+
+def test_metrics_compare_flags_failover_and_swap_drops(tmp_path):
+    """ISSUE 10 gate: serving_failover_total growth (requests re-routed
+    off dead hosts) and ANY serving_swap_dropped_requests_total growth
+    (a hot-swap that dropped traffic — zero by construction) are
+    failure-class regressions."""
+    a = _snapshot_with({"serving_failover_total": 0,
+                        "serving_swap_dropped_requests_total": 0,
+                        "serving_tokens_total": 1000})
+    b = _snapshot_with({"serving_failover_total": 4,
+                        "serving_swap_dropped_requests_total": 2,
+                        "serving_tokens_total": 1000})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why["serving_failover_total"] == "failure counter grew"
+    assert why["serving_swap_dropped_requests_total"] == \
+        "failure counter grew"
+    assert metrics_report.compare_counters(a, a) == []
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_failover_total" in bad.stdout
+
+
+def test_metrics_compare_flags_kv_handoff_p99_regression(tmp_path):
+    """ISSUE 10 gate: the serving_kv_handoff_seconds approximate p99
+    (from cumulative buckets) GROWING past the threshold is
+    failure-class — a handoff-latency tail stalls decode admission even
+    when every transfer succeeds. Same-tail traffic growth passes."""
+    fast = {"0.005": 90, "0.01": 99, "0.05": 100, "+Inf": 100}
+    slow = {"0.005": 10, "0.01": 30, "0.05": 99, "+Inf": 100}
+    a = _snapshot_with_hist({"serving_tokens_total": 100},
+                            {"serving_kv_handoff_seconds": fast})
+    b = _snapshot_with_hist({"serving_tokens_total": 100},
+                            {"serving_kv_handoff_seconds": slow})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_kv_handoff_seconds:p99") == \
+        "KV handoff p99 grew", regs
+    # same shape at 10x the traffic: the p99 is unchanged -> clean
+    fast10 = {k: v * 10 for k, v in fast.items()}
+    c = _snapshot_with_hist({"serving_tokens_total": 1000},
+                            {"serving_kv_handoff_seconds": fast10})
+    assert not any(w == "KV handoff p99 grew" for *_, w in
+                   metrics_report.compare_counters(a, c))
+    # an unrelated histogram's tail moving is NOT gated
+    d = _snapshot_with_hist({"serving_tokens_total": 100},
+                            {"serving_decode_step_seconds": slow})
+    e = _snapshot_with_hist({"serving_tokens_total": 100},
+                            {"serving_decode_step_seconds": fast})
+    assert not any("p99" in k for k, *_ in
+                   metrics_report.compare_counters(d, e))
+    # and the CLI gate exits nonzero on the regression
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_kv_handoff_seconds:p99" in bad.stdout

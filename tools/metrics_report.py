@@ -15,11 +15,14 @@ two runs with a REGRESSION mode for CI:
 threshold. Direction matters and is decided per counter name:
 
   - FAILURE counters (name matches error|reject|timeout|miss|drop|
-    failure|retr(y|ies)|fault|breaker): regression = the count GREW past
-    the threshold — `ps_retries_total` and friends are failure-CLASS
-    evidence (each one is a transport fault the fabric absorbed), so a
-    run that suddenly retries more is a regression even when it still
-    converges,
+    failure|retr(y|ies)|fault|breaker|failover): regression = the count
+    GREW past the threshold — `ps_retries_total` and friends are
+    failure-CLASS evidence (each one is a transport fault the fabric
+    absorbed), so a run that suddenly retries more is a regression even
+    when it still converges. `serving_failover_total` (requests re-routed
+    off a dead serving host) and `serving_swap_dropped_requests_total`
+    (requests a weight hot-swap failed — must stay 0) join the class
+    for the multi-host serving tier (ISSUE 10),
   - all other counters (work done: tokens, requests, bytes, hits):
     regression = the count SHRANK past the threshold,
   - rate pairs (X_hits/X_misses incl. the persistent compile cache,
@@ -31,7 +34,11 @@ threshold. Direction matters and is decided per counter name:
   - device-profile gauges (ISSUE 9): `deviceprof_total_device_ms_per_step`
     GROWING is failure-class (the kernels themselves slowed down), and
     `deviceprof_op_efficiency{op=...}` / `deviceprof_min_op_efficiency`
-    DROPPING is failure-class (an op moved away from its roofline).
+    DROPPING is failure-class (an op moved away from its roofline),
+  - histogram tails (ISSUE 10): `serving_kv_handoff_seconds` approximate
+    p99 (from the cumulative buckets) GROWING past the threshold is
+    failure-class — a handoff-latency tail stalls decode admission even
+    when every transfer still succeeds.
 
 Small-count noise is ignored via --min-delta (absolute floor, default 1).
 
@@ -48,7 +55,7 @@ SCHEMA = "paddle_tpu.metrics.v1"
 _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
-    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt", re.I)
+    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover", re.I)
 
 # counter pairs whose RATIO is the SLO signal: a rate drop past the
 # threshold is a failure-class regression even when the numerator grew
@@ -94,6 +101,48 @@ _GAUGE_DROP_RULES = (
     (re.compile(r"deviceprof_(?:op|min_op)_efficiency(\{.*\})?$"),
      "per-op device efficiency dropped"),
 )
+
+# HISTOGRAM rules (ISSUE 10): histograms whose approximate p99 GROWING
+# past the threshold is failure-class. serving_kv_handoff_seconds is the
+# multi-host KV-handoff latency: its tail blowing up means prefill
+# workers stall decode admission (TTFT regression) even when every
+# handoff still succeeds, so the count/sum rules alone would miss it.
+_HIST_P99_RULES = (
+    (re.compile(r"serving_kv_handoff_seconds(\{.*\})?$"),
+     "KV handoff p99 grew"),
+)
+
+
+def _approx_p99(buckets, count):
+    """Upper edge of the bucket holding the 99th percentile — the
+    standard Prometheus histogram_quantile approximation (cumulative
+    counts, '+Inf' edge reads as infinity)."""
+    want = 0.99 * count
+    for edge in sorted((e for e in buckets if e != "+Inf"), key=float):
+        if buckets[edge] >= want:
+            return float(edge)
+    return float("inf")
+
+
+def _hist_p99s(rec):
+    """{ 'name{labels}': approx p99 } for every histogram sample matching
+    a _HIST_P99_RULES pattern, with its rule's reason."""
+    out = {}
+    for m in rec.get("metrics", []):
+        if m["type"] != "histogram":
+            continue
+        for pat, why in _HIST_P99_RULES:
+            if not pat.match(m["name"]):
+                continue
+            for s in m["samples"]:
+                if not s.get("count"):
+                    continue
+                labels = s.get("labels") or {}
+                key = m["name"] + ("{" + ",".join(
+                    f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+                    if labels else "")
+                out[key] = (_approx_p99(s["buckets"], s["count"]), why)
+    return out
 
 
 # ------------------------------------------------------------- validation
@@ -327,6 +376,15 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
         for pat, why in _GAUGE_DROP_RULES:
             if pat.search(key) and vb < va and -pct > max_regress_pct:
                 regressions.append((key, va, vb, pct, why))
+    ha, hb = _hist_p99s(a_rec), _hist_p99s(b_rec)
+    for key in sorted(set(ha) & set(hb)):
+        (va, why), (vb, _) = ha[key], hb[key]
+        if va <= 0 or vb <= va:
+            continue
+        pct = float("inf") if vb == float("inf") \
+            else (vb - va) / va * 100.0
+        if pct > max_regress_pct:
+            regressions.append((key + ":p99", va, vb, pct, why))
     return regressions
 
 

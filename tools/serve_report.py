@@ -7,13 +7,15 @@ two record kinds:
   {"kind": "step", "step", "t", "queue_depth", "active_slots",
    "tokens_generated"}
   {"kind": "request", "request_id", "status", "prompt_len", "tokens",
-   "priority", "preempted", "prefix_hit", "spec_proposed",
+   "priority", "preempted", "prefix_hit", "adopted", "spec_proposed",
    "spec_accepted", "ttft_s", "decode_s"}
 
 The per-request SLO fields (ISSUE 6): `priority` is the request's class
 (0=interactive, 1=standard, 2=batch), `preempted` how many times it was
 evicted and requeued under allocation pressure, `prefix_hit` whether its
-prefill reused shared prefix-cache blocks. The spec-decode fields
+prefill reused shared prefix-cache blocks, `adopted` (ISSUE 10) whether
+it was placed from a KV bundle handed off by another host's prefill
+worker instead of a local prefill. The spec-decode fields
 (ISSUE 7): `spec_proposed`/`spec_accepted` count the draft tokens a
 speculative engine proposed/had accepted for this request (both 0 on
 one-token engines); the summary reports the run's acceptance rate over
@@ -34,13 +36,14 @@ STEP_FIELDS = {"kind": str, "step": int, "t": (int, float),
                "tokens_generated": int}
 REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "prompt_len": int, "tokens": int, "priority": int,
-                  "preempted": int, "prefix_hit": bool,
+                  "preempted": int, "prefix_hit": bool, "adopted": bool,
                   "spec_proposed": int, "spec_accepted": int,
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
-# absent == 0 in files written before the speculative-decode fields
-# landed (ISSUE 7) — historical artifacts must stay gradeable
-OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted"}
+# absent == 0/False in files written before the speculative-decode
+# fields (ISSUE 7) and the multi-host `adopted` flag (ISSUE 10) landed —
+# historical artifacts must stay gradeable
+OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted", "adopted"}
 STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
 
