@@ -70,7 +70,8 @@ __all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
 # the documented catalogue; arm() accepts any name so tests can add sites
 SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.decode_step", "serving.block_alloc",
-         "serving.kv_handoff", "serving.weight_swap", "dataloader.next")
+         "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
+         "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

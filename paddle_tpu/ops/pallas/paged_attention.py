@@ -73,7 +73,8 @@ def _largest_divisor_leq(n, cap):
 
 
 def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, bs, tq, hq, nb, scale):
+            acc_ref, m_ref, l_ref, *, bs, tq, hq, nb, scale,
+            ks_ref=None, vs_ref=None, qmax=127.0):
     s = pl.program_id(0)
     qi = pl.program_id(2)
     j = pl.program_id(3)          # kv block — innermost: the online scan
@@ -105,7 +106,14 @@ def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         for hh in range(hq):
             qh = qblk[:, hh, :]
             kh = kblk[:, hh, :]
-            vh = jnp.where(ever[:, None], vblk[:, hh, :], 0.0)
+            vh_raw = vblk[:, hh, :]
+            if ks_ref is not None:
+                # in-VMEM dequant of the streamed int8 block: the exact
+                # expression serving.blocks.dequant computes, so the
+                # kernel and the gather oracle see identical f32 values
+                kh = kh.astype(jnp.float32) * (ks_ref[0, hh] / qmax)
+                vh_raw = vh_raw.astype(jnp.float32) * (vs_ref[0, hh] / qmax)
+            vh = jnp.where(ever[:, None], vh_raw, 0.0)
             sc = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
@@ -134,7 +142,8 @@ def _kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention(q, k_pool, v_pool, tables, pos, scale=None,
-                    q_tile=None, head_tile=None, interpret=None):
+                    q_tile=None, head_tile=None, interpret=None,
+                    k_scale=None, v_scale=None, qmax=127.0):
     """Block-table attention without the dense gather.
 
     q: [S, T, H, D] query tokens sitting at positions pos..pos+T-1 of
@@ -144,6 +153,13 @@ def paged_attention(q, k_pool, v_pool, tables, pos, scale=None,
     [S, T, H, D] — numerically the online-softmax evaluation of exactly
     the same masked attention `blocks.attend` (gather + dense) computes.
 
+    With `k_scale`/`v_scale` ([N, H] float32, the quantized pools'
+    per-block per-head scales) the pools are int8 and dequantize
+    IN-kernel: each grid step's scale row rides the same block-table
+    index map as its K/V block (one tiny [1, head_tile] DMA alongside
+    the block), so the dense f32 view is never materialized and the HBM
+    read bill is the int8 bytes.
+
     q_tile/head_tile are caps (tuned via the shipped autotune table);
     the effective tile is the largest divisor of T / H under the cap.
     On non-TPU backends the kernel runs in Pallas interpret mode.
@@ -151,6 +167,19 @@ def paged_attention(q, k_pool, v_pool, tables, pos, scale=None,
     S, T, H, D = q.shape
     N, bs = k_pool.shape[0], k_pool.shape[1]
     nb = tables.shape[1]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("quantized paged attention needs BOTH k_scale "
+                         "and v_scale (or neither)")
+    quant = k_scale is not None
+    if quant and (k_pool.dtype != jnp.int8 or v_pool.dtype != jnp.int8):
+        raise ValueError(f"scales given but pool dtypes are "
+                         f"{k_pool.dtype}/{v_pool.dtype}, want int8")
+    if not quant and (k_pool.dtype == jnp.int8
+                      or v_pool.dtype == jnp.int8):
+        # mirror of the guard above: attention over raw int8 codes is
+        # finite, plausible, and silently wrong — the corruption class
+        # the quality gate exists to catch must not have a front door
+        raise ValueError("int8 pools need k_scale AND v_scale")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     if interpret is None:
@@ -176,14 +205,26 @@ def paged_attention(q, k_pool, v_pool, tables, pos, scale=None,
         # physical block the slot's table maps logical block j to
         return (tables_ref[s, j], 0, h, 0)
 
+    def scale_index(s, h, qi, j, tables_ref, pos_ref):
+        # the scale row rides the same walk: one [1, hq] strip per block
+        return (tables_ref[s, j], h)
+
+    in_specs = [
+        pl.BlockSpec((1, tq, hq, D), q_index),
+        pl.BlockSpec((1, bs, hq, D), kv_index),
+        pl.BlockSpec((1, bs, hq, D), kv_index),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, hq), scale_index),
+                     pl.BlockSpec((1, hq), scale_index)]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                    # tables, pos
         grid=(S, nh, nq, nb),
-        in_specs=[
-            pl.BlockSpec((1, tq, hq, D), q_index),
-            pl.BlockSpec((1, bs, hq, D), kv_index),
-            pl.BlockSpec((1, bs, hq, D), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, tq, hq, D), q_index),
         scratch_shapes=[
             pltpu.VMEM((tq, hq, D), jnp.float32),      # acc
@@ -191,11 +232,18 @@ def paged_attention(q, k_pool, v_pool, tables, pos, scale=None,
             pltpu.VMEM((tq, hq, _LANE), jnp.float32),  # running sum
         ],
     )
-    kernel = functools.partial(_kernel, bs=bs, tq=tq, hq=hq, nb=nb,
-                               scale=float(scale))
+    base = functools.partial(_kernel, bs=bs, tq=tq, hq=hq, nb=nb,
+                             scale=float(scale), qmax=float(qmax))
+    if quant:
+        def kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                   vs_ref, o_ref, acc_ref, m_ref, l_ref):
+            base(tables_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                 acc_ref, m_ref, l_ref, ks_ref=ks_ref, vs_ref=vs_ref)
+    else:
+        kernel = base
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, T, H, D), q.dtype),
         interpret=interpret,
-    )(tables, pos, q, k_pool, v_pool)
+    )(tables, pos, *operands)

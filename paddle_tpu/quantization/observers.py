@@ -35,6 +35,17 @@ class HistogramObserver:
         a = np.asarray(arr, np.float32).reshape(-1)
         if a.size == 0:
             return
+        # non-finite samples are DROPPED, not binned: a single inf would
+        # otherwise spin the range-doubling loop forever (hi can never
+        # catch an infinite batch max), and a NaN poisons vmin/vmax and
+        # every threshold derived from them. Calibration data with
+        # overflow garbage should clip it upstream; the observer's job
+        # is to stay deterministic regardless.
+        finite = np.isfinite(a)
+        if not finite.all():
+            a = a[finite]
+            if a.size == 0:
+                return
         self.vmin = min(self.vmin, float(a.min()))
         self.vmax = max(self.vmax, float(a.max()))
         a = np.abs(a)

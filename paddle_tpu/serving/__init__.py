@@ -7,7 +7,9 @@ turn a compiled decoder into a serving engine:
   kv_cache.py     — static-shape preallocated KV cache (one decode
                     executable, ever; vLLM's preallocation insight)
   blocks.py       — paged KV: fixed-size block pool + per-slot block
-                    tables, refcounted for copy-on-write sharing
+                    tables, refcounted for copy-on-write sharing; int8
+                    pools with per-block per-head scales (ISSUE 11 —
+                    2x the KV tokens per HBM byte, dequant in-kernel)
   prefix_cache.py — shared system-prompt blocks, keyed on prompt-token
                     hash, LRU-evicted under allocation pressure
   sampling.py     — greedy / temperature / top-k / top-p token selection
@@ -37,7 +39,9 @@ turn a compiled decoder into a serving engine:
 `tools/load_harness.py` ride the same engines. See docs/serving.md.
 """
 from . import blocks, kv_cache, prefix_cache, sampling, spec_decode  # noqa: F401,E501
-from .blocks import BlockAllocError, BlockPool  # noqa: F401
+from .blocks import (  # noqa: F401
+    BlockAllocError, BlockPool, PagedLayerKV, QuantPagedLayerKV,
+)
 from .engine import (  # noqa: F401
     EngineConfig, GenerationEngine, PagedEngineConfig, PagedGenerationEngine,
     default_compile_cache_dir, make_engine, save_for_generation,
@@ -53,7 +57,8 @@ from .spec_decode import (  # noqa: F401
 
 __all__ = [
     "kv_cache", "blocks", "prefix_cache", "sampling", "spec_decode",
-    "BlockAllocError", "BlockPool", "PrefixCache",
+    "BlockAllocError", "BlockPool", "PagedLayerKV", "QuantPagedLayerKV",
+    "PrefixCache",
     "EngineConfig", "GenerationEngine", "PagedEngineConfig",
     "PagedGenerationEngine", "save_for_generation", "make_engine",
     "default_compile_cache_dir",

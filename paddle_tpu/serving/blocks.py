@@ -40,11 +40,20 @@ from ..observability import metrics as _metrics
 from . import kv_cache as kvc
 
 __all__ = ["BlockAllocError", "BlockPool", "PagedLayerKV",
-           "PagedDecodeCache", "alloc_pools", "write", "gather", "attend",
-           "attend_kernel", "attention_impl", "current_attention_impl",
-           "blocks_for_tokens", "GARBAGE_BLOCK"]
+           "QuantPagedLayerKV", "PagedDecodeCache", "alloc_pools",
+           "alloc_quant_pools", "write", "quant_write", "gather",
+           "gather_quant", "dequant", "attend", "attend_quant",
+           "attend_kernel", "attend_kernel_quant", "attention_impl",
+           "current_attention_impl", "blocks_for_tokens", "GARBAGE_BLOCK",
+           "QMAX"]
 
 GARBAGE_BLOCK = 0
+
+# int8 symmetric quantization range: codes in [-127, 127], scale = the
+# per-block per-head abs-max, dequant = code * scale / QMAX — the same
+# math as quantization.fake_quant at bits=8 (qmax = 2^(8-1) - 1), which
+# is the reference the quality tests compare against.
+QMAX = 127.0
 
 _M_POOL_TOTAL = _metrics.gauge(
     "serving_block_pool_blocks_total",
@@ -63,11 +72,28 @@ class BlockAllocError(RuntimeError):
 # One layer's paged K/V: [num_blocks, block_size, heads, head_dim] pools.
 PagedLayerKV = collections.namedtuple("PagedLayerKV", ["k", "v"])
 
+# One layer's QUANTIZED paged K/V: int8 pools of the same shape plus the
+# per-block per-head scale arrays ([num_blocks, heads] float32) that ride
+# NEXT TO them — a physical block's token K/V dequantizes as
+# `code * scale[block, head] / QMAX`. Scales are part of block identity:
+# sharing a block (prefix cache, COW) shares its scale row, and freeing
+# it retires both together (the scale row is simply overwritten by the
+# next writer, like the codes).
+QuantPagedLayerKV = collections.namedtuple(
+    "QuantPagedLayerKV", ["k", "v", "k_scale", "v_scale"])
+
 # Whole-model paged cache: `layers` tuple of PagedLayerKV, `tables` int32
 # [slots, max_blocks_per_slot] physical block ids (0 == garbage), `pos`
 # int32 [slots] tokens written per slot — same role as DecodeCache.pos.
+# `valid` (optional, int32 [S] or None) is how many of a write's T tokens
+# are REAL per slot: prefill feeds bucket-PADDED ids, and a quantized
+# pool must not let the padding tokens' K/V inflate the tail block's
+# abs-max scale (the float path never cared — padding is position-masked
+# out of attention either way). None means all T tokens are real (decode,
+# verify, the float path).
 PagedDecodeCache = collections.namedtuple(
-    "PagedDecodeCache", ["layers", "tables", "pos"])
+    "PagedDecodeCache", ["layers", "tables", "pos", "valid"],
+    defaults=(None,))
 
 
 def blocks_for_tokens(n_tokens, block_size):
@@ -81,6 +107,21 @@ def alloc_pools(num_layers, num_blocks, block_size, num_heads, head_dim,
     shape = (num_blocks, block_size, num_heads, head_dim)
     return tuple(PagedLayerKV(jnp.zeros(shape, dtype),
                               jnp.zeros(shape, dtype))
+                 for _ in range(num_layers))
+
+
+def alloc_quant_pools(num_layers, num_blocks, block_size, num_heads,
+                      head_dim):
+    """Zeroed INT8 K/V pools + per-block per-head scale arrays: one
+    QuantPagedLayerKV per layer. At equal token capacity the pool bytes
+    are dtype-bytes/1 of the float pools, with a `4 * heads` bytes/block
+    scale overhead (~1/(block_size*head_dim) relative — negligible)."""
+    shape = (num_blocks, block_size, num_heads, head_dim)
+    sshape = (num_blocks, num_heads)
+    return tuple(QuantPagedLayerKV(jnp.zeros(shape, jnp.int8),
+                                   jnp.zeros(shape, jnp.int8),
+                                   jnp.zeros(sshape, jnp.float32),
+                                   jnp.zeros(sshape, jnp.float32))
                  for _ in range(num_layers))
 
 
@@ -104,12 +145,115 @@ def write(pool, new, tables, pos):
     return pool.at[phys, off].set(new.astype(pool.dtype))
 
 
+def dequant(codes, scale):
+    """Dequantize int8 block codes [..., block_size, heads, head_dim]
+    against per-block per-head scales [..., heads]:
+    `code * (scale / QMAX)`. The multiplication ORDER is part of the
+    contract — the Pallas kernel computes the identical expression, so
+    the kernel and gather paths see bit-identical dequantized values."""
+    return dequant_codes(codes, scale[..., None, :, None])
+
+
+def dequant_codes(codes, scale_b):
+    """THE canonical dequant expression over a broadcast-ready scale:
+    `code * (scale / QMAX)` — multiplication ORDER included, the Pallas
+    kernel computes the identical expression in VMEM. Every dequant in
+    the package (per-head KV pools here, per-channel decode weights in
+    `engine._dequant_params`) must route through this one helper so a
+    precision tweak can never diverge the paths."""
+    return codes.astype(jnp.float32) * (scale_b / QMAX)
+
+
+def quantize_codes(x, scale_b):
+    """THE canonical quantize expression over a broadcast-ready POSITIVE
+    scale: fake-quant round/clip to int8 codes. The inverse partner of
+    `dequant_codes`; shared by the KV write path and the decode-weight
+    quantizer for the same single-expression reason."""
+    q = jnp.clip(jnp.round(x / scale_b * QMAX), -QMAX, QMAX)
+    return q.astype(jnp.int8)
+
+
+def _quantize(x, scale):
+    """x [..., bs, h, d] f32 -> int8 codes against per-head scales
+    [..., h] (abs-max symmetric; zero-scale blocks quantize to 0)."""
+    return quantize_codes(x, jnp.maximum(scale, 1e-30)[..., None, :, None])
+
+
+def quant_write(pool, scale, new, tables, pos, valid=None):
+    """The quantizing `write`: scatter `new` [S, T, h, d] float token
+    K/V into the INT8 `pool` [N, bs, h, d] + `scale` [N, h], routed
+    through `tables` exactly like `write`. Returns (pool', scale').
+
+    Scale maintenance is per touched block: the write gathers every
+    physical block the S slots' new tokens land in, dequantizes the
+    already-resident positions (positions < pos — later positions hold
+    junk that must not poison the scale), overlays the new tokens,
+    recomputes the per-head abs-max over all valid positions
+    (< pos + valid; `valid` [S] defaults to T), and requantizes the
+    whole block. `valid < T` is the bucket-PADDED prefill: the padding
+    tokens' K/V must neither ride the abs-max scale (a one-time
+    inflated rounding the later re-zeroing could never undo) nor leave
+    nonzero codes. Fully-written earlier blocks are never touched, so
+    their codes and scales are immutable — which is what makes
+    prefix-cache sharing of quantized blocks safe. Shapes are static:
+    the same trace serves every call."""
+    S, T = new.shape[0], new.shape[1]
+    bs = pool.shape[1]
+    nb = tables.shape[1]
+    pos = pos.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+    # tight static bound on blocks one slot's T-token write can touch:
+    # positions pos..pos+T-1 span at most (pos%bs + T - 1)//bs + 1
+    # blocks, maximized at pos%bs == bs-1 — for the T=1 decode hot path
+    # this is exactly ONE block per slot, not two
+    nblk = (T + bs - 2) // bs + 1
+    base = pos // bs                                             # [S]
+    tlb = base[:, None] + jnp.arange(nblk, dtype=jnp.int32)[None, :]
+    phys = jnp.take_along_axis(tables, jnp.minimum(tlb, nb - 1), axis=1)
+    phys = jnp.where(tlb < nb, phys, GARBAGE_BLOCK)              # [S, nblk]
+    blk_q = pool[phys]                             # [S, nblk, bs, h, d]
+    blk_s = scale[phys]                            # [S, nblk, h]
+    f = dequant(blk_q, blk_s)
+    gpos = tlb[:, :, None] * bs \
+        + jnp.arange(bs, dtype=jnp.int32)[None, None, :]   # [S, nblk, bs]
+    positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    bidx = positions // bs - base[:, None]                   # [S, T]
+    off = positions % bs
+    sidx = jnp.broadcast_to(
+        jnp.arange(S, dtype=jnp.int32)[:, None], (S, T))
+    f = f.at[sidx, bidx, off].set(new.astype(jnp.float32))
+    n_real = jnp.full((S,), T, jnp.int32) if valid is None \
+        else jnp.minimum(valid.astype(jnp.int32), T)
+    # one mask zeroes everything non-real: dequantized junk past the
+    # resident frontier (positions in [pos, pos+n_real) were ALL just
+    # overlaid by the .set above, so nothing real is lost) and the
+    # overlaid padding tail of a bucket-padded prefill — neither may
+    # ride the abs-max scale below nor leave nonzero codes
+    keep = gpos < pos[:, None, None] + n_real[:, None, None]
+    f = jnp.where(keep[..., None, None], f, 0.0)
+    s_new = jnp.max(jnp.abs(f), axis=(2, 4))                 # [S, nblk, h]
+    q_new = _quantize(f, s_new)
+    # duplicate phys entries (several slots' overflow -> the garbage
+    # block) scatter in unspecified order — garbage only, same as write
+    return pool.at[phys].set(q_new), scale.at[phys].set(s_new)
+
+
 def gather(pool, tables):
     """Rebuild each slot's contiguous [S, max_blocks*block_size, h, d]
     K/V view from the pool via its block table (one XLA gather)."""
     S, nb = tables.shape
     g = pool[tables.astype(jnp.int32)]        # [S, nb, bs, h, d]
     return g.reshape(S, nb * pool.shape[1], pool.shape[2], pool.shape[3])
+
+
+def gather_quant(pool, scales, tables):
+    """Quantized `gather`: rebuild each slot's contiguous dense f32 view
+    from an int8 pool + its scale array — the dequantizing reference the
+    in-kernel dequant path is tested against."""
+    S, nb = tables.shape
+    t = tables.astype(jnp.int32)
+    f = dequant(pool[t], scales[t])           # [S, nb, bs, h, d] f32
+    return f.reshape(S, nb * pool.shape[1], pool.shape[2], pool.shape[3])
 
 
 def attend(q, k_pool, v_pool, tables, pos, scale=None):
@@ -122,6 +266,16 @@ def attend(q, k_pool, v_pool, tables, pos, scale=None):
                       pos, scale)
 
 
+def attend_quant(q, k_pool, v_pool, k_scale, v_scale, tables, pos,
+                 scale=None):
+    """Quantized block-table attention, gather reference: dequantize the
+    gathered blocks (per-block per-head scales) into the dense f32 view,
+    then the exact same masked math as `attend`. The oracle the int8
+    kernel path is asserted against on CPU."""
+    return kvc.attend(q, gather_quant(k_pool, k_scale, tables),
+                      gather_quant(v_pool, v_scale, tables), pos, scale)
+
+
 def attend_kernel(q, k_pool, v_pool, tables, pos, scale=None):
     """Block-table attention via the Pallas paged-attention kernel: the
     block table is walked IN-kernel (scalar-prefetch index maps), so the
@@ -132,6 +286,19 @@ def attend_kernel(q, k_pool, v_pool, tables, pos, scale=None):
     against the gather path."""
     from ..ops.pallas.paged_attention import paged_attention
     return paged_attention(q, k_pool, v_pool, tables, pos, scale=scale)
+
+
+def attend_kernel_quant(q, k_pool, v_pool, k_scale, v_scale, tables, pos,
+                        scale=None):
+    """Quantized block-table attention, in-kernel dequant: the scale
+    rows ride the same scalar-prefetch/block-DMA machinery as the block
+    table walk, and each streamed int8 block dequantizes in VMEM with
+    the exact `dequant` expression — the dense f32 view is never
+    materialized, so the decode HBM read bill is the int8 bytes plus a
+    ~1/(block_size*head_dim) scale overhead."""
+    from ..ops.pallas.paged_attention import paged_attention
+    return paged_attention(q, k_pool, v_pool, tables, pos, scale=scale,
+                           k_scale=k_scale, v_scale=v_scale, qmax=QMAX)
 
 
 # Which attend implementation GPTAttention traces for paged caches:

@@ -37,11 +37,20 @@ import struct
 import numpy as np
 
 from ...observability import faults as _faults
+from ..blocks import dequant_codes as _dequant_codes
 
-__all__ = ["KVWireError", "BUNDLE_VERSION", "pack_kv_bundle",
-           "unpack_kv_bundle", "pack_payload", "unpack_payload"]
+__all__ = ["KVWireError", "BUNDLE_VERSION", "QUANT_BUNDLE_VERSION",
+           "pack_kv_bundle", "unpack_kv_bundle", "pack_payload",
+           "unpack_payload"]
 
-BUNDLE_VERSION = 1
+BUNDLE_VERSION = 1            # float bundles: L * (K | V)
+# v2 (ISSUE 11): QUANTIZED bundles — int8 codes ship with their
+# per-source-block per-head scales, L * (K | V | Kscale | Vscale), plus
+# "scale_block" (the sender pool's block size = tokens per scale row)
+# and "scale_blocks" (rows per scale array) pinned in the header. The
+# receiver dequantizes on unpack, so the adopt path is version-blind;
+# v1 bundles stay readable forever.
+QUANT_BUNDLE_VERSION = 2
 _MAGIC = 0x3142564B                      # "KVB1" little-endian
 _U32 = struct.Struct("<I")
 _HEAD = struct.Struct("<II")             # magic | header_len
@@ -53,18 +62,32 @@ class KVWireError(ValueError):
     frame; never a torn adoption."""
 
 
-def pack_kv_bundle(ks, vs, meta=None):
+def pack_kv_bundle(ks, vs, meta=None, k_scales=None, v_scales=None,
+                   scale_block=None):
     """Serialize one request's per-layer K/V slices.
 
     ks/vs: sequences of [tokens, heads, head_dim] arrays, one per layer,
     all sharing shape and dtype (the engine's `extract_kv` output).
     `meta` is a small JSON-able dict (first_token, plen, request key...)
-    that rides the header verbatim."""
+    that rides the header verbatim.
+
+    QUANTIZED (v2) bundles: pass int8 ks/vs plus `k_scales`/`v_scales`
+    (per-layer [scale_blocks, heads] float32 — the quantized pool's
+    per-block per-head scales, `engine.extract_kv_wire`) and
+    `scale_block` (tokens each scale row covers). The wire then carries
+    the int8 bytes — a quarter of the f32 bundle — and the receiver
+    dequantizes at unpack."""
     _faults.fire("serving.kv_handoff")
     if len(ks) != len(vs) or not ks:
         raise KVWireError(
             f"bundle needs matching non-empty K/V layer lists, got "
             f"{len(ks)}/{len(vs)}")
+    quant = (k_scales is not None or v_scales is not None
+             or scale_block is not None)
+    if quant and (k_scales is None or v_scales is None
+                  or scale_block is None):
+        raise KVWireError("quantized bundle needs k_scales, v_scales AND "
+                          "scale_block together")
     ks = [np.ascontiguousarray(k) for k in ks]
     vs = [np.ascontiguousarray(v) for v in vs]
     shape, dtype = ks[0].shape, ks[0].dtype
@@ -76,14 +99,49 @@ def pack_kv_bundle(ks, vs, meta=None):
             raise KVWireError(
                 f"bundle layers disagree: {arr.shape}/{arr.dtype} vs "
                 f"{shape}/{dtype}")
-    header = json.dumps({
-        "v": BUNDLE_VERSION, "dtype": dtype.name, "layers": len(ks),
+    if not quant and dtype == np.int8:
+        # fail at the SENDER, mirroring unpack's v1+int8 rejection —
+        # scale-less int8 codes must never ship and cross the network
+        # only to be refused on the receiving host
+        raise KVWireError("int8 K/V needs k_scales/v_scales/scale_block "
+                          "— scale-less codes are not a legal wire")
+    header = {
+        "v": QUANT_BUNDLE_VERSION if quant else BUNDLE_VERSION,
+        "dtype": dtype.name, "layers": len(ks),
         "tokens": int(shape[0]), "heads": int(shape[1]),
-        "head_dim": int(shape[2]), "meta": dict(meta or {})}).encode()
-    parts = [_HEAD.pack(_MAGIC, len(header)), header]
-    for k, v in zip(ks, vs):
-        parts.append(k.tobytes())
-        parts.append(v.tobytes())
+        "head_dim": int(shape[2]), "meta": dict(meta or {})}
+    parts = [None, None]        # head + header, filled below
+    if quant:
+        if dtype != np.int8:
+            raise KVWireError(
+                f"quantized bundle K/V must be int8, got {dtype}")
+        sb = int(scale_block)
+        if sb < 1:                        # mirror unpack's guard
+            raise KVWireError(f"scale_block must be >= 1, got {sb}")
+        nsb = -(-int(shape[0]) // sb)     # ceil(tokens / scale_block)
+        sshape = (nsb, int(shape[1]))
+        k_scales = [np.ascontiguousarray(s, np.float32) for s in k_scales]
+        v_scales = [np.ascontiguousarray(s, np.float32) for s in v_scales]
+        if len(k_scales) != len(ks) or len(v_scales) != len(vs):
+            raise KVWireError(
+                f"scale count mismatch: {len(k_scales)}/{len(v_scales)} "
+                f"scale arrays for {len(ks)} layers")
+        for s in k_scales + v_scales:
+            if s.shape != sshape:
+                raise KVWireError(
+                    f"scale shape {s.shape} != {sshape} "
+                    f"(ceil(tokens/scale_block) x heads)")
+        header["scale_block"] = sb
+        header["scale_blocks"] = nsb
+        for k, v, sk, sv in zip(ks, vs, k_scales, v_scales):
+            parts += [k.tobytes(), v.tobytes(),
+                      sk.tobytes(), sv.tobytes()]
+    else:
+        for k, v in zip(ks, vs):
+            parts += [k.tobytes(), v.tobytes()]
+    blob = json.dumps(header).encode()
+    parts[0] = _HEAD.pack(_MAGIC, len(blob))
+    parts[1] = blob
     return b"".join(parts)
 
 
@@ -108,9 +166,11 @@ def unpack_kv_bundle(buf):
         header = json.loads(bytes(buf[_HEAD.size:_HEAD.size + hlen]))
     except ValueError as e:
         raise KVWireError(f"bundle header is not JSON: {e}") from None
-    if header.get("v") != BUNDLE_VERSION:
-        raise KVWireError(f"bundle version {header.get('v')!r}, want "
-                          f"{BUNDLE_VERSION}")
+    version = header.get("v")
+    if version not in (BUNDLE_VERSION, QUANT_BUNDLE_VERSION):
+        raise KVWireError(f"bundle version {version!r}, want "
+                          f"{BUNDLE_VERSION} or {QUANT_BUNDLE_VERSION}")
+    quant = version == QUANT_BUNDLE_VERSION
     try:
         dtype = np.dtype(header["dtype"])
         layers = int(header["layers"])
@@ -122,21 +182,73 @@ def unpack_kv_bundle(buf):
         raise KVWireError(f"bundle header degenerate: layers={layers}, "
                           f"shape={shape}")
     per = int(np.prod(shape)) * dtype.itemsize
-    want = _HEAD.size + hlen + layers * 2 * per
+    sper, sshape, sb = 0, None, 0
+    if not quant and dtype == np.int8:
+        # raw int8 codes in a v1 float bundle are scale-less garbage —
+        # a quantized sender that lost its scales, never a legal wire
+        raise KVWireError("v1 float bundle carries int8 K/V — "
+                          "quantized bundles must be v2 with scales")
+    if quant:
+        if dtype != np.int8:
+            raise KVWireError(
+                f"quantized bundle dtype {dtype}, must be int8")
+        try:
+            sb = int(header["scale_block"])
+            nsb = int(header["scale_blocks"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise KVWireError(
+                f"quantized bundle header malformed: {e}") from None
+        if sb < 1 or nsb != -(-shape[0] // sb):
+            # the SCALE-COUNT CONSISTENCY check: a header whose scale
+            # rows cannot tile its own token count is a wire lie
+            raise KVWireError(
+                f"scale count mismatch: {nsb} scale rows of {sb} tokens "
+                f"cannot cover {shape[0]} tokens")
+        sshape = (nsb, shape[1])
+        sper = int(np.prod(sshape)) * 4          # float32 scales
+    want = _HEAD.size + hlen + layers * 2 * (per + sper)
     if len(buf) != want:
         raise KVWireError(
             f"bundle truncated or padded: {len(buf)} bytes, header "
-            f"demands {want} ({layers} layers x 2 x {per}B)")
+            f"demands {want} ({layers} layers x 2 x {per + sper}B)")
     ks, vs = [], []
     off = _HEAD.size + hlen
     for _ in range(layers):
-        ks.append(np.frombuffer(buf, dtype, count=int(np.prod(shape)),
-                                offset=off).reshape(shape))
+        k = np.frombuffer(buf, dtype, count=int(np.prod(shape)),
+                          offset=off).reshape(shape)
         off += per
-        vs.append(np.frombuffer(buf, dtype, count=int(np.prod(shape)),
-                                offset=off).reshape(shape))
+        v = np.frombuffer(buf, dtype, count=int(np.prod(shape)),
+                          offset=off).reshape(shape)
         off += per
-    return ks, vs, header.get("meta", {})
+        if quant:
+            sk = np.frombuffer(buf, np.float32,
+                               count=int(np.prod(sshape)),
+                               offset=off).reshape(sshape)
+            off += sper
+            sv = np.frombuffer(buf, np.float32,
+                               count=int(np.prod(sshape)),
+                               offset=off).reshape(sshape)
+            off += sper
+            k = _dequant_tokens(k, sk, sb)
+            v = _dequant_tokens(v, sv, sb)
+        ks.append(k)
+        vs.append(v)
+    meta = header.get("meta", {})
+    if quant:
+        meta = dict(meta, quantized=True)
+    return ks, vs, meta
+
+
+def _dequant_tokens(codes, scales, scale_block):
+    """[tokens, h, d] int8 codes + [nsb, h] per-source-block scales ->
+    f32 tokens: token t dequantizes against scale row t // scale_block,
+    through `blocks.dequant_codes` — the package's ONE dequant
+    expression (numpy in, numpy out: no device dispatch on the wire
+    path), so wire-unpacked KV can never diverge from locally-decoded
+    KV by a precision tweak to one copy."""
+    rows = np.arange(codes.shape[0]) // scale_block     # [tokens]
+    return np.asarray(
+        _dequant_codes(codes, scales[rows][:, :, None]), np.float32)
 
 
 def pack_payload(obj, tail=b""):

@@ -100,6 +100,10 @@ class TensorParallelPagedEngine(PagedGenerationEngine):
         self._mesh = Mesh(np.asarray(devices[:config.tp]), ("mp",))
         self._pool_sharding = NamedSharding(
             self._mesh, P(None, None, "mp", None))
+        # a quantized pool's [num_blocks, heads] scale arrays split over
+        # the SAME heads axis as the codes they scale — per-shard scales
+        # follow the head split, so dequant stays shard-local
+        self._scale_sharding = NamedSharding(self._mesh, P(None, "mp"))
         self._replicated = NamedSharding(self._mesh, P())
         super().__init__(model, config)
 
@@ -120,21 +124,43 @@ class TensorParallelPagedEngine(PagedGenerationEngine):
         self._buffers = {name: jax.device_put(arr, self._replicated)
                          for name, arr in self._buffers.items()}
         self._pool = tuple(type(layer)(
-            jax.device_put(layer.k, self._pool_sharding),
-            jax.device_put(layer.v, self._pool_sharding))
+            *(jax.device_put(x, self._pool_sharding if x.ndim == 4
+                             else self._scale_sharding) for x in layer))
             for layer in self._pool)
 
-    def _constrain_pools(self, pools):
-        """Pin every new-pool output to the heads-sharded layout at
-        trace time — input and output shardings stay identical forever,
-        which is what keeps the decode executable compiled exactly once
-        on a mesh (see module docstring)."""
-        return [jax.lax.with_sharding_constraint(p, self._pool_sharding)
-                for p in pools]
+    def _constrain_pools(self, pool):
+        """Pin every new-pool output (codes AND, for a quantized pool,
+        the scale arrays) to its sharded layout at trace time — input
+        and output shardings stay identical forever, which is what keeps
+        the decode executable compiled exactly once on a mesh (see
+        module docstring)."""
+        return tuple(type(layer)(
+            *(jax.lax.with_sharding_constraint(
+                x, self._pool_sharding if x.ndim == 4
+                else self._scale_sharding) for x in layer))
+            for layer in pool)
 
     def _place_param(self, name, arr):
         """Hot-swapped weights re-apply the original mesh sharding."""
         return jax.device_put(arr, self._param_shardings[name])
+
+    def _place_quant_weight(self, name, codes, scale_b, axis):
+        """Quantized decode weights shard EXACTLY like their float
+        originals (same shape, same split_axis spec). The per-channel
+        scale vector follows the split only when the channel axis IS the
+        sharded axis (qkv/fc1 column splits, the wte vocab split);
+        row-parallel weights (out_proj/fc2: split axis 0, channels on
+        axis 1) keep replicated scales — every shard holds all output
+        channels."""
+        sharding = self._param_shardings.get(
+            name, NamedSharding(self._mesh, P()))
+        split = sharding.spec[axis] if axis < len(sharding.spec) else None
+        sparts = [None] * scale_b.ndim
+        if split is not None:
+            sparts[axis] = split
+        return {"q": jax.device_put(codes, sharding),
+                "scale": jax.device_put(
+                    scale_b, NamedSharding(self._mesh, P(*sparts)))}
 
     # -- introspection (what the tests assert) -------------------------------
     @property

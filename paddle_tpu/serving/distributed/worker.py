@@ -203,7 +203,11 @@ class ServingWorker:
         with self._lock:
             slot = 0                          # one prefill at a time
             first = self.engine.prefill(slot, prompt)
-            ks, vs, plen = self.engine.extract_kv(slot)
+            # quantization-aware: a kv_dtype="int8" engine ships the
+            # int8 codes + per-block scales (a v2 bundle, ~1/4 the
+            # bytes); float engines ship the v1 layout unchanged
+            wire = self.engine.extract_kv_wire(slot)
+            plen = wire["plen"]
             stats = dict(getattr(self.engine, "last_prefill_stats", {}))
             self.engine.reset_slot(slot)
         # the handoff: fire the chaos site, then stream the bundle to
@@ -215,8 +219,11 @@ class ServingWorker:
             # serving.kv_handoff fires inside pack (sender end) and
             # inside the decode worker's unpack (receiver end)
             bundle = _kv.pack_kv_bundle(
-                ks, vs, meta={"key": key, "plen": plen,
-                              "first_token": int(first)})
+                wire["ks"], wire["vs"],
+                meta={"key": key, "plen": plen, "first_token": int(first)},
+                k_scales=wire.get("k_scales"),
+                v_scales=wire.get("v_scales"),
+                scale_block=wire.get("scale_block"))
             t0 = time.perf_counter()
             scope = _tc.trace_scope(rctx[0]) if rctx is not None else None
             try:

@@ -22,6 +22,7 @@ through `functional_call`, so the same eager model object serves both
 training and serving without a second weight copy.
 """
 import dataclasses
+import functools
 import json
 import os
 
@@ -38,6 +39,24 @@ from . import blocks
 from . import kv_cache as kvc
 from . import sampling
 from .prefix_cache import PrefixCache
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _quantize_weight(w, axis):
+    """One decode-matmul weight -> (int8 codes, broadcast-ready f32
+    per-channel scales), entirely on device: abs-max over every axis but
+    `axis` (the jnp mirror of `quantization.observers.channel_abs_max`,
+    which the weight-quant tests pin it against) and the fake-quant
+    round/clip. Jitted once per (shape, axis), so hot-swap
+    re-quantization replays cached executables instead of paying a
+    device_get -> numpy -> re-upload round-trip in the swap window."""
+    w = w.astype(jnp.float32)
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    s = jnp.maximum(jnp.max(jnp.abs(w), axis=red), 1e-30)
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    s_b = s.reshape(shape)
+    return blocks.quantize_codes(w, s_b), s_b
 
 __all__ = ["EngineConfig", "GenerationEngine", "PagedEngineConfig",
            "PagedGenerationEngine", "save_for_generation", "make_engine",
@@ -138,6 +157,7 @@ class GenerationEngine:
         self.compile_cache = _cc.CompileCache(self.config.compile_cache_dir) \
             if self.config.compile_cache_dir else None
         self._alloc_state()                    # cache layout hook
+        self._build_decode_params()            # weight-quant hook
         self._decode = self._cached(self._decode_fn, "decode")
         self._prefill = {}   # bucket -> cached-jitted fn
 
@@ -166,6 +186,22 @@ class GenerationEngine:
             cfg.num_layers, self.config.slots, self.config.max_len,
             cfg.num_heads, cfg.hidden_size // cfg.num_heads,
             self._params["wte.weight"].dtype)
+
+    def _build_decode_params(self):
+        """Derive the param set the DECODE-path executables consume.
+        Identity here (decode serves the same float params as prefill);
+        the paged engine overrides for weight_dtype="int8": quantized
+        entries become {"q": int8 codes, "scale": broadcast-ready
+        per-channel scales} and the decode trace dequantizes them —
+        prefill always stays on `self._params`. Re-run after every
+        weight hot-swap (`_after_param_swap`)."""
+        self._decode_params = self._params
+
+    def _after_param_swap(self):
+        """Post-commit hook of `swap_params`: keep derived param views
+        (the quantized decode set, a spec engine's shared-draft arrays)
+        coherent with the freshly swapped weights."""
+        self._build_decode_params()
 
     # -- functional forward -------------------------------------------------
     def _run_model(self, params, layers_k, layers_v, pos, ids):
@@ -315,8 +351,11 @@ class GenerationEngine:
                          TracerEventType.UserDefined,
                          {"slots": self.config.slots}):
             tokens = self._last_tokens
+            # decode consumes _decode_params (identity == _params here;
+            # the paged engine's weight-quant hook makes them differ) so
+            # the hook's contract holds on every engine
             nxt, gk, gv, pos = self._decode(
-                self._params, [l.k for l in self._cache.layers],
+                self._decode_params, [l.k for l in self._cache.layers],
                 [l.v for l in self._cache.layers], self._cache.pos,
                 jnp.asarray(tokens), self._next_key())
         self._set_cache(gk, gv, pos)
@@ -370,6 +409,7 @@ class GenerationEngine:
         # surface lazily from inside a later decode step
         jax.block_until_ready(list(staged.values()))
         self._params = staged                  # the commit point
+        self._after_param_swap()
         return len(staged)
 
     def _place_param(self, name, arr):
@@ -430,7 +470,8 @@ class PagedEngineConfig(EngineConfig):
 
     def __init__(self, block_size=16, num_blocks=None,
                  enable_prefix_cache=True, attention_impl="gather",
-                 **kwargs):
+                 kv_dtype="float32", weight_dtype="float32",
+                 capture_logits=False, **kwargs):
         super().__init__(**kwargs)
         self.block_size = int(block_size)
         self.max_blocks_per_slot = -(-self.max_len // self.block_size)
@@ -447,9 +488,29 @@ class PagedEngineConfig(EngineConfig):
             raise ValueError(f"attention_impl must be 'gather' or "
                              f"'kernel', got {attention_impl!r}")
         self.attention_impl = attention_impl
+        # quantized serving (ISSUE 11): kv_dtype="int8" stores the KV
+        # pools as int8 codes + per-block per-head scales (2x the token
+        # budget per HBM byte vs bf16, 4x vs these f32 pools);
+        # weight_dtype="int8" runs the DECODE matmuls from int8 weights
+        # with per-output-channel scales (prefill stays float — it is
+        # compute-bound and runs once per request; decode is bandwidth-
+        # bound and runs per token). Validated here, like attention_impl.
+        for knob, val in (("kv_dtype", kv_dtype),
+                          ("weight_dtype", weight_dtype)):
+            if val not in ("float32", "int8"):
+                raise ValueError(f"{knob} must be 'float32' or 'int8', "
+                                 f"got {val!r}")
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
+        # capture_logits=True makes the decode executable additionally
+        # return the [slots, vocab] last-token logits (engine.last_logits)
+        # — the quant-quality harness's logit-KL tap. A different traced
+        # program, still compiled exactly once.
+        self.capture_logits = bool(capture_logits)
 
     _DICT_FIELDS = EngineConfig._DICT_FIELDS + (
-        "block_size", "num_blocks", "enable_prefix_cache", "attention_impl")
+        "block_size", "num_blocks", "enable_prefix_cache", "attention_impl",
+        "kv_dtype", "weight_dtype", "capture_logits")
 
 
 class PagedGenerationEngine(GenerationEngine):
@@ -473,20 +534,31 @@ class PagedGenerationEngine(GenerationEngine):
         self.trace_counts["adopt"] = {}
         self._adopt = {}
 
-    def _constrain_pools(self, pools):
+    def _constrain_pools(self, pool):
         """Trace-time sharding hook on every new-pool output (decode,
-        prefill, adopt). Identity here; the tensor-parallel engine pins
-        the heads-sharded layout so executable input/output shardings
-        stay fixed and the compile-once invariant survives the mesh."""
-        return pools
+        prefill, adopt): takes and returns the whole pool tuple (one
+        (Quant)PagedLayerKV per layer). Identity here; the tensor-
+        parallel engine pins the heads-sharded layout so executable
+        input/output shardings stay fixed and the compile-once invariant
+        survives the mesh."""
+        return pool
+
+    @property
+    def kv_quantized(self):
+        return self.config.kv_dtype == "int8"
 
     def _alloc_state(self):
         cfg = self._model.cfg
         c = self.config
-        self._pool = blocks.alloc_pools(
-            cfg.num_layers, c.num_blocks, c.block_size, cfg.num_heads,
-            cfg.hidden_size // cfg.num_heads,
-            self._params["wte.weight"].dtype)
+        if self.kv_quantized:
+            self._pool = blocks.alloc_quant_pools(
+                cfg.num_layers, c.num_blocks, c.block_size, cfg.num_heads,
+                cfg.hidden_size // cfg.num_heads)
+        else:
+            self._pool = blocks.alloc_pools(
+                cfg.num_layers, c.num_blocks, c.block_size, cfg.num_heads,
+                cfg.hidden_size // cfg.num_heads,
+                self._params["wte.weight"].dtype)
         # pos lives host-side (np): the block math (ensure_slot_capacity,
         # once per slot per decode step) must not pay a device fetch each
         # read — ONE transfer per decode/prefill return refreshes it
@@ -497,6 +569,70 @@ class PagedGenerationEngine(GenerationEngine):
         self.prefix_cache = PrefixCache(self.block_pool, c.block_size) \
             if c.enable_prefix_cache else None
         self.last_prefill_stats = {}
+        self.last_logits = None
+
+    # -- int8 decode weights (ISSUE 11) --------------------------------------
+    def _weight_quant_axis(self, name, arr):
+        """Per-channel quantization axis for a decode-matmul weight, or
+        None to keep the param float. Quantized: every 2-D `.weight` —
+        the qkv/out_proj/fc1/fc2 Linears (channel axis 1, the output
+        column — reference fake_channel_wise_quantize_abs_max for
+        Linear) and the tied `wte.weight` head matmul (channel axis 0,
+        the vocab row). `wpe.weight` stays float: it is a position
+        LOOKUP, not a decode matmul, and its read is one row per slot."""
+        if arr.ndim != 2 or not name.endswith(".weight"):
+            return None
+        if "wpe" in name:
+            return None
+        return 0 if name.endswith("wte.weight") else 1
+
+    def _build_decode_params(self):
+        """weight_dtype="int8": re-express every decode-matmul weight as
+        int8 codes + per-output-channel scales (`channel_abs_max`, the
+        dormant PTQ subsystem's scale rule) for the decode/verify
+        executables, which dequantize at trace time — XLA fuses the
+        convert+scale into the matmul operand read, so the HBM bill of
+        the bandwidth-bound decode step is the int8 bytes. The float
+        params (`self._params`) are untouched: prefill keeps serving
+        them. Scales ship broadcast-ready (reshaped to the weight's
+        rank) so the pytree stays {name: array | {"q","scale"}} with no
+        static metadata riding the executable arguments."""
+        if self.config.weight_dtype != "int8":
+            self._decode_params = self._params
+            return
+        self._decode_params = self._quantize_params(self._params)
+
+    def _quantize_params(self, params):
+        """int8-quantize every decode-matmul weight of a param dict
+        (per-channel abs-max scales); non-matmul params pass through.
+        Quantization runs ON DEVICE under jit (`_quantize_weight`) so a
+        weight hot-swap re-quantizes without a host round-trip inside
+        the between-steps swap window."""
+        out = {}
+        for name, arr in params.items():
+            axis = self._weight_quant_axis(name, arr)
+            if axis is None:
+                out[name] = arr
+                continue
+            codes, s_b = _quantize_weight(arr, axis)
+            out[name] = self._place_quant_weight(name, codes, s_b, axis)
+        return out
+
+    def _place_quant_weight(self, name, codes, scale_b, axis):
+        """Device placement of one quantized decode weight — the TP
+        engine re-applies the float param's mesh sharding (per-shard
+        scales follow the split when the channel axis IS the sharded
+        axis)."""
+        return {"q": codes, "scale": scale_b}
+
+    @staticmethod
+    def _dequant_params(params):
+        """Materialize a decode param dict inside the trace: quantized
+        entries dequantize through the one canonical expression
+        (`blocks.dequant_codes`), float entries pass through."""
+        return {n: (blocks.dequant_codes(v["q"], v["scale"])
+                    if isinstance(v, dict) else v)
+                for n, v in params.items()}
 
     # -- block accounting ----------------------------------------------------
     def _alloc_blocks(self, n):
@@ -557,54 +693,61 @@ class PagedGenerationEngine(GenerationEngine):
         wrap the warms exactly as it wraps the live calls — a kernel-
         config engine warmed outside the context would compile (and
         commit under the kernel key) the gather program."""
-        pk = [l.k for l in self._pool]
-        pv = [l.v for l in self._pool]
         tables = jnp.asarray(self._tables)
         pos = jnp.asarray(self._pos)
         key = self._warm_key()
         out = {}
         with blocks.attention_impl(self.config.attention_impl):
             out["decode"] = self._decode.warm(
-                self._params, pk, pv, tables, pos,
+                self._decode_params, self._pool, tables, pos,
                 jnp.zeros((self.config.slots,), jnp.int32), key)
             for b in self.config.prefill_buckets:
                 if b not in self._prefill:
                     self._prefill[b] = self._make_prefill(b)
                 out[f"prefill[{b}]"] = self._prefill[b].warm(
-                    self._params, pk, pv, tables, pos,
+                    self._params, self._pool, tables, pos,
                     jnp.asarray(0, jnp.int32), jnp.zeros((b,), jnp.int32),
                     jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32),
                     key)
         return out
 
     # -- functional forward (paged) -----------------------------------------
-    def _run_model_paged(self, params, pool_k, pool_v, tables, pos, ids):
+    def _run_model_paged(self, params, pool, tables, pos, ids, valid=None):
+        """GPT cached forward over the pool pytree (a tuple of
+        (Quant)PagedLayerKV of raw arrays) -> (logits, new pool).
+        `valid` [S]: real tokens per slot in this write (prefill passes
+        the unpadded suffix length so bucket padding stays out of a
+        quantized pool's block scales)."""
         cache = blocks.PagedDecodeCache(
-            tuple(blocks.PagedLayerKV(Tensor(k), Tensor(v))
-                  for k, v in zip(pool_k, pool_v)),
-            Tensor(tables), Tensor(pos))
+            tuple(type(l)(*(Tensor(x) for x in l)) for l in pool),
+            Tensor(tables), Tensor(pos),
+            None if valid is None else Tensor(valid))
         out, _ = functional_call(
             self._model, params, self._buffers, args=(Tensor(ids),),
             kwargs={"cache": cache}, train=False)
         logits, new_cache = out
         return (logits._data,
-                [l.k._data for l in new_cache.layers],
-                [l.v._data for l in new_cache.layers])
+                tuple(type(l)(*(x._data for x in l))
+                      for l in new_cache.layers))
 
     # -- decode: ONE executable ---------------------------------------------
-    def _decode_fn(self, params, pk, pv, tables, pos, tokens, key):
+    def _decode_fn(self, params, pool, tables, pos, tokens, key):
         self.trace_counts["decode"] += 1     # trace-time only
-        logits, nk, nv = self._run_model_paged(params, pk, pv, tables, pos,
-                                               tokens[:, None])
+        logits, npool = self._run_model_paged(
+            self._dequant_params(params), pool, tables, pos,
+            tokens[:, None])
         nxt = self._select(logits[:, 0, :], key)
-        nk, nv = self._constrain_pools(nk), self._constrain_pools(nv)
-        return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
+        npool = self._constrain_pools(npool)
+        new_pos = jnp.minimum(pos + 1, self.config.max_len - 1)
+        if self.config.capture_logits:
+            return nxt, npool, new_pos, logits[:, 0, :]
+        return nxt, npool, new_pos
 
     # -- prefill: one executable per SUFFIX bucket ---------------------------
     def _make_prefill(self, bucket):
         nb = self.config.max_blocks_per_slot
 
-        def prefill_fn(params, pk, pv, tables, pos, slot, ids, length,
+        def prefill_fn(params, pool, tables, pos, slot, ids, length,
                        start, key):
             self.trace_counts["prefill"][bucket] = \
                 self.trace_counts["prefill"].get(bucket, 0) + 1
@@ -613,16 +756,16 @@ class PagedGenerationEngine(GenerationEngine):
             # suffix K/V and the gather over the (possibly shared) prefix
             # blocks; `start` = tokens already resident (prefix hit)
             row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
-            logits, npk, npv = self._run_model_paged(
-                params, pk, pv, row, start[None], ids[None, :])
-            npk = self._constrain_pools(npk)
-            npv = self._constrain_pools(npv)
+            logits, npool = self._run_model_paged(
+                params, pool, row, start[None], ids[None, :],
+                valid=length[None])
+            npool = self._constrain_pools(npool)
             pos = jax.lax.dynamic_update_slice(
                 pos, (start + length)[None].astype(pos.dtype), (slot,))
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
                                                 keepdims=False)
             first_token = self._select(last[None, :], key)[0]
-            return first_token, npk, npv, pos
+            return first_token, npool, pos
         return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     # -- public compute API --------------------------------------------------
@@ -672,17 +815,15 @@ class PagedGenerationEngine(GenerationEngine):
         with RecordEvent("serving::prefill", TracerEventType.UserDefined,
                          {"bucket": bucket, "length": plen,
                           "slot": slot, "prefix_hit_tokens": nshared,
-                          "paged": True,
+                          "paged": True, "kv_dtype": self.config.kv_dtype,
                           "attend": self.config.attention_impl}), \
                 blocks.attention_impl(self.config.attention_impl):
-            first, pk, pv, pos = self._prefill[bucket](
-                self._params, [l.k for l in self._pool],
-                [l.v for l in self._pool], jnp.asarray(self._tables),
+            first, pool, pos = self._prefill[bucket](
+                self._params, self._pool, jnp.asarray(self._tables),
                 jnp.asarray(self._pos), jnp.asarray(slot, jnp.int32),
                 jnp.asarray(padded), jnp.asarray(suffix.size, jnp.int32),
                 jnp.asarray(nshared, jnp.int32), self._next_key())
-        self._pool = tuple(blocks.PagedLayerKV(k, v)
-                           for k, v in zip(pk, pv))
+        self._pool = pool
         self._pos = np.array(pos, np.int32)   # owned, writable copy
         if self.prefix_cache is not None:
             # the prompt's fully-written blocks become shareable; the
@@ -702,24 +843,54 @@ class PagedGenerationEngine(GenerationEngine):
         under pressure — callers driving the engine directly see it; the
         scheduler pre-grows per slot so it can preempt instead)."""
         _faults.fire("serving.decode_step")
+        self._fire_kv_quant_chaos()
         self.ensure_decode_capacity()
         with RecordEvent("serving::decode_step",
                          TracerEventType.UserDefined,
                          {"slots": self.config.slots, "paged": True,
+                          "kv_dtype": self.config.kv_dtype,
                           "attend": self.config.attention_impl}), \
                 blocks.attention_impl(self.config.attention_impl):
             tokens = self._last_tokens
-            nxt, pk, pv, pos = self._decode(
-                self._params, [l.k for l in self._pool],
-                [l.v for l in self._pool], jnp.asarray(self._tables),
+            res = self._decode(
+                self._decode_params, self._pool, jnp.asarray(self._tables),
                 jnp.asarray(self._pos), jnp.asarray(tokens),
                 self._next_key())
-        self._pool = tuple(blocks.PagedLayerKV(k, v)
-                           for k, v in zip(pk, pv))
+        if self.config.capture_logits:
+            nxt, pool, pos, logits = res
+            self.last_logits = np.asarray(logits, np.float32)
+        else:
+            nxt, pool, pos = res
+        self._pool = pool
         self._pos = np.array(pos, np.int32)   # owned, writable copy
         out = np.asarray(nxt, np.int32)
         self._last_tokens = out.copy()
         return out
+
+    def _fire_kv_quant_chaos(self):
+        """The `serving.kv_quant` chaos site (truncate mode, like the
+        file-tear sites: the CALLER performs the damage): when armed and
+        fired on a quantized engine, corrupt ONE in-use block's scale
+        row (K and V, layer 0) — the int8 codes dequantize against a
+        wrong scale from here on, which is exactly the silent-corruption
+        class the serving_quant_* quality gate exists to catch."""
+        if not self.kv_quantized:
+            return
+        spec = _faults.fire("serving.kv_quant")
+        if spec is None or spec.mode != "truncate":
+            # fire() also returns the spec for a served delay/raise —
+            # only truncate mode contracts the caller to do damage
+            return
+        victim = next((int(b) for b in range(1, self.block_pool.num_blocks)
+                       if self.block_pool.refcount(b) > 0), None)
+        if victim is None:
+            return
+        layer = self._pool[0]
+        self._pool = (type(layer)(
+            layer.k, layer.v,
+            layer.k_scale.at[victim].mul(64.0),
+            layer.v_scale.at[victim].mul(64.0)),
+        ) + self._pool[1:]
 
     # -- multi-host KV handoff (ISSUE 10) ------------------------------------
     def extract_kv(self, slot):
@@ -730,24 +901,59 @@ class PagedGenerationEngine(GenerationEngine):
         are bit-identical to what a local prefill would have written,
         which is what makes cross-host greedy streams exact. Returns
         (ks, vs, plen)."""
+        row, plen, nb = self._extract_row(slot)
+        ks, vs = [], []
+        for layer in self._pool:
+            if self.kv_quantized:
+                k = blocks.dequant(layer.k[row], layer.k_scale[row])
+                v = blocks.dequant(layer.v[row], layer.v_scale[row])
+            else:
+                k, v = layer.k[row], layer.v[row]      # [nb, bs, h, d]
+            ks.append(self._strip_padding(k, nb, plen))
+            vs.append(self._strip_padding(v, nb, plen))
+        return ks, vs, plen
+
+    def _extract_row(self, slot):
+        """Shared head of the extract paths: validate the slot, return
+        (block-id row device array, resident tokens, block count)."""
         slot = int(slot)
         if not self._slot_active[slot]:
             raise ValueError(f"slot {slot} holds no request to extract")
         plen = int(self._pos[slot])
         if plen < 1:
             raise ValueError(f"slot {slot} has no resident tokens")
-        bs = self.config.block_size
-        nb = blocks.blocks_for_tokens(plen, bs)
-        row = jnp.asarray(self._tables[slot][:nb], jnp.int32)
-        ks, vs = [], []
+        nb = blocks.blocks_for_tokens(plen, self.config.block_size)
+        return jnp.asarray(self._tables[slot][:nb], jnp.int32), plen, nb
+
+    def _strip_padding(self, arr, nb, plen):
+        """[nb, bs, h, d] block stack -> contiguous [plen, h, d] host
+        tokens (block padding stripped — only real tokens ship)."""
+        a = np.asarray(jax.device_get(arr))
+        return np.ascontiguousarray(
+            a.reshape(nb * self.config.block_size, *a.shape[2:])[:plen])
+
+    def extract_kv_wire(self, slot):
+        """The wire-format half of `extract_kv`: everything
+        `kv_handoff.pack_kv_bundle` needs, quantization-aware. Float
+        engines return {"ks", "vs", "plen"}; quantized engines add the
+        int8 codes' per-block per-head scales ("k_scales"/"v_scales",
+        [nblocks, heads] float32 per layer) and "scale_block" (this
+        pool's block size — the span each scale row covers), so the
+        bundle ships the int8 bytes instead of a 4x dequantized copy."""
+        if not self.kv_quantized:
+            ks, vs, plen = self.extract_kv(slot)
+            return {"ks": ks, "vs": vs, "plen": plen}
+        row, plen, nb = self._extract_row(slot)
+        ks, vs, kss, vss = [], [], [], []
         for layer in self._pool:
-            k = np.asarray(jax.device_get(layer.k[row]))   # [nb, bs, h, d]
-            v = np.asarray(jax.device_get(layer.v[row]))
-            ks.append(np.ascontiguousarray(
-                k.reshape(nb * bs, *k.shape[2:])[:plen]))
-            vs.append(np.ascontiguousarray(
-                v.reshape(nb * bs, *v.shape[2:])[:plen]))
-        return ks, vs, plen
+            ks.append(self._strip_padding(layer.k[row], nb, plen))
+            vs.append(self._strip_padding(layer.v[row], nb, plen))
+            kss.append(np.asarray(jax.device_get(layer.k_scale[row]),
+                                  np.float32))
+            vss.append(np.asarray(jax.device_get(layer.v_scale[row]),
+                                  np.float32))
+        return {"ks": ks, "vs": vs, "plen": plen, "k_scales": kss,
+                "v_scales": vss, "scale_block": self.config.block_size}
 
     def adopt_kv(self, slot, ks, vs, plen, first_token):
         """The handoff SINK half: place a request whose prefill ran on
@@ -789,7 +995,7 @@ class PagedGenerationEngine(GenerationEngine):
         self._tables[slot] = row
         self._slot_active[slot] = True
         bucket = self.bucket_for(plen)
-        dtype = self._pool[0].k.dtype
+        dtype = np.float32 if self.kv_quantized else self._pool[0].k.dtype
         pad_ks, pad_vs = [], []
         for k, v in zip(ks, vs):
             pk = np.zeros((bucket,) + head_shape, dtype)
@@ -806,15 +1012,13 @@ class PagedGenerationEngine(GenerationEngine):
                              {"slot": slot, "tokens": plen,
                               "bucket": bucket, "blocks": n}), \
                     blocks.attention_impl(self.config.attention_impl):
-                npk, npv = self._adopt[bucket](
-                    [l.k for l in self._pool], [l.v for l in self._pool],
-                    jnp.asarray(self._tables),
+                npool = self._adopt[bucket](
+                    self._pool, jnp.asarray(self._tables),
                     jnp.asarray(slot, jnp.int32), pad_ks, pad_vs)
         except Exception:
             self.reset_slot(slot)           # never strand the blocks
             raise
-        self._pool = tuple(blocks.PagedLayerKV(k, v)
-                           for k, v in zip(npk, npv))
+        self._pool = npool
         self._pos[slot] = plen
         self._last_tokens[slot] = np.int32(first_token)
         self.last_prefill_stats = {"prefix_hit_tokens": 0,
@@ -828,20 +1032,30 @@ class PagedGenerationEngine(GenerationEngine):
         padded [bucket, h, d] layer slices into the slot's blocks from
         position 0 (padding past plen lands in the slot's own blocks
         beyond pos — invisible, overwritten by decode, exactly like a
-        right-padded local prefill tail)."""
+        right-padded local prefill tail). A quantized pool adopts
+        through the quantizing write, so the adopted prefix requantizes
+        against THIS pool's block layout."""
         nb = self.config.max_blocks_per_slot
 
-        def adopt_fn(pk, pv, tables, slot, new_ks, new_vs):
+        def adopt_fn(pool, tables, slot, new_ks, new_vs):
             self.trace_counts["adopt"][bucket] = \
                 self.trace_counts["adopt"].get(bucket, 0) + 1
             slot = slot.astype(jnp.int32)
             row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
             zero = jnp.zeros((1,), jnp.int32)
-            npk = [blocks.write(p, k[None], row, zero)
-                   for p, k in zip(pk, new_ks)]
-            npv = [blocks.write(p, v[None], row, zero)
-                   for p, v in zip(pv, new_vs)]
-            return self._constrain_pools(npk), self._constrain_pools(npv)
+            npool = []
+            for layer, k, v in zip(pool, new_ks, new_vs):
+                if hasattr(layer, "k_scale"):
+                    kq, ksc = blocks.quant_write(layer.k, layer.k_scale,
+                                                 k[None], row, zero)
+                    vq, vsc = blocks.quant_write(layer.v, layer.v_scale,
+                                                 v[None], row, zero)
+                    npool.append(blocks.QuantPagedLayerKV(kq, vq, ksc, vsc))
+                else:
+                    npool.append(blocks.PagedLayerKV(
+                        blocks.write(layer.k, k[None], row, zero),
+                        blocks.write(layer.v, v[None], row, zero)))
+            return self._constrain_pools(tuple(npool))
         return self._cached(adopt_fn, f"adopt[{bucket}]")
 
     def reset_slot(self, slot):

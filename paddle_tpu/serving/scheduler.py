@@ -310,6 +310,23 @@ class Scheduler:
         self.counts = dict.fromkeys(_COUNTERS, 0)
         self._metrics_f = (open(self.config.metrics_path, "a")
                            if self.config.metrics_path else None)
+        self._write_run_record()
+
+    def _write_run_record(self):
+        """One `run` header record per scheduler: the engine's KV/weight
+        dtypes (ISSUE 11), so a serving JSONL is self-describing about
+        what precision produced it. `quant_greedy_match` is filled by
+        quality harnesses that append their own run record; absent
+        fields default — historical artifacts stay gradeable."""
+        if not self._metrics_f:
+            return
+        cfg = self.engine.config
+        self._metrics_f.write(json.dumps({
+            "kind": "run",
+            "kv_dtype": getattr(cfg, "kv_dtype", "float32"),
+            "weight_dtype": getattr(cfg, "weight_dtype", "float32")})
+            + "\n")
+        self._metrics_f.flush()
 
     # -- admission -----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_s=None,

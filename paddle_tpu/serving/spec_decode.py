@@ -119,6 +119,12 @@ class SpecDecodeConfig(PagedEngineConfig):
         if self.gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.draft_layers = int(draft_layers)
+        if self.capture_logits:
+            raise ValueError(
+                "capture_logits is not supported on the speculative "
+                "engine: its decode path is the verify window, which "
+                "never threads last-token logits out — point quality "
+                "harnesses at a PagedGenerationEngine instead")
 
     _DICT_FIELDS = PagedEngineConfig._DICT_FIELDS + ("gamma", "draft_layers")
 
@@ -167,6 +173,11 @@ class SpeculativeEngine(PagedGenerationEngine):
         self.trace_counts["draft_decode"] = 0
         self.trace_counts["spec_verify"] = 0
         self.trace_counts["draft_prefill"] = {}
+        # weight_dtype="int8" composes: the draft's decode matmuls run
+        # from the same quantized representation as the target's verify
+        # (the truncated draft SHARES the target arrays, so its codes
+        # quantize from the identical weights)
+        self._build_draft_decode_params()
         # cached through the same persistent tier as the target's
         # executables; the compile signature now includes the draft's
         # config (set above), so draft-shape changes can never alias
@@ -192,6 +203,23 @@ class SpeculativeEngine(PagedGenerationEngine):
         """A verify forward writes the whole γ+1 window per slot."""
         return self.config.gamma + 1
 
+    def _build_draft_decode_params(self):
+        """Draft params that IDENTITY-share a target array (the
+        truncated-draft no-second-copy contract) reuse the target's
+        already-quantized `_decode_params` entry — one quantization per
+        shared array per build/hot-swap, not two."""
+        if self.config.weight_dtype != "int8":
+            self._draft_decode_params = self._draft_params
+            return
+        out, fresh = {}, {}
+        for name, arr in self._draft_params.items():
+            if arr is self._params.get(name):
+                out[name] = self._decode_params[name]
+            else:
+                fresh[name] = arr
+        out.update(self._quantize_params(fresh))
+        self._draft_decode_params = out
+
     def swap_params(self, new_params):
         """Hot-swap (ISSUE 10) for the speculative pair: the target
         swaps like any paged engine, then every draft param that SHARED
@@ -205,6 +233,7 @@ class SpeculativeEngine(PagedGenerationEngine):
         for name, arr in list(self._draft_params.items()):
             if name in old_target and arr is old_target[name]:
                 self._draft_params[name] = self._params[name]
+        self._build_draft_decode_params()      # re-quantize the new draft
         return n
 
     # -- draft functional forward -------------------------------------------
@@ -224,20 +253,32 @@ class SpeculativeEngine(PagedGenerationEngine):
     # -- the three executables ----------------------------------------------
     def _draft_decode_fn(self, params, lk, lv, pos, tokens):
         self.trace_counts["draft_decode"] += 1     # trace-time only
-        logits, nk, nv = self._run_draft(params, lk, lv, pos,
-                                         tokens[:, None])
+        logits, nk, nv = self._run_draft(self._dequant_params(params),
+                                         lk, lv, pos, tokens[:, None])
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
         return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
 
-    def _spec_verify_fn(self, params, pk, pv, tables, pos, window):
+    def _spec_verify_fn(self, params, pool, tables, pos, window):
         self.trace_counts["spec_verify"] += 1      # trace-time only
-        logits, nk, nv = self._run_model_paged(params, pk, pv, tables,
-                                               pos, window)
+        logits, npool = self._run_model_paged(
+            self._dequant_params(params), pool, tables, pos, window)
+        npool = self._constrain_pools(npool)
         choices, n_acc, last = sampling.greedy_verify(logits, window)
         # advance by accepted+1; rejected-tail K/V stays beyond pos,
-        # invisible and overwritten next round (rollback by position)
+        # invisible and overwritten next round (rollback by position).
+        # int8 pools: the verify write cannot mask the not-yet-known
+        # rejected tail (valid would need n_acc before the forward
+        # emits logits), so rejected tokens ride the touched block's
+        # abs-max scale for this ONE write — resident tokens in that
+        # block re-round once against the inflated scale. The scale
+        # itself self-corrects on the next write (rollback puts pos
+        # before the block end, so it is re-gathered and its abs-max
+        # recomputed over real positions only), and reads are always
+        # consistent (code*scale, tail masked by pos) — the residual
+        # is bounded extra rounding noise, priced by the spec-quant
+        # composition test's 0.9 stream-agreement bar.
         pos_next = jnp.minimum(pos + n_acc + 1, self.config.max_len - 1)
-        return choices, n_acc, last, nk, nv, pos_next
+        return choices, n_acc, last, npool, pos_next
 
     def _make_draft_prefill(self, bucket):
         def fn(params, lk, lv, pos, slot, ids, length):
@@ -277,13 +318,12 @@ class SpeculativeEngine(PagedGenerationEngine):
         dv = [l.v for l in self._draft_kv]
         dpos = jnp.asarray(self._draft_pos)
         out["draft_decode"] = self._draft_decode.warm(
-            self._draft_params, dk, dv, dpos,
+            self._draft_decode_params, dk, dv, dpos,
             jnp.zeros((c.slots,), jnp.int32))
         with blocks.attention_impl(c.attention_impl):
             out["spec_verify"] = self._spec_verify.warm(
-                self._params, [l.k for l in self._pool],
-                [l.v for l in self._pool], jnp.asarray(self._tables),
-                jnp.asarray(self._pos),
+                self._decode_params, self._pool,
+                jnp.asarray(self._tables), jnp.asarray(self._pos),
                 jnp.zeros((c.slots, c.gamma + 1), jnp.int32))
         for b in c.prefill_buckets:
             if b not in self._draft_prefill:
@@ -332,6 +372,7 @@ class SpeculativeEngine(PagedGenerationEngine):
         slots round-trip garbage harmlessly exactly as in the one-token
         loop."""
         _faults.fire("serving.decode_step")
+        self._fire_kv_quant_chaos()
         self.ensure_decode_capacity()
         c = self.config
         gamma = c.gamma
@@ -349,12 +390,12 @@ class SpeculativeEngine(PagedGenerationEngine):
             cols = [feed]
             for i in range(gamma):
                 feed, dk, dv, dpos = self._draft_decode(
-                    self._draft_params, dk, dv, dpos, feed)
+                    self._draft_decode_params, dk, dv, dpos, feed)
                 cols.append(feed)
             # the extra feed writes d_γ's K/V so a fully-accepted window
             # leaves the draft cache complete; its proposal is discarded
             _, dk, dv, dpos = self._draft_decode(
-                self._draft_params, dk, dv, dpos, feed)
+                self._draft_decode_params, dk, dv, dpos, feed)
             window = jnp.stack(cols, axis=1)          # [S, γ+1]
         draft_s = time.perf_counter() - t0
         _M_DRAFT_SECONDS.observe(draft_s)
@@ -364,14 +405,12 @@ class SpeculativeEngine(PagedGenerationEngine):
                          {"window": gamma + 1, "slots": c.slots,
                           "attend": c.attention_impl}), \
                 blocks.attention_impl(c.attention_impl):
-            choices, n_acc, last, pk, pv, pos = self._spec_verify(
-                self._params, [l.k for l in self._pool],
-                [l.v for l in self._pool], jnp.asarray(self._tables),
-                jnp.asarray(self._pos), window)
+            choices, n_acc, last, pool, pos = self._spec_verify(
+                self._decode_params, self._pool,
+                jnp.asarray(self._tables), jnp.asarray(self._pos), window)
         verify_s = time.perf_counter() - t1
         _M_VERIFY_SECONDS.observe(verify_s)
-        self._pool = tuple(blocks.PagedLayerKV(k, v)
-                           for k, v in zip(pk, pv))
+        self._pool = pool
         self._pos = np.array(pos, np.int32)   # owned, writable copy
         self._draft_kv = tuple(kvc.LayerKV(k, v) for k, v in zip(dk, dv))
         # the rollback: both caches advance to committed+0 — the draft's

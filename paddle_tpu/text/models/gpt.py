@@ -48,7 +48,7 @@ class GPTAttention(Layer):
         self.out_proj.weight.split_axis = 0  # row-parallel over mp
         self.dropout = cfg.attention_dropout
 
-    def forward(self, x, cache=None, pos=None, tables=None):
+    def forward(self, x, cache=None, pos=None, tables=None, valid=None):
         """Train/prefill-uncached path when cache is None. With a
         `serving.kv_cache.LayerKV` cache (+ per-slot `pos`), the projected
         k/v are written into the preallocated buffers at pos via
@@ -58,21 +58,41 @@ class GPTAttention(Layer):
         is a `serving.blocks.PagedLayerKV` pool instead: writes scatter
         into the slot's physical blocks and attention gathers them back
         through the block table — same avals forever, same compile-once
-        property."""
+        property. `valid` (quantized pools only) is the per-slot count
+        of REAL tokens in this write — bucket padding must not ride the
+        block scales."""
         B, S, H = x.shape
         qkv = self.qkv(x)  # B,S,3H
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # B,S,h,d
         if cache is not None and tables is not None:
             from ...serving import blocks as _blk
+            kernel = _blk.current_attention_impl() == "kernel"
+            if hasattr(cache, "k_scale"):
+                # QUANTIZED pool (serving.blocks.QuantPagedLayerKV): the
+                # write requantizes the touched blocks (abs-max per block
+                # per head) and attention dequantizes — in-kernel for the
+                # "kernel" impl, via the gathered dense view for "gather"
+                k_pool, k_sc = apply_op(_blk.quant_write, cache.k,
+                                        cache.k_scale, k, tables, pos,
+                                        valid)
+                v_pool, v_sc = apply_op(_blk.quant_write, cache.v,
+                                        cache.v_scale, v, tables, pos,
+                                        valid)
+                attend = _blk.attend_kernel_quant if kernel \
+                    else _blk.attend_quant
+                out = apply_op(attend, q, k_pool, v_pool, k_sc, v_sc,
+                               tables, pos)
+                out = out.reshape([B, S, H])
+                return self.out_proj(out), _blk.QuantPagedLayerKV(
+                    k_pool, v_pool, k_sc, v_sc)
             k_pool = apply_op(_blk.write, cache.k, k, tables, pos)
             v_pool = apply_op(_blk.write, cache.v, v, tables, pos)
             # trace-time dispatch (serving.blocks.attention_impl):
             # "gather" rebuilds the dense view (bit-exact oracle),
             # "kernel" walks the block table inside the Pallas kernel —
             # distinct function objects, so executables can never mix
-            attend = _blk.attend_kernel \
-                if _blk.current_attention_impl() == "kernel" else _blk.attend
+            attend = _blk.attend_kernel if kernel else _blk.attend
             out = apply_op(attend, q, k_pool, v_pool, tables, pos)
             out = out.reshape([B, S, H])
             return self.out_proj(out), _blk.PagedLayerKV(k_pool, v_pool)
@@ -114,10 +134,11 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, cache=None, pos=None, tables=None):
+    def forward(self, x, cache=None, pos=None, tables=None, valid=None):
         if cache is not None:
             attn_out, new_cache = self.attn(self.ln1(x), cache=cache,
-                                            pos=pos, tables=tables)
+                                            pos=pos, tables=tables,
+                                            valid=valid)
             x = x + self.dropout(attn_out)
             x = x + self.dropout(self.mlp(self.ln2(x)))
             return x, new_cache
@@ -170,6 +191,7 @@ class GPT(Layer):
             # block tables alongside the pools; the dense DecodeCache has
             # no `tables` field — same forward, two memory layouts
             tables = getattr(cache, "tables", None)
+            valid = getattr(cache, "valid", None)
             pos = cache.pos
             positions = apply_op(
                 lambda p, ids: p.astype(jnp.int32)[:, None]
@@ -178,7 +200,8 @@ class GPT(Layer):
             x = self.drop(self.wte(input_ids) + self.wpe(positions))
             new_layers = []
             for blk, lkv in zip(self.blocks, cache.layers):
-                x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables)
+                x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables,
+                                 valid=valid)
                 new_layers.append(new_lkv)
             logits = self._head(self.ln_f(x))
             if tables is not None:

@@ -216,6 +216,41 @@ def test_metrics_compare_flags_spec_acceptance_rate_drop(tmp_path):
                    metrics_report.compare_counters(a, c))
 
 
+def test_metrics_compare_flags_quant_quality_regressions(tmp_path):
+    """ISSUE 11 gate: a `serving_quant_greedy_match` drop (the quantized
+    path disagreeing with its f32 oracle) and a `serving_quant_logit_kl`
+    growth are failure-class — int8 serving that drifts from float is a
+    correctness regression, however fast. Both directions exercised
+    through compare_counters AND the CLI exit code."""
+    a = _snapshot_with_gauges(gauges={"serving_quant_greedy_match": 1.0,
+                                      "serving_quant_logit_kl": 0.001,
+                                      "serving_load_tokens_per_s": 100.0})
+    b = _snapshot_with_gauges(gauges={"serving_quant_greedy_match": 0.62,
+                                      "serving_quant_logit_kl": 0.9,
+                                      "serving_load_tokens_per_s": 100.0})
+    regs = metrics_report.compare_counters(a, b, min_delta=0.001)
+    why = {k: w for k, *_, w in regs}
+    assert why["serving_quant_greedy_match"] == \
+        "quantized greedy-match rate vs f32 oracle dropped"
+    assert why["serving_quant_logit_kl"] == \
+        "quantized logit KL vs f32 oracle grew"
+    assert metrics_report.compare_counters(a, a, min_delta=0.001) == []
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb, "--min-delta", "0.001"],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_quant_greedy_match" in bad.stdout
+    # an unchanged-quality run with MORE traffic stays clean
+    c = _snapshot_with_gauges(gauges={"serving_quant_greedy_match": 1.0,
+                                      "serving_quant_logit_kl": 0.001,
+                                      "serving_load_tokens_per_s": 900.0})
+    assert metrics_report.compare_counters(a, c, min_delta=0.001) == []
+
+
 def test_bench_emits_cost_model_delta(bench_artifacts):
     """ISSUE 8 satellite (ROADMAP item 1 debt): every bench run carries
     the analytical predicted-vs-measured block in extra, and the

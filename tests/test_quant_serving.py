@@ -1,0 +1,465 @@
+"""Quantized serving (ISSUE 11): int8 KV block pools + int8 decode weights.
+
+Acceptance, mapped:
+  - quantizing write / dequantizing gather round-trip within the int8
+    scale bound, immutable fully-written blocks
+    (test_quant_write_roundtrip_*);
+  - int8 kernel attend == int8 gather attend on CPU: elementwise to
+    float32 tolerance AND token-exact greedy streams between the two
+    impls (test_quant_kernel_*);
+  - quality gate: quantized engine vs the f32 oracle — teacher-forced
+    greedy match >= 0.99, tiny logit KL, serving_quant_* gauges + the
+    serve-report `run` record (test_quant_engine_matches_f32_oracle);
+  - weight path: decode weights are exactly the fake-quant math over
+    `channel_abs_max` scales, prefill params stay float
+    (test_quant_weights_*);
+  - composition: SpeculativeEngine with a quantized draft, and the TP
+    engine with head-sharded pools + per-shard scales
+    (test_spec_quant_*, test_tp_quant_*; slow tier with the chaos run —
+    each builds one more engine family, the tier-1 budget is full);
+  - versioned KV handoff: v2 quantized bundles round-trip losslessly
+    (vs the engine's own dequant), truncation and scale-count lies are
+    KVWireError, v1 stays readable (test_quant_handoff_*);
+  - chaos: the serving.kv_quant fault site corrupts one block's scale
+    and the quality gate catches it via metrics_report --compare
+    (test_kv_quant_chaos_*);
+  - quantization/observers.py: threshold determinism + the non-finite
+    collect fix (test_observer_*).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.observability import faults, metrics
+from paddle_tpu.quantization import fake_quant
+from paddle_tpu.quantization.observers import (
+    HistogramObserver, channel_abs_max, hist_percentile_threshold,
+    kl_threshold, mse_threshold)
+from paddle_tpu.serving import PagedGenerationEngine, blocks
+from paddle_tpu.serving.distributed.kv_handoff import (
+    BUNDLE_VERSION, KVWireError, QUANT_BUNDLE_VERSION, pack_kv_bundle,
+    unpack_kv_bundle)
+from paddle_tpu.serving.spec_decode import SpeculativeEngine
+from paddle_tpu.text.models import gpt_tiny
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+import load_harness  # noqa: E402
+import metrics_report  # noqa: E402
+import serve_report  # noqa: E402
+
+VOCAB = 1024
+ENGINE_KW = dict(slots=3, max_len=64, block_size=8)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    m = gpt_tiny()
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(7)
+    return [rng.randint(0, VOCAB, int(rng.randint(6, 20))).tolist()
+            for _ in range(3)]
+
+
+@pytest.fixture(scope="module")
+def quant_stream(tiny, prompts):
+    """One gather-impl quantized engine driven 12 greedy steps — the
+    reference stream the kernel/spec/TP composition tests compare to."""
+    eng = PagedGenerationEngine(tiny, kv_dtype="int8", weight_dtype="int8",
+                                **ENGINE_KW)
+    firsts = [eng.prefill(s, p) for s, p in enumerate(prompts)]
+    stream = [[] for _ in prompts]
+    for _ in range(12):
+        toks = eng.decode()
+        for s in range(len(prompts)):
+            stream[s].append(int(toks[s]))
+    return eng, firsts, stream
+
+
+# ---------------------------------------------------------------- blocks
+
+def test_quant_write_roundtrip_and_immutable_full_blocks():
+    rng = np.random.RandomState(0)
+    S, H, D, bs, nb, N = 2, 4, 8, 4, 4, 12
+    pool = jnp.zeros((N, bs, H, D), jnp.int8)
+    scale = jnp.zeros((N, H), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, 1 + S * nb)).reshape(S, nb), jnp.int32)
+    pos = jnp.zeros((S,), jnp.int32)
+    written = []
+    for t in range(9):                       # crosses two block boundaries
+        new = jnp.asarray(rng.randn(S, 1, H, D), jnp.float32)
+        written.append(np.asarray(new)[:, 0])
+        pool, scale = blocks.quant_write(pool, scale, new, tables, pos)
+        if t == 3:                           # block 0 just filled (bs=4)
+            frozen_codes = np.asarray(pool[tables[:, 0]])
+            frozen_scale = np.asarray(scale[tables[:, 0]])
+        pos = pos + 1
+    # dequantized view matches the written f32 values within the int8
+    # bound: |err| <= scale / (2 * 127) per element, plus bounded
+    # requantization drift while a block fills
+    dense = np.asarray(blocks.gather_quant(pool, scale, tables))
+    want = np.stack(written, axis=1)         # [S, 9, H, D]
+    err = np.abs(dense[:, :9] - want)
+    bound = np.abs(want).max() * (1.5 / 127.0) + 1e-6
+    assert err.max() <= bound, (err.max(), bound)
+    # a fully-written block is never touched again — codes AND scale
+    np.testing.assert_array_equal(np.asarray(pool[tables[:, 0]]),
+                                  frozen_codes)
+    np.testing.assert_array_equal(np.asarray(scale[tables[:, 0]]),
+                                  frozen_scale)
+    # positions never written dequantize to exact zeros (no junk scale)
+    assert np.all(dense[:, 9:4 * nb] == 0.0)
+
+
+def test_quant_write_valid_excludes_padding_from_scale():
+    """Bucket-padded prefill: tokens past `valid` must neither ride the
+    per-block abs-max scale (a one-time inflated rounding) nor leave
+    nonzero codes — the quant analogue of the float path's 'padding is
+    invisible' invariant."""
+    rng = np.random.RandomState(2)
+    H, D, bs = 2, 4, 8
+    pool = jnp.zeros((3, bs, H, D), jnp.int8)
+    scale = jnp.zeros((3, H), jnp.float32)
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    pos = jnp.zeros((1,), jnp.int32)
+    new = rng.randn(1, bs, H, D).astype(np.float32)
+    new[:, 5:] *= 100.0                       # huge padding junk
+    p_all, s_all = blocks.quant_write(pool, scale, jnp.asarray(new),
+                                      tables, pos)
+    p_v, s_v = blocks.quant_write(pool, scale, jnp.asarray(new), tables,
+                                  pos, valid=jnp.asarray([5], jnp.int32))
+    assert float(s_all[1].max()) > 50.0       # junk DID inflate unmasked
+    np.testing.assert_allclose(np.asarray(s_v[1]),
+                               np.abs(new[0, :5]).max(axis=(0, 2)),
+                               rtol=1e-6)
+    got = np.asarray(blocks.gather_quant(p_v, s_v, tables))[0]
+    assert np.all(got[5:bs] == 0.0)           # padding codes are zeros
+    np.testing.assert_allclose(got[:5], new[0, :5],
+                               atol=np.abs(new[0, :5]).max() / 127 + 1e-6)
+
+
+def test_quant_kernel_matches_gather_attend():
+    """int8 kernel attend == int8 gather attend on CPU: identical
+    dequantized inputs by construction, outputs equal to f32 tolerance
+    (the same contract the f32 kernel tests assert)."""
+    rng = np.random.RandomState(1)
+    S, T, H, D, bs, nb = 2, 4, 4, 16, 8, 3
+    N = 1 + S * nb
+    codes = rng.randint(-127, 128, (N, bs, H, D)).astype(np.int8)
+    kc, vc = jnp.asarray(codes), jnp.asarray(codes[::-1].copy())
+    ks = jnp.asarray(rng.rand(N, H).astype(np.float32) + 0.1)
+    vs = jnp.asarray(rng.rand(N, H).astype(np.float32) + 0.1)
+    tables = jnp.asarray(np.arange(1, N).reshape(S, nb), jnp.int32)
+    pos = jnp.asarray([5, 17], jnp.int32)
+    q = jnp.asarray(rng.randn(S, T, H, D), jnp.float32)
+    want = blocks.attend_quant(q, kc, vc, ks, vs, tables, pos)
+    got = blocks.attend_kernel_quant(q, kc, vc, ks, vs, tables, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quant_kernel_rejects_half_scales():
+    from paddle_tpu.ops.pallas.paged_attention import paged_attention
+    q = jnp.zeros((1, 1, 2, 4))
+    pool = jnp.zeros((2, 2, 2, 4), jnp.int8)
+    with pytest.raises(ValueError, match="BOTH"):
+        paged_attention(q, pool, pool, jnp.zeros((1, 1), jnp.int32),
+                        jnp.zeros((1,), jnp.int32),
+                        k_scale=jnp.zeros((2, 2)))
+
+
+# ---------------------------------------------------------------- engines
+
+@pytest.fixture(scope="module")
+def quality(tiny, tmp_path_factory):
+    """One healthy quality-harness run (f32 oracle + quant engine),
+    shared by the gate test and the chaos test's baseline."""
+    serve_jsonl = str(tmp_path_factory.mktemp("quant") / "serve.jsonl")
+    out = load_harness.quant_quality(
+        tiny, slots=2, max_len=64, block_size=8, steps=12, seed=0,
+        serve_metrics_path=serve_jsonl)
+    return out, serve_jsonl
+
+
+def test_quant_engine_matches_f32_oracle(quality):
+    """The quality gate end-to-end: teacher-forced greedy match vs the
+    f32 paged oracle >= 0.99 (it is 1.0 on this seed), logit KL tiny,
+    gauges exported, and the serve-report `run` record appended +
+    schema-valid + rendered."""
+    out, serve_jsonl = quality
+    assert out["greedy_match"] >= 0.99, out
+    assert out["logit_kl"] < 1e-3, out
+    snap = metrics.registry().snapshot()
+    flat = {m["name"]: m["samples"][0]["value"] for m in snap["metrics"]
+            if m["name"].startswith("serving_quant_")}
+    assert flat["serving_quant_greedy_match"] == out["greedy_match"]
+    assert flat["serving_quant_logit_kl"] == out["logit_kl"]
+    records = serve_report.load(serve_jsonl)
+    assert serve_report.validate_records(records) == []
+    summary = serve_report.summarize(records)
+    assert summary["kv_dtype"] == "int8"
+    assert summary["weight_dtype"] == "int8"
+    assert summary["quant_greedy_match"] == out["greedy_match"]
+    assert "quant quality vs f32 oracle" in serve_report.render(summary)
+
+
+def test_quant_kernel_engine_token_exact_vs_gather_engine(
+        tiny, prompts, quant_stream):
+    """'int8 kernel attend == int8 gather attend exactly on CPU' at the
+    stream level: the same quantized engine under the two impls emits
+    IDENTICAL greedy tokens, and both compile decode exactly once."""
+    geng, gfirsts, gstream = quant_stream
+    keng = PagedGenerationEngine(tiny, kv_dtype="int8", weight_dtype="int8",
+                                 attention_impl="kernel", **ENGINE_KW)
+    kfirsts = [keng.prefill(s, p) for s, p in enumerate(prompts)]
+    assert kfirsts == gfirsts
+    for step in range(6):
+        toks = keng.decode()
+        for s in range(len(prompts)):
+            assert int(toks[s]) == gstream[s][step], (step, s)
+    assert geng.trace_counts["decode"] == 1
+    assert keng.trace_counts["decode"] == 1
+
+
+def test_quant_weights_fake_quant_math_and_float_prefill(tiny):
+    """weight_dtype='int8' decode params ARE the fake-quant math over
+    channel_abs_max scales (the dormant PTQ subsystem's rule); prefill
+    keeps the untouched float params; non-matmul params pass through."""
+    eng = PagedGenerationEngine(tiny, weight_dtype="int8", **ENGINE_KW)
+    name = "blocks.0.attn.qkv.weight"
+    entry = eng._decode_params[name]
+    assert isinstance(entry, dict) and entry["q"].dtype == jnp.int8
+    w = np.asarray(eng._params[name], np.float32)
+    ref = np.asarray(fake_quant(jnp.asarray(w),
+                                jnp.asarray(channel_abs_max(w, 1)),
+                                bits=8, channel_axis=1))
+    got = np.asarray(entry["q"], np.float32) \
+        * np.asarray(entry["scale"]) / 127.0
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+    # the tied head quantizes per vocab ROW (axis 0)
+    assert eng._decode_params["wte.weight"]["scale"].shape == (VOCAB, 1)
+    # lookups and norms stay float: wpe, layer norms, biases
+    assert not isinstance(eng._decode_params["wpe.weight"], dict)
+    assert not isinstance(eng._decode_params["blocks.0.ln1.weight"], dict)
+    assert not isinstance(eng._decode_params["blocks.0.attn.qkv.bias"],
+                          dict)
+    # prefill serves the original float dict object
+    assert eng._params[name] is not None
+    assert not any(isinstance(v, dict) for v in eng._params.values())
+
+
+@pytest.mark.slow
+def test_spec_quant_composes(tiny, prompts, quant_stream):
+    """SpeculativeEngine(kv_dtype=weight_dtype='int8'): quantized draft
+    + quantized verify agree with the one-token quantized engine's
+    greedy stream, within the spec compile bounds."""
+    _, gfirsts, gstream = quant_stream
+    se = SpeculativeEngine(tiny, gamma=2, kv_dtype="int8",
+                           weight_dtype="int8", **ENGINE_KW)
+    sfirsts = [se.prefill(s, p) for s, p in enumerate(prompts)]
+    assert sfirsts == gfirsts
+    stream = [[] for _ in prompts]
+    for _ in range(4):
+        toks, n_emit = se.decode_many()
+        for s in range(len(prompts)):
+            stream[s] += [int(t) for t in toks[s, :n_emit[s]]]
+    # spec writes KV per γ+1-token verify window while the one-token
+    # loop requantizes blocks as they fill, so the quantization noise
+    # differs slightly — the streams must still agree overwhelmingly
+    agree = np.mean(np.concatenate([
+        np.asarray(stream[s][:n]) == np.asarray(gstream[s][:n])
+        for s in range(len(prompts))
+        for n in [min(8, len(stream[s]), len(gstream[s]))]]))
+    assert agree >= 0.9, (agree, stream, gstream)
+    assert se.trace_counts["spec_verify"] == 1
+    assert se.trace_counts["draft_decode"] == 1
+    assert se.trace_counts["decode"] == 0
+    # the draft's decode matmuls ride quantized params too
+    assert isinstance(
+        se._draft_decode_params["blocks.0.attn.qkv.weight"], dict)
+
+
+@pytest.mark.slow
+def test_tp_quant_token_exact_and_sharded_scales(tiny, prompts,
+                                                 quant_stream):
+    """The TP engine with int8 pools: token-exact vs the single-device
+    quantized engine, decode compiled once, pool codes AND scales
+    genuinely head-sharded (per-shard scales follow the head split)."""
+    from paddle_tpu.serving.distributed.tp import TensorParallelPagedEngine
+    _, gfirsts, gstream = quant_stream
+    tp = TensorParallelPagedEngine(tiny, tp=2, kv_dtype="int8",
+                                   weight_dtype="int8", **ENGINE_KW)
+    firsts = [tp.prefill(s, p) for s, p in enumerate(prompts)]
+    assert firsts == gfirsts
+    for step in range(6):
+        toks = tp.decode()
+        for s in range(len(prompts)):
+            assert int(toks[s]) == gstream[s][step], (step, s)
+    assert tp.trace_counts["decode"] == 1
+    heads = tiny.cfg.num_heads
+    assert set(tp.kv_shard_report().values()) == {heads // 2}
+    scale_shards = {s.data.shape[1]
+                    for s in tp._pool[0].k_scale.addressable_shards}
+    assert scale_shards == {heads // 2}
+    # column-split qkv weight: quantized codes shard like the original,
+    # per-channel scale vector splits with it
+    q = tp._decode_params["blocks.0.attn.qkv.weight"]
+    assert {s.data.shape[1] for s in q["q"].addressable_shards} == \
+        {tiny.cfg.hidden_size * 3 // 2}
+    assert {s.data.shape[1] for s in q["scale"].addressable_shards} == \
+        {tiny.cfg.hidden_size * 3 // 2}
+
+
+# ---------------------------------------------------------------- handoff
+
+def test_quant_handoff_bundle_v2_roundtrip_and_rejection(quant_stream):
+    """v2 quantized bundles: unpack-dequant == the engine's own dequant
+    (lossless at the wire), ~4x smaller than f32 bundles, truncation at
+    any cut and scale-count lies raise KVWireError, v1 stays readable."""
+    eng, _, _ = quant_stream
+    wire = eng.extract_kv_wire(0)
+    bundle = pack_kv_bundle(
+        wire["ks"], wire["vs"], meta={"plen": wire["plen"]},
+        k_scales=wire["k_scales"], v_scales=wire["v_scales"],
+        scale_block=wire["scale_block"])
+    ks_f32, vs_f32, plen = eng.extract_kv(0)
+    ks, vs, meta = unpack_kv_bundle(bundle)
+    assert meta["quantized"] is True and meta["plen"] == plen
+    for a, b in zip(ks + vs, ks_f32 + vs_f32):
+        np.testing.assert_array_equal(a, b)
+    # the f32 bundle of the same request is ~4x the bytes
+    f32_bundle = pack_kv_bundle(ks_f32, vs_f32, meta={})
+    assert len(f32_bundle) > 3.5 * len(bundle)
+    # truncation rejection holds for the versioned bundle — every cut
+    # class: inside head, inside header, inside codes, one short byte
+    for cut in (4, 20, len(bundle) // 2, len(bundle) - 1):
+        with pytest.raises(KVWireError):
+            unpack_kv_bundle(bundle[:cut])
+    # scale-count lie: a header whose scale rows cannot tile its tokens
+    import struct
+    magic, hlen = struct.unpack_from("<II", bundle, 0)
+    hdr = json.loads(bytes(bundle[8:8 + hlen]))
+    assert hdr["v"] == QUANT_BUNDLE_VERSION
+    hdr["scale_blocks"] += 1
+    blob = json.dumps(hdr).encode()
+    with pytest.raises(KVWireError, match="scale count"):
+        unpack_kv_bundle(struct.pack("<II", magic, len(blob)) + blob
+                         + bytes(bundle[8 + hlen:]))
+    # quantized bundles must declare int8
+    with pytest.raises(KVWireError, match="int8"):
+        pack_kv_bundle(ks_f32, vs_f32, k_scales=wire["k_scales"],
+                       v_scales=wire["v_scales"],
+                       scale_block=wire["scale_block"])
+    # v1 float bundles stay readable forever
+    k1, v1, _ = unpack_kv_bundle(f32_bundle)
+    hdr1 = json.loads(bytes(f32_bundle[8:8 + struct.unpack_from(
+        "<II", f32_bundle, 0)[1]]))
+    assert hdr1["v"] == BUNDLE_VERSION
+    np.testing.assert_array_equal(k1[0], ks_f32[0])
+
+
+# ------------------------------------------------------------------ chaos
+
+@pytest.mark.slow
+def test_kv_quant_chaos_caught_by_quality_gate(tiny, quality):
+    """Corrupt ONE block's scale through the serving.kv_quant fault site
+    (truncate mode: the engine performs the damage): the greedy-match
+    rate collapses and metrics_report --compare gates the drop as
+    failure-class."""
+    assert "serving.kv_quant" in faults.SITES
+    healthy, _ = quality
+    faults.arm("serving.kv_quant", mode="truncate", nth=1, max_fires=1)
+    try:
+        sick = load_harness.quant_quality(tiny, slots=2, max_len=64,
+                                          block_size=8, steps=12, seed=0)
+    finally:
+        faults.disarm_all()
+    assert sick["greedy_match"] < healthy["greedy_match"], (healthy, sick)
+    assert sick["logit_kl"] > healthy["logit_kl"]
+    mk = lambda g: {  # noqa: E731
+        "schema": metrics_report.SCHEMA, "ts": 1.0, "pid": 1,
+        "metrics": [{"name": n, "type": "gauge", "help": "",
+                     "labelnames": [],
+                     "samples": [{"labels": {}, "value": v}]}
+                    for n, v in g.items()]}
+    regs = metrics_report.compare_counters(
+        mk({"serving_quant_greedy_match": healthy["greedy_match"],
+            "serving_quant_logit_kl": healthy["logit_kl"]}),
+        mk({"serving_quant_greedy_match": sick["greedy_match"],
+            "serving_quant_logit_kl": sick["logit_kl"]}),
+        max_regress_pct=5.0, min_delta=0.001)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_quant_greedy_match") == \
+        "quantized greedy-match rate vs f32 oracle dropped"
+
+
+# -------------------------------------------------------------- observers
+
+def test_observer_thresholds_deterministic():
+    rng = np.random.RandomState(3)
+    data = [rng.randn(512) * (1 + i) for i in range(4)]
+
+    def run():
+        obs = HistogramObserver(bins=256)
+        for batch in data:
+            obs.collect(batch)
+        return {algo: obs.threshold(algo)
+                for algo in ("abs_max", "min_max", "avg", "hist", "KL",
+                             "mse")}
+
+    a, b = run(), run()
+    assert a == b                              # bit-deterministic
+    # thresholds land in the histogram range (edges may overshoot the
+    # batch abs-max by up to a bin: the range doubles to absorb batches)
+    hi = 2 * a["abs_max"]
+    assert 0 < a["KL"] <= hi
+    assert 0 < a["mse"] <= hi
+    assert 0 < a["hist"] <= hi
+    # direct threshold helpers: deterministic on a fixed histogram
+    hist = np.asarray([int(x) for x in np.linspace(100, 0, 64)],
+                      np.float64)
+    assert kl_threshold(hist, 0.1) == kl_threshold(hist, 0.1)
+    assert mse_threshold(hist, 0.1) == mse_threshold(hist, 0.1)
+    p = hist_percentile_threshold(hist, 0.1, 0.9999)
+    assert p == hist_percentile_threshold(hist, 0.1, 0.9999)
+    assert 0 < p <= 6.4
+
+
+def test_observer_empty_and_nonfinite_edges():
+    obs = HistogramObserver(bins=64)
+    # empty histogram: every algo answers 0.0, nothing raises
+    for algo in ("abs_max", "min_max", "avg", "hist", "KL", "mse"):
+        assert obs.threshold(algo) == 0.0
+    assert hist_percentile_threshold(np.zeros(64), 0.1, 0.99) == 0.0
+    assert kl_threshold(np.zeros(64), 0.1) == 0.0
+    # an inf sample must NOT hang the range-doubling loop or poison the
+    # scale; NaN must not poison vmin/vmax (the pre-fix failure modes)
+    obs.collect(np.asarray([1.0, np.inf, np.nan, -2.0, np.nan]))
+    obs.collect(np.asarray([np.nan, np.nan]))      # all-dropped batch
+    obs.collect(np.asarray([], np.float32))        # empty batch
+    for algo in ("abs_max", "min_max", "avg", "hist", "KL", "mse"):
+        t = obs.threshold(algo)
+        # finite and inside the (finite!) histogram range — pre-fix,
+        # KL/mse would hang or return inf/nan here
+        assert np.isfinite(t) and 0 < t <= 2.0 * obs.hi, (algo, t)
+    assert obs.vmin == -2.0 and obs.vmax == 1.0
+
+
+def test_channel_abs_max_axes():
+    w = np.asarray([[1.0, -5.0], [-3.0, 2.0], [0.5, 4.0]])   # (in=3, out=2)
+    np.testing.assert_array_equal(channel_abs_max(w, 1), [3.0, 5.0])
+    np.testing.assert_array_equal(channel_abs_max(w, 0), [5.0, 3.0, 4.0])
+    w4 = np.arange(24.0).reshape(2, 3, 2, 2) - 12
+    np.testing.assert_array_equal(channel_abs_max(w4, 0), [12.0, 11.0])

@@ -205,32 +205,39 @@ def _export_registry(summary):
 
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
                  prefix_cache=True, gamma=3, draft_layers=1,
-                 attention_impl="gather"):
-    """A serving engine of any KV/decode layout over `model`."""
+                 attention_impl="gather", kv_dtype="float32",
+                 weight_dtype="float32"):
+    """A serving engine of any KV/decode layout over `model`. `quant`
+    is paged with int8 KV pools AND int8 decode weights (ISSUE 11)."""
     from paddle_tpu.serving import (GenerationEngine, PagedGenerationEngine,
                                     SpeculativeEngine)
+    if kind == "quant":
+        kind, kv_dtype, weight_dtype = "paged", "int8", "int8"
     if kind == "dense":
         return GenerationEngine(model, slots=slots, max_len=max_len)
     if kind == "paged":
         return PagedGenerationEngine(
             model, slots=slots, max_len=max_len, block_size=block_size,
             num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
-            attention_impl=attention_impl)
+            attention_impl=attention_impl, kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype)
     if kind == "spec":
         return SpeculativeEngine(
             model, slots=slots, max_len=max_len, block_size=block_size,
             num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
             attention_impl=attention_impl, gamma=gamma,
-            draft_layers=draft_layers)
+            draft_layers=draft_layers, kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype)
     raise ValueError(f"unknown engine kind {kind!r} "
-                     f"(want dense|paged|spec)")
+                     f"(want dense|paged|spec|quant)")
 
 
 def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 num_blocks=None, prefix_cache=True, max_queue=256,
                 shed_watermark=None, virtual_step_s=None,
                 metrics_out=None, gamma=3, draft_layers=1,
-                attention_impl="gather"):
+                attention_impl="gather", kv_dtype="float32",
+                weight_dtype="float32"):
     """Build engine+scheduler, replay `traffic`, return the summary
     (annotated with the engine's KV budget and compile counters)."""
     from paddle_tpu.observability import metrics as _metrics
@@ -240,7 +247,8 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                           block_size=block_size, num_blocks=num_blocks,
                           prefix_cache=prefix_cache, gamma=gamma,
                           draft_layers=draft_layers,
-                          attention_impl=attention_impl)
+                          attention_impl=attention_impl,
+                          kv_dtype=kv_dtype, weight_dtype=weight_dtype)
     vclock = VirtualClock() if virtual_step_s is not None else None
     sched = Scheduler(engine, max_queue=max_queue,
                       shed_watermark=shed_watermark,
@@ -253,10 +261,13 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     summary["engine"] = kind
     summary["kv_memory_tokens"] = engine.kv_memory_tokens
     summary["slots"] = engine.slots
+    summary["kv_dtype"] = getattr(engine.config, "kv_dtype", "float32")
+    summary["weight_dtype"] = getattr(engine.config, "weight_dtype",
+                                      "float32")
     summary["trace_counts"] = {
         k: (dict(v) if isinstance(v, dict) else v)
         for k, v in engine.trace_counts.items()}
-    if kind in ("paged", "spec"):
+    if kind in ("paged", "spec", "quant"):
         summary["blocks_total"] = engine.block_pool.capacity
         pc = engine.prefix_cache
         summary["prefix_cache_blocks"] = len(pc) if pc is not None else 0
@@ -272,12 +283,104 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     return summary
 
 
+def quant_quality(model, slots=3, max_len=64, block_size=8,
+                  prompts=None, steps=24, seed=0, attention_impl="gather",
+                  kv_dtype="int8", weight_dtype="int8",
+                  serve_metrics_path=None, tie_eps=1e-3):
+    """The ISSUE 11 quality gate: drive a quantized paged engine and the
+    f32 paged ORACLE through the same teacher-forced token stream and
+    measure how far int8 serving drifts from float serving.
+
+    Teacher forcing makes the comparison per-step: after every decode
+    the oracle's token is fed to BOTH engines, so one early argmax flip
+    cannot cascade into incomparable streams — greedy_match is the
+    fraction of (slot, step) decisions where the quantized engine's
+    pick agrees with the oracle's, and logit_kl is the mean
+    KL(oracle softmax || quant softmax) over the same decisions (the
+    capture_logits decode tap).
+
+    `tie_eps` makes the match GENUINE-disagreement only: a decision
+    counts as matched when the oracle rates the quantized pick within
+    `tie_eps` of its own best logit. Sub-epsilon gaps flip under float
+    reproducibility noise alone (XLA CPU thread partitioning moves
+    logits by ~1e-6; an untrained-model top-2 gap can be 1e-4), so they
+    carry no signal about quantization — while real corruption (a wrong
+    block scale, rotted codes) moves logits orders of magnitude more
+    and still registers, which the serving.kv_quant chaos test pins.
+
+    Results are exported as `serving_quant_greedy_match` /
+    `serving_quant_logit_kl` gauges (failure-class gated by
+    `tools/metrics_report.py --compare`) and, when `serve_metrics_path`
+    is given, appended as a `run` record to the serving JSONL."""
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.serving import PagedGenerationEngine
+
+    rng = np.random.RandomState(seed)
+    vocab = model.cfg.vocab_size
+    if prompts is None:
+        prompts = [rng.randint(0, vocab, int(rng.randint(
+            block_size, 2 * block_size + 4))).tolist()
+            for _ in range(slots)]
+    prompts = list(prompts)[:slots]
+    common = dict(slots=slots, max_len=max_len, block_size=block_size,
+                  attention_impl=attention_impl, capture_logits=True,
+                  seed=seed)
+    oracle = PagedGenerationEngine(model, **common)
+    quant = PagedGenerationEngine(model, kv_dtype=kv_dtype,
+                                  weight_dtype=weight_dtype, **common)
+    for s, p in enumerate(prompts):
+        f = oracle.prefill(s, p)
+        quant.prefill(s, p)
+        quant.set_slot_token(s, f)           # teacher-force from step one
+    n = len(prompts)
+    matches, kls = [], []
+    for _ in range(int(steps)):
+        toks = oracle.decode()
+        quant.decode()
+        lo = oracle.last_logits[:n].astype(np.float64)
+        lq = quant.last_logits[:n].astype(np.float64)
+        ao, aq = np.argmax(lo, -1), np.argmax(lq, -1)
+        rows = np.arange(n)
+        matches.append((ao == aq)
+                       | (lo[rows, aq] >= lo[rows, ao] - tie_eps))
+        po = np.exp(lo - lo.max(-1, keepdims=True))
+        po /= po.sum(-1, keepdims=True)
+        zq = lq - lq.max(-1, keepdims=True)
+        log_q = zq - np.log(np.exp(zq).sum(-1, keepdims=True))
+        kls.append((po * (np.log(po + 1e-30) - log_q)).sum(-1))
+        for s in range(n):
+            quant.set_slot_token(s, int(toks[s]))
+    greedy_match = float(np.mean(matches))
+    logit_kl = float(np.mean(kls))
+    _metrics.gauge(
+        "serving_quant_greedy_match",
+        "Teacher-forced greedy argmax agreement of the quantized serving "
+        "path vs the f32 oracle (1.0 == every decision identical)"
+    ).set(greedy_match)
+    _metrics.gauge(
+        "serving_quant_logit_kl",
+        "Mean KL(f32 oracle || quantized) of the decode logits over the "
+        "teacher-forced comparison stream").set(logit_kl)
+    out = {"greedy_match": greedy_match, "logit_kl": logit_kl,
+           "steps": int(steps), "slots": n,
+           "kv_dtype": kv_dtype, "weight_dtype": weight_dtype}
+    if serve_metrics_path:
+        with open(serve_metrics_path, "a") as f:
+            f.write(json.dumps({
+                "kind": "run", "kv_dtype": kv_dtype,
+                "weight_dtype": weight_dtype,
+                "quant_greedy_match": greedy_match,
+                "quant_logit_kl": logit_kl}) + "\n")
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--engine", default="both",
-                   choices=("dense", "paged", "spec", "both", "all"),
+                   choices=("dense", "paged", "spec", "quant", "both",
+                            "all"),
                    help="'both' = dense+paged; 'all' adds the "
-                        "spec-decode arm")
+                        "spec-decode and quantized arms")
     p.add_argument("--model", default="gpt_tiny")
     p.add_argument("--users", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
@@ -324,8 +427,8 @@ def main(argv=None):
     paged_slots = args.paged_slots or min(
         2 * args.slots, max(args.slots + 1, num_blocks - 1))
     kinds = {"both": ("dense", "paged"),
-             "all": ("dense", "paged", "spec")}.get(args.engine,
-                                                   (args.engine,))
+             "all": ("dense", "paged", "spec", "quant")}.get(
+                 args.engine, (args.engine,))
     out = {}
     for kind in kinds:
         out[kind] = run_harness(

@@ -35,6 +35,11 @@ threshold. Direction matters and is decided per counter name:
     GROWING is failure-class (the kernels themselves slowed down), and
     `deviceprof_op_efficiency{op=...}` / `deviceprof_min_op_efficiency`
     DROPPING is failure-class (an op moved away from its roofline),
+  - quantized-serving quality gauges (ISSUE 11):
+    `serving_quant_greedy_match` (token agreement vs the f32 oracle)
+    DROPPING and `serving_quant_logit_kl` GROWING are failure-class —
+    int8 serving that quietly stops matching its float oracle is a
+    correctness regression, not a perf trade,
   - histogram tails (ISSUE 10): `serving_kv_handoff_seconds` approximate
     p99 (from the cumulative buckets) GROWING past the threshold is
     failure-class — a handoff-latency tail stalls decode admission even
@@ -90,6 +95,11 @@ _GAUGE_GROW_RULES = (
      "measured/predicted gap widened"),
     (re.compile(r"deviceprof_total_device_ms_per_step(\{.*\})?$"),
      "device time per step grew"),
+    # ISSUE 11: the quantized tier's logit divergence vs the f32 oracle
+    # growing means the int8 path is drifting (scale corruption, requant
+    # rot) even while tokens still mostly match
+    (re.compile(r"serving_quant_logit_kl(\{.*\})?$"),
+     "quantized logit KL vs f32 oracle grew"),
 )
 
 # GAUGE rules: gauges whose DROP past the threshold is failure-class.
@@ -100,6 +110,11 @@ _GAUGE_GROW_RULES = (
 _GAUGE_DROP_RULES = (
     (re.compile(r"deviceprof_(?:op|min_op)_efficiency(\{.*\})?$"),
      "per-op device efficiency dropped"),
+    # ISSUE 11 quality gate: greedy-match rate vs the f32 oracle is THE
+    # quantized-serving correctness headline — a drop past the threshold
+    # is failure-class no matter how fast the int8 path got
+    (re.compile(r"serving_quant_greedy_match(\{.*\})?$"),
+     "quantized greedy-match rate vs f32 oracle dropped"),
 )
 
 # HISTOGRAM rules (ISSUE 10): histograms whose approximate p99 GROWING

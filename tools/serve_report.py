@@ -40,6 +40,15 @@ REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "spec_proposed": int, "spec_accepted": int,
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
+# `run` header records (ISSUE 11): the engine's serving precisions and,
+# when a quality harness appended one, the measured greedy-match rate
+# vs the f32 oracle. EVERY field is optional — files written before the
+# quantized tier (no run record at all) stay gradeable.
+RUN_FIELDS = {"kind": str, "kv_dtype": str, "weight_dtype": str,
+              "quant_greedy_match": (int, float, type(None)),
+              "quant_logit_kl": (int, float, type(None))}
+OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
+                       "quant_logit_kl"}
 # absent == 0/False in files written before the speculative-decode
 # fields (ISSUE 7) and the multi-host `adopted` flag (ISSUE 10) landed —
 # historical artifacts must stay gradeable
@@ -52,13 +61,16 @@ def validate_records(records):
     errors = []
     for i, rec in enumerate(records):
         kind = rec.get("kind")
-        if kind not in ("step", "request"):
+        if kind not in ("step", "request", "run"):
             errors.append(f"record {i}: unknown kind {kind!r}")
             continue
-        schema = STEP_FIELDS if kind == "step" else REQUEST_FIELDS
+        schema = {"step": STEP_FIELDS, "request": REQUEST_FIELDS,
+                  "run": RUN_FIELDS}[kind]
+        optional = OPTIONAL_REQUEST_FIELDS if kind == "request" \
+            else OPTIONAL_RUN_FIELDS if kind == "run" else ()
         for field, types in schema.items():
             if field not in rec:
-                if field not in OPTIONAL_REQUEST_FIELDS:
+                if field not in optional:
                     errors.append(f"record {i} ({kind}): missing {field!r}")
             elif not isinstance(rec[field], types):
                 errors.append(
@@ -87,6 +99,13 @@ def _pct(values, q):
 def summarize(records):
     steps = [r for r in records if r["kind"] == "step"]
     reqs = [r for r in records if r["kind"] == "request"]
+    # run headers: later records win (a quality harness may append one
+    # carrying the measured match rate after the scheduler's own)
+    run = {}
+    for r in records:
+        if r["kind"] == "run":
+            run.update({k: v for k, v in r.items()
+                        if k != "kind" and v is not None})
     ttfts = [r["ttft_s"] for r in reqs if r["ttft_s"] is not None]
     decode_s = sum(r["decode_s"] or 0.0 for r in reqs)
     decode_tokens = sum(max(r["tokens"] - 1, 0) for r in reqs)
@@ -121,6 +140,10 @@ def summarize(records):
         "by_priority": {
             p: sum(1 for r in reqs if r["priority"] == p)
             for p in sorted({r["priority"] for r in reqs})},
+        "kv_dtype": run.get("kv_dtype"),
+        "weight_dtype": run.get("weight_dtype"),
+        "quant_greedy_match": run.get("quant_greedy_match"),
+        "quant_logit_kl": run.get("quant_logit_kl"),
     }
 
 
@@ -147,6 +170,15 @@ def render(summary):
                    f"{summary['spec_acceptance_rate']:.2f} "
                    f"({summary['spec_accepted']}/"
                    f"{summary['spec_proposed']} drafts)")
+    if summary.get("kv_dtype") or summary.get("weight_dtype"):
+        out.append(f"precision: kv={summary.get('kv_dtype') or '?'} "
+                   f"weights={summary.get('weight_dtype') or '?'}")
+    if summary.get("quant_greedy_match") is not None:
+        line = (f"quant quality vs f32 oracle: greedy-match "
+                f"{summary['quant_greedy_match']:.4f}")
+        if summary.get("quant_logit_kl") is not None:
+            line += f", logit-KL {summary['quant_logit_kl']:.6f}"
+        out.append(line)
     if summary["preemptions"]:
         out.append(f"preemptions: {summary['preemptions']}")
     out.append("priority mix: " + ", ".join(
