@@ -766,7 +766,17 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
     amortized by real accelerator prefill times). Extra carries both
     arms' p50/p99 TTFT, handoff bytes, and the compile-once counters;
     the streams of the two arms are ASSERTED identical, so the rung can
-    never trade correctness for throughput."""
+    never trade correctness for throughput.
+
+    Fleet observability artifacts (ISSUE 12): the distributed arm runs
+    under a FleetPlane — the router's poll loop federates every
+    worker's full metrics registry over OP_METRICS into
+    `fleet_metrics.jsonl` + ONE merged Prometheus exposition
+    (`fleet_metrics.prom`), and every request's end-to-end phase
+    timeline lands in `timelines.jsonl` (written under
+    $BENCH_DIST_OBS_DIR, default the rung's workdir). The rung asserts
+    each completed request has a timeline record whose phase durations
+    sum to within 5%% of its end-to-end latency."""
     import json as _json
     import subprocess
     import tempfile
@@ -777,6 +787,7 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
     from paddle_tpu.serving import (PagedEngineConfig,
                                     PagedGenerationEngine, Scheduler,
                                     ServingConfig)
+    from paddle_tpu.observability import fleet as _fleet
     from paddle_tpu.serving.distributed import DistFrontend
 
     sys.path.insert(0, os.path.join(
@@ -879,12 +890,24 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
                 time.sleep(0.05)
             with open(ep) as f:
                 endpoints.append(f.read().strip())
-        fe = DistFrontend(endpoints[1:], [endpoints[0]])
+        obs_dir = os.environ.get("BENCH_DIST_OBS_DIR") \
+            or os.path.join(workdir, "obs")
+        fe = DistFrontend(endpoints[1:], [endpoints[0]],
+                          timeline_path=os.path.join(obs_dir,
+                                                     "timelines.jsonl"))
+        plane = _fleet.FleetPlane(
+            fe, jsonl_path=os.path.join(obs_dir, "fleet_metrics.jsonl"),
+            poll_interval_s=0.2)
         t0 = time.perf_counter()
         reqs = [fe.submit(p, max_new=max_new) for p in prompts]
         fe.run(timeout_s=float(os.environ.get("BENCH_DIST_TIMEOUT_S",
                                               600)))
         dist_wall = time.perf_counter() - t0
+        # final federation sweep (workers still alive) + the ONE merged
+        # fleet Prometheus exposition
+        merged = plane.poll_now()
+        plane.write_prometheus(os.path.join(obs_dir,
+                                            "fleet_metrics.prom"))
         bad = [r for r in reqs if r.status != "DONE"]
         assert not bad, f"{len(bad)} dist requests not DONE: " \
                         f"{[(r.key, r.status, r.error) for r in bad[:3]]}"
@@ -897,11 +920,34 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
                           for s in stats.values()
                           if s.get("role") == "decode")
         staged = sum(1 for r in reqs if r.staged)
+        # ISSUE 12 gates: every completed request decomposes — one
+        # timeline record each, phase durations summing to e2e within
+        # the 5% acceptance tolerance — and the federated snapshot
+        # carries every fleet member under worker_id labels
+        timelines = fe.timeline_records()
+        assert len(timelines) == len(reqs), \
+            f"{len(timelines)} timeline records for {len(reqs)} requests"
+        tl_errs = serve_report.validate_records(timelines)
+        assert not tl_errs, \
+            f"timeline contract violations: {tl_errs[:3]}"
+        fleet_members = {s2.get("labels", {}).get("worker_id")
+                         for m2 in merged["metrics"]
+                         for s2 in m2["samples"]}
+        want_members = {f"decode{i}" for i in range(n_decode)} \
+            | {"prefill0", "router"}
+        assert want_members <= fleet_members, \
+            f"fleet snapshot missing members: " \
+            f"{want_members - fleet_members}"
+        phase_means = serve_report.timeline_phase_means(timelines)
         dist = _summary(
             [r.ttft_s for r in reqs if r.ttft_s is not None],
             sum(len(r.tokens) for r in reqs), dist_wall,
             {"kv_memory_tokens": dist_budget, "handoff_bytes": handoff,
-             "staged_requests": staged, "decode_workers": n_decode})
+             "staged_requests": staged, "decode_workers": n_decode,
+             "fleet_polls": plane.polls, "obs_dir": obs_dir,
+             "timeline_phase_means_s": phase_means,
+             "tail_attribution": serve_report.tail_attribution(
+                 timelines)})
         assert staged > 0, "no request rode the prefill->decode handoff"
         assert dist_budget == budget_tokens == single["kv_memory_tokens"]
     finally:

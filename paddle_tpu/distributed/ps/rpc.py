@@ -91,7 +91,16 @@ _OP_NAMES = {OP_PULL: "PULL", OP_PUSH: "PUSH", OP_PING: "PING",
              OP_GDEGREE: "GDEGREE"}
 
 
-def register_verb(op, name, idempotent=False):
+# verbs declared side-effect-free at registration (ISSUE 12): the fleet
+# observability sweep (OP_METRICS / OP_DUMP) polls every worker on an
+# interval, and a read-only verb is safe to retry, safe to fan out to a
+# sick host, and safe to drop on failure — the federator skips dark
+# members instead of erroring the poll. Introspectable so tools can
+# assert their polling path never carries a mutating verb.
+READONLY_VERBS = frozenset()
+
+
+def register_verb(op, name, idempotent=False, readonly=False):
     """Register an EXTENSION verb on the shared fabric (ISSUE 10: the
     serving KV-handoff/control verbs ride the same transport as the PS
     ops, inheriting the retry loop, breakers, trace propagation, byte/
@@ -101,8 +110,11 @@ def register_verb(op, name, idempotent=False):
     unambiguous. Extension verbs are served by PSServer `handlers` (see
     PSServer.__init__); `idempotent=True` opts the verb into the client
     retry loop — extension verbs must make that safe themselves (e.g.
-    dedup by an application-level request key)."""
-    global _IDEMPOTENT_OPS
+    dedup by an application-level request key). `readonly=True`
+    additionally declares the verb side-effect-free (implies idempotent;
+    see READONLY_VERBS) — the contract the fleet metrics federation
+    sweep rides."""
+    global _IDEMPOTENT_OPS, READONLY_VERBS
     op = int(op)
     if not 0 <= op < REQID_FLAG:
         raise ValueError(f"verb op {op} collides with the header flag "
@@ -111,8 +123,10 @@ def register_verb(op, name, idempotent=False):
         raise ValueError(f"verb op {op} already registered as "
                          f"{_OP_NAMES[op]!r}")
     _OP_NAMES[op] = name
-    if idempotent:
+    if idempotent or readonly:
         _IDEMPOTENT_OPS = _IDEMPOTENT_OPS | {op}
+    if readonly:
+        READONLY_VERBS = READONLY_VERBS | {op}
 _HDR = struct.Struct("<BII")
 _GS = struct.Struct("<iBH")       # seed | weighted | edge-type length
 _TL = struct.Struct("<H")         # type-name length

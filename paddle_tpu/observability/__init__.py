@@ -32,6 +32,22 @@ one substrate they all report through:
                        host spans + the analytical cost model, and the
                        one-shot healthy-window capture orchestration
                        (bench --xplane / scheduler.capture_decode_steps).
+  fleet.py           — the LIVE fleet plane (ISSUE 12): metrics
+                       federation (merge N per-process metrics.v1
+                       snapshots into one worker_id/role-labeled fleet
+                       snapshot, histogram buckets merged bucket-wise),
+                       the multi-window SLO burn-rate watchdog, and the
+                       router-side FleetPlane pump that polls OP_METRICS,
+                       streams fleet_metrics.jsonl, and pulls a fleet
+                       postmortem bundle over OP_DUMP on sustained
+                       breach.
+  reqtimeline.py     — per-request end-to-end timelines (ISSUE 12): the
+                       canonical phase vocabulary (queue/prefill/
+                       kv_handoff/adopt/place/decode/failover), the
+                       contiguous PhaseTrail whose segment durations sum
+                       exactly to the request's end-to-end span, and the
+                       reqtimeline.v1 record both the serving scheduler
+                       and the fleet router emit.
 
 Producers already wired in: serving scheduler (queue depth, slot
 occupancy, admission/timeout/reject counts, tokens, TTFT), PS RPC client
@@ -48,14 +64,14 @@ only when a trace is actually started).
 import sys
 
 from . import deviceprof  # noqa: F401
-from . import faults, flight_recorder, metrics, tracecontext  # noqa: F401
-from . import xplane  # noqa: F401
+from . import faults, fleet, flight_recorder, metrics  # noqa: F401
+from . import reqtimeline, tracecontext, xplane  # noqa: F401
 from .flight_recorder import dump_postmortem  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracecontext import merge_chrome_traces, trace_scope  # noqa: F401
 
 __all__ = ["metrics", "tracecontext", "flight_recorder", "faults",
-           "deviceprof", "xplane",
+           "deviceprof", "xplane", "fleet", "reqtimeline",
            "registry", "dump_postmortem", "trace_scope",
            "merge_chrome_traces"]
 

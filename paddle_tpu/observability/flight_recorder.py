@@ -172,6 +172,13 @@ class FlightRecorder:
         with self._lock:
             self.annotations[key] = _json_safe(value)
 
+    def annotations_snapshot(self):
+        """A consistent copy of the annotations (read under the lock —
+        the fleet postmortem bundle reads them from the SLO watchdog's
+        breach path while other threads may still be annotating)."""
+        with self._lock:
+            return dict(self.annotations)
+
     # ------------------------------------------------------------- watchdog
     def arm(self, timeout_s, what="operation", on_fire=None):
         """Start a hang deadline; returns a token for disarm(). On expiry

@@ -35,7 +35,8 @@ import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "SNAPSHOT_SCHEMA", "DEFAULT_BUCKETS", "registry", "counter",
-           "gauge", "histogram", "flatten_snapshot"]
+           "gauge", "histogram", "flatten_snapshot",
+           "prometheus_from_snapshot"]
 
 SNAPSHOT_SCHEMA = "paddle_tpu.metrics.v1"
 
@@ -368,23 +369,30 @@ class MetricsRegistry:
     def dump_prometheus(self):
         """Prometheus text exposition (# HELP / # TYPE / samples) from one
         consistent snapshot."""
-        snap = self.snapshot()
-        lines = []
-        for m in snap["metrics"]:
-            if m["help"]:
-                lines.append(f"# HELP {m['name']} {m['help']}")
-            lines.append(f"# TYPE {m['name']} {m['type']}")
-            for s in m["samples"]:
-                lab = _prom_labels(s["labels"])
-                if m["type"] == "histogram":
-                    for le, c in s["buckets"].items():
-                        blab = _prom_labels(dict(s["labels"], le=le))
-                        lines.append(f"{m['name']}_bucket{blab} {c}")
-                    lines.append(f"{m['name']}_sum{lab} {_fmt(s['sum'])}")
-                    lines.append(f"{m['name']}_count{lab} {s['count']}")
-                else:
-                    lines.append(f"{m['name']}{lab} {_fmt(s['value'])}")
-        return "\n".join(lines) + "\n"
+        return prometheus_from_snapshot(self.snapshot())
+
+
+def prometheus_from_snapshot(snap):
+    """Prometheus text exposition for any metrics.v1 snapshot dict — the
+    registry's own `dump_prometheus` and the fleet federator's MERGED
+    snapshot (observability.fleet) share this one renderer, so a fleet
+    exposition can never drift from the single-process format."""
+    lines = []
+    for m in snap["metrics"]:
+        if m["help"]:
+            lines.append(f"# HELP {m['name']} {m['help']}")
+        lines.append(f"# TYPE {m['name']} {m['type']}")
+        for s in m["samples"]:
+            lab = _prom_labels(s["labels"])
+            if m["type"] == "histogram":
+                for le, c in s["buckets"].items():
+                    blab = _prom_labels(dict(s["labels"], le=le))
+                    lines.append(f"{m['name']}_bucket{blab} {c}")
+                lines.append(f"{m['name']}_sum{lab} {_fmt(s['sum'])}")
+                lines.append(f"{m['name']}_count{lab} {s['count']}")
+            else:
+                lines.append(f"{m['name']}{lab} {_fmt(s['value'])}")
+    return "\n".join(lines) + "\n"
 
 
 def _fmt(v):

@@ -30,16 +30,19 @@ caller's scope), and `merge_chrome_traces` renders ONE causally-linked
 timeline across router, prefill, and decode processes.
 """
 import itertools
+import json
 import os
 import threading
 import time
 
 from ...distributed.ps import rpc as _rpc
 from ...observability import metrics as _metrics
+from ...observability import reqtimeline as _rt
+from ...observability import tracecontext as _tc
 from ..scheduler import DONE, ERROR, QUEUED, RUNNING, SHED, TIMEOUT
 from . import kv_handoff as _kv
-from .worker import (OP_KV_PUT, OP_POLL, OP_PREFILL, OP_STAT, OP_SUBMIT,
-                     OP_SWAP)
+from .worker import (OP_DUMP, OP_KV_PUT, OP_METRICS, OP_POLL, OP_PREFILL,
+                     OP_STAT, OP_SUBMIT, OP_SWAP)
 
 __all__ = ["ServingShardClient", "DistFrontend", "DistRequest",
            "NoWorkersError"]
@@ -97,6 +100,16 @@ class ServingShardClient(_rpc.ShardClientBase):
     def stat(self, i):
         return self._call(i, OP_STAT, {})
 
+    def metrics(self, i):
+        """The worker's full metrics.v1 registry snapshot (OP_METRICS,
+        read-only) — the fleet federation input."""
+        return self._call(i, OP_METRICS, {})
+
+    def dump(self, i, reason=""):
+        """Pull the worker's flight-recorder postmortem (OP_DUMP) — the
+        fleet postmortem bundle's per-member document."""
+        return self._call(i, OP_DUMP, {"reason": str(reason)})
+
 
 class DistRequest:
     """Router-side view of one request: the merged token stream across
@@ -117,9 +130,22 @@ class DistRequest:
         self.staged = False          # last placement used a handed bundle
         self.submitted_at = time.monotonic()
         self.first_token_at = None
+        self.finished_at = None
         self._base = []              # tokens from previous (dead) workers
         self._cur = []               # tokens from the current worker
         self._wire_key = self.key    # re-keyed per placement attempt
+        # router-side end-to-end phase timeline (ISSUE 12): opens in
+        # `queue` at submission; _place accounts prefill/kv_handoff/
+        # place segments from its measured RPC intervals, failover hops
+        # get their own named segment, and the trail seals at terminal
+        # status — segment durations sum exactly to e2e by construction
+        self.trail = _rt.PhaseTrail()
+        self.trail.begin(_rt.PH_QUEUE, self.submitted_at)
+        self._timeline_done = False
+        # the active trace id at submission (None outside a profiler
+        # window / trace_scope): joins the timeline record to the
+        # merged chrome trace's RPC spans for this request
+        self.trace_id = _tc.current_trace_id()
 
     @property
     def tokens(self):
@@ -138,7 +164,8 @@ class DistRequest:
 class DistFrontend:
     def __init__(self, decode_endpoints, prefill_endpoints=(),
                  retry=None, breaker_threshold=2, breaker_cooldown_s=30.0,
-                 request_timeout_s=10.0, connect_timeout_s=5.0):
+                 request_timeout_s=10.0, connect_timeout_s=5.0,
+                 timeline_path=None):
         # fast-failing defaults: a dead worker should cost milliseconds
         # of retries, then its breaker holds it dark while we re-place
         retry = retry or _rpc.RetryPolicy(max_attempts=2,
@@ -156,6 +183,13 @@ class DistFrontend:
         self._prefill_rr = 0
         self._inflight = {}          # key -> DistRequest
         self._lock = threading.Lock()
+        # the fleet observability plane (ISSUE 12): attaching an
+        # observability.fleet.FleetPlane sets this, and pump() then
+        # drives its interval-gated OP_METRICS federation sweep
+        self.fleet_plane = None
+        self.timeline_path = timeline_path
+        self._timeline = []          # reqtimeline.v1 records, in
+                                     # finalization order
 
     # -- placement -----------------------------------------------------------
     # Locking discipline: `self._lock` guards only the bookkeeping
@@ -185,23 +219,28 @@ class DistFrontend:
             return min(sorted(loads), key=lambda i: loads[i])
 
     def _remote_prefill(self, req, decode_i, exec_prompt):
-        """Remote prefill + handoff toward `decode_i`. True when the
-        bundle is staged there; False degrades to decode-local
-        recompute (dead prefill pool, chaos on the handoff path...)."""
+        """Remote prefill + handoff toward `decode_i`. Returns
+        (staged, handoff_s): staged=True when the bundle landed on the
+        decode worker, False degrades to decode-local recompute (dead
+        prefill pool, chaos on the handoff path...); handoff_s is the
+        prefill worker's measured KVPUT wall time, which _place uses to
+        split the observed PREFILL interval into prefill vs kv_handoff
+        timeline segments."""
         if self.prefill is None:
-            return False
+            return False, 0.0
         target = self.decode.endpoints[decode_i]
         for _ in range(len(self.prefill.endpoints)):
             with self._lock:
                 i = self._prefill_rr % len(self.prefill.endpoints)
                 self._prefill_rr += 1
             try:
-                self.prefill.prefill(i, req._wire_key, exec_prompt,
-                                     decode_endpoint=target)
-                return True
+                reply = self.prefill.prefill(i, req._wire_key,
+                                             exec_prompt,
+                                             decode_endpoint=target)
+                return True, float(reply.get("handoff_s") or 0.0)
             except (_rpc.PSUnavailableError, _rpc.PSServerError):
                 continue             # next prefill worker, else fallback
-        return False
+        return False, 0.0
 
     def submit(self, prompt, max_new=16, priority="standard",
                timeout_s=None):
@@ -219,17 +258,46 @@ class DistFrontend:
         remaining = req.max_new - len(req.tokens)
         while True:
             decode_i = self._pick_decode()   # NoWorkersError when dark
-            staged = self._remote_prefill(req, decode_i, exec_prompt)
+            t0 = time.monotonic()
+            staged, handoff_s = self._remote_prefill(req, decode_i,
+                                                     exec_prompt)
+            t1 = time.monotonic()
+            # timeline: seal the open queue/failover segment at the
+            # placement start, then account the measured intervals —
+            # a SUCCESSFUL remote prefill splits into prefill vs
+            # kv_handoff (the worker reports its KVPUT wall time) and
+            # the SUBMIT round-trip is `place`. A FAILED sweep (dead
+            # prefill pool, chaos) folds into `place` instead: no
+            # prefill ran there, and labeling the retry budget
+            # `prefill` would point the p99 tail attribution at
+            # prefill compute instead of the dark pool — the real
+            # prefill cost then shows up decode-local in
+            # worker_phases. Contiguous boundaries keep the
+            # phases-sum-to-e2e invariant exact.
+            req.trail.close(t0)
+            place_from = t0
+            if staged:
+                h = min(max(handoff_s, 0.0), t1 - t0)
+                req.trail.append(_rt.PH_PREFILL, t0, t1 - h)
+                if h > 0.0:
+                    req.trail.append(_rt.PH_KV_HANDOFF, t1 - h, t1)
+                place_from = t1
             try:
                 self.decode.submit(
                     decode_i, req._wire_key, exec_prompt,
                     max_new=remaining, priority=req.priority,
                     timeout_s=req.timeout_s, use_staged=staged)
             except _rpc.PSUnavailableError:
+                now = time.monotonic()
+                req.trail.append(_rt.PH_PLACE, place_from, now)
+                req.trail.begin(_rt.PH_QUEUE, now)
                 self._mark_dead(decode_i)
                 req._wire_key = f"{req.key}.p{req.failovers}" \
                                 f".{decode_i}x"
                 continue
+            now = time.monotonic()
+            req.trail.append(_rt.PH_PLACE, place_from, now)
+            req.trail.begin(_rt.PH_DECODE, now)
             req.worker = decode_i
             req.staged = staged
             req.status = RUNNING
@@ -257,6 +325,17 @@ class DistFrontend:
                 continue
             for req in reqs:
                 self._merge(req, polled.get(req._wire_key))
+        plane = self.fleet_plane
+        if plane is not None:
+            # the fleet plane rides the existing poll loop: one
+            # interval-gated OP_METRICS federation sweep per pump.
+            # Observation must never kill token delivery — a failed
+            # sweep (full disk under the jsonl stream, a member
+            # shipping a malformed snapshot) skips this round
+            try:
+                plane.maybe_poll()
+            except Exception:                            # noqa: BLE001
+                pass
         with self._lock:
             return sum(1 for r in self._inflight.values()
                        if not r.done())
@@ -276,6 +355,7 @@ class DistFrontend:
             if status == ERROR:
                 req.error = view.get("error")
             req.status = status
+            self._finalize_timeline(req, view)
 
     def _failover(self, req):
         """Restart `req` on a live worker, recompute-style: everything
@@ -285,17 +365,56 @@ class DistFrontend:
         regenerated — exactly, by determinism."""
         _M_FAILOVER.inc()
         req.failovers += 1
+        # the hop gets its own named timeline phase: opens at detection
+        # (the failed poll / UNKNOWN answer) and seals when the
+        # re-placement's prefill starts inside _place — so a SIGKILLed
+        # worker's victims show `failover` between two decode segments
+        req.trail.begin(_rt.PH_FAILOVER, time.monotonic())
         req._base = req.tokens
         req._cur = []
         req._wire_key = f"{req.key}.f{req.failovers}"
         if req.max_new - len(req._base) < 1:
             req.status = DONE          # it raced its own completion
+            self._finalize_timeline(req)
             return
         try:
             self._place(req)
         except NoWorkersError as e:
             req.status = ERROR
             req.error = str(e)
+            self._finalize_timeline(req)
+
+    def _finalize_timeline(self, req, view=None):
+        """Seal the request's phase trail and emit its reqtimeline.v1
+        record, joining the serving worker's own trail (`worker_phases`,
+        shipped on the terminal POLL) when the worker reported one.
+        Idempotent: a request finalizes exactly once."""
+        if req._timeline_done:
+            return
+        req._timeline_done = True
+        req.finished_at = time.monotonic()
+        req.trail.close(req.finished_at)
+        rec = _rt.build_record(
+            req.status, req.submitted_at, req.finished_at,
+            req.trail.rel(req.submitted_at), key=req.key,
+            tokens=len(req.tokens), ttft_s=req.ttft_s,
+            failovers=req.failovers, worker=req.worker,
+            adopted=bool((view or {}).get("adopted")),
+            trace_id=req.trace_id,
+            worker_phases=(view or {}).get("phases"))
+        with self._lock:
+            self._timeline.append(rec)
+        if self.timeline_path:
+            d = os.path.dirname(os.path.abspath(self.timeline_path))
+            os.makedirs(d, exist_ok=True)
+            with open(self.timeline_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def timeline_records(self):
+        """The reqtimeline.v1 records of every finalized request so far
+        — what bench/tests read without re-parsing the JSONL."""
+        with self._lock:
+            return list(self._timeline)
 
     def run(self, timeout_s=120.0, poll_interval_s=0.01):
         """Pump until every submitted request is terminal (or the

@@ -26,6 +26,18 @@ trace propagation, and in-band error frames as the PS ops):
            `serving_model_version` gauge flips.
   STAT     (both roles)    health/placement signals: queue depth,
            active slots, pool occupancy, model version, handoff bytes.
+           A documented THIN PROJECTION of the metrics registry (ISSUE
+           12): the serving fields are read back out of one registry
+           snapshot, so STAT can never drift from what OP_METRICS ships
+           — there is no second bookkeeping.
+  METRICS  (both roles)    the worker's FULL `paddle_tpu.metrics.v1`
+           registry snapshot — the fleet federation verb
+           (observability/fleet.py merges them under worker_id/role
+           labels). Read-only: safe to retry, poll, and drop.
+  DUMP     (both roles)    write + return the worker's flight-recorder
+           postmortem (thread stacks, span ring, metrics) — what the
+           router pulls into a fleet postmortem bundle on a sustained
+           SLO breach.
 
 The decode role runs a background STEP LOOP (continuous batching via
 the existing SLO scheduler); the prefill role serves synchronously from
@@ -45,6 +57,7 @@ import numpy as np
 
 from ...distributed.ps import rpc as _rpc
 from ...framework import ckpt_commit as _ckpt
+from ...observability import flight_recorder as _fr
 from ...observability import metrics as _metrics
 from ...observability import tracecontext as _tc
 from ..scheduler import Scheduler, ServingConfig
@@ -52,22 +65,29 @@ from . import kv_handoff as _kv
 
 __all__ = ["ServingWorker", "load_checkpoint_params",
            "save_swap_checkpoint", "OP_KV_PUT", "OP_PREFILL", "OP_SUBMIT",
-           "OP_POLL", "OP_SWAP", "OP_STAT"]
+           "OP_POLL", "OP_SWAP", "OP_STAT", "OP_METRICS", "OP_DUMP"]
 
 # extension verbs on the PS fabric (< 0x40; see rpc.register_verb).
 # All are retry-safe: keyed dedup (PREFILL/SUBMIT), idempotent
-# overwrite (KVPUT/SWAP), or read-only (POLL/STAT).
+# overwrite (KVPUT/SWAP), or read-only (POLL/STAT/METRICS).
 OP_KV_PUT = 16
 OP_PREFILL = 17
 OP_SUBMIT = 18
 OP_POLL = 19
 OP_SWAP = 20
 OP_STAT = 21
+OP_METRICS = 22
+OP_DUMP = 23
 
 for _op, _name in ((OP_KV_PUT, "KVPUT"), (OP_PREFILL, "PREFILL"),
                    (OP_SUBMIT, "SUBMIT"), (OP_POLL, "POLL"),
                    (OP_SWAP, "SWAP"), (OP_STAT, "STAT")):
     _rpc.register_verb(_op, _name, idempotent=True)
+# the fleet observability sweep (ISSUE 12): METRICS is genuinely
+# side-effect-free; DUMP writes a postmortem artifact but is retry-safe
+# (bounded retention, every dump self-contained)
+_rpc.register_verb(OP_METRICS, "METRICS", readonly=True)
+_rpc.register_verb(OP_DUMP, "DUMP", idempotent=True)
 
 _M_HANDOFF_S = _metrics.histogram(
     "serving_kv_handoff_seconds",
@@ -114,13 +134,13 @@ class ServingWorker:
         # an optional decode-step pace (tests use it to hold a kill
         # window open; production leaves it 0)
         self.step_interval_s = float(step_interval_s)
-        self.handoff_bytes = 0               # STAT-visible running total
         self._stop = threading.Event()
         self.scheduler = Scheduler(engine, serving_config
                                    or ServingConfig()) \
             if role == "decode" else None
         _M_MODEL_VERSION.set(float(version))
-        handlers = {OP_SWAP: self._h_swap, OP_STAT: self._h_stat}
+        handlers = {OP_SWAP: self._h_swap, OP_STAT: self._h_stat,
+                    OP_METRICS: self._h_metrics, OP_DUMP: self._h_dump}
         if role == "decode":
             handlers.update({OP_KV_PUT: self._h_kv_put,
                              OP_SUBMIT: self._h_submit,
@@ -214,6 +234,7 @@ class ServingWorker:
         # the decode worker UNDER THE CALLER'S TRACE so the KVPUT spans
         # stitch into the router's timeline
         handoff_bytes = 0
+        handoff_s = 0.0
         target = obj.get("decode_endpoint")
         if target:
             # serving.kv_handoff fires inside pack (sender end) and
@@ -233,12 +254,16 @@ class ServingWorker:
             finally:
                 if scope is not None:
                     scope.__exit__(None, None, None)
-            _M_HANDOFF_S.observe(time.perf_counter() - t0)
+            handoff_s = time.perf_counter() - t0
+            _M_HANDOFF_S.observe(handoff_s)
             _M_HANDOFF_BYTES.inc(len(bundle))
             handoff_bytes = len(bundle)
-            self.handoff_bytes += handoff_bytes
         result = {"first_token": int(first), "plen": int(plen),
                   "handoff_bytes": handoff_bytes,
+                  # measured KVPUT wall time: lets the router split its
+                  # one observed PREFILL interval into prefill vs
+                  # kv_handoff timeline segments (ISSUE 12)
+                  "handoff_s": round(handoff_s, 6),
                   "prefix_hit_tokens": int(
                       stats.get("prefix_hit_tokens", 0) or 0)}
         self._prefill_done[key] = result
@@ -300,6 +325,12 @@ class ServingWorker:
                             "tokens": [int(t) for t in handle.tokens],
                             "error": handle.error,
                             "adopted": handle.adopted}
+                if handle.done():
+                    # terminal only: the worker's own phase trail rides
+                    # the LAST poll, so the router can join it into the
+                    # request's fleet timeline as `worker_phases`
+                    # (ISSUE 12) without bloating every poll round
+                    out[key]["phases"] = handle.phases
         return _kv.pack_payload(out)
 
     def _h_swap(self, body, aux, reqid, rctx):
@@ -328,28 +359,73 @@ class ServingWorker:
         return _kv.pack_payload(result)
 
     def _h_stat(self, body, aux, reqid, rctx):
+        """The hand-picked health/placement signals — wire shape
+        unchanged, but every serving figure is now a THIN PROJECTION of
+        ONE metrics-registry snapshot (ISSUE 12): the same snapshot
+        OP_METRICS ships whole, so STAT can never drift from what the
+        fleet federation sees. Engine-derived fields (KV budget, trace
+        counters, block occupancy) stay direct reads of live engine
+        state — they are not bookkeeping, they ARE the state. The
+        registry is process-global, matching the one-process-per-worker
+        deployment shape (module docstring); tests hosting several
+        workers in one process share these figures."""
+        flat = _metrics.flatten_snapshot(_metrics.registry().snapshot())
         out = {"role": self.role, "version": self.version,
                "endpoint": self.endpoint,
                "kv_memory_tokens": getattr(self.engine,
                                            "kv_memory_tokens", 0),
                "kv_usable_tokens": getattr(self.engine,
                                            "kv_usable_tokens", 0),
-               "handoff_bytes": self.handoff_bytes,
+               "handoff_bytes": int(flat.get(
+                   "serving_kv_handoff_bytes_total", 0)),
                "trace_counts": _jsonable(self.engine.trace_counts)}
         pool = getattr(self.engine, "block_pool", None)
         if pool is not None:
             out["blocks_in_use"] = pool.in_use
             out["blocks_total"] = pool.capacity
         if self.scheduler is not None:
-            with self._lock:
-                m = self.scheduler.metrics()
-            out.update({"queue_depth": m["queue_depth"],
-                        "active_slots": int(
-                            m["slot_occupancy"] * self.engine.slots),
-                        "requests": m["requests"],
-                        "tokens_generated": m["tokens_generated"],
-                        "model_version": self.scheduler.model_version})
+            # keep the historical `requests` key set (zero-filled), with
+            # VALUES read from the registry's serving_* counters
+            requests = dict.fromkeys(self.scheduler.counts, 0)
+            requests["serving.tokens"] = int(flat.get(
+                "serving_tokens_total", 0))
+            requests["serving.preempted"] = int(flat.get(
+                "serving_preempted_total", 0))
+            prefix = "serving_requests_total{status="
+            for key, v in flat.items():
+                if key.startswith(prefix):
+                    requests[f"serving.{key[len(prefix):-1]}"] = int(v)
+            out.update({
+                "queue_depth": int(flat.get("serving_queue_depth", 0)),
+                "active_slots": int(round(
+                    flat.get("serving_slot_occupancy", 0.0)
+                    * self.engine.slots)),
+                "requests": requests,
+                "tokens_generated": requests["serving.tokens"],
+                "model_version": self.scheduler.model_version})
         return _kv.pack_payload(out)
+
+    def _h_metrics(self, body, aux, reqid, rctx):
+        """OP_METRICS: the worker's FULL registry snapshot — the fleet
+        federation input (observability/fleet.py). Genuinely read-only:
+        polling it, retrying it, or dropping the reply changes nothing
+        on the worker."""
+        return _kv.pack_payload({
+            "role": self.role, "version": self.version,
+            "endpoint": self.endpoint,
+            "snapshot": _metrics.registry().snapshot()})
+
+    def _h_dump(self, body, aux, reqid, rctx):
+        """OP_DUMP: write this process's flight-recorder postmortem and
+        ship the document back — the router files it into the fleet
+        postmortem bundle on a sustained SLO breach. Retry-safe: every
+        dump is self-contained and retention-bounded."""
+        obj, _ = _kv.unpack_payload(body)
+        path = _fr.get().dump(obj.get("reason") or "fleet OP_DUMP")
+        with open(path) as f:
+            doc = json.load(f)
+        return _kv.pack_payload({"role": self.role, "path": path,
+                                 "postmortem": doc})
 
     @staticmethod
     def _trim(cache, cap=_DONE_CACHE_CAP):

@@ -43,8 +43,11 @@ and failed requests land in `serving_requests_total{status="error"}`.
 Observability: every step appends a JSONL record (queue depth, active
 slots, tokens emitted) and every request completion appends a summary
 (TTFT, decode rate, status, priority, preemption count, prefix-cache
-hit); the same figures feed profiler spans and the `native` stat
-counters, and `tools/serve_report.py` renders the file. The step loop is
+hit) PLUS a `paddle_tpu.reqtimeline.v1` timeline record (ISSUE 12):
+contiguous queue/prefill|adopt/decode phase segments whose durations sum
+exactly to the request's end-to-end latency, re-entering `queue` on
+every preemption; the same figures feed profiler spans and the `native`
+stat counters, and `tools/serve_report.py` renders the file. The step loop is
 synchronous by design — the engine's decode is one executable replay, so
 a thread adds latency, not throughput.
 """
@@ -58,6 +61,8 @@ import numpy as np
 
 from .. import native
 from ..observability import metrics as _metrics
+from ..observability import reqtimeline as _rt
+from ..observability import tracecontext as _tc
 from ..profiler import RecordEvent, TracerEventType
 from .blocks import BlockAllocError
 
@@ -190,6 +195,14 @@ class Request:
         self.first_token_at = None        # TTFT timestamp
         self.finished_at = None
         self._done = threading.Event()
+        # end-to-end phase timeline (ISSUE 12): the queue segment opens
+        # at submission, so segment durations sum EXACTLY to
+        # finished_at - submitted_at by PhaseTrail's construction
+        self.trail = _rt.PhaseTrail()
+        self.trail.begin(_rt.PH_QUEUE, submitted_at)
+        # trace id active at submission (None outside a trace window):
+        # joins this request's timeline record to its profiler spans
+        self.trace_id = _tc.current_trace_id()
 
     @property
     def exec_prompt(self):
@@ -264,6 +277,13 @@ class RequestHandle:
     def spec_accepted(self):
         """Draft tokens the verifier accepted for this request."""
         return self._req.spec_accepted
+
+    @property
+    def phases(self):
+        """The request's closed phase segments so far, t0-relative to its
+        submission (reqtimeline `rel()` shape) — what the POLL verb ships
+        to the router as `worker_phases` for terminal fleet requests."""
+        return self._req.trail.rel(self._req.submitted_at)
 
     def done(self):
         return self._req.status in (DONE, TIMEOUT, REJECTED, ERROR, SHED)
@@ -756,6 +776,7 @@ class Scheduler:
         req._exec_prompt = resume
         req._staged = None                 # evicted KV is gone: recompute
         req.status = QUEUED
+        req.trail.begin(_rt.PH_QUEUE, self._clock())
         self._queue.append(req)            # keeps its original arrival
                                            # order within its class
 
@@ -860,7 +881,9 @@ class Scheduler:
         request. BlockAllocError always escapes (the caller preempts)."""
         staged = req._staged
         if staged is None:
+            req.trail.begin(_rt.PH_PREFILL, self._clock())
             return self.engine.prefill(slot, req.exec_prompt)
+        req.trail.begin(_rt.PH_ADOPT, self._clock())
         try:
             first = self.engine.adopt_kv(slot, *staged)
         except BlockAllocError:
@@ -873,6 +896,9 @@ class Scheduler:
                               "error": f"{type(e).__name__}: "
                                        f"{str(e)[:160]}"}):
                 pass
+            # the failed adoption stays visible as its own segment; the
+            # recompute prefill opens a fresh one at the fallback moment
+            req.trail.begin(_rt.PH_PREFILL, self._clock())
             return self.engine.prefill(slot, req.exec_prompt)
         req._staged = None
         req.adopted = True
@@ -892,6 +918,7 @@ class Scheduler:
                 victim = self._pick_victim(worse_than=req.priority,
                                            exclude=(slot,))
                 if victim is None:
+                    req.trail.begin(_rt.PH_QUEUE, self._clock())
                     self._queue.append(req)     # retry next step
                     return "stop"
                 self._preempt(victim, "admission pressure")
@@ -901,12 +928,14 @@ class Scheduler:
                 return "failed"
             break
         else:
+            req.trail.begin(_rt.PH_QUEUE, self._clock())
             self._queue.append(req)
             return "stop"
         req.slot = slot
         req.status = RUNNING
         if req.first_token_at is None:
             req.first_token_at = self._clock()
+        req.trail.begin(_rt.PH_DECODE, self._clock())
         stats = getattr(self.engine, "last_prefill_stats", None) or {}
         if stats.get("prefix_hit_tokens", 0) > 0:
             req.prefix_hit = True
@@ -923,12 +952,14 @@ class Scheduler:
     def _finish(self, req, status, counter):
         req.status = status
         req.finished_at = self._clock()
+        req.trail.close(req.finished_at)
         self._count(counter)
         if req.first_token_at is not None:
             _M_TTFT.observe(req.first_token_at - req.submitted_at)
         if status in (DONE, TIMEOUT, ERROR, SHED):
             self._completed.append(req)
             self._write_request_record(req)
+            self._write_timeline_record(req)
         req._done.set()
 
     def _count(self, name):
@@ -979,6 +1010,30 @@ class Scheduler:
             "kind": "step", "step": self._steps, "t": now,
             "queue_depth": len(self._queue), "active_slots": active,
             "tokens_generated": self._decode_tokens}) + "\n")
+        self._metrics_f.flush()
+
+    def _build_timeline(self, req):
+        """One reqtimeline.v1 record for a terminal request — phase
+        durations sum exactly to e2e_s by PhaseTrail's construction."""
+        return _rt.build_record(
+            req.status, req.submitted_at, req.finished_at,
+            req.trail.rel(req.submitted_at), request_id=req.id,
+            tokens=len(req.tokens),
+            ttft_s=(req.first_token_at - req.submitted_at
+                    if req.first_token_at is not None else None),
+            priority=req.priority, preempted=req.preempted,
+            adopted=req.adopted, trace_id=req.trace_id)
+
+    def timeline_records(self):
+        """reqtimeline.v1 records for every completed request so far —
+        what tools/load_harness.py derives its per-phase TTFT breakdown
+        gauges from without re-reading the JSONL."""
+        return [self._build_timeline(r) for r in self._completed]
+
+    def _write_timeline_record(self, req):
+        if not self._metrics_f:
+            return
+        self._metrics_f.write(json.dumps(self._build_timeline(req)) + "\n")
         self._metrics_f.flush()
 
     def _write_request_record(self, req):

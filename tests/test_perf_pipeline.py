@@ -494,3 +494,103 @@ def test_metrics_compare_flags_kv_handoff_p99_regression(tmp_path):
                          capture_output=True, text=True, timeout=60)
     assert bad.returncode == 1
     assert "serving_kv_handoff_seconds:p99" in bad.stdout
+
+
+def _snapshot_with_labeled_gauges(gauges):
+    """Minimal valid metrics.v1 snapshot of labeled gauges:
+    {name: [(labels, value), ...]}."""
+    return {"schema": metrics_report.SCHEMA, "ts": 1.0, "pid": 1,
+            "metrics": [
+                {"name": n, "type": "gauge", "help": "",
+                 "labelnames": sorted(samples[0][0]),
+                 "samples": [{"labels": dict(lbl), "value": v}
+                             for lbl, v in samples]}
+                for n, samples in gauges.items()]}
+
+
+def test_metrics_compare_gates_slo_burn_through_cli(tmp_path):
+    """ISSUE 12 gate, through the CLI: `serving_slo_burn` crossing 1.0
+    from a clean baseline and a `serving_slo_degraded` 0 -> 1 flip are
+    failure-class — zero baselines, where every percentage rule must
+    skip, are exactly where the watchdog gauges live in a healthy run.
+    Burn GROWTH from a nonzero baseline trips the percentage rule."""
+    burn = ("serving_slo_burn", ({"slo": "ttft", "window": "fast"},))
+    healthy = _snapshot_with_labeled_gauges({
+        "serving_slo_burn": [(burn[1][0], 0.0)],
+        "serving_slo_degraded": [({}, 0.0)]})
+    breached = _snapshot_with_labeled_gauges({
+        "serving_slo_burn": [(burn[1][0], 25.0)],
+        "serving_slo_degraded": [({}, 1.0)]})
+    regs = metrics_report.compare_counters(healthy, breached)
+    why = {k.split("{")[0]: w for k, *_, w in regs}
+    assert "serving_slo_burn" in why and "serving_slo_degraded" in why
+    assert metrics_report.compare_counters(healthy, healthy) == []
+    # sub-1.0 burn from a clean baseline stays clean (budget not yet
+    # consumed faster than allowed); degraded flips on ANY nonzero
+    warm = _snapshot_with_labeled_gauges({
+        "serving_slo_burn": [(burn[1][0], 0.5)],
+        "serving_slo_degraded": [({}, 0.0)]})
+    assert metrics_report.compare_counters(healthy, warm) == []
+    # nonzero-baseline growth rides the percentage rule
+    grown = _snapshot_with_labeled_gauges({
+        "serving_slo_burn": [(burn[1][0], 2.0)],
+        "serving_slo_degraded": [({}, 0.0)]})
+    assert any(w == "SLO burn rate grew" for *_, w in
+               metrics_report.compare_counters(warm, grown))
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, healthy), (pb, breached)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_slo_degraded" in bad.stdout
+    assert "serving_slo_burn" in bad.stdout
+
+
+@pytest.mark.slow
+def test_bench_serve_dist_emits_fleet_artifacts(tmp_path):
+    """ISSUE 12 CI: `bench.py --serve-dist` leaves the fleet
+    observability artifact set — a schema-valid `fleet_metrics.jsonl`
+    (merged metrics.v1 stream with worker_id/role-labeled series and
+    _fleet aggregates), ONE merged Prometheus exposition, and a
+    `timelines.jsonl` whose reqtimeline.v1 records validate (phase sums
+    within the 5% gate is part of validation) with one record per
+    completed request."""
+    import serve_report
+
+    obs = str(tmp_path / "obs")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_INIT_BUDGET_S="120",
+               BENCH_DIST_REQUESTS="6", BENCH_DIST_MAXNEW="4",
+               BENCH_DIST_DECODE_WORKERS="2", BENCH_DIST_OBS_DIR=obs)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--serve-dist"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "error" not in rec, rec
+    extra = rec["extra"]["dist"]
+    assert extra["fleet_polls"] >= 1
+    assert extra["timeline_phase_means_s"].get("prefill", 0) > 0
+    assert extra["tail_attribution"]["dominant"]
+
+    snaps = metrics_report.load_snapshots(
+        os.path.join(obs, "fleet_metrics.jsonl"))   # raises on rot
+    members = {(s.get("labels") or {}).get("worker_id")
+               for m in snaps[-1]["metrics"] for s in m["samples"]}
+    assert {"decode0", "decode1", "prefill0", "router",
+            "_fleet"} <= members, members
+    prom = open(os.path.join(obs, "fleet_metrics.prom")).read()
+    assert metrics_report.validate_prometheus(prom) == []
+    assert 'worker_id="_fleet"' in prom
+
+    timelines = [json.loads(x) for x in
+                 open(os.path.join(obs, "timelines.jsonl")) if x.strip()]
+    assert len(timelines) == rec["extra"]["requests"]
+    errs = serve_report.validate_records(timelines)
+    assert errs == [], errs[:5]
+    phases = {s["phase"] for t in timelines for s in t["phases"]}
+    assert {"queue", "prefill", "place", "decode"} <= phases, phases
+    assert any(s["phase"] == "kv_handoff"
+               for t in timelines for s in t["phases"])

@@ -14,11 +14,15 @@ either KV layout:
                                   round, greedy-bit-identical output)
 
 The replay reports p50/p99 TTFT, decode tokens/sec, peak concurrency,
-shed/preempt/reject tallies and the prefix-cache hit rate; the same
-figures are exported through the unified metrics registry
-(`serving_load_*` gauges ride next to the scheduler's own counters and
-histograms) and an optional registry snapshot (paddle_tpu.metrics.v1
-JSONL) is written for `tools/metrics_report.py`.
+shed/preempt/reject tallies, the prefix-cache hit rate, and (ISSUE 12)
+a per-phase TTFT breakdown derived from the scheduler's reqtimeline
+records (queue wait vs prefill vs handoff/adopt vs first decode step);
+the same figures are exported through the unified metrics registry
+(`serving_load_*` gauges — including
+`serving_load_ttft_phase_seconds{phase=...}` — ride next to the
+scheduler's own counters and histograms) and an optional registry
+snapshot (paddle_tpu.metrics.v1 JSONL) is written for
+`tools/metrics_report.py`.
 
 Determinism: the TRACE is fully seeded (numpy RandomState). With
 `virtual_step_s` set, time itself is virtual — the scheduler runs on a
@@ -178,9 +182,31 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
         if wall_s > 0 else None,
         "ttft_p50_s": percentile(ttfts, 0.50),
         "ttft_p99_s": percentile(ttfts, 0.99),
+        "ttft_phase_s": _ttft_phase_breakdown(sched),
     }
     _export_registry(summary)
     return summary
+
+
+def _ttft_phase_breakdown(sched):
+    """Mean seconds each named phase contributed to TTFT, derived from
+    the scheduler's reqtimeline.v1 records (ISSUE 12): each completed
+    request's segments are clipped to its [0, ttft) window
+    (reqtimeline.ttft_breakdown), then averaged over the requests that
+    produced a first token — so a bench rung carries ATTRIBUTION
+    (queue wait vs prefill vs handoff/adopt vs first decode step), not
+    just the TTFT total."""
+    from paddle_tpu.observability import reqtimeline as _rt
+    totals, n = {}, 0
+    for rec in sched.timeline_records():
+        parts = _rt.ttft_breakdown(rec)
+        if parts is None:
+            continue
+        n += 1
+        for phase, s in parts.items():
+            totals[phase] = totals.get(phase, 0.0) + s
+    return {p: round(t / n, 6) for p, t in sorted(totals.items())} \
+        if n else {}
 
 
 def _export_registry(summary):
@@ -201,6 +227,14 @@ def _export_registry(summary):
     for name, (help_, value) in g.items():
         if value is not None:
             _metrics.gauge(name, help_).set(float(value))
+    phase_g = _metrics.gauge(
+        "serving_load_ttft_phase_seconds",
+        "Mean seconds each timeline phase contributed to TTFT over the "
+        "replay (per-request reqtimeline segments clipped to the TTFT "
+        "window; 'first_decode' = placement -> first token)",
+        labelnames=("phase",))
+    for phase, value in (summary.get("ttft_phase_s") or {}).items():
+        phase_g.labels(phase=phase).set(float(value))
 
 
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,

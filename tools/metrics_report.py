@@ -43,7 +43,23 @@ threshold. Direction matters and is decided per counter name:
   - histogram tails (ISSUE 10): `serving_kv_handoff_seconds` approximate
     p99 (from the cumulative buckets) GROWING past the threshold is
     failure-class — a handoff-latency tail stalls decode admission even
-    when every transfer still succeeds.
+    when every transfer still succeeds,
+  - SLO watchdog gauges (ISSUE 12): `serving_slo_burn{slo,window}`
+    GROWING past the threshold is failure-class, and both burn and
+    `serving_slo_degraded` are additionally FLIP-gated — a burn rate
+    crossing 1.0 (error budget consumed faster than allowed) or a
+    degraded flip 0 -> 1 fires even from a zero baseline, where
+    percentage rules are meaningless.
+
+Fleet-merged snapshots (ISSUE 12, observability/fleet.py) are compared
+LABEL-AWARE: every series already carries `worker_id`/`role` labels in
+its comparison key, so per-worker series match per worker — and the
+comparison first intersects the two snapshots' worker memberships,
+skipping series of workers absent from either side (a decode host that
+died mid-run B would otherwise read as every one of its work counters
+"shrinking" to zero; its death is already gated through the failure-
+class counters — failover, errors — that live on the surviving
+members and the `_fleet` aggregate).
 
 Small-count noise is ignored via --min-delta (absolute floor, default 1).
 
@@ -100,6 +116,22 @@ _GAUGE_GROW_RULES = (
     # rot) even while tokens still mostly match
     (re.compile(r"serving_quant_logit_kl(\{.*\})?$"),
      "quantized logit KL vs f32 oracle grew"),
+    # ISSUE 12: the online SLO watchdog's burn rate growing means the
+    # fleet is eating its error budget faster than run A did
+    (re.compile(r"serving_slo_burn(\{.*\})?$"),
+     "SLO burn rate grew"),
+)
+
+# FLIP rules (ISSUE 12): gauges judged against an ABSOLUTE line, not a
+# percentage — the percentage rules skip zero baselines, but a burn
+# gauge crossing 1.0 or a degraded gauge flipping 0 -> 1 is an incident
+# precisely when run A sat at 0. Each entry: (pattern, threshold B must
+# reach while A sat at/below zero, reason).
+_GAUGE_FLIP_RULES = (
+    (re.compile(r"serving_slo_degraded(\{.*\})?$"), 1e-9,
+     "fleet flipped into sustained SLO breach"),
+    (re.compile(r"serving_slo_burn(\{.*\})?$"), 1.0,
+     "SLO burn rate crossed 1.0 from a clean baseline"),
 )
 
 # GAUGE rules: gauges whose DROP past the threshold is failure-class.
@@ -126,6 +158,38 @@ _HIST_P99_RULES = (
     (re.compile(r"serving_kv_handoff_seconds(\{.*\})?$"),
      "KV handoff p99 grew"),
 )
+
+
+_WORKER_LABEL = re.compile(r"worker_id=([^,}]+)")
+_FLEET_LABEL = "_fleet"      # the fleet-aggregate member id (fleet.py)
+
+
+def _fleet_members(rec):
+    """worker_id label values present in a snapshot (empty for raw
+    single-process snapshots — membership filtering then no-ops)."""
+    out = set()
+    for m in rec.get("metrics", []):
+        for s in m.get("samples", []):
+            wid = (s.get("labels") or {}).get("worker_id")
+            if wid:
+                out.add(wid)
+    out.discard(_FLEET_LABEL)
+    return out
+
+
+def _member_filter(a_rec, b_rec):
+    """key -> bool: keep series whose worker_id is live in BOTH
+    snapshots (plus the _fleet aggregates and every unlabeled series).
+    See the module docstring's label-aware fleet comparison rules."""
+    ma, mb = _fleet_members(a_rec), _fleet_members(b_rec)
+    if not ma or not mb:
+        return lambda key: True
+    common = (ma & mb) | {_FLEET_LABEL}
+
+    def keep(key):
+        m = _WORKER_LABEL.search(key)
+        return m is None or m.group(1) in common
+    return keep
 
 
 def _approx_p99(buckets, count):
@@ -356,8 +420,11 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
     the miss counter growing would fire the failure rule, but a rate
     comparison stays meaningful when B simply served more traffic)."""
     a, b = flatten(a_rec, ("counter",)), flatten(b_rec, ("counter",))
+    keep = _member_filter(a_rec, b_rec)
     regressions = []
     for key in sorted(set(a) | set(b)):
+        if not keep(key):
+            continue                  # member absent from one side
         va, vb = a.get(key, 0.0), b.get(key, 0.0)
         delta = vb - va
         if abs(delta) < min_delta:
@@ -373,6 +440,8 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
                                     "work counter shrank"))
     ra, rb = _hit_rates(a), _hit_rates(b)
     for key in sorted(set(ra) & set(rb)):
+        if not keep(key):
+            continue
         va, vb = ra[key], rb[key]
         if va <= 0:
             continue
@@ -380,9 +449,16 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
         if vb < va and -pct > max_regress_pct:
             regressions.append((key, va, vb, pct, "hit rate dropped"))
     ga, gb = flatten(a_rec, ("gauge",)), flatten(b_rec, ("gauge",))
-    for key in sorted(set(ga) & set(gb)):
-        va, vb = ga[key], gb[key]
-        if va <= 0:
+    for key in sorted(set(ga) | set(gb)):
+        if not keep(key):
+            continue
+        va, vb = ga.get(key, 0.0), gb.get(key, 0.0)
+        # absolute flip rules first: meaningful exactly when va == 0,
+        # where every percentage rule below must skip
+        for pat, floor, why in _GAUGE_FLIP_RULES:
+            if pat.search(key) and va <= 0 and vb >= floor:
+                regressions.append((key, va, vb, float("inf"), why))
+        if key not in ga or key not in gb or va <= 0:
             continue
         pct = (vb - va) / va * 100.0
         for pat, why in _GAUGE_GROW_RULES:
@@ -393,6 +469,8 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
                 regressions.append((key, va, vb, pct, why))
     ha, hb = _hist_p99s(a_rec), _hist_p99s(b_rec)
     for key in sorted(set(ha) & set(hb)):
+        if not keep(key):
+            continue
         (va, why), (vb, _) = ha[key], hb[key]
         if va <= 0 or vb <= va:
             continue

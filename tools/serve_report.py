@@ -22,6 +22,20 @@ one-token engines); the summary reports the run's acceptance rate over
 them. Terminal statuses now include ERROR (engine failure contained to
 the request) and SHED (failed fast at admission by the SLO watermark).
 
+Timeline records (ISSUE 12): the scheduler (and the multi-host router,
+`DistFrontend(timeline_path=)`) additionally emit one
+`paddle_tpu.reqtimeline.v1` record per terminal request —
+`{"kind": "timeline", "schema", "status", "e2e_s", "ttft_s", "tokens",
+"preempted", "failovers", "adopted", "phases": [{"phase", "t0",
+"dur_s"}, ...]}` — whose contiguous phase segments decompose the
+request's end-to-end latency (queue / prefill / kv_handoff / adopt /
+place / decode / failover). Validation enforces the structural
+contract: known phase names, non-negative durations, and segment
+durations summing to `e2e_s` within 5% (the acceptance gate). The CLI
+grows a timeline view (mean seconds per phase) and a TAIL-ATTRIBUTION
+table: among the slowest requests by e2e (the p99 tail), which phase
+dominates — the "what do I fix" readout for a p99 regression.
+
 `validate_records` is the schema contract the CI smoke test asserts on;
 the CLI renders a human summary: request outcomes, TTFT percentiles,
 decode throughput, queue depth and slot occupancy over the run.
@@ -55,19 +69,39 @@ OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
 OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted", "adopted"}
 STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
+# per-request end-to-end timeline records (ISSUE 12), schema
+# paddle_tpu.reqtimeline.v1 — written by the scheduler next to its
+# request records and by the router per DistRequest
+TIMELINE_SCHEMA = "paddle_tpu.reqtimeline.v1"
+TIMELINE_FIELDS = {"kind": str, "schema": str, "status": str,
+                   "e2e_s": (int, float), "ttft_s": (int, float,
+                                                     type(None)),
+                   "tokens": int, "preempted": int, "failovers": int,
+                   "adopted": bool, "phases": list}
+OPTIONAL_TIMELINE_FIELDS = {"request_id", "key", "priority", "worker",
+                            "trace_id", "worker_phases"}
+TIMELINE_PHASES = {"queue", "prefill", "kv_handoff", "adopt", "place",
+                   "decode", "failover"}
+# the phases-sum-to-e2e acceptance gate: contiguous trail construction
+# makes the sum structurally exact, so 5% + 1ms of slack only absorbs
+# float rounding on sub-millisecond runs
+TIMELINE_SUM_TOL = 0.05
+
 
 def validate_records(records):
     """Returns a list of schema violations ([] == valid)."""
     errors = []
     for i, rec in enumerate(records):
         kind = rec.get("kind")
-        if kind not in ("step", "request", "run"):
+        if kind not in ("step", "request", "run", "timeline"):
             errors.append(f"record {i}: unknown kind {kind!r}")
             continue
         schema = {"step": STEP_FIELDS, "request": REQUEST_FIELDS,
-                  "run": RUN_FIELDS}[kind]
+                  "run": RUN_FIELDS,
+                  "timeline": TIMELINE_FIELDS}[kind]
         optional = OPTIONAL_REQUEST_FIELDS if kind == "request" \
-            else OPTIONAL_RUN_FIELDS if kind == "run" else ()
+            else OPTIONAL_RUN_FIELDS if kind == "run" \
+            else OPTIONAL_TIMELINE_FIELDS if kind == "timeline" else ()
         for field, types in schema.items():
             if field not in rec:
                 if field not in optional:
@@ -76,12 +110,86 @@ def validate_records(records):
                 errors.append(
                     f"record {i} ({kind}): {field!r} has type "
                     f"{type(rec[field]).__name__}")
-        extra = set(rec) - set(schema)
+        extra = set(rec) - set(schema) - set(optional)
         if extra:
             errors.append(f"record {i} ({kind}): unexpected {sorted(extra)}")
         if kind == "request" and rec.get("status") not in STATUSES:
             errors.append(f"record {i}: bad status {rec.get('status')!r}")
+        if kind == "timeline":
+            errors.extend(f"record {i} (timeline): {e}"
+                          for e in _validate_timeline(rec))
     return errors
+
+
+def _validate_timeline(rec):
+    """The reqtimeline.v1 structural contract: schema tag, known phase
+    vocabulary, non-negative contiguous-by-construction durations, and
+    phase durations summing to e2e_s within TIMELINE_SUM_TOL (+1ms)."""
+    errs = []
+    if rec.get("schema") != TIMELINE_SCHEMA:
+        errs.append(f"schema={rec.get('schema')!r}, "
+                    f"want {TIMELINE_SCHEMA!r}")
+    if rec.get("status") not in STATUSES:
+        errs.append(f"bad status {rec.get('status')!r}")
+    total = 0.0
+    for lists, where in ((rec.get("phases") or [], "phases"),
+                         (rec.get("worker_phases") or [],
+                          "worker_phases")):
+        for j, seg in enumerate(lists):
+            if not isinstance(seg, dict):
+                errs.append(f"{where}[{j}] not a dict")
+                continue
+            if seg.get("phase") not in TIMELINE_PHASES:
+                errs.append(f"{where}[{j}]: unknown phase "
+                            f"{seg.get('phase')!r}")
+            for fld in ("t0", "dur_s"):
+                v = seg.get(fld)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errs.append(f"{where}[{j}]: {fld}={v!r} invalid")
+            if where == "phases" and \
+                    isinstance(seg.get("dur_s"), (int, float)):
+                total += seg["dur_s"]
+    e2e = rec.get("e2e_s")
+    if isinstance(e2e, (int, float)) and rec.get("phases") and \
+            abs(total - e2e) > TIMELINE_SUM_TOL * max(e2e, 0.0) + 1e-3:
+        errs.append(f"phase durations sum to {total:.6f}, "
+                    f"e2e_s={e2e:.6f} (> {TIMELINE_SUM_TOL:.0%} apart)")
+    return errs
+
+
+def timeline_phase_means(timelines):
+    """{phase: mean seconds per request} over timeline records — the
+    timeline view's aggregate row."""
+    if not timelines:
+        return {}
+    totals = {}
+    for rec in timelines:
+        for seg in rec.get("phases", ()):
+            totals[seg["phase"]] = totals.get(seg["phase"], 0.0) \
+                + seg["dur_s"]
+    return {p: t / len(timelines) for p, t in sorted(totals.items())}
+
+
+def tail_attribution(timelines, q=0.99):
+    """Which phase dominates the latency tail: take the requests at or
+    above the q-quantile of e2e_s and report each phase's share of
+    their summed time. Returns {"e2e_p": quantile value, "requests": n,
+    "share": {phase: fraction}, "dominant": phase} or None without
+    timeline records."""
+    if not timelines:
+        return None
+    cut = _pct([r["e2e_s"] for r in timelines], q)
+    tail = [r for r in timelines if r["e2e_s"] >= cut]
+    totals = {}
+    for rec in tail:
+        for seg in rec.get("phases", ()):
+            totals[seg["phase"]] = totals.get(seg["phase"], 0.0) \
+                + seg["dur_s"]
+    grand = sum(totals.values())
+    share = {p: (t / grand if grand > 0 else 0.0)
+             for p, t in sorted(totals.items())}
+    return {"e2e_p": cut, "requests": len(tail), "share": share,
+            "dominant": max(share, key=share.get) if share else None}
 
 
 def load(path):
@@ -99,6 +207,7 @@ def _pct(values, q):
 def summarize(records):
     steps = [r for r in records if r["kind"] == "step"]
     reqs = [r for r in records if r["kind"] == "request"]
+    timelines = [r for r in records if r["kind"] == "timeline"]
     # run headers: later records win (a quality harness may append one
     # carrying the measured match rate after the scheduler's own)
     run = {}
@@ -144,6 +253,10 @@ def summarize(records):
         "weight_dtype": run.get("weight_dtype"),
         "quant_greedy_match": run.get("quant_greedy_match"),
         "quant_logit_kl": run.get("quant_logit_kl"),
+        "timelines": len(timelines),
+        "timeline_phase_means": timeline_phase_means(timelines),
+        "tail_attribution": tail_attribution(timelines),
+        "failovers": sum(r.get("failovers", 0) for r in timelines),
     }
 
 
@@ -183,6 +296,22 @@ def render(summary):
         out.append(f"preemptions: {summary['preemptions']}")
     out.append("priority mix: " + ", ".join(
         f"class{p}={n}" for p, n in summary["by_priority"].items()))
+    if summary.get("timelines"):
+        out += ["", f"## timelines ({summary['timelines']} requests"
+                    + (f", {summary['failovers']} failover hops)"
+                       if summary.get("failovers") else ")"), ""]
+        out.append("mean seconds per phase: " + ", ".join(
+            f"{p}={v:.4f}"
+            for p, v in summary["timeline_phase_means"].items()))
+        tail = summary.get("tail_attribution")
+        if tail:
+            out += ["", f"p99 tail attribution ({tail['requests']} "
+                        f"requests, e2e >= {tail['e2e_p']:.4f}s):",
+                    "", "| phase | share of tail time |", "|---|---|"]
+            for p, s in sorted(tail["share"].items(),
+                               key=lambda kv: -kv[1]):
+                mark = "  <- dominant" if p == tail["dominant"] else ""
+                out.append(f"| {p} | {s:.1%}{mark} |")
     return "\n".join(out)
 
 
