@@ -567,6 +567,12 @@ def _parse_args(argv):
                         "ONE single-process paged scheduler at the same "
                         "allocatable KV budget — tokens/sec, p50/p99 "
                         "TTFT, and handoff bytes per arm")
+    p.add_argument("--pp-stages", type=int, default=None,
+                   help="--serve-dist: run each decode worker GROUP as a "
+                        "pipeline-parallel engine with this many stages "
+                        "over its local devices (ISSUE 13; also "
+                        "$BENCH_DIST_PP_STAGES); per-group tensor degree "
+                        "via $BENCH_DIST_TP")
     p.add_argument("--cold-start", action="store_true",
                    help="cold-start rung: build a serving artifact, then "
                         "race a COLD process (empty compile cache, full "
@@ -629,7 +635,10 @@ def run_decode_bench(on_tpu, n_steps=None):
 def run_serve_load_bench(on_tpu, n_requests=None):
     """Serving load rung: the deterministic traffic-replay harness
     (tools/load_harness.py) at a shared-prefix mixture — dense, paged,
-    and speculative-decode engines AT THE SAME KV MEMORY BUDGET. The
+    and speculative-decode engines AT THE SAME KV MEMORY BUDGET, plus
+    (ISSUE 13) a pipeline-parallel arm at EQUAL MEASURED PER-HOST HBM
+    (hbm_accounting-gated <=1.05x the paged arm; per-stage compile
+    bounds asserted). The
     metric is the paged engine's replay tokens/sec; extra carries every
     arm's summary (tokens/sec, p50/p99 TTFT, peak concurrency, prefix
     hits, preemptions, and the spec arm's acceptance rate) plus the
@@ -695,6 +704,39 @@ def run_serve_load_bench(on_tpu, n_requests=None):
             draft_layers=draft_layers, attention_impl=attention_impl)
     paged, dense, spec, quant = (results["paged"], results["dense"],
                                  results["spec"], results["quant"])
+    # pp arm (ISSUE 13): pipeline-parallel serving at EQUAL PER-HOST
+    # HBM. Each of the pp stage groups holds 1/pp of the layers, so at
+    # the paged arm's per-device byte budget the pp pool takes pp× the
+    # blocks (and pp× the slots ride the decode ring). The budget is
+    # GATED below on the MEASURED per-device footprint
+    # (hbm_accounting), not dtype/count arithmetic — weights shrink per
+    # device too (1/pp + the tied-embedding copy), so pool-equality is
+    # the conservative sizing.
+    pp_stages = int(os.environ.get("BENCH_SERVE_PP", 2))
+    pp_tp = int(os.environ.get("BENCH_SERVE_PP_TP", 1))
+    pp_arm = None
+    if pp_stages * pp_tp <= len(jax.devices()):
+        pp_blocks = pp_stages * (num_blocks - 1) + 1
+        pp_slots = pp_stages * paged_slots
+        results["pp"] = load_harness.run_harness(
+            model, "pp", traffic, slots=pp_slots, max_len=max_len,
+            block_size=block, num_blocks=pp_blocks,
+            attention_impl=attention_impl, tp=pp_tp, pp=pp_stages)
+        pp_arm = results["pp"]
+        pp_hbm_ratio = (pp_arm["hbm_max_device_bytes"]
+                        / max(paged["hbm_max_device_bytes"], 1))
+        assert pp_hbm_ratio <= 1.05, \
+            f"pp arm exceeds the per-host HBM budget: " \
+            f"{pp_hbm_ratio:.3f}x the paged arm's measured per-device " \
+            f"bytes"
+    else:
+        # a 1-device host (no virtual-device XLA_FLAGS, single real
+        # chip): the hybrid-parallel arm is impossible — record why
+        # instead of failing the whole rung
+        pp_hbm_ratio = None
+        results["pp"] = {"skipped":
+                         f"needs {pp_stages * pp_tp} devices, have "
+                         f"{len(jax.devices())}"}
     # the quality gate rides the rung: teacher-forced greedy match +
     # logit KL vs the f32 oracle, exported as serving_quant_* gauges.
     # Sample size matters against the 0.99 gate below: 5 slots x 40
@@ -718,6 +760,16 @@ def run_serve_load_bench(on_tpu, n_requests=None):
         "spec": (spec["trace_counts"]["spec_verify"] == 1
                  and spec["trace_counts"]["draft_decode"] == 1
                  and spec["trace_counts"]["decode"] == 0),
+        # pp: every STAGE's decode ring executable compiles exactly
+        # once, and so does each (stage, chunk) prefill executable
+        # (vacuously true on hosts too small for the pp arm)
+        "pp": pp_arm is None or (
+            len(pp_arm["trace_counts"]["decode_pp"]) == pp_stages
+            and all(v == 1 for v in
+                    pp_arm["trace_counts"]["decode_pp"].values())
+            and all(v == 1 for v in
+                    pp_arm["trace_counts"]["prefill_pp"].values())
+            and pp_arm["trace_counts"]["decode"] == 0),
     }
     assert all(compile_bounds.values()), \
         f"decode compile counts unbounded: {compile_bounds}"
@@ -751,11 +803,19 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                       {"f32": f32_block_bytes, "int8": int8_block_bytes},
                   "quant_greedy_match": quality["greedy_match"],
                   "quant_logit_kl": quality["logit_kl"],
+                  "pp": results["pp"], "pp_stages": pp_stages,
+                  "pp_tp": pp_tp,
+                  "pp_hbm_vs_paged": round(pp_hbm_ratio, 4)
+                  if pp_hbm_ratio is not None else None,
+                  "pp_vs_paged_concurrency": round(
+                      pp_arm["max_concurrent"]
+                      / max(paged["max_concurrent"], 1), 3)
+                  if pp_arm is not None else None,
                   "backend": jax.default_backend()},
     }
 
 
-def run_serve_dist_bench(on_tpu, n_requests=None):
+def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
     """Multi-host serving rung (ISSUE 10): the same traffic through (a)
     ONE paged scheduler in this process and (b) a forked 1-prefill +
     N-decode worker fleet behind the router, at EQUAL allocatable KV
@@ -807,8 +867,21 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
     max_new = int(os.environ.get("BENCH_DIST_MAXNEW", 16 if on_tpu else 6))
     prompt_len = int(os.environ.get("BENCH_DIST_PROMPT",
                                     64 if on_tpu else 8))
+    # --pp-stages / $BENCH_DIST_PP_STAGES (ISSUE 13): each decode
+    # worker GROUP serves a pipeline-parallel engine over its local
+    # devices (tensor degree per stage via $BENCH_DIST_TP). The KV
+    # budget math is unchanged — block tables and the allocator are
+    # shared across a group's stages, so num_blocks means the same
+    # thing in both engine kinds.
+    pp_stages = pp_stages if pp_stages is not None else \
+        int(os.environ.get("BENCH_DIST_PP_STAGES", 0)) or None
     worker_cfg = {"slots": slots, "max_len": max_len, "block_size": block}
     per_worker = PagedEngineConfig(**worker_cfg)
+    engine_kind = "paged"
+    if pp_stages:
+        engine_kind = "pp"
+        worker_cfg = dict(worker_cfg, pp=int(pp_stages),
+                          tp=int(os.environ.get("BENCH_DIST_TP", 1)))
     # equal ALLOCATABLE budget: each worker reserves its own garbage
     # block, so the single process gets the summed usable blocks + one
     single_blocks = n_decode * (per_worker.num_blocks - 1) + 1
@@ -858,15 +931,27 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
     roles = ["prefill"] + ["decode"] * n_decode
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", jax.default_backend())
+    if pp_stages and jax.default_backend() == "cpu" and \
+            "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        # a pp worker group needs pp*tp local devices; on the CPU
+        # backend those are virtual
+        need = int(pp_stages) * int(worker_cfg.get("tp", 1))
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{max(need, 1)}").strip()
     for i, role in enumerate(roles):
         ep = os.path.join(workdir, f"ep_{i}")
         procs.append(subprocess.Popen(
             [sys.executable, "-m",
              "paddle_tpu.serving.distributed.worker_main",
-             "--role", role, "--engine", "paged",
+             "--role", role,
+             "--engine", engine_kind if role == "decode" else "paged",
              "--model", model_name, "--seed", str(seed),
              "--index", str(i),
-             "--engine-config", _json.dumps(worker_cfg),
+             "--engine-config", _json.dumps(
+                 worker_cfg if role == "decode"
+                 else {"slots": slots, "max_len": max_len,
+                       "block_size": block}),
              "--serving-config", _json.dumps(
                  {"max_queue": max(64, requests),
                   "default_max_new_tokens": max_new}),
@@ -944,6 +1029,7 @@ def run_serve_dist_bench(on_tpu, n_requests=None):
             sum(len(r.tokens) for r in reqs), dist_wall,
             {"kv_memory_tokens": dist_budget, "handoff_bytes": handoff,
              "staged_requests": staged, "decode_workers": n_decode,
+             "engine": engine_kind, "pp_stages": pp_stages,
              "fleet_polls": plane.polls, "obs_dir": obs_dir,
              "timeline_phase_means_s": phase_means,
              "tail_attribution": serve_report.tail_attribution(
@@ -1177,7 +1263,8 @@ def main(argv=None):
         wd = start_watchdog(float(os.environ.get("BENCH_RUNG_BUDGET_S", 900)),
                             "serve-dist rung")
         try:
-            result = run_serve_dist_bench(on_tpu)
+            result = run_serve_dist_bench(on_tpu,
+                                          pp_stages=args.pp_stages)
             emit(result["value"], result["vs_baseline"],
                  extra=result["extra"])
         finally:

@@ -37,6 +37,13 @@ Site catalogue (the call sites live next to the operation they break):
                        params are validated/committed — a raise rejects
                        the swap atomically (old weights keep serving,
                        zero requests dropped)
+  serving.pp_handoff   the pipeline-parallel stage boundary (ISSUE 13):
+                       fires on every activation/KV transfer from stage
+                       s to stage s+1 inside the serving ring (decode
+                       ticks and chunked-prefill hops alike) — a raise
+                       mid-ring escapes decode()/prefill() and proves
+                       the scheduler's quarantine + the router's
+                       group-level failover contain a dying stage
   dataloader.next      io.DataLoader.__iter__, before each batch
 
 Arming, in-process:
@@ -71,7 +78,7 @@ __all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
 SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.decode_step", "serving.block_alloc",
          "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
-         "dataloader.next")
+         "serving.pp_handoff", "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

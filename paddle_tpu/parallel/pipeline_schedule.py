@@ -239,6 +239,43 @@ def required_slots(fwd_tbl, bwd_tbl, farr, garr, n_microbatches, pp, vpp):
     return worst + 1
 
 
+def build_serving_tables(n_microbatches, pp):
+    """Forward-only tick table for SERVING pipelines (ISSUE 13): the
+    1F1B machinery above minus the backward half — microbatch g enters
+    stage 0 at tick g and rides the stage ring one hop per tick, so
+
+        tbl[t, s] = microbatch stage s processes at tick t (-1 idle)
+
+    over T = M + pp - 1 ticks. After the (pp-1)-tick fill every stage
+    works every tick (the steady-state ring); the only idle entries are
+    the fill/drain triangles, so the schedule's bubble fraction is
+    (pp-1)/(M + pp - 1) — shrinking with the microbatch count, which is
+    what `serving_pp_bubble_fraction` gauges and the metrics_report
+    failure-class rule watch."""
+    M, pp = int(n_microbatches), int(pp)
+    if M < 1 or pp < 1:
+        raise ValueError(f"need M >= 1 and pp >= 1, got M={M} pp={pp}")
+    T = M + pp - 1
+    tbl = np.full((T, pp), -1, np.int32)
+    for t in range(T):
+        for s in range(pp):
+            g = t - s
+            if 0 <= g < M:
+                tbl[t, s] = g
+    return tbl
+
+
+def serving_schedule_stats(tbl):
+    """Diagnostics for a `build_serving_tables` table: total ticks,
+    per-stage busy fraction, and the bubble fraction the gauges carry."""
+    T, pp = tbl.shape
+    busy = (tbl >= 0).sum(0)
+    work = int((tbl >= 0).sum())
+    return {"ticks": int(T),
+            "stage_busy": [float(b) / T for b in busy],
+            "bubble_frac": float(1.0 - work / (T * pp))}
+
+
 def schedule_stats(fwd_tbl, bwd_tbl):
     """Diagnostics: total ticks, bubble fraction, peak in-flight per stage."""
     T = fwd_tbl.shape[0]

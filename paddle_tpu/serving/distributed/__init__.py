@@ -4,13 +4,20 @@ The single-process engines (serving/engine.py) scale until one host's
 HBM or one chip's FLOPs run out; this package is the tier above them,
 un-descoping PARITY §2.7's multi-host row with three composable layers:
 
-  tp.py          — TENSOR-PARALLEL decode: the paged decode step sharded
+  tp.py          — TENSOR-PARALLEL serving: prefill AND decode sharded
                    over a device mesh ('mp' axis — KV pools and
                    attention heads split across devices, weights laid
                    out by their training-time `split_axis` annotations).
                    Token-exact vs the single-device paged engine and
                    still compiles exactly once; CPU-testable on the
                    virtual-device mesh.
+  pp.py          — PIPELINE-PARALLEL serving (ISSUE 13): GPT blocks
+                   partitioned into stages over the second mesh axis,
+                   each stage holding its own resident KV pool slice on
+                   its own (optionally tensor-parallel) device group —
+                   models bigger than one host's HBM serve end-to-end.
+                   Decode is a steady-state microbatch ring, prefill
+                   streams chunks through the stages 1F1B-style.
   kv_handoff.py  — KV-block WIRE FORMAT for disaggregated prefill/decode
                    pools: one request's per-layer K/V slices as a
                    validated, truncation-rejecting bundle.
@@ -32,6 +39,8 @@ single-process serving must not pay for.
 """
 from .kv_handoff import (KVWireError, pack_kv_bundle,  # noqa: F401
                          unpack_kv_bundle)
+from .pp import (PipelineParallelEngineConfig,  # noqa: F401
+                 PipelineParallelPagedEngine)
 from .router import DistFrontend, ServingShardClient  # noqa: F401
 from .tp import (TensorParallelEngineConfig,  # noqa: F401
                  TensorParallelPagedEngine)
@@ -40,6 +49,7 @@ from .worker import (ServingWorker, load_checkpoint_params,  # noqa: F401
 
 __all__ = [
     "TensorParallelEngineConfig", "TensorParallelPagedEngine",
+    "PipelineParallelEngineConfig", "PipelineParallelPagedEngine",
     "KVWireError", "pack_kv_bundle", "unpack_kv_bundle",
     "ServingWorker", "load_checkpoint_params", "save_swap_checkpoint",
     "DistFrontend", "ServingShardClient",

@@ -40,8 +40,8 @@ from ...observability import faults as _faults
 from ..blocks import dequant_codes as _dequant_codes
 
 __all__ = ["KVWireError", "BUNDLE_VERSION", "QUANT_BUNDLE_VERSION",
-           "pack_kv_bundle", "unpack_kv_bundle", "pack_payload",
-           "unpack_payload"]
+           "RNG_BUNDLE_VERSION", "pack_kv_bundle", "unpack_kv_bundle",
+           "pack_payload", "unpack_payload"]
 
 BUNDLE_VERSION = 1            # float bundles: L * (K | V)
 # v2 (ISSUE 11): QUANTIZED bundles — int8 codes ship with their
@@ -51,6 +51,15 @@ BUNDLE_VERSION = 1            # float bundles: L * (K | V)
 # receiver dequantizes on unpack, so the adopt path is version-blind;
 # v1 bundles stay readable forever.
 QUANT_BUNDLE_VERSION = 2
+# v3 (ISSUE 13): the header additionally carries the request's sampler
+# RNG state — {"rng": {"seed", "gen"}}, gen = the generation index of
+# the token AFTER `meta["first_token"]` — so a NON-GREEDY stream
+# adopted on another host (or restarted after a SIGKILL) continues
+# bit-identically: token n always samples with fold_in(key(seed), n).
+# The array layout is unchanged (float or quantized, decided by the
+# "scale_block" header field). v1/v2 bundles stay readable forever;
+# the RNG field absent means greedy-only failover, exactly as before.
+RNG_BUNDLE_VERSION = 3
 _MAGIC = 0x3142564B                      # "KVB1" little-endian
 _U32 = struct.Struct("<I")
 _HEAD = struct.Struct("<II")             # magic | header_len
@@ -63,7 +72,7 @@ class KVWireError(ValueError):
 
 
 def pack_kv_bundle(ks, vs, meta=None, k_scales=None, v_scales=None,
-                   scale_block=None):
+                   scale_block=None, rng=None):
     """Serialize one request's per-layer K/V slices.
 
     ks/vs: sequences of [tokens, heads, head_dim] arrays, one per layer,
@@ -76,7 +85,12 @@ def pack_kv_bundle(ks, vs, meta=None, k_scales=None, v_scales=None,
     per-block per-head scales, `engine.extract_kv_wire`) and
     `scale_block` (tokens each scale row covers). The wire then carries
     the int8 bytes — a quarter of the f32 bundle — and the receiver
-    dequantizes at unpack."""
+    dequantizes at unpack.
+
+    `rng=(seed, gen)` (ISSUE 13) stamps the bundle v3: the request's
+    sampler state after its first token, so the adopting host continues
+    a SAMPLED stream bit-identically. Composes with either array
+    layout."""
     _faults.fire("serving.kv_handoff")
     if len(ks) != len(vs) or not ks:
         raise KVWireError(
@@ -110,6 +124,9 @@ def pack_kv_bundle(ks, vs, meta=None, k_scales=None, v_scales=None,
         "dtype": dtype.name, "layers": len(ks),
         "tokens": int(shape[0]), "heads": int(shape[1]),
         "head_dim": int(shape[2]), "meta": dict(meta or {})}
+    if rng is not None:
+        header["v"] = RNG_BUNDLE_VERSION
+        header["rng"] = {"seed": int(rng[0]), "gen": int(rng[1])}
     parts = [None, None]        # head + header, filled below
     if quant:
         if dtype != np.int8:
@@ -167,10 +184,13 @@ def unpack_kv_bundle(buf):
     except ValueError as e:
         raise KVWireError(f"bundle header is not JSON: {e}") from None
     version = header.get("v")
-    if version not in (BUNDLE_VERSION, QUANT_BUNDLE_VERSION):
+    if version not in (BUNDLE_VERSION, QUANT_BUNDLE_VERSION,
+                       RNG_BUNDLE_VERSION):
         raise KVWireError(f"bundle version {version!r}, want "
-                          f"{BUNDLE_VERSION} or {QUANT_BUNDLE_VERSION}")
-    quant = version == QUANT_BUNDLE_VERSION
+                          f"{BUNDLE_VERSION}..{RNG_BUNDLE_VERSION}")
+    # v3 keeps either array layout: the scale header fields decide
+    quant = version == QUANT_BUNDLE_VERSION or (
+        version == RNG_BUNDLE_VERSION and "scale_block" in header)
     try:
         dtype = np.dtype(header["dtype"])
         layers = int(header["layers"])
@@ -184,10 +204,10 @@ def unpack_kv_bundle(buf):
     per = int(np.prod(shape)) * dtype.itemsize
     sper, sshape, sb = 0, None, 0
     if not quant and dtype == np.int8:
-        # raw int8 codes in a v1 float bundle are scale-less garbage —
-        # a quantized sender that lost its scales, never a legal wire
-        raise KVWireError("v1 float bundle carries int8 K/V — "
-                          "quantized bundles must be v2 with scales")
+        # raw int8 codes in a float-layout bundle are scale-less garbage
+        # — a quantized sender that lost its scales, never a legal wire
+        raise KVWireError("float-layout bundle carries int8 K/V — "
+                          "quantized bundles must carry scales")
     if quant:
         if dtype != np.int8:
             raise KVWireError(
@@ -236,6 +256,13 @@ def unpack_kv_bundle(buf):
     meta = header.get("meta", {})
     if quant:
         meta = dict(meta, quantized=True)
+    rng_h = header.get("rng")
+    if rng_h is not None:
+        try:
+            meta = dict(meta, rng=(int(rng_h["seed"]), int(rng_h["gen"])))
+        except (KeyError, TypeError, ValueError) as e:
+            raise KVWireError(f"bundle rng field malformed: {e}") \
+                from None
     return ks, vs, meta
 
 

@@ -1,0 +1,677 @@
+"""Pipeline-parallel serving over a (tp, pp) mesh (ISSUE 13).
+
+Tensor parallelism (tp.py) stops scaling when ONE host's HBM cannot
+hold even its 1/tp shard of the weights next to a useful KV pool — the
+reference's Fleet stack answers with the second mesh axis: pipeline
+parallelism. This module is the serving half of that answer, reusing
+the two conventions the training stack already proved:
+
+  - the STAGE SPLIT is `text.models.gpt.gpt_pipeline_stages` — the
+    LayerDesc/`ernie_pipeline_descs` convention (embed | blocks | head,
+    tied embedding resident on first AND last stage like a
+    SharedLayerDesc), partitioned uniformly like
+    `fleet.meta_parallel.PipelineLayer`;
+  - the TICK SCHEDULE is `parallel.pipeline_schedule` — the same
+    static-table machinery that drives the compiled 1F1B trainer, minus
+    the backward half (`build_serving_tables`).
+
+Topology: `pp * tp` devices; stage s owns devices [s*tp, (s+1)*tp) as
+its own 1-D 'mp' mesh. WITHIN a stage everything is exactly tp.py —
+weights sharded by their `split_axis` annotations, the stage's KV pool
+sharded over heads, outputs pinned with `with_sharding_constraint` so
+each stage executable compiles EXACTLY once. ACROSS stages the only
+traffic is the [microbatch, 1, H] hidden activation (decode) or the
+[1, chunk, H] prefill chunk — `jax.device_put` onto the next stage's
+mesh is the stage boundary, and the `serving.pp_handoff` fault site
+fires on every hop.
+
+DECODE is a ring over the slot microbatches: slots split into M
+contiguous microbatches, and one `decode()` call runs the
+`build_serving_tables(M, pp)` schedule — microbatch g enters stage 0 at
+tick g, rides one hop per tick, and its sampled/greedy token exits the
+last stage pp-1 ticks later. After the fill every stage works every
+tick (steady-state, bubble-free); only the fill/drain triangles idle,
+so the call's bubble fraction is (pp-1)/(M+pp-1), exported as
+`serving_pp_bubble_fraction` (+ per-stage `serving_pp_stage_busy`) and
+failure-class gated by tools/metrics_report.py. Every slot still
+advances exactly one token per decode() — the scheduler contract is
+unchanged, and token-exactness vs the single-device paged engine is
+inherited (same ops, same order, per-slot rows are batch-independent).
+
+PREFILL is microbatched THROUGH the stages the same way: the padded
+suffix splits into fixed-size chunks (`prefill_chunk`; default one
+chunk = the bucket), chunk c enters stage 0 at tick c — the forward
+half of 1F1B — writing each stage's K/V slice into that stage's
+resident pool as it passes. The first token taps the final chunk's
+last-stage hidden through a tiny head executable.
+
+The per-slot state the block math needs (tables, positions, allocator,
+prefix cache) is HOST state shared by all stages — block ids mean the
+same thing in every stage's pool, so handoff/adopt/hot-swap/int8
+compose per stage: `extract_kv`/`adopt_kv` walk the stages' layer
+slices in model order (wire format unchanged), `swap_params` re-places
+each stage's params on its own mesh, and kv_dtype/weight_dtype="int8"
+quantize per stage exactly as on one device.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import functional_call, functional_state
+from ...observability import faults as _faults
+from ...observability import metrics as _metrics
+from ...parallel import pipeline_schedule as _psched
+from ...profiler import RecordEvent, TracerEventType
+from .. import blocks
+from ..engine import PagedEngineConfig, PagedGenerationEngine
+from .tp import param_partition_specs, quant_scale_sharding
+
+__all__ = ["PipelineParallelEngineConfig", "PipelineParallelPagedEngine"]
+
+_M_BUBBLE = _metrics.gauge(
+    "serving_pp_bubble_fraction",
+    "Idle fraction of the pipeline-serving tick schedule since engine "
+    "start (fill/drain triangles over all decode/prefill rotations; "
+    "0 = every stage worked every tick). Growth is failure-class in "
+    "tools/metrics_report.py --compare")
+_M_STAGE_BUSY = _metrics.gauge(
+    "serving_pp_stage_busy",
+    "Per-stage busy fraction of the pipeline-serving tick schedule "
+    "since engine start",
+    labelnames=("stage",))
+
+
+class PipelineParallelEngineConfig(PagedEngineConfig):
+    """PagedEngineConfig plus the (tp, pp) mesh shape.
+
+    pp: pipeline stages (>= 2; pp=1 is just the paged/TP engine).
+    tp: tensor degree WITHIN each stage (num_heads must divide by it).
+    decode_microbatches: slot groups riding the decode ring (must
+      divide `slots`; default pp — more microbatches shrink the
+      per-call bubble as (pp-1)/(M+pp-1)).
+    prefill_chunk: tokens per pipelined prefill chunk (None = one chunk
+      per suffix bucket — the unchunked ladder; a fixed chunk size
+      collapses the per-stage prefill executables to ONE each).
+    stage_layers: explicit per-stage block counts (default: the uniform
+      PipelineLayer split)."""
+
+    def __init__(self, pp=2, tp=1, decode_microbatches=None,
+                 prefill_chunk=None, stage_layers=None, **kwargs):
+        super().__init__(**kwargs)
+        self.pp = int(pp)
+        self.tp = int(tp)
+        if self.pp < 2:
+            raise ValueError(f"pp must be >= 2 (got {pp}); a one-stage "
+                             f"pipeline is the paged/tp engine")
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if decode_microbatches:
+            self.decode_microbatches = int(decode_microbatches)
+            if self.slots % self.decode_microbatches:
+                raise ValueError(
+                    f"decode_microbatches={self.decode_microbatches} "
+                    f"must divide slots={self.slots}")
+        else:
+            # default: the largest divisor of slots within the stage
+            # count — always valid, bubble-minimal for the slot shape
+            self.decode_microbatches = max(
+                d for d in range(1, min(self.pp, self.slots) + 1)
+                if self.slots % d == 0)
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1 or None")
+        self.stage_layers = tuple(int(x) for x in stage_layers) \
+            if stage_layers else None
+
+    _DICT_FIELDS = PagedEngineConfig._DICT_FIELDS + (
+        "pp", "tp", "decode_microbatches", "prefill_chunk",
+        "stage_layers")
+
+
+class _Stage:
+    """Per-stage placement record: the GPTStage module, its 'mp' mesh,
+    placed params/buffers (+ the int8 decode set), its resident KV pool
+    slice, and the stage-local -> global param-name map."""
+    __slots__ = ("module", "mesh", "replicated", "pool_sharding",
+                 "scale_sharding", "param_shardings", "params",
+                 "buffers", "decode_params", "pool", "name_map",
+                 "layers")
+
+
+class PipelineParallelPagedEngine(PagedGenerationEngine):
+    """PagedGenerationEngine partitioned into pipeline stages over a
+    (tp, pp) device grid. Public contract unchanged (prefill / decode /
+    adopt / extract / reset / swap, compile-once trace counters — now
+    PER STAGE under `decode_pp` / `prefill_pp` / `adopt_pp`); block
+    accounting is host-side and shared across stages."""
+
+    def __init__(self, model, config=None, **kwargs):
+        config = config or PipelineParallelEngineConfig(**kwargs)
+        if not isinstance(config, PipelineParallelEngineConfig):
+            raise TypeError("PipelineParallelPagedEngine needs a "
+                            "PipelineParallelEngineConfig")
+        devices = jax.devices()
+        if config.pp * config.tp > len(devices):
+            raise ValueError(
+                f"(tp={config.tp}) x (pp={config.pp}) needs "
+                f"{config.pp * config.tp} devices, have {len(devices)}")
+        if model.cfg.num_heads % config.tp:
+            raise ValueError(
+                f"tp={config.tp} must divide num_heads="
+                f"{model.cfg.num_heads} (heads are the sharded axis)")
+        if model.cfg.num_layers < config.pp:
+            raise ValueError(
+                f"pp={config.pp} exceeds num_layers="
+                f"{model.cfg.num_layers}")
+        super().__init__(model, config)
+        self.trace_counts["decode_pp"] = {}
+        self.trace_counts["prefill_pp"] = {}
+        self.trace_counts["adopt_pp"] = {}
+        self._stage_decode = [self._make_stage_decode(s)
+                              for s in range(config.pp)]
+        self._stage_prefill = {}      # (stage, chunk_len) -> cached fn
+        self._pp_head = {}            # chunk_len -> cached head fn
+        self._pp_adopt = {}           # (stage, bucket) -> cached fn
+
+    # -- placement ------------------------------------------------------------
+    def _alloc_state(self):
+        from ...text.models.gpt import gpt_pipeline_stages
+        cfg = self._model.cfg
+        c = self.config
+        devices = jax.devices()
+        modules = gpt_pipeline_stages(self._model, c.pp,
+                                      stage_layers=c.stage_layers)
+        self._stages = []
+        for s, mod in enumerate(modules):
+            st = _Stage()
+            st.module = mod
+            st.layers = mod.stop - mod.start
+            st.mesh = Mesh(np.asarray(devices[s * c.tp:(s + 1) * c.tp]),
+                           ("mp",))
+            st.replicated = NamedSharding(st.mesh, P())
+            st.pool_sharding = NamedSharding(st.mesh,
+                                             P(None, None, "mp", None))
+            st.scale_sharding = NamedSharding(st.mesh, P(None, "mp"))
+            # stage-local functional names -> global model names (the
+            # swap/quantization join): blocks re-index by the stage's
+            # start offset, the tied head matrix IS wte.weight
+            st.name_map = {}
+            for name in functional_state(mod)[0]:
+                if name.startswith("blocks."):
+                    i, rest = name[len("blocks."):].split(".", 1)
+                    st.name_map[name] = f"blocks.{mod.start + int(i)}.{rest}"
+                elif name.startswith("head_wte."):
+                    st.name_map[name] = "wte." + name[len("head_wte."):]
+                else:
+                    st.name_map[name] = name
+            self._stages.append(st)
+        self._place_stage_params()
+        # the master param copy stays HOST-resident: it is the
+        # hot-swap validation record, not serving state — per-device
+        # HBM accounting must see only the per-stage placed shards
+        self._params = {k: np.asarray(jax.device_get(v))
+                        for k, v in self._params.items()}
+        heads, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        for st in self._stages:
+            raw = blocks.alloc_quant_pools(
+                st.layers, c.num_blocks, c.block_size, heads, hd) \
+                if self.kv_quantized else blocks.alloc_pools(
+                    st.layers, c.num_blocks, c.block_size, heads, hd)
+            st.pool = tuple(type(l)(
+                *(jax.device_put(x, st.pool_sharding if x.ndim == 4
+                                 else st.scale_sharding) for x in l))
+                for l in raw)
+        self._alloc_host_state()
+        # tick/bubble accounting across the engine lifetime
+        self._pp_ticks = 0
+        self._pp_busy = np.zeros((c.pp,), np.int64)
+        self._decode_tbl = _psched.build_serving_tables(
+            c.decode_microbatches, c.pp)
+
+    def _place_stage_params(self):
+        """(Re-)place every stage's float params + buffers on its mesh
+        from the master copy — at build and after every hot-swap."""
+        for st in self._stages:
+            specs = param_partition_specs(st.module)
+            st.param_shardings = {
+                name: NamedSharding(st.mesh, specs.get(name, P()))
+                for name in st.name_map}
+            st.params = {
+                name: jax.device_put(self._params[st.name_map[name]],
+                                     st.param_shardings[name])
+                for name in st.name_map}
+            fs_buffers = functional_state(st.module)[1]
+            st.buffers = {name: jax.device_put(arr, st.replicated)
+                          for name, arr in fs_buffers.items()}
+
+    def _build_decode_params(self):
+        """Per-stage decode param sets: identity (float) or the int8
+        codes+scales re-expression, placed on the stage's mesh with the
+        scale vector following the split only when the channel axis IS
+        the sharded axis (the tp.py rule, per stage)."""
+        self._decode_params = {}      # unused: decode() is per-stage
+        for st in getattr(self, "_stages", ()):
+            if self.config.weight_dtype != "int8":
+                st.decode_params = st.params
+                continue
+            from ..engine import _quantize_weight
+            out = {}
+            for name, arr in st.params.items():
+                axis = self._weight_quant_axis(st.name_map[name], arr)
+                if axis is None:
+                    out[name] = arr
+                    continue
+                codes, s_b = _quantize_weight(arr, axis)
+                sharding = st.param_shardings[name]
+                out[name] = {
+                    "q": jax.device_put(codes, sharding),
+                    "scale": jax.device_put(s_b, quant_scale_sharding(
+                        st.mesh, sharding, axis, s_b.ndim))}
+            st.decode_params = out
+
+    def _place_param(self, name, arr):
+        """The swapped-in master copy stays HOST-resident: staging the
+        whole float model through one device would defeat the
+        bigger-than-one-host claim exactly in the swap window. Stage
+        placement happens in `_after_param_swap`, device by device."""
+        return np.asarray(arr)
+
+    def _after_param_swap(self):
+        self._place_stage_params()
+        self._build_decode_params()
+
+    @property
+    def _pool(self):
+        """The whole-model pool view, stage slices in layer order —
+        what the extract/handoff paths walk. Read-only: every writer in
+        this engine commits to `self._stages[s].pool` instead."""
+        return tuple(l for st in self._stages for l in st.pool)
+
+    def _weight_sources(self):
+        """Per-stage placed params only: the host master copy is the
+        swap-validation record, not device state (the base walk also
+        skips numpy leaves by construction)."""
+        return [src for st in self._stages
+                for src in (st.params, st.decode_params)]
+
+    # -- stage forward --------------------------------------------------------
+    def _run_stage(self, st, params, pool, tables, pos, x, op,
+                   valid=None):
+        """functional_call of one GPTStage over raw arrays -> (out,
+        new stage pool). `params` may be the int8 decode set (dequant
+        at trace time, like the single-device engine)."""
+        cache = blocks.PagedDecodeCache(
+            tuple(type(l)(*(Tensor(a) for a in l)) for l in pool),
+            Tensor(tables), Tensor(pos),
+            None if valid is None else Tensor(valid))
+        out, _ = functional_call(
+            st.module, self._dequant_params(params), st.buffers,
+            args=(Tensor(x),),
+            kwargs={"cache": cache, "pos": cache.pos,
+                    "tables": cache.tables, "valid": cache.valid,
+                    "op": op}, train=False)
+        y, new_layers = out
+        return y._data, tuple(type(l)(*(a._data for a in l))
+                              for l in new_layers)
+
+    def _constrain_stage(self, st, pool):
+        return tuple(type(l)(
+            *(jax.lax.with_sharding_constraint(
+                x, st.pool_sharding if x.ndim == 4 else st.scale_sharding)
+              for x in l)) for l in pool)
+
+    # -- decode: ONE executable PER STAGE ------------------------------------
+    def _make_stage_decode(self, s):
+        st = self._stages[s]
+        last = st.module.is_last
+
+        if not last:
+            def fn(params, pool, tables, pos, x):
+                self.trace_counts["decode_pp"][s] = \
+                    self.trace_counts["decode_pp"].get(s, 0) + 1
+                y, npool = self._run_stage(st, params, pool, tables,
+                                           pos, x, op="block")
+                y = jax.lax.with_sharding_constraint(y, st.replicated)
+                return y, self._constrain_stage(st, npool)
+            return self._cached(fn, f"decode_stage[{s}]")
+
+        def fn(params, pool, tables, pos, x, key, *rng):
+            self.trace_counts["decode_pp"][s] = \
+                self.trace_counts["decode_pp"].get(s, 0) + 1
+            logits, npool = self._run_stage(st, params, pool, tables,
+                                           pos, x, op="block_head")
+            nxt = self._select_slots(logits[:, 0, :], key, *rng)
+            npool = self._constrain_stage(st, npool)
+            if self.config.capture_logits:
+                return nxt, npool, logits[:, 0, :]
+            return nxt, npool
+        return self._cached(fn, f"decode_stage[{s}]")
+
+    def decode(self):
+        """Advance every slot one token by running the M-microbatch
+        serving ring through the pp stages (module docstring). Returns
+        np.int32 [slots] exactly like the single-device engine."""
+        _faults.fire("serving.decode_step")
+        self._fire_kv_quant_chaos()
+        self.ensure_decode_capacity()
+        c = self.config
+        M = c.decode_microbatches
+        mbs = c.slots // M
+        tbl = self._decode_tbl
+        tokens = self._last_tokens
+        key = self._next_key()
+        hidden = [None] * M
+        out_tokens = np.zeros((c.slots,), np.int32)
+        out_nxt = [None] * M
+        out_logits = [None] * M
+        # tables/pos are immutable for the whole call: upload each
+        # microbatch's slices ONCE, not once per (tick, stage) — each
+        # mb runs pp stages, so this saves (pp-1)/pp of the transfers
+        # on the per-token hot path
+        mb_slices = [(jnp.asarray(self._tables[g * mbs:(g + 1) * mbs]),
+                      jnp.asarray(self._pos[g * mbs:(g + 1) * mbs]))
+                     for g in range(M)]
+        with RecordEvent("serving::decode_step",
+                         TracerEventType.UserDefined,
+                         {"slots": c.slots, "paged": True, "pp": c.pp,
+                          "tp": c.tp, "microbatches": M,
+                          "kv_dtype": c.kv_dtype,
+                          "attend": c.attention_impl}), \
+                blocks.attention_impl(c.attention_impl):
+            for t in range(tbl.shape[0]):
+                for s in range(c.pp):
+                    g = int(tbl[t, s])
+                    if g < 0:
+                        continue
+                    st = self._stages[s]
+                    lo, hi = g * mbs, (g + 1) * mbs
+                    mb_tables, mb_pos = mb_slices[g]
+                    if st.module.is_first:
+                        x = jnp.asarray(tokens[lo:hi].reshape(mbs, 1))
+                    else:
+                        # the stage boundary: the chaos site fires, then
+                        # the activation moves onto this stage's mesh
+                        _faults.fire("serving.pp_handoff")
+                        x = jax.device_put(hidden[g], st.replicated)
+                    self._pp_busy[s] += 1
+                    if st.module.is_last:
+                        args = [st.decode_params, st.pool, mb_tables,
+                                mb_pos, x, key]
+                        if self._sampling:
+                            args += [jnp.asarray(self._slot_seeds[lo:hi]),
+                                     jnp.asarray(self._slot_gen[lo:hi])]
+                        res = self._stage_decode[s](*args)
+                        if c.capture_logits:
+                            nxt, npool, lg = res
+                            out_logits[g] = lg
+                        else:
+                            nxt, npool = res
+                        # keep the token arrays ON DEVICE until the ring
+                        # drains: converting here would sync the host
+                        # every tick and serialize exactly the
+                        # cross-stage overlap the ring exists for
+                        out_nxt[g] = nxt
+                    else:
+                        hidden[g], npool = self._stage_decode[s](
+                            st.decode_params, st.pool, mb_tables,
+                            mb_pos, x)
+                    st.pool = npool
+                self._pp_ticks += 1
+        for g in range(M):
+            out_tokens[g * mbs:(g + 1) * mbs] = np.asarray(out_nxt[g],
+                                                           np.int32)
+        self._pos = np.minimum(self._pos + 1,
+                               c.max_len - 1).astype(np.int32)
+        self._slot_gen += 1
+        if c.capture_logits:
+            self.last_logits = np.concatenate(
+                [np.asarray(l, np.float32) for l in out_logits], axis=0)
+        self._export_pp_stats()
+        self._last_tokens = out_tokens.copy()
+        return out_tokens
+
+    def _fire_kv_quant_chaos(self):
+        """The serving.kv_quant site over per-stage pools: corrupt one
+        in-use block's scale row of stage 0's first resident layer."""
+        if not self.kv_quantized:
+            return
+        spec = _faults.fire("serving.kv_quant")
+        if spec is None or spec.mode != "truncate":
+            return
+        victim = next((int(b) for b in range(1, self.block_pool.num_blocks)
+                       if self.block_pool.refcount(b) > 0), None)
+        if victim is None:
+            return
+        st = self._stages[0]
+        layer = st.pool[0]
+        st.pool = (type(layer)(
+            layer.k, layer.v,
+            layer.k_scale.at[victim].mul(64.0),
+            layer.v_scale.at[victim].mul(64.0)),) + st.pool[1:]
+
+    # -- prefill: chunks pipelined through the stages -------------------------
+    def _make_stage_prefill(self, s, chunk):
+        st = self._stages[s]
+        nb = self.config.max_blocks_per_slot
+
+        def fn(params, pool, tables, slot, x, start, valid):
+            key = (s, chunk)
+            self.trace_counts["prefill_pp"][key] = \
+                self.trace_counts["prefill_pp"].get(key, 0) + 1
+            slot = slot.astype(jnp.int32)
+            row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
+            y, npool = self._run_stage(st, params, pool, row,
+                                       start[None], x, op="block",
+                                       valid=valid[None])
+            y = jax.lax.with_sharding_constraint(y, st.replicated)
+            return y, self._constrain_stage(st, npool)
+        return self._cached(fn, f"prefill_stage[{s}][{chunk}]")
+
+    def _make_pp_head(self, chunk):
+        st = self._stages[-1]
+
+        def fn(params, hidden, idx, key):
+            tag = ("head", chunk)
+            self.trace_counts["prefill_pp"][tag] = \
+                self.trace_counts["prefill_pp"].get(tag, 0) + 1
+            logits, _ = functional_call(
+                st.module, params, st.buffers, args=(Tensor(hidden),),
+                kwargs={"op": "head"}, train=False)
+            last = jax.lax.dynamic_index_in_dim(logits._data[0], idx,
+                                                keepdims=False)
+            return self._select(last[None, :], key)[0]
+        return self._cached(fn, f"prefill_head[{chunk}]")
+
+    def _prefill_execute(self, slot, padded, length, start, bucket):
+        """The pipelined prefill: pad the suffix to whole chunks, run
+        only the chunks carrying real tokens, and stream them through
+        the stages on the forward-1F1B tick table — chunk c enters
+        stage 0 at tick c while chunk c-1 runs stage 1. Each hop fires
+        `serving.pp_handoff`; K/V lands in each stage's own pool as the
+        chunk passes. Returns the first token from the head tap over
+        the final chunk's last-stage hidden."""
+        c = self.config
+        chunk = min(c.prefill_chunk or bucket, bucket)
+        n_run = max(1, -(-length // chunk))
+        ids = np.zeros((n_run * chunk,), np.int32)
+        n_copy = min(padded.shape[0], n_run * chunk)
+        ids[:n_copy] = padded[:n_copy]
+        tbl = _psched.build_serving_tables(n_run, c.pp)
+        tables = jnp.asarray(self._tables)
+        slot_j = jnp.asarray(slot, jnp.int32)
+        hidden = [None] * n_run
+        for t in range(tbl.shape[0]):
+            for s in range(c.pp):
+                g = int(tbl[t, s])
+                if g < 0:
+                    continue
+                st = self._stages[s]
+                if (s, chunk) not in self._stage_prefill:
+                    self._stage_prefill[(s, chunk)] = \
+                        self._make_stage_prefill(s, chunk)
+                start_g = start + g * chunk
+                valid_g = int(np.clip(length - g * chunk, 0, chunk))
+                if st.module.is_first:
+                    x = jnp.asarray(
+                        ids[g * chunk:(g + 1) * chunk][None, :])
+                else:
+                    _faults.fire("serving.pp_handoff")
+                    x = jax.device_put(hidden[g], st.replicated)
+                self._pp_busy[s] += 1
+                hidden[g], npool = self._stage_prefill[(s, chunk)](
+                    st.params, st.pool, tables, slot_j, x,
+                    jnp.asarray(start_g, jnp.int32),
+                    jnp.asarray(valid_g, jnp.int32))
+                st.pool = npool
+            self._pp_ticks += 1
+        if chunk not in self._pp_head:
+            self._pp_head[chunk] = self._make_pp_head(chunk)
+        idx = (length - 1) - (n_run - 1) * chunk
+        first = self._pp_head[chunk](
+            self._stages[-1].params, hidden[n_run - 1],
+            jnp.asarray(idx, jnp.int32), self._slot_key(slot))
+        self._pos[slot] = start + length
+        self._export_pp_stats()
+        return int(first)
+
+    # -- KV adopt (multi-host handoff sink), per stage ------------------------
+    def _adopt_scatter(self, slot, bucket, pad_ks, pad_vs):
+        off = 0
+        for s, st in enumerate(self._stages):
+            n = st.layers
+            if (s, bucket) not in self._pp_adopt:
+                self._pp_adopt[(s, bucket)] = \
+                    self._make_stage_adopt(s, bucket)
+            st.pool = self._pp_adopt[(s, bucket)](
+                st.pool, jnp.asarray(self._tables),
+                jnp.asarray(slot, jnp.int32),
+                pad_ks[off:off + n], pad_vs[off:off + n])
+            off += n
+
+    def _make_stage_adopt(self, s, bucket):
+        st = self._stages[s]
+        nb = self.config.max_blocks_per_slot
+
+        def adopt_fn(pool, tables, slot, new_ks, new_vs):
+            key = (s, bucket)
+            self.trace_counts["adopt_pp"][key] = \
+                self.trace_counts["adopt_pp"].get(key, 0) + 1
+            slot = slot.astype(jnp.int32)
+            row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
+            zero = jnp.zeros((1,), jnp.int32)
+            npool = []
+            for layer, k, v in zip(pool, new_ks, new_vs):
+                if hasattr(layer, "k_scale"):
+                    kq, ksc = blocks.quant_write(layer.k, layer.k_scale,
+                                                 k[None], row, zero)
+                    vq, vsc = blocks.quant_write(layer.v, layer.v_scale,
+                                                 v[None], row, zero)
+                    npool.append(blocks.QuantPagedLayerKV(kq, vq, ksc,
+                                                          vsc))
+                else:
+                    npool.append(blocks.PagedLayerKV(
+                        blocks.write(layer.k, k[None], row, zero),
+                        blocks.write(layer.v, v[None], row, zero)))
+            return self._constrain_stage(st, tuple(npool))
+        return self._cached(adopt_fn, f"adopt_stage[{s}][{bucket}]")
+
+    # -- observability / introspection ----------------------------------------
+    def _export_pp_stats(self):
+        stats = self.pp_stats()
+        _M_BUBBLE.set(stats["bubble_fraction"])
+        for s, b in enumerate(stats["stage_busy"]):
+            _M_STAGE_BUSY.labels(stage=str(s)).set(b)
+
+    def pp_stats(self):
+        """Lifetime tick accounting: {bubble_fraction, stage_busy[s],
+        ticks} — what the gauges, the scheduler step records, and
+        serve_report's per-stage column carry."""
+        t = max(self._pp_ticks, 1)
+        busy = [float(b) / t for b in self._pp_busy]
+        work = int(self._pp_busy.sum())
+        return {"ticks": int(self._pp_ticks),
+                "stage_busy": busy,
+                "bubble_fraction":
+                    float(1.0 - work / (t * self.config.pp))}
+
+    def stage_report(self):
+        """Per-stage placement proof: layer range, devices, and the
+        heads each device holds of that stage's layer-0 K pool."""
+        out = []
+        for st in self._stages:
+            shards = st.pool[0].k.addressable_shards
+            out.append({
+                "layers": [st.module.start, st.module.stop],
+                "devices": sorted(str(d) for d in st.mesh.devices.flat),
+                "heads_per_device": {str(s.device): int(s.data.shape[2])
+                                     for s in shards}})
+        return out
+
+    # -- AOT warmup ------------------------------------------------------------
+    def executable_names(self):
+        c = self.config
+        names = [f"decode_stage[{s}]" for s in range(c.pp)]
+        for b in c.prefill_buckets:
+            chunk = min(c.prefill_chunk or b, b)
+            names += [f"prefill_stage[{s}][{chunk}]"
+                      for s in range(c.pp)]
+            names.append(f"prefill_head[{chunk}]")
+        return sorted(set(names))
+
+    def precompile(self):
+        """AOT-build the per-stage executable set (decode ring + every
+        bucket's prefill chunk set + the head taps)."""
+        c = self.config
+        mbs = c.slots // c.decode_microbatches
+        H = self._model.cfg.hidden_size
+        key = self._warm_key()
+        out = {}
+        with blocks.attention_impl(c.attention_impl):
+            for s, st in enumerate(self._stages):
+                mb_tables = jnp.asarray(self._tables[:mbs])
+                mb_pos = jnp.asarray(self._pos[:mbs])
+                if st.module.is_first:
+                    x = jnp.zeros((mbs, 1), jnp.int32)
+                else:
+                    x = jax.device_put(jnp.zeros((mbs, 1, H), jnp.float32),
+                                       st.replicated)
+                if st.module.is_last:
+                    args = [st.decode_params, st.pool, mb_tables, mb_pos,
+                            x, key]
+                    if self._sampling:
+                        args += [jnp.zeros((mbs,), jnp.uint32),
+                                 jnp.zeros((mbs,), jnp.int32)]
+                    out[f"decode_stage[{s}]"] = \
+                        self._stage_decode[s].warm(*args)
+                else:
+                    out[f"decode_stage[{s}]"] = self._stage_decode[s].warm(
+                        st.decode_params, st.pool, mb_tables, mb_pos, x)
+            for b in c.prefill_buckets:
+                chunk = min(c.prefill_chunk or b, b)
+                for s, st in enumerate(self._stages):
+                    if (s, chunk) not in self._stage_prefill:
+                        self._stage_prefill[(s, chunk)] = \
+                            self._make_stage_prefill(s, chunk)
+                    if st.module.is_first:
+                        x = jnp.zeros((1, chunk), jnp.int32)
+                    else:
+                        x = jax.device_put(
+                            jnp.zeros((1, chunk, H), jnp.float32),
+                            st.replicated)
+                    out[f"prefill_stage[{s}][{chunk}]"] = \
+                        self._stage_prefill[(s, chunk)].warm(
+                            st.params, st.pool, jnp.asarray(self._tables),
+                            jnp.asarray(0, jnp.int32), x,
+                            jnp.asarray(0, jnp.int32),
+                            jnp.asarray(1, jnp.int32))
+                if chunk not in self._pp_head:
+                    self._pp_head[chunk] = self._make_pp_head(chunk)
+                out[f"prefill_head[{chunk}]"] = self._pp_head[chunk].warm(
+                    self._stages[-1].params,
+                    jax.device_put(jnp.zeros((1, chunk, H), jnp.float32),
+                                   self._stages[-1].replicated),
+                    jnp.asarray(0, jnp.int32), key)
+        return out

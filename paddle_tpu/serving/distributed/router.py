@@ -18,9 +18,21 @@ decode pools. Per request it:
      SIGKILLed host), every request it carried restarts on a live
      worker recompute-style: prompt + tokens-received-so-far becomes
      the restart prompt (the PR 6 preemption rule, lifted across
-     hosts), so under greedy decoding the delivered stream completes
-     BIT-IDENTICALLY to an unkilled run. `serving_failover_total`
-     counts the events (failure-class in metrics_report).
+     hosts), so the delivered stream completes BIT-IDENTICALLY to an
+     unkilled run — under greedy decoding AND (ISSUE 13) under
+     temperature>0 sampling: every placement carries the request's
+     stable `rng_seed` plus the delivered-token count, and token n
+     always samples with fold_in(key(seed), n) whatever host runs it.
+     `serving_failover_total` counts the events (failure-class in
+     metrics_report).
+
+Worker GROUPS (ISSUE 13): each decode endpoint is one worker *group* —
+a process serving its whole (tp, pp) device grid (tensor-parallel
+and/or pipeline-parallel engine over that host's local devices; STAT
+reports the shape under "parallel"). Placement, polling, and failover
+are group-granular: a SIGKILL anywhere in a group (a middle pipeline
+stage included) takes the whole group dark, and its requests restart on
+a healthy group with bit-identical streams.
 
 Trace stitching: run the frontend under a profiler window (or a
 `tracecontext.trace_scope`) and every verb frame carries the trace id;
@@ -34,6 +46,7 @@ import json
 import os
 import threading
 import time
+import zlib
 
 from ...distributed.ps import rpc as _rpc
 from ...observability import metrics as _metrics
@@ -74,20 +87,24 @@ class ServingShardClient(_rpc.ShardClientBase):
             return obj_out
         return self._exchange(i, msg, reader)
 
-    def prefill(self, i, key, prompt, decode_endpoint=None):
+    def prefill(self, i, key, prompt, decode_endpoint=None,
+                rng_seed=None, rng_gen=0):
         return self._call(i, OP_PREFILL, {
             "key": key, "prompt": [int(t) for t in prompt],
-            "decode_endpoint": decode_endpoint})
+            "decode_endpoint": decode_endpoint,
+            "rng_seed": rng_seed, "rng_gen": int(rng_gen)})
 
     def kv_put(self, i, key, bundle):
         return self._call(i, OP_KV_PUT, {"key": key}, tail=bundle)
 
     def submit(self, i, key, prompt, max_new=None, priority="standard",
-               timeout_s=None, use_staged=False):
+               timeout_s=None, use_staged=False, rng_seed=None,
+               rng_gen=0):
         return self._call(i, OP_SUBMIT, {
             "key": key, "prompt": [int(t) for t in prompt],
             "max_new": max_new, "priority": priority,
-            "timeout_s": timeout_s, "use_staged": bool(use_staged)})
+            "timeout_s": timeout_s, "use_staged": bool(use_staged),
+            "rng_seed": rng_seed, "rng_gen": int(rng_gen)})
 
     def poll(self, i, keys):
         return self._call(i, OP_POLL, {"keys": list(keys)})
@@ -117,12 +134,20 @@ class DistRequest:
 
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new, priority, timeout_s=None):
+    def __init__(self, prompt, max_new, priority, timeout_s=None,
+                 rng_seed=None):
         self.key = f"r{next(self._ids)}.{os.getpid()}"
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.priority = priority
         self.timeout_s = timeout_s
+        # the request's sampler seed (ISSUE 13): STABLE across every
+        # placement — original, preempt restart, failover restart — so
+        # a temperature>0 stream replays bit-identically wherever it
+        # lands. Derived from the wire key when not supplied; callers
+        # comparing against an out-of-process oracle pass it explicitly.
+        self.rng_seed = int(rng_seed) if rng_seed is not None \
+            else (zlib.crc32(self.key.encode()) & 0x7FFFFFFF)
         self.status = QUEUED
         self.error = None
         self.worker = None           # decode shard index currently serving
@@ -234,17 +259,19 @@ class DistFrontend:
                 i = self._prefill_rr % len(self.prefill.endpoints)
                 self._prefill_rr += 1
             try:
-                reply = self.prefill.prefill(i, req._wire_key,
-                                             exec_prompt,
-                                             decode_endpoint=target)
+                reply = self.prefill.prefill(
+                    i, req._wire_key, exec_prompt,
+                    decode_endpoint=target, rng_seed=req.rng_seed,
+                    rng_gen=len(req.tokens))
                 return True, float(reply.get("handoff_s") or 0.0)
             except (_rpc.PSUnavailableError, _rpc.PSServerError):
                 continue             # next prefill worker, else fallback
         return False, 0.0
 
     def submit(self, prompt, max_new=16, priority="standard",
-               timeout_s=None):
-        req = DistRequest(prompt, max_new, priority, timeout_s=timeout_s)
+               timeout_s=None, rng_seed=None):
+        req = DistRequest(prompt, max_new, priority, timeout_s=timeout_s,
+                          rng_seed=rng_seed)
         self._place(req)                 # RPCs happen OUTSIDE the lock
         with self._lock:
             self._inflight[req.key] = req
@@ -283,10 +310,14 @@ class DistFrontend:
                     req.trail.append(_rt.PH_KV_HANDOFF, t1 - h, t1)
                 place_from = t1
             try:
+                # rng_gen = tokens already DELIVERED: the worker samples
+                # this placement's first token at that stream position,
+                # so a temperature>0 failover restart replays exactly
                 self.decode.submit(
                     decode_i, req._wire_key, exec_prompt,
                     max_new=remaining, priority=req.priority,
-                    timeout_s=req.timeout_s, use_staged=staged)
+                    timeout_s=req.timeout_s, use_staged=staged,
+                    rng_seed=req.rng_seed, rng_gen=len(req.tokens))
             except _rpc.PSUnavailableError:
                 now = time.monotonic()
                 req.trail.append(_rt.PH_PLACE, place_from, now)

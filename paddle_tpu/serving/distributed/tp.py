@@ -1,4 +1,4 @@
-"""Tensor-parallel paged decode: the serving engine over a device mesh.
+"""Tensor-parallel paged serving: prefill AND decode over a device mesh.
 
 One chip's HBM bounds both the weights and the KV pool a paged engine
 can hold; tensor parallelism splits BOTH over a mesh's 'mp' axis the
@@ -15,12 +15,16 @@ Megatron mp_layers):
     memory — the serving-side win;
   - block tables, positions and tokens stay replicated (tiny int32).
 
-The decode step itself is the SAME traced program as the single-device
+Decode AND prefill are the SAME traced programs as the single-device
 paged engine (`functional_call` over the same Layer forward — token
 exactness is inherited, not re-proven), partitioned by XLA's SPMD
 partitioner from the input shardings, with `with_sharding_constraint`
 pinning every new-pool output to the heads-sharded layout (the
-`_constrain_pools` hook). Pinning outputs is what preserves the
+`_constrain_pools` hook — the per-bucket prefill executables pin their
+output pools exactly like decode, so prefill K/V lands straight in the
+head-sharded blocks and the per-chip prefill FLOPs drop tp× with the
+column/row weight splits; ISSUE 13 asserts this with a prefill-only
+shard check). Pinning outputs is what preserves the
 compile-exactly-once invariant on a mesh: unpinned outputs could come
 back with a drifted sharding, and re-feeding them would change the
 input shardings — a silent retrace. The per-op collectives (all-reduce
@@ -28,10 +32,17 @@ after attention out-proj and MLP fc2, the Megatron pattern) are
 inserted by the partitioner along the same 'mp' axis the hand-written
 training collectives use.
 
+HBM accounting caveat (ISSUE 13): with `weight_dtype="int8"` the int8
+decode set shards next to the FLOAT set — prefill keeps serving the
+float shards, so per-device weight bytes are float_shard + int8_shard
+(~1.25× the float shard), NOT a quarter. `hbm_accounting()` measures
+the true footprint from the arrays' actual shards; equal-HBM bench
+arms must size against it, never against dtype-width arithmetic.
+
 CPU-testable: the tests run on the 8 virtual host devices
 (`--xla_force_host_platform_device_count`), asserting token-exact
-streams vs the single-device paged engine, a decode trace count of 1,
-and genuinely partitioned pool shards.
+streams vs the single-device paged engine, per-executable trace counts
+of 1, and genuinely partitioned pool shards after prefill alone.
 """
 import numpy as np
 
@@ -42,7 +53,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engine import PagedEngineConfig, PagedGenerationEngine
 
 __all__ = ["TensorParallelEngineConfig", "TensorParallelPagedEngine",
-           "param_partition_specs"]
+           "param_partition_specs", "quant_scale_sharding"]
 
 
 class TensorParallelEngineConfig(PagedEngineConfig):
@@ -57,6 +68,19 @@ class TensorParallelEngineConfig(PagedEngineConfig):
             raise ValueError(f"tp must be >= 1, got {tp}")
 
     _DICT_FIELDS = PagedEngineConfig._DICT_FIELDS + ("tp",)
+
+
+def quant_scale_sharding(mesh, sharding, axis, scale_ndim):
+    """THE int8 scale-sharding rule, shared by the TP and PP engines:
+    the per-channel scale vector follows its weight's split only when
+    the channel axis IS the sharded axis (qkv/fc1 column splits, the
+    wte vocab split); row-parallel weights keep replicated scales —
+    every shard holds all output channels."""
+    split = sharding.spec[axis] if axis < len(sharding.spec) else None
+    sparts = [None] * scale_ndim
+    if split is not None:
+        sparts[axis] = split
+    return NamedSharding(mesh, P(*sparts))
 
 
 def param_partition_specs(model):
@@ -154,13 +178,9 @@ class TensorParallelPagedEngine(PagedGenerationEngine):
         channels."""
         sharding = self._param_shardings.get(
             name, NamedSharding(self._mesh, P()))
-        split = sharding.spec[axis] if axis < len(sharding.spec) else None
-        sparts = [None] * scale_b.ndim
-        if split is not None:
-            sparts[axis] = split
         return {"q": jax.device_put(codes, sharding),
-                "scale": jax.device_put(
-                    scale_b, NamedSharding(self._mesh, P(*sparts)))}
+                "scale": jax.device_put(scale_b, quant_scale_sharding(
+                    self._mesh, sharding, axis, scale_b.ndim))}
 
     # -- introspection (what the tests assert) -------------------------------
     @property

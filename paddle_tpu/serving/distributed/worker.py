@@ -220,9 +220,18 @@ class ServingWorker:
         if cached is not None:               # retried PREFILL: replay
             return _kv.pack_payload(dict(cached, cached=True))
         prompt = [int(t) for t in obj["prompt"]]
+        # per-request sampler state (ISSUE 13): the router pins the
+        # request's seed + delivered count, so this prefill's first
+        # token is generation index `rng_gen` of THAT stream — and the
+        # bundle ships the post-first-token state for the adopter
+        rng = None
+        if obj.get("rng_seed") is not None:
+            rng = (int(obj["rng_seed"]), int(obj.get("rng_gen") or 0))
         with self._lock:
             slot = 0                          # one prefill at a time
-            first = self.engine.prefill(slot, prompt)
+            first = self.engine.prefill(slot, prompt, rng=rng)
+            bundle_rng = self.engine.slot_rng(slot) \
+                if rng is not None else None
             # quantization-aware: a kv_dtype="int8" engine ships the
             # int8 codes + per-block scales (a v2 bundle, ~1/4 the
             # bytes); float engines ship the v1 layout unchanged
@@ -244,7 +253,8 @@ class ServingWorker:
                 meta={"key": key, "plen": plen, "first_token": int(first)},
                 k_scales=wire.get("k_scales"),
                 v_scales=wire.get("v_scales"),
-                scale_block=wire.get("scale_block"))
+                scale_block=wire.get("scale_block"),
+                rng=bundle_rng)
             t0 = time.perf_counter()
             scope = _tc.trace_scope(rctx[0]) if rctx is not None else None
             try:
@@ -290,12 +300,18 @@ class ServingWorker:
                     ks, vs, meta = staged
                     staged_kv = (ks, vs, int(meta.get("plen", len(ks[0]))),
                                  int(meta.get("first_token", 0)))
+                    if meta.get("rng") is not None:
+                        # a v3 bundle: the prefill host's post-first-
+                        # token sampler state rides into adoption
+                        staged_kv += (tuple(meta["rng"]),)
             handle = self.scheduler.submit(
                 [int(t) for t in obj["prompt"]],
                 max_new_tokens=obj.get("max_new"),
                 timeout_s=obj.get("timeout_s"),
                 priority=obj.get("priority", "standard"),
-                staged_kv=staged_kv)
+                staged_kv=staged_kv,
+                rng_seed=obj.get("rng_seed"),
+                rng_gen=int(obj.get("rng_gen") or 0))
             self._requests[key] = handle
             self._trim_requests()
         return _kv.pack_payload({"ok": 1,
@@ -370,6 +386,7 @@ class ServingWorker:
         deployment shape (module docstring); tests hosting several
         workers in one process share these figures."""
         flat = _metrics.flatten_snapshot(_metrics.registry().snapshot())
+        ecfg = self.engine.config
         out = {"role": self.role, "version": self.version,
                "endpoint": self.endpoint,
                "kv_memory_tokens": getattr(self.engine,
@@ -378,7 +395,14 @@ class ServingWorker:
                                            "kv_usable_tokens", 0),
                "handoff_bytes": int(flat.get(
                    "serving_kv_handoff_bytes_total", 0)),
+               # the worker GROUP's parallel shape (ISSUE 13): one
+               # process = one (tp, pp) group over its local devices
+               "parallel": {"tp": int(getattr(ecfg, "tp", 1)),
+                            "pp": int(getattr(ecfg, "pp", 1))},
                "trace_counts": _jsonable(self.engine.trace_counts)}
+        pp_stats = getattr(self.engine, "pp_stats", None)
+        if pp_stats is not None:
+            out["pp_stats"] = _jsonable(pp_stats())
         pool = getattr(self.engine, "block_pool", None)
         if pool is not None:
             out["blocks_in_use"] = pool.in_use

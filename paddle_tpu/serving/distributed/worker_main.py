@@ -29,7 +29,9 @@ def main(argv=None):
     p.add_argument("--role", choices=("decode", "prefill"),
                    default="decode")
     p.add_argument("--engine", default="paged",
-                   help="serving engine kind: dense|paged|spec|tp")
+                   help="serving engine kind: dense|paged|spec|tp|pp "
+                        "(tp/pp serve this process's whole local device "
+                        "grid — one process = one worker GROUP)")
     p.add_argument("--model", default="gpt_tiny",
                    help="model factory name in paddle_tpu.text.models")
     p.add_argument("--seed", type=int, default=2024,

@@ -149,6 +149,17 @@ class GenerationEngine:
         self._params, self._buffers = functional_state(model)
         self._rng = jax.random.key(self.config.seed)
         self._last_tokens = np.zeros((self.config.slots,), np.int32)
+        # per-slot sampler RNG (ISSUE 13): slot s's n-th generated token
+        # samples with fold_in(key(seed_s), n) — a pure function of the
+        # REQUEST's (seed, generation index), never of the slot index,
+        # the co-resident batch, or engine history. That is what makes a
+        # sampled stream replayable on another slot, another engine, or
+        # another host (the v3 KV-handoff RNG field): feed the same
+        # (seed, gen) and the continuation is bit-identical. `_slot_gen`
+        # holds the generation index of each slot's NEXT token.
+        self._slot_seeds = np.zeros((self.config.slots,), np.uint32)
+        self._slot_gen = np.zeros((self.config.slots,), np.int32)
+        self._rng_nonce = 0
         # trace counters: the python bodies below run ONLY when jax traces,
         # so these counts are the number of compilations, not of calls.
         # A warm persistent-cache load DESERIALIZES the executable and
@@ -225,10 +236,10 @@ class GenerationEngine:
             temperature=c.temperature, top_k=c.top_k, top_p=c.top_p)
 
     # -- decode: ONE executable --------------------------------------------
-    def _decode_fn(self, params, gk, gv, pos, tokens, key):
+    def _decode_fn(self, params, gk, gv, pos, tokens, key, *rng):
         self.trace_counts["decode"] += 1     # trace-time only
         logits, nk, nv = self._run_model(params, gk, gv, pos, tokens[:, None])
-        nxt = self._select(logits[:, 0, :], key)
+        nxt = self._select_slots(logits[:, 0, :], key, *rng)
         # free slots keep decoding garbage harmlessly; clamp so their
         # position (and the wpe lookup) stays in-bounds forever
         return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
@@ -275,6 +286,69 @@ class GenerationEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    # -- per-slot sampler RNG (ISSUE 13) -------------------------------------
+    @property
+    def _sampling(self):
+        return self.config.decode_strategy == "sampling"
+
+    def _default_slot_seed(self):
+        """Deterministic per-placement default when the caller carries no
+        RNG state (single-engine serving, bundles without the v3 field):
+        derived from the engine seed and a per-engine nonce, so replays
+        of one engine are reproducible but two engines never correlate
+        — and a failover without explicit state stays greedy-only."""
+        self._rng_nonce += 1
+        return np.uint32((self.config.seed * 2654435761
+                          + self._rng_nonce * 40503) & 0x7FFFFFFF)
+
+    def set_slot_rng(self, slot, seed, gen):
+        """Arm slot's sampler state: its next token is generation index
+        `gen` of the request seeded `seed`."""
+        self._slot_seeds[int(slot)] = np.uint32(seed)
+        self._slot_gen[int(slot)] = np.int32(gen)
+
+    def slot_rng(self, slot):
+        """(seed, gen) with gen = the generation index of the slot's
+        NEXT token — exactly what a KV-handoff bundle must carry for the
+        adopting host to continue a sampled stream bit-identically."""
+        return (int(self._slot_seeds[int(slot)]),
+                int(self._slot_gen[int(slot)]))
+
+    def _slot_key(self, slot):
+        """Host-side key for the slot's next token — the same
+        fold_in(key(seed), gen) expression the decode executable
+        computes in-trace, so prefill (restart) and decode (original)
+        sample generation index n identically."""
+        slot = int(slot)
+        return jax.random.fold_in(
+            jax.random.key(jnp.uint32(self._slot_seeds[slot])),
+            int(self._slot_gen[slot]))
+
+    def _rng_args(self):
+        """Extra decode-executable inputs for the sampling strategy:
+        per-slot seeds + generation counters (empty for greedy — the
+        greedy executables keep their PR 3 signature and caches)."""
+        if not self._sampling:
+            return ()
+        return (jnp.asarray(self._slot_seeds), jnp.asarray(self._slot_gen))
+
+    def _select_slots(self, logits, key, seeds=None, gen=None):
+        """Per-slot token selection: greedy (or a legacy shared-key
+        call) routes through `_select`; sampling derives each row's key
+        from its own (seed, gen) so the pick depends only on the
+        request's stream position and its logits row."""
+        if seeds is None or not self._sampling:
+            return self._select(logits, key)
+        c = self.config
+
+        def one(row, s, n):
+            k = jax.random.fold_in(jax.random.key(s), n)
+            return sampling.select_tokens(
+                row[None], key=k, strategy="sampling",
+                temperature=c.temperature, top_k=c.top_k,
+                top_p=c.top_p)[0]
+        return jax.vmap(one)(logits, seeds, gen)
+
     def _warm_key(self):
         """A key with `_next_key`'s aval for AOT warmup — warmup must not
         consume the engine's RNG stream (token streams stay identical
@@ -302,7 +376,8 @@ class GenerationEngine:
         key = self._warm_key()
         out = {"decode": self._decode.warm(
             self._params, gk, gv, pos,
-            jnp.zeros((self.config.slots,), jnp.int32), key)}
+            jnp.zeros((self.config.slots,), jnp.int32), key,
+            *self._rng_args())}
         for b in self.config.prefill_buckets:
             if b not in self._prefill:
                 self._prefill[b] = self._make_prefill(b)
@@ -312,9 +387,11 @@ class GenerationEngine:
         return out
 
     # -- public compute API -------------------------------------------------
-    def prefill(self, slot, prompt_ids):
+    def prefill(self, slot, prompt_ids, rng=None):
         """Write `prompt_ids` (1-D ints) into `slot`'s cache rows; returns
-        the first generated token (host int)."""
+        the first generated token (host int). `rng=(seed, gen)` arms the
+        slot's per-request sampler state (the first token is generation
+        index `gen`); None draws a fresh deterministic seed at gen 0."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -323,6 +400,9 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt length {prompt.size} leaves no decode headroom "
                 f"(max_len={self.config.max_len})")
+        seed, gen = rng if rng is not None \
+            else (self._default_slot_seed(), 0)
+        self.set_slot_rng(slot, seed, gen)
         bucket = self.bucket_for(prompt.size)
         padded = np.zeros((bucket,), np.int32)
         padded[:prompt.size] = prompt
@@ -336,8 +416,9 @@ class GenerationEngine:
                 [l.v for l in self._cache.layers],
                 self._cache.pos, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(padded), jnp.asarray(prompt.size, jnp.int32),
-                self._next_key())
+                self._slot_key(slot))
         self._set_cache(gk, gv, pos)
+        self._slot_gen[int(slot)] += 1
         first = int(first)
         self._last_tokens[int(slot)] = np.int32(first)
         return first
@@ -357,8 +438,9 @@ class GenerationEngine:
             nxt, gk, gv, pos = self._decode(
                 self._decode_params, [l.k for l in self._cache.layers],
                 [l.v for l in self._cache.layers], self._cache.pos,
-                jnp.asarray(tokens), self._next_key())
+                jnp.asarray(tokens), self._next_key(), *self._rng_args())
         self._set_cache(gk, gv, pos)
+        self._slot_gen += 1
         out = np.asarray(nxt, np.int32)
         self._last_tokens = out.copy()
         return out
@@ -396,7 +478,10 @@ class GenerationEngine:
             arr = new_params[name]
             if isinstance(arr, Tensor):
                 arr = arr._data
-            arr = jnp.asarray(arr)
+            # validate on the RAW array: placement belongs to
+            # _place_param, so an engine whose master copy is
+            # host-resident (pipeline-parallel) never routes the whole
+            # float model through the default device in the swap window
             if tuple(arr.shape) != tuple(old.shape):
                 raise ValueError(
                     f"swap param {name!r} shape {tuple(arr.shape)} != "
@@ -406,7 +491,8 @@ class GenerationEngine:
                 arr = arr.astype(old.dtype)   # ckpt round-trips may widen
             staged[name] = self._place_param(name, arr)
         # materialize before commit so a device placement error cannot
-        # surface lazily from inside a later decode step
+        # surface lazily from inside a later decode step (host-resident
+        # leaves pass through untouched)
         jax.block_until_ready(list(staged.values()))
         self._params = staged                  # the commit point
         self._after_param_swap()
@@ -414,8 +500,10 @@ class GenerationEngine:
 
     def _place_param(self, name, arr):
         """Device placement hook for swapped-in params — the TP engine
-        overrides to re-apply each param's mesh sharding."""
-        return arr
+        overrides to re-apply each param's mesh sharding; the PP engine
+        keeps the master copy on HOST (stage placement happens in
+        `_after_param_swap`, never through one device)."""
+        return jnp.asarray(arr)
 
     def reset_slot(self, slot):
         """Mark a slot free: pos=0 so stale K/V rows are invisible."""
@@ -424,6 +512,7 @@ class GenerationEngine:
         self._cache = kvc.DecodeCache(self._cache.layers,
                                       jnp.asarray(pos))
         self._last_tokens[int(slot)] = np.int32(0)
+        self.set_slot_rng(slot, 0, 0)
 
     def slot_positions(self):
         return np.asarray(self._cache.pos, np.int32)
@@ -450,6 +539,76 @@ class GenerationEngine:
         """Token capacity of the KV memory this engine reserves — the
         budget figure the load harness equalizes across layouts."""
         return self.config.slots * self.config.max_len
+
+    # -- per-device HBM accounting (ISSUE 13) --------------------------------
+    def _weight_sources(self):
+        """The param dicts whose arrays count as resident weight state
+        — the engines override to add/replace sources (the speculative
+        draft set, the pipeline stages' placed shards)."""
+        return [self._params, getattr(self, "_decode_params", None) or {}]
+
+    def _weight_arrays(self):
+        """Every RESIDENT weight array this engine keeps on device —
+        including both the float set (prefill always serves it) AND the
+        int8 decode set when weight_dtype="int8". That double residency
+        is the honest accounting the equal-HBM bench arms must use:
+        int8 decode weights do NOT shrink the per-device weight bill to
+        a quarter — the float shards stay for prefill, so the bill is
+        float_shard + int8_shard (~1.25x the float shard). Identity-
+        shared arrays (spec's truncated draft, decode==params) count
+        once; quant entries contribute codes AND scales."""
+        seen, out = set(), []
+        for src in self._weight_sources():
+            for v in src.values():
+                for arr in ((v["q"], v["scale"]) if isinstance(v, dict)
+                            else (v,)):
+                    if isinstance(arr, np.ndarray):
+                        continue      # host-resident master copies
+                    if id(arr) not in seen:
+                        seen.add(id(arr))
+                        out.append(arr)
+        return out
+
+    def _kv_arrays(self):
+        """Every resident KV-memory array (dense cache buffers here;
+        the paged engines override with their pools + scales)."""
+        return [x for l in self._cache.layers for x in (l.k, l.v)]
+
+    def hbm_accounting(self):
+        """Measured per-device byte footprint of the resident serving
+        state, from the arrays' actual shards (`addressable_shards`) —
+        never from dtype-width arithmetic. Returns {"per_device":
+        {device: {"weights", "kv", "total"}}, "max_device_total",
+        "weights_total", "kv_total"} — `max_device_total` is the
+        per-host HBM figure the equal-HBM bench comparisons equalize
+        (and what "a model bigger than one host" is measured against).
+
+        Scope caveat: the figure covers ENGINE-owned state. The eager
+        source Layer's own parameter arrays (materialized at model
+        build, typically on the default device, and kept alive by the
+        Layer for hot-swap/training callers) are NOT counted — on a
+        real bigger-than-one-host pp deployment the worker must build
+        the model host-side or free the eager device copies, which is
+        the open ROADMAP item 4 deployment note."""
+        per = {}
+
+        def add(arr, kind):
+            for s in arr.addressable_shards:
+                d = per.setdefault(str(s.device),
+                                   {"weights": 0, "kv": 0})
+                d[kind] += int(s.data.nbytes)
+        for arr in self._weight_arrays():
+            add(jnp.asarray(arr), "weights")
+        for arr in self._kv_arrays():
+            add(jnp.asarray(arr), "kv")
+        for d in per.values():
+            d["total"] = d["weights"] + d["kv"]
+        return {
+            "per_device": per,
+            "max_device_total": max((d["total"] for d in per.values()),
+                                    default=0),
+            "weights_total": sum(d["weights"] for d in per.values()),
+            "kv_total": sum(d["kv"] for d in per.values())}
 
 
 class PagedEngineConfig(EngineConfig):
@@ -559,6 +718,15 @@ class PagedGenerationEngine(GenerationEngine):
                 cfg.num_layers, c.num_blocks, c.block_size, cfg.num_heads,
                 cfg.hidden_size // cfg.num_heads,
                 self._params["wte.weight"].dtype)
+        self._alloc_host_state()
+
+    def _alloc_host_state(self):
+        """The mesh-oblivious host half of the paged state: per-slot
+        positions/tables/activity, the block allocator, and the prefix
+        cache. Factored out so the pipeline-parallel engine (which owns
+        per-STAGE device pools) reuses it verbatim — block tables and
+        the allocator are shared across stages by construction."""
+        c = self.config
         # pos lives host-side (np): the block math (ensure_slot_capacity,
         # once per slot per decode step) must not pay a device fetch each
         # read — ONE transfer per decode/prefill return refreshes it
@@ -687,6 +855,9 @@ class PagedGenerationEngine(GenerationEngine):
         """Allocatable capacity: the reserve minus the garbage block."""
         return (self.config.num_blocks - 1) * self.config.block_size
 
+    def _kv_arrays(self):
+        return [x for layer in self._pool for x in layer]
+
     # -- AOT warmup ----------------------------------------------------------
     def precompile(self):
         """Paged-engine warmup. The attention-impl trace context must
@@ -700,7 +871,8 @@ class PagedGenerationEngine(GenerationEngine):
         with blocks.attention_impl(self.config.attention_impl):
             out["decode"] = self._decode.warm(
                 self._decode_params, self._pool, tables, pos,
-                jnp.zeros((self.config.slots,), jnp.int32), key)
+                jnp.zeros((self.config.slots,), jnp.int32), key,
+                *self._rng_args())
             for b in self.config.prefill_buckets:
                 if b not in self._prefill:
                     self._prefill[b] = self._make_prefill(b)
@@ -731,12 +903,12 @@ class PagedGenerationEngine(GenerationEngine):
                       for l in new_cache.layers))
 
     # -- decode: ONE executable ---------------------------------------------
-    def _decode_fn(self, params, pool, tables, pos, tokens, key):
+    def _decode_fn(self, params, pool, tables, pos, tokens, key, *rng):
         self.trace_counts["decode"] += 1     # trace-time only
         logits, npool = self._run_model_paged(
             self._dequant_params(params), pool, tables, pos,
             tokens[:, None])
-        nxt = self._select(logits[:, 0, :], key)
+        nxt = self._select_slots(logits[:, 0, :], key, *rng)
         npool = self._constrain_pools(npool)
         new_pos = jnp.minimum(pos + 1, self.config.max_len - 1)
         if self.config.capture_logits:
@@ -769,12 +941,15 @@ class PagedGenerationEngine(GenerationEngine):
         return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     # -- public compute API --------------------------------------------------
-    def prefill(self, slot, prompt_ids):
+    def prefill(self, slot, prompt_ids, rng=None):
         """Place `prompt_ids` into `slot`: match the prefix cache, alloc
         private blocks for the remainder, run the SUFFIX through the
         bucket executable (writes scatter into this slot's blocks), and
         return the first generated token. `last_prefill_stats` records
-        the prefix hit for the scheduler's request metrics."""
+        the prefix hit for the scheduler's request metrics. `rng=(seed,
+        gen)` arms the slot's per-request sampler state — the first
+        token is generation index `gen` (a restart's delivered-token
+        count), so a sampled stream resumes bit-identically."""
         slot = int(slot)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -805,26 +980,23 @@ class PagedGenerationEngine(GenerationEngine):
         row[len(shared_ids):len(shared_ids) + n_priv] = priv
         self._tables[slot] = row
         self._slot_active[slot] = True
+        seed, gen = rng if rng is not None \
+            else (self._default_slot_seed(), 0)
+        self.set_slot_rng(slot, seed, gen)
 
         suffix = prompt[nshared:]
         bucket = self.bucket_for(suffix.size)
         padded = np.zeros((bucket,), np.int32)
         padded[:suffix.size] = suffix
-        if bucket not in self._prefill:
-            self._prefill[bucket] = self._make_prefill(bucket)
         with RecordEvent("serving::prefill", TracerEventType.UserDefined,
                          {"bucket": bucket, "length": plen,
                           "slot": slot, "prefix_hit_tokens": nshared,
                           "paged": True, "kv_dtype": self.config.kv_dtype,
                           "attend": self.config.attention_impl}), \
                 blocks.attention_impl(self.config.attention_impl):
-            first, pool, pos = self._prefill[bucket](
-                self._params, self._pool, jnp.asarray(self._tables),
-                jnp.asarray(self._pos), jnp.asarray(slot, jnp.int32),
-                jnp.asarray(padded), jnp.asarray(suffix.size, jnp.int32),
-                jnp.asarray(nshared, jnp.int32), self._next_key())
-        self._pool = pool
-        self._pos = np.array(pos, np.int32)   # owned, writable copy
+            first = self._prefill_execute(slot, padded, int(suffix.size),
+                                          nshared, bucket)
+        self._slot_gen[slot] += 1
         if self.prefix_cache is not None:
             # the prompt's fully-written blocks become shareable; the
             # matched prefix chain is already registered (touch only)
@@ -836,6 +1008,22 @@ class PagedGenerationEngine(GenerationEngine):
         first = int(first)
         self._last_tokens[slot] = np.int32(first)
         return first
+
+    def _prefill_execute(self, slot, padded, length, start, bucket):
+        """Run the suffix through the bucket executable and commit the
+        new pool/pos — the one device step of `prefill`, hook-shaped so
+        the pipeline-parallel engine can stream the suffix through its
+        stages in chunks instead. Returns the first token (host int)."""
+        if bucket not in self._prefill:
+            self._prefill[bucket] = self._make_prefill(bucket)
+        first, pool, pos = self._prefill[bucket](
+            self._params, self._pool, jnp.asarray(self._tables),
+            jnp.asarray(self._pos), jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded), jnp.asarray(length, jnp.int32),
+            jnp.asarray(start, jnp.int32), self._slot_key(slot))
+        self._pool = pool
+        self._pos = np.array(pos, np.int32)   # owned, writable copy
+        return int(first)
 
     def decode(self):
         """Advance every slot one token; returns np.int32 [slots]. Active
@@ -855,7 +1043,7 @@ class PagedGenerationEngine(GenerationEngine):
             res = self._decode(
                 self._decode_params, self._pool, jnp.asarray(self._tables),
                 jnp.asarray(self._pos), jnp.asarray(tokens),
-                self._next_key())
+                self._next_key(), *self._rng_args())
         if self.config.capture_logits:
             nxt, pool, pos, logits = res
             self.last_logits = np.asarray(logits, np.float32)
@@ -863,6 +1051,7 @@ class PagedGenerationEngine(GenerationEngine):
             nxt, pool, pos = res
         self._pool = pool
         self._pos = np.array(pos, np.int32)   # owned, writable copy
+        self._slot_gen += 1
         out = np.asarray(nxt, np.int32)
         self._last_tokens = out.copy()
         return out
@@ -955,7 +1144,7 @@ class PagedGenerationEngine(GenerationEngine):
         return {"ks": ks, "vs": vs, "plen": plen, "k_scales": kss,
                 "v_scales": vss, "scale_block": self.config.block_size}
 
-    def adopt_kv(self, slot, ks, vs, plen, first_token):
+    def adopt_kv(self, slot, ks, vs, plen, first_token, rng=None):
         """The handoff SINK half: place a request whose prefill ran on
         ANOTHER host. Allocates the blocks `plen` tokens need, scatters
         the per-layer K/V slices into them through one fixed-shape
@@ -963,8 +1152,12 @@ class PagedGenerationEngine(GenerationEngine):
         so adoption compiles at most `len(buckets)` times, ever), and
         arms the slot exactly as a local prefill would: pos=plen, next
         decode input = `first_token` (the token the prefill host already
-        emitted). Raises BlockAllocError under pressure — the
-        scheduler's cue to preempt, like prefill."""
+        emitted). `rng=(seed, gen)` is the v3 bundle's sampler state —
+        the adopting slot's next token is generation index `gen`, so a
+        sampled stream continues bit-identically across the handoff;
+        None (v1/v2 bundles) arms a fresh local seed: greedy-only
+        failover, as before ISSUE 13. Raises BlockAllocError under
+        pressure — the scheduler's cue to preempt, like prefill."""
         slot = int(slot)
         plen = int(plen)
         cfg = self._model.cfg
@@ -1004,28 +1197,38 @@ class PagedGenerationEngine(GenerationEngine):
             pv[:plen] = np.asarray(v, dtype)
             pad_ks.append(jnp.asarray(pk))
             pad_vs.append(jnp.asarray(pv))
-        if bucket not in self._adopt:
-            self._adopt[bucket] = self._make_adopt(bucket)
         try:
             with RecordEvent("serving::adopt_kv",
                              TracerEventType.UserDefined,
                              {"slot": slot, "tokens": plen,
                               "bucket": bucket, "blocks": n}), \
                     blocks.attention_impl(self.config.attention_impl):
-                npool = self._adopt[bucket](
-                    self._pool, jnp.asarray(self._tables),
-                    jnp.asarray(slot, jnp.int32), pad_ks, pad_vs)
+                self._adopt_scatter(slot, bucket, pad_ks, pad_vs)
         except Exception:
             self.reset_slot(slot)           # never strand the blocks
             raise
-        self._pool = npool
         self._pos[slot] = plen
         self._last_tokens[slot] = np.int32(first_token)
+        if rng is not None:
+            self.set_slot_rng(slot, rng[0], rng[1])
+        else:
+            self.set_slot_rng(slot, self._default_slot_seed(), 0)
         self.last_prefill_stats = {"prefix_hit_tokens": 0,
                                    "blocks_allocated": n,
                                    "suffix_bucket": bucket,
                                    "adopted": True}
         return int(first_token)
+
+    def _adopt_scatter(self, slot, bucket, pad_ks, pad_vs):
+        """Run the adopt executable(s) and commit the new pool(s) — the
+        one device step of `adopt_kv`, hook-shaped so the
+        pipeline-parallel engine can scatter each stage's layer slices
+        into that stage's own resident pool."""
+        if bucket not in self._adopt:
+            self._adopt[bucket] = self._make_adopt(bucket)
+        self._pool = self._adopt[bucket](
+            self._pool, jnp.asarray(self._tables),
+            jnp.asarray(slot, jnp.int32), pad_ks, pad_vs)
 
     def _make_adopt(self, bucket):
         """One fixed-shape KV-adopt executable per bucket: scatter the
@@ -1070,6 +1273,7 @@ class PagedGenerationEngine(GenerationEngine):
         self._slot_active[slot] = False
         self._pos[slot] = 0
         self._last_tokens[slot] = np.int32(0)
+        self.set_slot_rng(slot, 0, 0)
 
     def slot_positions(self):
         return self._pos.copy()
@@ -1084,16 +1288,20 @@ def default_compile_cache_dir(path):
 
 
 def _engine_kind(config):
-    """"dense" | "paged" | "spec" | "tp" for an EngineConfig-family
-    instance (most-derived class first). The TP check consults
-    sys.modules instead of importing: a TensorParallelEngineConfig can
-    only exist if its module was already imported, so classifying a
-    plain dense/paged/spec config never pulls the multi-host tier in
-    (the lazy-import contract of serving/distributed/)."""
+    """"dense" | "paged" | "spec" | "tp" | "pp" for an EngineConfig-
+    family instance (most-derived class first). The TP/PP checks consult
+    sys.modules instead of importing: those config classes can only
+    exist if their module was already imported, so classifying a plain
+    dense/paged/spec config never pulls the multi-host tier in (the
+    lazy-import contract of serving/distributed/)."""
     import sys
     from .spec_decode import SpecDecodeConfig
     if isinstance(config, SpecDecodeConfig):
         return "spec"
+    pp_mod = sys.modules.get("paddle_tpu.serving.distributed.pp")
+    if pp_mod is not None and \
+            isinstance(config, pp_mod.PipelineParallelEngineConfig):
+        return "pp"
     tp_mod = sys.modules.get("paddle_tpu.serving.distributed.tp")
     if tp_mod is not None and \
             isinstance(config, tp_mod.TensorParallelEngineConfig):
@@ -1109,7 +1317,7 @@ def _engine_kind(config):
 def make_engine(model, kind, config_dict, compile_cache_dir=None):
     """Rebuild an engine from a `.gencfg` serving record: the recorded
     ctor kwargs plus a machine-local compile-cache dir. Only an
-    explicit kind="tp" pays the multi-host tier import."""
+    explicit kind="tp"/"pp" pays the multi-host tier import."""
     from .spec_decode import SpecDecodeConfig, SpeculativeEngine
     classes = {"dense": (GenerationEngine, EngineConfig),
                "paged": (PagedGenerationEngine, PagedEngineConfig),
@@ -1119,9 +1327,14 @@ def make_engine(model, kind, config_dict, compile_cache_dir=None):
                                      TensorParallelPagedEngine)
         classes["tp"] = (TensorParallelPagedEngine,
                          TensorParallelEngineConfig)
+    if kind == "pp":
+        from .distributed.pp import (PipelineParallelEngineConfig,
+                                     PipelineParallelPagedEngine)
+        classes["pp"] = (PipelineParallelPagedEngine,
+                         PipelineParallelEngineConfig)
     if kind not in classes:
         raise ValueError(f"unknown serving engine kind {kind!r}; "
-                         f"want one of {sorted(classes) + ['tp']}")
+                         f"want one of {sorted(classes) + ['tp', 'pp']}")
     engine_cls, cfg_cls = classes[kind]
     cfg = cfg_cls(compile_cache_dir=compile_cache_dir, **config_dict)
     return engine_cls(model, cfg)

@@ -174,13 +174,20 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens, deadline, submitted_at,
-                 priority=1):
+                 priority=1, rng_seed=None, rng_gen=0):
         self.id = next(Request._ids)
         self.prompt = list(prompt)        # ORIGINAL prompt, never mutated
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline          # absolute clock value or None
         self.submitted_at = submitted_at
         self.priority = int(priority)
+        # per-request sampler RNG (ISSUE 13): generation index n samples
+        # with fold_in(key(rng_seed), rng_gen + n) whatever slot/engine/
+        # host runs it. rng_gen > 0 means tokens 0..rng_gen-1 were
+        # already delivered elsewhere (a router failover restart) and
+        # this request's prompt carries them.
+        self.rng_seed = rng_seed          # filled by the scheduler
+        self.rng_gen = int(rng_gen)
         self.status = QUEUED
         self.tokens = []                  # generated tokens, stream order
         self.error = None                 # cause string for status ERROR
@@ -341,24 +348,41 @@ class Scheduler:
         if not self._metrics_f:
             return
         cfg = self.engine.config
-        self._metrics_f.write(json.dumps({
+        rec = {
             "kind": "run",
             "kv_dtype": getattr(cfg, "kv_dtype", "float32"),
-            "weight_dtype": getattr(cfg, "weight_dtype", "float32")})
-            + "\n")
+            "weight_dtype": getattr(cfg, "weight_dtype", "float32")}
+        # hybrid-parallel shape (ISSUE 13): lets serve_report label the
+        # run and render the per-stage column for pp engines
+        tp, pp = getattr(cfg, "tp", 1), getattr(cfg, "pp", 1)
+        if tp != 1 or pp != 1:
+            rec["tp"], rec["pp"] = int(tp), int(pp)
+        self._metrics_f.write(json.dumps(rec) + "\n")
         self._metrics_f.flush()
 
     # -- admission -----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_s=None,
-               priority="standard", staged_kv=None):
-        """`staged_kv=(ks, vs, plen, first_token)` places the request
-        from a handed-off KV bundle (another host already ran its
-        prefill) instead of computing prefill locally — `prompt` must
-        still be the full prompt: it is the recompute source for
+               priority="standard", staged_kv=None, rng_seed=None,
+               rng_gen=0):
+        """`staged_kv=(ks, vs, plen, first_token[, rng])` places the
+        request from a handed-off KV bundle (another host already ran
+        its prefill) instead of computing prefill locally — `prompt`
+        must still be the full prompt: it is the recompute source for
         preemption and failover restarts, and the staged bundle is
         silently dropped (local prefill resumes ownership) whenever it
         cannot be adopted — wrong length, engine without a paged pool,
-        or a bundle that fails adoption for any non-pressure reason."""
+        or a bundle that fails adoption for any non-pressure reason.
+        The optional 5th element is the bundle's (seed, gen) sampler
+        state (a v3 bundle), which adoption arms verbatim.
+
+        `rng_seed`/`rng_gen` pin the request's sampler stream (ISSUE
+        13): token n samples with fold_in(key(rng_seed), rng_gen + n),
+        so a restart carrying the same seed and the delivered count
+        continues a sampled stream bit-identically. rng_seed=None
+        derives a deterministic per-request default from the engine
+        seed and the request id — in-process replays (and preemption
+        restarts) are exact; cross-process oracles must pass the seed
+        explicitly."""
         prompt = [int(t) for t in prompt]
         now = self._clock()
         max_new = self.config.default_max_new_tokens \
@@ -373,7 +397,10 @@ class Scheduler:
             else self.config.default_timeout_s
         req = Request(prompt, max_new,
                       now + timeout if timeout is not None else None, now,
-                      priority=prio)
+                      priority=prio, rng_seed=rng_seed, rng_gen=rng_gen)
+        if req.rng_seed is None:
+            req.rng_seed = (getattr(self.engine.config, "seed", 0)
+                            * 1000003 + req.id * 7919 + 1) & 0x7FFFFFFF
         handle = RequestHandle(req, self._clock)
         if self._draining:
             self._finish(req, REJECTED, "serving.rejected")
@@ -882,10 +909,19 @@ class Scheduler:
         staged = req._staged
         if staged is None:
             req.trail.begin(_rt.PH_PREFILL, self._clock())
-            return self.engine.prefill(slot, req.exec_prompt)
+            return self._engine_prefill(slot, req)
         req.trail.begin(_rt.PH_ADOPT, self._clock())
         try:
-            first = self.engine.adopt_kv(slot, *staged)
+            # a v3 bundle's 5th element is the prefill host's post-first-
+            # token (seed, gen). An rng-less (v1/v2) bundle still arms
+            # the REQUEST's stream at gen+1 — the adopted first token's
+            # provenance is the foreign prefill (so only greedy restarts
+            # replay it exactly, the documented legacy contract), but
+            # every subsequent sample rides this request's seed instead
+            # of a throwaway engine default
+            rng = staged[4] if len(staged) > 4 else \
+                (req.rng_seed, req.rng_gen + 1)
+            first = self.engine.adopt_kv(slot, *staged[:4], rng=rng)
         except BlockAllocError:
             raise
         except Exception as e:                           # noqa: BLE001
@@ -899,11 +935,24 @@ class Scheduler:
             # the failed adoption stays visible as its own segment; the
             # recompute prefill opens a fresh one at the fallback moment
             req.trail.begin(_rt.PH_PREFILL, self._clock())
-            return self.engine.prefill(slot, req.exec_prompt)
+            return self._engine_prefill(slot, req)
         req._staged = None
         req.adopted = True
         _M_ADOPTED.inc()
         return first
+
+    def _engine_prefill(self, slot, req):
+        """Prefill with the request's sampler state at THIS placement:
+        its next token is generation index base + tokens-already-
+        delivered (preempt restarts fold the delivered run into
+        exec_prompt). Engines without per-slot RNG (minimal stubs) get
+        the plain call — the capability probe mirrors the adopt_kv
+        one."""
+        if not hasattr(self.engine, "set_slot_rng"):
+            return self.engine.prefill(slot, req.exec_prompt)
+        return self.engine.prefill(
+            slot, req.exec_prompt,
+            rng=(req.rng_seed, req.rng_gen + len(req.tokens)))
 
     def _try_place(self, slot, req):
         """Prefill `req` into `slot`. Allocation pressure preempts a
@@ -1006,10 +1055,15 @@ class Scheduler:
     def _write_step_record(self, now, active):
         if not self._metrics_f:
             return
-        self._metrics_f.write(json.dumps({
-            "kind": "step", "step": self._steps, "t": now,
-            "queue_depth": len(self._queue), "active_slots": active,
-            "tokens_generated": self._decode_tokens}) + "\n")
+        rec = {"kind": "step", "step": self._steps, "t": now,
+               "queue_depth": len(self._queue), "active_slots": active,
+               "tokens_generated": self._decode_tokens}
+        pp_stats = getattr(self.engine, "pp_stats", None)
+        if pp_stats is not None:
+            s = pp_stats()
+            rec["pp_bubble_fraction"] = round(s["bubble_fraction"], 6)
+            rec["pp_stage_busy"] = [round(b, 6) for b in s["stage_busy"]]
+        self._metrics_f.write(json.dumps(rec) + "\n")
         self._metrics_f.flush()
 
     def _build_timeline(self, req):

@@ -203,6 +203,20 @@ class SpeculativeEngine(PagedGenerationEngine):
         """A verify forward writes the whole γ+1 window per slot."""
         return self.config.gamma + 1
 
+    def _weight_sources(self):
+        """HBM accounting (ISSUE 13): the draft's weights are resident
+        too. The id-dedup in the base walk keeps truncated-draft arrays
+        that IDENTITY-share the target's (the no-second-copy contract)
+        counted once — only genuinely distinct draft buffers add."""
+        return super()._weight_sources() + [self._draft_params,
+                                            self._draft_decode_params]
+
+    def _kv_arrays(self):
+        """The draft's dense KV cache is resident serving state next to
+        the target's paged pools."""
+        return super()._kv_arrays() + \
+            [x for l in self._draft_kv for x in (l.k, l.v)]
+
     def _build_draft_decode_params(self):
         """Draft params that IDENTITY-share a target array (the
         truncated-draft no-second-copy contract) reuse the target's
@@ -334,13 +348,13 @@ class SpeculativeEngine(PagedGenerationEngine):
         return out
 
     # -- public compute API --------------------------------------------------
-    def prefill(self, slot, prompt_ids):
+    def prefill(self, slot, prompt_ids, rng=None):
         """Target prefill (prefix cache, suffix bucket, first token) plus
         the draft prefill of the FULL prompt into its dense cache — the
         draft has no prefix sharing, so its bucket is over the whole
         prompt length. Draft state moves only after the target prefill
         sticks, so an allocation failure leaves both sides untouched."""
-        first = super().prefill(slot, prompt_ids)
+        first = super().prefill(slot, prompt_ids, rng=rng)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         bucket = self.bucket_for(prompt.size)
         padded = np.zeros((bucket,), np.int32)
@@ -418,6 +432,10 @@ class SpeculativeEngine(PagedGenerationEngine):
         self._draft_pos = self._pos.copy()
         out = np.asarray(choices, np.int32)
         n_emit = np.asarray(n_acc, np.int32) + 1
+        # keep the per-slot sampler counters stream-accurate even though
+        # spec decode is greedy-only: a v3 handoff of this slot still
+        # carries the right generation index
+        self._slot_gen += n_emit
         self._last_tokens = np.asarray(last, np.int32).copy()
         self.last_spec_stats = {
             "proposed_per_slot": gamma,

@@ -1,6 +1,7 @@
 """NLP model zoo: GPT / BERT / ERNIE (TPU-native flagship models)."""
 from .gpt import (  # noqa: F401
-    GPT, GPTConfig, GPTForGeneration, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b,
+    GPT, GPTConfig, GPTForGeneration, GPTStage, gpt_pipeline_stages,
+    gpt_stage_ranges, gpt_tiny, gpt_125m, gpt_350m, gpt_1p3b,
     gpt_6p7b,
 )
 from .bert import Bert, BertConfig, BertForPretraining  # noqa: F401

@@ -232,6 +232,112 @@ class GPT(Layer):
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
 
+class GPTStage(Layer):
+    """One pipeline stage of a GPT for hybrid-parallel SERVING (ISSUE
+    13) — the `LayerDesc`/`ernie_pipeline_descs` stage-split convention
+    (embed | N blocks | head), collapsed to constructed layers sharing
+    the parent model's sublayer objects (no second weight copy at
+    build; the serving engine places each stage's params on its own
+    device group). The tied embedding plays the `SharedLayerDesc` role:
+    it appears on the FIRST stage as the input table and on the LAST as
+    the head matrix (`head_wte`) — one logical parameter, resident on
+    both stages' devices, exactly how a shared desc materializes across
+    a pipeline.
+
+    `forward(x, cache=..., pos=..., tables=..., op=...)` runs the
+    cached paged path of `GPT.forward` for this stage's slice:
+      op="block"       embed (first stage only) + this stage's blocks
+                        -> (hidden, new layer KVs)
+      op="block_head"  block + final LN + LM head -> (logits, new KVs)
+      op="head"        x is block output; final LN + head -> logits
+                        (the chunked-prefill first-token tap)
+    """
+
+    def __init__(self, gpt, start, stop):
+        super().__init__()
+        cfg = gpt.cfg
+        self.cfg = cfg
+        self.start, self.stop = int(start), int(stop)
+        self.is_first = self.start == 0
+        self.is_last = self.stop == cfg.num_layers
+        if self.is_first:
+            self.wte = gpt.wte
+            self.wpe = gpt.wpe
+            self.drop = gpt.drop
+        self.blocks = LayerList([gpt.blocks[i]
+                                 for i in range(self.start, self.stop)])
+        if self.is_last:
+            self.ln_f = gpt.ln_f
+            if cfg.tie_embeddings:
+                if not self.is_first:
+                    self.head_wte = gpt.wte    # the SharedLayerDesc tie
+            else:
+                self.lm_head = gpt.lm_head
+
+    def _head(self, x):
+        if not self.cfg.tie_embeddings:
+            return self.lm_head(x)
+        w = self.wte.weight if self.is_first else self.head_wte.weight
+        return apply_op(lambda h, wt: jnp.einsum("bsh,vh->bsv", h, wt),
+                        x, w)
+
+    def forward(self, x, cache=None, pos=None, tables=None, valid=None,
+                op="block"):
+        if op == "head":
+            return self._head(self.ln_f(x))
+        if self.is_first:
+            positions = apply_op(
+                lambda p, ids: p.astype(jnp.int32)[:, None]
+                + jnp.arange(ids.shape[1], dtype=jnp.int32), pos, x)
+            x = self.drop(self.wte(x) + self.wpe(positions))
+        new_layers = []
+        for blk, lkv in zip(self.blocks, cache.layers):
+            x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables,
+                             valid=valid)
+            new_layers.append(new_lkv)
+        if op == "block_head":
+            return self._head(self.ln_f(x)), tuple(new_layers)
+        return x, tuple(new_layers)
+
+
+def gpt_stage_ranges(num_layers, pp, stage_layers=None):
+    """Contiguous [start, stop) block ranges for `pp` stages — the
+    uniform partition `fleet.meta_parallel.PipelineLayer` applies to a
+    LayerDesc list, or an explicit per-stage layer-count override (must
+    sum to num_layers)."""
+    pp = int(pp)
+    if stage_layers is not None:
+        counts = [int(c) for c in stage_layers]
+        if len(counts) != pp or sum(counts) != num_layers \
+                or min(counts) < 1:
+            raise ValueError(
+                f"stage_layers {counts} must be {pp} positive counts "
+                f"summing to {num_layers}")
+    else:
+        if not 1 <= pp <= num_layers:
+            raise ValueError(f"pp={pp} must be in 1..num_layers="
+                             f"{num_layers}")
+        base, rem = divmod(num_layers, pp)
+        counts = [base + (1 if s < rem else 0) for s in range(pp)]
+    ranges, at = [], 0
+    for c in counts:
+        ranges.append((at, at + c))
+        at += c
+    return ranges
+
+
+def gpt_pipeline_stages(model, pp, stage_layers=None):
+    """Partition `model` (a GPT) into `pp` GPTStage layers sharing its
+    sublayer objects — what `serving.distributed.pp` places over the
+    pipeline mesh axis."""
+    stages = [GPTStage(model, a, b)
+              for a, b in gpt_stage_ranges(model.cfg.num_layers, pp,
+                                           stage_layers)]
+    for st in stages:
+        st.eval()
+    return stages
+
+
 class GPTForGeneration(Layer):
     """Autoregressive decoding head over a GPT (reference capability:
     PaddleNLP GPTForGeneration / generation_utils). `use_cache=True` runs

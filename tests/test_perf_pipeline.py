@@ -350,6 +350,29 @@ def test_metrics_compare_flags_cost_model_gap_growth(tmp_path):
     assert "gap widened" in bad.stdout
 
 
+def test_metrics_compare_flags_pp_bubble_growth(tmp_path):
+    """ISSUE 13 gate: the pipeline-serving bubble fraction GROWING past
+    the threshold is failure-class (stages started idling — schedule
+    rot or microbatch imbalance); shrinking or stable stays clean."""
+    a = _snapshot_with_gauges(gauges={"serving_pp_bubble_fraction": 0.20})
+    b = _snapshot_with_gauges(gauges={"serving_pp_bubble_fraction": 0.45})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_pp_bubble_fraction") == \
+        "pipeline-serving bubble fraction grew"
+    assert metrics_report.compare_counters(a, a) == []
+    assert metrics_report.compare_counters(b, a) == []
+    pa, pb = str(tmp_path / "pa.jsonl"), str(tmp_path / "pb.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "bubble fraction grew" in bad.stdout
+
+
 def test_metrics_compare_flags_deviceprof_regressions(tmp_path):
     """ISSUE 9 gate: the device-profile gauges are failure classes —
     total device ms/step GROWING past the threshold (the kernels got

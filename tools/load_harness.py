@@ -240,9 +240,11 @@ def _export_registry(summary):
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
                  prefix_cache=True, gamma=3, draft_layers=1,
                  attention_impl="gather", kv_dtype="float32",
-                 weight_dtype="float32"):
+                 weight_dtype="float32", tp=2, pp=2, prefill_chunk=None):
     """A serving engine of any KV/decode layout over `model`. `quant`
-    is paged with int8 KV pools AND int8 decode weights (ISSUE 11)."""
+    is paged with int8 KV pools AND int8 decode weights (ISSUE 11);
+    `tp`/`pp` are the hybrid-parallel arms (ISSUE 13) over this
+    process's local devices — `pp` takes both mesh knobs."""
     from paddle_tpu.serving import (GenerationEngine, PagedGenerationEngine,
                                     SpeculativeEngine)
     if kind == "quant":
@@ -262,8 +264,26 @@ def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
             attention_impl=attention_impl, gamma=gamma,
             draft_layers=draft_layers, kv_dtype=kv_dtype,
             weight_dtype=weight_dtype)
+    if kind == "tp":
+        from paddle_tpu.serving.distributed.tp import (
+            TensorParallelEngineConfig, TensorParallelPagedEngine)
+        return TensorParallelPagedEngine(model, TensorParallelEngineConfig(
+            tp=tp, slots=slots, max_len=max_len, block_size=block_size,
+            num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
+            attention_impl=attention_impl, kv_dtype=kv_dtype,
+            weight_dtype=weight_dtype))
+    if kind == "pp":
+        from paddle_tpu.serving.distributed.pp import (
+            PipelineParallelEngineConfig, PipelineParallelPagedEngine)
+        return PipelineParallelPagedEngine(
+            model, PipelineParallelEngineConfig(
+                pp=pp, tp=tp, prefill_chunk=prefill_chunk, slots=slots,
+                max_len=max_len, block_size=block_size,
+                num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
+                attention_impl=attention_impl, kv_dtype=kv_dtype,
+                weight_dtype=weight_dtype))
     raise ValueError(f"unknown engine kind {kind!r} "
-                     f"(want dense|paged|spec|quant)")
+                     f"(want dense|paged|spec|quant|tp|pp)")
 
 
 def run_harness(model, kind, traffic, slots, max_len, block_size=8,
@@ -271,7 +291,7 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 shed_watermark=None, virtual_step_s=None,
                 metrics_out=None, gamma=3, draft_layers=1,
                 attention_impl="gather", kv_dtype="float32",
-                weight_dtype="float32"):
+                weight_dtype="float32", tp=2, pp=2, prefill_chunk=None):
     """Build engine+scheduler, replay `traffic`, return the summary
     (annotated with the engine's KV budget and compile counters)."""
     from paddle_tpu.observability import metrics as _metrics
@@ -282,7 +302,8 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                           prefix_cache=prefix_cache, gamma=gamma,
                           draft_layers=draft_layers,
                           attention_impl=attention_impl,
-                          kv_dtype=kv_dtype, weight_dtype=weight_dtype)
+                          kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                          tp=tp, pp=pp, prefill_chunk=prefill_chunk)
     vclock = VirtualClock() if virtual_step_s is not None else None
     sched = Scheduler(engine, max_queue=max_queue,
                       shed_watermark=shed_watermark,
@@ -298,10 +319,13 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     summary["kv_dtype"] = getattr(engine.config, "kv_dtype", "float32")
     summary["weight_dtype"] = getattr(engine.config, "weight_dtype",
                                       "float32")
+    # JSON-safe: the pp engine's per-(stage, chunk) counters key on
+    # tuples — stringify inner keys so summaries serialize
     summary["trace_counts"] = {
-        k: (dict(v) if isinstance(v, dict) else v)
+        k: ({str(ik): iv for ik, iv in v.items()}
+            if isinstance(v, dict) else v)
         for k, v in engine.trace_counts.items()}
-    if kind in ("paged", "spec", "quant"):
+    if kind in ("paged", "spec", "quant", "tp", "pp"):
         summary["blocks_total"] = engine.block_pool.capacity
         pc = engine.prefix_cache
         summary["prefix_cache_blocks"] = len(pc) if pc is not None else 0
@@ -311,6 +335,15 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
         summary["spec_accepted"] = m.get("spec_accepted", 0)
         summary["spec_acceptance_rate"] = m.get("spec_acceptance_rate")
         summary["gamma"] = engine.config.gamma
+    # measured per-device HBM (ISSUE 13): what the equal-per-host-HBM
+    # bench arms equalize/gate on — never dtype-width arithmetic
+    summary["hbm_max_device_bytes"] = \
+        engine.hbm_accounting()["max_device_total"]
+    if kind in ("tp", "pp"):
+        summary["tp"] = engine.config.tp
+    if kind == "pp":
+        summary["pp"] = engine.config.pp
+        summary["pp_stats"] = engine.pp_stats()
     if metrics_out:
         _metrics.registry().write_snapshot(metrics_out)
         summary["metrics_snapshot"] = metrics_out
@@ -411,10 +444,12 @@ def quant_quality(model, slots=3, max_len=64, block_size=8,
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--engine", default="both",
-                   choices=("dense", "paged", "spec", "quant", "both",
-                            "all"),
+                   choices=("dense", "paged", "spec", "quant", "tp",
+                            "pp", "both", "all"),
                    help="'both' = dense+paged; 'all' adds the "
-                        "spec-decode and quantized arms")
+                        "spec-decode and quantized arms; tp/pp are the "
+                        "hybrid-parallel engines over this process's "
+                        "local devices (ISSUE 13)")
     p.add_argument("--model", default="gpt_tiny")
     p.add_argument("--users", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
@@ -438,6 +473,14 @@ def main(argv=None):
                    choices=("gather", "kernel"),
                    help="paged/spec attend: dense-view gather or the "
                         "Pallas in-kernel block-table walk")
+    p.add_argument("--tp", type=int, default=2,
+                   help="tensor degree of the tp/pp arms (per stage "
+                        "for pp)")
+    p.add_argument("--pp", type=int, default=2,
+                   help="pipeline stage count of the pp arm")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="pp arm: tokens per pipelined prefill chunk "
+                        "(default: one chunk per suffix bucket)")
     p.add_argument("--timeout-s", type=float, default=None)
     p.add_argument("--shed-watermark", type=int, default=None)
     p.add_argument("--virtual-step-s", type=float, default=None,
@@ -473,6 +516,7 @@ def main(argv=None):
             virtual_step_s=args.virtual_step_s,
             gamma=args.gamma, draft_layers=args.draft_layers,
             attention_impl=args.attention_impl,
+            tp=args.tp, pp=args.pp, prefill_chunk=args.prefill_chunk,
             metrics_out=args.metrics_out
             if kind == kinds[-1] else None)
     print(json.dumps(out, indent=2, sort_keys=True))

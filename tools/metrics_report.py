@@ -120,6 +120,12 @@ _GAUGE_GROW_RULES = (
     # fleet is eating its error budget faster than run A did
     (re.compile(r"serving_slo_burn(\{.*\})?$"),
      "SLO burn rate grew"),
+    # ISSUE 13: the pipeline-serving tick schedule's idle fraction
+    # growing means stages are stalling (schedule rot, microbatch
+    # imbalance) — throughput decays even while every stream stays
+    # token-exact
+    (re.compile(r"serving_pp_bubble_fraction(\{.*\})?$"),
+     "pipeline-serving bubble fraction grew"),
 )
 
 # FLIP rules (ISSUE 12): gauges judged against an ABSOLUTE line, not a

@@ -48,6 +48,9 @@ import sys
 STEP_FIELDS = {"kind": str, "step": int, "t": (int, float),
                "queue_depth": int, "active_slots": int,
                "tokens_generated": int}
+# pipeline-serving step fields (ISSUE 13): cumulative tick accounting
+# of a pipeline-parallel engine — absent on every other engine kind
+OPTIONAL_STEP_FIELDS = {"pp_bubble_fraction", "pp_stage_busy"}
 REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "prompt_len": int, "tokens": int, "priority": int,
                   "preempted": int, "prefix_hit": bool, "adopted": bool,
@@ -59,10 +62,11 @@ REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
 # vs the f32 oracle. EVERY field is optional — files written before the
 # quantized tier (no run record at all) stay gradeable.
 RUN_FIELDS = {"kind": str, "kv_dtype": str, "weight_dtype": str,
+              "tp": int, "pp": int,
               "quant_greedy_match": (int, float, type(None)),
               "quant_logit_kl": (int, float, type(None))}
 OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
-                       "quant_logit_kl"}
+                       "quant_logit_kl", "tp", "pp"}
 # absent == 0/False in files written before the speculative-decode
 # fields (ISSUE 7) and the multi-host `adopted` flag (ISSUE 10) landed —
 # historical artifacts must stay gradeable
@@ -101,7 +105,8 @@ def validate_records(records):
                   "timeline": TIMELINE_FIELDS}[kind]
         optional = OPTIONAL_REQUEST_FIELDS if kind == "request" \
             else OPTIONAL_RUN_FIELDS if kind == "run" \
-            else OPTIONAL_TIMELINE_FIELDS if kind == "timeline" else ()
+            else OPTIONAL_TIMELINE_FIELDS if kind == "timeline" \
+            else OPTIONAL_STEP_FIELDS
         for field, types in schema.items():
             if field not in rec:
                 if field not in optional:
@@ -251,6 +256,16 @@ def summarize(records):
             for p in sorted({r["priority"] for r in reqs})},
         "kv_dtype": run.get("kv_dtype"),
         "weight_dtype": run.get("weight_dtype"),
+        "tp": run.get("tp"),
+        "pp": run.get("pp"),
+        # pipeline serving (ISSUE 13): the LAST step's cumulative tick
+        # accounting is the run's figure (the counters are lifetime)
+        "pp_bubble_fraction": next(
+            (s["pp_bubble_fraction"] for s in reversed(steps)
+             if "pp_bubble_fraction" in s), None),
+        "pp_stage_busy": next(
+            (s["pp_stage_busy"] for s in reversed(steps)
+             if "pp_stage_busy" in s), None),
         "quant_greedy_match": run.get("quant_greedy_match"),
         "quant_logit_kl": run.get("quant_logit_kl"),
         "timelines": len(timelines),
@@ -286,6 +301,17 @@ def render(summary):
     if summary.get("kv_dtype") or summary.get("weight_dtype"):
         out.append(f"precision: kv={summary.get('kv_dtype') or '?'} "
                    f"weights={summary.get('weight_dtype') or '?'}")
+    if summary.get("tp") or summary.get("pp"):
+        out.append(f"parallel shape: tp={summary.get('tp') or 1} "
+                   f"pp={summary.get('pp') or 1}")
+    if summary.get("pp_stage_busy") is not None:
+        out += ["", "## pipeline stages", "",
+                "| stage | busy fraction |", "|---|---|"]
+        for s, b in enumerate(summary["pp_stage_busy"]):
+            out.append(f"| {s} | {b:.3f} |")
+        if summary.get("pp_bubble_fraction") is not None:
+            out.append(f"\npipeline bubble fraction: "
+                       f"{summary['pp_bubble_fraction']:.3f}")
     if summary.get("quant_greedy_match") is not None:
         line = (f"quant quality vs f32 oracle: greedy-match "
                 f"{summary['quant_greedy_match']:.4f}")
