@@ -638,7 +638,10 @@ def run_serve_load_bench(on_tpu, n_requests=None):
     and speculative-decode engines AT THE SAME KV MEMORY BUDGET, plus
     (ISSUE 13) a pipeline-parallel arm at EQUAL MEASURED PER-HOST HBM
     (hbm_accounting-gated <=1.05x the paged arm; per-stage compile
-    bounds asserted). The
+    bounds asserted), plus (ISSUE 14) a spec×pp arm at the pp arm's
+    pool budget — per-stage verify compile bounds asserted, acceptance
+    rate + bubble fraction reported together, and steady-state
+    tokens/sec asserted >= the pp-alone ring on warmed executables. The
     metric is the paged engine's replay tokens/sec; extra carries every
     arm's summary (tokens/sec, p50/p99 TTFT, peak concurrency, prefix
     hits, preemptions, and the spec arm's acceptance rate) plus the
@@ -715,13 +718,15 @@ def run_serve_load_bench(on_tpu, n_requests=None):
     pp_stages = int(os.environ.get("BENCH_SERVE_PP", 2))
     pp_tp = int(os.environ.get("BENCH_SERVE_PP_TP", 1))
     pp_arm = None
+    pp_engines = []
     if pp_stages * pp_tp <= len(jax.devices()):
         pp_blocks = pp_stages * (num_blocks - 1) + 1
         pp_slots = pp_stages * paged_slots
         results["pp"] = load_harness.run_harness(
             model, "pp", traffic, slots=pp_slots, max_len=max_len,
             block_size=block, num_blocks=pp_blocks,
-            attention_impl=attention_impl, tp=pp_tp, pp=pp_stages)
+            attention_impl=attention_impl, tp=pp_tp, pp=pp_stages,
+            engine_sink=pp_engines)
         pp_arm = results["pp"]
         pp_hbm_ratio = (pp_arm["hbm_max_device_bytes"]
                         / max(paged["hbm_max_device_bytes"], 1))
@@ -737,6 +742,43 @@ def run_serve_load_bench(on_tpu, n_requests=None):
         results["pp"] = {"skipped":
                          f"needs {pp_stages * pp_tp} devices, have "
                          f"{len(jax.devices())}"}
+    # spec×pp arm (ISSUE 14): speculative verify windows on the
+    # pipeline ring, at the pp arm's pool sizing (equal target-pool
+    # budget, the ISSUE 7 spec-arm precedent; the draft's stage-0
+    # weights + dense cache are REPORTED via the measured HBM ratio,
+    # not hidden — at production shape they are ~1/12 of a stage
+    # shard, priced in docs/PERF_NOTES.md). Skips explicitly on hosts
+    # with < pp*tp devices, per the PR 13 precedent.
+    spec_pp_arm = None
+    spec_pp_hbm_ratio = None
+    spec_pp_rates = None
+    if pp_arm is not None:
+        results["spec_pp"] = load_harness.run_harness(
+            model, "spec_pp", traffic, slots=pp_slots, max_len=max_len,
+            block_size=block, num_blocks=pp_blocks, gamma=gamma,
+            draft_layers=draft_layers, attention_impl=attention_impl,
+            tp=pp_tp, pp=pp_stages, engine_sink=pp_engines)
+        spec_pp_arm = results["spec_pp"]
+        spec_pp_hbm_ratio = (spec_pp_arm["hbm_max_device_bytes"]
+                             / max(pp_arm["hbm_max_device_bytes"], 1))
+        # the composed-throughput acceptance — spec×pp >= pp-alone —
+        # measured STEADY-STATE on the harness arms' already-WARMED
+        # engines: a tiny CPU replay's wall clock is compile-dominated
+        # (the spec arm compiles pp more executables than the one-token
+        # ring), compile time must not decide a throughput claim, and
+        # rebuilding the two most compile-heavy engine families just to
+        # probe them would spend scarce tier-1 wall clock for no signal
+        spec_pp_rates = _spec_pp_steady_rate(model, *pp_engines)
+        assert spec_pp_rates["spec_pp_tokens_per_s"] >= \
+            spec_pp_rates["pp_tokens_per_s"], \
+            f"spec×pp steady-state decode " \
+            f"{spec_pp_rates['spec_pp_tokens_per_s']} tok/s fell below " \
+            f"the pp-alone ring's {spec_pp_rates['pp_tokens_per_s']} " \
+            f"tok/s at equal pool budget"
+    else:
+        results["spec_pp"] = {"skipped":
+                              f"needs {pp_stages * pp_tp} devices, have "
+                              f"{len(jax.devices())}"}
     # the quality gate rides the rung: teacher-forced greedy match +
     # logit KL vs the f32 oracle, exported as serving_quant_* gauges.
     # Sample size matters against the 0.99 gate below: 5 slots x 40
@@ -770,6 +812,18 @@ def run_serve_load_bench(on_tpu, n_requests=None):
             and all(v == 1 for v in
                     pp_arm["trace_counts"]["prefill_pp"].values())
             and pp_arm["trace_counts"]["decode"] == 0),
+        # spec×pp (ISSUE 14): ONE verify executable per stage, ONE
+        # draft decode, and the one-token paths NEVER trace during the
+        # spec run — per-stage decode_pp stays empty, and so do both
+        # single-device decode counters
+        "spec_pp": spec_pp_arm is None or (
+            len(spec_pp_arm["trace_counts"]["verify_pp"]) == pp_stages
+            and all(v == 1 for v in
+                    spec_pp_arm["trace_counts"]["verify_pp"].values())
+            and spec_pp_arm["trace_counts"]["draft_decode"] == 1
+            and spec_pp_arm["trace_counts"]["spec_verify"] == 0
+            and not spec_pp_arm["trace_counts"]["decode_pp"]
+            and spec_pp_arm["trace_counts"]["decode"] == 0),
     }
     assert all(compile_bounds.values()), \
         f"decode compile counts unbounded: {compile_bounds}"
@@ -811,8 +865,82 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                       pp_arm["max_concurrent"]
                       / max(paged["max_concurrent"], 1), 3)
                   if pp_arm is not None else None,
+                  "spec_pp": results["spec_pp"],
+                  "spec_pp_acceptance_rate":
+                      spec_pp_arm["spec_acceptance_rate"]
+                  if spec_pp_arm is not None else None,
+                  "spec_pp_hbm_vs_pp": round(spec_pp_hbm_ratio, 4)
+                  if spec_pp_hbm_ratio is not None else None,
+                  "spec_pp_steady_rates": spec_pp_rates,
                   "backend": jax.default_backend()},
     }
+
+
+def _spec_pp_steady_rate(model, pp_e, sp_e):
+    """Steady-state decode tokens/sec: the spec×pp engine vs the
+    one-token pp ring, driven on the harness arms' already-built,
+    already-WARMED engines (same (tp, pp) mesh and pool budget by
+    construction — no second compile bill). A few slots are re-armed
+    with fresh prompts after the replay drained; BOTH engines run their
+    full slot batch per pass (free lanes do the same garbage work on
+    each side), and both rates count only the ACTIVE slots' tokens, so
+    the asserted ratio compares identical work on identical footing.
+    The spec figure counts EMITTED tokens (n_emit over active slots),
+    so the acceptance rate is priced in exactly as the analytical
+    (E[acc]+1)/(1+γ/L_frac) factor says — a draft that rots to zero
+    acceptance loses this comparison, as it should."""
+    import time as _time
+
+    import numpy as np
+
+    active = min(int(os.environ.get("BENCH_SERVE_SPECPP_SLOTS", 4)),
+                 pp_e.slots)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, model.cfg.vocab_size, 8).tolist()
+               for _ in range(active)]
+
+    def arm(engine):
+        # re-prefill resets the active slots' positions, so a repeat
+        # never grows past the pool the replay was sized for
+        for s, p in enumerate(prompts):
+            engine.prefill(s, p)
+    arm(pp_e)
+    arm(sp_e)
+    pp_e.decode()                                   # re-warm the ring
+    sp_e.decode_many()                              # re-warm draft+verify
+    steps = int(os.environ.get("BENCH_SERVE_SPECPP_STEPS", 8))
+    repeats = max(int(os.environ.get("BENCH_SERVE_SPECPP_REPEATS", 3)), 1)
+    # PER-CALL MEDIANS, interleaved: one ring pass is a few ms on CPU
+    # and the scheduler/GC regularly lands 10x spikes inside any timing
+    # window, so whole-window rates (and max-of-window racing) flip the
+    # asserted ratio on noise. Alternating one pp step with one spec
+    # round makes load shifts hit both sides equally, and the median of
+    # steps*repeats per-call samples is immune to the spikes. Each
+    # repeat re-arms and runs two UNMEASURED spec rounds so the active
+    # lanes reach their greedy fixed point — the timed rounds then
+    # carry STEADY-STATE acceptance, the figure the analytical pricing
+    # is stated for. Both entry points run ensure_decode_capacity
+    # themselves — no extra host work charged to either side.
+    t_pp, t_sp, emitted = [], [], []
+    for _ in range(repeats):
+        arm(pp_e)
+        arm(sp_e)
+        for _ in range(2):                          # converge, untimed
+            sp_e.decode_many()
+        for _ in range(steps):
+            t0 = _time.perf_counter()
+            pp_e.decode()
+            t_pp.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            _, n_emit = sp_e.decode_many()
+            t_sp.append(_time.perf_counter() - t0)
+            emitted.append(int(n_emit[:active].sum()))
+    pp_rate = active / sorted(t_pp)[len(t_pp) // 2]
+    sp_rate = (sum(emitted) / len(emitted)) \
+        / sorted(t_sp)[len(t_sp) // 2]
+    return {"pp_tokens_per_s": round(pp_rate, 2),
+            "spec_pp_tokens_per_s": round(sp_rate, 2),
+            "slots": active, "steps": steps, "repeats": repeats}
 
 
 def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):

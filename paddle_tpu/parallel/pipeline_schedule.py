@@ -239,7 +239,7 @@ def required_slots(fwd_tbl, bwd_tbl, farr, garr, n_microbatches, pp, vpp):
     return worst + 1
 
 
-def build_serving_tables(n_microbatches, pp):
+def build_serving_tables(n_microbatches, pp, tokens_per_tick=1):
     """Forward-only tick table for SERVING pipelines (ISSUE 13): the
     1F1B machinery above minus the backward half — microbatch g enters
     stage 0 at tick g and rides the stage ring one hop per tick, so
@@ -251,23 +251,62 @@ def build_serving_tables(n_microbatches, pp):
     the fill/drain triangles, so the schedule's bubble fraction is
     (pp-1)/(M + pp - 1) — shrinking with the microbatch count, which is
     what `serving_pp_bubble_fraction` gauges and the metrics_report
-    failure-class rule watch."""
+    failure-class rule watch.
+
+    tokens_per_tick (ISSUE 14): W > 1 grows a third dimension — each
+    (tick, stage) cell carries the W token slots of its microbatch's
+    verify window (a speculative γ+1-token window riding the ring):
+
+        tbl[t, s, w] = global token slot g * W + w (-1 idle)
+
+    Same T, same fill/drain triangles — but one ring pass now moves up
+    to M·W tokens instead of M, so the fill/drain cost AMORTIZES per
+    emitted token by the window width: idle stage-ticks per emitted
+    token fall from (pp-1)·pp/M to (pp-1)·pp/(M·W·rate), where `rate`
+    is the fraction of window tokens the verify rule accepts. That
+    amortization is the spec×pp composition's second win next to the
+    per-verify token multiplier (docs/PERF_NOTES.md prices both)."""
     M, pp = int(n_microbatches), int(pp)
-    if M < 1 or pp < 1:
-        raise ValueError(f"need M >= 1 and pp >= 1, got M={M} pp={pp}")
+    W = int(tokens_per_tick)
+    if M < 1 or pp < 1 or W < 1:
+        raise ValueError(f"need M >= 1, pp >= 1 and tokens_per_tick >= 1, "
+                         f"got M={M} pp={pp} W={W}")
     T = M + pp - 1
-    tbl = np.full((T, pp), -1, np.int32)
+    if W == 1:
+        tbl = np.full((T, pp), -1, np.int32)
+        for t in range(T):
+            for s in range(pp):
+                g = t - s
+                if 0 <= g < M:
+                    tbl[t, s] = g
+        return tbl
+    tbl = np.full((T, pp, W), -1, np.int32)
     for t in range(T):
         for s in range(pp):
             g = t - s
             if 0 <= g < M:
-                tbl[t, s] = g
+                tbl[t, s] = g * W + np.arange(W, dtype=np.int32)
     return tbl
 
 
 def serving_schedule_stats(tbl):
     """Diagnostics for a `build_serving_tables` table: total ticks,
-    per-stage busy fraction, and the bubble fraction the gauges carry."""
+    per-stage busy fraction, and the bubble fraction the gauges carry.
+    A 3-D (tokens-per-tick) table additionally reports the window width
+    and `ticks_per_token_max` = T/(M·W), the per-emitted-token tick
+    bill at full acceptance — the figure the spec×pp bubble
+    amortization divides."""
+    if tbl.ndim == 3:
+        T, pp, W = tbl.shape
+        busy2 = (tbl >= 0).any(-1)
+        M = int(busy2[:, 0].sum())
+        busy = busy2.sum(0)
+        work = int(busy2.sum())
+        return {"ticks": int(T),
+                "stage_busy": [float(b) / T for b in busy],
+                "bubble_frac": float(1.0 - work / (T * pp)),
+                "tokens_per_tick": int(W),
+                "ticks_per_token_max": float(T) / (M * W)}
     T, pp = tbl.shape
     busy = (tbl >= 0).sum(0)
     work = int((tbl >= 0).sum())

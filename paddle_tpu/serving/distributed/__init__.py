@@ -40,7 +40,9 @@ single-process serving must not pay for.
 from .kv_handoff import (KVWireError, pack_kv_bundle,  # noqa: F401
                          unpack_kv_bundle)
 from .pp import (PipelineParallelEngineConfig,  # noqa: F401
-                 PipelineParallelPagedEngine)
+                 PipelineParallelPagedEngine, PipelineParallelSpecConfig,
+                 PipelineParallelSpeculativeEngine,
+                 free_eager_device_copies)
 from .router import DistFrontend, ServingShardClient  # noqa: F401
 from .tp import (TensorParallelEngineConfig,  # noqa: F401
                  TensorParallelPagedEngine)
@@ -50,6 +52,8 @@ from .worker import (ServingWorker, load_checkpoint_params,  # noqa: F401
 __all__ = [
     "TensorParallelEngineConfig", "TensorParallelPagedEngine",
     "PipelineParallelEngineConfig", "PipelineParallelPagedEngine",
+    "PipelineParallelSpecConfig", "PipelineParallelSpeculativeEngine",
+    "free_eager_device_copies",
     "KVWireError", "pack_kv_bundle", "unpack_kv_bundle",
     "ServingWorker", "load_checkpoint_params", "save_swap_checkpoint",
     "DistFrontend", "ServingShardClient",

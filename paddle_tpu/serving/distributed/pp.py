@@ -53,6 +53,8 @@ slices in model order (wire format unchanged), `swap_params` re-places
 each stage's params on its own mesh, and kv_dtype/weight_dtype="int8"
 quantize per stage exactly as on one device.
 """
+import time
+
 import numpy as np
 
 import jax
@@ -66,10 +68,36 @@ from ...observability import metrics as _metrics
 from ...parallel import pipeline_schedule as _psched
 from ...profiler import RecordEvent, TracerEventType
 from .. import blocks
-from ..engine import PagedEngineConfig, PagedGenerationEngine
+from .. import kv_cache as kvc
+from .. import sampling
+from .. import spec_decode as _spec
+from ..engine import (PagedEngineConfig, PagedGenerationEngine,
+                      _quantize_weight)
 from .tp import param_partition_specs, quant_scale_sharding
 
-__all__ = ["PipelineParallelEngineConfig", "PipelineParallelPagedEngine"]
+__all__ = ["PipelineParallelEngineConfig", "PipelineParallelPagedEngine",
+           "PipelineParallelSpecConfig", "PipelineParallelSpeculativeEngine",
+           "free_eager_device_copies", "pp_executable_names"]
+
+
+def pp_executable_names(config, spec=False):
+    """The pipeline engines' executable-name set, derived from config
+    alone — ONE derivation shared by the engines' `executable_names()`
+    and the `.gencfg` recording path (`engine._executable_set`), so the
+    serving record's AOT set can never drift from what the engine
+    actually builds (the chunk-collapse rule lives only here)."""
+    names = [f"decode_stage[{s}]" for s in range(config.pp)]
+    for b in config.prefill_buckets:
+        chunk = min(config.prefill_chunk or b, b)
+        names += [f"prefill_stage[{s}][{chunk}]"
+                  for s in range(config.pp)]
+        names.append(f"prefill_head[{chunk}]")
+    names = sorted(set(names))
+    if spec:
+        names += ["draft_decode"]
+        names += [f"draft_prefill[{b}]" for b in config.prefill_buckets]
+        names += [f"verify_stage[{s}]" for s in range(config.pp)]
+    return names
 
 _M_BUBBLE = _metrics.gauge(
     "serving_pp_bubble_fraction",
@@ -90,8 +118,9 @@ class PipelineParallelEngineConfig(PagedEngineConfig):
     pp: pipeline stages (>= 2; pp=1 is just the paged/TP engine).
     tp: tensor degree WITHIN each stage (num_heads must divide by it).
     decode_microbatches: slot groups riding the decode ring (must
-      divide `slots`; default pp — more microbatches shrink the
-      per-call bubble as (pp-1)/(M+pp-1)).
+      divide `slots`; default = the largest divisor of `slots` that is
+      <= pp — more microbatches shrink the per-call bubble as
+      (pp-1)/(M+pp-1)).
     prefill_chunk: tokens per pipelined prefill chunk (None = one chunk
       per suffix bucket — the unchunked ladder; a fixed chunk size
       collapses the per-stage prefill executables to ONE each).
@@ -212,8 +241,11 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         # the master param copy stays HOST-resident: it is the
         # hot-swap validation record, not serving state — per-device
         # HBM accounting must see only the per-stage placed shards
+        # (buffers too: each stage holds its own placed copy)
         self._params = {k: np.asarray(jax.device_get(v))
                         for k, v in self._params.items()}
+        self._buffers = {k: np.asarray(jax.device_get(v))
+                         for k, v in self._buffers.items()}
         heads, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
         for st in self._stages:
             raw = blocks.alloc_quant_pools(
@@ -257,7 +289,6 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
             if self.config.weight_dtype != "int8":
                 st.decode_params = st.params
                 continue
-            from ..engine import _quantize_weight
             out = {}
             for name, arr in st.params.items():
                 axis = self._weight_quant_axis(st.name_map[name], arr)
@@ -324,19 +355,29 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
               for x in l)) for l in pool)
 
     # -- decode: ONE executable PER STAGE ------------------------------------
+    def _make_stage_forward(self, s, counter, name):
+        """A NON-LAST stage's ring executable — the one-token decode
+        hop and the spec verify hop (ISSUE 14) share this exact shape:
+        run the stage's blocks over the hop input, pin the activation
+        and pool output shardings. Only the trace counter and the
+        cache name differ."""
+        st = self._stages[s]
+
+        def fn(params, pool, tables, pos, x):
+            self.trace_counts[counter][s] = \
+                self.trace_counts[counter].get(s, 0) + 1
+            y, npool = self._run_stage(st, params, pool, tables,
+                                       pos, x, op="block")
+            y = jax.lax.with_sharding_constraint(y, st.replicated)
+            return y, self._constrain_stage(st, npool)
+        return self._cached(fn, name)
+
     def _make_stage_decode(self, s):
         st = self._stages[s]
-        last = st.module.is_last
 
-        if not last:
-            def fn(params, pool, tables, pos, x):
-                self.trace_counts["decode_pp"][s] = \
-                    self.trace_counts["decode_pp"].get(s, 0) + 1
-                y, npool = self._run_stage(st, params, pool, tables,
-                                           pos, x, op="block")
-                y = jax.lax.with_sharding_constraint(y, st.replicated)
-                return y, self._constrain_stage(st, npool)
-            return self._cached(fn, f"decode_stage[{s}]")
+        if not st.module.is_last:
+            return self._make_stage_forward(s, "decode_pp",
+                                            f"decode_stage[{s}]")
 
         def fn(params, pool, tables, pos, x, key, *rng):
             self.trace_counts["decode_pp"][s] = \
@@ -350,6 +391,47 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
             return nxt, npool
         return self._cached(fn, f"decode_stage[{s}]")
 
+    def _ride_ring(self, tbl, mb_count, stage_call):
+        """Walk a forward-1F1B tick table: for every busy (tick, stage)
+        cell, move the microbatch's activation one hop onto the stage's
+        mesh (the `serving.pp_handoff` chaos site fires per hop), call
+        `stage_call(s, st, g, x)` -> (out, new_pool) — `x` is None on
+        the FIRST stage, whose callable owns its own input — commit the
+        stage pool, and keep the busy/tick accounting. Returns the
+        per-microbatch LAST-stage outputs, still on device (a host
+        fetch per tick would serialize exactly the cross-stage overlap
+        the ring exists for). ONE walker shared by one-token decode and
+        the spec verify ring (ISSUE 14), so handoff chaos, busy
+        accounting, and pool-commit semantics can never diverge between
+        them. 3-D (tokens-per-tick) tables walk the same skeleton —
+        each cell's token slots collapse to their microbatch."""
+        hidden = [None] * mb_count
+        out = [None] * mb_count
+        for t in range(tbl.shape[0]):
+            for s in range(self.config.pp):
+                g = int(tbl[t, s] if tbl.ndim == 2 else tbl[t, s, 0])
+                if g < 0:
+                    continue
+                if tbl.ndim == 3:
+                    g //= tbl.shape[2]       # token slot -> microbatch
+                st = self._stages[s]
+                if st.module.is_first:
+                    x = None
+                else:
+                    # the stage boundary: the chaos site fires, then
+                    # the activation moves onto this stage's mesh
+                    _faults.fire("serving.pp_handoff")
+                    x = jax.device_put(hidden[g], st.replicated)
+                self._pp_busy[s] += 1
+                res, npool = stage_call(s, st, g, x)
+                if st.module.is_last:
+                    out[g] = res
+                else:
+                    hidden[g] = res
+                st.pool = npool
+            self._pp_ticks += 1
+        return out
+
     def decode(self):
         """Advance every slot one token by running the M-microbatch
         serving ring through the pp stages (module docstring). Returns
@@ -360,12 +442,9 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         c = self.config
         M = c.decode_microbatches
         mbs = c.slots // M
-        tbl = self._decode_tbl
         tokens = self._last_tokens
         key = self._next_key()
-        hidden = [None] * M
         out_tokens = np.zeros((c.slots,), np.int32)
-        out_nxt = [None] * M
         out_logits = [None] * M
         # tables/pos are immutable for the whole call: upload each
         # microbatch's slices ONCE, not once per (tick, stage) — each
@@ -374,6 +453,26 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         mb_slices = [(jnp.asarray(self._tables[g * mbs:(g + 1) * mbs]),
                       jnp.asarray(self._pos[g * mbs:(g + 1) * mbs]))
                      for g in range(M)]
+
+        def stage_call(s, st, g, x):
+            lo, hi = g * mbs, (g + 1) * mbs
+            mb_tables, mb_pos = mb_slices[g]
+            if st.module.is_first:
+                x = jnp.asarray(tokens[lo:hi].reshape(mbs, 1))
+            if not st.module.is_last:
+                return self._stage_decode[s](st.decode_params, st.pool,
+                                             mb_tables, mb_pos, x)
+            args = [st.decode_params, st.pool, mb_tables, mb_pos, x, key]
+            if self._sampling:
+                args += [jnp.asarray(self._slot_seeds[lo:hi]),
+                         jnp.asarray(self._slot_gen[lo:hi])]
+            res = self._stage_decode[s](*args)
+            if c.capture_logits:
+                nxt, npool, lg = res
+                out_logits[g] = lg
+                return nxt, npool
+            return res
+
         with RecordEvent("serving::decode_step",
                          TracerEventType.UserDefined,
                          {"slots": c.slots, "paged": True, "pp": c.pp,
@@ -381,45 +480,7 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
                           "kv_dtype": c.kv_dtype,
                           "attend": c.attention_impl}), \
                 blocks.attention_impl(c.attention_impl):
-            for t in range(tbl.shape[0]):
-                for s in range(c.pp):
-                    g = int(tbl[t, s])
-                    if g < 0:
-                        continue
-                    st = self._stages[s]
-                    lo, hi = g * mbs, (g + 1) * mbs
-                    mb_tables, mb_pos = mb_slices[g]
-                    if st.module.is_first:
-                        x = jnp.asarray(tokens[lo:hi].reshape(mbs, 1))
-                    else:
-                        # the stage boundary: the chaos site fires, then
-                        # the activation moves onto this stage's mesh
-                        _faults.fire("serving.pp_handoff")
-                        x = jax.device_put(hidden[g], st.replicated)
-                    self._pp_busy[s] += 1
-                    if st.module.is_last:
-                        args = [st.decode_params, st.pool, mb_tables,
-                                mb_pos, x, key]
-                        if self._sampling:
-                            args += [jnp.asarray(self._slot_seeds[lo:hi]),
-                                     jnp.asarray(self._slot_gen[lo:hi])]
-                        res = self._stage_decode[s](*args)
-                        if c.capture_logits:
-                            nxt, npool, lg = res
-                            out_logits[g] = lg
-                        else:
-                            nxt, npool = res
-                        # keep the token arrays ON DEVICE until the ring
-                        # drains: converting here would sync the host
-                        # every tick and serialize exactly the
-                        # cross-stage overlap the ring exists for
-                        out_nxt[g] = nxt
-                    else:
-                        hidden[g], npool = self._stage_decode[s](
-                            st.decode_params, st.pool, mb_tables,
-                            mb_pos, x)
-                    st.pool = npool
-                self._pp_ticks += 1
+            out_nxt = self._ride_ring(self._decode_tbl, M, stage_call)
         for g in range(M):
             out_tokens[g * mbs:(g + 1) * mbs] = np.asarray(out_nxt[g],
                                                            np.int32)
@@ -499,34 +560,26 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         ids = np.zeros((n_run * chunk,), np.int32)
         n_copy = min(padded.shape[0], n_run * chunk)
         ids[:n_copy] = padded[:n_copy]
-        tbl = _psched.build_serving_tables(n_run, c.pp)
         tables = jnp.asarray(self._tables)
         slot_j = jnp.asarray(slot, jnp.int32)
-        hidden = [None] * n_run
-        for t in range(tbl.shape[0]):
-            for s in range(c.pp):
-                g = int(tbl[t, s])
-                if g < 0:
-                    continue
-                st = self._stages[s]
-                if (s, chunk) not in self._stage_prefill:
-                    self._stage_prefill[(s, chunk)] = \
-                        self._make_stage_prefill(s, chunk)
-                start_g = start + g * chunk
-                valid_g = int(np.clip(length - g * chunk, 0, chunk))
-                if st.module.is_first:
-                    x = jnp.asarray(
-                        ids[g * chunk:(g + 1) * chunk][None, :])
-                else:
-                    _faults.fire("serving.pp_handoff")
-                    x = jax.device_put(hidden[g], st.replicated)
-                self._pp_busy[s] += 1
-                hidden[g], npool = self._stage_prefill[(s, chunk)](
-                    st.params, st.pool, tables, slot_j, x,
-                    jnp.asarray(start_g, jnp.int32),
-                    jnp.asarray(valid_g, jnp.int32))
-                st.pool = npool
-            self._pp_ticks += 1
+
+        def stage_call(s, st, g, x):
+            if (s, chunk) not in self._stage_prefill:
+                self._stage_prefill[(s, chunk)] = \
+                    self._make_stage_prefill(s, chunk)
+            if st.module.is_first:
+                x = jnp.asarray(ids[g * chunk:(g + 1) * chunk][None, :])
+            start_g = start + g * chunk
+            valid_g = int(np.clip(length - g * chunk, 0, chunk))
+            return self._stage_prefill[(s, chunk)](
+                st.params, st.pool, tables, slot_j, x,
+                jnp.asarray(start_g, jnp.int32),
+                jnp.asarray(valid_g, jnp.int32))
+
+        # the prefill chunks ride the SAME walker as the decode/verify
+        # rings — a chunk is one microbatch of the forward-1F1B table
+        hidden = self._ride_ring(
+            _psched.build_serving_tables(n_run, c.pp), n_run, stage_call)
         if chunk not in self._pp_head:
             self._pp_head[chunk] = self._make_pp_head(chunk)
         idx = (length - 1) - (n_run - 1) * chunk
@@ -612,14 +665,7 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
 
     # -- AOT warmup ------------------------------------------------------------
     def executable_names(self):
-        c = self.config
-        names = [f"decode_stage[{s}]" for s in range(c.pp)]
-        for b in c.prefill_buckets:
-            chunk = min(c.prefill_chunk or b, b)
-            names += [f"prefill_stage[{s}][{chunk}]"
-                      for s in range(c.pp)]
-            names.append(f"prefill_head[{chunk}]")
-        return sorted(set(names))
+        return pp_executable_names(self.config)
 
     def precompile(self):
         """AOT-build the per-stage executable set (decode ring + every
@@ -675,3 +721,302 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
                                    self._stages[-1].replicated),
                     jnp.asarray(0, jnp.int32), key)
         return out
+
+
+class PipelineParallelSpecConfig(_spec.SpecDecodeConfig,
+                                 PipelineParallelEngineConfig):
+    """The spec×pp knob set (ISSUE 14): SpecDecodeConfig's speculative
+    half (gamma, draft_layers, greedy-only, no capture_logits) over
+    PipelineParallelEngineConfig's mesh half (pp, tp,
+    decode_microbatches, prefill_chunk, stage_layers). The cooperative
+    __init__ chain resolves the pp shape first, then the speculative
+    validation runs — one config, every knob of both parents."""
+
+    _DICT_FIELDS = PipelineParallelEngineConfig._DICT_FIELDS + (
+        "gamma", "draft_layers")
+
+
+class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
+                                        PipelineParallelPagedEngine):
+    """Speculative decode ON the pipeline ring (ISSUE 14): the two
+    biggest decode-throughput layers in the stack, composed so their
+    wins multiply.
+
+    DRAFT — on the first stage's mesh. The truncated shared-weight
+    draft (target's first `draft_layers` blocks + embeddings + final
+    LN, one logical weight set) is placed REPLICATED on stage 0's 'mp'
+    mesh next to that stage's shard: γ single-token draft decodes run
+    there against the draft's dense cache exactly as on one device.
+    The pp master copy is host numpy, so the single-device engine's
+    no-second-DEVICE-copy identity share becomes a real stage-0 byte
+    bill here (draft weights + its dense KV) — counted by
+    `hbm_accounting`, priced in docs/PERF_NOTES.md, and small by
+    construction at production shape (1/12 of the layers).
+
+    VERIFY — ONE fixed-shape [mbs, γ+1] window per microbatch rides
+    the SAME forward-1F1B tick tables as one-token pp decode
+    (`build_serving_tables(M, pp, tokens_per_tick=γ+1)`), one
+    compile-once executable per stage (`verify_pp` trace counters:
+    stage 0 embeds the window tokens, interior stages forward the
+    [mbs, γ+1, H] activation, the last stage taps logits and runs
+    `sampling.greedy_verify` in-trace). Each stage writes the window's
+    K/V into its own resident pool slice through the shared block
+    tables — so a REJECTION needs no cross-stage protocol at all:
+    exactly as in PR 7, pos advances by n_accepted+1 on the host and
+    the rejected tail stays physically in already-owned blocks of
+    every stage, invisible by position masking and overwritten next
+    round. No block reference moves, on any stage.
+
+    WHY IT MULTIPLIES — each ring pass costs the same M+pp-1 ticks as
+    one-token decode but emits up to (γ+1)× the tokens, so the
+    fill/drain bubble amortizes per emitted token by the acceptance-
+    weighted window width ON TOP of the (1+γ/12)/(E[acc]+1) per-token
+    compute ratio the single-device engine buys (PERF_NOTES prices the
+    product). Greedy streams are BIT-IDENTICAL to both parents — the
+    one-token pp engine and the single-device speculative engine — and
+    per-slot sampler generation counters advance by n_emit, so v3 RNG
+    KV-handoff bundles stay failover-exact mid-window."""
+
+    def __init__(self, model, config=None, draft=None, **kwargs):
+        config = config or PipelineParallelSpecConfig(**kwargs)
+        if not isinstance(config, PipelineParallelSpecConfig):
+            raise TypeError("PipelineParallelSpeculativeEngine needs a "
+                            "PipelineParallelSpecConfig")
+        # an auto-built truncated draft tracks the target by NAME across
+        # hot-swaps (the host master copy forecloses identity sharing);
+        # an explicit draft keeps its own weights — it only ever moves
+        # the acceptance rate, never the emitted stream
+        self._draft_shares_target = draft is None
+        _spec.SpeculativeEngine.__init__(self, model, config, draft=draft)
+        # the single-device verify executable must never run here — the
+        # window rides the stage ring instead. Poisoned loudly (None),
+        # and its trace counter staying 0 is asserted by the tests.
+        self._spec_verify = None
+        self.trace_counts["verify_pp"] = {}
+        self._stage_verify = [self._make_stage_verify(s)
+                              for s in range(config.pp)]
+        self._verify_tbl = _psched.build_serving_tables(
+            config.decode_microbatches, config.pp,
+            tokens_per_tick=config.gamma + 1)
+
+    # -- draft placement: stage 0's mesh --------------------------------------
+    def _place_draft_kv(self, layers):
+        st = self._stages[0]
+        return tuple(kvc.LayerKV(jax.device_put(l.k, st.replicated),
+                                 jax.device_put(l.v, st.replicated))
+                     for l in layers)
+
+    def _draft_feed(self, tokens):
+        return jax.device_put(tokens, self._stages[0].replicated)
+
+    def _build_draft_decode_params(self):
+        """Draft-on-first-stage: params AND buffers device_put
+        replicated onto stage 0's mesh (the draft is small next to a
+        stage shard; a second partition-spec map would buy little).
+        weight_dtype="int8" re-expresses the placed set exactly like
+        the target's per-stage decode sets. Re-run after every
+        hot-swap, so a swapped target never serves against a stale
+        draft."""
+        st = self._stages[0]
+        self._draft_params = {
+            name: jax.device_put(arr, st.replicated)
+            for name, arr in self._draft_params.items()}
+        self._draft_buffers = {
+            name: jax.device_put(arr, st.replicated)
+            for name, arr in self._draft_buffers.items()}
+        if self.config.weight_dtype != "int8":
+            self._draft_decode_params = self._draft_params
+            return
+        out = {}
+        for name, arr in self._draft_params.items():
+            axis = self._weight_quant_axis(name, arr)
+            if axis is None:
+                out[name] = arr
+                continue
+            codes, s_b = _quantize_weight(arr, axis)
+            out[name] = {"q": jax.device_put(codes, st.replicated),
+                         "scale": jax.device_put(s_b, st.replicated)}
+        self._draft_decode_params = out
+
+    def swap_params(self, new_params):
+        """Hot-swap for the spec×pp pair: the target swaps through the
+        pp path (host master copy, per-stage re-placement), then the
+        auto-built truncated draft re-sources every param from the NEW
+        master by name — same between-steps window, so acceptance never
+        degrades against a stale draft. An explicit draft keeps its own
+        arrays."""
+        n = PipelineParallelPagedEngine.swap_params(self, new_params)
+        if self._draft_shares_target:
+            for name in list(self._draft_params):
+                if name in self._params:
+                    self._draft_params[name] = self._params[name]
+            self._build_draft_decode_params()
+        return n
+
+    # -- the per-stage verify executables --------------------------------------
+    def _make_stage_verify(self, s):
+        st = self._stages[s]
+
+        if not st.module.is_last:
+            # same hop shape as the one-token ring — only the counter
+            # and the avals (a γ+1 window instead of one token) differ
+            return self._make_stage_forward(s, "verify_pp",
+                                            f"verify_stage[{s}]")
+
+        def fn(params, pool, tables, pos, x, window):
+            self.trace_counts["verify_pp"][s] = \
+                self.trace_counts["verify_pp"].get(s, 0) + 1
+            logits, npool = self._run_stage(st, params, pool, tables,
+                                            pos, x, op="block_head")
+            npool = self._constrain_stage(st, npool)
+            choices, n_acc, last = sampling.greedy_verify(logits, window)
+            return choices, n_acc, last, npool
+        return self._cached(fn, f"verify_stage[{s}]")
+
+    # -- public compute API ----------------------------------------------------
+    def decode_many(self):
+        """One speculative round over the stage ring: γ draft decodes on
+        stage 0's mesh, then the [mbs, γ+1] verify window of every slot
+        microbatch rides the forward-1F1B tick table through the pp
+        stages — each stage writing its own pool slice — and the host
+        rolls every position back to committed+accepted+1. Returns
+        (tokens [S, γ+1], n_emit [S]) exactly like the single-device
+        speculative engine."""
+        _faults.fire("serving.decode_step")
+        self._fire_kv_quant_chaos()
+        self.ensure_decode_capacity()          # γ+1-wide block growth
+        c = self.config
+        gamma = c.gamma
+        W = gamma + 1
+        M = c.decode_microbatches
+        mbs = c.slots // M
+        t0 = time.perf_counter()
+        with RecordEvent("serving::spec_draft", TracerEventType.UserDefined,
+                         {"gamma": gamma, "slots": c.slots, "pp": c.pp,
+                          "tp": c.tp}):
+            window, dk, dv, dpos = self._draft_propose()
+        draft_s = time.perf_counter() - t0
+        _spec._M_DRAFT_SECONDS.observe(draft_s)
+        t1 = time.perf_counter()
+        # tables/pos upload once per microbatch (the pp decode rule);
+        # the window slices stay ON DEVICE — stage 0 embeds them, the
+        # last stage compares against them
+        mb_slices = [(jnp.asarray(self._tables[g * mbs:(g + 1) * mbs]),
+                      jnp.asarray(self._pos[g * mbs:(g + 1) * mbs]))
+                     for g in range(M)]
+        mb_windows = [window[g * mbs:(g + 1) * mbs] for g in range(M)]
+
+        def stage_call(s, st, g, x):
+            mb_tables, mb_pos = mb_slices[g]
+            if st.module.is_first:
+                x = mb_windows[g]
+            if not st.module.is_last:
+                return self._stage_verify[s](st.decode_params, st.pool,
+                                             mb_tables, mb_pos, x)
+            win = jax.device_put(mb_windows[g], st.replicated)
+            ch, na, la, npool = self._stage_verify[s](
+                st.decode_params, st.pool, mb_tables, mb_pos, x, win)
+            return (ch, na, la), npool
+
+        with RecordEvent("serving::spec_verify",
+                         TracerEventType.UserDefined,
+                         {"window": W, "slots": c.slots, "pp": c.pp,
+                          "microbatches": M,
+                          "attend": c.attention_impl}), \
+                blocks.attention_impl(c.attention_impl):
+            out = self._ride_ring(self._verify_tbl, M, stage_call)
+        verify_s = time.perf_counter() - t1
+        _spec._M_VERIFY_SECONDS.observe(verify_s)
+        choices = np.concatenate([np.asarray(o[0], np.int32)
+                                  for o in out])
+        n_acc = np.concatenate([np.asarray(o[1], np.int32) for o in out])
+        last = np.concatenate([np.asarray(o[2], np.int32) for o in out])
+        # the rollback, host-side across every stage at once: rejected-
+        # tail K/V stays physically resident beyond the new pos in each
+        # stage's pool — invisible, overwritten next round, no block
+        # reference moves (the PR 7 rule, unchanged by the mesh)
+        self._pos = np.minimum(self._pos + n_acc + 1,
+                               c.max_len - 1).astype(np.int32)
+        self._draft_kv = tuple(kvc.LayerKV(k, v) for k, v in zip(dk, dv))
+        self._draft_pos = self._pos.copy()
+        n_emit = (n_acc + 1).astype(np.int32)
+        self._slot_gen += n_emit               # v3 RNG stays stream-exact
+        self._last_tokens = last.astype(np.int32).copy()
+        self.last_spec_stats = {
+            "proposed_per_slot": gamma,
+            "draft_s": draft_s, "verify_s": verify_s}
+        self._export_pp_stats()
+        return choices, n_emit
+
+    # -- AOT warmup -------------------------------------------------------------
+    def executable_names(self):
+        return pp_executable_names(self.config, spec=True)
+
+    def precompile(self):
+        """The pp executable set (decode ring + prefill chunks + head
+        taps) plus the speculative set: draft decode/prefills on stage
+        0's mesh and every stage's [mbs, γ+1] verify."""
+        out = PipelineParallelPagedEngine.precompile(self)
+        c = self.config
+        mbs = c.slots // c.decode_microbatches
+        W = c.gamma + 1
+        H = self._model.cfg.hidden_size
+        dk = [l.k for l in self._draft_kv]
+        dv = [l.v for l in self._draft_kv]
+        dpos = jnp.asarray(self._draft_pos)
+        out["draft_decode"] = self._draft_decode.warm(
+            self._draft_decode_params, dk, dv, dpos,
+            self._draft_feed(jnp.zeros((c.slots,), jnp.int32)))
+        for b in c.prefill_buckets:
+            if b not in self._draft_prefill:
+                self._draft_prefill[b] = self._make_draft_prefill(b)
+            out[f"draft_prefill[{b}]"] = self._draft_prefill[b].warm(
+                self._draft_params, dk, dv, dpos,
+                jnp.asarray(0, jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.asarray(1, jnp.int32))
+        with blocks.attention_impl(c.attention_impl):
+            for s, st in enumerate(self._stages):
+                mb_tables = jnp.asarray(self._tables[:mbs])
+                mb_pos = jnp.asarray(self._pos[:mbs])
+                win = jax.device_put(jnp.zeros((mbs, W), jnp.int32),
+                                     st.replicated)
+                if st.module.is_first:
+                    x = win
+                else:
+                    x = jax.device_put(jnp.zeros((mbs, W, H), jnp.float32),
+                                       st.replicated)
+                if st.module.is_last:
+                    out[f"verify_stage[{s}]"] = self._stage_verify[s].warm(
+                        st.decode_params, st.pool, mb_tables, mb_pos, x,
+                        win)
+                else:
+                    out[f"verify_stage[{s}]"] = self._stage_verify[s].warm(
+                        st.decode_params, st.pool, mb_tables, mb_pos, x)
+        return out
+
+
+def free_eager_device_copies(model):
+    """Host-side model materialization (ROADMAP item 4d): re-point every
+    eager parameter/buffer of `model` at a HOST numpy copy, freeing the
+    default-device arrays the Layer build materialized. The pp engines
+    keep their master weight copy host-resident and place per-stage
+    shards themselves, so after engine construction the eager device
+    copies are pure waste — and on a genuinely bigger-than-one-host
+    deployment, waste that does not FIT next to a stage shard.
+    `worker_main --engine pp|spec_pp` calls this right after engine
+    construction; the eager Layer stays fully usable (state_dict for
+    hot-swap sources, even eager forwards — jnp re-uploads on demand).
+    A spec_pp engine's truncated DRAFT Layer aliases the same device
+    arrays through its own Tensors — call this on `engine.draft_model`
+    too (worker_main does), or the aliased arrays stay alive and the
+    bytes figure returned for the target alone overstates what was
+    actually released. Returns (arrays_moved, bytes_freed)."""
+    moved, freed = 0, 0
+    for t in model.state_dict().values():
+        data = t._data
+        if isinstance(data, np.ndarray):
+            continue
+        t._data = np.asarray(jax.device_get(data))
+        moved += 1
+        freed += int(data.nbytes)
+    return moved, freed

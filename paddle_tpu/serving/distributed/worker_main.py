@@ -29,9 +29,10 @@ def main(argv=None):
     p.add_argument("--role", choices=("decode", "prefill"),
                    default="decode")
     p.add_argument("--engine", default="paged",
-                   help="serving engine kind: dense|paged|spec|tp|pp "
-                        "(tp/pp serve this process's whole local device "
-                        "grid — one process = one worker GROUP)")
+                   help="serving engine kind: dense|paged|spec|tp|pp|"
+                        "spec_pp (tp/pp/spec_pp serve this process's "
+                        "whole local device grid — one process = one "
+                        "worker GROUP)")
     p.add_argument("--model", default="gpt_tiny",
                    help="model factory name in paddle_tpu.text.models")
     p.add_argument("--seed", type=int, default=2024,
@@ -78,6 +79,22 @@ def main(argv=None):
 
     engine = make_engine(model, args.engine,
                          json.loads(args.engine_config))
+    if args.engine in ("pp", "spec_pp"):
+        # host-side model materialization (ROADMAP item 4d): the pp
+        # engines keep their master copy host-resident and place
+        # per-stage shards themselves, so the eager Layer's default-
+        # device param copies are freed right after engine construction
+        # — engine hbm_accounting() is now the WHOLE device story for a
+        # bigger-than-one-host deployment (the Layer stays usable as
+        # the hot-swap/state_dict source from host numpy). The spec_pp
+        # draft Layer aliases the same device arrays through its OWN
+        # Tensors and would keep them alive — free it too.
+        from paddle_tpu.serving.distributed.pp import \
+            free_eager_device_copies
+        free_eager_device_copies(model)
+        draft = getattr(engine, "draft_model", None)
+        if draft is not None:
+            free_eager_device_copies(draft)
     serving_cfg = ServingConfig(**json.loads(args.serving_config)) \
         if args.role == "decode" else None
     worker = ServingWorker(model, engine, role=args.role,

@@ -1288,17 +1288,21 @@ def default_compile_cache_dir(path):
 
 
 def _engine_kind(config):
-    """"dense" | "paged" | "spec" | "tp" | "pp" for an EngineConfig-
-    family instance (most-derived class first). The TP/PP checks consult
-    sys.modules instead of importing: those config classes can only
-    exist if their module was already imported, so classifying a plain
-    dense/paged/spec config never pulls the multi-host tier in (the
-    lazy-import contract of serving/distributed/)."""
+    """"dense" | "paged" | "spec" | "tp" | "pp" | "spec_pp" for an
+    EngineConfig-family instance (most-derived class first). The TP/PP
+    checks consult sys.modules instead of importing: those config
+    classes can only exist if their module was already imported, so
+    classifying a plain dense/paged/spec config never pulls the
+    multi-host tier in (the lazy-import contract of
+    serving/distributed/)."""
     import sys
     from .spec_decode import SpecDecodeConfig
+    pp_mod = sys.modules.get("paddle_tpu.serving.distributed.pp")
+    if pp_mod is not None and \
+            isinstance(config, pp_mod.PipelineParallelSpecConfig):
+        return "spec_pp"
     if isinstance(config, SpecDecodeConfig):
         return "spec"
-    pp_mod = sys.modules.get("paddle_tpu.serving.distributed.pp")
     if pp_mod is not None and \
             isinstance(config, pp_mod.PipelineParallelEngineConfig):
         return "pp"
@@ -1332,9 +1336,15 @@ def make_engine(model, kind, config_dict, compile_cache_dir=None):
                                      PipelineParallelPagedEngine)
         classes["pp"] = (PipelineParallelPagedEngine,
                          PipelineParallelEngineConfig)
+    if kind == "spec_pp":
+        from .distributed.pp import (PipelineParallelSpecConfig,
+                                     PipelineParallelSpeculativeEngine)
+        classes["spec_pp"] = (PipelineParallelSpeculativeEngine,
+                              PipelineParallelSpecConfig)
     if kind not in classes:
-        raise ValueError(f"unknown serving engine kind {kind!r}; "
-                         f"want one of {sorted(classes) + ['tp', 'pp']}")
+        raise ValueError(
+            f"unknown serving engine kind {kind!r}; want one of "
+            f"{sorted(classes) + ['tp', 'pp', 'spec_pp']}")
     engine_cls, cfg_cls = classes[kind]
     cfg = cfg_cls(compile_cache_dir=compile_cache_dir, **config_dict)
     return engine_cls(model, cfg)
@@ -1402,7 +1412,13 @@ def save_for_generation(model, path, input_spec=None, engine_config=None,
 
 def _executable_set(kind, config):
     """Executable names for a serving record without building the engine
-    (the precompile=False recording path)."""
+    (the precompile=False recording path) — the per-stage set for the
+    pipeline kinds, mirroring each engine's executable_names()."""
+    if kind in ("pp", "spec_pp"):
+        # a pp-kind config only exists if its module is imported (the
+        # lazy contract _engine_kind documents), so this import is free
+        from .distributed.pp import pp_executable_names
+        return pp_executable_names(config, spec=(kind == "spec_pp"))
     names = ["decode"] + [f"prefill[{b}]" for b in config.prefill_buckets]
     if kind == "spec":
         names += ["draft_decode", "spec_verify"]

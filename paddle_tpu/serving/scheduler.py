@@ -65,6 +65,7 @@ from ..observability import reqtimeline as _rt
 from ..observability import tracecontext as _tc
 from ..profiler import RecordEvent, TracerEventType
 from .blocks import BlockAllocError
+from .engine import _engine_kind
 
 __all__ = ["ServingConfig", "Scheduler", "Request", "RequestHandle",
            "QueueFullError", "LoadShedError", "PRIORITIES"]
@@ -119,10 +120,16 @@ _M_PREEMPTED = _metrics.counter(
     "Preemptions under allocation pressure (victim requeued or errored)")
 _M_SPEC_PROPOSED = _metrics.counter(
     "serving_spec_proposed_total",
-    "Draft tokens proposed to the speculative verifier (occupied slots)")
+    "Draft tokens proposed to the speculative verifier (occupied "
+    "slots), labeled by the engine kind that proposed them (spec | "
+    "spec_pp) — the per-engine acceptance RATE is failure-class gated "
+    "by tools/metrics_report.py --compare per labelset",
+    labelnames=("engine",))
 _M_SPEC_ACCEPTED = _metrics.counter(
     "serving_spec_accepted_total",
-    "Draft tokens the speculative verifier accepted (occupied slots)")
+    "Draft tokens the speculative verifier accepted (occupied slots), "
+    "labeled by engine kind like serving_spec_proposed_total",
+    labelnames=("engine",))
 _M_ADOPTED = _metrics.counter(
     "serving_kv_adopted_total",
     "Requests placed from a handed-off KV bundle instead of a local "
@@ -316,6 +323,15 @@ class Scheduler:
     def __init__(self, engine, config=None, clock=time.monotonic, **kwargs):
         self.engine = engine
         self.config = config or ServingConfig(**kwargs)
+        # engine kind (ISSUE 14): labels the spec proposed/accepted
+        # counters and the run record, so a fleet mixing spec and
+        # spec_pp engines gates each acceptance rate separately.
+        # Minimal stub engines (tests) without a real config class
+        # degrade to "unknown" instead of failing construction.
+        try:
+            self._engine_kind = _engine_kind(engine.config)
+        except Exception:                                # noqa: BLE001
+            self._engine_kind = "unknown"
         self._clock = clock
         self._queue = collections.deque()
         self._slots = [None] * engine.slots   # Request or None
@@ -350,6 +366,7 @@ class Scheduler:
         cfg = self.engine.config
         rec = {
             "kind": "run",
+            "engine": self._engine_kind,
             "kv_dtype": getattr(cfg, "kv_dtype", "float32"),
             "weight_dtype": getattr(cfg, "weight_dtype", "float32")}
         # hybrid-parallel shape (ISSUE 13): lets serve_report label the
@@ -357,6 +374,11 @@ class Scheduler:
         tp, pp = getattr(cfg, "tp", 1), getattr(cfg, "pp", 1)
         if tp != 1 or pp != 1:
             rec["tp"], rec["pp"] = int(tp), int(pp)
+        # speculative shape (ISSUE 14): the spec AND spec_pp run records
+        # carry the window knob next to their acceptance-rate fields
+        gamma = getattr(cfg, "gamma", None)
+        if gamma is not None:
+            rec["gamma"] = int(gamma)
         self._metrics_f.write(json.dumps(rec) + "\n")
         self._metrics_f.flush()
 
@@ -641,8 +663,10 @@ class Scheduler:
                         req.spec_accepted += accepted
                         self._spec_proposed += proposed
                         self._spec_accepted += accepted
-                        _M_SPEC_PROPOSED.inc(proposed)
-                        _M_SPEC_ACCEPTED.inc(accepted)
+                        _M_SPEC_PROPOSED.labels(
+                            engine=self._engine_kind).inc(proposed)
+                        _M_SPEC_ACCEPTED.labels(
+                            engine=self._engine_kind).inc(accepted)
                     # append the slot's emitted run, truncating where the
                     # one-token loop would have stopped (eos / max_new) —
                     # the delivered stream stays bit-identical to it

@@ -168,7 +168,7 @@ class SpeculativeEngine(PagedGenerationEngine):
             dcfg.num_layers, config.slots, config.max_len, dcfg.num_heads,
             dcfg.hidden_size // dcfg.num_heads,
             self._draft_params["wte.weight"].dtype)
-        self._draft_kv = dkv.layers
+        self._draft_kv = self._place_draft_kv(dkv.layers)
         self._draft_pos = np.zeros((config.slots,), np.int32)
         self.trace_counts["draft_decode"] = 0
         self.trace_counts["spec_verify"] = 0
@@ -249,6 +249,19 @@ class SpeculativeEngine(PagedGenerationEngine):
                 self._draft_params[name] = self._params[name]
         self._build_draft_decode_params()      # re-quantize the new draft
         return n
+
+    # -- draft placement hooks (identity here; the pipeline-parallel
+    # composition pins the whole draft onto stage 0's mesh) ------------------
+    def _place_draft_kv(self, layers):
+        """Where the draft's dense KV cache lives — the default device
+        here; `PipelineParallelSpeculativeEngine` overrides to place it
+        on the first stage's mesh (draft-on-first-stage)."""
+        return layers
+
+    def _draft_feed(self, tokens):
+        """Placement of the round's t0 token vector before it enters the
+        draft decode executable."""
+        return tokens
 
     # -- draft functional forward -------------------------------------------
     def _run_draft(self, params, lk, lv, pos, ids):
@@ -379,6 +392,32 @@ class SpeculativeEngine(PagedGenerationEngine):
         super().reset_slot(slot)
         self._draft_pos[int(slot)] = 0
 
+    def _draft_propose(self):
+        """The γ-proposal draft loop of one speculative round: γ
+        single-token draft decodes plus the cache-completing extra feed
+        of d_γ (its proposal discarded). Returns (window [S, γ+1] device
+        array, dk, dv, dpos) — the caller commits the draft cache only
+        after the verify sticks. Shared verbatim by the single-device
+        and pipeline-parallel verify paths."""
+        dk = [l.k for l in self._draft_kv]
+        dv = [l.v for l in self._draft_kv]
+        dpos = jnp.asarray(self._draft_pos)
+        feed = self._draft_feed(jnp.asarray(self._last_tokens))
+        # the window stays ON DEVICE: fetching each proposal to host
+        # would serialize the γ draft dispatches on a round-trip sync
+        # apiece; stacked device columns let them pipeline and defer
+        # the only host sync of the round to the verify output
+        cols = [feed]
+        for _ in range(self.config.gamma):
+            feed, dk, dv, dpos = self._draft_decode(
+                self._draft_decode_params, dk, dv, dpos, feed)
+            cols.append(feed)
+        # the extra feed writes d_γ's K/V so a fully-accepted window
+        # leaves the draft cache complete; its proposal is discarded
+        _, dk, dv, dpos = self._draft_decode(
+            self._draft_decode_params, dk, dv, dpos, feed)
+        return jnp.stack(cols, axis=1), dk, dv, dpos  # window [S, γ+1]
+
     def decode_many(self):
         """One speculative round for every slot: γ draft proposals, one
         target verify, position rollback. Returns (tokens [S, γ+1],
@@ -393,24 +432,7 @@ class SpeculativeEngine(PagedGenerationEngine):
         t0 = time.perf_counter()
         with RecordEvent("serving::spec_draft", TracerEventType.UserDefined,
                          {"gamma": gamma, "slots": c.slots}):
-            dk = [l.k for l in self._draft_kv]
-            dv = [l.v for l in self._draft_kv]
-            dpos = jnp.asarray(self._draft_pos)
-            feed = jnp.asarray(self._last_tokens)
-            # the window stays ON DEVICE: fetching each proposal to host
-            # would serialize the γ draft dispatches on a round-trip sync
-            # apiece; stacked device columns let them pipeline and defer
-            # the only host sync of the round to the verify output
-            cols = [feed]
-            for i in range(gamma):
-                feed, dk, dv, dpos = self._draft_decode(
-                    self._draft_decode_params, dk, dv, dpos, feed)
-                cols.append(feed)
-            # the extra feed writes d_γ's K/V so a fully-accepted window
-            # leaves the draft cache complete; its proposal is discarded
-            _, dk, dv, dpos = self._draft_decode(
-                self._draft_decode_params, dk, dv, dpos, feed)
-            window = jnp.stack(cols, axis=1)          # [S, γ+1]
+            window, dk, dv, dpos = self._draft_propose()
         draft_s = time.perf_counter() - t0
         _M_DRAFT_SECONDS.observe(draft_s)
         t1 = time.perf_counter()

@@ -216,6 +216,112 @@ def test_metrics_compare_flags_spec_acceptance_rate_drop(tmp_path):
                    metrics_report.compare_counters(a, c))
 
 
+def _snapshot_with_labeled(counters):
+    """Snapshot whose counters carry per-sample labels:
+    {name: [(labels_dict, value), ...]}."""
+    return {"schema": metrics_report.SCHEMA, "ts": 1.0, "pid": 1,
+            "metrics": [
+                {"name": n, "type": "counter", "help": "",
+                 "labelnames": sorted({k for lb, _ in samples
+                                       for k in lb}),
+                 "samples": [{"labels": lb, "value": v}
+                             for lb, v in samples]}
+                for n, samples in counters.items()]}
+
+
+def test_metrics_compare_flags_spec_acceptance_rate_drop_pp_arm(tmp_path):
+    """ISSUE 14 gate: the spec counters are labeled per ENGINE KIND, and
+    the acceptance-rate rule pairs + gates each labelset separately — a
+    spec×pp draft rotting on the pipeline ring is flagged even while
+    the single-device engine's rate stays healthy (and must not drag
+    the healthy series into the regression list)."""
+    a = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 80),
+                                        ({"engine": "spec_pp"}, 75)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 100),
+                                        ({"engine": "spec_pp"}, 100)]})
+    b = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 160),
+                                        ({"engine": "spec_pp"}, 90)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 200),
+                                        ({"engine": "spec_pp"}, 300)]})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_spec_acceptance_rate{engine=spec_pp}") == \
+        "hit rate dropped"
+    assert "serving_spec_acceptance_rate{engine=spec}" not in why
+    # the CLI gate exits nonzero and names the labeled series
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_spec_acceptance_rate{engine=spec_pp}" in bad.stdout
+
+
+def test_metrics_compare_spans_label_schema_boundary():
+    """A baseline recorded BEFORE the spec counters grew the engine
+    label must still gate: the labeled run's family aggregate pairs
+    with the bare baseline rate, and the bare-vs-labeled key mismatch
+    is read as a schema change — never as counters vanishing/appearing
+    ('work counter shrank' false positives)."""
+    old = _snapshot_with({"serving_spec_accepted_total": 75,
+                          "serving_spec_proposed_total": 100})
+    new_bad = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 90)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 300)]})
+    regs = metrics_report.compare_counters(old, new_bad)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_spec_acceptance_rate") == "hit rate dropped"
+    assert not any(w == "work counter shrank" for w in why.values())
+    # same rate and volume across the boundary: clean both directions
+    # (the bare row compares against the labeled side's family SUM, so
+    # the volume rules keep gating across the schema change too)
+    new_ok = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 75)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 100)]})
+    assert metrics_report.compare_counters(old, new_ok) == []
+    assert metrics_report.compare_counters(new_ok, old) == []
+    # two LABELED runs with identical per-engine rates but a shifted
+    # traffic mix: the per-labelset series gate, and the bare family
+    # aggregate must NOT fire on the mix shift (Simpson's paradox)
+    mix_a = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 90),
+                                        ({"engine": "spec_pp"}, 30)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 100),
+                                        ({"engine": "spec_pp"}, 100)]})
+    mix_b = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 90),
+                                        ({"engine": "spec_pp"}, 300)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 100),
+                                        ({"engine": "spec_pp"}, 1000)]})
+    assert not any(w == "hit rate dropped" for *_, w in
+                   metrics_report.compare_counters(mix_a, mix_b))
+    # a labeled MEMBER vanishing between two labeled runs is NOT a
+    # schema change: an engine dropping out of the fleet must keep
+    # tripping the counter rules
+    gone = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 90)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 100)]})
+    regs = metrics_report.compare_counters(mix_a, gone)
+    assert any(k == "serving_spec_accepted_total{engine=spec_pp}"
+               and w == "work counter shrank" for k, *_, w in regs)
+    # volume rules bridge too: a 99% collapse in spec WORK across the
+    # boundary gates even while the acceptance rate holds — the bare
+    # row compares against the labeled side's family sum
+    tiny_new = _snapshot_with_labeled({
+        "serving_spec_accepted_total": [({"engine": "spec"}, 7)],
+        "serving_spec_proposed_total": [({"engine": "spec"}, 10)]})
+    regs = metrics_report.compare_counters(old, tiny_new)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_spec_accepted_total") == "work counter shrank"
+    assert why.get("serving_spec_proposed_total") == "work counter shrank"
+
+
 def test_metrics_compare_flags_quant_quality_regressions(tmp_path):
     """ISSUE 11 gate: a `serving_quant_greedy_match` drop (the quantized
     path disagreeing with its f32 oracle) and a `serving_quant_logit_kl`

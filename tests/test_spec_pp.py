@@ -1,0 +1,378 @@
+"""Speculative decode on the pipeline ring (ISSUE 14): multi-token
+verify windows that eat the pp bubble.
+
+Acceptance, mapped:
+  - greedy spec×pp streams are BIT-IDENTICAL to the one-token pp engine
+    AND the single-device speculative engine on the (tp=2, pp=2) CPU
+    mesh, with per-stage compile-once asserted — draft_decode==1,
+    verify_pp==1 per stage, decode_pp=={} (the one-token ring never
+    traces during spec), spec_verify==0 (the single-device verify
+    executable never runs on the mesh)
+    (test_spec_pp_bit_identical_to_both_parents);
+  - `build_serving_tables` grows a tokens-per-tick dimension: the same
+    M+pp-1 ticks move up to (γ+1)× the tokens, amortizing the
+    fill/drain bubble per emitted token
+    (test_serving_tables_tokens_per_tick);
+  - slow tier (the PR 11/13 tier-audit precedent — the lean tier-1
+    core above stays ~25s): host-side model materialization (ROADMAP
+    4d: free_eager_device_copies re-points the eager Layer at host
+    numpy, the engine still serves deterministically and hot-swaps
+    from the host state_dict — no full-model device copy survives);
+    the engine-kind-labeled run record + spec counters; scheduler
+    preemption/eos exactness; int8+swap+handoff composition with v3
+    RNG generation counters across spec rounds; the gencfg/make_engine
+    round-trip; and the load-harness spec_pp arm.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel import pipeline_schedule as psched
+from paddle_tpu.serving import (PagedEngineConfig, PagedGenerationEngine,
+                                Scheduler, ServingConfig, SpecDecodeConfig,
+                                SpeculativeEngine)
+from paddle_tpu.serving.distributed import (
+    PipelineParallelEngineConfig, PipelineParallelPagedEngine,
+    PipelineParallelSpecConfig, PipelineParallelSpeculativeEngine,
+    free_eager_device_copies)
+from paddle_tpu.serving.engine import _engine_kind, make_engine
+from paddle_tpu.text.models import gpt_tiny
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+import serve_report  # noqa: E402
+
+VOCAB = 1024
+ENGINE_KW = dict(slots=2, max_len=64, block_size=8)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    m = gpt_tiny()
+    m.eval()
+    return m
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(0, VOCAB, n).tolist()
+
+
+def _spec_stream(engine, slot_prompts, n_tokens):
+    rows = [[engine.prefill(s, p)] for s, p in enumerate(slot_prompts)]
+    while min(len(r) for r in rows) < n_tokens:
+        toks, n_emit = engine.decode_many()
+        for s in range(len(slot_prompts)):
+            for j in range(int(n_emit[s])):
+                rows[s].append(int(toks[s, j]))
+    return [r[:n_tokens] for r in rows]
+
+
+# --------------------------------------------------- schedule machinery
+
+def test_serving_tables_tokens_per_tick():
+    """The tokens-per-tick dimension: same M+pp-1 tick skeleton, every
+    busy (tick, stage) cell carrying its microbatch's W token slots —
+    one ring pass moves M*W tokens, so per-emitted-token tick cost
+    falls W-fold at full acceptance."""
+    tbl2 = psched.build_serving_tables(4, 3)
+    tbl3 = psched.build_serving_tables(4, 3, tokens_per_tick=4)
+    assert tbl3.shape == (6, 3, 4)
+    for t in range(6):
+        for s in range(3):
+            g = t - s
+            if 0 <= g < 4:
+                assert tbl2[t, s] == g
+                assert list(tbl3[t, s]) == [g * 4 + w for w in range(4)]
+            else:
+                assert (tbl3[t, s] == -1).all()
+    s2 = psched.serving_schedule_stats(tbl2)
+    s3 = psched.serving_schedule_stats(tbl3)
+    # the SCHEDULE's bubble fraction is unchanged — what changes is the
+    # tokens each busy tick moves
+    assert s3["bubble_frac"] == pytest.approx(s2["bubble_frac"])
+    assert s3["stage_busy"] == s2["stage_busy"]
+    assert s3["tokens_per_tick"] == 4
+    assert s3["ticks_per_token_max"] == pytest.approx(6 / 16)
+    with pytest.raises(ValueError, match="tokens_per_tick"):
+        psched.build_serving_tables(2, 2, tokens_per_tick=0)
+
+
+def test_spec_pp_config_validation():
+    cfg = PipelineParallelSpecConfig(pp=2, tp=2, gamma=3, **ENGINE_KW)
+    assert _engine_kind(cfg) == "spec_pp"
+    # round-trips through the .gencfg record form
+    cfg2 = PipelineParallelSpecConfig(**cfg.as_dict())
+    assert cfg2.gamma == 3 and cfg2.pp == 2 and cfg2.tp == 2
+    with pytest.raises(ValueError, match="greedy"):
+        PipelineParallelSpecConfig(pp=2, decode_strategy="sampling")
+    with pytest.raises(ValueError, match="gamma"):
+        PipelineParallelSpecConfig(pp=2, gamma=0)
+    with pytest.raises(ValueError, match="pp must be >= 2"):
+        PipelineParallelSpecConfig(pp=1)
+
+
+# ------------------------------------------------------- THE acceptance
+
+def test_spec_pp_bit_identical_to_both_parents(tiny):
+    """THE acceptance run: greedy spec×pp streams on the (tp=2, pp=2)
+    mesh equal the one-token pp engine's AND the single-device
+    speculative engine's, token for token — with per-stage compile-once
+    asserted and both one-token paths proven never to trace."""
+    prompts = [_prompt(210, 7), _prompt(211, 13)]
+    n = 11
+
+    pp = PipelineParallelPagedEngine(
+        tiny, PipelineParallelEngineConfig(pp=2, tp=2, **ENGINE_KW))
+    rows_pp = [[pp.prefill(s, p)] for s, p in enumerate(prompts)]
+    for _ in range(n - 1):
+        pp.ensure_decode_capacity()
+        t = pp.decode()
+        for s in range(2):
+            rows_pp[s].append(int(t[s]))
+
+    spec = SpeculativeEngine(tiny, SpecDecodeConfig(gamma=3,
+                                                    draft_layers=1,
+                                                    **ENGINE_KW))
+    rows_spec = _spec_stream(spec, prompts, n)
+
+    sp = PipelineParallelSpeculativeEngine(
+        tiny, PipelineParallelSpecConfig(pp=2, tp=2, gamma=3,
+                                         draft_layers=1, **ENGINE_KW))
+    rows = _spec_stream(sp, prompts, n)
+    assert rows == rows_pp
+    assert rows == rows_spec
+    # the verify window really multiplies: at least one round emitted
+    # more than one token per slot
+    assert sp.decode_write_tokens == 4
+    # compile discipline, per stage: ONE verify executable per stage,
+    # ONE draft decode, and the one-token paths never trace
+    assert sp.trace_counts["verify_pp"] == {0: 1, 1: 1}
+    assert sp.trace_counts["draft_decode"] == 1
+    assert sp.trace_counts["spec_verify"] == 0
+    assert sp.trace_counts["decode_pp"] == {}
+    assert sp.trace_counts["decode"] == 0
+    assert all(v == 1 for v in sp.trace_counts["prefill_pp"].values())
+    # the draft rides stage 0's mesh — its weights and dense KV are
+    # honest stage-0 bytes next to the shard, visible to hbm_accounting
+    acc_pp, acc_sp = pp.hbm_accounting(), sp.hbm_accounting()
+    assert acc_sp["max_device_total"] > acc_pp["max_device_total"]
+    assert acc_sp["weights_total"] > acc_pp["weights_total"]
+
+
+@pytest.mark.slow
+def test_host_materialization_frees_eager_copies():
+    """ROADMAP 4d regression: after free_eager_device_copies the eager
+    Layer is wholly host-backed (no full-model device copy survives
+    engine construction), the engine's own master copy is host numpy,
+    serving stays deterministic, and a hot-swap from the host
+    state_dict still lands."""
+    m = gpt_tiny()
+    m.eval()
+    eng = PipelineParallelSpeculativeEngine(
+        m, PipelineParallelSpecConfig(pp=2, gamma=3, **ENGINE_KW))
+    prompt = _prompt(220, 9)
+    before = _spec_stream(eng, [prompt], 8)[0]
+    moved, freed = free_eager_device_copies(m)
+    assert moved > 0 and freed > 0
+    assert all(isinstance(t._data, np.ndarray)
+               for t in m.state_dict().values())
+    # second call is a no-op — everything already lives on host
+    assert free_eager_device_copies(m) == (0, 0)
+    # the truncated DRAFT Layer aliases the target's device arrays
+    # through its OWN Tensors — the worker frees it too, or the copies
+    # survive behind the engine's back
+    d_moved, d_freed = free_eager_device_copies(eng.draft_model)
+    assert d_moved > 0 and d_freed > 0
+    assert all(isinstance(t._data, np.ndarray)
+               for t in eng.draft_model.state_dict().values())
+    # the engine's master copy was host-resident all along
+    assert all(isinstance(v, np.ndarray) for v in eng._params.values())
+    # replay after the free: same engine, same stream
+    eng.reset_slot(0)
+    assert _spec_stream(eng, [prompt], 8)[0] == before
+    # hot-swap from the host-backed state_dict still works and keeps
+    # the stream (same weights in, same stream out)
+    eng.swap_params({k: np.asarray(v.numpy())
+                     for k, v in m.state_dict().items()})
+    eng.reset_slot(0)
+    assert _spec_stream(eng, [prompt], 8)[0] == before
+
+
+@pytest.mark.slow
+def test_run_record_engine_fields(tiny, tmp_path):
+    """The scheduler's run record names the engine kind + gamma, the
+    serve_report schema accepts and renders them, and the registry's
+    spec counters carry the engine label."""
+    from paddle_tpu.observability import metrics as _metrics
+    metrics_path = str(tmp_path / "m.jsonl")
+    eng = SpeculativeEngine(tiny, SpecDecodeConfig(gamma=3, **ENGINE_KW))
+    sched = Scheduler(eng, ServingConfig(default_max_new_tokens=5,
+                                         metrics_path=metrics_path))
+    h = sched.submit(_prompt(230, 8))
+    sched.drain()
+    assert h.status == "DONE"
+    records = serve_report.load(metrics_path)
+    assert serve_report.validate_records(records) == []
+    run = next(r for r in records if r["kind"] == "run")
+    assert run["engine"] == "spec" and run["gamma"] == 3
+    summary = serve_report.summarize(records)
+    assert summary["engine"] == "spec" and summary["gamma"] == 3
+    assert "engine: spec (gamma=3)" in serve_report.render(summary)
+    flat = _metrics.flatten_snapshot(_metrics.registry().snapshot(),
+                                     kinds=("counter",))
+    assert flat.get("serving_spec_proposed_total{engine=spec}", 0) > 0
+    # pre-ISSUE-14 run records (no engine field) stay gradeable
+    old = [{"kind": "run", "kv_dtype": "float32",
+            "weight_dtype": "float32"}]
+    assert serve_report.validate_records(old) == []
+    assert serve_report.summarize(old)["engine"] is None
+
+
+# ----------------------------------------- compose + chaos (slow tier)
+
+@pytest.mark.slow
+def test_spec_pp_scheduler_preemption_and_eos_exact(tiny):
+    """Through the scheduler: mid-stream preemption under an
+    oversubscribed pool AND an eos accepted mid-window both truncate
+    exactly where the one-token loop would — streams stay bit-identical
+    through recompute restarts, spec telemetry flows per request, and
+    no blocks leak."""
+    from paddle_tpu.text.models import GPTForGeneration
+    import paddle_tpu as paddle
+
+    def reference(prompt, max_new, eos=None):
+        gen = GPTForGeneration(tiny)
+        ids = paddle.to_tensor(np.asarray(prompt)[None, :].astype("int64"))
+        out, lengths = gen.generate(ids, max_new_tokens=max_new,
+                                    eos_token_id=eos)
+        return list(out.numpy()[0][:int(lengths.numpy()[0])])
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 1000, 6).tolist() for _ in range(4)]
+    eng = PipelineParallelSpeculativeEngine(
+        tiny, PipelineParallelSpecConfig(
+            pp=2, gamma=3, slots=2, max_len=32, block_size=4,
+            num_blocks=6, enable_prefix_cache=False))
+    sched = Scheduler(eng, max_queue=16)
+    hs = [sched.submit(p, max_new_tokens=6) for p in prompts]
+    sched.run_until_idle()
+    assert sched.counts["serving.preempted"] > 0
+    for h, p in zip(hs, prompts):
+        assert h.status == "DONE", (h.status, h.error)
+        assert h.tokens == reference(p, 6)
+        assert h.spec_proposed > 0
+    assert eng.block_pool.in_use == 0
+
+    # eos inside an accepted window truncates exactly
+    prompt = _prompt(240, 6)
+    base = reference(prompt, 8)
+    eos = base[3]
+    want = reference(prompt, 8, eos=eos)
+    assert len(want) < len(base)
+    eng2 = PipelineParallelSpeculativeEngine(
+        tiny, PipelineParallelSpecConfig(pp=2, gamma=4, slots=2,
+                                         max_len=64, block_size=8,
+                                         eos_token_id=eos))
+    sched2 = Scheduler(eng2, max_queue=4)
+    h2 = sched2.submit(prompt, max_new_tokens=8)
+    sched2.run_until_idle()
+    assert h2.status == "DONE"
+    assert h2.tokens == want
+
+
+@pytest.mark.slow
+def test_spec_pp_int8_swap_handoff_compose(tiny):
+    """The layers compose on the ring: int8 KV+weights spec×pp matches
+    the int8 single-device speculative engine; a hot-swap re-places
+    every stage AND re-sources the shared draft in the same window; a
+    mid-stream extract off the spec×pp mesh adopts onto one device and
+    continues exactly; and the adopting slot's v3 RNG generation
+    counter reflects every window token emitted."""
+    prompt = _prompt(250, 10)
+    q_sd = SpeculativeEngine(
+        tiny, SpecDecodeConfig(gamma=3, kv_dtype="int8",
+                               weight_dtype="int8", **ENGINE_KW))
+    q_pp = PipelineParallelSpeculativeEngine(
+        tiny, PipelineParallelSpecConfig(
+            pp=2, gamma=3, kv_dtype="int8", weight_dtype="int8",
+            **ENGINE_KW))
+    assert _spec_stream(q_pp, [prompt], 9)[0] == \
+        _spec_stream(q_sd, [prompt], 9)[0]
+
+    # float ring: swap mid-stream (same weights -> same stream), then
+    # hand off to a single-device engine
+    ref = SpeculativeEngine(tiny, SpecDecodeConfig(gamma=3, **ENGINE_KW))
+    want = _spec_stream(ref, [prompt], 14)[0]
+    sp = PipelineParallelSpeculativeEngine(
+        tiny, PipelineParallelSpecConfig(pp=2, gamma=3, **ENGINE_KW))
+    got = [sp.prefill(0, prompt)]
+    toks, n_emit = sp.decode_many()
+    got += [int(toks[0, j]) for j in range(int(n_emit[0]))]
+    sp.swap_params({k: np.asarray(v.numpy())
+                    for k, v in tiny.state_dict().items()})
+    toks, n_emit = sp.decode_many()
+    got += [int(toks[0, j]) for j in range(int(n_emit[0]))]
+    assert got == want[:len(got)]
+    # the slot's sampler generation index counts every emitted token —
+    # what a v3 KV-handoff bundle must carry for failover-exact resume
+    assert sp.slot_rng(0)[1] == len(got)
+    ks, vs, plen = sp.extract_kv(0)
+    B = PagedGenerationEngine(tiny, PagedEngineConfig(**ENGINE_KW))
+    B.adopt_kv(0, ks, vs, plen, got[-1], rng=sp.slot_rng(0))
+    cont = []
+    for _ in range(3):
+        B.ensure_decode_capacity()
+        cont.append(int(B.decode()[0]))
+    assert cont == want[len(got):len(got) + 3]
+
+
+@pytest.mark.slow
+def test_spec_pp_make_engine_and_gencfg_roundtrip(tiny, tmp_path):
+    """make_engine rebuilds the spec×pp engine from its recorded kind +
+    config dict, and the recorded executable set names the per-stage
+    verify/draft executables."""
+    from paddle_tpu.serving.engine import _executable_set
+    cfg = PipelineParallelSpecConfig(pp=2, gamma=2, **ENGINE_KW)
+    eng = make_engine(tiny, "spec_pp", cfg.as_dict())
+    assert isinstance(eng, PipelineParallelSpeculativeEngine)
+    assert eng.config.gamma == 2 and eng.config.pp == 2
+    names = _executable_set("spec_pp", cfg)
+    assert "verify_stage[0]" in names and "verify_stage[1]" in names
+    assert "draft_decode" in names
+    assert "decode_stage[0]" in names
+    # the record and the engine derive from ONE helper — they can
+    # never drift
+    assert names == eng.executable_names()
+    assert _executable_set("pp", cfg) == \
+        [n for n in names if not n.startswith(("draft", "verify"))]
+    prompt = _prompt(260, 8)
+    ref = SpeculativeEngine(tiny, SpecDecodeConfig(gamma=2, **ENGINE_KW))
+    assert _spec_stream(eng, [prompt], 7)[0] == \
+        _spec_stream(ref, [prompt], 7)[0]
+
+
+@pytest.mark.slow
+def test_load_harness_spec_pp_arm(tiny):
+    """The harness's spec_pp arm completes the deterministic trace,
+    reports acceptance rate AND pp bubble together, and keeps the
+    per-stage compile counts bounded."""
+    import load_harness
+    traffic = load_harness.TrafficConfig(
+        users=4, requests=8, rate_rps=500.0, prefix_pool=2, prefix_len=16,
+        suffix_min=2, suffix_max=6, max_new_tokens=4, seed=0)
+    out = load_harness.run_harness(
+        tiny, "spec_pp", traffic, slots=8, max_len=64, block_size=8,
+        num_blocks=47, virtual_step_s=0.05, tp=1, pp=2, gamma=3)
+    assert out["by_status"] == {"DONE": 8}
+    assert out["spec_proposed"] > 0
+    assert 0.0 <= out["spec_acceptance_rate"] <= 1.0
+    assert out["gamma"] == 3 and out["pp"] == 2
+    assert 0.0 < out["pp_stats"]["bubble_fraction"] < 1.0
+    tc = out["trace_counts"]
+    assert tc["verify_pp"] == {"0": 1, "1": 1}
+    assert tc["draft_decode"] == 1
+    assert tc["spec_verify"] == 0
+    assert tc["decode_pp"] == {}
+    assert tc["decode"] == 0

@@ -244,7 +244,9 @@ def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
     """A serving engine of any KV/decode layout over `model`. `quant`
     is paged with int8 KV pools AND int8 decode weights (ISSUE 11);
     `tp`/`pp` are the hybrid-parallel arms (ISSUE 13) over this
-    process's local devices — `pp` takes both mesh knobs."""
+    process's local devices — `pp` takes both mesh knobs; `spec_pp`
+    (ISSUE 14) runs speculative γ+1-token verify windows on the
+    pipeline ring (gamma/draft_layers compose with pp/tp)."""
     from paddle_tpu.serving import (GenerationEngine, PagedGenerationEngine,
                                     SpeculativeEngine)
     if kind == "quant":
@@ -282,8 +284,21 @@ def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
                 num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
                 attention_impl=attention_impl, kv_dtype=kv_dtype,
                 weight_dtype=weight_dtype))
+    if kind == "spec_pp":
+        # the ISSUE 14 composition: --gamma/--draft-layers compose with
+        # --pp/--tp — speculative verify windows on the pipeline ring
+        from paddle_tpu.serving.distributed.pp import (
+            PipelineParallelSpecConfig, PipelineParallelSpeculativeEngine)
+        return PipelineParallelSpeculativeEngine(
+            model, PipelineParallelSpecConfig(
+                pp=pp, tp=tp, prefill_chunk=prefill_chunk, slots=slots,
+                max_len=max_len, block_size=block_size,
+                num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
+                attention_impl=attention_impl, gamma=gamma,
+                draft_layers=draft_layers, kv_dtype=kv_dtype,
+                weight_dtype=weight_dtype))
     raise ValueError(f"unknown engine kind {kind!r} "
-                     f"(want dense|paged|spec|quant|tp|pp)")
+                     f"(want dense|paged|spec|quant|tp|pp|spec_pp)")
 
 
 def run_harness(model, kind, traffic, slots, max_len, block_size=8,
@@ -291,9 +306,13 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 shed_watermark=None, virtual_step_s=None,
                 metrics_out=None, gamma=3, draft_layers=1,
                 attention_impl="gather", kv_dtype="float32",
-                weight_dtype="float32", tp=2, pp=2, prefill_chunk=None):
+                weight_dtype="float32", tp=2, pp=2, prefill_chunk=None,
+                engine_sink=None):
     """Build engine+scheduler, replay `traffic`, return the summary
-    (annotated with the engine's KV budget and compile counters)."""
+    (annotated with the engine's KV budget and compile counters).
+    `engine_sink`: optional list the built (now-warmed) engine is
+    appended to, so a caller can keep driving its compiled executables
+    — bench's steady-state probe, which must not pay a second build."""
     from paddle_tpu.observability import metrics as _metrics
     from paddle_tpu.serving import Scheduler
 
@@ -325,11 +344,11 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
         k: ({str(ik): iv for ik, iv in v.items()}
             if isinstance(v, dict) else v)
         for k, v in engine.trace_counts.items()}
-    if kind in ("paged", "spec", "quant", "tp", "pp"):
+    if kind in ("paged", "spec", "quant", "tp", "pp", "spec_pp"):
         summary["blocks_total"] = engine.block_pool.capacity
         pc = engine.prefix_cache
         summary["prefix_cache_blocks"] = len(pc) if pc is not None else 0
-    if kind == "spec":
+    if kind in ("spec", "spec_pp"):
         m = sched.metrics()
         summary["spec_proposed"] = m.get("spec_proposed", 0)
         summary["spec_accepted"] = m.get("spec_accepted", 0)
@@ -339,14 +358,19 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     # bench arms equalize/gate on — never dtype-width arithmetic
     summary["hbm_max_device_bytes"] = \
         engine.hbm_accounting()["max_device_total"]
-    if kind in ("tp", "pp"):
+    if kind in ("tp", "pp", "spec_pp"):
         summary["tp"] = engine.config.tp
-    if kind == "pp":
+    if kind in ("pp", "spec_pp"):
+        # acceptance rate and bubble fraction ride the SAME summary for
+        # the composed arm (ISSUE 14): the two failure-class gauges of
+        # the spec×pp win, reported together
         summary["pp"] = engine.config.pp
         summary["pp_stats"] = engine.pp_stats()
     if metrics_out:
         _metrics.registry().write_snapshot(metrics_out)
         summary["metrics_snapshot"] = metrics_out
+    if engine_sink is not None:
+        engine_sink.append(engine)
     return summary
 
 
@@ -445,11 +469,14 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--engine", default="both",
                    choices=("dense", "paged", "spec", "quant", "tp",
-                            "pp", "both", "all"),
+                            "pp", "spec_pp", "both", "all"),
                    help="'both' = dense+paged; 'all' adds the "
                         "spec-decode and quantized arms; tp/pp are the "
                         "hybrid-parallel engines over this process's "
-                        "local devices (ISSUE 13)")
+                        "local devices (ISSUE 13); spec_pp composes "
+                        "speculative verify windows onto the pipeline "
+                        "ring (--gamma/--draft-layers with --pp/--tp, "
+                        "ISSUE 14)")
     p.add_argument("--model", default="gpt_tiny")
     p.add_argument("--users", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)

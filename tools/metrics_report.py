@@ -398,23 +398,51 @@ def render(records, title="metrics report"):
 def _hit_rates(flat):
     """{name: rate} for every rate-rule counter pair with at least one
     event (X_hits/X_misses hit rate, X_accepted/X_proposed acceptance
+    rate). Labeled pairs pair PER LABELSET and keep the labels on the
+    derived rate key (ISSUE 14: serving_spec_*_total{engine=spec_pp}
+    gates separately from the single-device engine's series — one
+    engine's draft rotting must not hide behind another's healthy
     rate)."""
     rates = {}
+    agg = {}
     for key, num in flat.items():
         for pat, denom_suffix, denom_adds, rate_suffix in _RATE_RULES:
             m = pat.match(key)
             if not m:
                 continue
-            denom_key = m.group("base") + denom_suffix \
-                + (m.group("labels") or "")
+            labels = m.group("labels") or ""
+            denom_key = m.group("base") + denom_suffix + labels
             denom = flat.get(denom_key)
             if denom is None:
                 continue
             total = num + denom if denom_adds else denom
             if total <= 0:
                 continue
-            rates[m.group("base") + rate_suffix] = num / total
+            rates[m.group("base") + rate_suffix + labels] = num / total
+            # labeled pairs ALSO roll up into a family aggregate under
+            # the BARE rate name, so a baseline recorded before a family
+            # grew labels (unlabeled totals) still pairs and gates
+            # against a labeled run across the upgrade boundary
+            n0, t0 = agg.get(m.group("base") + rate_suffix, (0.0, 0.0))
+            agg[m.group("base") + rate_suffix] = (n0 + num, t0 + total)
+    for key, (n, t) in agg.items():
+        rates.setdefault(key, n / t)
     return rates
+
+
+def _schema_bridge(key, other_flat):
+    """True when `key` and the OTHER snapshot express the same family
+    under opposite label schemas — bare here vs labeled there, or
+    labeled here vs bare there: the upgrade boundary of a family that
+    grew labels between runs, where the per-key counter rules must not
+    read the key mismatch as a counter appearing/vanishing. A LABELED
+    key missing from a side that is itself labeled is NOT a schema
+    change — it is a vanished member (e.g. an engine dropping out of
+    the fleet) and must keep gating."""
+    fam = key.split("{", 1)[0]
+    if "{" in key:
+        return fam in other_flat             # labeled here, bare there
+    return any(k.startswith(fam + "{") for k in other_flat)
 
 
 def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
@@ -431,7 +459,25 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
     for key in sorted(set(a) | set(b)):
         if not keep(key):
             continue                  # member absent from one side
-        va, vb = a.get(key, 0.0), b.get(key, 0.0)
+        va, vb = a.get(key), b.get(key)
+        # label-schema bridge (ISSUE 14): when one run writes a family
+        # bare and the other labeled (the upgrade boundary — e.g. the
+        # spec counters grew an engine label), the bare and labeled
+        # keys are the SAME data. Labeled keys defer to the bare row,
+        # and the bare row compares against the labeled side's family
+        # SUM — so the volume rules (work shrank / failure grew) keep
+        # gating across the boundary, next to the aggregate rate.
+        if va is None and _schema_bridge(key, a):
+            if "{" in key:
+                continue              # covered by the bare-family row
+            va = sum(v for k2, v in a.items()
+                     if k2.startswith(key + "{"))
+        if vb is None and _schema_bridge(key, b):
+            if "{" in key:
+                continue
+            vb = sum(v for k2, v in b.items()
+                     if k2.startswith(key + "{"))
+        va, vb = va or 0.0, vb or 0.0
         delta = vb - va
         if abs(delta) < min_delta:
             continue
@@ -447,6 +493,15 @@ def compare_counters(a_rec, b_rec, max_regress_pct=25.0, min_delta=1.0):
     ra, rb = _hit_rates(a), _hit_rates(b)
     for key in sorted(set(ra) & set(rb)):
         if not keep(key):
+            continue
+        if "{" not in key \
+                and any(k.startswith(key + "{") for k in ra) \
+                and any(k.startswith(key + "{") for k in rb):
+            # both runs carry per-labelset rates for this family: those
+            # series gate. The bare family aggregate exists only to
+            # bridge the pre-label schema boundary — gating it between
+            # two labeled runs would flag a pure traffic-MIX shift as a
+            # rate drop (Simpson's paradox) with no per-engine change
             continue
         va, vb = ra[key], rb[key]
         if va <= 0:

@@ -45,11 +45,14 @@ Usage: python tools/serve_report.py serve_metrics.jsonl
 import json
 import sys
 
+# pipeline-serving step fields (ISSUE 13): cumulative tick accounting
+# of a pipeline-parallel engine — absent on every other engine kind,
+# type-validated when present (render formats them numerically)
 STEP_FIELDS = {"kind": str, "step": int, "t": (int, float),
                "queue_depth": int, "active_slots": int,
-               "tokens_generated": int}
-# pipeline-serving step fields (ISSUE 13): cumulative tick accounting
-# of a pipeline-parallel engine — absent on every other engine kind
+               "tokens_generated": int,
+               "pp_bubble_fraction": (int, float),
+               "pp_stage_busy": list}
 OPTIONAL_STEP_FIELDS = {"pp_bubble_fraction", "pp_stage_busy"}
 REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "prompt_len": int, "tokens": int, "priority": int,
@@ -59,14 +62,18 @@ REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "decode_s": (int, float, type(None))}
 # `run` header records (ISSUE 11): the engine's serving precisions and,
 # when a quality harness appended one, the measured greedy-match rate
-# vs the f32 oracle. EVERY field is optional — files written before the
-# quantized tier (no run record at all) stay gradeable.
-RUN_FIELDS = {"kind": str, "kv_dtype": str, "weight_dtype": str,
-              "tp": int, "pp": int,
+# vs the f32 oracle. `engine`/`gamma` (ISSUE 14) label the run with its
+# engine KIND (dense|paged|spec|quant|tp|pp|spec_pp) and, for the
+# speculative kinds, the window knob — so a pp run record carries its
+# spec shape next to the acceptance-rate request fields. EVERY field is
+# optional — files written before the quantized tier (no run record at
+# all) stay gradeable.
+RUN_FIELDS = {"kind": str, "engine": str, "kv_dtype": str,
+              "weight_dtype": str, "tp": int, "pp": int, "gamma": int,
               "quant_greedy_match": (int, float, type(None)),
               "quant_logit_kl": (int, float, type(None))}
 OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
-                       "quant_logit_kl", "tp", "pp"}
+                       "quant_logit_kl", "tp", "pp", "engine", "gamma"}
 # absent == 0/False in files written before the speculative-decode
 # fields (ISSUE 7) and the multi-host `adopted` flag (ISSUE 10) landed —
 # historical artifacts must stay gradeable
@@ -120,6 +127,11 @@ def validate_records(records):
             errors.append(f"record {i} ({kind}): unexpected {sorted(extra)}")
         if kind == "request" and rec.get("status") not in STATUSES:
             errors.append(f"record {i}: bad status {rec.get('status')!r}")
+        if kind == "step" and isinstance(rec.get("pp_stage_busy"), list) \
+                and not all(isinstance(b, (int, float))
+                            for b in rec["pp_stage_busy"]):
+            errors.append(f"record {i} (step): pp_stage_busy entries "
+                          f"must be numbers")
         if kind == "timeline":
             errors.extend(f"record {i} (timeline): {e}"
                           for e in _validate_timeline(rec))
@@ -256,6 +268,8 @@ def summarize(records):
             for p in sorted({r["priority"] for r in reqs})},
         "kv_dtype": run.get("kv_dtype"),
         "weight_dtype": run.get("weight_dtype"),
+        "engine": run.get("engine"),
+        "gamma": run.get("gamma"),
         "tp": run.get("tp"),
         "pp": run.get("pp"),
         # pipeline serving (ISSUE 13): the LAST step's cumulative tick
@@ -293,6 +307,11 @@ def render(summary):
     if summary["prefix_hit_rate"] is not None:
         out.append(f"prefix-cache hit rate: "
                    f"{summary['prefix_hit_rate']:.2f}")
+    if summary.get("engine"):
+        line = f"engine: {summary['engine']}"
+        if summary.get("gamma") is not None:
+            line += f" (gamma={summary['gamma']})"
+        out.append(line)
     if summary["spec_acceptance_rate"] is not None:
         out.append(f"spec-decode acceptance rate: "
                    f"{summary['spec_acceptance_rate']:.2f} "
