@@ -35,6 +35,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -696,6 +697,13 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                        num_blocks * f32_block_bytes // int8_block_bytes)
     quant_slots = int(os.environ.get("BENCH_SERVE_QUANT_SLOTS",
                                      2 * paged_slots))
+    # the decision audit log rides the DEFAULT (paged) arm (ISSUE 15):
+    # the scheduler's serving JSONL (request/timeline records + every
+    # decisions.v1 admit/shed/preempt/place record) is schema-validated
+    # below and cross-checked record-by-record against the terminal
+    # request outcomes
+    serve_jsonl = os.path.join(
+        tempfile.mkdtemp(prefix="bench_serve_load_"), "serve.jsonl")
     results = {}
     for kind, n_slots, n_blocks in (
             ("dense", slots, num_blocks), ("paged", paged_slots, num_blocks),
@@ -704,9 +712,11 @@ def run_serve_load_bench(on_tpu, n_requests=None):
         results[kind] = load_harness.run_harness(
             model, kind, traffic, slots=n_slots, max_len=max_len,
             block_size=block, num_blocks=n_blocks, gamma=gamma,
-            draft_layers=draft_layers, attention_impl=attention_impl)
+            draft_layers=draft_layers, attention_impl=attention_impl,
+            serve_jsonl=serve_jsonl if kind == "paged" else None)
     paged, dense, spec, quant = (results["paged"], results["dense"],
                                  results["spec"], results["quant"])
+    decision_audit = _audit_serve_decisions(serve_jsonl)
     # pp arm (ISSUE 13): pipeline-parallel serving at EQUAL PER-HOST
     # HBM. Each of the pp stage groups holds 1/pp of the layers, so at
     # the paged arm's per-device byte budget the pp pool takes pp× the
@@ -872,8 +882,50 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                   "spec_pp_hbm_vs_pp": round(spec_pp_hbm_ratio, 4)
                   if spec_pp_hbm_ratio is not None else None,
                   "spec_pp_steady_rates": spec_pp_rates,
+                  "decision_audit": decision_audit,
                   "backend": jax.default_backend()},
     }
+
+
+def _audit_serve_decisions(serve_jsonl):
+    """The ISSUE 15 CI gate over the --serve-load default arm's serving
+    JSONL: every record schema-valid (decision records additionally
+    REPLAY-verified by the validator — inputs must reproduce the stored
+    outcome), and the audit log COMPLETE: every terminal SHED request
+    has exactly one shed decision naming it, and every request's
+    preemption count matches the preempt decisions naming it as victim.
+    Returns the audit summary dict (asserts on any violation)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_report
+    recs = [json.loads(line) for line in open(serve_jsonl)
+            if line.strip()]
+    errs = serve_report.validate_records(recs)
+    assert not errs, f"serving JSONL schema/replay errors: {errs[:5]}"
+    decs = [r for r in recs if r["kind"] == "decision"]
+    req_recs = [r for r in recs if r["kind"] == "request"]
+    shed_by_req = {}
+    preempt_by_req = {}
+    for d in decs:
+        if d["action"] == "shed":
+            rid = d.get("request_id")
+            shed_by_req[rid] = shed_by_req.get(rid, 0) + 1
+        elif d["action"] == "preempt":
+            rid = d["outcome"].get("victim_request_id")
+            preempt_by_req[rid] = preempt_by_req.get(rid, 0) + 1
+    for r in req_recs:
+        rid = r["request_id"]
+        if r["status"] == "SHED":
+            assert shed_by_req.get(rid) == 1, \
+                f"request {rid} SHED with {shed_by_req.get(rid, 0)} " \
+                f"shed decision records (want exactly 1)"
+        assert preempt_by_req.get(rid, 0) == r["preempted"], \
+            f"request {rid} preempted {r['preempted']}x but " \
+            f"{preempt_by_req.get(rid, 0)} preempt decisions name it"
+    return {"records": len(recs), "decisions": len(decs),
+            "by_action": {a: sum(1 for d in decs if d["action"] == a)
+                          for a in sorted({d["action"] for d in decs})},
+            "path": serve_jsonl}
 
 
 def _spec_pp_steady_rate(model, pp_e, sp_e):

@@ -1,0 +1,211 @@
+"""Scheduler decision audit log (ISSUE 15): `paddle_tpu.decisions.v1`.
+
+The serving stack makes load-bearing decisions — admit, shed, preempt,
+place, failover, swap, quarantine — that until now left only counters
+behind: `serving_shed_total` says HOW OFTEN, nothing says WHY tenant A's
+request was shed at 14:03 while tenant B's sailed through. This module
+owns the typed audit record both emitters (`serving/scheduler.py`,
+`serving/distributed/router.py`) append next to their metrics/timeline
+JSONL streams: every record carries the decision's *inputs* (queue
+depth, pool free fraction, priority, deadline slack, the victim
+candidates a preemption weighed, tenant) so the decision is
+REPRODUCIBLE from its record alone.
+
+Reproducibility is structural, not aspirational: the replay functions
+here (`replay_shed`, `replay_victim`, `replay_place`) are the SAME code
+the scheduler and router call to make the live decision — the emitters
+build the inputs dict first, ask the replay function for the verdict,
+then record both. `validate_records` re-runs the replay over each
+record's inputs and flags any record whose stored outcome disagrees —
+the serve_report CI gate therefore enforces "inputs -> same outcome" on
+every artifact it grades.
+
+Record shape (kind "decision", schema `paddle_tpu.decisions.v1`):
+
+  {"kind": "decision", "schema": ..., "action": admit|shed|preempt|
+   place|failover|swap|quarantine, "t": float, "emitter": "scheduler"|
+   "router", "request_id"/"key": ..., "tenant": str, "cohort": str?,
+   "trace_id": str?, "inputs": {...}, "outcome": {...}}
+
+Stdlib-only, like every observability submodule.
+"""
+
+__all__ = ["SCHEMA", "ACTIONS", "DEFAULT_TENANT", "build_record",
+           "replay_shed", "replay_victim", "replay_place",
+           "validate_records", "by_tenant"]
+
+SCHEMA = "paddle_tpu.decisions.v1"
+
+ACTIONS = ("admit", "shed", "preempt", "place", "failover", "swap",
+           "quarantine")
+
+# the tenant label value of unlabeled traffic: one vocabulary across
+# the scheduler, router, metrics labelsets, and reports, so single-
+# tenant artifacts grade identically before and after the label landed
+DEFAULT_TENANT = "default"
+
+
+def build_record(action, inputs, outcome, emitter, t, request_id=None,
+                 key=None, tenant=None, cohort=None, trace_id=None):
+    """One decisions.v1 record. `inputs` must hold everything the
+    matching replay function needs; `outcome` what was decided."""
+    if action not in ACTIONS:
+        raise ValueError(f"unknown decision action {action!r}; "
+                         f"want one of {ACTIONS}")
+    rec = {"kind": "decision", "schema": SCHEMA, "action": str(action),
+           "t": float(t), "emitter": str(emitter),
+           "tenant": str(tenant) if tenant is not None else DEFAULT_TENANT,
+           "inputs": dict(inputs), "outcome": dict(outcome)}
+    if request_id is not None:
+        rec["request_id"] = int(request_id)
+    if key is not None:
+        rec["key"] = str(key)
+    if cohort is not None:
+        rec["cohort"] = str(cohort)
+    if trace_id is not None:
+        rec["trace_id"] = str(trace_id)
+    return rec
+
+
+# ------------------------------------------------------------- the replays
+#
+# These ARE the live decision rules — the scheduler/router call them with
+# the same inputs dict they record, so a record's outcome can never
+# disagree with its replay except through a code change (which the
+# validator then flags on historical artifacts, loudly and on purpose).
+
+def replay_shed(inputs):
+    """The admission load-shed rule over recorded inputs. Returns the
+    binding reason string, or None to admit.
+
+    inputs: priority, shed_priority, queue_depth, shed_watermark (or
+    None), pool_free_fraction (or None), shed_pool_free (or None)."""
+    prio = int(inputs["priority"])
+    if prio < int(inputs["shed_priority"]):
+        return None
+    wm = inputs.get("shed_watermark")
+    if wm is not None and int(inputs["queue_depth"]) >= int(wm):
+        return (f"queue depth {inputs['queue_depth']} >= watermark "
+                f"{int(wm)}")
+    floor = inputs.get("shed_pool_free")
+    free = inputs.get("pool_free_fraction")
+    if floor is not None and free is not None and \
+            float(free) < float(floor):
+        return (f"block pool free fraction {float(free):.3f} < "
+                f"{float(floor)}")
+    return None
+
+
+def replay_victim(candidates, worse_than=None):
+    """The preemption-victim rule over a recorded candidate table:
+    worst priority class first, most deadline slack within a class
+    (slack None == infinite — batch work yields before anything on a
+    clock); earliest-listed candidate wins ties, matching the
+    scheduler's slot-order scan. Returns the winning candidate dict, or
+    None.
+
+    candidates: [{"slot", "request_id", "tenant", "priority",
+    "deadline_slack_s" (None == no deadline)}, ...] in slot order."""
+    best, best_key = None, None
+    for cand in candidates:
+        prio = int(cand["priority"])
+        if worse_than is not None and prio <= int(worse_than):
+            continue
+        slack = cand.get("deadline_slack_s")
+        slack = float("inf") if slack is None else float(slack)
+        key = (prio, slack)
+        if best is None or key > best_key:
+            best, best_key = cand, key
+    return best
+
+
+def replay_place(inputs):
+    """The router placement rule over recorded inputs: the live worker
+    carrying the fewest in-flight requests, lowest index on ties.
+
+    inputs: {"loads": {worker_id(str|int): inflight_count}}."""
+    loads = inputs["loads"]
+    if not loads:
+        return None
+    return min(sorted(loads, key=lambda k: int(k)),
+               key=lambda k: loads[k])
+
+
+# ------------------------------------------------------------- validation
+
+def _replay_errors(rec):
+    """Re-run the replay rule over the record's inputs; return mismatch
+    descriptions ([] when the outcome reproduces or no rule applies)."""
+    action = rec.get("action")
+    inputs = rec.get("inputs") or {}
+    outcome = rec.get("outcome") or {}
+    try:
+        if action == "shed":
+            why = replay_shed(inputs)
+            if why is None:
+                return ["shed record's inputs do not shed on replay"]
+            if outcome.get("reason") != why:
+                return [f"shed reason {outcome.get('reason')!r} != "
+                        f"replayed {why!r}"]
+        elif action == "preempt":
+            got = replay_victim(inputs.get("candidates") or (),
+                                worse_than=inputs.get("worse_than"))
+            want_slot = outcome.get("victim_slot")
+            if got is None:
+                return ["preempt record has no eligible victim on replay"]
+            if int(got["slot"]) != int(want_slot):
+                return [f"preempt victim slot {want_slot} != replayed "
+                        f"slot {got['slot']}"]
+        elif action == "place" and "loads" in inputs:
+            got = replay_place(inputs)
+            want = outcome.get("worker")
+            if want is not None and got is not None and \
+                    str(got) != str(want):
+                return [f"place worker {want!r} != replayed {got!r}"]
+    except (KeyError, TypeError, ValueError) as e:
+        return [f"replay failed: {type(e).__name__}: {e}"]
+    return []
+
+
+def validate_records(records):
+    """Schema + reproducibility violations over decision records
+    ([] == every decision valid AND reproducible from its inputs)."""
+    errors = []
+    for i, rec in enumerate(records):
+        if rec.get("kind") != "decision":
+            errors.append(f"record {i}: kind={rec.get('kind')!r}, "
+                          f"want 'decision'")
+            continue
+        where = f"record {i} (decision/{rec.get('action')})"
+        if rec.get("schema") != SCHEMA:
+            errors.append(f"{where}: schema={rec.get('schema')!r}, "
+                          f"want {SCHEMA!r}")
+        if rec.get("action") not in ACTIONS:
+            errors.append(f"{where}: unknown action {rec.get('action')!r}")
+        if not isinstance(rec.get("t"), (int, float)):
+            errors.append(f"{where}: t={rec.get('t')!r} invalid")
+        if not isinstance(rec.get("tenant"), str) or not rec["tenant"]:
+            errors.append(f"{where}: tenant={rec.get('tenant')!r} invalid")
+        if rec.get("emitter") not in ("scheduler", "router"):
+            errors.append(f"{where}: emitter={rec.get('emitter')!r} "
+                          f"invalid")
+        for fld in ("inputs", "outcome"):
+            if not isinstance(rec.get(fld), dict):
+                errors.append(f"{where}: {fld} missing or not a dict")
+        if isinstance(rec.get("inputs"), dict) and \
+                isinstance(rec.get("outcome"), dict):
+            errors.extend(f"{where}: {e}" for e in _replay_errors(rec))
+    return errors
+
+
+def by_tenant(records):
+    """{tenant: {action: count}} over decision records — the
+    serve_report per-tenant decision table's data."""
+    out = {}
+    for rec in records:
+        if rec.get("kind") != "decision":
+            continue
+        t = rec.get("tenant") or DEFAULT_TENANT
+        out.setdefault(t, {})
+        out[t][rec["action"]] = out[t].get(rec["action"], 0) + 1
+    return out

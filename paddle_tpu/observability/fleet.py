@@ -29,7 +29,7 @@ happened to carry. This module is the live fleet view:
       alert) must BOTH burn past `burn_threshold` for `sustain`
       consecutive observations before the fleet is declared degraded —
       a single slow request can't page, a sustained breach can't hide.
-      Exports `serving_slo_burn{slo,window}` and `serving_slo_degraded`
+      Exports `serving_slo_burn{slo,window,tenant}` and `serving_slo_degraded`
       gauges; fires `on_breach(details)` once per degraded episode.
 
   FleetPlane
@@ -59,8 +59,9 @@ import time
 from . import flight_recorder as _fr
 from . import metrics as _metrics
 
-__all__ = ["FLEET_LABEL", "BUNDLE_SCHEMA", "merge_snapshots", "SLO",
-           "default_slos", "BurnRateWatchdog", "FleetPlane"]
+__all__ = ["FLEET_LABEL", "ALL_TENANTS", "BUNDLE_SCHEMA",
+           "merge_snapshots", "SLO", "default_slos", "per_tenant_slos",
+           "prime_tenant_series", "BurnRateWatchdog", "FleetPlane"]
 
 # worker_id/role value of the fleet-aggregate series in a merged snapshot
 FLEET_LABEL = "_fleet"
@@ -70,8 +71,13 @@ _WID_PAT = re.compile(r"worker_id=([^,}]+)")
 _M_BURN = _metrics.gauge(
     "serving_slo_burn",
     "Online SLO burn rate (bad fraction per window / error budget); "
-    "1.0 = consuming budget exactly as fast as allowed",
-    labelnames=("slo", "window"))
+    "1.0 = consuming budget exactly as fast as allowed. tenant=_all "
+    "for fleet-wide SLOs, else the tenant the SLO is scoped to "
+    "(ISSUE 15)",
+    labelnames=("slo", "window", "tenant"))
+
+# tenant label value of SLOs judging the whole fleet (no tenant scope)
+ALL_TENANTS = "_all"
 _M_DEGRADED = _metrics.gauge(
     "serving_slo_degraded",
     "1 while the fleet is in a sustained SLO breach (fast AND slow "
@@ -173,10 +179,17 @@ class SLO:
     kind="failure": `bad` is a tuple of regexes over flattened counter
     keys (fleet-aggregate rows) whose sum counts failure events; `total`
     a regex tuple for the event denominator. objective 0.99 = "at most
-    1% of events may fail"."""
+    1% of events may fail".
+
+    `tenant` (ISSUE 15) scopes the SLO to ONE tenant's label slice:
+    only histogram samples / counter series carrying tenant=<value>
+    contribute, and the burn gauge exports as
+    `serving_slo_burn{slo,window,tenant}` — the per-tenant isolation
+    gate ROADMAP item 5 rides on. tenant=None judges every series
+    (exported under tenant="_all")."""
 
     def __init__(self, name, kind="latency", hist=None, threshold_s=None,
-                 objective=0.99, bad=(), total=()):
+                 objective=0.99, bad=(), total=(), tenant=None):
         if kind not in ("latency", "failure"):
             raise ValueError(f"unknown SLO kind {kind!r}")
         if kind == "latency" and (not hist or threshold_s is None):
@@ -191,6 +204,20 @@ class SLO:
         self.budget = max(1.0 - self.objective, 1e-9)
         self.bad = tuple(re.compile(p) for p in bad)
         self.total = tuple(re.compile(p) for p in total)
+        self.tenant = None if tenant is None else str(tenant)
+        self._tenant_pat = None if tenant is None else re.compile(
+            r"[{,]tenant=" + re.escape(self.tenant) + r"[,}]")
+
+    @property
+    def key(self):
+        """Unique series key inside a watchdog: two SLOs may share a
+        NAME (the gauge label) while judging different tenants."""
+        return self.name if self.tenant is None \
+            else f"{self.name}@{self.tenant}"
+
+    def _in_scope(self, labels):
+        return self.tenant is None or \
+            (labels or {}).get("tenant") == self.tenant
 
     def _hist_bad_total(self, s):
         good = 0
@@ -219,10 +246,12 @@ class SLO:
                 if m["name"] != self.hist or m["type"] != "histogram":
                     continue
                 for s in m["samples"]:
-                    wid = (s.get("labels") or {}).get("worker_id",
-                                                      "_solo")
+                    labels = s.get("labels") or {}
+                    wid = labels.get("worker_id", "_solo")
                     if wid == FLEET_LABEL:
                         continue           # aggregates would double-count
+                    if not self._in_scope(labels):
+                        continue           # another tenant's series
                     # zero-count samples still record: first sight at
                     # (0, 0) means the member's entire burst since
                     # attach counts as delta, not baseline
@@ -235,6 +264,9 @@ class SLO:
             wid = m.group(1) if m else "_solo"
             if wid == FLEET_LABEL:
                 continue
+            if self._tenant_pat is not None and \
+                    not self._tenant_pat.search(key):
+                continue                   # another tenant's series
             is_bad = any(p.search(key) for p in self.bad)
             is_total = any(p.search(key) for p in self.total)
             if not (is_bad or is_total):
@@ -264,6 +296,59 @@ def default_slos(ttft_s=1.0, decode_step_s=0.5, latency_objective=0.99,
     )
 
 
+def prime_tenant_series(tenants, registry=None):
+    """Create the zero-valued tenant-labeled children the per-tenant
+    SLOs read, BEFORE a watchdog takes its baseline observation. Label
+    children are created lazily on first use — without priming, a fresh
+    tenant's series would first appear in the post-traffic snapshot,
+    which the watchdog's first-sight-is-baseline rule would swallow
+    whole (exactly the burst the caller wants judged). A (0, 0) sample
+    in the baseline makes the whole burst a DELTA instead. Idempotent;
+    tenants with existing history are untouched."""
+    reg = registry or _metrics.registry()
+    hist = reg.histogram("serving_ttft_seconds", labelnames=("tenant",))
+    requests = reg.counter("serving_requests_total",
+                           labelnames=("status", "tenant"))
+    shed = reg.counter("serving_shed_total", labelnames=("tenant",))
+    for t in tenants:
+        hist.labels(tenant=t)
+        shed.labels(tenant=t)
+        for status in ("admitted", "error", "timeout"):
+            requests.labels(status=status, tenant=t)
+
+
+def per_tenant_slos(tenants, ttft_s=1.0, latency_objective=0.99,
+                    failure_objective=0.999, include_fleet=True):
+    """The ISSUE 15 labelset: one TTFT SLO and one failure-ratio SLO
+    PER TENANT (each scoped to that tenant's label slice — shed and
+    errored requests count against the tenant they belong to), plus the
+    fleet-wide defaults. Feeding these to a BurnRateWatchdog makes
+    `serving_slo_burn{slo,window,tenant}` live — the isolation gate of
+    ROADMAP item 5 ("tenant A's burst cannot move tenant B's p99 TTFT")
+    is then one threshold comparison over these gauges."""
+    slos = list(default_slos(ttft_s=ttft_s,
+                             latency_objective=latency_objective,
+                             failure_objective=failure_objective)) \
+        if include_fleet else []
+    for t in tenants:
+        slos.append(SLO("ttft", hist="serving_ttft_seconds",
+                        threshold_s=ttft_s,
+                        objective=latency_objective, tenant=t))
+        # sheds count in the DENOMINATOR too: a window where every one
+        # of a tenant's requests is shed at admission must read as max
+        # burn (bad == total), not divide-by-zero-quietly-0.0 — the
+        # total-denial scenario is exactly what the isolation gate is
+        # for
+        slos.append(SLO(
+            "failures", kind="failure", objective=failure_objective,
+            tenant=t,
+            bad=(r"^serving_requests_total\{.*status=(error|timeout)",
+                 r"^serving_shed_total"),
+            total=(r"^serving_requests_total\{.*status=admitted",
+                   r"^serving_shed_total")))
+    return tuple(slos)
+
+
 class BurnRateWatchdog:
     """Multi-window burn-rate evaluation over a snapshot stream.
 
@@ -273,7 +358,8 @@ class BurnRateWatchdog:
     dead member stops contributing — see SLO.sample_members), folds the
     monotone deltas into its own cumulative (bad, total) series,
     differences THAT over the fast and slow windows, and publishes
-    `serving_slo_burn{slo,window}`. The fleet is DEGRADED while at least
+    `serving_slo_burn{slo,window,tenant}` (tenant="_all" for
+    unscoped SLOs). The fleet is DEGRADED while at least
     one SLO burns past `burn_threshold` on BOTH windows for `sustain`
     consecutive observations (`serving_slo_degraded` = 1); the first
     observation that establishes a degraded episode fires `on_breach`
@@ -293,15 +379,19 @@ class BurnRateWatchdog:
         self.on_breach = on_breach
         reg = registry or _metrics.registry()
         self._g_burn = reg.gauge("serving_slo_burn", _M_BURN.help,
-                                 labelnames=("slo", "window"))
+                                 labelnames=("slo", "window", "tenant"))
         self._g_degraded = reg.gauge("serving_slo_degraded",
                                      _M_DEGRADED.help)
-        self._series = {s.name: collections.deque() for s in self.slos}
+        # keyed by slo.key, not name: per-tenant SLOs share a NAME (the
+        # gauge label) while tracking separate series (ISSUE 15)
+        self._series = {s.key: collections.deque() for s in self.slos}
+        if len(self._series) != len(self.slos):
+            raise ValueError("duplicate SLO (name, tenant) pairs")
         # per-member previous cumulative samples + the watchdog's OWN
         # monotone cumulative sums (see observe): member churn/restart
         # can never drive a window delta negative
-        self._prev = {s.name: {} for s in self.slos}
-        self._cum = {s.name: [0.0, 0.0] for s in self.slos}
+        self._prev = {s.key: {} for s in self.slos}
+        self._cum = {s.key: [0.0, 0.0] for s in self.slos}
         self._breach_streak = 0
         self._breached = False            # latched for this episode
         self.degraded = False
@@ -335,7 +425,7 @@ class BurnRateWatchdog:
         burns = {}
         candidate = False
         for slo in self.slos:
-            series = self._series[slo.name]
+            series = self._series[slo.key]
             # per-member monotone differencing: a member first seen is a
             # baseline (its history predates this watchdog), a member
             # whose counts DROPPED restarted (delta clamps to 0 for that
@@ -343,8 +433,8 @@ class BurnRateWatchdog:
             # the accumulated (bad, total) sums only ever grow, so the
             # window deltas below stay meaningful through host death,
             # exactly when they matter most
-            prev = self._prev[slo.name]
-            cum = self._cum[slo.name]
+            prev = self._prev[slo.key]
+            cum = self._cum[slo.key]
             for wid, (b, t) in slo.sample_members(snap).items():
                 pb, pt = prev.get(wid, (None, None))
                 if pb is not None:
@@ -358,9 +448,12 @@ class BurnRateWatchdog:
                 series.popleft()
             fast = self._window_burn(slo, series, now, self.fast_window_s)
             slow = self._window_burn(slo, series, now, self.slow_window_s)
-            burns[slo.name] = {"fast": fast, "slow": slow}
-            self._g_burn.labels(slo=slo.name, window="fast").set(fast)
-            self._g_burn.labels(slo=slo.name, window="slow").set(slow)
+            burns[slo.key] = {"fast": fast, "slow": slow}
+            tenant = slo.tenant if slo.tenant is not None else ALL_TENANTS
+            self._g_burn.labels(slo=slo.name, window="fast",
+                                tenant=tenant).set(fast)
+            self._g_burn.labels(slo=slo.name, window="slow",
+                                tenant=tenant).set(slow)
             if min(fast, slow) >= self.burn_threshold:
                 candidate = True
         self.last_burn = burns

@@ -91,7 +91,8 @@ class PhaseTrail:
 def build_record(status, submitted_t, finished_t, phases, request_id=None,
                  key=None, tokens=0, ttft_s=None, priority=None,
                  preempted=0, failovers=0, worker=None, adopted=False,
-                 trace_id=None, worker_phases=None):
+                 trace_id=None, worker_phases=None, tenant=None,
+                 cohort=None):
     """One `paddle_tpu.reqtimeline.v1` record. `phases` is the
     `PhaseTrail.rel()` list (t0 relative to `submitted_t`);
     `worker_phases` optionally carries the serving worker's own trail
@@ -116,6 +117,12 @@ def build_record(status, submitted_t, finished_t, phases, request_id=None,
         rec["trace_id"] = str(trace_id)
     if worker_phases is not None:
         rec["worker_phases"] = list(worker_phases)
+    # request attribution (ISSUE 15): the tenant/cohort labels join the
+    # timeline to the request's metric labelsets and decision records
+    if tenant is not None:
+        rec["tenant"] = str(tenant)
+    if cohort is not None:
+        rec["cohort"] = str(cohort)
     return rec
 
 

@@ -48,8 +48,8 @@ from .engine import (  # noqa: F401
 )
 from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
-    LoadShedError, QueueFullError, Request, RequestHandle, Scheduler,
-    ServingConfig,
+    PRIORITIES, LoadShedError, QueueFullError, Request, RequestHandle,
+    Scheduler, ServingConfig,
 )
 from .spec_decode import (  # noqa: F401
     SpecDecodeConfig, SpeculativeEngine, truncated_draft,
@@ -59,6 +59,7 @@ __all__ = [
     "kv_cache", "blocks", "prefix_cache", "sampling", "spec_decode",
     "BlockAllocError", "BlockPool", "PagedLayerKV", "QuantPagedLayerKV",
     "PrefixCache",
+    "PRIORITIES",
     "EngineConfig", "GenerationEngine", "PagedEngineConfig",
     "PagedGenerationEngine", "save_for_generation", "make_engine",
     "default_compile_cache_dir",

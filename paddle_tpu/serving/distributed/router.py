@@ -41,6 +41,7 @@ prefill->decode KVPUT rides the same id (the worker re-enters the
 caller's scope), and `merge_chrome_traces` renders ONE causally-linked
 timeline across router, prefill, and decode processes.
 """
+import collections
 import itertools
 import json
 import os
@@ -49,6 +50,7 @@ import time
 import zlib
 
 from ...distributed.ps import rpc as _rpc
+from ...observability import decisions as _dec
 from ...observability import metrics as _metrics
 from ...observability import reqtimeline as _rt
 from ...observability import tracecontext as _tc
@@ -88,23 +90,25 @@ class ServingShardClient(_rpc.ShardClientBase):
         return self._exchange(i, msg, reader)
 
     def prefill(self, i, key, prompt, decode_endpoint=None,
-                rng_seed=None, rng_gen=0):
+                rng_seed=None, rng_gen=0, tenant=None, cohort=None):
         return self._call(i, OP_PREFILL, {
             "key": key, "prompt": [int(t) for t in prompt],
             "decode_endpoint": decode_endpoint,
-            "rng_seed": rng_seed, "rng_gen": int(rng_gen)})
+            "rng_seed": rng_seed, "rng_gen": int(rng_gen),
+            "tenant": tenant, "cohort": cohort})
 
     def kv_put(self, i, key, bundle):
         return self._call(i, OP_KV_PUT, {"key": key}, tail=bundle)
 
     def submit(self, i, key, prompt, max_new=None, priority="standard",
                timeout_s=None, use_staged=False, rng_seed=None,
-               rng_gen=0):
+               rng_gen=0, tenant=None, cohort=None):
         return self._call(i, OP_SUBMIT, {
             "key": key, "prompt": [int(t) for t in prompt],
             "max_new": max_new, "priority": priority,
             "timeout_s": timeout_s, "use_staged": bool(use_staged),
-            "rng_seed": rng_seed, "rng_gen": int(rng_gen)})
+            "rng_seed": rng_seed, "rng_gen": int(rng_gen),
+            "tenant": tenant, "cohort": cohort})
 
     def poll(self, i, keys):
         return self._call(i, OP_POLL, {"keys": list(keys)})
@@ -135,12 +139,18 @@ class DistRequest:
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new, priority, timeout_s=None,
-                 rng_seed=None):
+                 rng_seed=None, tenant=None, cohort=None):
         self.key = f"r{next(self._ids)}.{os.getpid()}"
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.priority = priority
         self.timeout_s = timeout_s
+        # request attribution (ISSUE 15): carried on every PREFILL/
+        # SUBMIT wire frame next to rng_seed, into the worker scheduler's
+        # labelsets, and onto this router's own timeline + decision
+        # records — one label from router to fleet snapshot
+        self.tenant = str(tenant) if tenant else _dec.DEFAULT_TENANT
+        self.cohort = str(cohort) if cohort else None
         # the request's sampler seed (ISSUE 13): STABLE across every
         # placement — original, preempt restart, failover restart — so
         # a temperature>0 stream replays bit-identically wherever it
@@ -213,8 +223,43 @@ class DistFrontend:
         # drives its interval-gated OP_METRICS federation sweep
         self.fleet_plane = None
         self.timeline_path = timeline_path
+        if timeline_path:
+            os.makedirs(os.path.dirname(os.path.abspath(timeline_path)),
+                        exist_ok=True)
         self._timeline = []          # reqtimeline.v1 records, in
                                      # finalization order
+        # decisions.v1 records (ISSUE 15): place/failover, newest-last.
+        # RING-bounded like the scheduler's — the timeline JSONL keeps
+        # the full history
+        self._decisions = collections.deque(maxlen=4096)
+
+    def _append_stream(self, rec):
+        """Append one record to the timeline JSONL stream (timelines
+        and decisions share it; the directory exists from __init__)."""
+        if self.timeline_path:
+            with open(self.timeline_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    # -- the decision audit log (ISSUE 15) -----------------------------------
+    def _decide(self, action, req, inputs, outcome):
+        """One router-side decisions.v1 record (placement, failover) —
+        appended in memory and to the timeline JSONL stream, keyed and
+        tenant-labeled like the request's timeline record."""
+        rec = _dec.build_record(
+            action, inputs, outcome, "router", time.monotonic(),
+            key=req.key, tenant=req.tenant, cohort=req.cohort,
+            trace_id=req.trace_id)
+        with self._lock:
+            self._decisions.append(rec)
+        self._append_stream(rec)
+        return rec
+
+    def decision_records(self):
+        """Every router decisions.v1 record so far (placements and
+        failover hops) — what tests/bench audit without re-parsing the
+        JSONL."""
+        with self._lock:
+            return list(self._decisions)
 
     # -- placement -----------------------------------------------------------
     # Locking discipline: `self._lock` guards only the bookkeeping
@@ -233,7 +278,9 @@ class DistFrontend:
     def _pick_decode(self):
         """SLO-aware placement: the live worker carrying the fewest
         in-flight router requests (queue-depth-proportional load
-        balancing without a STAT round-trip per submit)."""
+        balancing without a STAT round-trip per submit). The choice IS
+        `decisions.replay_place` over the load table, so the place
+        decision record reproduces it. Returns (worker, loads)."""
         with self._lock:
             if not self._live:
                 raise NoWorkersError("every decode worker is dark")
@@ -241,7 +288,7 @@ class DistFrontend:
             for req in self._inflight.values():
                 if not req.done() and req.worker in loads:
                     loads[req.worker] += 1
-            return min(sorted(loads), key=lambda i: loads[i])
+        return _dec.replay_place({"loads": loads}), loads
 
     def _remote_prefill(self, req, decode_i, exec_prompt):
         """Remote prefill + handoff toward `decode_i`. Returns
@@ -262,16 +309,17 @@ class DistFrontend:
                 reply = self.prefill.prefill(
                     i, req._wire_key, exec_prompt,
                     decode_endpoint=target, rng_seed=req.rng_seed,
-                    rng_gen=len(req.tokens))
+                    rng_gen=len(req.tokens), tenant=req.tenant,
+                    cohort=req.cohort)
                 return True, float(reply.get("handoff_s") or 0.0)
             except (_rpc.PSUnavailableError, _rpc.PSServerError):
                 continue             # next prefill worker, else fallback
         return False, 0.0
 
     def submit(self, prompt, max_new=16, priority="standard",
-               timeout_s=None, rng_seed=None):
+               timeout_s=None, rng_seed=None, tenant=None, cohort=None):
         req = DistRequest(prompt, max_new, priority, timeout_s=timeout_s,
-                          rng_seed=rng_seed)
+                          rng_seed=rng_seed, tenant=tenant, cohort=cohort)
         self._place(req)                 # RPCs happen OUTSIDE the lock
         with self._lock:
             self._inflight[req.key] = req
@@ -284,7 +332,8 @@ class DistFrontend:
         exec_prompt = req.prompt + req.tokens
         remaining = req.max_new - len(req.tokens)
         while True:
-            decode_i = self._pick_decode()   # NoWorkersError when dark
+            # NoWorkersError when dark; `loads` is the decision input
+            decode_i, loads = self._pick_decode()
             t0 = time.monotonic()
             staged, handoff_s = self._remote_prefill(req, decode_i,
                                                      exec_prompt)
@@ -317,12 +366,19 @@ class DistFrontend:
                     decode_i, req._wire_key, exec_prompt,
                     max_new=remaining, priority=req.priority,
                     timeout_s=req.timeout_s, use_staged=staged,
-                    rng_seed=req.rng_seed, rng_gen=len(req.tokens))
+                    rng_seed=req.rng_seed, rng_gen=len(req.tokens),
+                    tenant=req.tenant, cohort=req.cohort)
             except _rpc.PSUnavailableError:
                 now = time.monotonic()
                 req.trail.append(_rt.PH_PLACE, place_from, now)
                 req.trail.begin(_rt.PH_QUEUE, now)
                 self._mark_dead(decode_i)
+                # the failed attempt is auditable too: the load table
+                # named this worker, the SUBMIT found it dark
+                self._decide("place", req,
+                             {"loads": loads, "staged": staged},
+                             {"worker": decode_i, "ok": False,
+                              "error": "decode worker unavailable"})
                 req._wire_key = f"{req.key}.p{req.failovers}" \
                                 f".{decode_i}x"
                 continue
@@ -332,6 +388,11 @@ class DistFrontend:
             req.worker = decode_i
             req.staged = staged
             req.status = RUNNING
+            self._decide("place", req,
+                         {"loads": loads, "staged": staged,
+                          "tokens_delivered": len(req.tokens)},
+                         {"worker": decode_i, "ok": True,
+                          "staged": staged})
             return
 
     # -- streaming / failover ------------------------------------------------
@@ -401,9 +462,19 @@ class DistFrontend:
         # re-placement's prefill starts inside _place — so a SIGKILLed
         # worker's victims show `failover` between two decode segments
         req.trail.begin(_rt.PH_FAILOVER, time.monotonic())
+        dead = req.worker
         req._base = req.tokens
         req._cur = []
         req._wire_key = f"{req.key}.f{req.failovers}"
+        # the hop's audit record (ISSUE 15): same key/tenant/trace_id
+        # as the request's timeline, so "why did tenant A's stream move
+        # hosts" joins its latency decomposition in one grep
+        self._decide("failover", req,
+                     {"dead_worker": dead,
+                      "tokens_delivered": len(req._base),
+                      "failovers": req.failovers,
+                      "live_workers": self.live_decode_workers()},
+                     {"restart": req.max_new - len(req._base) >= 1})
         if req.max_new - len(req._base) < 1:
             req.status = DONE          # it raced its own completion
             self._finalize_timeline(req)
@@ -432,14 +503,11 @@ class DistFrontend:
             failovers=req.failovers, worker=req.worker,
             adopted=bool((view or {}).get("adopted")),
             trace_id=req.trace_id,
-            worker_phases=(view or {}).get("phases"))
+            worker_phases=(view or {}).get("phases"),
+            tenant=req.tenant, cohort=req.cohort)
         with self._lock:
             self._timeline.append(rec)
-        if self.timeline_path:
-            d = os.path.dirname(os.path.abspath(self.timeline_path))
-            os.makedirs(d, exist_ok=True)
-            with open(self.timeline_path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+        self._append_stream(rec)
 
     def timeline_records(self):
         """The reqtimeline.v1 records of every finalized request so far

@@ -50,6 +50,7 @@ send path (and inside bundle pack/unpack), `serving.weight_swap` inside
 `engine.swap_params` — both armable across processes via PTN_FAULTS.
 """
 import json
+import re
 import threading
 import time
 
@@ -60,6 +61,7 @@ from ...framework import ckpt_commit as _ckpt
 from ...observability import flight_recorder as _fr
 from ...observability import metrics as _metrics
 from ...observability import tracecontext as _tc
+from ...profiler import RecordEvent, TracerEventType
 from ..scheduler import Scheduler, ServingConfig
 from . import kv_handoff as _kv
 
@@ -227,7 +229,14 @@ class ServingWorker:
         rng = None
         if obj.get("rng_seed") is not None:
             rng = (int(obj["rng_seed"]), int(obj.get("rng_gen") or 0))
-        with self._lock:
+        # the attribution label reaches the prefill HOST too (ISSUE 15):
+        # the remote prefill's span carries the request's tenant/cohort,
+        # so a prefill-side trace attributes its compute like the decode
+        # side's scheduler spans do
+        with self._lock, RecordEvent(
+                "serving::remote_prefill", TracerEventType.UserDefined,
+                {"key": key, "tenant": obj.get("tenant") or "default",
+                 "cohort": obj.get("cohort"), "prompt_len": len(prompt)}):
             slot = 0                          # one prefill at a time
             first = self.engine.prefill(slot, prompt, rng=rng)
             bundle_rng = self.engine.slot_rng(slot) \
@@ -311,7 +320,9 @@ class ServingWorker:
                 priority=obj.get("priority", "standard"),
                 staged_kv=staged_kv,
                 rng_seed=obj.get("rng_seed"),
-                rng_gen=int(obj.get("rng_gen") or 0))
+                rng_gen=int(obj.get("rng_gen") or 0),
+                tenant=obj.get("tenant"),
+                cohort=obj.get("cohort"))
             self._requests[key] = handle
             self._trim_requests()
         return _kv.pack_payload({"ok": 1,
@@ -409,16 +420,22 @@ class ServingWorker:
             out["blocks_total"] = pool.capacity
         if self.scheduler is not None:
             # keep the historical `requests` key set (zero-filled), with
-            # VALUES read from the registry's serving_* counters
+            # VALUES read from the registry's serving_* counters — which
+            # now carry tenant labels (ISSUE 15), so the projection SUMS
+            # across the tenant dimension: STAT stays the tenant-blind
+            # health view, OP_METRICS ships the full labelsets
             requests = dict.fromkeys(self.scheduler.counts, 0)
-            requests["serving.tokens"] = int(flat.get(
-                "serving_tokens_total", 0))
-            requests["serving.preempted"] = int(flat.get(
-                "serving_preempted_total", 0))
-            prefix = "serving_requests_total{status="
             for key, v in flat.items():
-                if key.startswith(prefix):
-                    requests[f"serving.{key[len(prefix):-1]}"] = int(v)
+                fam = key.split("{", 1)[0]
+                if fam == "serving_tokens_total":
+                    requests["serving.tokens"] += int(v)
+                elif fam == "serving_preempted_total":
+                    requests["serving.preempted"] += int(v)
+                elif fam == "serving_requests_total":
+                    m = re.search(r"status=([^,}]+)", key)
+                    if m:
+                        k = f"serving.{m.group(1)}"
+                        requests[k] = requests.get(k, 0) + int(v)
             out.update({
                 "queue_depth": int(flat.get("serving_queue_depth", 0)),
                 "active_slots": int(round(

@@ -50,6 +50,16 @@ every preemption; the same figures feed profiler spans and the `native`
 stat counters, and `tools/serve_report.py` renders the file. The step loop is
 synchronous by design — the engine's decode is one executable replay, so
 a thread adds latency, not throughput.
+
+Request attribution (ISSUE 15): every request carries `tenant`/`cohort`
+labels — through the metric labelsets (`serving_requests_total{status,
+tenant}` and friends), the timeline records, and the profiler span args
+— and every load-bearing decision (admit/shed/preempt/place/quarantine/
+swap) appends a `paddle_tpu.decisions.v1` audit record whose INPUTS
+reproduce the outcome through the shared replay rules in
+`observability/decisions.py` (the same code the live path calls). The
+labels are observability-only: the engine never sees them, so labeled
+and unlabeled traffic decode bit-identically.
 """
 import collections
 import itertools
@@ -60,6 +70,7 @@ import time
 import numpy as np
 
 from .. import native
+from ..observability import decisions as _dec
 from ..observability import metrics as _metrics
 from ..observability import reqtimeline as _rt
 from ..observability import tracecontext as _tc
@@ -95,29 +106,40 @@ _COUNTERS = ("serving.admitted", "serving.completed", "serving.rejected",
 
 _M_REQUESTS = _metrics.counter(
     "serving_requests_total",
-    "Serving requests by terminal/admission status",
-    labelnames=("status",))
+    "Serving requests by terminal/admission status and tenant "
+    "(ISSUE 15: the tenant labelset rides every per-request family)",
+    labelnames=("status", "tenant"))
 _M_TOKENS = _metrics.counter(
-    "serving_tokens_total", "Tokens emitted by the serving engine")
+    "serving_tokens_total", "Tokens emitted by the serving engine",
+    labelnames=("tenant",))
 _M_QUEUE_DEPTH = _metrics.gauge(
     "serving_queue_depth", "Admission-queue depth after the last step")
 _M_OCCUPANCY = _metrics.gauge(
     "serving_slot_occupancy",
     "Fraction of decode slots occupied after the last step")
 _M_TTFT = _metrics.histogram(
-    "serving_ttft_seconds", "Time to first token per completed request")
+    "serving_ttft_seconds", "Time to first token per completed request",
+    labelnames=("tenant",))
 _M_DECODE_SECONDS = _metrics.histogram(
     "serving_decode_step_seconds", "Wall time of one engine decode step")
+_M_REQ_DECODE = _metrics.histogram(
+    "serving_request_decode_seconds",
+    "Per-request decode wall time (first token -> terminal), the "
+    "per-tenant decode-latency companion of the tenant-agnostic "
+    "per-step histogram", labelnames=("tenant",))
 _M_DECODE_FAILURES = _metrics.counter(
     "serving_decode_failures_total",
     "Engine decode/prefill calls that raised; each fails only the "
     "affected requests")
 _M_SHED = _metrics.counter(
     "serving_shed_total",
-    "Requests load-shed at admission (queue/pool watermark)")
+    "Requests load-shed at admission (queue/pool watermark), by tenant "
+    "— per-tenant growth is failure-class in tools/metrics_report.py",
+    labelnames=("tenant",))
 _M_PREEMPTED = _metrics.counter(
     "serving_preempted_total",
-    "Preemptions under allocation pressure (victim requeued or errored)")
+    "Preemptions under allocation pressure (victim requeued or "
+    "errored), by the victim's tenant", labelnames=("tenant",))
 _M_SPEC_PROPOSED = _metrics.counter(
     "serving_spec_proposed_total",
     "Draft tokens proposed to the speculative verifier (occupied "
@@ -181,13 +203,22 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens, deadline, submitted_at,
-                 priority=1, rng_seed=None, rng_gen=0):
+                 priority=1, rng_seed=None, rng_gen=0, tenant=None,
+                 cohort=None):
         self.id = next(Request._ids)
         self.prompt = list(prompt)        # ORIGINAL prompt, never mutated
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline          # absolute clock value or None
         self.submitted_at = submitted_at
         self.priority = int(priority)
+        # request attribution (ISSUE 15): the tenant label carried into
+        # every metric labelset, decision record, timeline record and
+        # profiler span arg this request touches. `cohort` is the free-
+        # form request-class companion (e.g. "interactive" traffic vs a
+        # batch backfill inside one tenant). Observability-only by
+        # construction: neither value reaches the engine.
+        self.tenant = str(tenant) if tenant else _dec.DEFAULT_TENANT
+        self.cohort = str(cohort) if cohort else None
         # per-request sampler RNG (ISSUE 13): generation index n samples
         # with fold_in(key(rng_seed), rng_gen + n) whatever slot/engine/
         # host runs it. rng_gen > 0 means tokens 0..rng_gen-1 were
@@ -265,6 +296,16 @@ class RequestHandle:
     @property
     def priority(self):
         return self._req.priority
+
+    @property
+    def tenant(self):
+        """The request's attribution tenant label (ISSUE 15)."""
+        return self._req.tenant
+
+    @property
+    def cohort(self):
+        """The request-class label within its tenant (or None)."""
+        return self._req.cohort
 
     @property
     def preempted(self):
@@ -350,6 +391,11 @@ class Scheduler:
         self.last_swap = None                 # apply_pending_swap summary
         self.model_version = None
         self._completed = []
+        # decisions.v1 records, newest-last; RING-bounded — the JSONL
+        # stream keeps the full history, the in-memory view is for
+        # tests/bench audits and must not grow with request count on a
+        # long-lived worker
+        self._decisions = collections.deque(maxlen=4096)
         self.counts = dict.fromkeys(_COUNTERS, 0)
         self._metrics_f = (open(self.config.metrics_path, "a")
                            if self.config.metrics_path else None)
@@ -382,10 +428,46 @@ class Scheduler:
         self._metrics_f.write(json.dumps(rec) + "\n")
         self._metrics_f.flush()
 
+    # -- the decision audit log (ISSUE 15) -----------------------------------
+    def _decide(self, action, req, inputs, outcome):
+        """Append one decisions.v1 record (in memory + the serving
+        JSONL): the decision's inputs make it reproducible via the
+        paddle_tpu.observability.decisions replay rules — the same code
+        that just made it."""
+        rec = _dec.build_record(
+            action, inputs, outcome, "scheduler", self._clock(),
+            request_id=getattr(req, "id", None),
+            tenant=getattr(req, "tenant", None),
+            cohort=getattr(req, "cohort", None),
+            trace_id=getattr(req, "trace_id", None))
+        self._decisions.append(rec)
+        if self._metrics_f:
+            self._metrics_f.write(json.dumps(rec) + "\n")
+            self._metrics_f.flush()
+        return rec
+
+    def decision_records(self):
+        """Every decisions.v1 record emitted so far — what bench/tests
+        audit without re-reading the JSONL."""
+        return list(self._decisions)
+
+    def _pool_free_fraction(self):
+        """Allocatable fraction of the block pool (prefix-cache-held
+        blocks count as free — they evict on demand), or None on
+        engines without a pool. The shed rule's input, recorded on
+        every shed decision."""
+        pool = getattr(self.engine, "block_pool", None)
+        if pool is None or pool.capacity <= 0:
+            return None
+        cache = getattr(self.engine, "prefix_cache", None)
+        free = pool.available + (cache.evictable()
+                                 if cache is not None else 0)
+        return free / pool.capacity
+
     # -- admission -----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_s=None,
                priority="standard", staged_kv=None, rng_seed=None,
-               rng_gen=0):
+               rng_gen=0, tenant=None, cohort=None):
         """`staged_kv=(ks, vs, plen, first_token[, rng])` places the
         request from a handed-off KV bundle (another host already ran
         its prefill) instead of computing prefill locally — `prompt`
@@ -404,7 +486,12 @@ class Scheduler:
         derives a deterministic per-request default from the engine
         seed and the request id — in-process replays (and preemption
         restarts) are exact; cross-process oracles must pass the seed
-        explicitly."""
+        explicitly.
+
+        `tenant`/`cohort` (ISSUE 15) label the request for attribution:
+        metrics labelsets, the decision audit log, timeline records and
+        profiler spans all carry them; the engine never sees either, so
+        labeled and unlabeled traffic decode bit-identically."""
         prompt = [int(t) for t in prompt]
         now = self._clock()
         max_new = self.config.default_max_new_tokens \
@@ -419,7 +506,8 @@ class Scheduler:
             else self.config.default_timeout_s
         req = Request(prompt, max_new,
                       now + timeout if timeout is not None else None, now,
-                      priority=prio, rng_seed=rng_seed, rng_gen=rng_gen)
+                      priority=prio, rng_seed=rng_seed, rng_gen=rng_gen,
+                      tenant=tenant, cohort=cohort)
         if req.rng_seed is None:
             req.rng_seed = (getattr(self.engine.config, "seed", 0)
                             * 1000003 + req.id * 7919 + 1) & 0x7FFFFFFF
@@ -445,9 +533,11 @@ class Scheduler:
                 f"exceeds the engine limits (max prompt "
                 f"{self.engine.max_prompt_len}, cache max_len "
                 f"{self.engine.config.max_len})")
-        shed_why = self._should_shed(prio)
+        shed_inputs = self._shed_inputs(prio)
+        shed_why = _dec.replay_shed(shed_inputs)
         if shed_why:
-            _M_SHED.inc()
+            _M_SHED.labels(tenant=req.tenant).inc()
+            self._decide("shed", req, shed_inputs, {"reason": shed_why})
             self._finish(req, SHED, "serving.shed")
             raise LoadShedError(
                 f"load shed (priority class {prio}): {shed_why}")
@@ -455,34 +545,31 @@ class Scheduler:
                 and int(staged_kv[2]) == len(prompt):
             req._staged = staged_kv
         self._queue.append(req)
-        self._count("serving.admitted")
+        self._decide("admit", req,
+                     dict(shed_inputs, max_queue=self.config.max_queue,
+                          staged=req._staged is not None),
+                     {"admitted": True, "queued_behind": len(self._queue)
+                      - 1})
+        self._count("serving.admitted", req)
         return handle
 
-    def _should_shed(self, prio):
-        """SLO admission control: sheddable classes are failed FAST past
-        the watermark instead of queueing to a certain deadline death.
-        Returns the reason string, or None to admit."""
+    def _shed_inputs(self, prio):
+        """The admission load-shed rule's inputs (SLO admission control,
+        ISSUE 6): sheddable classes are failed FAST past the watermark
+        instead of queueing to a certain deadline death. The VERDICT is
+        `decisions.replay_shed(inputs)` — the same rule every shed
+        decision record replays under, so the audit log is reproducible
+        by construction."""
         c = self.config
-        if prio < c.shed_priority:
-            return None
-        if c.shed_watermark is not None and \
-                len(self._queue) >= c.shed_watermark:
-            return (f"queue depth {len(self._queue)} >= watermark "
-                    f"{c.shed_watermark}")
-        pool = getattr(self.engine, "block_pool", None)
-        if c.shed_pool_free is not None and pool is not None and \
-                pool.capacity > 0:
-            # blocks held only by the prefix cache are evictable on
-            # demand — count them as free, or a warm cache would read as
-            # a full pool and shed traffic forever on an idle system
-            cache = getattr(self.engine, "prefix_cache", None)
-            free = pool.available + (cache.evictable()
-                                     if cache is not None else 0)
-            if free / pool.capacity < c.shed_pool_free:
-                return (f"block pool free fraction "
-                        f"{free / pool.capacity:.3f} < "
-                        f"{c.shed_pool_free}")
-        return None
+        # the pool scan (refcounts over every prefix-cache entry) is
+        # paid only when the pool-free rule is armed — submit is the
+        # admission hot path and the replay ignores the field otherwise
+        return {"priority": prio, "shed_priority": c.shed_priority,
+                "queue_depth": len(self._queue),
+                "shed_watermark": c.shed_watermark,
+                "pool_free_fraction": self._pool_free_fraction()
+                if c.shed_pool_free is not None else None,
+                "shed_pool_free": c.shed_pool_free}
 
     # -- the iteration loop --------------------------------------------------
     def capture_decode_steps(self, steps=1, out_dir="./serving_xplane"):
@@ -608,6 +695,10 @@ class Scheduler:
                                       "version": swap["version"],
                                       "params": n,
                                       "inflight": self.active_slots()}
+            self._decide("swap", None,
+                         {"version": swap["version"],
+                          "inflight": self.active_slots()},
+                         dict(self.last_swap))
             # per-swap outcome rides the event: a queued swap's waiter
             # must not read a LATER swap's last_swap
             swap["event"].swap_result = dict(self.last_swap)
@@ -673,7 +764,7 @@ class Scheduler:
                     for j in range(int(counts[slot])):
                         req.tokens.append(int(toks[slot, j]))
                         self._decode_tokens += 1
-                        self._count("serving.tokens")
+                        self._count("serving.tokens", req)
                         if req.finished(eos):
                             break
                 # a healthy step is the reprobe proof: reopen every
@@ -745,6 +836,8 @@ class Scheduler:
             _M_SWAP_DROPPED.inc(self.active_slots())
             self._swap_probation = False
         cause = f"{type(exc).__name__}: {exc}"
+        failed = [{"slot": s, "request_id": r.id, "tenant": r.tenant}
+                  for s, r in enumerate(self._slots) if r is not None]
         with RecordEvent("serving::decode_failure",
                          TracerEventType.UserDefined,
                          {"error": cause[:200],
@@ -753,6 +846,13 @@ class Scheduler:
                 if req is not None:
                     self._fail_engine_request(slot, req, cause)
         self._quarantine_all_but_probe()
+        self._decide("quarantine", None,
+                     {"error": cause[:200], "failed": failed,
+                      "engine_slots": self.engine.slots},
+                     {"quarantined": sorted(self._quarantined),
+                      "probe_slot": min(set(range(self.engine.slots))
+                                        - self._quarantined, default=None),
+                      "failed_requests": len(failed)})
 
     def _on_prefill_failure(self, slot, req, exc):
         """A prefill exception fails ONLY the request being placed — it
@@ -766,31 +866,51 @@ class Scheduler:
         with RecordEvent("serving::prefill_failure",
                          TracerEventType.UserDefined,
                          {"slot": slot, "request": req.id,
+                          "tenant": req.tenant,
                           "error": cause[:200]}):
             self._fail_engine_request(slot, req, cause)
         self._quarantine_all_but_probe()
+        self._decide("quarantine", req,
+                     {"error": cause[:200],
+                      "failed": [{"slot": slot, "request_id": req.id,
+                                  "tenant": req.tenant}],
+                      "engine_slots": self.engine.slots},
+                     {"quarantined": sorted(self._quarantined),
+                      "probe_slot": min(set(range(self.engine.slots))
+                                        - self._quarantined, default=None),
+                      "failed_requests": 1})
 
     # -- SLO machinery: preemption ------------------------------------------
+    def _victim_candidates(self, exclude=()):
+        """The candidate table a preemption weighs: every occupied,
+        non-excluded slot with its (priority, deadline slack, tenant) —
+        in slot order, recorded verbatim on the decision record so the
+        victim choice replays exactly."""
+        now = self._clock()
+        cands = []
+        for slot, req in enumerate(self._slots):
+            if req is None or slot in exclude:
+                continue
+            cands.append({
+                "slot": slot, "request_id": req.id,
+                "tenant": req.tenant, "priority": req.priority,
+                "deadline_slack_s": (None if req.deadline is None
+                                     else req.deadline - now)})
+        return cands
+
     def _pick_victim(self, worse_than=None, exclude=()):
         """The preemption victim: worst priority class first, most
         deadline slack within a class (no deadline == infinite slack —
         batch work yields before anything on a clock). `worse_than`
-        restricts to classes strictly below the given priority."""
-        best, best_key = None, None
-        now = self._clock()
-        for slot, req in enumerate(self._slots):
-            if req is None or slot in exclude:
-                continue
-            if worse_than is not None and req.priority <= worse_than:
-                continue
-            slack = float("inf") if req.deadline is None \
-                else req.deadline - now
-            key = (req.priority, slack)
-            if best is None or key > best_key:
-                best, best_key = slot, key
-        return best
+        restricts to classes strictly below the given priority. The
+        choice rule IS `decisions.replay_victim` over the candidate
+        table, so every preempt decision record reproduces it. Returns
+        (victim slot or None, candidates)."""
+        cands = self._victim_candidates(exclude)
+        best = _dec.replay_victim(cands, worse_than=worse_than)
+        return (None if best is None else best["slot"]), cands
 
-    def _preempt(self, slot, reason):
+    def _preempt(self, slot, reason, worse_than=None, candidates=None):
         """Evict `slot`'s request, freeing its blocks back to the pool
         (engine.reset_slot drops every table reference), and requeue it
         recompute-style: prompt+generated-so-far becomes the restart
@@ -805,20 +925,43 @@ class Scheduler:
         self._slots[slot] = None
         req.slot = None
         req.preempted += 1
-        self._count("serving.preempted")
+        self._count("serving.preempted", req)
         with RecordEvent("serving::preempt", TracerEventType.UserDefined,
                          {"slot": slot, "request": req.id,
                           "priority": req.priority,
+                          "tenant": req.tenant,
                           "tokens": len(req.tokens),
                           "reason": reason}):
             pass
         remaining = req.max_new_tokens - len(req.tokens)
+        resume = req.prompt + req.tokens
+        fits = (len(resume) <= self.engine.max_prompt_len
+                and len(resume) + remaining <= self.engine.config.max_len)
+        disposition = "done" if remaining < 1 \
+            else ("requeued" if fits else "error")
+        # the audit record (ISSUE 15): the candidate table this victim
+        # beat + the rule scope, so the choice replays from the record
+        self._decide(
+            "preempt", req,
+            {"reason": reason, "worse_than": worse_than,
+             "candidates": candidates
+             if candidates is not None else [{
+                 "slot": slot, "request_id": req.id,
+                 "tenant": req.tenant, "priority": req.priority,
+                 "deadline_slack_s": None}],
+             "queue_depth": len(self._queue),
+             # same armed-only cost rule as _shed_inputs: the replay
+             # never reads this field, so the O(cache-entries) scan is
+             # paid only when the pool-free shed rule is configured
+             "pool_free_fraction": self._pool_free_fraction()
+             if self.config.shed_pool_free is not None else None},
+            {"victim_slot": slot, "victim_request_id": req.id,
+             "victim_tenant": req.tenant, "disposition": disposition,
+             "tokens_delivered": len(req.tokens)})
         if remaining < 1:                  # raced its own completion
             self._finish(req, DONE, "serving.completed")
             return
-        resume = req.prompt + req.tokens
-        if len(resume) > self.engine.max_prompt_len or \
-                len(resume) + remaining > self.engine.config.max_len:
+        if not fits:
             req.error = (f"preempted ({reason}) and the restart prompt "
                          f"({len(resume)} tokens) exceeds the engine "
                          f"limits")
@@ -856,11 +999,13 @@ class Scheduler:
                 except BlockAllocError:
                     # worse_than=priority-1 keeps classes >= the growing
                     # request's own; the growing slot is a candidate too
-                    victim = self._pick_victim(
+                    victim, cands = self._pick_victim(
                         worse_than=req.priority - 1)
                     if victim is None:      # unreachable: slot qualifies
-                        victim = slot
-                    self._preempt(victim, "allocation pressure")
+                        victim, cands = slot, None
+                    self._preempt(victim, "allocation pressure",
+                                  worse_than=req.priority - 1,
+                                  candidates=cands)
                     if victim == slot:
                         break
 
@@ -885,6 +1030,7 @@ class Scheduler:
                 with RecordEvent("serving::retire",
                                  TracerEventType.UserDefined,
                                  {"slot": slot, "request": req.id,
+                                  "tenant": req.tenant,
                                   "tokens": len(req.tokens),
                                   "timeout": timed_out}):
                     self.engine.reset_slot(slot)
@@ -988,13 +1134,14 @@ class Scheduler:
             try:
                 first = self._place_once(slot, req)
             except BlockAllocError:
-                victim = self._pick_victim(worse_than=req.priority,
-                                           exclude=(slot,))
+                victim, cands = self._pick_victim(
+                    worse_than=req.priority, exclude=(slot,))
                 if victim is None:
                     req.trail.begin(_rt.PH_QUEUE, self._clock())
                     self._queue.append(req)     # retry next step
                     return "stop"
-                self._preempt(victim, "admission pressure")
+                self._preempt(victim, "admission pressure",
+                              worse_than=req.priority, candidates=cands)
                 continue
             except Exception as e:               # noqa: BLE001
                 self._on_prefill_failure(slot, req, e)
@@ -1012,9 +1159,17 @@ class Scheduler:
         stats = getattr(self.engine, "last_prefill_stats", None) or {}
         if stats.get("prefix_hit_tokens", 0) > 0:
             req.prefix_hit = True
+        self._decide("place", req,
+                     {"slot": slot, "queue_depth": len(self._queue),
+                      "priority": req.priority,
+                      "preempted": req.preempted,
+                      "staged": req.adopted},
+                     {"placed": True, "slot": slot,
+                      "adopted": req.adopted,
+                      "prefix_hit": req.prefix_hit})
         req.tokens.append(first)
         self._decode_tokens += 1
-        self._count("serving.tokens")
+        self._count("serving.tokens", req)
         if req.finished(self.engine.config.eos_token_id):
             self.engine.reset_slot(slot)
             self._finish(req, DONE, "serving.completed")
@@ -1026,24 +1181,31 @@ class Scheduler:
         req.status = status
         req.finished_at = self._clock()
         req.trail.close(req.finished_at)
-        self._count(counter)
+        self._count(counter, req)
         if req.first_token_at is not None:
-            _M_TTFT.observe(req.first_token_at - req.submitted_at)
+            _M_TTFT.labels(tenant=req.tenant).observe(
+                req.first_token_at - req.submitted_at)
+            _M_REQ_DECODE.labels(tenant=req.tenant).observe(
+                req.finished_at - req.first_token_at)
         if status in (DONE, TIMEOUT, ERROR, SHED):
             self._completed.append(req)
             self._write_request_record(req)
             self._write_timeline_record(req)
         req._done.set()
 
-    def _count(self, name):
+    def _count(self, name, req=None):
         # registry first (the unified surface), then the deprecated
-        # per-instance dict + native stat mirror for existing readers
+        # per-instance dict + native stat mirror for existing readers.
+        # Every per-request family carries the request's tenant label
+        # (ISSUE 15); counts with no request context label "default".
+        tenant = getattr(req, "tenant", None) or _dec.DEFAULT_TENANT
         if name == "serving.tokens":
-            _M_TOKENS.inc()
+            _M_TOKENS.labels(tenant=tenant).inc()
         elif name == "serving.preempted":
-            _M_PREEMPTED.inc()
+            _M_PREEMPTED.labels(tenant=tenant).inc()
         else:
-            _M_REQUESTS.labels(status=name.split(".", 1)[1]).inc()
+            _M_REQUESTS.labels(status=name.split(".", 1)[1],
+                               tenant=tenant).inc()
         self.counts[name] += 1
         native.stat_add(name, 1)
 
@@ -1100,7 +1262,8 @@ class Scheduler:
             ttft_s=(req.first_token_at - req.submitted_at
                     if req.first_token_at is not None else None),
             priority=req.priority, preempted=req.preempted,
-            adopted=req.adopted, trace_id=req.trace_id)
+            adopted=req.adopted, trace_id=req.trace_id,
+            tenant=req.tenant, cohort=req.cohort)
 
     def timeline_records(self):
         """reqtimeline.v1 records for every completed request so far —
@@ -1121,6 +1284,8 @@ class Scheduler:
                     if req.first_token_at else None)
         self._metrics_f.write(json.dumps({
             "kind": "request", "request_id": req.id, "status": req.status,
+            "tenant": req.tenant,
+            **({"cohort": req.cohort} if req.cohort else {}),
             "prompt_len": len(req.prompt), "tokens": len(req.tokens),
             "priority": req.priority, "preempted": req.preempted,
             "prefix_hit": req.prefix_hit, "adopted": req.adopted,

@@ -202,7 +202,7 @@ def test_watchdog_latency_burn_and_sustained_breach():
     wd.observe(_ttft_snap(70, 400))
     assert not wd.degraded
     g = _flat(metrics.registry().snapshot())
-    assert "serving_slo_burn{slo=ttft,window=fast}" in g
+    assert "serving_slo_burn{slo=ttft,tenant=_all,window=fast}" in g
     assert g["serving_slo_degraded"] == 0.0
 
 
@@ -459,11 +459,15 @@ def test_worker_verbs_stat_projects_the_snapshot(fleet_worker):
     stat = fe.decode.stat(0)
     # STAT == a thin projection of the SAME registry snapshot: no
     # second bookkeeping to drift
-    assert stat["tokens_generated"] == flat["serving_tokens_total"]
+    # tenant-labeled families (ISSUE 15): STAT sums the tenant slices
+    assert stat["tokens_generated"] == sum(
+        v for k, v in flat.items()
+        if k.startswith("serving_tokens_total"))
     assert stat["handoff_bytes"] == flat.get(
         "serving_kv_handoff_bytes_total", 0)
-    assert stat["requests"]["serving.completed"] == flat[
-        "serving_requests_total{status=completed}"]
+    assert stat["requests"]["serving.completed"] == sum(
+        v for k, v in flat.items()
+        if k.startswith("serving_requests_total{status=completed"))
     # the terminal POLL carried the worker's own phase trail, joined
     # into the router record as worker_phases
     rec = fe.timeline_records()[-1]
@@ -569,8 +573,10 @@ def test_forked_federation_reconciles(tmp_path):
             fe, jsonl_path=str(tmp_path / "fleet.jsonl"),
             poll_interval_s=0.05)
         rng = np.random.RandomState(5)
-        reqs = [fe.submit(rng.randint(0, VOCAB, 6).tolist(), max_new=4)
-                for _ in range(6)]
+        tenants = ("acme", "globex")
+        reqs = [fe.submit(rng.randint(0, VOCAB, 6).tolist(), max_new=4,
+                          tenant=tenants[i % 2])
+                for i in range(6)]
         fe.run(timeout_s=120)
         assert all(r.status == "DONE" for r in reqs)
         merged = plane.poll_now()
@@ -579,36 +585,49 @@ def test_forked_federation_reconciles(tmp_path):
         assert {"decode0", "decode1", "router"} <= set(members)
         for wid in ("decode0", "decode1"):
             local = metrics.flatten_snapshot(members[wid]["snapshot"])
-            key = f"serving_tokens_total{{role=decode,worker_id={wid}}}"
-            assert flat[key] == local["serving_tokens_total"] > 0
-        # fleet aggregate = sum over EVERY member carrying the series
-        # (the router's own registry federates too — in this test
-        # process it may carry counts from earlier in-process tests)
-        assert flat["serving_tokens_total"
-                    "{role=_fleet,worker_id=_fleet}"] == sum(
-            metrics.flatten_snapshot(m["snapshot"]).get(
-                "serving_tokens_total", 0)
-            for m in plane.last_members)
+            merged_total = sum(
+                v for k, v in flat.items()
+                if k.startswith("serving_tokens_total{")
+                and f"worker_id={wid}" in k)
+            local_total = sum(v for k, v in local.items()
+                              if k.startswith("serving_tokens_total"))
+            assert merged_total == local_total > 0
+        # the tenant labelset survives federation (ISSUE 15): each
+        # tenant's series keeps worker_id x tenant labels AND gets its
+        # own _fleet aggregate row per tenant labelset, summed over
+        # every member carrying that tenant
+        for t in tenants:
+            agg_key = (f"serving_tokens_total{{role=_fleet,tenant={t},"
+                       f"worker_id=_fleet}}")
+            per_worker = sum(
+                v for k, v in flat.items()
+                if k.startswith("serving_tokens_total{")
+                and f"tenant={t}" in k and "_fleet" not in k)
+            assert flat[agg_key] == per_worker > 0
 
-        # histogram buckets: aggregate == bucket-wise member sum
-        def _buckets(snap, wid=None):
+        # histogram buckets: per-(worker, tenant) samples sum
+        # BUCKET-WISE into the _fleet row of each tenant labelset
+        def _samples(snap, wid=None, tenant=None):
             for m in snap["metrics"]:
                 if m["name"] != "serving_ttft_seconds":
                     continue
-                for s in m["samples"]:
-                    if wid is None or \
-                            s["labels"].get("worker_id") == wid:
-                        return s
-            return None
-        agg = _buckets(merged, fleet.FLEET_LABEL)
-        parts = [b for b in (_buckets(m["snapshot"])
-                             for m in plane.last_members) if b]
-        assert sum(p["count"] for p in
-                   (_buckets(members[w]["snapshot"])
-                    for w in ("decode0", "decode1"))) == len(reqs)
-        assert agg["count"] == sum(p["count"] for p in parts)
-        for edge, c in agg["buckets"].items():
-            assert c == sum(p["buckets"][edge] for p in parts)
+                return [s for s in m["samples"]
+                        if (wid is None or (s.get("labels") or {})
+                            .get("worker_id") == wid)
+                        and (tenant is None or (s.get("labels") or {})
+                             .get("tenant") == tenant)]
+            return []
+        assert sum(s["count"] for w in ("decode0", "decode1")
+                   for s in _samples(members[w]["snapshot"])) == len(reqs)
+        for t in tenants:
+            aggs = _samples(merged, fleet.FLEET_LABEL, t)
+            assert len(aggs) == 1, aggs
+            parts = [s for m in plane.last_members
+                     for s in _samples(m["snapshot"], tenant=t)]
+            assert aggs[0]["count"] == sum(p["count"]
+                                           for p in parts) > 0
+            for edge, c in aggs[0]["buckets"].items():
+                assert c == sum(p["buckets"][edge] for p in parts)
         # the artifacts: schema-valid fleet JSONL + ONE merged prom
         recs = metrics_report.load_snapshots(str(tmp_path / "fleet.jsonl"))
         assert recs
@@ -616,8 +635,18 @@ def test_forked_federation_reconciles(tmp_path):
             plane.prometheus()) == []
         tl = [json.loads(x) for x in
               open(tmp_path / "tl.jsonl") if x.strip()]
-        assert len(tl) == len(reqs)
         assert serve_report.validate_records(tl) == []
+        tl_recs = [r for r in tl if r["kind"] == "timeline"]
+        assert len(tl_recs) == len(reqs)
+        # every timeline record names its tenant; the router's place
+        # decisions (interleaved in the same stream) agree with it
+        assert {r["tenant"] for r in tl_recs} == set(tenants)
+        decs = [r for r in tl if r["kind"] == "decision"]
+        assert decs and {d["tenant"] for d in decs} <= set(tenants)
+        by_key = {r["key"]: r for r in tl_recs}
+        for d in decs:
+            if d["key"] in by_key:
+                assert d["tenant"] == by_key[d["key"]]["tenant"]
         fe.stop_workers()
         fe.close()
     finally:
@@ -663,7 +692,8 @@ def test_sigkill_chaos_timeline_burn_and_bundle(tmp_path):
         rec.annotations.pop("fleet.slo_breach", None)
         prompts = [np.random.RandomState(100 + i).randint(
             0, VOCAB, 6 + (i % 3)).tolist() for i in range(4)]
-        reqs = [fe.submit(p, max_new=16) for p in prompts]
+        reqs = [fe.submit(p, max_new=16, tenant=f"t{i % 2}")
+                for i, p in enumerate(prompts)]
         plane.poll_now()                     # healthy baseline sample
         victims = [r for r in reqs if r.worker == 1]
         assert victims, "nothing placed on the worker we will kill"
@@ -689,10 +719,14 @@ def test_sigkill_chaos_timeline_burn_and_bundle(tmp_path):
             "serving_slo_degraded"] == 1.0
 
         # the victim's timeline: failover is a NAMED phase, and the
-        # trail still decomposes its end-to-end latency
-        tl = {r["key"]: r for r in
-              (json.loads(x) for x in open(tmp_path / "tl.jsonl"))}
-        assert serve_report.validate_records(list(tl.values())) == []
+        # trail still decomposes its end-to-end latency. The stream
+        # interleaves timeline + decisions.v1 records; validation
+        # REPLAYS every decision's inputs (ISSUE 15)
+        tl_all = [json.loads(x) for x in open(tmp_path / "tl.jsonl")
+                  if x.strip()]
+        assert serve_report.validate_records(tl_all) == []
+        tl = {r["key"]: r for r in tl_all if r["kind"] == "timeline"}
+        decs = [r for r in tl_all if r["kind"] == "decision"]
         for v in victims:
             trec = tl[v.key]
             phases = [s["phase"] for s in trec["phases"]]
@@ -703,6 +737,17 @@ def test_sigkill_chaos_timeline_burn_and_bundle(tmp_path):
             # the hop re-placed and decoded again: decode appears on
             # both sides of the failover mark
             assert phases.index("failover") < len(phases) - 1
+            # the decision log names the hop, with the SAME tenant and
+            # trace id as the victim's timeline record (ISSUE 15): the
+            # "why did this stream move hosts" record joins its latency
+            # decomposition on (key, tenant, trace_id)
+            hops = [d for d in decs if d["action"] == "failover"
+                    and d["key"] == v.key]
+            assert len(hops) == v.failovers > 0
+            for d in hops:
+                assert d["tenant"] == trec["tenant"] == v.tenant
+                assert d.get("trace_id") == trec.get("trace_id")
+                assert d["inputs"]["dead_worker"] == 1
 
         bundle = plane.last_bundle
         assert bundle and os.path.isdir(bundle)

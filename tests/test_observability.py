@@ -217,9 +217,13 @@ def test_serving_counters_hit_registry_and_legacy_dict():
     def delta(key):
         return after.get(key, 0) - before.get(key, 0)
 
-    assert delta("serving_requests_total{status=admitted}") == 2
-    assert delta("serving_requests_total{status=completed}") == 2
-    assert delta("serving_tokens_total") == 6
+    # the per-request families carry the tenant labelset (ISSUE 15);
+    # unlabeled submits land under tenant=default
+    assert delta(
+        "serving_requests_total{status=admitted,tenant=default}") == 2
+    assert delta(
+        "serving_requests_total{status=completed,tenant=default}") == 2
+    assert delta("serving_tokens_total{tenant=default}") == 6
     # the deprecated per-instance dict still answers
     assert sched.counts["serving.admitted"] == 2
     assert sched.counts["serving.tokens"] == 6

@@ -679,6 +679,58 @@ def test_metrics_compare_gates_slo_burn_through_cli(tmp_path):
     assert "serving_slo_burn" in bad.stdout
 
 
+def test_metrics_compare_tenant_membership_and_per_tenant_rules(tmp_path):
+    """ISSUE 15 gate, through the CLI: per-tenant shed growth and a
+    per-tenant SLO-burn flip fire on exactly the tenant that regressed;
+    a tenant present in only one run is MEMBERSHIP-SKIPPED (the PR 12
+    worker-intersection machinery generalized to the tenant dimension),
+    and the `_all` (unscoped) SLO rows always participate."""
+    a = _snapshot_with_labeled({
+        "serving_shed_total": [({"tenant": "a"}, 2.0),
+                               ({"tenant": "b"}, 2.0)],
+        "serving_tokens_total": [({"tenant": "a"}, 1000.0),
+                                 ({"tenant": "b"}, 1000.0)]})
+    b = _snapshot_with_labeled({
+        "serving_shed_total": [({"tenant": "a"}, 2.0),
+                               ({"tenant": "b"}, 40.0),
+                               ({"tenant": "c"}, 50.0)],
+        "serving_tokens_total": [({"tenant": "a"}, 1000.0),
+                                 ({"tenant": "b"}, 1000.0),
+                                 ({"tenant": "c"}, 5.0)]})
+    regs = metrics_report.compare_counters(a, b)
+    keys = [k for k, *_ in regs]
+    assert "serving_shed_total{tenant=b}" in keys          # the regressor
+    assert not any("tenant=a" in k for k in keys)          # healthy tenant
+    # tenant c exists only in B (onboarded between runs): its series
+    # must not read as failure counters appearing from zero
+    assert not any("tenant=c" in k for k in keys), keys
+    # per-tenant burn flip from a clean baseline + the _all row's growth
+    ga = _snapshot_with_labeled_gauges({"serving_slo_burn": [
+        ({"slo": "ttft", "window": "fast", "tenant": "a"}, 0.0),
+        ({"slo": "ttft", "window": "fast", "tenant": "b"}, 0.0),
+        ({"slo": "ttft", "window": "fast", "tenant": "_all"}, 0.5)]})
+    gb = _snapshot_with_labeled_gauges({"serving_slo_burn": [
+        ({"slo": "ttft", "window": "fast", "tenant": "a"}, 0.2),
+        ({"slo": "ttft", "window": "fast", "tenant": "b"}, 30.0),
+        ({"slo": "ttft", "window": "fast", "tenant": "_all"}, 2.0)]})
+    gregs = metrics_report.compare_counters(ga, gb, min_delta=0.01)
+    gkeys = [k for k, *_ in gregs]
+    assert any("tenant=b" in k for k in gkeys), gkeys      # b crossed 1.0
+    assert any("tenant=_all" in k for k in gkeys), gkeys   # _all grew
+    assert not any(",tenant=a," in k for k in gkeys), gkeys
+    # the CLI exit code reflects the per-tenant gate
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_shed_total{tenant=b}" in bad.stdout
+
+
 @pytest.mark.slow
 def test_bench_serve_dist_emits_fleet_artifacts(tmp_path):
     """ISSUE 12 CI: `bench.py --serve-dist` leaves the fleet
@@ -714,11 +766,17 @@ def test_bench_serve_dist_emits_fleet_artifacts(tmp_path):
     assert metrics_report.validate_prometheus(prom) == []
     assert 'worker_id="_fleet"' in prom
 
-    timelines = [json.loads(x) for x in
-                 open(os.path.join(obs, "timelines.jsonl")) if x.strip()]
-    assert len(timelines) == rec["extra"]["requests"]
-    errs = serve_report.validate_records(timelines)
+    stream = [json.loads(x) for x in
+              open(os.path.join(obs, "timelines.jsonl")) if x.strip()]
+    errs = serve_report.validate_records(stream)
     assert errs == [], errs[:5]
+    # the stream interleaves decisions.v1 records (ISSUE 15) with the
+    # timelines: one timeline per request, plus replay-valid placement
+    # decisions
+    timelines = [r for r in stream if r["kind"] == "timeline"]
+    assert len(timelines) == rec["extra"]["requests"]
+    assert any(r["kind"] == "decision" and r["action"] == "place"
+               for r in stream)
     phases = {s["phase"] for t in timelines for s in t["phases"]}
     assert {"queue", "prefill", "place", "decode"} <= phases, phases
     assert any(s["phase"] == "kv_handoff"

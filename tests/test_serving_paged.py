@@ -285,6 +285,17 @@ def test_injected_alloc_pressure_never_corrupts_neighbors(tiny):
         assert h.status == "DONE", (h.status, h.error)
         assert h.tokens == _reference_tokens(tiny, p, 5)
     assert eng.block_pool.in_use == 0
+    # the decision audit log composes with chaos (ISSUE 15): every
+    # injected-pressure preemption left a replay-valid decisions.v1
+    # record naming its victim, and the tally matches the counter
+    from paddle_tpu.observability import decisions as _dec
+    recs = sched.decision_records()
+    assert _dec.validate_records(recs) == [], _dec.validate_records(recs)
+    preempts = [r for r in recs if r["action"] == "preempt"]
+    assert len(preempts) == sched.counts["serving.preempted"]
+    for r in preempts:
+        assert r["outcome"]["victim_request_id"] in {h.request_id
+                                                     for h in hs}
 
 
 def test_growth_pressure_never_evicts_better_class(tiny):

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Join the committed BENCH_r*.json history into one trend table.
+
+Every bench round commits a `BENCH_rNN.json` driver document
+({n, cmd, rc, tail, parsed}) — but the wedged-grant rounds (r03-r05:
+rc=124 timeouts, `backend probe hung` zero-MFU records) are EVIDENCE OF
+A SICK BACKEND, not perf regressions, and must never poison a trend
+line or get picked as a `--compare` baseline. This tool classifies each
+round:
+
+  HEALTHY  rc==0 with a parsed metric and a nonzero value — a real
+           measurement, trend-worthy and baseline-eligible
+  WEDGED   the grant wedge signature: rc==124 (the driver timeout),
+           "backend probe hung"/"wedged grant" in the error or tail, or
+           a zero-value metric carrying an error field (the bench's own
+           backend-unavailable record) — excluded from trend AND
+           baseline, listed with its wedge reason
+  FAILED   everything else (a genuine crash, e.g. r02's HBM OOM) —
+           excluded from baseline, shown as a failure in the table
+
+and renders the trajectory (metric, value, MFU where derivable —
+`extra.mfu` percentages normalized to fractions) over the HEALTHY
+window only, plus the newest healthy round as the recommended compare
+baseline. `--jsonl` writes the rows as `paddle_tpu.benchtrend.v1`
+records for downstream joins.
+
+Stdlib-only: the artifacts must outlive the TPU grant that wrote them.
+
+Usage:
+  python tools/bench_trend.py                 # BENCH_r*.json in repo root
+  python tools/bench_trend.py BENCH_r01.json BENCH_r04.json
+  python tools/bench_trend.py --jsonl trend.jsonl
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+SCHEMA = "paddle_tpu.benchtrend.v1"
+
+HEALTHY = "HEALTHY"
+WEDGED = "WEDGED"
+FAILED = "FAILED"
+
+# the wedge signatures: the driver's timeout rc, and the bench's own
+# backend-probe postmortem strings (BENCH_r03-r05)
+_WEDGE_RC = 124
+_WEDGE_PAT = re.compile(r"backend probe hung|wedged grant|"
+                        r"backend unavailable", re.I)
+
+
+def classify(doc):
+    """(class, reason) for one BENCH_rNN driver document."""
+    rc = doc.get("rc")
+    parsed = doc.get("parsed") or {}
+    err = str(parsed.get("error") or "")
+    tail = str(doc.get("tail") or "")
+    if rc == _WEDGE_RC:
+        return WEDGED, f"driver timeout (rc={_WEDGE_RC})"
+    if _WEDGE_PAT.search(err) or (_WEDGE_PAT.search(tail)
+                                  and not parsed.get("value")):
+        return WEDGED, (err or "wedge signature in tail")[:120]
+    if parsed and not parsed.get("value") and err:
+        return WEDGED, f"zero metric with error: {err[:100]}"
+    if rc != 0 or not parsed or not parsed.get("value"):
+        return FAILED, f"rc={rc}, " + (
+            "no parsed metric" if not parsed
+            else err[:100] or "no metric value")
+    return HEALTHY, ""
+
+
+def _mfu(parsed):
+    """Best-effort MFU fraction from a parsed bench record: the
+    `extra.mfu` field (percent values normalized), or the value itself
+    when the metric IS an MFU fraction."""
+    if not parsed:
+        return None
+    mfu = (parsed.get("extra") or {}).get("mfu")
+    if mfu is not None:
+        mfu = float(mfu)
+        return mfu / 100.0 if mfu > 1.0 else mfu
+    if "mfu" in str(parsed.get("metric") or "").lower() or \
+            "MFU" in str(parsed.get("unit") or ""):
+        v = parsed.get("value")
+        return None if v is None else float(v)
+    return None
+
+
+def load_rows(paths):
+    """One benchtrend.v1 row per BENCH file, in run order."""
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        parsed = doc.get("parsed") or {}
+        cls, why = classify(doc)
+        m = re.search(r"(r\d+)", os.path.basename(path))
+        rows.append({
+            "schema": SCHEMA,
+            "run": m.group(1) if m else os.path.basename(path),
+            "n": doc.get("n"), "rc": doc.get("rc"), "class": cls,
+            "reason": why,
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "mfu": _mfu(parsed),
+            "path": path})
+    rows.sort(key=lambda r: (r["n"] is None, r["n"], r["run"]))
+    return rows
+
+
+def healthy_baseline(rows):
+    """The newest HEALTHY row — the only legitimate `--compare`
+    baseline; wedged/failed rounds can never be picked."""
+    healthy = [r for r in rows if r["class"] == HEALTHY]
+    return healthy[-1] if healthy else None
+
+
+def render(rows):
+    out = ["# bench trend", "",
+           "| run | rc | class | metric | value | MFU | note |",
+           "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        val = "-" if r["value"] is None else f"{r['value']:g}"
+        mfu = "-" if r["mfu"] is None else f"{r['mfu']:.4f}"
+        note = r["reason"] or (r["unit"] or "")
+        out.append(f"| {r['run']} | {r['rc']} | {r['class']} | "
+                   f"{r['metric'] or '-'} | {val} | {mfu} | "
+                   f"{note[:60]} |")
+    healthy = [r for r in rows if r["class"] == HEALTHY]
+    wedged = [r for r in rows if r["class"] == WEDGED]
+    out += ["", f"healthy window: {len(healthy)}/{len(rows)} rounds"
+            + (f" ({', '.join(r['run'] for r in healthy)})"
+               if healthy else "")]
+    if wedged:
+        out.append(f"wedged (excluded from trend/baseline): "
+                   f"{', '.join(r['run'] for r in wedged)}")
+    traj = [r for r in healthy if r["mfu"] is not None]
+    if traj:
+        out.append("healthy MFU trajectory: " + " -> ".join(
+            f"{r['run']}={r['mfu']:.4f}" for r in traj))
+    base = healthy_baseline(rows)
+    if base:
+        out.append(f"compare baseline: {base['run']} "
+                   f"({base['metric']}={base['value']:g})")
+    else:
+        out.append("compare baseline: NONE — no healthy round on "
+                   "record")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*",
+                   help="BENCH_r*.json documents (default: glob the "
+                        "repo root)")
+    p.add_argument("--jsonl", default=None,
+                   help="write the benchtrend.v1 rows here")
+    args = p.parse_args(argv)
+    paths = args.files or sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_r*.json")))
+    if not paths:
+        print("no BENCH_r*.json files found", file=sys.stderr)
+        return 2
+    rows = load_rows(paths)
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    print(render(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,12 +60,21 @@ __all__ = ["TrafficConfig", "VirtualClock", "synth_trace", "replay",
 class TrafficConfig:
     """Knobs of the synthetic trace. `prefix_pool` shared system prompts
     of `prefix_len` tokens are dealt round-robin to `users`; each request
-    appends a random suffix of suffix_min..suffix_max tokens."""
+    appends a random suffix of suffix_min..suffix_max tokens.
+
+    Multi-tenant mix (ISSUE 15): `tenants` maps tenant name ->
+    arrival rate (rps); each tenant gets its own independent seeded
+    Poisson stream and a share of `requests` proportional to its rate.
+    `burst` = {"tenant", "t0", "dur_s", "mult"} multiplies ONE tenant's
+    arrival rate inside a window — the isolation-gate scenario (tenant
+    A bursts, tenant B's p99 must hold). With tenants=None the trace is
+    the historical single-stream shape, byte-identical to before the
+    labelset landed."""
 
     def __init__(self, users=8, requests=32, rate_rps=200.0, prefix_pool=2,
                  prefix_len=16, suffix_min=2, suffix_max=8,
                  max_new_tokens=4, priority_weights=(1, 2, 1),
-                 timeout_s=None, seed=0):
+                 timeout_s=None, seed=0, tenants=None, burst=None):
         self.users = int(users)
         self.requests = int(requests)
         self.rate_rps = float(rate_rps)
@@ -77,6 +86,8 @@ class TrafficConfig:
         self.priority_weights = tuple(priority_weights)
         self.timeout_s = timeout_s
         self.seed = int(seed)
+        self.tenants = dict(tenants) if tenants else None
+        self.burst = dict(burst) if burst else None
 
 
 class VirtualClock:
@@ -95,7 +106,12 @@ class VirtualClock:
 def synth_trace(cfg, vocab):
     """The deterministic request trace: a list of dicts with arrival
     time `t` (seconds from start, Poisson via seeded exponential
-    inter-arrivals), `prompt`, `priority`, `max_new`, `user`."""
+    inter-arrivals), `prompt`, `priority`, `max_new`, `user` — plus
+    `tenant` when cfg.tenants is set (one independent seeded stream per
+    tenant, merged by arrival time; the burst window multiplies its
+    tenant's rate in place)."""
+    if cfg.tenants:
+        return _synth_multi_tenant(cfg, vocab)
     rng = np.random.RandomState(cfg.seed)
     prefixes = [rng.randint(0, vocab, cfg.prefix_len).tolist()
                 for _ in range(max(cfg.prefix_pool, 1))]
@@ -117,6 +133,43 @@ def synth_trace(cfg, vocab):
     return items
 
 
+def _synth_multi_tenant(cfg, vocab):
+    """One seeded Poisson stream per tenant (requests split pro-rata by
+    rate), merged by arrival time. The burst knob multiplies the named
+    tenant's instantaneous rate inside [t0, t0+dur_s) — the two-tenant
+    isolation scenario of ROADMAP item 5."""
+    w = np.asarray(cfg.priority_weights, np.float64)
+    w = w / w.sum()
+    burst = cfg.burst or {}
+    total_rate = sum(cfg.tenants.values()) or 1.0
+    items = []
+    for idx, (tenant, rate) in enumerate(sorted(cfg.tenants.items())):
+        rng = np.random.RandomState(cfg.seed + 7919 * (idx + 1))
+        prefixes = [rng.randint(0, vocab, cfg.prefix_len).tolist()
+                    for _ in range(max(cfg.prefix_pool, 1))]
+        n = max(1, int(round(cfg.requests * rate / total_rate)))
+        t = 0.0
+        for i in range(n):
+            r = float(rate)
+            if burst.get("tenant") == tenant and \
+                    burst["t0"] <= t < burst["t0"] + burst["dur_s"]:
+                r *= float(burst["mult"])
+            t += float(rng.exponential(1.0 / r))
+            user = i % cfg.users
+            prompt = list(prefixes[user % len(prefixes)])
+            n_suffix = int(rng.randint(cfg.suffix_min,
+                                       cfg.suffix_max + 1))
+            prompt += rng.randint(0, vocab, n_suffix).tolist()
+            items.append({
+                "t": t, "user": user, "tenant": tenant,
+                "prompt": prompt,
+                "priority": int(rng.choice(len(w), p=w)),
+                "max_new": cfg.max_new_tokens,
+            })
+    items.sort(key=lambda it: it["t"])
+    return items
+
+
 # one percentile convention across the serving tools: serve_report owns it
 percentile = serve_report._pct
 
@@ -127,13 +180,15 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
     scheduler's clock passes each item's arrival time; sheds/rejections
     are tallied, everything else runs to a terminal status. Returns the
     summary dict."""
-    from paddle_tpu.serving import LoadShedError, QueueFullError
+    from paddle_tpu.serving import PRIORITIES, LoadShedError, QueueFullError
 
+    cohort_of = {v: k for k, v in PRIORITIES.items()}
     wall0 = time.monotonic()
     now = (lambda: virtual_clock()) if virtual_clock is not None \
         else (lambda: time.monotonic() - wall0)
     handles = []
     shed = rejected = 0
+    shed_by_tenant = {}
     next_i = 0
     max_concurrent = 0
     steps = 0
@@ -144,9 +199,13 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
             try:
                 handles.append(sched.submit(
                     it["prompt"], max_new_tokens=it["max_new"],
-                    timeout_s=timeout_s, priority=it["priority"]))
+                    timeout_s=timeout_s, priority=it["priority"],
+                    tenant=it.get("tenant"),
+                    cohort=cohort_of.get(it["priority"])))
             except LoadShedError:
                 shed += 1
+                t = it.get("tenant", "default")
+                shed_by_tenant[t] = shed_by_tenant.get(t, 0) + 1
             except QueueFullError:
                 rejected += 1
         more = sched.step()
@@ -184,21 +243,59 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
         "ttft_p99_s": percentile(ttfts, 0.99),
         "ttft_phase_s": _ttft_phase_breakdown(sched),
     }
+    if any("tenant" in it for it in trace):
+        summary["tenants"] = _tenant_summary(trace, handles,
+                                             shed_by_tenant, sched)
     _export_registry(summary)
     return summary
 
 
-def _ttft_phase_breakdown(sched):
-    """Mean seconds each named phase contributed to TTFT, derived from
-    the scheduler's reqtimeline.v1 records (ISSUE 12): each completed
-    request's segments are clipped to its [0, ttft) window
+def _tenant_summary(trace, handles, shed_by_tenant, sched):
+    """Per-tenant replay figures (ISSUE 15): request/shed tallies,
+    per-tenant p50/p99 TTFT, and per-tenant TTFT phase attribution
+    (each tenant's own timeline records clipped to their TTFT windows)
+    — the isolation-gate readout: did tenant A's burst move tenant B's
+    tail?"""
+    tenants = sorted({it.get("tenant", "default") for it in trace})
+    by_tenant_handles = {}
+    for h in handles:
+        by_tenant_handles.setdefault(h.tenant, []).append(h)
+    tl_by_tenant = {}
+    for rec in sched.timeline_records():
+        tl_by_tenant.setdefault(rec.get("tenant", "default"),
+                                []).append(rec)
+    out = {}
+    for t in tenants:
+        hs = by_tenant_handles.get(t, [])
+        ttfts = [h.ttft_s for h in hs if h.ttft_s is not None]
+        by_status = {}
+        for h in hs:
+            by_status[h.status] = by_status.get(h.status, 0) + 1
+        out[t] = {
+            "requests": sum(1 for it in trace
+                            if it.get("tenant", "default") == t),
+            "submitted": len(hs),
+            "shed": shed_by_tenant.get(t, 0),
+            "by_status": by_status,
+            "preempted": sum(h.preempted for h in hs),
+            "ttft_p50_s": percentile(ttfts, 0.50),
+            "ttft_p99_s": percentile(ttfts, 0.99),
+            "ttft_phase_s": _phase_means(tl_by_tenant.get(t, [])),
+        }
+    return out
+
+
+def _phase_means(timeline_records):
+    """Mean seconds each named phase contributed to TTFT over an
+    iterable of reqtimeline.v1 records (ISSUE 12): each request's
+    segments are clipped to its [0, ttft) window
     (reqtimeline.ttft_breakdown), then averaged over the requests that
-    produced a first token — so a bench rung carries ATTRIBUTION
-    (queue wait vs prefill vs handoff/adopt vs first decode step), not
-    just the TTFT total."""
+    produced a first token. ONE implementation for the aggregate and
+    the per-tenant (ISSUE 15) views, so the attribution math cannot
+    drift between them."""
     from paddle_tpu.observability import reqtimeline as _rt
     totals, n = {}, 0
-    for rec in sched.timeline_records():
+    for rec in timeline_records:
         parts = _rt.ttft_breakdown(rec)
         if parts is None:
             continue
@@ -207,6 +304,13 @@ def _ttft_phase_breakdown(sched):
             totals[phase] = totals.get(phase, 0.0) + s
     return {p: round(t / n, 6) for p, t in sorted(totals.items())} \
         if n else {}
+
+
+def _ttft_phase_breakdown(sched):
+    """The replay-wide attribution (queue wait vs prefill vs
+    handoff/adopt vs first decode step) — a bench rung carries WHY, not
+    just the TTFT total."""
+    return _phase_means(sched.timeline_records())
 
 
 def _export_registry(summary):
@@ -235,6 +339,27 @@ def _export_registry(summary):
         labelnames=("phase",))
     for phase, value in (summary.get("ttft_phase_s") or {}).items():
         phase_g.labels(phase=phase).set(float(value))
+    # per-tenant replay gauges (ISSUE 15): the tenant-labeled TTFT
+    # percentiles + phase attribution the isolation gate compares
+    tg50 = _metrics.gauge(
+        "serving_load_tenant_ttft_p50_seconds",
+        "Replay p50 TTFT per tenant", labelnames=("tenant",))
+    tg99 = _metrics.gauge(
+        "serving_load_tenant_ttft_p99_seconds",
+        "Replay p99 TTFT per tenant — the figure the item-5 isolation "
+        "gate compares across a neighbor's burst",
+        labelnames=("tenant",))
+    tgphase = _metrics.gauge(
+        "serving_load_tenant_ttft_phase_seconds",
+        "Mean seconds each timeline phase contributed to TTFT, per "
+        "tenant", labelnames=("tenant", "phase"))
+    for tenant, ts in (summary.get("tenants") or {}).items():
+        if ts.get("ttft_p50_s") is not None:
+            tg50.labels(tenant=tenant).set(float(ts["ttft_p50_s"]))
+        if ts.get("ttft_p99_s") is not None:
+            tg99.labels(tenant=tenant).set(float(ts["ttft_p99_s"]))
+        for phase, value in (ts.get("ttft_phase_s") or {}).items():
+            tgphase.labels(tenant=tenant, phase=phase).set(float(value))
 
 
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
@@ -303,16 +428,25 @@ def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
 
 def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 num_blocks=None, prefix_cache=True, max_queue=256,
-                shed_watermark=None, virtual_step_s=None,
+                shed_watermark=None, shed_pool_free=None,
+                virtual_step_s=None,
                 metrics_out=None, gamma=3, draft_layers=1,
                 attention_impl="gather", kv_dtype="float32",
                 weight_dtype="float32", tp=2, pp=2, prefill_chunk=None,
-                engine_sink=None):
+                engine_sink=None, serve_jsonl=None, decision_sink=None):
     """Build engine+scheduler, replay `traffic`, return the summary
     (annotated with the engine's KV budget and compile counters).
     `engine_sink`: optional list the built (now-warmed) engine is
     appended to, so a caller can keep driving its compiled executables
-    — bench's steady-state probe, which must not pay a second build."""
+    — bench's steady-state probe, which must not pay a second build.
+    `serve_jsonl` (ISSUE 15): write the scheduler's serving JSONL
+    (step/request/timeline AND decisions.v1 records) to this path;
+    `decision_sink`: optional list extended with the scheduler's
+    decision records after the replay — what bench's audit asserts
+    over. A multi-tenant traffic config additionally judges per-tenant
+    SLO burn (fleet.per_tenant_slos) across the replay and reports it
+    under summary["tenant_slo_burn"]."""
+    from paddle_tpu.observability import fleet as _fleet
     from paddle_tpu.observability import metrics as _metrics
     from paddle_tpu.serving import Scheduler
 
@@ -326,12 +460,37 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     vclock = VirtualClock() if virtual_step_s is not None else None
     sched = Scheduler(engine, max_queue=max_queue,
                       shed_watermark=shed_watermark,
+                      shed_pool_free=shed_pool_free,
+                      metrics_path=serve_jsonl,
                       clock=(vclock if vclock is not None
                              else time.monotonic))
     trace = synth_trace(traffic, model.cfg.vocab_size)
+    wd = None
+    if traffic.tenants:
+        # per-tenant SLO burn across the replay window (ISSUE 15): one
+        # baseline observation before traffic, one after — the burn
+        # gauges land tenant-labeled in the shared registry, so the
+        # metrics_out snapshot (and any fleet merge of it) carries
+        # serving_slo_burn{slo,window,tenant}
+        # prime the tenant label children FIRST: the baseline snapshot
+        # must carry (0, 0) samples for fresh tenants, or the watchdog's
+        # first-sight-is-baseline rule would swallow the whole replay
+        _fleet.prime_tenant_series(sorted(traffic.tenants))
+        wd = _fleet.BurnRateWatchdog(
+            slos=_fleet.per_tenant_slos(sorted(traffic.tenants)),
+            fast_window_s=60.0, slow_window_s=600.0, sustain=2,
+            clock=(vclock if vclock is not None else time.monotonic))
+        wd.observe(_metrics.registry().snapshot())
     summary = replay(sched, trace, timeout_s=traffic.timeout_s,
                      virtual_clock=vclock,
                      virtual_step_s=virtual_step_s or 0.01)
+    if wd is not None:
+        summary["tenant_slo_burn"] = wd.observe(
+            _metrics.registry().snapshot())
+    if decision_sink is not None:
+        decision_sink.extend(sched.decision_records())
+    if serve_jsonl:
+        sched.close()
     summary["engine"] = kind
     summary["kv_memory_tokens"] = engine.kv_memory_tokens
     summary["slots"] = engine.slots
@@ -510,6 +669,20 @@ def main(argv=None):
                         "(default: one chunk per suffix bucket)")
     p.add_argument("--timeout-s", type=float, default=None)
     p.add_argument("--shed-watermark", type=int, default=None)
+    p.add_argument("--tenants", default=None,
+                   help="multi-tenant mix (ISSUE 15): 'a:400,b:100' = "
+                        "tenant name:arrival rps per tenant; requests "
+                        "split pro-rata, per-tenant p50/p99 TTFT + "
+                        "phase attribution + SLO burn reported")
+    p.add_argument("--burst", default=None,
+                   help="burst knob: 'TENANT:T0:DUR:MULT' multiplies "
+                        "TENANT's arrival rate by MULT inside "
+                        "[T0, T0+DUR) seconds — the isolation-gate "
+                        "scenario")
+    p.add_argument("--serve-jsonl", default=None,
+                   help="write the scheduler's serving JSONL here "
+                        "(step/request/timeline + decisions.v1 audit "
+                        "records; tools/serve_report.py renders it)")
     p.add_argument("--virtual-step-s", type=float, default=None,
                    help="run on a deterministic virtual clock (this many "
                         "virtual seconds per scheduler step)")
@@ -520,11 +693,20 @@ def main(argv=None):
     from paddle_tpu.text import models as _models
     model = getattr(_models, args.model)()
     model.eval()
+    tenants = None
+    if args.tenants:
+        tenants = {name: float(rate) for name, rate in
+                   (part.split(":") for part in args.tenants.split(","))}
+    burst = None
+    if args.burst:
+        bt, t0, dur, mult = args.burst.split(":")
+        burst = {"tenant": bt, "t0": float(t0), "dur_s": float(dur),
+                 "mult": float(mult)}
     traffic = TrafficConfig(
         users=args.users, requests=args.requests, rate_rps=args.rate_rps,
         prefix_pool=args.prefix_pool, prefix_len=args.prefix_len,
         max_new_tokens=args.max_new, timeout_s=args.timeout_s,
-        seed=args.seed)
+        seed=args.seed, tenants=tenants, burst=burst)
 
     budget = args.slots * args.max_len           # dense KV budget, tokens
     num_blocks = budget // args.block_size       # same budget in blocks
@@ -545,6 +727,8 @@ def main(argv=None):
             attention_impl=args.attention_impl,
             tp=args.tp, pp=args.pp, prefill_chunk=args.prefill_chunk,
             metrics_out=args.metrics_out
+            if kind == kinds[-1] else None,
+            serve_jsonl=args.serve_jsonl
             if kind == kinds[-1] else None)
     print(json.dumps(out, indent=2, sort_keys=True))
     return 0

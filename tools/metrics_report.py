@@ -61,6 +61,16 @@ died mid-run B would otherwise read as every one of its work counters
 class counters — failover, errors — that live on the surviving
 members and the `_fleet` aggregate).
 
+The TENANT dimension (ISSUE 15) rides the same membership machinery:
+series are intersected on their `tenant` label values too, so a tenant
+onboarded or offboarded between runs never reads as counters appearing
+from / shrinking to zero, while per-tenant series of tenants live in
+BOTH runs gate per labelset — `serving_shed_total{tenant=a}` growing,
+`serving_slo_burn{slo=ttft,tenant=a,...}` growing or crossing 1.0 from
+a clean baseline, and per-tenant acceptance/hit-rate drops all fire on
+exactly the tenant that regressed (tenant `_all` — the unscoped SLO
+rows — always participates).
+
 Small-count noise is ignored via --min-delta (absolute floor, default 1).
 
 Stdlib-only, no live backend needed — like tools/perf_report.py, the
@@ -168,34 +178,52 @@ _HIST_P99_RULES = (
 
 _WORKER_LABEL = re.compile(r"worker_id=([^,}]+)")
 _FLEET_LABEL = "_fleet"      # the fleet-aggregate member id (fleet.py)
+_TENANT_LABEL = re.compile(r"[{,]tenant=([^,}]+)")
+_ALL_TENANTS = "_all"        # tenant value of unscoped SLO gauges
 
 
-def _fleet_members(rec):
-    """worker_id label values present in a snapshot (empty for raw
-    single-process snapshots — membership filtering then no-ops)."""
+def _label_values(rec, labelname, drop=()):
+    """Distinct values of one label across a snapshot's samples (empty
+    when the dimension is absent — filtering then no-ops)."""
     out = set()
     for m in rec.get("metrics", []):
         for s in m.get("samples", []):
-            wid = (s.get("labels") or {}).get("worker_id")
-            if wid:
-                out.add(wid)
-    out.discard(_FLEET_LABEL)
-    return out
+            v = (s.get("labels") or {}).get(labelname)
+            if v:
+                out.add(v)
+    return out - set(drop)
+
+
+def _dimension_filter(a_rec, b_rec, labelname, pat, always=()):
+    """key -> bool over ONE label dimension: keep series whose label
+    value appears in BOTH snapshots (plus the `always` sentinels —
+    fleet aggregates, the _all-tenants SLO rows — and every series not
+    carrying the label). The PR 12 per-worker membership-intersection
+    rule, generalized so the tenant dimension (ISSUE 15) rides the same
+    machinery: a tenant absent from one run (onboarded/offboarded
+    between A and B) must not read as every one of its counters
+    appearing or vanishing."""
+    ma = _label_values(a_rec, labelname, drop=always)
+    mb = _label_values(b_rec, labelname, drop=always)
+    if not ma or not mb:
+        return lambda key: True
+    common = (ma & mb) | set(always)
+
+    def keep(key):
+        m = pat.search(key)
+        return m is None or m.group(1) in common
+    return keep
 
 
 def _member_filter(a_rec, b_rec):
-    """key -> bool: keep series whose worker_id is live in BOTH
-    snapshots (plus the _fleet aggregates and every unlabeled series).
-    See the module docstring's label-aware fleet comparison rules."""
-    ma, mb = _fleet_members(a_rec), _fleet_members(b_rec)
-    if not ma or not mb:
-        return lambda key: True
-    common = (ma & mb) | {_FLEET_LABEL}
-
-    def keep(key):
-        m = _WORKER_LABEL.search(key)
-        return m is None or m.group(1) in common
-    return keep
+    """key -> bool: worker-membership AND tenant-membership
+    intersection (see the module docstring's label-aware comparison
+    rules)."""
+    fw = _dimension_filter(a_rec, b_rec, "worker_id", _WORKER_LABEL,
+                           always=(_FLEET_LABEL,))
+    ft = _dimension_filter(a_rec, b_rec, "tenant", _TENANT_LABEL,
+                           always=(_ALL_TENANTS,))
+    return lambda key: fw(key) and ft(key)
 
 
 def _approx_p99(buckets, count):

@@ -40,10 +40,34 @@ dominates — the "what do I fix" readout for a p99 regression.
 the CLI renders a human summary: request outcomes, TTFT percentiles,
 decode throughput, queue depth and slot occupancy over the run.
 
+Decision records (ISSUE 15): both emitters additionally append
+`paddle_tpu.decisions.v1` records (kind "decision") — the scheduler
+decision AUDIT LOG. Every admit/shed/preempt/place/failover/swap/
+quarantine event records its INPUTS (queue depth, pool free fraction,
+priority, deadline slack, the candidate table a preemption weighed,
+tenant), so any decision is reproducible from its record; validation
+REPLAYS each record's inputs through the live decision rules
+(paddle_tpu/observability/decisions.py) and fails on any mismatch. The
+CLI renders a per-tenant decision table and a preemption-victim
+attribution table (which tenant's requests paid for allocation
+pressure, and how).
+
 Usage: python tools/serve_report.py serve_metrics.jsonl
 """
+import importlib.util
 import json
+import os
 import sys
+
+# the decisions module is stdlib-only; load it by file path so this
+# tool keeps grading artifacts without importing the (jax-heavy)
+# paddle_tpu package — the artifacts must outlive the TPU grant
+_DEC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "paddle_tpu", "observability", "decisions.py")
+_spec = importlib.util.spec_from_file_location("_ptn_decisions", _DEC_PATH)
+decisions = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(decisions)
 
 # pipeline-serving step fields (ISSUE 13): cumulative tick accounting
 # of a pipeline-parallel engine — absent on every other engine kind,
@@ -58,6 +82,7 @@ REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "prompt_len": int, "tokens": int, "priority": int,
                   "preempted": int, "prefix_hit": bool, "adopted": bool,
                   "spec_proposed": int, "spec_accepted": int,
+                  "tenant": str, "cohort": str,
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
 # `run` header records (ISSUE 11): the engine's serving precisions and,
@@ -75,9 +100,11 @@ RUN_FIELDS = {"kind": str, "engine": str, "kv_dtype": str,
 OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
                        "quant_logit_kl", "tp", "pp", "engine", "gamma"}
 # absent == 0/False in files written before the speculative-decode
-# fields (ISSUE 7) and the multi-host `adopted` flag (ISSUE 10) landed —
-# historical artifacts must stay gradeable
-OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted", "adopted"}
+# fields (ISSUE 7), the multi-host `adopted` flag (ISSUE 10), and the
+# tenant/cohort attribution labels (ISSUE 15) landed — historical
+# artifacts must stay gradeable
+OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted", "adopted",
+                           "tenant", "cohort"}
 STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
 # per-request end-to-end timeline records (ISSUE 12), schema
@@ -88,9 +115,11 @@ TIMELINE_FIELDS = {"kind": str, "schema": str, "status": str,
                    "e2e_s": (int, float), "ttft_s": (int, float,
                                                      type(None)),
                    "tokens": int, "preempted": int, "failovers": int,
-                   "adopted": bool, "phases": list}
+                   "adopted": bool, "phases": list,
+                   "tenant": str, "cohort": str}
 OPTIONAL_TIMELINE_FIELDS = {"request_id", "key", "priority", "worker",
-                            "trace_id", "worker_phases"}
+                            "trace_id", "worker_phases", "tenant",
+                            "cohort"}
 TIMELINE_PHASES = {"queue", "prefill", "kv_handoff", "adopt", "place",
                    "decode", "failover"}
 # the phases-sum-to-e2e acceptance gate: contiguous trail construction
@@ -104,6 +133,12 @@ def validate_records(records):
     errors = []
     for i, rec in enumerate(records):
         kind = rec.get("kind")
+        if kind == "decision":
+            # decisions.v1 (ISSUE 15): schema AND reproducibility —
+            # the replay rules must reproduce each record's outcome
+            errors.extend(f"record {i}: {e}"
+                          for e in decisions.validate_records([rec]))
+            continue
         if kind not in ("step", "request", "run", "timeline"):
             errors.append(f"record {i}: unknown kind {kind!r}")
             continue
@@ -221,10 +256,36 @@ def _pct(values, q):
     return vs[min(int(q * (len(vs) - 1) + 0.5), len(vs) - 1)]
 
 
+def decision_table(decision_recs):
+    """{tenant: {action: count}} — the per-tenant decision table."""
+    return decisions.by_tenant(decision_recs)
+
+
+def preemption_attribution(decision_recs):
+    """Who paid for allocation pressure: per victim tenant, the
+    preemption count, dispositions, and how many rival candidates each
+    victim beat (candidates - 1 averaged) — the 'why was tenant A's
+    request evicted' readout."""
+    out = {}
+    for rec in decision_recs:
+        if rec.get("action") != "preempt":
+            continue
+        t = rec["outcome"].get("victim_tenant", rec.get("tenant"))
+        row = out.setdefault(t, {"preemptions": 0, "dispositions": {},
+                                 "candidates_beaten": 0})
+        row["preemptions"] += 1
+        d = rec["outcome"].get("disposition", "?")
+        row["dispositions"][d] = row["dispositions"].get(d, 0) + 1
+        row["candidates_beaten"] += max(
+            len(rec["inputs"].get("candidates") or []) - 1, 0)
+    return out
+
+
 def summarize(records):
     steps = [r for r in records if r["kind"] == "step"]
     reqs = [r for r in records if r["kind"] == "request"]
     timelines = [r for r in records if r["kind"] == "timeline"]
+    decision_recs = [r for r in records if r["kind"] == "decision"]
     # run headers: later records win (a quality harness may append one
     # carrying the measured match rate after the scheduler's own)
     run = {}
@@ -286,6 +347,16 @@ def summarize(records):
         "timeline_phase_means": timeline_phase_means(timelines),
         "tail_attribution": tail_attribution(timelines),
         "failovers": sum(r.get("failovers", 0) for r in timelines),
+        "decisions": len(decision_recs),
+        "decision_table": decision_table(decision_recs),
+        "preemption_attribution": preemption_attribution(decision_recs),
+        "by_tenant": {
+            t: {s: sum(1 for r in reqs
+                       if r.get("tenant", "default") == t
+                       and r["status"] == s)
+                for s in sorted({r["status"] for r in reqs
+                                 if r.get("tenant", "default") == t})}
+            for t in sorted({r.get("tenant", "default") for r in reqs})},
     }
 
 
@@ -357,6 +428,31 @@ def render(summary):
                                key=lambda kv: -kv[1]):
                 mark = "  <- dominant" if p == tail["dominant"] else ""
                 out.append(f"| {p} | {s:.1%}{mark} |")
+    if summary.get("decisions"):
+        out += ["", f"## decision audit log ({summary['decisions']} "
+                    f"records, every one replay-verified)", ""]
+        actions = sorted({a for acts in summary["decision_table"].values()
+                          for a in acts})
+        out += ["| tenant | " + " | ".join(actions) + " |",
+                "|---" * (len(actions) + 1) + "|"]
+        for t, acts in sorted(summary["decision_table"].items()):
+            out.append("| " + t + " | " + " | ".join(
+                str(acts.get(a, 0)) for a in actions) + " |")
+        pre = summary.get("preemption_attribution") or {}
+        if pre:
+            out += ["", "### preemption-victim attribution", "",
+                    "| victim tenant | preemptions | dispositions | "
+                    "rivals beaten |", "|---|---|---|---|"]
+            for t, row in sorted(pre.items()):
+                disp = ", ".join(f"{k}={v}" for k, v in
+                                 sorted(row["dispositions"].items()))
+                out.append(f"| {t} | {row['preemptions']} | {disp} | "
+                           f"{row['candidates_beaten']} |")
+    if summary.get("by_tenant") and len(summary["by_tenant"]) > 1:
+        out += ["", "## requests by tenant", ""]
+        for t, statuses in sorted(summary["by_tenant"].items()):
+            out.append(f"- {t}: " + ", ".join(
+                f"{s}={n}" for s, n in sorted(statuses.items())))
     return "\n".join(out)
 
 
