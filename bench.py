@@ -705,6 +705,10 @@ def run_serve_load_bench(on_tpu, n_requests=None):
     serve_jsonl = os.path.join(
         tempfile.mkdtemp(prefix="bench_serve_load_"), "serve.jsonl")
     results = {}
+    paged_engines = []
+    # divergence counters are process-global; a chaos test that armed the
+    # leak fault earlier in this process must not fail THIS run's audit
+    kv_div_baseline = _kv_divergence_totals()
     for kind, n_slots, n_blocks in (
             ("dense", slots, num_blocks), ("paged", paged_slots, num_blocks),
             ("spec", paged_slots, num_blocks),
@@ -713,10 +717,16 @@ def run_serve_load_bench(on_tpu, n_requests=None):
             model, kind, traffic, slots=n_slots, max_len=max_len,
             block_size=block, num_blocks=n_blocks, gamma=gamma,
             draft_layers=draft_layers, attention_impl=attention_impl,
-            serve_jsonl=serve_jsonl if kind == "paged" else None)
+            serve_jsonl=serve_jsonl if kind == "paged" else None,
+            engine_sink=paged_engines if kind == "paged" else None)
     paged, dense, spec, quant = (results["paged"], results["dense"],
                                  results["spec"], results["quant"])
     decision_audit = _audit_serve_decisions(serve_jsonl)
+    # the KV-ledger end-of-run reconciliation rides the same default arm
+    # (ISSUE 16): the paged engine's full kvledger.v1 stream must replay
+    # into an exact reconstruction of the pool — zero leaked blocks
+    kv_ledger_audit = _audit_kv_ledger(paged_engines[0], kv_div_baseline) \
+        if paged_engines else None
     # pp arm (ISSUE 13): pipeline-parallel serving at EQUAL PER-HOST
     # HBM. Each of the pp stage groups holds 1/pp of the layers, so at
     # the paged arm's per-device byte budget the pp pool takes pp× the
@@ -883,6 +893,7 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                   if spec_pp_hbm_ratio is not None else None,
                   "spec_pp_steady_rates": spec_pp_rates,
                   "decision_audit": decision_audit,
+                  "kv_ledger_audit": kv_ledger_audit,
                   "backend": jax.default_backend()},
     }
 
@@ -926,6 +937,63 @@ def _audit_serve_decisions(serve_jsonl):
             "by_action": {a: sum(1 for d in decs if d["action"] == a)
                           for a in sorted({d["action"] for d in decs})},
             "path": serve_jsonl}
+
+
+def _kv_divergence_totals():
+    """{labels-json: value} of serving_kv_ledger_divergence_total from a
+    fresh registry snapshot (the counter is process-global, so audits
+    compare deltas, never absolutes)."""
+    from paddle_tpu.observability import metrics as _obs_metrics
+    snap = _obs_metrics.registry().snapshot()
+    return {json.dumps(s["labels"], sort_keys=True): s["value"]
+            for m in snap["metrics"]
+            if m["name"] == "serving_kv_ledger_divergence_total"
+            for s in m["samples"]}
+
+
+def _audit_kv_ledger(engine, div_baseline):
+    """The ISSUE 16 end-of-run gate, the ledger analogue of the decision
+    audit above: replay the paged arm's FULL kvledger.v1 event stream
+    through a fresh shadow pool and require it to RECONSTRUCT the real
+    BlockPool exactly — identical free list, identical per-block
+    refcounts, zero leaked blocks (every block still resident after the
+    replay drained is a prefix-cache holding, never a retired request's
+    orphan) — with a clean event stream and zero reconciler divergences
+    latched during the run. Returns the audit summary dict (asserts on
+    any violation); None when the ledger is disabled (PTN_KV_LEDGER=0)."""
+    from paddle_tpu.observability import kvledger as _kvl
+
+    ledger = getattr(engine, "kv_ledger", None)
+    if ledger is None:
+        return None
+    pool = engine.block_pool
+    shadow = _kvl.replay_events(ledger.events, pool.num_blocks)
+    assert not shadow.errors, \
+        f"kvledger stream has impossible transitions: {shadow.errors[:3]}"
+    real_refs = [int(r) for r in pool._refs]
+    assert shadow.refs == real_refs, \
+        f"ledger replay refcounts diverge from the pool at blocks " \
+        f"{[b for b in range(pool.num_blocks) if shadow.refs[b] != real_refs[b]][:8]}"
+    assert shadow.free_set() == set(int(b) for b in pool._free), \
+        f"ledger replay free list diverges from the pool: " \
+        f"{sorted(shadow.free_set() ^ set(int(b) for b in pool._free))[:8]}"
+    # zero leaked blocks: with every request retired, each still-resident
+    # block must be a cache insertion (its only holders of kind 'cached')
+    leaked = sorted(b for b in shadow.allocated if b not in shadow.cached)
+    assert not leaked, \
+        f"blocks {leaked[:8]} resident after drain but not prefix-cached " \
+        f"(leaked by a retired request)"
+    diverged = {k: v - div_baseline.get(k, 0)
+                for k, v in _kv_divergence_totals().items()
+                if v - div_baseline.get(k, 0)}
+    assert not diverged, \
+        f"reconciler latched divergences during the run: {diverged}"
+    return {"events": len(ledger.events),
+            "blocks_resident": len(shadow.allocated),
+            "blocks_cached": len(shadow.cached),
+            "tenant_kind_blocks": {
+                f"{t}/{k}": n
+                for (t, k), n in sorted(shadow.tenant_kind_blocks().items())}}
 
 
 def _spec_pp_steady_rate(model, pp_e, sp_e):

@@ -48,6 +48,14 @@ one substrate they all report through:
                        exactly to the request's end-to-end span, and the
                        reqtimeline.v1 record both the serving scheduler
                        and the fleet router emit.
+  kvledger.py        — the KV-memory attribution plane (ISSUE 16): the
+                       kvledger.v1 block lifecycle event log (alloc/ref/
+                       unref/free/share/cache_insert/cache_evict) the
+                       block pool + prefix cache emit, per-tenant
+                       resident-HBM gauges (serving_kv_blocks/bytes
+                       {tenant,kind}), and the LedgerReconciler shadow-
+                       pool watchdog that latches any ledger-vs-pool
+                       divergence at scheduler-step boundaries.
 
 Producers already wired in: serving scheduler (queue depth, slot
 occupancy, admission/timeout/reject counts, tokens, TTFT), PS RPC client
@@ -65,13 +73,13 @@ import sys
 
 from . import deviceprof  # noqa: F401
 from . import faults, fleet, flight_recorder, metrics  # noqa: F401
-from . import reqtimeline, tracecontext, xplane  # noqa: F401
+from . import kvledger, reqtimeline, tracecontext, xplane  # noqa: F401
 from .flight_recorder import dump_postmortem  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracecontext import merge_chrome_traces, trace_scope  # noqa: F401
 
 __all__ = ["metrics", "tracecontext", "flight_recorder", "faults",
-           "deviceprof", "xplane", "fleet", "reqtimeline",
+           "deviceprof", "xplane", "fleet", "reqtimeline", "kvledger",
            "registry", "dump_postmortem", "trace_scope",
            "merge_chrome_traces"]
 

@@ -37,6 +37,17 @@ Site catalogue (the call sites live next to the operation they break):
                        params are validated/committed — a raise rejects
                        the swap atomically (old weights keep serving,
                        zero requests dropped)
+  serving.kv_ledger_leak  serving.blocks.BlockPool.unref, at the moment
+                       a last reference drops (ISSUE 16): `truncate`
+                       mode makes the caller SKIP the free-list return —
+                       the pool leaks the block while the kvledger
+                       records the free that should have happened. The
+                       detector is observability.kvledger's
+                       LedgerReconciler: its free-list invariant
+                       diverges within one scheduler step and
+                       `serving_kv_ledger_divergence_total` (failure-
+                       class in metrics_report --compare) latches the
+                       leak
   serving.pp_handoff   the pipeline-parallel stage boundary (ISSUE 13):
                        fires on every activation/KV transfer from stage
                        s to stage s+1 inside the serving ring (decode
@@ -78,7 +89,8 @@ __all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
 SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.decode_step", "serving.block_alloc",
          "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
-         "serving.pp_handoff", "dataloader.next")
+         "serving.pp_handoff", "serving.kv_ledger_leak",
+         "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

@@ -310,11 +310,22 @@ def prime_tenant_series(tenants, registry=None):
     requests = reg.counter("serving_requests_total",
                            labelnames=("status", "tenant"))
     shed = reg.counter("serving_shed_total", labelnames=("tenant",))
+    # the KV residency plane (ISSUE 16) rides the same priming rule: a
+    # tenant's serving_kv_blocks{tenant,kind} children exist at zero in
+    # the merged fleet snapshot before its first block lands, so a
+    # dashboard join over tenants never sees a hole
+    kv_blocks = reg.gauge("serving_kv_blocks",
+                          labelnames=("tenant", "kind"))
+    kv_bytes = reg.gauge("serving_kv_bytes",
+                         labelnames=("tenant", "kind"))
     for t in tenants:
         hist.labels(tenant=t)
         shed.labels(tenant=t)
         for status in ("admitted", "error", "timeout"):
             requests.labels(status=status, tenant=t)
+        for kind in ("private", "shared", "cached"):
+            kv_blocks.labels(tenant=t, kind=kind)
+            kv_bytes.labels(tenant=t, kind=kind)
 
 
 def per_tenant_slos(tenants, ttft_s=1.0, latency_objective=0.99,

@@ -345,7 +345,15 @@ class BlockPool:
         self.block_size = int(block_size)
         self._free = list(range(self.num_blocks - 1, GARBAGE_BLOCK, -1))
         self._refs = np.zeros((self.num_blocks,), np.int32)
+        # KV attribution ledger (observability.kvledger): attached by
+        # the engine when the ledger is enabled; every refcount
+        # transition below mirrors into it. One `is None` check per
+        # operation is the entire disabled-path cost.
+        self._ledger = None
         self._export()
+
+    def attach_ledger(self, ledger):
+        self._ledger = ledger
 
     # -- accounting ---------------------------------------------------------
     @property
@@ -381,6 +389,8 @@ class BlockPool:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._refs[b] = 1
+        if self._ledger is not None:
+            self._ledger.pool_alloc(out)
         self._export()
         return out
 
@@ -390,15 +400,30 @@ class BlockPool:
         if block_id == GARBAGE_BLOCK or self._refs[block_id] < 1:
             raise ValueError(f"ref of unallocated block {block_id}")
         self._refs[block_id] += 1
+        if self._ledger is not None:
+            self._ledger.pool_ref(block_id)
 
     def unref(self, block_id):
         """Drop one reference; the block returns to the free list at
-        zero."""
+        zero.
+
+        `serving.kv_ledger_leak` is a fault-injection site: in truncate
+        mode the free-list return of a last-reference drop is SKIPPED —
+        the pool leaks the block while the ledger records the free it
+        should have produced. The damage is exactly what
+        LedgerReconciler's free-list invariant exists to catch, within
+        one scheduler step."""
         if block_id == GARBAGE_BLOCK:
             return
         if self._refs[block_id] < 1:
             raise ValueError(f"unref of free block {block_id}")
         self._refs[block_id] -= 1
+        if self._ledger is not None:
+            self._ledger.pool_unref(block_id)
         if self._refs[block_id] == 0:
-            self._free.append(int(block_id))
+            if self._ledger is not None:
+                self._ledger.pool_free(block_id)
+            spec = _faults.fire("serving.kv_ledger_leak")
+            if spec is None or spec.mode != "truncate":
+                self._free.append(int(block_id))
         self._export()

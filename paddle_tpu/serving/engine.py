@@ -34,6 +34,7 @@ from ..core.tensor import Tensor
 from ..framework import compile_cache as _cc
 from ..nn.layer.layers import functional_call, functional_state
 from ..observability import faults as _faults
+from ..observability import kvledger as _kvl
 from ..profiler import RecordEvent, TracerEventType
 from . import blocks
 from . import kv_cache as kvc
@@ -736,8 +737,43 @@ class PagedGenerationEngine(GenerationEngine):
         self.block_pool = blocks.BlockPool(c.num_blocks, c.block_size)
         self.prefix_cache = PrefixCache(self.block_pool, c.block_size) \
             if c.enable_prefix_cache else None
+        # KV attribution ledger (observability.kvledger): because every
+        # engine kind — paged, spec, tp, pp, spec_pp — funnels through
+        # this host half, attaching here covers all of their pool
+        # slices (the pp engine's per-stage pools share this ONE
+        # allocator via the `_pool` property's whole-model view).
+        # Construction-time opt-out is the zero-cost contract: disabled,
+        # the pool/cache pay one `is None` check per operation.
+        self.kv_ledger = None
+        if _kvl.enabled():
+            self.kv_ledger = _kvl.KVLedger(
+                c.num_blocks, block_bytes=self._kv_block_bytes())
+            self.block_pool.attach_ledger(self.kv_ledger)
+            if self.prefix_cache is not None:
+                self.prefix_cache.attach_ledger(self.kv_ledger)
         self.last_prefill_stats = {}
         self.last_logits = None
+
+    def _kv_block_bytes(self):
+        """HBM bytes one pool block pins across every layer and both
+        K/V sides, priced from the pool dtype — what turns the ledger's
+        per-tenant block counts into `serving_kv_bytes{tenant,kind}`.
+        Mirrors the bench's equal-byte-budget math: int8 blocks carry a
+        4-byte-per-head scale row next to the codes."""
+        cfg = self._model.cfg
+        c = self.config
+        heads = cfg.num_heads
+        head_dim = cfg.hidden_size // heads
+        if self.kv_quantized:
+            per_side = c.block_size * heads * head_dim + 4 * heads
+        else:
+            try:
+                itemsize = np.dtype(
+                    self._params["wte.weight"].dtype).itemsize
+            except Exception:                            # noqa: BLE001
+                itemsize = 4
+            per_side = c.block_size * heads * head_dim * itemsize
+        return 2 * per_side * cfg.num_layers
 
     # -- int8 decode weights (ISSUE 11) --------------------------------------
     def _weight_quant_axis(self, name, arr):

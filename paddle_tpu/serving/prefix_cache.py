@@ -34,6 +34,7 @@ a prefix-hit-rate drop as a failure-class regression.
 """
 import hashlib
 
+from ..observability import kvledger as _kvl
 from ..observability import metrics as _metrics
 from .blocks import GARBAGE_BLOCK
 
@@ -69,6 +70,14 @@ class PrefixCache:
         self._parent = {}         # key -> chain-parent key (None at k=0)
         self._children = {}       # key -> cached direct children count
         self._seq = 0
+        # KV attribution ledger (observability.kvledger): the cache
+        # emits the SEMANTIC layer — share/cache_insert/cache_evict —
+        # and refines the origin of its own pool refs so the shadow
+        # model classifies holders as shared/cached, not private
+        self._ledger = None
+
+    def attach_ledger(self, ledger):
+        self._ledger = ledger
 
     def __len__(self):
         return len(self._entries)
@@ -108,8 +117,14 @@ class PrefixCache:
                 break
             ids.append(blk)
             self._touch(key)
-        for b in ids:
-            self.pool.ref(b)
+        if ids and self._ledger is not None:
+            with _kvl.origin_scope("prefix_cache.match"):
+                for b in ids:
+                    self.pool.ref(b)
+            self._ledger.cache_share(ids, len(ids) * bs)
+        else:
+            for b in ids:
+                self.pool.ref(b)
         if record:
             self.record_lookup(bool(ids))
         return ids, len(ids) * bs
@@ -136,7 +151,12 @@ class PrefixCache:
                 self._touch(key)
                 prev_key = key
                 continue
-            self.pool.ref(blk)
+            if self._ledger is not None:
+                with _kvl.origin_scope("prefix_cache.insert"):
+                    self.pool.ref(blk)
+                self._ledger.cache_insert((blk,))
+            else:
+                self.pool.ref(blk)
             self._entries[key] = blk
             self._parent[key] = prev_key
             if prev_key is not None:
@@ -167,7 +187,14 @@ class PrefixCache:
                 if blk is None or self.pool.refcount(blk) != 1 \
                         or self._children.get(key, 0) > 0:
                     continue
-                self.pool.unref(blk)
+                if self._ledger is not None:
+                    # cache_evict BEFORE the unref so a replay never
+                    # sees the cache holding a freed block
+                    self._ledger.cache_evict((blk,))
+                    with _kvl.origin_scope("prefix_cache.evict"):
+                        self.pool.unref(blk)
+                else:
+                    self.pool.unref(blk)
                 parent = self._parent.pop(key, None)
                 if parent is not None and parent in self._children:
                     self._children[parent] -= 1

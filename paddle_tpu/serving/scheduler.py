@@ -71,6 +71,7 @@ import numpy as np
 
 from .. import native
 from ..observability import decisions as _dec
+from ..observability import kvledger as _kvl
 from ..observability import metrics as _metrics
 from ..observability import reqtimeline as _rt
 from ..observability import tracecontext as _tc
@@ -397,9 +398,33 @@ class Scheduler:
         # long-lived worker
         self._decisions = collections.deque(maxlen=4096)
         self.counts = dict.fromkeys(_COUNTERS, 0)
+        # KV attribution plane (ISSUE 16): when the engine attached a
+        # ledger, reconcile it against the real pool at every step
+        # boundary and stream its events into the serving JSONL. The
+        # scheduler is ALSO the attribution source: every engine call
+        # that can touch the pool runs under `_kv_attr`, so ledger
+        # events carry request/tenant/origin with zero engine plumbing.
+        ledger = getattr(engine, "kv_ledger", None)
+        pool = getattr(engine, "block_pool", None)
+        self._kv_reconciler = (
+            _kvl.LedgerReconciler(ledger, pool,
+                                  getattr(engine, "prefix_cache", None))
+            if ledger is not None and pool is not None else None)
+        self._kv_events_written = 0
         self._metrics_f = (open(self.config.metrics_path, "a")
                            if self.config.metrics_path else None)
         self._write_run_record()
+
+    def _kv_attr(self, req, origin):
+        """Attribution scope for one engine call touching the block
+        pool — a shared no-op context when no ledger is attached (the
+        zero-cost contract)."""
+        if self._kv_reconciler is None:
+            return _kvl.NULL_CTX
+        return _kvl.attribution(
+            request_id=req.id if req is not None else None,
+            tenant=req.tenant if req is not None else None,
+            origin=origin)
 
     def _write_run_record(self):
         """One `run` header record per scheduler: the engine's KV/weight
@@ -775,6 +800,13 @@ class Scheduler:
         self._steps += 1
         _M_QUEUE_DEPTH.set(len(self._queue))
         _M_OCCUPANCY.set(self.active_slots() / max(self.engine.slots, 1))
+        # the KV ledger watchdog (ISSUE 16): every step boundary, replay-
+        # vs-reality — a leaked block is caught within ONE step of the
+        # damage, and the step's lifecycle events land in the JSONL
+        # ahead of the step record that closed them
+        if self._kv_reconciler is not None:
+            self._kv_reconciler.check()
+            self._write_kvledger_records()
         self._write_step_record(now, len(active))
         return bool(self._queue or any(s is not None for s in self._slots))
 
@@ -806,7 +838,8 @@ class Scheduler:
         reset (broken engines must not block cleanup), future unblocked,
         error cause attached."""
         try:
-            self.engine.reset_slot(slot)
+            with self._kv_attr(req, "error"):
+                self.engine.reset_slot(slot)
         except Exception:                                # noqa: BLE001
             pass
         self._slots[slot] = None
@@ -919,7 +952,8 @@ class Scheduler:
         silently truncated."""
         req = self._slots[slot]
         try:
-            self.engine.reset_slot(slot)
+            with self._kv_attr(req, "preempt"):
+                self.engine.reset_slot(slot)
         except Exception:                                # noqa: BLE001
             pass
         self._slots[slot] = None
@@ -994,7 +1028,8 @@ class Scheduler:
                 if self._slots[slot] is None:
                     break                   # preempted itself below
                 try:
-                    ensure(slot)
+                    with self._kv_attr(req, "decode_grow"):
+                        ensure(slot)
                     break
                 except BlockAllocError:
                     # worse_than=priority-1 keeps classes >= the growing
@@ -1033,7 +1068,8 @@ class Scheduler:
                                   "tenant": req.tenant,
                                   "tokens": len(req.tokens),
                                   "timeout": timed_out}):
-                    self.engine.reset_slot(slot)
+                    with self._kv_attr(req, "retire"):
+                        self.engine.reset_slot(slot)
                 self._slots[slot] = None
                 self._finish(req, TIMEOUT if timed_out else DONE,
                              "serving.timeout" if timed_out
@@ -1091,7 +1127,8 @@ class Scheduler:
             # of a throwaway engine default
             rng = staged[4] if len(staged) > 4 else \
                 (req.rng_seed, req.rng_gen + 1)
-            first = self.engine.adopt_kv(slot, *staged[:4], rng=rng)
+            with self._kv_attr(req, "adopt"):
+                first = self.engine.adopt_kv(slot, *staged[:4], rng=rng)
         except BlockAllocError:
             raise
         except Exception as e:                           # noqa: BLE001
@@ -1118,11 +1155,12 @@ class Scheduler:
         exec_prompt). Engines without per-slot RNG (minimal stubs) get
         the plain call — the capability probe mirrors the adopt_kv
         one."""
-        if not hasattr(self.engine, "set_slot_rng"):
-            return self.engine.prefill(slot, req.exec_prompt)
-        return self.engine.prefill(
-            slot, req.exec_prompt,
-            rng=(req.rng_seed, req.rng_gen + len(req.tokens)))
+        with self._kv_attr(req, "prefill"):
+            if not hasattr(self.engine, "set_slot_rng"):
+                return self.engine.prefill(slot, req.exec_prompt)
+            return self.engine.prefill(
+                slot, req.exec_prompt,
+                rng=(req.rng_seed, req.rng_gen + len(req.tokens)))
 
     def _try_place(self, slot, req):
         """Prefill `req` into `slot`. Allocation pressure preempts a
@@ -1171,7 +1209,8 @@ class Scheduler:
         self._decode_tokens += 1
         self._count("serving.tokens", req)
         if req.finished(self.engine.config.eos_token_id):
-            self.engine.reset_slot(slot)
+            with self._kv_attr(req, "retire"):
+                self.engine.reset_slot(slot)
             self._finish(req, DONE, "serving.completed")
         else:
             self._slots[slot] = req
@@ -1250,6 +1289,22 @@ class Scheduler:
             rec["pp_bubble_fraction"] = round(s["bubble_fraction"], 6)
             rec["pp_stage_busy"] = [round(b, 6) for b in s["stage_busy"]]
         self._metrics_f.write(json.dumps(rec) + "\n")
+        self._metrics_f.flush()
+
+    def _write_kvledger_records(self):
+        """Stream the ledger events emitted since the last step into the
+        serving JSONL as `kvledger` records — the on-disk half of the
+        attribution plane: serve_report's residency table and the
+        offline replay audit both reconstruct the pool from these."""
+        if not self._metrics_f or self._kv_reconciler is None:
+            return
+        events = self._kv_reconciler.ledger.events
+        if self._kv_events_written >= len(events):
+            return
+        for ev in events[self._kv_events_written:]:
+            self._metrics_f.write(
+                json.dumps({"kind": "kvledger", **ev}) + "\n")
+        self._kv_events_written = len(events)
         self._metrics_f.flush()
 
     def _build_timeline(self, req):

@@ -22,7 +22,9 @@ and renders the trajectory (metric, value, MFU where derivable —
 `extra.mfu` percentages normalized to fractions) over the HEALTHY
 window only, plus the newest healthy round as the recommended compare
 baseline. `--jsonl` writes the rows as `paddle_tpu.benchtrend.v1`
-records for downstream joins.
+records for downstream joins; `--json` prints the same rows plus the
+recommended baseline as one JSON document on stdout for pipelines that
+would rather `json.load` than scrape the table.
 
 Stdlib-only: the artifacts must outlive the TPU grant that wrote them.
 
@@ -30,6 +32,7 @@ Usage:
   python tools/bench_trend.py                 # BENCH_r*.json in repo root
   python tools/bench_trend.py BENCH_r01.json BENCH_r04.json
   python tools/bench_trend.py --jsonl trend.jsonl
+  python tools/bench_trend.py --json > trend.json
 """
 import argparse
 import glob
@@ -159,6 +162,10 @@ def main(argv=None):
                         "repo root)")
     p.add_argument("--jsonl", default=None,
                    help="write the benchtrend.v1 rows here")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable JSON document "
+                        "(rows + recommended baseline) to stdout "
+                        "instead of the rendered table")
     args = p.parse_args(argv)
     paths = args.files or sorted(glob.glob(os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -171,7 +178,13 @@ def main(argv=None):
         with open(args.jsonl, "w") as f:
             for r in rows:
                 f.write(json.dumps(r) + "\n")
-    print(render(rows))
+    if args.json:
+        # the same rows the --jsonl stream carries, as ONE document a
+        # pipeline can `json.load` straight off stdout
+        print(json.dumps({"schema": SCHEMA, "rows": rows,
+                          "baseline": healthy_baseline(rows)}, indent=2))
+    else:
+        print(render(rows))
     return 0
 
 

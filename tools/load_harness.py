@@ -192,6 +192,12 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
     next_i = 0
     max_concurrent = 0
     steps = 0
+    # per-tenant resident KV blocks (ISSUE 16), sampled at every step
+    # boundary from the engine's kvledger shadow — the quota baseline:
+    # peak says what a tenant cap must admit, mean what it typically
+    # holds
+    kv_ledger = getattr(sched.engine, "kv_ledger", None)
+    kv_peak, kv_sum = {}, {}
     while True:
         while next_i < len(trace) and trace[next_i]["t"] <= now():
             it = trace[next_i]
@@ -211,6 +217,11 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
         more = sched.step()
         steps += 1
         max_concurrent = max(max_concurrent, sched.active_slots())
+        if kv_ledger is not None:
+            for t, n in kv_ledger.shadow.tenant_resident_totals().items():
+                if n > kv_peak.get(t, 0):
+                    kv_peak[t] = n
+                kv_sum[t] = kv_sum.get(t, 0) + n
         if virtual_clock is not None:
             virtual_clock.advance(virtual_step_s)
         if next_i >= len(trace) and not more:
@@ -243,19 +254,28 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
         "ttft_p99_s": percentile(ttfts, 0.99),
         "ttft_phase_s": _ttft_phase_breakdown(sched),
     }
+    if kv_ledger is not None and kv_peak:
+        summary["kv_blocks_peak"] = max(kv_peak.values())
     if any("tenant" in it for it in trace):
-        summary["tenants"] = _tenant_summary(trace, handles,
-                                             shed_by_tenant, sched)
+        summary["tenants"] = _tenant_summary(
+            trace, handles, shed_by_tenant, sched,
+            kv_peak=kv_peak if kv_ledger is not None else None,
+            kv_mean={t: s / steps for t, s in kv_sum.items()}
+            if kv_ledger is not None and steps else None)
     _export_registry(summary)
     return summary
 
 
-def _tenant_summary(trace, handles, shed_by_tenant, sched):
+def _tenant_summary(trace, handles, shed_by_tenant, sched,
+                    kv_peak=None, kv_mean=None):
     """Per-tenant replay figures (ISSUE 15): request/shed tallies,
     per-tenant p50/p99 TTFT, and per-tenant TTFT phase attribution
     (each tenant's own timeline records clipped to their TTFT windows)
     — the isolation-gate readout: did tenant A's burst move tenant B's
-    tail?"""
+    tail? With a kvledger attached (ISSUE 16) each tenant also reports
+    its peak/mean resident KV blocks over the replay — the residency
+    figure next to p99 TTFT that ROADMAP item-2 quota caps calibrate
+    against."""
     tenants = sorted({it.get("tenant", "default") for it in trace})
     by_tenant_handles = {}
     for h in handles:
@@ -282,6 +302,10 @@ def _tenant_summary(trace, handles, shed_by_tenant, sched):
             "ttft_p99_s": percentile(ttfts, 0.99),
             "ttft_phase_s": _phase_means(tl_by_tenant.get(t, [])),
         }
+        if kv_peak is not None:
+            out[t]["kv_blocks_peak"] = kv_peak.get(t, 0)
+            out[t]["kv_blocks_mean"] = round(
+                (kv_mean or {}).get(t, 0.0), 4)
     return out
 
 
@@ -353,6 +377,16 @@ def _export_registry(summary):
         "serving_load_tenant_ttft_phase_seconds",
         "Mean seconds each timeline phase contributed to TTFT, per "
         "tenant", labelnames=("tenant", "phase"))
+    # per-tenant resident KV blocks (ISSUE 16): the kvledger residency
+    # sampled at replay step boundaries — peak next to p99 TTFT
+    tgkvp = _metrics.gauge(
+        "serving_load_tenant_kv_blocks_peak",
+        "Peak resident KV blocks a tenant held at any replay step "
+        "boundary (kvledger shadow sample)", labelnames=("tenant",))
+    tgkvm = _metrics.gauge(
+        "serving_load_tenant_kv_blocks_mean",
+        "Mean resident KV blocks per tenant over all replay steps",
+        labelnames=("tenant",))
     for tenant, ts in (summary.get("tenants") or {}).items():
         if ts.get("ttft_p50_s") is not None:
             tg50.labels(tenant=tenant).set(float(ts["ttft_p50_s"]))
@@ -360,6 +394,10 @@ def _export_registry(summary):
             tg99.labels(tenant=tenant).set(float(ts["ttft_p99_s"]))
         for phase, value in (ts.get("ttft_phase_s") or {}).items():
             tgphase.labels(tenant=tenant, phase=phase).set(float(value))
+        if ts.get("kv_blocks_peak") is not None:
+            tgkvp.labels(tenant=tenant).set(float(ts["kv_blocks_peak"]))
+            tgkvm.labels(tenant=tenant).set(
+                float(ts.get("kv_blocks_mean") or 0.0))
 
 
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,

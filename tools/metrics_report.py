@@ -49,7 +49,13 @@ threshold. Direction matters and is decided per counter name:
     `serving_slo_degraded` are additionally FLIP-gated — a burn rate
     crossing 1.0 (error budget consumed faster than allowed) or a
     degraded flip 0 -> 1 fires even from a zero baseline, where
-    percentage rules are meaningless.
+    percentage rules are meaningless,
+  - KV-ledger watchdog counters (ISSUE 16):
+    `serving_kv_ledger_divergence_total{invariant=...}` joins the
+    failure class (pattern `diverg`/`leak`) — the reconciler primes
+    every invariant child at 0, so a single latched divergence in run B
+    gates through the zero-baseline failure-counter rule even though
+    run A never saw the series move.
 
 Fleet-merged snapshots (ISSUE 12, observability/fleet.py) are compared
 LABEL-AWARE: every series already carries `worker_id`/`role` labels in
@@ -86,7 +92,8 @@ SCHEMA = "paddle_tpu.metrics.v1"
 _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
-    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover", re.I)
+    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover|diverg|leak",
+    re.I)
 
 # counter pairs whose RATIO is the SLO signal: a rate drop past the
 # threshold is a failure-class regression even when the numerator grew

@@ -52,6 +52,17 @@ CLI renders a per-tenant decision table and a preemption-victim
 attribution table (which tenant's requests paid for allocation
 pressure, and how).
 
+KV ledger records (ISSUE 16): schedulers whose engine attached a
+`paddle_tpu.kvledger.v1` ledger additionally stream every block
+lifecycle event (kind "kvledger": alloc/ref/unref/free/share/
+cache_insert/cache_evict, each carrying block ids + request/tenant/
+origin attribution) into the same file at step boundaries. Validation
+checks the event vocabulary and shape; the CLI replays the stream into
+a per-tenant KV RESIDENCY table (private/shared/cached resident blocks
++ peak) and a prefix-chain sharing table (who rides whose cached
+chains) — the offline half of the attribution plane whose live half is
+`serving_kv_blocks{tenant,kind}` and the LedgerReconciler watchdog.
+
 Usage: python tools/serve_report.py serve_metrics.jsonl
 """
 import importlib.util
@@ -122,6 +133,19 @@ OPTIONAL_TIMELINE_FIELDS = {"request_id", "key", "priority", "worker",
                             "cohort"}
 TIMELINE_PHASES = {"queue", "prefill", "kv_handoff", "adopt", "place",
                    "decode", "failover"}
+
+# KV block lifecycle events (ISSUE 16), schema paddle_tpu.kvledger.v1 —
+# streamed by the scheduler at step boundaries when the engine attached
+# a kvledger. `tokens` rides only on `share` events (prefill work the
+# cache reuse avoided).
+KVLEDGER_SCHEMA = "paddle_tpu.kvledger.v1"
+KVLEDGER_EVENTS = {"alloc", "ref", "unref", "free", "share",
+                   "cache_insert", "cache_evict"}
+KVLEDGER_FIELDS = {"kind": str, "schema": str, "seq": int, "event": str,
+                   "blocks": list,
+                   "request_id": (int, type(None)), "tenant": str,
+                   "origin": (str, type(None)), "tokens": int}
+OPTIONAL_KVLEDGER_FIELDS = {"tokens"}
 # the phases-sum-to-e2e acceptance gate: contiguous trail construction
 # makes the sum structurally exact, so 5% + 1ms of slack only absorbs
 # float rounding on sub-millisecond runs
@@ -139,15 +163,17 @@ def validate_records(records):
             errors.extend(f"record {i}: {e}"
                           for e in decisions.validate_records([rec]))
             continue
-        if kind not in ("step", "request", "run", "timeline"):
+        if kind not in ("step", "request", "run", "timeline",
+                        "kvledger"):
             errors.append(f"record {i}: unknown kind {kind!r}")
             continue
         schema = {"step": STEP_FIELDS, "request": REQUEST_FIELDS,
-                  "run": RUN_FIELDS,
-                  "timeline": TIMELINE_FIELDS}[kind]
+                  "run": RUN_FIELDS, "timeline": TIMELINE_FIELDS,
+                  "kvledger": KVLEDGER_FIELDS}[kind]
         optional = OPTIONAL_REQUEST_FIELDS if kind == "request" \
             else OPTIONAL_RUN_FIELDS if kind == "run" \
             else OPTIONAL_TIMELINE_FIELDS if kind == "timeline" \
+            else OPTIONAL_KVLEDGER_FIELDS if kind == "kvledger" \
             else OPTIONAL_STEP_FIELDS
         for field, types in schema.items():
             if field not in rec:
@@ -170,6 +196,20 @@ def validate_records(records):
         if kind == "timeline":
             errors.extend(f"record {i} (timeline): {e}"
                           for e in _validate_timeline(rec))
+        if kind == "kvledger":
+            if rec.get("schema") != KVLEDGER_SCHEMA:
+                errors.append(f"record {i} (kvledger): schema="
+                              f"{rec.get('schema')!r}, want "
+                              f"{KVLEDGER_SCHEMA!r}")
+            if rec.get("event") not in KVLEDGER_EVENTS:
+                errors.append(f"record {i} (kvledger): unknown event "
+                              f"{rec.get('event')!r}")
+            if isinstance(rec.get("blocks"), list) and \
+                    not all(isinstance(b, int) and b > 0
+                            for b in rec["blocks"]):
+                errors.append(f"record {i} (kvledger): blocks must be "
+                              f"positive ints (the garbage block never "
+                              f"enters the ledger)")
     return errors
 
 
@@ -244,6 +284,95 @@ def tail_attribution(timelines, q=0.99):
             "dominant": max(share, key=share.get) if share else None}
 
 
+def kv_residency(events):
+    """Replay a kvledger.v1 stream into the per-tenant residency view:
+    final resident blocks by ownership kind (private/shared/cached —
+    classified from the origin that took each reference, mirroring the
+    live shadow model in paddle_tpu/observability/kvledger.py), the
+    per-tenant PEAK resident blocks over the run, and the prefix-chain
+    sharing table (per rider tenant: share events, blocks and prefill
+    tokens reused, and whose cached chains they rode). Returns
+    {"tenants": {...}, "prefix_share": {...}} or None without events."""
+    if not events:
+        return None
+
+    def _kind(origin):
+        return ("shared" if origin == "prefix_cache.match"
+                else "cached" if origin == "prefix_cache.insert"
+                else "private")
+
+    def _drop(hs, tenant, rid, origin):
+        if not hs:
+            return
+        if origin == "prefix_cache.evict":
+            for i, h in enumerate(hs):
+                if h[1] == "cached":
+                    hs.pop(i)
+                    return
+        for pred in (lambda h: rid is not None and h[2] == rid
+                     and h[1] != "cached",
+                     lambda h: h[0] == tenant and h[1] == "shared",
+                     lambda h: h[0] == tenant and h[1] == "private",
+                     lambda h: True):
+            for i, h in enumerate(hs):
+                if pred(h):
+                    hs.pop(i)
+                    return
+
+    holders = {}     # block -> [(tenant, kind, request_id)]
+    owner = {}       # block -> the tenant whose prefill cached it
+    peak = {}        # tenant -> max distinct resident blocks
+    share = {}       # rider tenant -> sharing stats
+    for ev in events:
+        event = ev["event"]
+        t = ev.get("tenant") or "default"
+        rid, origin = ev.get("request_id"), ev.get("origin")
+        bs = ev.get("blocks") or []
+        if event == "alloc":
+            for b in bs:
+                holders[b] = [(t, "private", rid)]
+        elif event == "ref":
+            for b in bs:
+                holders.setdefault(b, []).append((t, _kind(origin), rid))
+        elif event == "unref":
+            for b in bs:
+                _drop(holders.get(b), t, rid, origin)
+        elif event == "free":
+            for b in bs:
+                holders.pop(b, None)
+        elif event == "share":
+            row = share.setdefault(t, {"events": 0, "blocks": 0,
+                                       "tokens": 0, "owners": {}})
+            row["events"] += 1
+            row["blocks"] += len(bs)
+            row["tokens"] += ev.get("tokens", 0)
+            for b in bs:
+                o = owner.get(b)
+                if o is not None:
+                    row["owners"][o] = row["owners"].get(o, 0) + 1
+        elif event == "cache_insert":
+            for b in bs:
+                owner[b] = t
+        elif event == "cache_evict":
+            for b in bs:
+                owner.pop(b, None)
+        res = {}
+        for hs in holders.values():
+            for tt in {h[0] for h in hs}:
+                res[tt] = res.get(tt, 0) + 1
+        for tt, n in res.items():
+            if n > peak.get(tt, 0):
+                peak[tt] = n
+    tenants = {t: {"private": 0, "shared": 0, "cached": 0,
+                   "peak_blocks": p} for t, p in peak.items()}
+    for hs in holders.values():
+        for tt, kk in {(h[0], h[1]) for h in hs}:
+            tenants.setdefault(tt, {"private": 0, "shared": 0,
+                                    "cached": 0, "peak_blocks": 0})
+            tenants[tt][kk] += 1
+    return {"tenants": tenants, "prefix_share": share}
+
+
 def load(path):
     with open(path) as f:
         return [json.loads(line) for line in f if line.strip()]
@@ -286,6 +415,7 @@ def summarize(records):
     reqs = [r for r in records if r["kind"] == "request"]
     timelines = [r for r in records if r["kind"] == "timeline"]
     decision_recs = [r for r in records if r["kind"] == "decision"]
+    kvledger_recs = [r for r in records if r["kind"] == "kvledger"]
     # run headers: later records win (a quality harness may append one
     # carrying the measured match rate after the scheduler's own)
     run = {}
@@ -350,6 +480,8 @@ def summarize(records):
         "decisions": len(decision_recs),
         "decision_table": decision_table(decision_recs),
         "preemption_attribution": preemption_attribution(decision_recs),
+        "kvledger_events": len(kvledger_recs),
+        "kv_residency": kv_residency(kvledger_recs),
         "by_tenant": {
             t: {s: sum(1 for r in reqs
                        if r.get("tenant", "default") == t
@@ -448,6 +580,27 @@ def render(summary):
                                  sorted(row["dispositions"].items()))
                 out.append(f"| {t} | {row['preemptions']} | {disp} | "
                            f"{row['candidates_beaten']} |")
+    res = summary.get("kv_residency")
+    if res:
+        out += ["", f"## KV residency ({summary['kvledger_events']} "
+                    f"ledger events)", "",
+                "| tenant | private | shared | cached | peak resident |",
+                "|---|---|---|---|---|"]
+        for t, row in sorted(res["tenants"].items()):
+            out.append(f"| {t} | {row['private']} | {row['shared']} | "
+                       f"{row['cached']} | {row['peak_blocks']} |")
+        if res["prefix_share"]:
+            out += ["", "### prefix-chain sharing (who rides whose "
+                        "chains)", "",
+                    "| rider tenant | share events | blocks | "
+                    "tokens reused | chain owners |",
+                    "|---|---|---|---|---|"]
+            for t, row in sorted(res["prefix_share"].items()):
+                owners = ", ".join(
+                    f"{o}={n}" for o, n in sorted(
+                        row["owners"].items())) or "-"
+                out.append(f"| {t} | {row['events']} | {row['blocks']} |"
+                           f" {row['tokens']} | {owners} |")
     if summary.get("by_tenant") and len(summary["by_tenant"]) > 1:
         out += ["", "## requests by tenant", ""]
         for t, statuses in sorted(summary["by_tenant"].items()):
