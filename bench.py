@@ -811,6 +811,14 @@ def run_serve_load_bench(on_tpu, n_requests=None):
         block_size=block, steps=int(os.environ.get(
             "BENCH_SERVE_QUALITY_STEPS", 40)),
         attention_impl=attention_impl, seed=0)
+    # multi-tenant isolation gate (ISSUE 17): two tenants at the paged
+    # arm's exact KV budget — tenant A bursts behind its adapter, token
+    # bucket and namespace quota; tenant B's p99 TTFT, B's resident
+    # system-prompt blocks, and the one-executable adapter trace are
+    # all ASSERTED inside (a breach fails the rung, not just a number)
+    tenant_iso = _isolation_gate(model, load_harness, traffic,
+                                 paged_slots, max_len, block, num_blocks,
+                                 attention_impl)
     # compile-count discipline, asserted per arm: ONE decode executable
     # (dense/paged/quant) or ONE draft-decode + ONE verify executable
     # (spec) — a rung that recompiles per step must fail, not report
@@ -894,6 +902,7 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                   "spec_pp_steady_rates": spec_pp_rates,
                   "decision_audit": decision_audit,
                   "kv_ledger_audit": kv_ledger_audit,
+                  "tenant_isolation": tenant_iso,
                   "backend": jax.default_backend()},
     }
 
@@ -994,6 +1003,110 @@ def _audit_kv_ledger(engine, div_baseline):
             "tenant_kind_blocks": {
                 f"{t}/{k}": n
                 for (t, k), n in sorted(shadow.tenant_kind_blocks().items())}}
+
+
+def _isolation_gate(model, load_harness, base_traffic, slots, max_len,
+                    block, num_blocks, attention_impl):
+    """The ISSUE 17 multi-tenant isolation gate: two tenants share ONE
+    paged engine at the same KV budget as the default arm — tenant A
+    carries its own LoRA adapter, a token bucket, and a prefix-namespace
+    quota; tenant B is the well-behaved neighbor. Two deterministic
+    virtual-clock replays run back to back: a no-burst BASELINE, then
+    the same trace with tenant A's arrival rate multiplied inside a
+    burst window. The gate (asserted, so a regression fails the rung
+    like a compile-count breach would):
+
+      1. tenant B's burst-run p99 TTFT stays within GATE x its own
+         no-burst baseline (floor-clamped — a tiny CPU replay's p99 is
+         a handful of virtual steps);
+      2. tenant B's namespace loses ZERO blocks to A's pressure — the
+         quota-aware eviction order reclaims A's own leaves first and
+         never a protected neighbor's system prompt;
+      3. the mixed-tenant adapter-on batch still decodes through ONE
+         compiled executable (trace count == 1), per-tenant behavior
+         riding the gather-by-slot arrays as data, not program.
+
+    All knobs env-tunable (BENCH_SERVE_ISO_*); both replays run the
+    injectable virtual clock, so the verdict is bit-reproducible on CPU
+    CI."""
+    rate_a = float(os.environ.get("BENCH_SERVE_ISO_RATE_A", 400.0))
+    rate_b = float(os.environ.get("BENCH_SERVE_ISO_RATE_B", 100.0))
+    burst_mult = float(os.environ.get("BENCH_SERVE_ISO_BURST_MULT", 6.0))
+    requests = int(os.environ.get("BENCH_SERVE_ISO_REQUESTS",
+                                  2 * base_traffic.requests))
+    gate_mult = float(os.environ.get("BENCH_SERVE_ISO_GATE", 2.0))
+    gate_floor_s = float(os.environ.get("BENCH_SERVE_ISO_FLOOR", 0.25))
+    # A's token bucket prices a request at prompt+max_new tokens. The
+    # refill rate covers A's STEADY arrival rate exactly; the burst
+    # capacity holds ~10 requests of clump slack — so baseline traffic
+    # flows, and the burst window overdraws and gets denied: the rate
+    # limiter, not tenant B, absorbs A's excess
+    cost = base_traffic.prefix_len + base_traffic.suffix_max \
+        + base_traffic.max_new_tokens
+    bucket_a = float(os.environ.get("BENCH_SERVE_ISO_BUCKET_A",
+                                    rate_a * cost))
+    burst_a = float(os.environ.get("BENCH_SERVE_ISO_BURST_CAP_A",
+                                   10 * cost))
+    quota = max(2, (num_blocks - 1) // 2)
+    tenancy = load_harness.build_tenancy(
+        ("tenant_a", "tenant_b"),
+        adapters_arg=os.environ.get("BENCH_SERVE_ISO_ADAPTERS",
+                                    "tenant_a:4"),
+        quotas_arg=f"tenant_a:{quota},tenant_b:{quota}",
+        rates_arg=f"tenant_a:{bucket_a:.0f}/{burst_a:.0f}")
+    tenants = {"tenant_a": rate_a, "tenant_b": rate_b}
+    arms = {}
+    engines = []
+    for arm, burst in (
+            ("baseline", None),
+            ("burst", {"tenant": "tenant_a",
+                       "t0": float(os.environ.get(
+                           "BENCH_SERVE_ISO_BURST_T0", 0.0)),
+                       "dur_s": float(os.environ.get(
+                           "BENCH_SERVE_ISO_BURST_DUR", 0.05)),
+                       "mult": burst_mult})):
+        traffic = load_harness.TrafficConfig(
+            users=base_traffic.users, requests=requests,
+            prefix_len=base_traffic.prefix_len,
+            suffix_min=base_traffic.suffix_min,
+            suffix_max=base_traffic.suffix_max,
+            max_new_tokens=base_traffic.max_new_tokens,
+            seed=base_traffic.seed, tenants=tenants, burst=burst)
+        arms[arm] = load_harness.run_harness(
+            model, "paged", traffic, slots=slots, max_len=max_len,
+            block_size=block, num_blocks=num_blocks,
+            attention_impl=attention_impl, virtual_step_s=0.01,
+            engine_sink=engines, tenancy=tenancy)
+    base_b = arms["baseline"]["tenants"]["tenant_b"]
+    burst_b = arms["burst"]["tenants"]["tenant_b"]
+    burst_a = arms["burst"]["tenants"]["tenant_a"]
+    gate_s = max(gate_floor_s, gate_mult * (base_b["ttft_p99_s"] or 0.0))
+    assert (burst_b["ttft_p99_s"] or 0.0) <= gate_s, \
+        f"tenant isolation breached: tenant B p99 TTFT " \
+        f"{burst_b['ttft_p99_s']}s under tenant A's burst exceeds the " \
+        f"gate {gate_s:.4f}s (baseline {base_b['ttft_p99_s']}s x " \
+        f"{gate_mult}, floor {gate_floor_s}s)"
+    assert burst_b.get("ns_blocks_evicted", 0) == 0, \
+        f"tenant B lost {burst_b['ns_blocks_evicted']} namespaced " \
+        f"prefix blocks to tenant A's burst (quota eviction must " \
+        f"reclaim A's own leaves, never a protected neighbor's)"
+    assert arms["burst"]["trace_counts"]["decode"] == 1, \
+        f"adapter-on mixed-tenant decode recompiled: " \
+        f"{arms['burst']['trace_counts']['decode']} traces (want 1)"
+    return {
+        "gate_p99_s": round(gate_s, 4),
+        "gate_mult": gate_mult,
+        "tenant_b_p99_baseline_s": base_b["ttft_p99_s"],
+        "tenant_b_p99_burst_s": burst_b["ttft_p99_s"],
+        "tenant_b_ns_evicted": burst_b.get("ns_blocks_evicted", 0),
+        "tenant_a_rate_limited": burst_a.get("rate_limited", 0),
+        "tenant_a_shed": burst_a.get("shed", 0),
+        "adapter_decode_traces": arms["burst"]["trace_counts"]["decode"],
+        "burst_mult": burst_mult,
+        "requests": requests,
+        "baseline": arms["baseline"]["tenants"],
+        "burst": arms["burst"]["tenants"],
+    }
 
 
 def _spec_pp_steady_rate(model, pp_e, sp_e):

@@ -1,7 +1,8 @@
 """Scheduler decision audit log (ISSUE 15): `paddle_tpu.decisions.v1`.
 
 The serving stack makes load-bearing decisions — admit, shed, preempt,
-place, failover, swap, quarantine — that until now left only counters
+place, failover, swap, quarantine, rate_limit — that until now left only
+counters
 behind: `serving_shed_total` says HOW OFTEN, nothing says WHY tenant A's
 request was shed at 14:03 while tenant B's sailed through. This module
 owns the typed audit record both emitters (`serving/scheduler.py`,
@@ -32,12 +33,12 @@ Stdlib-only, like every observability submodule.
 
 __all__ = ["SCHEMA", "ACTIONS", "DEFAULT_TENANT", "build_record",
            "replay_shed", "replay_victim", "replay_place",
-           "validate_records", "by_tenant"]
+           "replay_rate_limit", "validate_records", "by_tenant"]
 
 SCHEMA = "paddle_tpu.decisions.v1"
 
 ACTIONS = ("admit", "shed", "preempt", "place", "failover", "swap",
-           "quarantine")
+           "quarantine", "rate_limit")
 
 # the tenant label value of unlabeled traffic: one vocabulary across
 # the scheduler, router, metrics labelsets, and reports, so single-
@@ -96,6 +97,26 @@ def replay_shed(inputs):
     return None
 
 
+def replay_rate_limit(inputs):
+    """The token-budget admission rule over recorded inputs (ISSUE 17):
+    a request costing more tokens than its tenant's bucket holds is
+    limited. Returns the binding reason string, or None to admit.
+
+    inputs: tenant, cost (prompt + max_new tokens), tokens_available
+    (the bucket's post-refill level at decision time), rate_per_s,
+    burst. A request whose cost exceeds `burst` can NEVER admit — the
+    reason says so explicitly so operators see the misconfiguration."""
+    cost = float(inputs["cost"])
+    avail = float(inputs["tokens_available"])
+    if cost <= avail:
+        return None
+    burst = inputs.get("burst")
+    if burst is not None and cost > float(burst):
+        return (f"cost {cost:g} exceeds bucket capacity "
+                f"{float(burst):g} (never admissible)")
+    return (f"cost {cost:g} > tokens available {avail:g}")
+
+
 def replay_victim(candidates, worse_than=None):
     """The preemption-victim rule over a recorded candidate table:
     worst priority class first, most deadline slack within a class
@@ -147,6 +168,13 @@ def _replay_errors(rec):
             if outcome.get("reason") != why:
                 return [f"shed reason {outcome.get('reason')!r} != "
                         f"replayed {why!r}"]
+        elif action == "rate_limit":
+            why = replay_rate_limit(inputs)
+            if why is None:
+                return ["rate_limit record's inputs admit on replay"]
+            if outcome.get("reason") != why:
+                return [f"rate_limit reason {outcome.get('reason')!r} "
+                        f"!= replayed {why!r}"]
         elif action == "preempt":
             got = replay_victim(inputs.get("candidates") or (),
                                 worse_than=inputs.get("worse_than"))

@@ -37,6 +37,12 @@ Site catalogue (the call sites live next to the operation they break):
                        params are validated/committed — a raise rejects
                        the swap atomically (old weights keep serving,
                        zero requests dropped)
+  serving.adapter_swap GenerationEngine.swap_adapter (ISSUE 17), before
+                       the tenant's LoRA delta is validated/committed
+                       into the adapter bank — a raise rejects the swap
+                       atomically: the tenant's OLD adapter keeps
+                       serving, no half-applied delta, other tenants'
+                       streams untouched
   serving.kv_ledger_leak  serving.blocks.BlockPool.unref, at the moment
                        a last reference drops (ISSUE 16): `truncate`
                        mode makes the caller SKIP the free-list return —
@@ -89,8 +95,8 @@ __all__ = ["FaultSpec", "FaultInjected", "SITES", "ENV_VAR", "arm",
 SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.decode_step", "serving.block_alloc",
          "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
-         "serving.pp_handoff", "serving.kv_ledger_leak",
-         "dataloader.next")
+         "serving.adapter_swap", "serving.pp_handoff",
+         "serving.kv_ledger_leak", "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

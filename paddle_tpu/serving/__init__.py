@@ -48,8 +48,8 @@ from .engine import (  # noqa: F401
 )
 from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (  # noqa: F401
-    PRIORITIES, LoadShedError, QueueFullError, Request, RequestHandle,
-    Scheduler, ServingConfig,
+    PRIORITIES, LoadShedError, QueueFullError, RateLimitedError, Request,
+    RequestHandle, Scheduler, ServingConfig,
 )
 from .spec_decode import (  # noqa: F401
     SpecDecodeConfig, SpeculativeEngine, truncated_draft,
@@ -65,5 +65,5 @@ __all__ = [
     "default_compile_cache_dir",
     "SpecDecodeConfig", "SpeculativeEngine", "truncated_draft",
     "Scheduler", "ServingConfig", "Request", "RequestHandle",
-    "QueueFullError", "LoadShedError",
+    "QueueFullError", "LoadShedError", "RateLimitedError",
 ]

@@ -314,6 +314,30 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         self._place_stage_params()
         self._build_decode_params()
 
+    def _place_adapter_tree(self, tree):
+        """Per-tenant LoRA banks (ISSUE 17) shard WITH the stage: stage
+        s holds only its own blocks' [n_slots, r, ...] factors, sliced
+        from the bank tree by the stage's layer range and replicated on
+        its 'mp' mesh next to the stage shard — no stage ever stores
+        another stage's deltas. Returns a per-stage tuple; the stage
+        executables receive their own element."""
+        placed = []
+        for st in self._stages:
+            sl = {"layers": tuple(
+                tree["layers"][st.module.start:st.module.stop])}
+            placed.append(jax.device_put(sl, st.replicated))
+        return tuple(placed)
+
+    def _stage_adapter_args(self, s, lo, hi):
+        """Adapter extras for one (stage, microbatch) cell: stage s's
+        layer slice + the microbatch's slot->adapter-slot ids. Empty
+        when no bank is attached, so adapter-off stage traces keep
+        today's exact signatures."""
+        if self._adapter_bank is None:
+            return ()
+        return (self._adapter_tree[s],
+                jnp.asarray(self._slot_adapter[lo:hi]))
+
     @property
     def _pool(self):
         """The whole-model pool view, stage slices in layer order —
@@ -330,20 +354,25 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
 
     # -- stage forward --------------------------------------------------------
     def _run_stage(self, st, params, pool, tables, pos, x, op,
-                   valid=None):
+                   valid=None, adapters=None):
         """functional_call of one GPTStage over raw arrays -> (out,
         new stage pool). `params` may be the int8 decode set (dequant
-        at trace time, like the single-device engine)."""
+        at trace time, like the single-device engine). `adapters` is
+        this STAGE's per-tenant LoRA view ({"slot", "layers": the
+        stage's own slice}); the kwarg is added only when present so
+        adapter-off traces stay byte-identical."""
         cache = blocks.PagedDecodeCache(
             tuple(type(l)(*(Tensor(a) for a in l)) for l in pool),
             Tensor(tables), Tensor(pos),
             None if valid is None else Tensor(valid))
+        kwargs = {"cache": cache, "pos": cache.pos,
+                  "tables": cache.tables, "valid": cache.valid,
+                  "op": op}
+        if adapters is not None:
+            kwargs["adapters"] = adapters
         out, _ = functional_call(
             st.module, self._dequant_params(params), st.buffers,
-            args=(Tensor(x),),
-            kwargs={"cache": cache, "pos": cache.pos,
-                    "tables": cache.tables, "valid": cache.valid,
-                    "op": op}, train=False)
+            args=(Tensor(x),), kwargs=kwargs, train=False)
         y, new_layers = out
         return y._data, tuple(type(l)(*(a._data for a in l))
                               for l in new_layers)
@@ -363,11 +392,13 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         cache name differ."""
         st = self._stages[s]
 
-        def fn(params, pool, tables, pos, x):
+        def fn(params, pool, tables, pos, x, *extra):
+            adapters, _ = self._split_extra(extra)
             self.trace_counts[counter][s] = \
                 self.trace_counts[counter].get(s, 0) + 1
             y, npool = self._run_stage(st, params, pool, tables,
-                                       pos, x, op="block")
+                                       pos, x, op="block",
+                                       adapters=adapters)
             y = jax.lax.with_sharding_constraint(y, st.replicated)
             return y, self._constrain_stage(st, npool)
         return self._cached(fn, name)
@@ -379,11 +410,13 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
             return self._make_stage_forward(s, "decode_pp",
                                             f"decode_stage[{s}]")
 
-        def fn(params, pool, tables, pos, x, key, *rng):
+        def fn(params, pool, tables, pos, x, key, *extra):
+            adapters, rng = self._split_extra(extra)
             self.trace_counts["decode_pp"][s] = \
                 self.trace_counts["decode_pp"].get(s, 0) + 1
             logits, npool = self._run_stage(st, params, pool, tables,
-                                           pos, x, op="block_head")
+                                           pos, x, op="block_head",
+                                           adapters=adapters)
             nxt = self._select_slots(logits[:, 0, :], key, *rng)
             npool = self._constrain_stage(st, npool)
             if self.config.capture_logits:
@@ -457,12 +490,14 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         def stage_call(s, st, g, x):
             lo, hi = g * mbs, (g + 1) * mbs
             mb_tables, mb_pos = mb_slices[g]
+            adp = self._stage_adapter_args(s, lo, hi)
             if st.module.is_first:
                 x = jnp.asarray(tokens[lo:hi].reshape(mbs, 1))
             if not st.module.is_last:
                 return self._stage_decode[s](st.decode_params, st.pool,
-                                             mb_tables, mb_pos, x)
-            args = [st.decode_params, st.pool, mb_tables, mb_pos, x, key]
+                                             mb_tables, mb_pos, x, *adp)
+            args = [st.decode_params, st.pool, mb_tables, mb_pos, x, key,
+                    *adp]
             if self._sampling:
                 args += [jnp.asarray(self._slot_seeds[lo:hi]),
                          jnp.asarray(self._slot_gen[lo:hi])]
@@ -684,9 +719,10 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
                 else:
                     x = jax.device_put(jnp.zeros((mbs, 1, H), jnp.float32),
                                        st.replicated)
+                adp = self._stage_adapter_args(s, 0, mbs)
                 if st.module.is_last:
                     args = [st.decode_params, st.pool, mb_tables, mb_pos,
-                            x, key]
+                            x, key, *adp]
                     if self._sampling:
                         args += [jnp.zeros((mbs,), jnp.uint32),
                                  jnp.zeros((mbs,), jnp.int32)]
@@ -694,7 +730,8 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
                         self._stage_decode[s].warm(*args)
                 else:
                     out[f"decode_stage[{s}]"] = self._stage_decode[s].warm(
-                        st.decode_params, st.pool, mb_tables, mb_pos, x)
+                        st.decode_params, st.pool, mb_tables, mb_pos, x,
+                        *adp)
             for b in c.prefill_buckets:
                 chunk = min(c.prefill_chunk or b, b)
                 for s, st in enumerate(self._stages):
@@ -863,11 +900,13 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
             return self._make_stage_forward(s, "verify_pp",
                                             f"verify_stage[{s}]")
 
-        def fn(params, pool, tables, pos, x, window):
+        def fn(params, pool, tables, pos, x, window, *extra):
+            adapters, _ = self._split_extra(extra)
             self.trace_counts["verify_pp"][s] = \
                 self.trace_counts["verify_pp"].get(s, 0) + 1
             logits, npool = self._run_stage(st, params, pool, tables,
-                                            pos, x, op="block_head")
+                                            pos, x, op="block_head",
+                                            adapters=adapters)
             npool = self._constrain_stage(st, npool)
             choices, n_acc, last = sampling.greedy_verify(logits, window)
             return choices, n_acc, last, npool
@@ -907,15 +946,18 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
         mb_windows = [window[g * mbs:(g + 1) * mbs] for g in range(M)]
 
         def stage_call(s, st, g, x):
+            lo, hi = g * mbs, (g + 1) * mbs
             mb_tables, mb_pos = mb_slices[g]
+            adp = self._stage_adapter_args(s, lo, hi)
             if st.module.is_first:
                 x = mb_windows[g]
             if not st.module.is_last:
                 return self._stage_verify[s](st.decode_params, st.pool,
-                                             mb_tables, mb_pos, x)
+                                             mb_tables, mb_pos, x, *adp)
             win = jax.device_put(mb_windows[g], st.replicated)
             ch, na, la, npool = self._stage_verify[s](
-                st.decode_params, st.pool, mb_tables, mb_pos, x, win)
+                st.decode_params, st.pool, mb_tables, mb_pos, x, win,
+                *adp)
             return (ch, na, la), npool
 
         with RecordEvent("serving::spec_verify",
@@ -985,13 +1027,15 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
                 else:
                     x = jax.device_put(jnp.zeros((mbs, W, H), jnp.float32),
                                        st.replicated)
+                adp = self._stage_adapter_args(s, 0, mbs)
                 if st.module.is_last:
                     out[f"verify_stage[{s}]"] = self._stage_verify[s].warm(
                         st.decode_params, st.pool, mb_tables, mb_pos, x,
-                        win)
+                        win, *adp)
                 else:
                     out[f"verify_stage[{s}]"] = self._stage_verify[s].warm(
-                        st.decode_params, st.pool, mb_tables, mb_pos, x)
+                        st.decode_params, st.pool, mb_tables, mb_pos, x,
+                        *adp)
         return out
 
 

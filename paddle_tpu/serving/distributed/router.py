@@ -90,25 +90,30 @@ class ServingShardClient(_rpc.ShardClientBase):
         return self._exchange(i, msg, reader)
 
     def prefill(self, i, key, prompt, decode_endpoint=None,
-                rng_seed=None, rng_gen=0, tenant=None, cohort=None):
+                rng_seed=None, rng_gen=0, tenant=None, cohort=None,
+                namespace=None):
         return self._call(i, OP_PREFILL, {
             "key": key, "prompt": [int(t) for t in prompt],
             "decode_endpoint": decode_endpoint,
             "rng_seed": rng_seed, "rng_gen": int(rng_gen),
-            "tenant": tenant, "cohort": cohort})
+            "tenant": tenant, "cohort": cohort,
+            "namespace": namespace})
 
     def kv_put(self, i, key, bundle):
         return self._call(i, OP_KV_PUT, {"key": key}, tail=bundle)
 
     def submit(self, i, key, prompt, max_new=None, priority="standard",
                timeout_s=None, use_staged=False, rng_seed=None,
-               rng_gen=0, tenant=None, cohort=None):
+               rng_gen=0, tenant=None, cohort=None, adapter_id=None,
+               prefix_namespace=None):
         return self._call(i, OP_SUBMIT, {
             "key": key, "prompt": [int(t) for t in prompt],
             "max_new": max_new, "priority": priority,
             "timeout_s": timeout_s, "use_staged": bool(use_staged),
             "rng_seed": rng_seed, "rng_gen": int(rng_gen),
-            "tenant": tenant, "cohort": cohort})
+            "tenant": tenant, "cohort": cohort,
+            "adapter_id": adapter_id,
+            "prefix_namespace": prefix_namespace})
 
     def poll(self, i, keys):
         return self._call(i, OP_POLL, {"keys": list(keys)})
@@ -139,7 +144,8 @@ class DistRequest:
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new, priority, timeout_s=None,
-                 rng_seed=None, tenant=None, cohort=None):
+                 rng_seed=None, tenant=None, cohort=None,
+                 adapter_id=None, prefix_namespace=None):
         self.key = f"r{next(self._ids)}.{os.getpid()}"
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
@@ -151,6 +157,14 @@ class DistRequest:
         # records — one label from router to fleet snapshot
         self.tenant = str(tenant) if tenant else _dec.DEFAULT_TENANT
         self.cohort = str(cohort) if cohort else None
+        # multi-tenant serving (ISSUE 17): the adapter a decode worker
+        # should bind the request's slot to, and the prefix-cache
+        # namespace its prompt blocks key under — both ride the wire
+        # next to tenant, and both survive every re-placement (the
+        # failover restart binds the same adapter on the new worker)
+        self.adapter_id = str(adapter_id) if adapter_id else None
+        self.prefix_namespace = str(prefix_namespace) \
+            if prefix_namespace is not None else None
         # the request's sampler seed (ISSUE 13): STABLE across every
         # placement — original, preempt restart, failover restart — so
         # a temperature>0 stream replays bit-identically wherever it
@@ -310,16 +324,20 @@ class DistFrontend:
                     i, req._wire_key, exec_prompt,
                     decode_endpoint=target, rng_seed=req.rng_seed,
                     rng_gen=len(req.tokens), tenant=req.tenant,
-                    cohort=req.cohort)
+                    cohort=req.cohort,
+                    namespace=req.prefix_namespace)
                 return True, float(reply.get("handoff_s") or 0.0)
             except (_rpc.PSUnavailableError, _rpc.PSServerError):
                 continue             # next prefill worker, else fallback
         return False, 0.0
 
     def submit(self, prompt, max_new=16, priority="standard",
-               timeout_s=None, rng_seed=None, tenant=None, cohort=None):
+               timeout_s=None, rng_seed=None, tenant=None, cohort=None,
+               adapter_id=None, prefix_namespace=None):
         req = DistRequest(prompt, max_new, priority, timeout_s=timeout_s,
-                          rng_seed=rng_seed, tenant=tenant, cohort=cohort)
+                          rng_seed=rng_seed, tenant=tenant, cohort=cohort,
+                          adapter_id=adapter_id,
+                          prefix_namespace=prefix_namespace)
         self._place(req)                 # RPCs happen OUTSIDE the lock
         with self._lock:
             self._inflight[req.key] = req
@@ -367,7 +385,9 @@ class DistFrontend:
                     max_new=remaining, priority=req.priority,
                     timeout_s=req.timeout_s, use_staged=staged,
                     rng_seed=req.rng_seed, rng_gen=len(req.tokens),
-                    tenant=req.tenant, cohort=req.cohort)
+                    tenant=req.tenant, cohort=req.cohort,
+                    adapter_id=req.adapter_id,
+                    prefix_namespace=req.prefix_namespace)
             except _rpc.PSUnavailableError:
                 now = time.monotonic()
                 req.trail.append(_rt.PH_PLACE, place_from, now)

@@ -168,6 +168,14 @@ class TensorParallelPagedEngine(PagedGenerationEngine):
         """Hot-swapped weights re-apply the original mesh sharding."""
         return jax.device_put(arr, self._param_shardings[name])
 
+    def _place_adapter_tree(self, tree):
+        """Per-tenant LoRA banks (ISSUE 17) replicate over the mesh: the
+        rank-r factors are tiny next to the sharded base weights, and a
+        replicated delta keeps the partitioner's collective pattern
+        identical to the adapter-off trace (the all-reduce after
+        out_proj/fc2 still runs over the same 'mp' axis)."""
+        return jax.device_put(tree, self._replicated)
+
     def _place_quant_weight(self, name, codes, scale_b, axis):
         """Quantized decode weights shard EXACTLY like their float
         originals (same shape, same split_axis spec). The per-channel

@@ -119,7 +119,8 @@ class ServingWorker:
 
     def __init__(self, model, engine, role="decode", serving_config=None,
                  host="127.0.0.1", port=0, version=0,
-                 peer_client_kwargs=None, step_interval_s=0.0):
+                 peer_client_kwargs=None, step_interval_s=0.0,
+                 tenancy=None):
         if role not in ("decode", "prefill"):
             raise ValueError(f"role must be 'decode' or 'prefill', "
                              f"got {role!r}")
@@ -137,8 +138,10 @@ class ServingWorker:
         # window open; production leaves it 0)
         self.step_interval_s = float(step_interval_s)
         self._stop = threading.Event()
+        # tenancy (ISSUE 17): a TenancyConfig arms the decode
+        # scheduler's token buckets + prefix-cache quotas on this host
         self.scheduler = Scheduler(engine, serving_config
-                                   or ServingConfig()) \
+                                   or ServingConfig(), tenancy=tenancy) \
             if role == "decode" else None
         _M_MODEL_VERSION.set(float(version))
         handlers = {OP_SWAP: self._h_swap, OP_STAT: self._h_stat,
@@ -238,7 +241,14 @@ class ServingWorker:
                 {"key": key, "tenant": obj.get("tenant") or "default",
                  "cohort": obj.get("cohort"), "prompt_len": len(prompt)}):
             slot = 0                          # one prefill at a time
-            first = self.engine.prefill(slot, prompt, rng=rng)
+            # the namespace rides the PREFILL frame (ISSUE 17): the
+            # prefill host's prefix cache keys this prompt under the
+            # request's tenant namespace, so cross-tenant prompts never
+            # share blocks on the prefill side either
+            pkw = {}
+            if obj.get("namespace") is not None:
+                pkw["namespace"] = obj["namespace"]
+            first = self.engine.prefill(slot, prompt, rng=rng, **pkw)
             bundle_rng = self.engine.slot_rng(slot) \
                 if rng is not None else None
             # quantization-aware: a kv_dtype="int8" engine ships the
@@ -322,7 +332,9 @@ class ServingWorker:
                 rng_seed=obj.get("rng_seed"),
                 rng_gen=int(obj.get("rng_gen") or 0),
                 tenant=obj.get("tenant"),
-                cohort=obj.get("cohort"))
+                cohort=obj.get("cohort"),
+                adapter_id=obj.get("adapter_id"),
+                prefix_namespace=obj.get("prefix_namespace"))
             self._requests[key] = handle
             self._trim_requests()
         return _kv.pack_payload({"ok": 1,

@@ -161,6 +161,15 @@ class GenerationEngine:
         self._slot_seeds = np.zeros((self.config.slots,), np.uint32)
         self._slot_gen = np.zeros((self.config.slots,), np.int32)
         self._rng_nonce = 0
+        # per-tenant LoRA adapters (ISSUE 17): the bank's stacked
+        # [n_adapters, ...] arrays ride the decode executable as extra
+        # runtime inputs (like the sampler rng args) and each slot's
+        # int32 adapter id gathers its delta IN-trace — no bank attached
+        # means no extra args, so adapter-off engines keep their exact
+        # pre-tenancy traces and compile counts
+        self._adapter_bank = None
+        self._adapter_tree = None
+        self._slot_adapter = np.zeros((self.config.slots,), np.int32)
         # trace counters: the python bodies below run ONLY when jax traces,
         # so these counts are the number of compilations, not of calls.
         # A warm persistent-cache load DESERIALIZES the executable and
@@ -216,15 +225,19 @@ class GenerationEngine:
         self._build_decode_params()
 
     # -- functional forward -------------------------------------------------
-    def _run_model(self, params, layers_k, layers_v, pos, ids):
+    def _run_model(self, params, layers_k, layers_v, pos, ids,
+                   adapters=None):
         """GPT cached forward over raw arrays -> (logits, new k/v lists)."""
         cache = kvc.DecodeCache(
             tuple(kvc.LayerKV(Tensor(k), Tensor(v))
                   for k, v in zip(layers_k, layers_v)),
             Tensor(pos))
+        kwargs = {"cache": cache}
+        if adapters is not None:
+            kwargs["adapters"] = adapters
         out, _ = functional_call(
             self._model, params, self._buffers, args=(Tensor(ids),),
-            kwargs={"cache": cache}, train=False)
+            kwargs=kwargs, train=False)
         logits, new_cache = out
         return (logits._data,
                 [l.k._data for l in new_cache.layers],
@@ -237,9 +250,11 @@ class GenerationEngine:
             temperature=c.temperature, top_k=c.top_k, top_p=c.top_p)
 
     # -- decode: ONE executable --------------------------------------------
-    def _decode_fn(self, params, gk, gv, pos, tokens, key, *rng):
+    def _decode_fn(self, params, gk, gv, pos, tokens, key, *extra):
         self.trace_counts["decode"] += 1     # trace-time only
-        logits, nk, nv = self._run_model(params, gk, gv, pos, tokens[:, None])
+        adapters, rng = self._split_extra(extra)
+        logits, nk, nv = self._run_model(params, gk, gv, pos,
+                                         tokens[:, None], adapters=adapters)
         nxt = self._select_slots(logits[:, 0, :], key, *rng)
         # free slots keep decoding garbage harmlessly; clamp so their
         # position (and the wpe lookup) stays in-bounds forever
@@ -333,6 +348,85 @@ class GenerationEngine:
             return ()
         return (jnp.asarray(self._slot_seeds), jnp.asarray(self._slot_gen))
 
+    # -- per-tenant LoRA adapters (ISSUE 17) ---------------------------------
+    def attach_adapters(self, bank):
+        """Attach a `tenancy.AdapterBank`: from the NEXT decode step the
+        executables take the bank's stacked arrays + per-slot adapter
+        ids as extra runtime inputs (one new trace per executable —
+        adapters change the program once, tenants never do)."""
+        self._adapter_bank = bank
+        self._refresh_adapters()
+
+    @property
+    def adapter_bank(self):
+        """The attached tenancy.AdapterBank, or None — what the
+        scheduler probes to bind slots to tenants at placement."""
+        return self._adapter_bank
+
+    def _refresh_adapters(self):
+        """Re-mirror the bank's host masters to device (after attach and
+        after every adapter swap)."""
+        self._adapter_tree = self._place_adapter_tree(
+            self._adapter_bank.device_tree())
+
+    def _place_adapter_tree(self, tree):
+        """Device placement hook for the adapter pytree — the TP engine
+        overrides to replicate over its mesh; the PP engine shards each
+        stage's layer slice with the stage."""
+        return tree
+
+    def set_slot_adapter(self, slot, idx):
+        """Bind engine slot `slot` to adapter slot `idx` (0 = base).
+        A host int32 write — the next decode gathers the new row."""
+        self._slot_adapter[int(slot)] = np.int32(idx)
+
+    def slot_adapter(self, slot):
+        return int(self._slot_adapter[int(slot)])
+
+    def swap_adapter(self, tenant, state):
+        """Hot-load/replace ONE tenant's adapter between decode steps
+        (ISSUE 17 registry piece; same atomic-failure contract as
+        `swap_params`): the `serving.adapter_swap` chaos site fires
+        first, then the bank validates EVERY tensor before writing a
+        single row — any failure leaves the tenant's previous adapter
+        (and every other tenant's) serving untouched. Base weights are
+        never touched; no executable retraces (array values changed,
+        never shapes). Returns the tenant's adapter slot."""
+        if self._adapter_bank is None:
+            raise ValueError("no adapter bank attached "
+                             "(engine.attach_adapters)")
+        _faults.fire("serving.adapter_swap")
+        idx = self._adapter_bank.load(tenant, state)
+        self._refresh_adapters()
+        return idx
+
+    def drop_adapter(self, tenant):
+        """Zero a tenant's adapter row (its slots fall back to base)."""
+        if self._adapter_bank is None:
+            return None
+        idx = self._adapter_bank.drop(tenant)
+        if idx is not None:
+            self._refresh_adapters()
+        return idx
+
+    def _adapter_args(self):
+        """Extra decode-executable inputs for the adapter path: the
+        placed bank pytree + per-slot adapter ids (empty with no bank —
+        adapter-off executables keep their pre-tenancy signature and
+        caches, exactly like the greedy/sampling rng split)."""
+        if self._adapter_bank is None:
+            return ()
+        return (self._adapter_tree, jnp.asarray(self._slot_adapter))
+
+    def _split_extra(self, extra):
+        """Split a decode executable's trailing `*extra` args back into
+        (model adapter view | None, rng args) — the trace-time mirror of
+        `*self._adapter_args(), *self._rng_args()` at the call sites."""
+        if self._adapter_bank is None:
+            return None, extra
+        tree, ids = extra[0], extra[1]
+        return {"slot": ids, "layers": tree["layers"]}, extra[2:]
+
     def _select_slots(self, logits, key, seeds=None, gen=None):
         """Per-slot token selection: greedy (or a legacy shared-key
         call) routes through `_select`; sampling derives each row's key
@@ -378,7 +472,7 @@ class GenerationEngine:
         out = {"decode": self._decode.warm(
             self._params, gk, gv, pos,
             jnp.zeros((self.config.slots,), jnp.int32), key,
-            *self._rng_args())}
+            *self._adapter_args(), *self._rng_args())}
         for b in self.config.prefill_buckets:
             if b not in self._prefill:
                 self._prefill[b] = self._make_prefill(b)
@@ -388,11 +482,13 @@ class GenerationEngine:
         return out
 
     # -- public compute API -------------------------------------------------
-    def prefill(self, slot, prompt_ids, rng=None):
+    def prefill(self, slot, prompt_ids, rng=None, namespace=None):
         """Write `prompt_ids` (1-D ints) into `slot`'s cache rows; returns
         the first generated token (host int). `rng=(seed, gen)` arms the
         slot's per-request sampler state (the first token is generation
-        index `gen`); None draws a fresh deterministic seed at gen 0."""
+        index `gen`); None draws a fresh deterministic seed at gen 0.
+        `namespace` is accepted for interface parity with the paged
+        engines (the dense cache has no shared blocks to isolate)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -439,7 +535,8 @@ class GenerationEngine:
             nxt, gk, gv, pos = self._decode(
                 self._decode_params, [l.k for l in self._cache.layers],
                 [l.v for l in self._cache.layers], self._cache.pos,
-                jnp.asarray(tokens), self._next_key(), *self._rng_args())
+                jnp.asarray(tokens), self._next_key(),
+                *self._adapter_args(), *self._rng_args())
         self._set_cache(gk, gv, pos)
         self._slot_gen += 1
         out = np.asarray(nxt, np.int32)
@@ -514,6 +611,7 @@ class GenerationEngine:
                                       jnp.asarray(pos))
         self._last_tokens[int(slot)] = np.int32(0)
         self.set_slot_rng(slot, 0, 0)
+        self._slot_adapter[int(slot)] = 0
 
     def slot_positions(self):
         return np.asarray(self._cache.pos, np.int32)
@@ -734,6 +832,9 @@ class PagedGenerationEngine(GenerationEngine):
         self._pos = np.zeros((c.slots,), np.int32)
         self._tables = np.zeros((c.slots, c.max_blocks_per_slot), np.int32)
         self._slot_active = np.zeros((c.slots,), bool)
+        # per-slot prefix namespace (ISSUE 17): remembered from prefill
+        # so mid-decode block growth evicts under the same requester
+        self._slot_namespace = {}
         self.block_pool = blocks.BlockPool(c.num_blocks, c.block_size)
         self.prefix_cache = PrefixCache(self.block_pool, c.block_size) \
             if c.enable_prefix_cache else None
@@ -839,17 +940,21 @@ class PagedGenerationEngine(GenerationEngine):
                 for n, v in params.items()}
 
     # -- block accounting ----------------------------------------------------
-    def _alloc_blocks(self, n):
+    def _alloc_blocks(self, n, requester=None):
         """Pool alloc with prefix-cache eviction as the pressure valve:
         only when eviction cannot cover the shortfall does
         BlockAllocError escape to the scheduler (whose next lever is
-        preemption)."""
+        preemption). `requester` is the allocating request's prefix
+        namespace — quota-aware eviction drains the requester's OWN
+        leaves first and never touches a within-quota foreign
+        namespace's blocks (ISSUE 17)."""
         try:
             return self.block_pool.alloc(n)
         except blocks.BlockAllocError:
             if self.prefix_cache is not None:
                 short = n - self.block_pool.available
-                if self.prefix_cache.evict(short) >= short:
+                if self.prefix_cache.evict(short,
+                                           requester=requester) >= short:
                     return self.block_pool.alloc(n)
             raise
 
@@ -873,7 +978,10 @@ class PagedGenerationEngine(GenerationEngine):
         need = [lb for lb in range(first, last + 1)
                 if self._tables[slot, lb] == blocks.GARBAGE_BLOCK]
         if need:
-            for lb, b in zip(need, self._alloc_blocks(len(need))):
+            requester = self._slot_namespace.get(slot)
+            for lb, b in zip(need,
+                             self._alloc_blocks(len(need),
+                                                requester=requester)):
                 self._tables[slot, lb] = b
 
     def ensure_decode_capacity(self):
@@ -908,7 +1016,7 @@ class PagedGenerationEngine(GenerationEngine):
             out["decode"] = self._decode.warm(
                 self._decode_params, self._pool, tables, pos,
                 jnp.zeros((self.config.slots,), jnp.int32), key,
-                *self._rng_args())
+                *self._adapter_args(), *self._rng_args())
             for b in self.config.prefill_buckets:
                 if b not in self._prefill:
                     self._prefill[b] = self._make_prefill(b)
@@ -920,7 +1028,8 @@ class PagedGenerationEngine(GenerationEngine):
         return out
 
     # -- functional forward (paged) -----------------------------------------
-    def _run_model_paged(self, params, pool, tables, pos, ids, valid=None):
+    def _run_model_paged(self, params, pool, tables, pos, ids, valid=None,
+                         adapters=None):
         """GPT cached forward over the pool pytree (a tuple of
         (Quant)PagedLayerKV of raw arrays) -> (logits, new pool).
         `valid` [S]: real tokens per slot in this write (prefill passes
@@ -930,20 +1039,24 @@ class PagedGenerationEngine(GenerationEngine):
             tuple(type(l)(*(Tensor(x) for x in l)) for l in pool),
             Tensor(tables), Tensor(pos),
             None if valid is None else Tensor(valid))
+        kwargs = {"cache": cache}
+        if adapters is not None:
+            kwargs["adapters"] = adapters
         out, _ = functional_call(
             self._model, params, self._buffers, args=(Tensor(ids),),
-            kwargs={"cache": cache}, train=False)
+            kwargs=kwargs, train=False)
         logits, new_cache = out
         return (logits._data,
                 tuple(type(l)(*(x._data for x in l))
                       for l in new_cache.layers))
 
     # -- decode: ONE executable ---------------------------------------------
-    def _decode_fn(self, params, pool, tables, pos, tokens, key, *rng):
+    def _decode_fn(self, params, pool, tables, pos, tokens, key, *extra):
         self.trace_counts["decode"] += 1     # trace-time only
+        adapters, rng = self._split_extra(extra)
         logits, npool = self._run_model_paged(
             self._dequant_params(params), pool, tables, pos,
-            tokens[:, None])
+            tokens[:, None], adapters=adapters)
         nxt = self._select_slots(logits[:, 0, :], key, *rng)
         npool = self._constrain_pools(npool)
         new_pos = jnp.minimum(pos + 1, self.config.max_len - 1)
@@ -977,7 +1090,7 @@ class PagedGenerationEngine(GenerationEngine):
         return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     # -- public compute API --------------------------------------------------
-    def prefill(self, slot, prompt_ids, rng=None):
+    def prefill(self, slot, prompt_ids, rng=None, namespace=None):
         """Place `prompt_ids` into `slot`: match the prefix cache, alloc
         private blocks for the remainder, run the SUFFIX through the
         bucket executable (writes scatter into this slot's blocks), and
@@ -985,7 +1098,10 @@ class PagedGenerationEngine(GenerationEngine):
         the prefix hit for the scheduler's request metrics. `rng=(seed,
         gen)` arms the slot's per-request sampler state — the first
         token is generation index `gen` (a restart's delivered-token
-        count), so a sampled stream resumes bit-identically."""
+        count), so a sampled stream resumes bit-identically.
+        `namespace` (ISSUE 17) salts the prefix-cache keys — requests in
+        different namespaces can never share blocks, and allocation
+        pressure evicts the requester's own namespace first."""
         slot = int(slot)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -1003,10 +1119,12 @@ class PagedGenerationEngine(GenerationEngine):
         # STICKS — a BlockAllocError below means the scheduler will retry
         # and a per-attempt count would inflate the gated hit rate
         shared_ids, nshared = ([], 0) if self.prefix_cache is None \
-            else self.prefix_cache.match(toks, record=False)
+            else self.prefix_cache.match(toks, record=False,
+                                         namespace=namespace)
         n_priv = blocks.blocks_for_tokens(plen, bs) - nshared // bs
         try:
-            priv = self._alloc_blocks(n_priv) if n_priv else []
+            priv = self._alloc_blocks(n_priv, requester=namespace) \
+                if n_priv else []
         except blocks.BlockAllocError:
             for b in shared_ids:          # give back the matched refs
                 self.block_pool.unref(b)
@@ -1016,6 +1134,7 @@ class PagedGenerationEngine(GenerationEngine):
         row[len(shared_ids):len(shared_ids) + n_priv] = priv
         self._tables[slot] = row
         self._slot_active[slot] = True
+        self._slot_namespace[slot] = namespace
         seed, gen = rng if rng is not None \
             else (self._default_slot_seed(), 0)
         self.set_slot_rng(slot, seed, gen)
@@ -1036,7 +1155,8 @@ class PagedGenerationEngine(GenerationEngine):
         if self.prefix_cache is not None:
             # the prompt's fully-written blocks become shareable; the
             # matched prefix chain is already registered (touch only)
-            self.prefix_cache.insert(toks, row, (plen // bs) * bs)
+            self.prefix_cache.insert(toks, row, (plen // bs) * bs,
+                                     namespace=namespace)
             self.prefix_cache.record_lookup(nshared > 0)
         self.last_prefill_stats = {
             "prefix_hit_tokens": nshared, "blocks_allocated": n_priv,
@@ -1079,7 +1199,8 @@ class PagedGenerationEngine(GenerationEngine):
             res = self._decode(
                 self._decode_params, self._pool, jnp.asarray(self._tables),
                 jnp.asarray(self._pos), jnp.asarray(tokens),
-                self._next_key(), *self._rng_args())
+                self._next_key(), *self._adapter_args(),
+                *self._rng_args())
         if self.config.capture_logits:
             nxt, pool, pos, logits = res
             self.last_logits = np.asarray(logits, np.float32)
@@ -1310,6 +1431,8 @@ class PagedGenerationEngine(GenerationEngine):
         self._pos[slot] = 0
         self._last_tokens[slot] = np.int32(0)
         self.set_slot_rng(slot, 0, 0)
+        self._slot_adapter[slot] = 0
+        self._slot_namespace.pop(slot, None)
 
     def slot_positions(self):
         return self._pos.copy()

@@ -31,6 +31,16 @@ Sharing protocol (the copy-on-write invariant):
 Hit/miss counters (per prefill lookup) and the resident-block gauge feed
 the unified metrics registry; `tools/metrics_report.py --compare` treats
 a prefix-hit-rate drop as a failure-class regression.
+
+Multi-tenant namespaces (ISSUE 17): a request's prefix NAMESPACE salts
+every chain key, so two tenants in different namespaces can never share
+a block even for identical prompts — sharing stops at the trust
+boundary, by construction of the key. Eviction is quota-aware:
+`evict(n, requester=...)` drains the requester's OWN namespace's LRU
+leaves first, and a foreign namespace whose resident count sits within
+its quota (`set_quota`) is PROTECTED — a hot tenant's allocation
+pressure can never evict a paying tenant's system prompt. Requests with
+no namespace (and caches with no quotas) behave exactly as before.
 """
 import hashlib
 
@@ -38,7 +48,7 @@ from ..observability import kvledger as _kvl
 from ..observability import metrics as _metrics
 from .blocks import GARBAGE_BLOCK
 
-__all__ = ["PrefixCache", "prefix_key"]
+__all__ = ["PrefixCache", "prefix_key", "DEFAULT_NAMESPACE"]
 
 _M_HITS = _metrics.counter(
     "serving_prefix_cache_hits_total",
@@ -51,11 +61,25 @@ _M_BLOCKS = _metrics.gauge(
 _M_EVICTED = _metrics.counter(
     "serving_prefix_cache_evicted_total",
     "Prefix blocks evicted back to the pool under allocation pressure")
+_M_NS_EVICTED = _metrics.counter(
+    "serving_prefix_ns_evicted_total",
+    "Prefix blocks evicted per namespace under allocation pressure",
+    labelnames=("namespace",))
+
+# the namespace label value of un-namespaced entries — one vocabulary
+# with decisions.DEFAULT_TENANT so single-tenant artifacts grade the same
+DEFAULT_NAMESPACE = "default"
 
 
-def prefix_key(tokens):
-    """Stable content hash of a token prefix (the chain key)."""
+def prefix_key(tokens, namespace=None):
+    """Stable content hash of a token prefix (the chain key). A non-None
+    `namespace` salts the hash FIRST, so namespaced chains live in
+    disjoint key spaces — cross-namespace sharing is impossible, not
+    merely forbidden. namespace=None keys are byte-identical to the
+    pre-tenancy scheme."""
     h = hashlib.sha1()
+    if namespace is not None:
+        h.update(str(namespace).encode("utf-8") + b"\x00")
     for t in tokens:
         h.update(int(t).to_bytes(8, "little", signed=True))
     return h.hexdigest()
@@ -70,6 +94,13 @@ class PrefixCache:
         self._parent = {}         # key -> chain-parent key (None at k=0)
         self._children = {}       # key -> cached direct children count
         self._seq = 0
+        # per-namespace bookkeeping (ISSUE 17): entry ownership, resident
+        # counts, and quotas (resident <= quota protects a namespace from
+        # FOREIGN eviction pressure)
+        self._ns = {}             # key -> namespace (None = unscoped)
+        self._resident = {}       # namespace -> resident entry count
+        self._quotas = {}         # namespace -> quota (blocks)
+        self._ns_evicted = {}     # namespace -> evicted count (report tap)
         # KV attribution ledger (observability.kvledger): the cache
         # emits the SEMANTIC layer — share/cache_insert/cache_evict —
         # and refines the origin of its own pool refs so the shadow
@@ -78,6 +109,40 @@ class PrefixCache:
 
     def attach_ledger(self, ledger):
         self._ledger = ledger
+
+    # -- namespace quotas (ISSUE 17) -----------------------------------------
+    def set_quota(self, namespace, blocks):
+        """Cap + protect `namespace`: while its resident entries stay
+        <= `blocks`, no OTHER namespace's pressure can evict them (its
+        own requests still can). None removes the quota."""
+        if blocks is None:
+            self._quotas.pop(namespace, None)
+        else:
+            self._quotas[namespace] = int(blocks)
+
+    def set_quotas(self, quotas):
+        for ns, blocks in dict(quotas or {}).items():
+            self.set_quota(ns, blocks)
+
+    def resident(self, namespace):
+        """Resident prefix entries owned by `namespace`."""
+        return self._resident.get(namespace, 0)
+
+    def namespace_residents(self):
+        """{namespace-label: resident entries} (None -> "default")."""
+        return {(ns if ns is not None else DEFAULT_NAMESPACE): n
+                for ns, n in self._resident.items() if n}
+
+    def namespace_evictions(self):
+        """{namespace-label: blocks evicted} since construction."""
+        return dict(self._ns_evicted)
+
+    def _protected(self, namespace):
+        """True while `namespace` holds a quota AND sits within it —
+        foreign pressure must not touch it."""
+        quota = self._quotas.get(namespace)
+        return quota is not None and \
+            self._resident.get(namespace, 0) <= quota
 
     def __len__(self):
         return len(self._entries)
@@ -96,7 +161,7 @@ class PrefixCache:
         self._lru[key] = self._seq
 
     # -- lookup --------------------------------------------------------------
-    def match(self, prompt, record=True):
+    def match(self, prompt, record=True, namespace=None):
         """Longest cached block chain covering a strict prefix of
         `prompt`. Returns (block_ids, n_tokens) with one pool reference
         taken per returned block (owned by the caller's table row).
@@ -111,7 +176,7 @@ class PrefixCache:
         usable = (len(prompt) - 1) // bs      # full blocks, 1 token spared
         ids = []
         for k in range(usable):
-            key = prefix_key(prompt[:(k + 1) * bs])
+            key = prefix_key(prompt[:(k + 1) * bs], namespace)
             blk = self._entries.get(key)
             if blk is None:
                 break
@@ -134,7 +199,7 @@ class PrefixCache:
         (_M_HITS if hit else _M_MISSES).inc()
 
     # -- registration --------------------------------------------------------
-    def insert(self, prompt, table_row, upto_tokens):
+    def insert(self, prompt, table_row, upto_tokens, namespace=None):
         """Register the fully-written blocks of `prompt` (logical blocks
         whose every position < upto_tokens) from the request's table row.
         Already-cached chains keep their existing block (the duplicate
@@ -146,7 +211,7 @@ class PrefixCache:
             blk = int(table_row[k])
             if blk == GARBAGE_BLOCK:
                 continue
-            key = prefix_key(prompt[:(k + 1) * bs])
+            key = prefix_key(prompt[:(k + 1) * bs], namespace)
             if key in self._entries:
                 self._touch(key)
                 prev_key = key
@@ -158,6 +223,8 @@ class PrefixCache:
             else:
                 self.pool.ref(blk)
             self._entries[key] = blk
+            self._ns[key] = namespace
+            self._resident[namespace] = self._resident.get(namespace, 0) + 1
             self._parent[key] = prev_key
             if prev_key is not None:
                 self._children[prev_key] = \
@@ -167,15 +234,35 @@ class PrefixCache:
         _M_BLOCKS.set(len(self._entries))
 
     # -- eviction ------------------------------------------------------------
-    def evict(self, n_blocks):
+    def evict(self, n_blocks, requester=None):
         """Free up to n_blocks LRU entries nobody else references
         (refcount == 1: only the cache's own). Eviction is LEAF-first —
         an entry with a cached child is skipped, because `match` walks
         chains from block 0 and an evicted head would orphan its tail
-        (still resident, never matchable again). Returns how many blocks
-        went back to the pool."""
+        (still resident, never matchable again).
+
+        Quota-aware order (ISSUE 17): pass 1 drains the REQUESTER's own
+        namespace; pass 2 reaches into foreign namespaces, but skips any
+        that holds a quota and sits within it — the protection is
+        re-checked per eviction, so an over-quota namespace is drained
+        only down to its quota. With no requester and no quotas, every
+        entry is eligible — the pre-tenancy behavior, bit for bit.
+        Returns how many blocks went back to the pool."""
         if n_blocks <= 0:
             return 0
+        freed = self._evict_pass(n_blocks, lambda ns: ns == requester)
+        if freed < n_blocks:
+            freed += self._evict_pass(
+                n_blocks - freed,
+                lambda ns: ns != requester and not self._protected(ns))
+        if freed:
+            _M_EVICTED.inc(freed)
+            _M_BLOCKS.set(len(self._entries))
+        return freed
+
+    def _evict_pass(self, n_blocks, eligible):
+        """One LRU leaf-first sweep over entries whose namespace passes
+        `eligible` (re-evaluated per eviction — resident counts move)."""
         freed = 0
         progress = True
         while freed < n_blocks and progress:
@@ -183,6 +270,9 @@ class PrefixCache:
             for key in sorted(self._lru, key=self._lru.get):
                 if freed >= n_blocks:
                     break
+                ns = self._ns.get(key)
+                if not eligible(ns):
+                    continue
                 blk = self._entries.get(key)
                 if blk is None or self.pool.refcount(blk) != 1 \
                         or self._children.get(key, 0) > 0:
@@ -203,9 +293,11 @@ class PrefixCache:
                 self._children.pop(key, None)
                 del self._entries[key]
                 del self._lru[key]
+                self._ns.pop(key, None)
+                self._resident[ns] = self._resident.get(ns, 1) - 1
+                label = ns if ns is not None else DEFAULT_NAMESPACE
+                self._ns_evicted[label] = self._ns_evicted.get(label, 0) + 1
+                _M_NS_EVICTED.labels(namespace=label).inc()
                 freed += 1
                 progress = True     # a freed leaf may expose its parent
-        if freed:
-            _M_EVICTED.inc(freed)
-            _M_BLOCKS.set(len(self._entries))
         return freed

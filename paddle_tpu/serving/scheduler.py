@@ -80,7 +80,8 @@ from .blocks import BlockAllocError
 from .engine import _engine_kind
 
 __all__ = ["ServingConfig", "Scheduler", "Request", "RequestHandle",
-           "QueueFullError", "LoadShedError", "PRIORITIES"]
+           "QueueFullError", "LoadShedError", "RateLimitedError",
+           "PRIORITIES"]
 
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
@@ -169,6 +170,16 @@ _M_SWAP_DROPPED = _metrics.counter(
 _M_MODEL_VERSION = _metrics.gauge(
     "serving_model_version",
     "Model version the engine is currently serving (flips on hot-swap)")
+_M_RATE_LIMITED = _metrics.counter(
+    "serving_rate_limited_total",
+    "Requests denied at admission by their tenant's token bucket "
+    "(ISSUE 17) — per-tenant growth is failure-class in "
+    "tools/metrics_report.py", labelnames=("tenant",))
+_M_ADAPTER_SWAPS = _metrics.counter(
+    "serving_adapter_swaps_total",
+    "Per-tenant LoRA adapter hot-swaps applied between decode steps, "
+    "by outcome (a failed swap leaves the tenant's OLD adapter serving)",
+    labelnames=("status",))
 
 
 class QueueFullError(RuntimeError):
@@ -179,6 +190,15 @@ class LoadShedError(QueueFullError):
     """Request shed at admission by the SLO watermark — the system chose
     to fail this (sheddable-class) request fast rather than queue it past
     its useful life. Terminal status SHED."""
+
+
+class RateLimitedError(QueueFullError):
+    """Request denied at admission by its tenant's token bucket (ISSUE
+    17): the request's token cost (prompt + max_new) exceeds what the
+    bucket holds right now. Terminal status SHED with the request
+    record's `rate_limited` flag set; a QueueFullError subclass so
+    existing backpressure handlers (retry / count-and-move-on) keep
+    working unchanged."""
 
 
 class ServingConfig:
@@ -220,6 +240,13 @@ class Request:
         # construction: neither value reaches the engine.
         self.tenant = str(tenant) if tenant else _dec.DEFAULT_TENANT
         self.cohort = str(cohort) if cohort else None
+        # multi-tenant serving (ISSUE 17): the adapter the tenant's
+        # decode runs under (None = base weights), the prefix-cache
+        # namespace its blocks live in (None = the shared unscoped
+        # space), and whether admission denied it by token bucket
+        self.adapter_id = None
+        self.prefix_namespace = None
+        self.rate_limited = False
         # per-request sampler RNG (ISSUE 13): generation index n samples
         # with fold_in(key(rng_seed), rng_gen + n) whatever slot/engine/
         # host runs it. rng_gen > 0 means tokens 0..rng_gen-1 were
@@ -309,6 +336,22 @@ class RequestHandle:
         return self._req.cohort
 
     @property
+    def rate_limited(self):
+        """Whether admission denied this request by token bucket
+        (ISSUE 17; terminal status SHED with this flag set)."""
+        return self._req.rate_limited
+
+    @property
+    def adapter_id(self):
+        """The LoRA adapter this request decoded under (None = base)."""
+        return self._req.adapter_id
+
+    @property
+    def prefix_namespace(self):
+        """The prefix-cache namespace the request's blocks live in."""
+        return self._req.prefix_namespace
+
+    @property
     def preempted(self):
         """How many times the request was evicted and requeued."""
         return self._req.preempted
@@ -362,9 +405,23 @@ class RequestHandle:
 
 
 class Scheduler:
-    def __init__(self, engine, config=None, clock=time.monotonic, **kwargs):
+    def __init__(self, engine, config=None, clock=time.monotonic,
+                 tenancy=None, **kwargs):
         self.engine = engine
         self.config = config or ServingConfig(**kwargs)
+        # multi-tenant serving (ISSUE 17): `tenancy` is a
+        # tenancy.TenancyConfig — per-tenant token buckets gate
+        # admission AHEAD of the shed/preempt machinery, per-namespace
+        # resident-block quotas arm the prefix cache's protected
+        # eviction, and placement binds each slot to its tenant's
+        # adapter + namespace. tenancy=None is the pre-tenancy
+        # scheduler, bit for bit.
+        self._tenancy = tenancy
+        self._buckets = tenancy.buckets(clock) if tenancy is not None \
+            else {}
+        cache = getattr(engine, "prefix_cache", None)
+        if tenancy is not None and cache is not None:
+            cache.set_quotas(tenancy.quotas())
         # engine kind (ISSUE 14): labels the spec proposed/accepted
         # counters and the run record, so a fleet mixing spec and
         # spec_pp engines gates each acceptance rate separately.
@@ -388,6 +445,8 @@ class Scheduler:
         self._capture = None                  # armed decode-step capture
         self.last_capture = None              # finalize() summary block
         self._pending_swaps = collections.deque()   # armed hot-swaps
+        self._pending_adapter_swaps = collections.deque()
+        self.last_adapter_swap = None
         self._swap_probation = False          # first step after a swap
         self.last_swap = None                 # apply_pending_swap summary
         self.model_version = None
@@ -454,15 +513,17 @@ class Scheduler:
         self._metrics_f.flush()
 
     # -- the decision audit log (ISSUE 15) -----------------------------------
-    def _decide(self, action, req, inputs, outcome):
+    def _decide(self, action, req, inputs, outcome, tenant=None):
         """Append one decisions.v1 record (in memory + the serving
         JSONL): the decision's inputs make it reproducible via the
         paddle_tpu.observability.decisions replay rules — the same code
-        that just made it."""
+        that just made it. `tenant` overrides the label for decisions
+        with no Request context (adapter swaps)."""
         rec = _dec.build_record(
             action, inputs, outcome, "scheduler", self._clock(),
             request_id=getattr(req, "id", None),
-            tenant=getattr(req, "tenant", None),
+            tenant=tenant if tenant is not None
+            else getattr(req, "tenant", None),
             cohort=getattr(req, "cohort", None),
             trace_id=getattr(req, "trace_id", None))
         self._decisions.append(rec)
@@ -492,7 +553,8 @@ class Scheduler:
     # -- admission -----------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, timeout_s=None,
                priority="standard", staged_kv=None, rng_seed=None,
-               rng_gen=0, tenant=None, cohort=None):
+               rng_gen=0, tenant=None, cohort=None, adapter_id=None,
+               prefix_namespace=None):
         """`staged_kv=(ks, vs, plen, first_token[, rng])` places the
         request from a handed-off KV bundle (another host already ran
         its prefill) instead of computing prefill locally — `prompt`
@@ -516,7 +578,18 @@ class Scheduler:
         `tenant`/`cohort` (ISSUE 15) label the request for attribution:
         metrics labelsets, the decision audit log, timeline records and
         profiler spans all carry them; the engine never sees either, so
-        labeled and unlabeled traffic decode bit-identically."""
+        labeled and unlabeled traffic decode bit-identically.
+
+        `adapter_id`/`prefix_namespace` (ISSUE 17) pin the LoRA adapter
+        the request decodes under and the prefix-cache namespace its
+        blocks live in — wire pass-throughs for the distributed worker;
+        local callers usually leave both None and let the scheduler's
+        TenancyConfig resolve them from the tenant label. With a
+        tenancy config, admission ALSO runs the tenant's token bucket
+        BEFORE the shed watermark: a request costing more tokens
+        (prompt + max_new) than the bucket holds raises
+        RateLimitedError, ticks serving_rate_limited_total{tenant}, and
+        leaves a replayable rate_limit decision record."""
         prompt = [int(t) for t in prompt]
         now = self._clock()
         max_new = self.config.default_max_new_tokens \
@@ -536,6 +609,10 @@ class Scheduler:
         if req.rng_seed is None:
             req.rng_seed = (getattr(self.engine.config, "seed", 0)
                             * 1000003 + req.id * 7919 + 1) & 0x7FFFFFFF
+        req.adapter_id = str(adapter_id) if adapter_id else None
+        req.prefix_namespace = prefix_namespace if prefix_namespace \
+            is not None else (self._tenancy.namespace_of(req.tenant)
+                              if self._tenancy is not None else None)
         handle = RequestHandle(req, self._clock)
         if self._draining:
             self._finish(req, REJECTED, "serving.rejected")
@@ -558,6 +635,28 @@ class Scheduler:
                 f"exceeds the engine limits (max prompt "
                 f"{self.engine.max_prompt_len}, cache max_len "
                 f"{self.engine.config.max_len})")
+        # per-tenant token bucket (ISSUE 17) — AHEAD of the shed
+        # watermark: a budget denial is the tenant's own contract, not
+        # system pressure, so it must not depend on queue state. The
+        # live verdict IS decisions.replay_rate_limit over the recorded
+        # inputs — the validator re-runs the same rule on every artifact.
+        bucket = self._buckets.get(req.tenant)
+        if bucket is not None:
+            cost = len(prompt) + max_new
+            rl_inputs = {"tenant": req.tenant, "cost": cost,
+                         "tokens_available": bucket.available(),
+                         "rate_per_s": bucket.rate,
+                         "burst": bucket.burst}
+            rl_why = _dec.replay_rate_limit(rl_inputs)
+            if rl_why:
+                req.rate_limited = True
+                _M_RATE_LIMITED.labels(tenant=req.tenant).inc()
+                self._decide("rate_limit", req, rl_inputs,
+                             {"reason": rl_why})
+                self._finish(req, SHED, "serving.shed")
+                raise RateLimitedError(
+                    f"rate limited (tenant {req.tenant}): {rl_why}")
+            bucket.take(cost)
         shed_inputs = self._shed_inputs(prio)
         shed_why = _dec.replay_shed(shed_inputs)
         if shed_why:
@@ -729,9 +828,66 @@ class Scheduler:
             swap["event"].swap_result = dict(self.last_swap)
             swap["event"].set()
 
+    # -- per-tenant adapter hot-swap (ISSUE 17) ------------------------------
+    def schedule_adapter_swap(self, tenant, state):
+        """Arm a per-tenant LoRA adapter hot-swap: `state` (a
+        tenancy.AdapterState, e.g. AdapterRegistry.resolve's result)
+        replaces `tenant`'s adapter at the TOP of the next step —
+        strictly BETWEEN decode steps, the weight-swap window, so every
+        emitted token is computed wholly under one adapter version.
+        Same atomic-failure contract as schedule_weight_swap: a failed
+        swap (bank validation, or the `serving.adapter_swap` chaos
+        site) leaves the tenant's OLD adapter serving and every other
+        tenant untouched — base weights are never involved. Returns a
+        threading.Event set once applied or rejected; the outcome lands
+        in `self.last_adapter_swap`, the event's `swap_result`, and
+        `serving_adapter_swaps_total{status}`."""
+        ev = threading.Event()
+        self._pending_adapter_swaps.append(
+            {"tenant": str(tenant), "state": state, "event": ev})
+        return ev
+
+    def apply_pending_adapter_swap(self):
+        """Apply every armed adapter swap now, in arrival order (called
+        at the top of every step()). Returns True when at least one
+        swap was processed."""
+        applied = False
+        while True:
+            try:
+                swap = self._pending_adapter_swaps.popleft()
+            except IndexError:
+                return applied
+            applied = True
+            with RecordEvent("serving::adapter_swap",
+                             TracerEventType.UserDefined,
+                             {"tenant": swap["tenant"],
+                              "inflight": self.active_slots()}):
+                try:
+                    idx = self.engine.swap_adapter(swap["tenant"],
+                                                   swap["state"])
+                except Exception as e:                   # noqa: BLE001
+                    _M_ADAPTER_SWAPS.labels(status="failed").inc()
+                    self.last_adapter_swap = {
+                        "ok": False, "tenant": swap["tenant"],
+                        "error": f"{type(e).__name__}: {e}"}
+                else:
+                    _M_ADAPTER_SWAPS.labels(status="ok").inc()
+                    self.last_adapter_swap = {
+                        "ok": True, "tenant": swap["tenant"],
+                        "slot": idx,
+                        "inflight": self.active_slots()}
+            self._decide("swap", None,
+                         {"kind": "adapter", "tenant": swap["tenant"],
+                          "inflight": self.active_slots()},
+                         dict(self.last_adapter_swap),
+                         tenant=swap["tenant"])
+            swap["event"].swap_result = dict(self.last_adapter_swap)
+            swap["event"].set()
+
     def step(self):
         """One scheduling iteration. Returns True while work remains."""
         self.apply_pending_swap()
+        self.apply_pending_adapter_swap()
         now = self._clock()
         self._expire_queued(now)
         self._retire(now)
@@ -1112,6 +1268,7 @@ class Scheduler:
         dropped and the attempt falls back to local prefill in place —
         a rotted bundle degrades to recompute, never to a failed
         request. BlockAllocError always escapes (the caller preempts)."""
+        self._bind_slot_tenancy(slot, req)
         staged = req._staged
         if staged is None:
             req.trail.begin(_rt.PH_PREFILL, self._clock())
@@ -1148,19 +1305,41 @@ class Scheduler:
         _M_ADOPTED.inc()
         return first
 
+    def _bind_slot_tenancy(self, slot, req):
+        """Bind the slot to the request's adapter before placement
+        (ISSUE 17): the tenant's bank row if one is loaded, else slot 0
+        (base weights — also what non-tenant traffic always gets). A
+        host int32 write per placement; engines without a bank skip it
+        entirely."""
+        bank = getattr(self.engine, "adapter_bank", None)
+        if bank is None:
+            return
+        aid = req.adapter_id if req.adapter_id is not None else req.tenant
+        idx = bank.slot_of(aid)
+        self.engine.set_slot_adapter(slot, idx)
+        if idx and req.adapter_id is None:
+            req.adapter_id = aid
+
     def _engine_prefill(self, slot, req):
         """Prefill with the request's sampler state at THIS placement:
         its next token is generation index base + tokens-already-
         delivered (preempt restarts fold the delivered run into
         exec_prompt). Engines without per-slot RNG (minimal stubs) get
         the plain call — the capability probe mirrors the adopt_kv
-        one."""
+        one. The request's prefix namespace rides into the engine's
+        prefix-cache keying (only when set — stub engines never see the
+        kwarg)."""
+        kwargs = {}
+        if req.prefix_namespace is not None:
+            kwargs["namespace"] = req.prefix_namespace
         with self._kv_attr(req, "prefill"):
             if not hasattr(self.engine, "set_slot_rng"):
-                return self.engine.prefill(slot, req.exec_prompt)
+                return self.engine.prefill(slot, req.exec_prompt,
+                                           **kwargs)
             return self.engine.prefill(
                 slot, req.exec_prompt,
-                rng=(req.rng_seed, req.rng_gen + len(req.tokens)))
+                rng=(req.rng_seed, req.rng_gen + len(req.tokens)),
+                **kwargs)
 
     def _try_place(self, slot, req):
         """Prefill `req` into `slot`. Allocation pressure preempts a
@@ -1341,6 +1520,10 @@ class Scheduler:
             "kind": "request", "request_id": req.id, "status": req.status,
             "tenant": req.tenant,
             **({"cohort": req.cohort} if req.cohort else {}),
+            **({"adapter_id": req.adapter_id} if req.adapter_id else {}),
+            **({"prefix_namespace": str(req.prefix_namespace)}
+               if req.prefix_namespace is not None else {}),
+            **({"rate_limited": True} if req.rate_limited else {}),
             "prompt_len": len(req.prompt), "tokens": len(req.tokens),
             "priority": req.priority, "preempted": req.preempted,
             "prefix_hit": req.prefix_hit, "adopted": req.adopted,

@@ -285,10 +285,16 @@ class SpeculativeEngine(PagedGenerationEngine):
         nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
         return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
 
-    def _spec_verify_fn(self, params, pool, tables, pos, window):
+    def _spec_verify_fn(self, params, pool, tables, pos, window, *extra):
         self.trace_counts["spec_verify"] += 1      # trace-time only
+        # per-tenant adapters (ISSUE 17) ride the VERIFY forward — the
+        # target chooses every emitted token (greedy_verify emits the
+        # target's choices), so adapted output is exact; the draft stays
+        # base and only pays in acceptance rate on adapted slots
+        adapters, _ = self._split_extra(extra)
         logits, npool = self._run_model_paged(
-            self._dequant_params(params), pool, tables, pos, window)
+            self._dequant_params(params), pool, tables, pos, window,
+            adapters=adapters)
         npool = self._constrain_pools(npool)
         choices, n_acc, last = sampling.greedy_verify(logits, window)
         # advance by accepted+1; rejected-tail K/V stays beyond pos,
@@ -351,7 +357,8 @@ class SpeculativeEngine(PagedGenerationEngine):
             out["spec_verify"] = self._spec_verify.warm(
                 self._decode_params, self._pool,
                 jnp.asarray(self._tables), jnp.asarray(self._pos),
-                jnp.zeros((c.slots, c.gamma + 1), jnp.int32))
+                jnp.zeros((c.slots, c.gamma + 1), jnp.int32),
+                *self._adapter_args())
         for b in c.prefill_buckets:
             if b not in self._draft_prefill:
                 self._draft_prefill[b] = self._make_draft_prefill(b)
@@ -361,13 +368,14 @@ class SpeculativeEngine(PagedGenerationEngine):
         return out
 
     # -- public compute API --------------------------------------------------
-    def prefill(self, slot, prompt_ids, rng=None):
+    def prefill(self, slot, prompt_ids, rng=None, namespace=None):
         """Target prefill (prefix cache, suffix bucket, first token) plus
         the draft prefill of the FULL prompt into its dense cache — the
         draft has no prefix sharing, so its bucket is over the whole
         prompt length. Draft state moves only after the target prefill
         sticks, so an allocation failure leaves both sides untouched."""
-        first = super().prefill(slot, prompt_ids, rng=rng)
+        first = super().prefill(slot, prompt_ids, rng=rng,
+                                namespace=namespace)
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         bucket = self.bucket_for(prompt.size)
         padded = np.zeros((bucket,), np.int32)
@@ -443,7 +451,8 @@ class SpeculativeEngine(PagedGenerationEngine):
                 blocks.attention_impl(c.attention_impl):
             choices, n_acc, last, pool, pos = self._spec_verify(
                 self._decode_params, self._pool,
-                jnp.asarray(self._tables), jnp.asarray(self._pos), window)
+                jnp.asarray(self._tables), jnp.asarray(self._pos), window,
+                *self._adapter_args())
         verify_s = time.perf_counter() - t1
         _M_VERIFY_SECONDS.observe(verify_s)
         self._pool = pool

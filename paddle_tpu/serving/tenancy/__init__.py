@@ -1,0 +1,15 @@
+"""Multi-tenant serving subsystem (ISSUE 17): per-tenant LoRA adapters
+gathered by slot inside the one decode executable, an adapter registry
+over the ckpt_commit protocol, prefix-cache namespaces with quota-aware
+eviction, and token-budget rate limiting ahead of the scheduler's
+shed/preempt machinery. See docs/serving.md (multi-tenant section)."""
+from .adapters import (AdapterBank, AdapterState, TARGETS,  # noqa: F401
+                       init_adapter_state, lora_apply, lora_delta,
+                       target_dims)
+from .limits import TenancyConfig, TenantSpec, TokenBucket  # noqa: F401
+from .registry import AdapterRegistry  # noqa: F401
+
+__all__ = ["AdapterBank", "AdapterState", "AdapterRegistry", "TARGETS",
+           "TenancyConfig", "TenantSpec", "TokenBucket",
+           "init_adapter_state", "lora_apply", "lora_delta",
+           "target_dims"]

@@ -1,0 +1,237 @@
+"""Per-tenant LoRA adapters for the serving engines (ISSUE 17).
+
+Multi-tenant serving wants per-tenant model behavior without per-tenant
+executables: tenant T's requests should decode through base weights plus
+T's low-rank delta, while a batch mixing tenants still runs the ONE
+compiled decode step (docs/serving.md compile-once contract). The layout
+that squares this:
+
+  - every adapted matmul target (qkv / out_proj / fc1 / fc2 per layer)
+    holds its deltas STACKED over adapter slots:
+        A: [n_slots, d_in, r]     B: [n_slots, r, d_out]
+    slot 0 is permanently zero — the base model. Loading, updating or
+    dropping a tenant's adapter changes array VALUES, never shapes.
+  - each engine slot (batch row) carries an int32 adapter-slot id; the
+    decode trace gathers its row's delta BY SLOT:
+        delta = (x @ A[ids]) @ B[ids]        # einsum over the slot axis
+    so tenant mixing is data, not program structure. One trace covers
+    every assignment of tenants to rows, including all-base (ids == 0).
+  - the alpha/r scaling is folded into B at load time, so the trace is
+    two einsums with no per-slot scalars.
+
+Adapters ride the decode executable as trailing runtime arguments
+(mirroring the rng-args convention in `serving/engine.py`): an engine
+with no bank attached passes NOTHING extra — its traces, avals and
+compiled programs are bit-identical to an adapter-free build.
+
+Ranks may differ per tenant: the bank is allocated at its max rank and
+lower-rank adapters are zero-padded (padded rows/columns contribute
+exactly zero to the delta).
+
+Prefill runs base weights only — adapters are a DECODE-path feature,
+like int8 weight quantization (`weight_dtype="int8"`). Prefill is
+compute-bound and runs once per request; decode dominates a served
+token's lifetime, so that is where per-tenant behavior pays.
+"""
+import numpy as np
+
+__all__ = ["TARGETS", "target_dims", "lora_delta", "lora_apply",
+           "AdapterState", "init_adapter_state", "AdapterBank"]
+
+# the decode matmuls that take a delta, in model order
+TARGETS = ("qkv", "out_proj", "fc1", "fc2")
+
+
+def target_dims(cfg):
+    """{target: (d_in, d_out)} for a GPTConfig-shaped config."""
+    h = int(cfg.hidden_size)
+    m = int(cfg.intermediate_size)
+    return {"qkv": (h, 3 * h), "out_proj": (h, h),
+            "fc1": (h, m), "fc2": (m, h)}
+
+
+def lora_delta(x, a, b, ids):
+    """The gather-by-slot low-rank delta, jnp level.
+
+    x [S, T, d_in] (S engine slots, T tokens per slot — 1 for plain
+    decode, gamma+1 for speculative verify), a [n_slots, d_in, r],
+    b [n_slots, r, d_out] (alpha/r pre-folded), ids int32 [S].
+    Returns [S, T, d_out]."""
+    import jax.numpy as jnp
+    asel = jnp.take(a, ids, axis=0)          # [S, d_in, r]
+    bsel = jnp.take(b, ids, axis=0)          # [S, r, d_out]
+    mid = jnp.einsum("std,sdr->str", x.astype(asel.dtype), asel)
+    return jnp.einsum("str,sro->sto", mid, bsel)
+
+
+def lora_apply(y, x, view, name):
+    """Add `name`'s delta to base output `y` (Tensor) given input `x`
+    (Tensor) and a per-layer adapter view {"slot": ids, name: (a, b),
+    ...}. Missing targets pass through unchanged."""
+    pair = None if view is None else view.get(name)
+    if pair is None:
+        return y
+    from ...core.tensor import apply_op
+    a, b = pair
+    ids = view["slot"]
+    return apply_op(
+        lambda yy, xx: yy + lora_delta(xx, a, b, ids).astype(yy.dtype),
+        y, x)
+
+
+class AdapterState:
+    """One tenant's adapter payload: {f"layers.{i}.{target}.{a|b}":
+    np.ndarray} plus rank/alpha. The flat tensor dict is exactly what
+    `distributed.checkpoint.save_state_dict` persists (the registry's
+    ckpt_commit path), with alpha riding as a 0-d array."""
+
+    def __init__(self, tensors, rank, alpha=None):
+        self.tensors = dict(tensors)
+        self.rank = int(rank)
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+
+    def to_state_dict(self):
+        d = {k: np.asarray(v) for k, v in self.tensors.items()}
+        d["alpha"] = np.asarray(self.alpha, np.float64)
+        d["rank"] = np.asarray(self.rank, np.int64)
+        return d
+
+    @classmethod
+    def from_state_dict(cls, d):
+        tensors = {k: np.asarray(v) for k, v in d.items()
+                   if k not in ("alpha", "rank")}
+        if "rank" in d:
+            rank = int(np.asarray(d["rank"]))
+        else:
+            ranks = {v.shape[-1] for k, v in tensors.items()
+                     if k.endswith(".a")}
+            if len(ranks) != 1:
+                raise ValueError(f"adapter state has ambiguous rank {ranks}")
+            rank = ranks.pop()
+        alpha = float(np.asarray(d["alpha"])) if "alpha" in d else None
+        return cls(tensors, rank, alpha)
+
+
+def init_adapter_state(cfg, rank, seed=0, targets=TARGETS, scale=0.01,
+                       alpha=None):
+    """A random adapter for tests and the load harness: A ~ N(0, scale),
+    B ~ N(0, scale) — deliberately NON-zero in B so the delta is visible
+    in logits (training init would zero B; here we want observable
+    per-tenant divergence)."""
+    rng = np.random.default_rng(seed)
+    dims = target_dims(cfg)
+    tensors = {}
+    for i in range(int(cfg.num_layers)):
+        for t in targets:
+            din, dout = dims[t]
+            tensors[f"layers.{i}.{t}.a"] = \
+                rng.normal(0.0, scale, (din, rank)).astype(np.float32)
+            tensors[f"layers.{i}.{t}.b"] = \
+                rng.normal(0.0, scale, (rank, dout)).astype(np.float32)
+    return AdapterState(tensors, rank, alpha)
+
+
+class AdapterBank:
+    """Host-side master of the stacked per-slot adapter arrays plus the
+    tenant -> adapter-slot assignment. The engine mirrors the masters to
+    device via `device_tree()` after every mutation (attach / swap);
+    mutations are validate-ALL-then-write so a failed load leaves every
+    row — including the loading tenant's previous adapter — untouched."""
+
+    def __init__(self, cfg, n_adapters, rank, targets=TARGETS,
+                 dtype=np.float32):
+        if n_adapters < 2:
+            raise ValueError("n_adapters must be >= 2 (slot 0 is base)")
+        self.num_layers = int(cfg.num_layers)
+        self.n_adapters = int(n_adapters)
+        self.rank = int(rank)
+        self.targets = tuple(targets)
+        self.dims = {t: target_dims(cfg)[t] for t in self.targets}
+        self._a = {}
+        self._b = {}
+        for i in range(self.num_layers):
+            for t in self.targets:
+                din, dout = self.dims[t]
+                self._a[(i, t)] = np.zeros(
+                    (self.n_adapters, din, self.rank), dtype)
+                self._b[(i, t)] = np.zeros(
+                    (self.n_adapters, self.rank, dout), dtype)
+        self._tenants = {}            # tenant -> adapter slot (>= 1)
+        self.version = 0
+
+    def slot_of(self, tenant):
+        """The tenant's adapter slot; 0 (base) when none is loaded."""
+        return self._tenants.get(tenant, 0)
+
+    def tenants(self):
+        return dict(self._tenants)
+
+    def _stage(self, state):
+        """Validate `state` against the bank layout and return the fully
+        padded/folded per-key rows — no bank mutation."""
+        if state.rank > self.rank:
+            raise ValueError(f"adapter rank {state.rank} exceeds bank "
+                             f"rank {self.rank}")
+        scale = state.alpha / float(state.rank)
+        staged = {}
+        for i in range(self.num_layers):
+            for t in self.targets:
+                din, dout = self.dims[t]
+                ka, kb = f"layers.{i}.{t}.a", f"layers.{i}.{t}.b"
+                if ka not in state.tensors or kb not in state.tensors:
+                    raise ValueError(f"adapter state missing {ka}/{kb}")
+                a = np.asarray(state.tensors[ka])
+                b = np.asarray(state.tensors[kb])
+                if a.shape != (din, state.rank) or \
+                        b.shape != (state.rank, dout):
+                    raise ValueError(
+                        f"adapter {ka}/{kb} shapes {a.shape}/{b.shape} "
+                        f"!= ({din},{state.rank})/({state.rank},{dout})")
+                pa = np.zeros((din, self.rank), self._a[(i, t)].dtype)
+                pb = np.zeros((self.rank, dout), self._b[(i, t)].dtype)
+                pa[:, :state.rank] = a
+                # fold alpha/rank into B so the trace is two bare einsums
+                pb[:state.rank, :] = b * scale
+                staged[(i, t)] = (pa, pb)
+        return staged
+
+    def load(self, tenant, state):
+        """Load/replace `tenant`'s adapter. Validates everything before
+        writing a single row; returns the tenant's adapter slot."""
+        staged = self._stage(state)
+        idx = self._tenants.get(tenant)
+        if idx is None:
+            used = set(self._tenants.values())
+            idx = next((k for k in range(1, self.n_adapters)
+                        if k not in used), None)
+            if idx is None:
+                raise ValueError(
+                    f"adapter bank full ({self.n_adapters - 1} slots)")
+        for (i, t), (pa, pb) in staged.items():
+            self._a[(i, t)][idx] = pa
+            self._b[(i, t)][idx] = pb
+        self._tenants[tenant] = idx
+        self.version += 1
+        return idx
+
+    def drop(self, tenant):
+        """Forget `tenant`'s adapter (row zeroed; slot reusable)."""
+        idx = self._tenants.pop(tenant, None)
+        if idx is not None:
+            for i in range(self.num_layers):
+                for t in self.targets:
+                    self._a[(i, t)][idx] = 0.0
+                    self._b[(i, t)][idx] = 0.0
+            self.version += 1
+        return idx
+
+    def device_tree(self):
+        """{"layers": (per-layer {target: (a, b)} dicts, ...)} of device
+        arrays — the pytree the decode executable takes as an argument."""
+        import jax.numpy as jnp
+        layers = []
+        for i in range(self.num_layers):
+            layers.append({t: (jnp.asarray(self._a[(i, t)]),
+                               jnp.asarray(self._b[(i, t)]))
+                           for t in self.targets})
+        return {"layers": tuple(layers)}

@@ -48,7 +48,15 @@ class GPTAttention(Layer):
         self.out_proj.weight.split_axis = 0  # row-parallel over mp
         self.dropout = cfg.attention_dropout
 
-    def forward(self, x, cache=None, pos=None, tables=None, valid=None):
+    def _proj(self, out, adapters):
+        proj = self.out_proj(out)
+        if adapters is not None:
+            from ...serving.tenancy.adapters import lora_apply
+            proj = lora_apply(proj, out, adapters, "out_proj")
+        return proj
+
+    def forward(self, x, cache=None, pos=None, tables=None, valid=None,
+                adapters=None):
         """Train/prefill-uncached path when cache is None. With a
         `serving.kv_cache.LayerKV` cache (+ per-slot `pos`), the projected
         k/v are written into the preallocated buffers at pos via
@@ -60,9 +68,15 @@ class GPTAttention(Layer):
         through the block table — same avals forever, same compile-once
         property. `valid` (quantized pools only) is the per-slot count
         of REAL tokens in this write — bucket padding must not ride the
-        block scales."""
+        block scales. `adapters` (decode only) is this layer's per-slot
+        LoRA view {"slot": ids, "qkv": (a, b), "out_proj": (a, b)} —
+        deltas gathered BY SLOT so mixed-tenant batches keep one trace
+        (serving/tenancy/adapters.py)."""
         B, S, H = x.shape
         qkv = self.qkv(x)  # B,S,3H
+        if adapters is not None:
+            from ...serving.tenancy.adapters import lora_apply
+            qkv = lora_apply(qkv, x, adapters, "qkv")
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # B,S,h,d
         if cache is not None and tables is not None:
@@ -84,7 +98,7 @@ class GPTAttention(Layer):
                 out = apply_op(attend, q, k_pool, v_pool, k_sc, v_sc,
                                tables, pos)
                 out = out.reshape([B, S, H])
-                return self.out_proj(out), _blk.QuantPagedLayerKV(
+                return self._proj(out, adapters), _blk.QuantPagedLayerKV(
                     k_pool, v_pool, k_sc, v_sc)
             k_pool = apply_op(_blk.write, cache.k, k, tables, pos)
             v_pool = apply_op(_blk.write, cache.v, v, tables, pos)
@@ -95,14 +109,15 @@ class GPTAttention(Layer):
             attend = _blk.attend_kernel if kernel else _blk.attend
             out = apply_op(attend, q, k_pool, v_pool, tables, pos)
             out = out.reshape([B, S, H])
-            return self.out_proj(out), _blk.PagedLayerKV(k_pool, v_pool)
+            return self._proj(out, adapters), _blk.PagedLayerKV(k_pool,
+                                                                v_pool)
         if cache is not None:
             from ...serving import kv_cache as _kvc
             k_buf = apply_op(_kvc.write, cache.k, k, pos)
             v_buf = apply_op(_kvc.write, cache.v, v, pos)
             out = apply_op(_kvc.attend, q, k_buf, v_buf, pos)
             out = out.reshape([B, S, H])
-            return self.out_proj(out), _kvc.LayerKV(k_buf, v_buf)
+            return self._proj(out, adapters), _kvc.LayerKV(k_buf, v_buf)
         out = F.scaled_dot_product_attention(
             q, k, v, dropout_p=self.dropout, is_causal=True,
             training=self.training)
@@ -121,8 +136,17 @@ class GPTMLP(Layer):
         self.fc2.weight.split_axis = 0
         self.act = GELU(approximate=True)
 
-    def forward(self, x):
-        return self.fc2(self.act(self.fc1(x)))
+    def forward(self, x, adapters=None):
+        h = self.fc1(x)
+        if adapters is not None:
+            from ...serving.tenancy.adapters import lora_apply
+            h = lora_apply(h, x, adapters, "fc1")
+        mid = self.act(h)
+        y = self.fc2(mid)
+        if adapters is not None:
+            from ...serving.tenancy.adapters import lora_apply
+            y = lora_apply(y, mid, adapters, "fc2")
+        return y
 
 
 class GPTBlock(Layer):
@@ -134,13 +158,14 @@ class GPTBlock(Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, cache=None, pos=None, tables=None, valid=None):
+    def forward(self, x, cache=None, pos=None, tables=None, valid=None,
+                adapters=None):
         if cache is not None:
             attn_out, new_cache = self.attn(self.ln1(x), cache=cache,
                                             pos=pos, tables=tables,
-                                            valid=valid)
+                                            valid=valid, adapters=adapters)
             x = x + self.dropout(attn_out)
-            x = x + self.dropout(self.mlp(self.ln2(x)))
+            x = x + self.dropout(self.mlp(self.ln2(x), adapters=adapters))
             return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x)))
         x = x + self.dropout(self.mlp(self.ln2(x)))
@@ -182,7 +207,7 @@ class GPT(Layer):
             tuple(_kvc.LayerKV(Tensor(l.k), Tensor(l.v)) for l in raw.layers),
             Tensor(raw.pos))
 
-    def forward(self, input_ids, cache=None):
+    def forward(self, input_ids, cache=None, adapters=None):
         B, S = input_ids.shape
         from ...tensor.creation import arange
         if cache is not None:
@@ -199,9 +224,11 @@ class GPT(Layer):
                 pos, input_ids)
             x = self.drop(self.wte(input_ids) + self.wpe(positions))
             new_layers = []
-            for blk, lkv in zip(self.blocks, cache.layers):
+            for i, (blk, lkv) in enumerate(zip(self.blocks, cache.layers)):
+                lv = None if adapters is None else \
+                    {"slot": adapters["slot"], **adapters["layers"][i]}
                 x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables,
-                                 valid=valid)
+                                 valid=valid, adapters=lv)
                 new_layers.append(new_lkv)
             logits = self._head(self.ln_f(x))
             if tables is not None:
@@ -282,7 +309,7 @@ class GPTStage(Layer):
                         x, w)
 
     def forward(self, x, cache=None, pos=None, tables=None, valid=None,
-                op="block"):
+                op="block", adapters=None):
         if op == "head":
             return self._head(self.ln_f(x))
         if self.is_first:
@@ -291,9 +318,13 @@ class GPTStage(Layer):
                 + jnp.arange(ids.shape[1], dtype=jnp.int32), pos, x)
             x = self.drop(self.wte(x) + self.wpe(positions))
         new_layers = []
-        for blk, lkv in zip(self.blocks, cache.layers):
+        for i, (blk, lkv) in enumerate(zip(self.blocks, cache.layers)):
+            # `adapters["layers"]` is already THIS stage's slice — the
+            # engine shards the bank with the stage (distributed/pp.py)
+            lv = None if adapters is None else \
+                {"slot": adapters["slot"], **adapters["layers"][i]}
             x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables,
-                             valid=valid)
+                             valid=valid, adapters=lv)
             new_layers.append(new_lkv)
         if op == "block_head":
             return self._head(self.ln_f(x)), tuple(new_layers)

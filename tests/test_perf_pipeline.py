@@ -731,6 +731,51 @@ def test_metrics_compare_tenant_membership_and_per_tenant_rules(tmp_path):
     assert "serving_shed_total{tenant=b}" in bad.stdout
 
 
+def test_metrics_compare_flags_rate_limit_and_ns_eviction_growth(tmp_path):
+    """ISSUE 17 gate, through the CLI: serving_rate_limited_total{tenant}
+    and serving_prefix_ns_evicted_total{namespace} growth are
+    failure-class. Membership intersection covers BOTH label dimensions:
+    a tenant (or namespace) present in only one run is skipped — churn in
+    the tenant roster must not read as counters appearing from zero."""
+    a = _snapshot_with_labeled({
+        "serving_rate_limited_total": [({"tenant": "a"}, 1.0),
+                                       ({"tenant": "b"}, 1.0)],
+        "serving_prefix_ns_evicted_total": [({"namespace": "ns-a"}, 2.0)],
+        "serving_tokens_total": [({}, 1000.0)]})
+    b = _snapshot_with_labeled({
+        "serving_rate_limited_total": [({"tenant": "a"}, 1.0),
+                                       ({"tenant": "b"}, 40.0),
+                                       ({"tenant": "c"}, 99.0)],
+        "serving_prefix_ns_evicted_total": [({"namespace": "ns-a"}, 30.0),
+                                            ({"namespace": "ns-new"}, 50.0)],
+        "serving_tokens_total": [({}, 1000.0)]})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_rate_limited_total{tenant=b}") == \
+        "failure counter grew"
+    assert why.get("serving_prefix_ns_evicted_total{namespace=ns-a}") == \
+        "failure counter grew"
+    keys = list(why)
+    # the regressors fire on exactly the member that regressed...
+    assert not any("tenant=a" in k for k in keys)
+    # ...and roster churn (tenant c / ns-new exist only in B) is skipped
+    assert not any("tenant=c" in k for k in keys), keys
+    assert not any("ns-new" in k for k in keys), keys
+    assert metrics_report.compare_counters(a, a) == []
+    # the CLI exit code reflects the gate and names the labeled series
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_rate_limited_total{tenant=b}" in bad.stdout
+    assert "serving_prefix_ns_evicted_total{namespace=ns-a}" in bad.stdout
+
+
 @pytest.mark.slow
 def test_bench_serve_dist_emits_fleet_artifacts(tmp_path):
     """ISSUE 12 CI: `bench.py --serve-dist` leaves the fleet

@@ -54,7 +54,7 @@ if _TOOLS not in sys.path:
 import serve_report  # noqa: E402  (sibling tool: shared percentile calc)
 
 __all__ = ["TrafficConfig", "VirtualClock", "synth_trace", "replay",
-           "build_engine", "run_harness", "percentile"]
+           "build_engine", "build_tenancy", "run_harness", "percentile"]
 
 
 class TrafficConfig:
@@ -180,15 +180,17 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
     scheduler's clock passes each item's arrival time; sheds/rejections
     are tallied, everything else runs to a terminal status. Returns the
     summary dict."""
-    from paddle_tpu.serving import PRIORITIES, LoadShedError, QueueFullError
+    from paddle_tpu.serving import (PRIORITIES, LoadShedError,
+                                    QueueFullError, RateLimitedError)
 
     cohort_of = {v: k for k, v in PRIORITIES.items()}
     wall0 = time.monotonic()
     now = (lambda: virtual_clock()) if virtual_clock is not None \
         else (lambda: time.monotonic() - wall0)
     handles = []
-    shed = rejected = 0
+    shed = rejected = rate_limited = 0
     shed_by_tenant = {}
+    rl_by_tenant = {}
     next_i = 0
     max_concurrent = 0
     steps = 0
@@ -212,6 +214,14 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
                 shed += 1
                 t = it.get("tenant", "default")
                 shed_by_tenant[t] = shed_by_tenant.get(t, 0) + 1
+            except RateLimitedError:
+                # ISSUE 17: the token bucket said no BEFORE the shed
+                # watermark even looked — tallied apart from sheds so
+                # the per-tenant readout separates "engine was full"
+                # from "tenant exceeded its own budget"
+                rate_limited += 1
+                t = it.get("tenant", "default")
+                rl_by_tenant[t] = rl_by_tenant.get(t, 0) + 1
             except QueueFullError:
                 rejected += 1
         more = sched.step()
@@ -242,6 +252,7 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
         "by_status": by_status,
         "shed": shed,
         "rejected": rejected,
+        "rate_limited": rate_limited,
         "preempted": m["requests"].get("serving.preempted", 0),
         "prefix_hits": sum(1 for h in handles if h.prefix_hit),
         "max_concurrent": max_concurrent,
@@ -261,13 +272,14 @@ def replay(sched, trace, timeout_s=None, virtual_clock=None,
             trace, handles, shed_by_tenant, sched,
             kv_peak=kv_peak if kv_ledger is not None else None,
             kv_mean={t: s / steps for t, s in kv_sum.items()}
-            if kv_ledger is not None and steps else None)
+            if kv_ledger is not None and steps else None,
+            rl_by_tenant=rl_by_tenant)
     _export_registry(summary)
     return summary
 
 
 def _tenant_summary(trace, handles, shed_by_tenant, sched,
-                    kv_peak=None, kv_mean=None):
+                    kv_peak=None, kv_mean=None, rl_by_tenant=None):
     """Per-tenant replay figures (ISSUE 15): request/shed tallies,
     per-tenant p50/p99 TTFT, and per-tenant TTFT phase attribution
     (each tenant's own timeline records clipped to their TTFT windows)
@@ -284,6 +296,15 @@ def _tenant_summary(trace, handles, shed_by_tenant, sched,
     for rec in sched.timeline_records():
         tl_by_tenant.setdefault(rec.get("tenant", "default"),
                                 []).append(rec)
+    # namespace residency/eviction (ISSUE 17): when the engine runs a
+    # namespaced prefix cache, each tenant's quota view rides next to
+    # its latency figures — tenant name IS the namespace under
+    # TenancyConfig's default wiring
+    pc = getattr(sched.engine, "prefix_cache", None)
+    ns_resident = pc.namespace_residents() if pc is not None \
+        and hasattr(pc, "namespace_residents") else {}
+    ns_evicted = pc.namespace_evictions() if pc is not None \
+        and hasattr(pc, "namespace_evictions") else {}
     out = {}
     for t in tenants:
         hs = by_tenant_handles.get(t, [])
@@ -296,6 +317,7 @@ def _tenant_summary(trace, handles, shed_by_tenant, sched,
                             if it.get("tenant", "default") == t),
             "submitted": len(hs),
             "shed": shed_by_tenant.get(t, 0),
+            "rate_limited": (rl_by_tenant or {}).get(t, 0),
             "by_status": by_status,
             "preempted": sum(h.preempted for h in hs),
             "ttft_p50_s": percentile(ttfts, 0.50),
@@ -306,6 +328,9 @@ def _tenant_summary(trace, handles, shed_by_tenant, sched,
             out[t]["kv_blocks_peak"] = kv_peak.get(t, 0)
             out[t]["kv_blocks_mean"] = round(
                 (kv_mean or {}).get(t, 0.0), 4)
+        if ns_resident or ns_evicted:
+            out[t]["ns_blocks_resident"] = int(ns_resident.get(t, 0))
+            out[t]["ns_blocks_evicted"] = int(ns_evicted.get(t, 0))
     return out
 
 
@@ -387,6 +412,18 @@ def _export_registry(summary):
         "serving_load_tenant_kv_blocks_mean",
         "Mean resident KV blocks per tenant over all replay steps",
         labelnames=("tenant",))
+    # multi-tenant isolation figures (ISSUE 17): rate-limit denials and
+    # namespace-quota evictions per tenant — what the isolation gate and
+    # metrics_report's failure-class scan read after a replay
+    tgrl = _metrics.gauge(
+        "serving_load_tenant_rate_limited",
+        "Submissions the tenant's token bucket denied over the replay",
+        labelnames=("tenant",))
+    tgnse = _metrics.gauge(
+        "serving_load_tenant_ns_evicted_blocks",
+        "Prefix-cache blocks evicted FROM the tenant's namespace over "
+        "the replay (quota-pressure reclaims included)",
+        labelnames=("tenant",))
     for tenant, ts in (summary.get("tenants") or {}).items():
         if ts.get("ttft_p50_s") is not None:
             tg50.labels(tenant=tenant).set(float(ts["ttft_p50_s"]))
@@ -398,6 +435,72 @@ def _export_registry(summary):
             tgkvp.labels(tenant=tenant).set(float(ts["kv_blocks_peak"]))
             tgkvm.labels(tenant=tenant).set(
                 float(ts.get("kv_blocks_mean") or 0.0))
+        tgrl.labels(tenant=tenant).set(float(ts.get("rate_limited", 0)))
+        if ts.get("ns_blocks_evicted") is not None:
+            tgnse.labels(tenant=tenant).set(
+                float(ts["ns_blocks_evicted"]))
+
+
+def build_tenancy(tenants, adapters_arg=None, quotas_arg=None,
+                  rates_arg=None):
+    """A serving.tenancy.TenancyConfig from the CLI knob strings
+    ('a:4,b:8' / 'a:8' / 'a:400/800'). Returns None when no knob names
+    any tenant — the pre-tenancy scheduler shape. Namespace defaults to
+    the tenant's own name for every tenant the config knows, so prompt
+    blocks never cross tenants once tenancy is on."""
+    from paddle_tpu.serving.tenancy import TenancyConfig, TenantSpec
+
+    def _pairs(arg):
+        if not arg:
+            return {}
+        return dict(part.split(":", 1) for part in arg.split(","))
+
+    adapters = _pairs(adapters_arg)
+    quotas = _pairs(quotas_arg)
+    rates = _pairs(rates_arg)
+    names = sorted(set(tenants or ()) | set(adapters) | set(quotas)
+                   | set(rates))
+    if not (adapters or quotas or rates):
+        return None
+    specs = {}
+    for i, name in enumerate(names):
+        rate = burst = None
+        if name in rates:
+            r = rates[name].split("/")
+            rate = float(r[0])
+            burst = float(r[1]) if len(r) > 1 else None
+        specs[name] = TenantSpec(
+            namespace=name,
+            kv_block_quota=int(quotas[name]) if name in quotas else None,
+            rate_tokens_per_s=rate, burst_tokens=burst,
+            adapter_rank=int(adapters[name]) if name in adapters
+            else None,
+            adapter_seed=i + 1)
+    return TenancyConfig(tenants=specs)
+
+
+def _attach_tenant_adapters(model, engine, tenancy):
+    """Load each adapter-carrying tenant's synthetic seeded LoRA into a
+    bank on `engine` (ISSUE 17). Bank rank is the max declared tenant
+    rank (lower-rank adapters zero-pad); tenants without a rank run base
+    weights through slot 0 of the same ONE compiled trace. No-op when no
+    tenant declares an adapter — the engine stays bit-identical to an
+    adapter-free build."""
+    from paddle_tpu.serving.tenancy import AdapterBank, init_adapter_state
+    ranked = {t: s for t, s in tenancy.tenants.items()
+              if s.adapter_rank is not None and s.adapter_rank > 0}
+    if not ranked:
+        return None
+    rank = max(s.adapter_rank for s in ranked.values())
+    bank = AdapterBank(model.cfg, n_adapters=max(tenancy.adapter_slots,
+                                                 len(ranked) + 1),
+                       rank=rank)
+    for tenant, spec in sorted(ranked.items()):
+        bank.load(tenant, init_adapter_state(
+            model.cfg, spec.adapter_rank, seed=spec.adapter_seed,
+            scale=spec.adapter_scale))
+    engine.attach_adapters(bank)
+    return bank
 
 
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
@@ -471,7 +574,8 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 metrics_out=None, gamma=3, draft_layers=1,
                 attention_impl="gather", kv_dtype="float32",
                 weight_dtype="float32", tp=2, pp=2, prefill_chunk=None,
-                engine_sink=None, serve_jsonl=None, decision_sink=None):
+                engine_sink=None, serve_jsonl=None, decision_sink=None,
+                tenancy=None):
     """Build engine+scheduler, replay `traffic`, return the summary
     (annotated with the engine's KV budget and compile counters).
     `engine_sink`: optional list the built (now-warmed) engine is
@@ -483,7 +587,12 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
     decision records after the replay — what bench's audit asserts
     over. A multi-tenant traffic config additionally judges per-tenant
     SLO burn (fleet.per_tenant_slos) across the replay and reports it
-    under summary["tenant_slo_burn"]."""
+    under summary["tenant_slo_burn"].
+    `tenancy` (ISSUE 17): a serving.tenancy.TenancyConfig arms the
+    scheduler's token buckets + prefix-namespace quotas, and every
+    tenant whose spec carries an `adapter_rank` gets a synthetic
+    seeded LoRA adapter loaded into the engine's bank before traffic —
+    the one-command isolation-gate shape."""
     from paddle_tpu.observability import fleet as _fleet
     from paddle_tpu.observability import metrics as _metrics
     from paddle_tpu.serving import Scheduler
@@ -495,13 +604,16 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                           attention_impl=attention_impl,
                           kv_dtype=kv_dtype, weight_dtype=weight_dtype,
                           tp=tp, pp=pp, prefill_chunk=prefill_chunk)
+    if tenancy is not None:
+        _attach_tenant_adapters(model, engine, tenancy)
     vclock = VirtualClock() if virtual_step_s is not None else None
     sched = Scheduler(engine, max_queue=max_queue,
                       shed_watermark=shed_watermark,
                       shed_pool_free=shed_pool_free,
                       metrics_path=serve_jsonl,
                       clock=(vclock if vclock is not None
-                             else time.monotonic))
+                             else time.monotonic),
+                      tenancy=tenancy)
     trace = synth_trace(traffic, model.cfg.vocab_size)
     wd = None
     if traffic.tenants:
@@ -717,6 +829,21 @@ def main(argv=None):
                         "TENANT's arrival rate by MULT inside "
                         "[T0, T0+DUR) seconds — the isolation-gate "
                         "scenario")
+    p.add_argument("--tenant-adapters", default=None,
+                   help="per-tenant LoRA rank (ISSUE 17): 'a:4,b:8' "
+                        "loads a synthetic seeded rank-r adapter for "
+                        "each named tenant; unlisted tenants decode "
+                        "base weights through the same one compiled "
+                        "trace")
+    p.add_argument("--tenant-quotas", default=None,
+                   help="per-tenant resident prefix-block quota: "
+                        "'a:8,b:8' — namespace == tenant name; a hot "
+                        "tenant over quota evicts its OWN leaves first")
+    p.add_argument("--tenant-rates", default=None,
+                   help="per-tenant token-bucket 'a:400/800,b:100' = "
+                        "rate[/burst] tokens per second; denials land "
+                        "as serving_rate_limited_total{tenant} and in "
+                        "the per-tenant replay summary")
     p.add_argument("--serve-jsonl", default=None,
                    help="write the scheduler's serving JSONL here "
                         "(step/request/timeline + decisions.v1 audit "
@@ -740,6 +867,8 @@ def main(argv=None):
         bt, t0, dur, mult = args.burst.split(":")
         burst = {"tenant": bt, "t0": float(t0), "dur_s": float(dur),
                  "mult": float(mult)}
+    tenancy = build_tenancy(tenants, args.tenant_adapters,
+                            args.tenant_quotas, args.tenant_rates)
     traffic = TrafficConfig(
         users=args.users, requests=args.requests, rate_rps=args.rate_rps,
         prefix_pool=args.prefix_pool, prefix_len=args.prefix_len,
@@ -767,7 +896,8 @@ def main(argv=None):
             metrics_out=args.metrics_out
             if kind == kinds[-1] else None,
             serve_jsonl=args.serve_jsonl
-            if kind == kinds[-1] else None)
+            if kind == kinds[-1] else None,
+            tenancy=tenancy)
     print(json.dumps(out, indent=2, sort_keys=True))
     return 0
 

@@ -55,7 +55,15 @@ threshold. Direction matters and is decided per counter name:
     failure class (pattern `diverg`/`leak`) — the reconciler primes
     every invariant child at 0, so a single latched divergence in run B
     gates through the zero-baseline failure-counter rule even though
-    run A never saw the series move.
+    run A never saw the series move,
+  - multi-tenant serving counters (ISSUE 17):
+    `serving_rate_limited_total{tenant}` (token-bucket denials) and
+    `serving_prefix_ns_evicted_total{namespace}` (prefix blocks evicted
+    out of a tenant namespace) join the failure class (patterns
+    `rate_limited`/`evict`); both gate per labelset under the tenant
+    membership-intersection rule — a newly onboarded tenant's counters
+    never read as regressions, a shared tenant's growth fires on
+    exactly the tenant that regressed.
 
 Fleet-merged snapshots (ISSUE 12, observability/fleet.py) are compared
 LABEL-AWARE: every series already carries `worker_id`/`role` labels in
@@ -92,7 +100,8 @@ SCHEMA = "paddle_tpu.metrics.v1"
 _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
-    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover|diverg|leak",
+    r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover|diverg|leak"
+    r"|rate_limited|evict",
     re.I)
 
 # counter pairs whose RATIO is the SLO signal: a rate drop past the
@@ -187,6 +196,9 @@ _WORKER_LABEL = re.compile(r"worker_id=([^,}]+)")
 _FLEET_LABEL = "_fleet"      # the fleet-aggregate member id (fleet.py)
 _TENANT_LABEL = re.compile(r"[{,]tenant=([^,}]+)")
 _ALL_TENANTS = "_all"        # tenant value of unscoped SLO gauges
+# prefix-cache namespaces (ISSUE 17) are tenant trust boundaries — the
+# same onboard/offboard churn argument applies to their label dimension
+_NAMESPACE_LABEL = re.compile(r"[{,]namespace=([^,}]+)")
 
 
 def _label_values(rec, labelname, drop=()):
@@ -230,7 +242,8 @@ def _member_filter(a_rec, b_rec):
                            always=(_FLEET_LABEL,))
     ft = _dimension_filter(a_rec, b_rec, "tenant", _TENANT_LABEL,
                            always=(_ALL_TENANTS,))
-    return lambda key: fw(key) and ft(key)
+    fn = _dimension_filter(a_rec, b_rec, "namespace", _NAMESPACE_LABEL)
+    return lambda key: fw(key) and ft(key) and fn(key)
 
 
 def _approx_p99(buckets, count):

@@ -63,6 +63,15 @@ a per-tenant KV RESIDENCY table (private/shared/cached resident blocks
 chains) — the offline half of the attribution plane whose live half is
 `serving_kv_blocks{tenant,kind}` and the LedgerReconciler watchdog.
 
+Multi-tenant serving fields (ISSUE 17): request records may carry
+`adapter_id` (the LoRA adapter the request decoded through),
+`prefix_namespace` (the tenant namespace its prompt blocks keyed
+under), and `rate_limited` (its tenant's token bucket denied it —
+terminal SHED). All optional — historical artifacts validate
+unchanged. When any is present the CLI adds a per-tenant tenancy
+table: rate-limit denials, adapter usage, namespaces, and cached
+blocks each tenant's namespaces lost to eviction.
+
 Usage: python tools/serve_report.py serve_metrics.jsonl
 """
 import importlib.util
@@ -94,6 +103,8 @@ REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "preempted": int, "prefix_hit": bool, "adopted": bool,
                   "spec_proposed": int, "spec_accepted": int,
                   "tenant": str, "cohort": str,
+                  "adapter_id": str, "prefix_namespace": str,
+                  "rate_limited": bool,
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
 # `run` header records (ISSUE 11): the engine's serving precisions and,
@@ -111,11 +122,15 @@ RUN_FIELDS = {"kind": str, "engine": str, "kv_dtype": str,
 OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
                        "quant_logit_kl", "tp", "pp", "engine", "gamma"}
 # absent == 0/False in files written before the speculative-decode
-# fields (ISSUE 7), the multi-host `adopted` flag (ISSUE 10), and the
-# tenant/cohort attribution labels (ISSUE 15) landed — historical
-# artifacts must stay gradeable
+# fields (ISSUE 7), the multi-host `adopted` flag (ISSUE 10), the
+# tenant/cohort attribution labels (ISSUE 15), and the multi-tenant
+# serving fields (ISSUE 17: which LoRA adapter served the request,
+# which prefix-cache namespace its blocks keyed under, and whether the
+# tenant's token bucket denied it) landed — historical artifacts must
+# stay gradeable
 OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted", "adopted",
-                           "tenant", "cohort"}
+                           "tenant", "cohort", "adapter_id",
+                           "prefix_namespace", "rate_limited"}
 STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
 # per-request end-to-end timeline records (ISSUE 12), schema
@@ -489,7 +504,46 @@ def summarize(records):
                 for s in sorted({r["status"] for r in reqs
                                  if r.get("tenant", "default") == t})}
             for t in sorted({r.get("tenant", "default") for r in reqs})},
+        "tenancy": tenancy_table(reqs, kvledger_recs),
     }
+
+
+def tenancy_table(reqs, kvledger_recs=()):
+    """Per-tenant multi-tenancy figures (ISSUE 17) from the request
+    records (+ the ledger stream, when present): how many requests the
+    tenant's token bucket denied, how many decoded through a LoRA
+    adapter (and which), which prefix namespaces its prompts keyed
+    under, and how many cached blocks its namespaces lost to eviction.
+    Returns None when no record carries any ISSUE 17 field — historical
+    files keep their historical report."""
+    if not any(r.get("rate_limited") or r.get("adapter_id")
+               or r.get("prefix_namespace") is not None for r in reqs):
+        return None
+    ns_evicted = {}
+    for ev in kvledger_recs:
+        if ev.get("event") == "cache_evict":
+            t = ev.get("tenant") or "default"
+            ns_evicted[t] = ns_evicted.get(t, 0) + len(
+                ev.get("blocks") or [])
+    out = {}
+    for r in reqs:
+        t = r.get("tenant", "default")
+        row = out.setdefault(t, {"requests": 0, "rate_limited": 0,
+                                 "adapter_requests": 0, "adapters": {},
+                                 "namespaces": set()})
+        row["requests"] += 1
+        if r.get("rate_limited"):
+            row["rate_limited"] += 1
+        aid = r.get("adapter_id")
+        if aid:
+            row["adapter_requests"] += 1
+            row["adapters"][aid] = row["adapters"].get(aid, 0) + 1
+        if r.get("prefix_namespace") is not None:
+            row["namespaces"].add(r["prefix_namespace"])
+    for t, row in out.items():
+        row["namespaces"] = sorted(row["namespaces"])
+        row["ns_blocks_evicted"] = ns_evicted.get(t, 0)
+    return out
 
 
 def render(summary):
@@ -606,6 +660,22 @@ def render(summary):
         for t, statuses in sorted(summary["by_tenant"].items()):
             out.append(f"- {t}: " + ", ".join(
                 f"{s}={n}" for s, n in sorted(statuses.items())))
+    ten = summary.get("tenancy")
+    if ten:
+        out += ["", "## multi-tenant serving (adapters / namespaces / "
+                    "rate limits)", "",
+                "| tenant | requests | rate limited | adapter requests |"
+                " adapters | namespaces | ns blocks evicted |",
+                "|---|---|---|---|---|---|---|"]
+        for t, row in sorted(ten.items()):
+            adapters = ", ".join(
+                f"{a}={n}" for a, n in sorted(row["adapters"].items())) \
+                or "-"
+            namespaces = ", ".join(row["namespaces"]) or "-"
+            out.append(f"| {t} | {row['requests']} | "
+                       f"{row['rate_limited']} | "
+                       f"{row['adapter_requests']} | {adapters} | "
+                       f"{namespaces} | {row['ns_blocks_evicted']} |")
     return "\n".join(out)
 
 
