@@ -33,7 +33,8 @@ Stdlib-only, like every observability submodule.
 
 __all__ = ["SCHEMA", "ACTIONS", "DEFAULT_TENANT", "build_record",
            "replay_shed", "replay_victim", "replay_place",
-           "replay_rate_limit", "validate_records", "by_tenant"]
+           "replay_affinity_place", "replay_rate_limit",
+           "validate_records", "by_tenant"]
 
 SCHEMA = "paddle_tpu.decisions.v1"
 
@@ -152,6 +153,36 @@ def replay_place(inputs):
                key=lambda k: loads[k])
 
 
+def replay_affinity_place(inputs):
+    """The prefix-affinity router placement rule (ISSUE 18) over
+    recorded inputs: longest-prefix-match wins AHEAD of least-loaded —
+    recomputing a long cached prefix costs more than a small load skew —
+    unless the owner is already `load_slack` requests busier than the
+    least-loaded worker, in which case placement falls back to the plain
+    least-loaded rule (`replay_place`). Lowest worker index wins match
+    ties, mirroring the load-tie rule.
+
+    inputs: {"loads": {worker_id: inflight_count},
+             "matches": {worker_id: matched_prefix_tokens},
+             "min_match": int (tokens; matches below it don't bind),
+             "load_slack": number}."""
+    loads = inputs["loads"]
+    if not loads:
+        return None
+    matches = inputs.get("matches") or {}
+    min_match = int(inputs.get("min_match", 1))
+    slack = float(inputs.get("load_slack", 0))
+    best, best_tok = None, 0
+    for w in sorted(loads, key=lambda k: int(k)):
+        tok = int(matches.get(w) or matches.get(str(w)) or 0)
+        if tok >= min_match and tok > best_tok:
+            best, best_tok = w, tok
+    if best is not None and float(loads[best]) - \
+            min(float(v) for v in loads.values()) <= slack:
+        return best
+    return replay_place(inputs)
+
+
 # ------------------------------------------------------------- validation
 
 def _replay_errors(rec):
@@ -184,6 +215,13 @@ def _replay_errors(rec):
             if int(got["slot"]) != int(want_slot):
                 return [f"preempt victim slot {want_slot} != replayed "
                         f"slot {got['slot']}"]
+        elif action == "place" and "matches" in inputs:
+            got = replay_affinity_place(inputs)
+            want = outcome.get("worker")
+            if want is not None and got is not None and \
+                    str(got) != str(want):
+                return [f"affinity place worker {want!r} != replayed "
+                        f"{got!r}"]
         elif action == "place" and "loads" in inputs:
             got = replay_place(inputs)
             want = outcome.get("worker")

@@ -54,6 +54,22 @@ Site catalogue (the call sites live next to the operation they break):
                        `serving_kv_ledger_divergence_total` (failure-
                        class in metrics_report --compare) latches the
                        leak
+  serving.kv_spill     the KV tier demotion path (ISSUE 18): fires in
+                       HostTier.put (HBM -> host RAM) and DiskTier.put
+                       (host -> append-log) — `truncate` mode tears the
+                       spill (the host entry is dropped / the disk
+                       record's payload bytes are cut short), so the
+                       chain is LOST, never corrupt: a later lookup
+                       misses and the engine recompute-prefills,
+                       bit-identical to the no-tier oracle
+  serving.kv_restore   the KV tier promotion/restore path (ISSUE 18):
+                       fires in TieredBlockStore.lookup (host/disk ->
+                       HBM promote) and in the cross-host prefix
+                       restore — `truncate` makes the restore read see
+                       a torn/short payload (sha256 verify fails,
+                       `serving_kv_tier_corrupt_total` latches, the
+                       chain degrades to miss-and-recompute); `delay`
+                       models slow disk/wire without corruption
   serving.pp_handoff   the pipeline-parallel stage boundary (ISSUE 13):
                        fires on every activation/KV transfer from stage
                        s to stage s+1 inside the serving ring (decode
@@ -96,7 +112,8 @@ SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.decode_step", "serving.block_alloc",
          "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
          "serving.adapter_swap", "serving.pp_handoff",
-         "serving.kv_ledger_leak", "dataloader.next")
+         "serving.kv_ledger_leak", "serving.kv_spill",
+         "serving.kv_restore", "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 MODES = ("raise", "delay", "drop", "truncate")

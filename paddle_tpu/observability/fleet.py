@@ -323,7 +323,7 @@ def prime_tenant_series(tenants, registry=None):
         shed.labels(tenant=t)
         for status in ("admitted", "error", "timeout"):
             requests.labels(status=status, tenant=t)
-        for kind in ("private", "shared", "cached"):
+        for kind in ("private", "shared", "cached", "host", "disk"):
             kv_blocks.labels(tenant=t, kind=kind)
             kv_bytes.labels(tenant=t, kind=kind)
 

@@ -26,6 +26,18 @@ and origin site, captured from the attribution context at emit time):
   cache_insert  the prefix cache took its own reference on a block
                 (the block now outlives the inserting request)
   cache_evict   the prefix cache dropped an entry under pressure
+  tier_demote   an evicted chain entry left HBM for a colder tier
+                (ISSUE 18): carries `key` (the prefix-chain entry key),
+                `tier` ("host"|"disk") and `owner` (the chain's
+                namespace tenant). The HBM side still emits its own
+                unref/free — tier events track the COLD copy's
+                residency, so the reconciler can prove zero blocks
+                leaked ACROSS tiers, not just inside the pool
+  tier_promote  a tiered entry was restored into HBM (the pool-side
+                alloc/ref/cache_insert events ride alongside)
+  tier_drop     a tiered entry was discarded (capacity pressure,
+                corruption at restore, or explicit invalidation) —
+                the chain is gone everywhere; a later match misses
 
 Attribution: BlockPool and PrefixCache know nothing about requests or
 tenants. The scheduler wraps every engine call that can touch the pool
@@ -73,10 +85,10 @@ __all__ = ["SCHEMA", "EVENTS", "KINDS", "INVARIANTS", "KVLedger",
 
 SCHEMA = "paddle_tpu.kvledger.v1"
 EVENTS = ("alloc", "ref", "unref", "free", "share", "cache_insert",
-          "cache_evict")
-KINDS = ("private", "shared", "cached")
+          "cache_evict", "tier_demote", "tier_promote", "tier_drop")
+KINDS = ("private", "shared", "cached", "host", "disk")
 INVARIANTS = ("event_stream", "refcounts", "free_list", "cached_set",
-              "orphan_chain", "evictable")
+              "orphan_chain", "evictable", "tier_residency")
 DEFAULT_TENANT = "default"
 
 _G_BLOCKS = _metrics.gauge(
@@ -193,6 +205,7 @@ class ShadowPool:
         self.allocated = set()       # block ids with a live allocation
         self.holders = {}            # block -> [(tenant, kind, req_id)]
         self.cached = {}             # block -> inserting tenant
+        self.tiered = {}             # chain key -> (owner tenant, tier)
         self.errors = []             # event-stream self-inconsistencies
         self.applied = 0
 
@@ -228,6 +241,23 @@ class ShadowPool:
         tenant = ev.get("tenant") or DEFAULT_TENANT
         rid = ev.get("request_id")
         origin = ev.get("origin")
+        if kind in ("tier_demote", "tier_promote", "tier_drop"):
+            # tier events are keyed by prefix-chain entry, not block id:
+            # the HBM side's alloc/unref/free events cover the pool, so
+            # a tier event only moves the COLD copy's residency record
+            key = ev.get("key")
+            if key is None:
+                self._err(f"seq {ev.get('seq')}: {kind} without a key")
+            elif kind == "tier_demote":
+                self.tiered[key] = (ev.get("owner") or tenant,
+                                    ev.get("tier"))
+            else:
+                if key not in self.tiered:
+                    self._err(f"seq {ev.get('seq')}: {kind} of "
+                              f"untiered key {key}")
+                self.tiered.pop(key, None)
+            self.applied += 1
+            return
         for b in ev.get("blocks", ()):
             b = int(b)
             if not 0 < b < self.num_blocks:
@@ -281,6 +311,12 @@ class ShadowPool:
         out = {}
         for b, hs in self.holders.items():
             for tk in {(h[0], h[1]) for h in hs}:
+                out[tk] = out.get(tk, 0) + 1
+        # cold tiers (ISSUE 18): one entry == one block-sized record, so
+        # serving_kv_blocks{tenant,kind=host|disk} counts demoted blocks
+        for owner, tier in self.tiered.values():
+            if tier in ("host", "disk"):
+                tk = (owner or DEFAULT_TENANT, tier)
                 out[tk] = out.get(tk, 0) + 1
         return out
 
@@ -366,6 +402,21 @@ class KVLedger:
     def cache_evict(self, block_ids):
         self._emit("cache_evict", block_ids)
 
+    # TieredBlockStore hooks (ISSUE 18: residency across cold tiers)
+    def tier_demote(self, block_ids, key, tier, owner):
+        self._emit("tier_demote", block_ids, key=str(key),
+                   tier=str(tier), owner=str(owner))
+
+    def tier_promote(self, block_ids, key, tier, owner):
+        self._emit("tier_promote", block_ids, key=str(key),
+                   tier=str(tier), owner=str(owner))
+
+    def tier_drop(self, key, tier, owner, reason=None):
+        ev = {"key": str(key), "tier": str(tier), "owner": str(owner)}
+        if reason is not None:
+            ev["reason"] = str(reason)
+        self._emit("tier_drop", (), **ev)
+
     def compact(self):
         """Drop the serialized history (the live shadow keeps its
         state). Only safe at a reconciled boundary; replay from the
@@ -396,10 +447,11 @@ class LedgerReconciler:
     annotation + one postmortem bundle) and keeps being counted each
     step it persists — a leak does not heal by being old."""
 
-    def __init__(self, ledger, pool, cache=None):
+    def __init__(self, ledger, pool, cache=None, tier_store=None):
         self.ledger = ledger
         self.pool = pool
         self.cache = cache
+        self.tier_store = tier_store
         self.divergences = []        # latched messages, newest-last
         self._dumped = False
         self.last_postmortem = None
@@ -465,6 +517,25 @@ class LedgerReconciler:
                 out.append(("evictable",
                             f"cache.evictable()={got} but the ledger "
                             f"counts {want} cache-only blocks"))
+        store = self.tier_store
+        if store is not None:
+            # ISSUE 18: the shadow's {key: tier} map must equal the live
+            # tier store's residency — a demote the ledger missed (or a
+            # dropped entry it still counts) is a cross-tier leak
+            real_tiers = {str(k): str(t)
+                          for k, t in store.residency().items()}
+            led_tiers = {str(k): str(t)
+                         for k, (_own, t) in shadow.tiered.items()}
+            if real_tiers != led_tiers:
+                ghost = sorted(set(led_tiers) - set(real_tiers))
+                unseen = sorted(set(real_tiers) - set(led_tiers))
+                moved = sorted(k for k in set(led_tiers) & set(real_tiers)
+                               if led_tiers[k] != real_tiers[k])
+                out.append(("tier_residency",
+                            f"{len(ghost)} ledger-only tier entries "
+                            f"(dropped without tier_drop), {len(unseen)} "
+                            f"store-only (demoted without tier_demote), "
+                            f"{len(moved)} on the wrong tier"))
         return out
 
     def check(self):

@@ -31,8 +31,8 @@ Stdlib-only, like every observability submodule.
 """
 
 __all__ = ["SCHEMA", "PH_QUEUE", "PH_PREFILL", "PH_KV_HANDOFF", "PH_ADOPT",
-           "PH_PLACE", "PH_DECODE", "PH_FAILOVER", "PHASES", "PhaseTrail",
-           "build_record", "ttft_breakdown"]
+           "PH_PLACE", "PH_DECODE", "PH_FAILOVER", "PH_KV_RESTORE",
+           "PHASES", "PhaseTrail", "build_record", "ttft_breakdown"]
 
 SCHEMA = "paddle_tpu.reqtimeline.v1"
 
@@ -45,8 +45,9 @@ PH_ADOPT = "adopt"            # placement from a staged KV bundle
 PH_PLACE = "place"            # router SUBMIT/placement overhead (fleet)
 PH_DECODE = "decode"          # first token -> terminal (or next eviction)
 PH_FAILOVER = "failover"      # dead-worker hop: detection -> re-placed
+PH_KV_RESTORE = "kv_restore"  # tier promote / cross-host prefix restore
 PHASES = (PH_QUEUE, PH_PREFILL, PH_KV_HANDOFF, PH_ADOPT, PH_PLACE,
-          PH_DECODE, PH_FAILOVER)
+          PH_DECODE, PH_FAILOVER, PH_KV_RESTORE)
 
 
 class PhaseTrail:

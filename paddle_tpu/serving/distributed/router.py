@@ -56,8 +56,9 @@ from ...observability import reqtimeline as _rt
 from ...observability import tracecontext as _tc
 from ..scheduler import DONE, ERROR, QUEUED, RUNNING, SHED, TIMEOUT
 from . import kv_handoff as _kv
-from .worker import (OP_DUMP, OP_KV_PUT, OP_METRICS, OP_POLL, OP_PREFILL,
-                     OP_STAT, OP_SUBMIT, OP_SWAP)
+from .worker import (OP_DUMP, OP_KV_EXPORT, OP_KV_PUT, OP_METRICS,
+                     OP_POLL, OP_PREFILL, OP_PREFIX_LOOKUP, OP_STAT,
+                     OP_SUBMIT, OP_SWAP)
 
 __all__ = ["ServingShardClient", "DistFrontend", "DistRequest",
            "NoWorkersError"]
@@ -117,6 +118,23 @@ class ServingShardClient(_rpc.ShardClientBase):
 
     def poll(self, i, keys):
         return self._call(i, OP_POLL, {"keys": list(keys)})
+
+    def prefix_lookup(self, i, prompt, namespace=None):
+        """How many tokens of `prompt` worker `i` could serve from its
+        prefix cache, HBM and cold tiers included (OP_PREFIX_LOOKUP,
+        read-only) — the affinity placement probe (ISSUE 18)."""
+        return self._call(i, OP_PREFIX_LOOKUP, {
+            "prompt": [int(t) for t in prompt], "namespace": namespace})
+
+    def kv_export(self, i, key, prompt, decode_endpoint=None,
+                  namespace=None, tenant=None):
+        """Ask worker `i` to export its cached chain for `prompt` and
+        stream it to `decode_endpoint`'s staging area as a prefix_only
+        bundle (OP_KV_EXPORT) — the cross-host restore edge."""
+        return self._call(i, OP_KV_EXPORT, {
+            "key": key, "prompt": [int(t) for t in prompt],
+            "decode_endpoint": decode_endpoint, "namespace": namespace,
+            "tenant": tenant})
 
     def swap(self, i, path, version=None, apply_timeout_s=30):
         return self._call(i, OP_SWAP, {
@@ -214,7 +232,8 @@ class DistFrontend:
     def __init__(self, decode_endpoints, prefill_endpoints=(),
                  retry=None, breaker_threshold=2, breaker_cooldown_s=30.0,
                  request_timeout_s=10.0, connect_timeout_s=5.0,
-                 timeline_path=None):
+                 timeline_path=None, prefix_affinity=False,
+                 affinity_min_match=1, affinity_load_slack=0):
         # fast-failing defaults: a dead worker should cost milliseconds
         # of retries, then its breaker holds it dark while we re-place
         retry = retry or _rpc.RetryPolicy(max_attempts=2,
@@ -230,6 +249,19 @@ class DistFrontend:
             if prefill_endpoints else None
         self._live = set(range(len(self.decode.endpoints)))
         self._prefill_rr = 0
+        # fleet-global prefix cache (ISSUE 18): with prefix_affinity on,
+        # placement probes every live decode worker (OP_PREFIX_LOOKUP)
+        # and routes to the longest cached match — unless that owner is
+        # already `affinity_load_slack` requests busier than the least-
+        # loaded worker, in which case the request lands least-loaded
+        # and the owner's chain is WIRE-RESTORED there (OP_KV_EXPORT).
+        # Matches below `affinity_min_match` tokens (set it to the
+        # engine's block_size: sub-block matches restore nothing) never
+        # bind. The rule IS decisions.replay_affinity_place over the
+        # recorded inputs.
+        self.prefix_affinity = bool(prefix_affinity)
+        self.affinity_min_match = int(affinity_min_match)
+        self.affinity_load_slack = float(affinity_load_slack)
         self._inflight = {}          # key -> DistRequest
         self._lock = threading.Lock()
         # the fleet observability plane (ISSUE 12): attaching an
@@ -289,20 +321,38 @@ class DistFrontend:
         with self._lock:
             self._live.discard(i)
 
-    def _pick_decode(self):
+    def _pick_decode(self, req=None, exec_prompt=None):
         """SLO-aware placement: the live worker carrying the fewest
         in-flight router requests (queue-depth-proportional load
-        balancing without a STAT round-trip per submit). The choice IS
-        `decisions.replay_place` over the load table, so the place
-        decision record reproduces it. Returns (worker, loads)."""
+        balancing without a STAT round-trip per submit). With
+        prefix_affinity on (ISSUE 18), a per-worker OP_PREFIX_LOOKUP
+        sweep runs first and the longest cached match wins ahead of
+        least-loaded, within the load-slack bound. Either way the
+        choice IS the matching decisions replay rule over the recorded
+        inputs. Returns (worker, loads, matches-or-None); the lookup
+        RPCs run OUTSIDE the lock, per the locking discipline above."""
         with self._lock:
             if not self._live:
                 raise NoWorkersError("every decode worker is dark")
             loads = {i: 0 for i in self._live}
-            for req in self._inflight.values():
-                if not req.done() and req.worker in loads:
-                    loads[req.worker] += 1
-        return _dec.replay_place({"loads": loads}), loads
+            for req_ in self._inflight.values():
+                if not req_.done() and req_.worker in loads:
+                    loads[req_.worker] += 1
+        if self.prefix_affinity and req is not None and exec_prompt:
+            matches = {}
+            for i in sorted(loads):
+                try:
+                    reply = self.decode.prefix_lookup(
+                        i, exec_prompt, namespace=req.prefix_namespace)
+                    matches[i] = int(reply.get("match_tokens") or 0)
+                except (_rpc.PSUnavailableError, _rpc.PSServerError):
+                    matches[i] = 0       # dark probe: no affinity claim
+            choice = _dec.replay_affinity_place(
+                {"loads": loads, "matches": matches,
+                 "min_match": self.affinity_min_match,
+                 "load_slack": self.affinity_load_slack})
+            return choice, loads, matches
+        return _dec.replay_place({"loads": loads}), loads, None
 
     def _remote_prefill(self, req, decode_i, exec_prompt):
         """Remote prefill + handoff toward `decode_i`. Returns
@@ -350,12 +400,37 @@ class DistFrontend:
         exec_prompt = req.prompt + req.tokens
         remaining = req.max_new - len(req.tokens)
         while True:
-            # NoWorkersError when dark; `loads` is the decision input
-            decode_i, loads = self._pick_decode()
+            # NoWorkersError when dark; `loads` (+ affinity `matches`)
+            # are the decision inputs
+            decode_i, loads, matches = self._pick_decode(req, exec_prompt)
             t0 = time.monotonic()
             staged, handoff_s = self._remote_prefill(req, decode_i,
                                                      exec_prompt)
             t1 = time.monotonic()
+            # cross-host prefix restore (ISSUE 18): when affinity found
+            # a chain owner but placement landed elsewhere (load slack)
+            # — and no full prefill bundle is already staged — ship the
+            # owner's chain to the chosen worker's staging area. Any
+            # failure restores nothing: the local prefill recomputes.
+            restored_from = None
+            if not staged and matches:
+                owner = next(
+                    (w for w in sorted(matches)
+                     if matches[w] >= self.affinity_min_match
+                     and matches[w] == max(matches.values())), None)
+                if owner is not None and owner != decode_i:
+                    try:
+                        reply = self.decode.kv_export(
+                            owner, req._wire_key, exec_prompt,
+                            decode_endpoint=self.decode.endpoints[
+                                decode_i],
+                            namespace=req.prefix_namespace,
+                            tenant=req.tenant)
+                        if reply.get("ok"):
+                            restored_from = owner
+                    except (_rpc.PSUnavailableError, _rpc.PSServerError):
+                        pass
+            t2 = time.monotonic()
             # timeline: seal the open queue/failover segment at the
             # placement start, then account the measured intervals —
             # a SUCCESSFUL remote prefill splits into prefill vs
@@ -376,6 +451,20 @@ class DistFrontend:
                 if h > 0.0:
                     req.trail.append(_rt.PH_KV_HANDOFF, t1 - h, t1)
                 place_from = t1
+            if restored_from is not None:
+                # the wire restore is its own named phase: the owner's
+                # export + KVPUT wall time, visible in the request's
+                # latency decomposition like prefill/kv_handoff are
+                req.trail.append(_rt.PH_KV_RESTORE, place_from, t2)
+                place_from = t2
+            # the affinity decision inputs ride every place record so
+            # the validator replays the same rule the sweep used
+            dec_inputs = {"loads": loads, "staged": staged}
+            if matches is not None:
+                dec_inputs.update(
+                    {"matches": matches,
+                     "min_match": self.affinity_min_match,
+                     "load_slack": self.affinity_load_slack})
             try:
                 # rng_gen = tokens already DELIVERED: the worker samples
                 # this placement's first token at that stream position,
@@ -383,7 +472,8 @@ class DistFrontend:
                 self.decode.submit(
                     decode_i, req._wire_key, exec_prompt,
                     max_new=remaining, priority=req.priority,
-                    timeout_s=req.timeout_s, use_staged=staged,
+                    timeout_s=req.timeout_s,
+                    use_staged=staged or restored_from is not None,
                     rng_seed=req.rng_seed, rng_gen=len(req.tokens),
                     tenant=req.tenant, cohort=req.cohort,
                     adapter_id=req.adapter_id,
@@ -395,8 +485,7 @@ class DistFrontend:
                 self._mark_dead(decode_i)
                 # the failed attempt is auditable too: the load table
                 # named this worker, the SUBMIT found it dark
-                self._decide("place", req,
-                             {"loads": loads, "staged": staged},
+                self._decide("place", req, dec_inputs,
                              {"worker": decode_i, "ok": False,
                               "error": "decode worker unavailable"})
                 req._wire_key = f"{req.key}.p{req.failovers}" \
@@ -409,10 +498,11 @@ class DistFrontend:
             req.staged = staged
             req.status = RUNNING
             self._decide("place", req,
-                         {"loads": loads, "staged": staged,
-                          "tokens_delivered": len(req.tokens)},
+                         dict(dec_inputs,
+                              tokens_delivered=len(req.tokens)),
                          {"worker": decode_i, "ok": True,
-                          "staged": staged})
+                          "staged": staged,
+                          "restored_from": restored_from})
             return
 
     # -- streaming / failover ------------------------------------------------

@@ -67,7 +67,8 @@ from . import kv_handoff as _kv
 
 __all__ = ["ServingWorker", "load_checkpoint_params",
            "save_swap_checkpoint", "OP_KV_PUT", "OP_PREFILL", "OP_SUBMIT",
-           "OP_POLL", "OP_SWAP", "OP_STAT", "OP_METRICS", "OP_DUMP"]
+           "OP_POLL", "OP_SWAP", "OP_STAT", "OP_METRICS", "OP_DUMP",
+           "OP_PREFIX_LOOKUP", "OP_KV_EXPORT"]
 
 # extension verbs on the PS fabric (< 0x40; see rpc.register_verb).
 # All are retry-safe: keyed dedup (PREFILL/SUBMIT), idempotent
@@ -80,6 +81,12 @@ OP_SWAP = 20
 OP_STAT = 21
 OP_METRICS = 22
 OP_DUMP = 23
+# the fleet-global prefix cache (ISSUE 18): PREFIXLOOKUP answers "how
+# many tokens of this prompt could you serve from cache (HBM + tiers)?"
+# — the router's affinity-placement probe; KVEXPORT reads the matched
+# chain and streams it to a peer's staging area as a prefix_only bundle
+OP_PREFIX_LOOKUP = 24
+OP_KV_EXPORT = 25
 
 for _op, _name in ((OP_KV_PUT, "KVPUT"), (OP_PREFILL, "PREFILL"),
                    (OP_SUBMIT, "SUBMIT"), (OP_POLL, "POLL"),
@@ -90,6 +97,10 @@ for _op, _name in ((OP_KV_PUT, "KVPUT"), (OP_PREFILL, "PREFILL"),
 # (bounded retention, every dump self-contained)
 _rpc.register_verb(OP_METRICS, "METRICS", readonly=True)
 _rpc.register_verb(OP_DUMP, "DUMP", idempotent=True)
+# PREFIXLOOKUP is a pure probe; KVEXPORT re-reads + re-puts the same
+# bytes on retry (idempotent overwrite at the receiver, like KVPUT)
+_rpc.register_verb(OP_PREFIX_LOOKUP, "PREFIXLOOKUP", readonly=True)
+_rpc.register_verb(OP_KV_EXPORT, "KVEXPORT", idempotent=True)
 
 _M_HANDOFF_S = _metrics.histogram(
     "serving_kv_handoff_seconds",
@@ -149,7 +160,9 @@ class ServingWorker:
         if role == "decode":
             handlers.update({OP_KV_PUT: self._h_kv_put,
                              OP_SUBMIT: self._h_submit,
-                             OP_POLL: self._h_poll})
+                             OP_POLL: self._h_poll,
+                             OP_PREFIX_LOOKUP: self._h_prefix_lookup,
+                             OP_KV_EXPORT: self._h_kv_export})
         else:
             handlers[OP_PREFILL] = self._h_prefill
         self.server = _rpc.PSServer(host=host, port=port, handlers=handlers)
@@ -313,16 +326,27 @@ class ServingWorker:
             if key in self._requests:        # retried SUBMIT: no-op
                 return _kv.pack_payload({"ok": 1, "dup": True})
             staged_kv = None
+            staged_prefix = None
             if obj.get("use_staged"):
                 staged = self._staged.pop(key, None)
                 if staged is not None:
                     ks, vs, meta = staged
-                    staged_kv = (ks, vs, int(meta.get("plen", len(ks[0]))),
-                                 int(meta.get("first_token", 0)))
-                    if meta.get("rng") is not None:
-                        # a v3 bundle: the prefill host's post-first-
-                        # token sampler state rides into adoption
-                        staged_kv += (tuple(meta["rng"]),)
+                    if meta.get("prefix_only"):
+                        # a KVEXPORT bundle (ISSUE 18): a peer's cached
+                        # PREFIX chain, not a finished prefill — it
+                        # restores into the prefix cache ahead of this
+                        # request's own local prefill
+                        staged_prefix = (
+                            ks, vs, int(meta.get("plen", len(ks[0]))),
+                            meta.get("namespace"))
+                    else:
+                        staged_kv = (ks, vs,
+                                     int(meta.get("plen", len(ks[0]))),
+                                     int(meta.get("first_token", 0)))
+                        if meta.get("rng") is not None:
+                            # a v3 bundle: the prefill host's post-first-
+                            # token sampler state rides into adoption
+                            staged_kv += (tuple(meta["rng"]),)
             handle = self.scheduler.submit(
                 [int(t) for t in obj["prompt"]],
                 max_new_tokens=obj.get("max_new"),
@@ -334,11 +358,14 @@ class ServingWorker:
                 tenant=obj.get("tenant"),
                 cohort=obj.get("cohort"),
                 adapter_id=obj.get("adapter_id"),
-                prefix_namespace=obj.get("prefix_namespace"))
+                prefix_namespace=obj.get("prefix_namespace"),
+                staged_prefix=staged_prefix)
             self._requests[key] = handle
             self._trim_requests()
         return _kv.pack_payload({"ok": 1,
-                                 "staged": staged_kv is not None})
+                                 "staged": staged_kv is not None,
+                                 "staged_prefix":
+                                     staged_prefix is not None})
 
     def _trim_requests(self):
         """Bound the handle map like the other keyed caches — but only
@@ -371,6 +398,61 @@ class ServingWorker:
                     # (ISSUE 12) without bloating every poll round
                     out[key]["phases"] = handle.phases
         return _kv.pack_payload(out)
+
+    def _h_prefix_lookup(self, body, aux, reqid, rctx):
+        """OP_PREFIX_LOOKUP (ISSUE 18): how many tokens of `prompt`
+        this worker could serve from its prefix cache — HBM entries AND
+        host/disk-tiered continuations. Genuinely read-only (no refs,
+        LRU touches, or promotion), so the router can probe every shard
+        per placement without perturbing cache state anywhere."""
+        obj, _ = _kv.unpack_payload(body)
+        probe = getattr(self.engine, "prefix_probe", None)
+        n = 0
+        if probe is not None:
+            with self._lock:
+                n = int(probe([int(t) for t in obj["prompt"]],
+                              obj.get("namespace")))
+        return _kv.pack_payload({"match_tokens": n})
+
+    def _h_kv_export(self, body, aux, reqid, rctx):
+        """OP_KV_EXPORT (ISSUE 18): read this worker's cached chain for
+        `prompt` (HBM + tiers, tier records sha-verified) and stream it
+        to the target peer's staging area as a `prefix_only` KV bundle
+        under the caller's trace — the cross-host restore edge of the
+        fleet-global prefix cache. The chain stays resident here; the
+        peer registers a COPY. Retry-safe: a retried export re-reads
+        and re-puts the same bytes (idempotent overwrite, like KVPUT)."""
+        obj, _ = _kv.unpack_payload(body)
+        key = obj["key"]
+        ns = obj.get("namespace")
+        extract = getattr(self.engine, "extract_prefix_kv", None)
+        if extract is None:
+            return _kv.pack_payload({"ok": 0, "plen": 0, "bytes": 0})
+        with self._lock, RecordEvent(
+                "serving::kv_export", TracerEventType.UserDefined,
+                {"key": key, "tenant": obj.get("tenant") or "default"}):
+            ks, vs, plen = extract([int(t) for t in obj["prompt"]],
+                                   namespace=ns)
+        if plen < 1:
+            return _kv.pack_payload({"ok": 0, "plen": 0, "bytes": 0})
+        bundle = _kv.pack_kv_bundle(
+            ks, vs, meta={"key": key, "plen": int(plen),
+                          "prefix_only": True, "namespace": ns})
+        sent = 0
+        target = obj.get("decode_endpoint")
+        if target:
+            scope = _tc.trace_scope(rctx[0]) if rctx is not None else None
+            try:
+                if scope is not None:
+                    scope.__enter__()
+                self._peer(target).kv_put(0, key, bundle)
+            finally:
+                if scope is not None:
+                    scope.__exit__(None, None, None)
+            _M_HANDOFF_BYTES.inc(len(bundle))
+            sent = len(bundle)
+        return _kv.pack_payload({"ok": 1, "plen": int(plen),
+                                 "bytes": sent})
 
     def _h_swap(self, body, aux, reqid, rctx):
         obj, _ = _kv.unpack_payload(body)

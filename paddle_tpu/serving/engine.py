@@ -39,7 +39,7 @@ from ..profiler import RecordEvent, TracerEventType
 from . import blocks
 from . import kv_cache as kvc
 from . import sampling
-from .prefix_cache import PrefixCache
+from .prefix_cache import PrefixCache, prefix_key
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -729,7 +729,10 @@ class PagedEngineConfig(EngineConfig):
     def __init__(self, block_size=16, num_blocks=None,
                  enable_prefix_cache=True, attention_impl="gather",
                  kv_dtype="float32", weight_dtype="float32",
-                 capture_logits=False, **kwargs):
+                 capture_logits=False, enable_kv_tiers=False,
+                 host_tier_blocks=64, host_tier_dtype="float32",
+                 disk_tier_dir=None, disk_tier_blocks=256,
+                 disk_tier_compact_threshold=0.5, **kwargs):
         super().__init__(**kwargs)
         self.block_size = int(block_size)
         self.max_blocks_per_slot = -(-self.max_len // self.block_size)
@@ -765,10 +768,27 @@ class PagedEngineConfig(EngineConfig):
         # — the quant-quality harness's logit-KL tap. A different traced
         # program, still compiled exactly once.
         self.capture_logits = bool(capture_logits)
+        # KV memory hierarchy (ISSUE 18, serving.kv_tiers): evicted
+        # prefix-cache leaves demote to a pinned host pool (optionally
+        # int8-requantized) and cascade to an append-log disk tier
+        # instead of being freed; a match against a demoted chain
+        # promotes the blocks back. Default OFF: disabled tiering is
+        # bit-identical to the pre-tier engine, asserted in tests.
+        self.enable_kv_tiers = bool(enable_kv_tiers)
+        self.host_tier_blocks = int(host_tier_blocks)
+        if host_tier_dtype not in ("float32", "int8"):
+            raise ValueError(f"host_tier_dtype must be 'float32' or "
+                             f"'int8', got {host_tier_dtype!r}")
+        self.host_tier_dtype = host_tier_dtype
+        self.disk_tier_dir = disk_tier_dir
+        self.disk_tier_blocks = int(disk_tier_blocks)
+        self.disk_tier_compact_threshold = float(disk_tier_compact_threshold)
 
     _DICT_FIELDS = EngineConfig._DICT_FIELDS + (
         "block_size", "num_blocks", "enable_prefix_cache", "attention_impl",
-        "kv_dtype", "weight_dtype", "capture_logits")
+        "kv_dtype", "weight_dtype", "capture_logits", "enable_kv_tiers",
+        "host_tier_blocks", "host_tier_dtype", "disk_tier_dir",
+        "disk_tier_blocks", "disk_tier_compact_threshold")
 
 
 class PagedGenerationEngine(GenerationEngine):
@@ -852,6 +872,29 @@ class PagedGenerationEngine(GenerationEngine):
             self.block_pool.attach_ledger(self.kv_ledger)
             if self.prefix_cache is not None:
                 self.prefix_cache.attach_ledger(self.kv_ledger)
+        # KV tier store (ISSUE 18): plugged UNDER the prefix cache so
+        # eviction demotes and match promotes. The store's device I/O is
+        # the two eager callbacks below — host + transfer work only, so
+        # the compile-once decode contract survives tiering untouched.
+        self.kv_tiers = None
+        if getattr(c, "enable_kv_tiers", False) \
+                and self.prefix_cache is not None:
+            from .kv_tiers import TieredBlockStore
+            self.kv_tiers = TieredBlockStore(
+                self._tier_read_block, self._tier_write_block,
+                write_blocks=self._tier_write_blocks,
+                host_blocks=c.host_tier_blocks,
+                host_dtype=c.host_tier_dtype,
+                disk_dir=c.disk_tier_dir,
+                disk_blocks=c.disk_tier_blocks,
+                disk_compact_threshold=c.disk_tier_compact_threshold)
+            if self.kv_ledger is not None:
+                self.kv_tiers.attach_ledger(self.kv_ledger)
+            self.prefix_cache.attach_tier(self.kv_tiers)
+            # the ONE compiled restore scatter (fixed lane count —
+            # GARBAGE_BLOCK pads short runs); audited next to decode
+            self._tier_writer = jax.jit(self._tier_writer_fn)
+            self.trace_counts["tier_restore"] = 0
         self.last_prefill_stats = {}
         self.last_logits = None
 
@@ -875,6 +918,104 @@ class PagedGenerationEngine(GenerationEngine):
                 itemsize = 4
             per_side = c.block_size * heads * head_dim * itemsize
         return 2 * per_side * cfg.num_layers
+
+    # -- KV tier device I/O (ISSUE 18) --------------------------------------
+    def _tier_read_block(self, blk):
+        """TieredBlockStore's read callback: one physical block's
+        whole-model KV as pool-NATIVE host numpy arrays — f32 slabs, or
+        int8 codes + their scale rows for quantized pools (lossless
+        either way). Eager gathers only; never a traced program."""
+        blk = int(blk)
+        arrays = {}
+        for li, layer in enumerate(self._pool):
+            arrays[f"k{li}"] = np.asarray(jax.device_get(layer.k[blk]))
+            arrays[f"v{li}"] = np.asarray(jax.device_get(layer.v[blk]))
+            if hasattr(layer, "k_scale"):
+                arrays[f"ks{li}"] = np.asarray(
+                    jax.device_get(layer.k_scale[blk]), np.float32)
+                arrays[f"vs{li}"] = np.asarray(
+                    jax.device_get(layer.v_scale[blk]), np.float32)
+        return {"arrays": arrays, "quant": self.kv_quantized}
+
+    def _tier_write_block(self, blk, arrays):
+        """TieredBlockStore's write callback: scatter one block's
+        pool-native arrays back into the live pool. All host->device
+        transfers are issued FIRST (`jax.device_put` — the async
+        prefetch that overlaps the caller's suffix prefill), then the
+        per-layer eager `.at[blk].set` updates commit the pool. Eager
+        ops only: tier promotion can never add a traced program, which
+        is what keeps the decode compile count at exactly one."""
+        blk = int(blk)
+        dev = {n: jax.device_put(np.asarray(a))
+               for n, a in arrays.items()}
+        npool = []
+        for li, layer in enumerate(self._pool):
+            if hasattr(layer, "k_scale"):
+                npool.append(blocks.QuantPagedLayerKV(
+                    layer.k.at[blk].set(dev[f"k{li}"]),
+                    layer.v.at[blk].set(dev[f"v{li}"]),
+                    layer.k_scale.at[blk].set(dev[f"ks{li}"]),
+                    layer.v_scale.at[blk].set(dev[f"vs{li}"])))
+            else:
+                npool.append(blocks.PagedLayerKV(
+                    layer.k.at[blk].set(
+                        dev[f"k{li}"].astype(layer.k.dtype)),
+                    layer.v.at[blk].set(
+                        dev[f"v{li}"].astype(layer.v.dtype))))
+        self._pool = tuple(npool)
+
+    def _tier_writer_fn(self, pool, idx, payload):
+        """The batched tier-restore program: one fixed-shape scatter of
+        a whole promoted chain run into every pool array. `idx` is
+        padded to `max_blocks_per_slot` lanes with GARBAGE_BLOCK —
+        writes there are discarded by contract (the same scratch row
+        masked decode writes land in), so one compiled shape serves
+        every run length and the program compiles exactly ONCE per
+        engine (`trace_counts["tier_restore"]`)."""
+        self.trace_counts["tier_restore"] = \
+            self.trace_counts.get("tier_restore", 0) + 1  # trace-time only
+        out = []
+        for layer, pl in zip(pool, payload):
+            if hasattr(layer, "k_scale"):
+                out.append(blocks.QuantPagedLayerKV(
+                    layer.k.at[idx].set(pl[0]),
+                    layer.v.at[idx].set(pl[1]),
+                    layer.k_scale.at[idx].set(pl[2]),
+                    layer.v_scale.at[idx].set(pl[3])))
+            else:
+                out.append(blocks.PagedLayerKV(
+                    layer.k.at[idx].set(pl[0].astype(layer.k.dtype)),
+                    layer.v.at[idx].set(pl[1].astype(layer.v.dtype))))
+        return tuple(out)
+
+    def _tier_write_blocks(self, blks, arrays_list):
+        """Batched tier restore for a whole chain run: pad the run to
+        the fixed `max_blocks_per_slot` lane count (GARBAGE_BLOCK lanes
+        absorb the padding) and commit it through ONE compiled scatter
+        call — a cold chain of m blocks costs one dispatch, not
+        O(m * layers) eager ops, which is what lets a host-tier restore
+        beat recomputing the prefix even on CPU-dispatch-bound hosts.
+        Runs longer than the lane count chunk."""
+        lanes = max(int(self.config.max_blocks_per_slot), 1)
+        for lo in range(0, len(blks), lanes):
+            run = blks[lo:lo + lanes]
+            arrs = arrays_list[lo:lo + lanes]
+            m = len(run)
+            idx = np.full((lanes,), blocks.GARBAGE_BLOCK, np.int32)
+            idx[:m] = [int(b) for b in run]
+            payload = []
+            for li, layer in enumerate(self._pool):
+                names = (f"k{li}", f"v{li}", f"ks{li}", f"vs{li}") \
+                    if hasattr(layer, "k_scale") else (f"k{li}", f"v{li}")
+                lanes_pl = []
+                for n in names:
+                    first = np.asarray(arrs[0][n])
+                    pad = np.zeros((lanes,) + first.shape, first.dtype)
+                    pad[:m] = [np.asarray(a[n]) for a in arrs]
+                    lanes_pl.append(pad)
+                payload.append(tuple(lanes_pl))
+            self._pool = self._tier_writer(self._pool, idx,
+                                           tuple(payload))
 
     # -- int8 decode weights (ISSUE 11) --------------------------------------
     def _weight_quant_axis(self, name, arr):
@@ -1118,9 +1259,13 @@ class PagedGenerationEngine(GenerationEngine):
         # record=False: the hit/miss counters tick only when this prefill
         # STICKS — a BlockAllocError below means the scheduler will retry
         # and a per-attempt count would inflate the gated hit rate
+        # reserve = this prompt's total block need: tier promotion may
+        # alloc to restore cold chain blocks, but never below the
+        # headroom the suffix prefill is about to claim (ISSUE 18)
         shared_ids, nshared = ([], 0) if self.prefix_cache is None \
-            else self.prefix_cache.match(toks, record=False,
-                                         namespace=namespace)
+            else self.prefix_cache.match(
+                toks, record=False, namespace=namespace,
+                reserve=blocks.blocks_for_tokens(plen, bs))
         n_priv = blocks.blocks_for_tokens(plen, bs) - nshared // bs
         try:
             priv = self._alloc_blocks(n_priv, requester=namespace) \
@@ -1158,9 +1303,14 @@ class PagedGenerationEngine(GenerationEngine):
             self.prefix_cache.insert(toks, row, (plen // bs) * bs,
                                      namespace=namespace)
             self.prefix_cache.record_lookup(nshared > 0)
+        tier_stats = self.prefix_cache.last_tier_stats \
+            if self.prefix_cache is not None \
+            else {"promoted_blocks": 0, "restore_s": 0.0}
         self.last_prefill_stats = {
             "prefix_hit_tokens": nshared, "blocks_allocated": n_priv,
-            "suffix_bucket": bucket}
+            "suffix_bucket": bucket,
+            "tier_promoted_blocks": tier_stats["promoted_blocks"],
+            "tier_restore_s": tier_stats["restore_s"]}
         first = int(first)
         self._last_tokens[slot] = np.int32(first)
         return first
@@ -1417,6 +1567,162 @@ class PagedGenerationEngine(GenerationEngine):
                         blocks.write(layer.v, v[None], row, zero)))
             return self._constrain_pools(tuple(npool))
         return self._cached(adopt_fn, f"adopt[{bucket}]")
+
+    # -- fleet-global prefix cache halves (ISSUE 18) -------------------------
+    def prefix_probe(self, prompt_ids, namespace=None):
+        """Longest servable cached-prefix length for `prompt_ids`, in
+        tokens, counting HBM entries AND tiered continuations.
+        Side-effect-free (no refs, no LRU touches, no promotion) — the
+        `OP_PREFIX_LOOKUP` readonly fabric verb answers from this, and
+        the DistFrontend's affinity sweep calls it on every shard."""
+        if self.prefix_cache is None:
+            return 0
+        toks = [int(t) for t in
+                np.asarray(prompt_ids, np.int64).reshape(-1)]
+        return int(self.prefix_cache.probe(toks, namespace))
+
+    def extract_prefix_kv(self, prompt_ids, namespace=None):
+        """The fleet restore SOURCE half: read this engine's cached
+        chain for `prompt_ids` — HBM entries and tiered continuations
+        both — as per-layer [plen, heads, head_dim] float32 host arrays
+        (the `extract_kv` wire shape), plus the covered token count.
+        Entries stay resident here; the peer registers a COPY. Tiered
+        records are verified (sha256 on disk) before export — a corrupt
+        record ends the walk, shipping only the good prefix."""
+        if self.prefix_cache is None:
+            return [], [], 0
+        toks = [int(t) for t in
+                np.asarray(prompt_ids, np.int64).reshape(-1)]
+        bs = self.config.block_size
+        cache = self.prefix_cache
+        nl = len(self._pool)
+        parts_k = [[] for _ in range(nl)]
+        parts_v = [[] for _ in range(nl)]
+        n = 0
+        for k in range((len(toks) - 1) // bs):
+            key = prefix_key(toks[:(k + 1) * bs], namespace)
+            blk = cache._entries.get(key)
+            if blk is not None:
+                for li, layer in enumerate(self._pool):
+                    if self.kv_quantized:
+                        kb = blocks.dequant(layer.k[blk][None],
+                                            layer.k_scale[blk][None])[0]
+                        vb = blocks.dequant(layer.v[blk][None],
+                                            layer.v_scale[blk][None])[0]
+                    else:
+                        kb, vb = layer.k[blk], layer.v[blk]
+                    parts_k[li].append(
+                        np.asarray(jax.device_get(kb), np.float32))
+                    parts_v[li].append(
+                        np.asarray(jax.device_get(vb), np.float32))
+                n += 1
+                continue
+            rec = self.kv_tiers.peek(key) if self.kv_tiers is not None \
+                and key in self.kv_tiers else None
+            if rec is None:
+                break
+            for li in range(nl):
+                kb = np.asarray(rec["arrays"][f"k{li}"])
+                vb = np.asarray(rec["arrays"][f"v{li}"])
+                if rec.get("quant"):
+                    ksc = rec["arrays"][f"ks{li}"]
+                    vsc = rec["arrays"][f"vs{li}"]
+                    kb = np.asarray(blocks.dequant_codes(
+                        kb, ksc[None, :, None]), np.float32)
+                    vb = np.asarray(blocks.dequant_codes(
+                        vb, vsc[None, :, None]), np.float32)
+                parts_k[li].append(np.asarray(kb, np.float32))
+                parts_v[li].append(np.asarray(vb, np.float32))
+            n += 1
+        if n == 0:
+            return [], [], 0
+        ks = [np.ascontiguousarray(np.concatenate(p)) for p in parts_k]
+        vs = [np.ascontiguousarray(np.concatenate(p)) for p in parts_v]
+        return ks, vs, n * bs
+
+    def restore_prefix(self, prompt_ids, ks, vs, plen, namespace=None):
+        """The fleet restore SINK half: register another host's exported
+        prefix chain into THIS engine's prefix cache, so the very next
+        local prefill of `prompt_ids` matches it like a warm local
+        chain. Eager per-block device writes only (`_tier_write_block`)
+        — no new traced programs, the compile-once contract holds.
+
+        Fires `serving.kv_restore` once for the whole bundle: raise or
+        truncate degrades to restoring NOTHING (the prefill recomputes
+        — never a partial/corrupt registration). Allocation pressure
+        (BlockAllocError after eviction) ends the walk early: the good
+        prefix registered so far still matches. Returns tokens now
+        servable from the restored chain (multiple of block_size)."""
+        if self.prefix_cache is None or int(plen) < 1:
+            return 0
+        try:
+            spec = _faults.fire("serving.kv_restore")
+        except Exception:
+            return 0
+        if spec is not None and spec.mode == "truncate":
+            return 0
+        cfg = self._model.cfg
+        head_shape = (cfg.num_heads, cfg.hidden_size // cfg.num_heads)
+        if len(ks) != cfg.num_layers or len(vs) != cfg.num_layers:
+            raise ValueError(
+                f"restore bundle has {len(ks)}/{len(vs)} layers, model "
+                f"has {cfg.num_layers}")
+        for arr in list(ks) + list(vs):
+            if tuple(np.asarray(arr).shape) != (int(plen),) + head_shape:
+                raise ValueError(
+                    f"restore layer shape {tuple(np.asarray(arr).shape)} "
+                    f"!= {(int(plen),) + head_shape}")
+        toks = [int(t) for t in
+                np.asarray(prompt_ids, np.int64).reshape(-1)]
+        bs = self.config.block_size
+        n = min(int(plen) // bs, (len(toks) - 1) // bs)
+        cache = self.prefix_cache
+        prev_key = None
+        restored = 0
+        for k in range(n):
+            key = prefix_key(toks[:(k + 1) * bs], namespace)
+            if key in cache._entries:
+                cache._touch(key)
+                prev_key = key
+                restored += 1
+                continue
+            if self.kv_tiers is not None and key in self.kv_tiers:
+                # the continuation is tiered locally: stop registering —
+                # a later entry whose parent lives in a cold tier would
+                # orphan the chain (match promotes the tiered entry
+                # itself when the prefill arrives)
+                break
+            try:
+                blk = int(self._alloc_blocks(1, requester=namespace)[0])
+            except blocks.BlockAllocError:
+                break
+            arrays = {}
+            for li, layer in enumerate(self._pool):
+                kb = np.ascontiguousarray(np.asarray(
+                    ks[li][k * bs:(k + 1) * bs], np.float32))
+                vb = np.ascontiguousarray(np.asarray(
+                    vs[li][k * bs:(k + 1) * bs], np.float32))
+                if hasattr(layer, "k_scale"):
+                    ksc = np.maximum(
+                        np.abs(kb).max(axis=(0, 2)), 1e-30
+                    ).astype(np.float32)
+                    vsc = np.maximum(
+                        np.abs(vb).max(axis=(0, 2)), 1e-30
+                    ).astype(np.float32)
+                    arrays[f"k{li}"] = np.asarray(blocks.quantize_codes(
+                        kb, ksc[None, :, None]), np.int8)
+                    arrays[f"v{li}"] = np.asarray(blocks.quantize_codes(
+                        vb, vsc[None, :, None]), np.int8)
+                    arrays[f"ks{li}"] = ksc
+                    arrays[f"vs{li}"] = vsc
+                else:
+                    arrays[f"k{li}"] = kb
+                    arrays[f"v{li}"] = vb
+            self._tier_write_block(blk, arrays)
+            cache.register_block(key, blk, namespace, prev_key)
+            prev_key = key
+            restored += 1
+        return restored * bs
 
     def reset_slot(self, slot):
         """Free the slot: every table entry drops the request's

@@ -1,0 +1,36 @@
+"""KV memory hierarchy (ISSUE 18): host-RAM + disk block tiers behind
+the BlockPool/PrefixCache contracts, plus the fleet-global prefix cache
+plumbing.
+
+The block pool is HBM-only and the prefix cache is per-process;
+millions-of-users prefix reuse dies the moment the hot set exceeds one
+host's HBM. This package adds the colder tiers of the paper's layer-1
+memory hierarchy:
+
+  HBM pool  --evict-->  HostTier (pinned host numpy, optionally
+                        int8-requantized through the canonical
+                        quantize_codes/dequant_codes pair)
+            --pressure-->  DiskTier (append-only block log + index,
+                           torn-tail recovery, sha256 verify-at-restore,
+                           threshold compaction — the DiskSparseTable /
+                           ckpt_commit fsync idiom)
+
+`TieredBlockStore` orchestrates the two and is what the engine attaches
+to its PrefixCache: eviction demotes instead of freeing, a prefix match
+against a demoted chain promotes blocks back into freshly allocated HBM
+with `jax.device_put` prefetch, and every residency transition emits a
+kvledger `tier_demote`/`tier_promote`/`tier_drop` event so the
+reconciler proves zero blocks leak ACROSS tiers. Corruption anywhere
+(torn spill, torn restore, sha mismatch) degrades to miss-and-recompute
+— never wrong KV.
+
+The fleet-global half (OP_PREFIX_LOOKUP affinity routing + cross-host
+chain restore over the kv_handoff wire) lives in serving/distributed/;
+this package is single-process and jax-light (the only device work is
+the engine-provided read/write callbacks).
+"""
+from .disk import DiskTier
+from .host import HostTier
+from .store import TieredBlockStore
+
+__all__ = ["HostTier", "DiskTier", "TieredBlockStore"]

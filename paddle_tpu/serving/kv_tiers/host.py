@@ -1,0 +1,124 @@
+"""Host-RAM KV tier: pinned numpy copies of evicted prefix blocks.
+
+One entry holds one block's whole-model KV payload (every layer, both
+sides, plus scale rows for quantized pools) in the POOL-NATIVE format
+the engine's read callback produced, keyed by the prefix-chain entry
+key. LRU-ordered: `overflow()` surfaces the coldest entries for the
+store to demote to disk (or drop) when the tier exceeds capacity.
+
+`dtype="int8"` re-quantizes float payloads on the way in through THE
+canonical `quantize_codes`/`dequant_codes` pair (per-block per-head
+abs-max scales, the same rule as the int8 KV pools) and reconstitutes
+f32 on the way out — lossy, bounded by the PR 11 quality gate, and a
+4x capacity win per host byte. Payloads that are already int8 codes
+(quantized pools) store losslessly regardless.
+"""
+import collections
+
+import numpy as np
+
+from ..blocks import dequant_codes, quantize_codes
+
+__all__ = ["HostTier"]
+
+# array-name suffix marking a host-requantized pair: "k3" becomes
+# "k3/q8" (codes) + "k3/s8" (per-head scales)
+_Q8 = "/q8"
+_S8 = "/s8"
+
+
+class HostTier:
+    """Capacity-bounded {chain key -> block record} host store. A record
+    is `{"ns", "parent", "quant", "arrays": {name: np.ndarray}}` — the
+    arrays dict is exactly what the engine's block reader produced (and
+    what its writer accepts back), so the tier never needs to know the
+    pool's layer layout."""
+
+    def __init__(self, capacity_blocks, dtype="float32"):
+        if dtype not in ("float32", "int8"):
+            raise ValueError(f"host tier dtype must be 'float32' or "
+                             f"'int8', got {dtype!r}")
+        self.capacity = int(capacity_blocks)
+        self.dtype = dtype
+        self._entries = collections.OrderedDict()   # key -> rec, LRU first
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __contains__(self, key):
+        return key in self._entries
+
+    def keys(self):
+        return list(self._entries)
+
+    # -- codec ---------------------------------------------------------------
+    def _encode(self, rec):
+        """int8 mode: requantize each float array through the canonical
+        pair (per-head abs-max over the block). int8 inputs (codes,
+        scale rows of a quantized pool) pass through losslessly."""
+        if self.dtype != "int8":
+            return rec
+        arrays = {}
+        for name, a in rec["arrays"].items():
+            if a.dtype != np.float32 or a.ndim != 3:
+                arrays[name] = a          # codes / scale rows: lossless
+                continue
+            # a: [block_size, heads, head_dim] -> per-head abs-max [h]
+            scale = np.maximum(np.abs(a).max(axis=(0, 2)), 1e-30)
+            codes = np.asarray(
+                quantize_codes(a, scale[None, :, None]), np.int8)
+            arrays[name + _Q8] = codes
+            arrays[name + _S8] = scale.astype(np.float32)
+        return dict(rec, arrays=arrays)
+
+    @staticmethod
+    def _decode(rec):
+        """Reconstitute pool-native arrays from a possibly-requantized
+        record (the inverse of `_encode`, through `dequant_codes`)."""
+        if not any(n.endswith(_Q8) for n in rec["arrays"]):
+            return rec
+        arrays = {}
+        for name, a in rec["arrays"].items():
+            if name.endswith(_S8):
+                continue
+            if name.endswith(_Q8):
+                scale = rec["arrays"][name[:-len(_Q8)] + _S8]
+                arrays[name[:-len(_Q8)]] = np.asarray(
+                    dequant_codes(a, scale[None, :, None]), np.float32)
+            else:
+                arrays[name] = a
+        return dict(rec, arrays=arrays)
+
+    # -- tier ops ------------------------------------------------------------
+    def put(self, key, rec):
+        """Store (or refresh) one block record at MRU position. The
+        caller (TieredBlockStore) fires the `serving.kv_spill` site and
+        decides what a torn spill means — this container only stores."""
+        self._entries.pop(key, None)
+        self._entries[key] = self._encode(rec)
+
+    def get(self, key):
+        """Pool-native record or None; a hit refreshes LRU position."""
+        rec = self._entries.get(key)
+        if rec is None:
+            return None
+        self._entries.move_to_end(key)
+        return self._decode(rec)
+
+    def raw(self, key):
+        """The stored (possibly requantized) record, LRU untouched —
+        what demotion to disk serializes, avoiding a decode/re-encode
+        round trip."""
+        return self._entries.get(key)
+
+    def drop(self, key):
+        return self._entries.pop(key, None) is not None
+
+    def overflow(self):
+        """Pop and return the coldest entries beyond capacity as
+        [(key, raw record)] — the store demotes them to disk or drops
+        them, emitting the ledger events either way."""
+        out = []
+        while len(self._entries) > max(self.capacity, 0):
+            out.append(self._entries.popitem(last=False))
+        return out

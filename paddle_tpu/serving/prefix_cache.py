@@ -32,6 +32,20 @@ Hit/miss counters (per prefill lookup) and the resident-block gauge feed
 the unified metrics registry; `tools/metrics_report.py --compare` treats
 a prefix-hit-rate drop as a failure-class regression.
 
+KV tiers (ISSUE 18): `attach_tier` plugs a
+`serving.kv_tiers.TieredBlockStore` under the cache. Eviction then
+DEMOTES instead of freeing — the entry's KV is captured into the host
+tier (cascading to disk under host pressure) before the block returns
+to the pool — and `match` PROMOTES: when the HBM walk breaks on a key a
+colder tier holds, the block is re-allocated, its KV written back
+eagerly (device_put prefetch — host/transfer work only, never a new
+traced program), and the entry re-registered cache-owned, so the match
+continues through it. Promotion respects a `reserve` headroom hint so
+restoring a cold chain can never starve the suffix prefill's own
+allocation. Because demoted entries leave `_entries`/`_resident`,
+tenant quotas meter the HBM tier only — an over-quota namespace SPILLS
+instead of dropping (ISSUE 18's quota contract).
+
 Multi-tenant namespaces (ISSUE 17): a request's prefix NAMESPACE salts
 every chain key, so two tenants in different namespaces can never share
 a block even for identical prompts — sharing stops at the trust
@@ -43,10 +57,11 @@ pressure can never evict a paying tenant's system prompt. Requests with
 no namespace (and caches with no quotas) behave exactly as before.
 """
 import hashlib
+import time
 
 from ..observability import kvledger as _kvl
 from ..observability import metrics as _metrics
-from .blocks import GARBAGE_BLOCK
+from .blocks import GARBAGE_BLOCK, BlockAllocError
 
 __all__ = ["PrefixCache", "prefix_key", "DEFAULT_NAMESPACE"]
 
@@ -106,9 +121,19 @@ class PrefixCache:
         # and refines the origin of its own pool refs so the shadow
         # model classifies holders as shared/cached, not private
         self._ledger = None
+        # cold-tier store (ISSUE 18, serving.kv_tiers): None keeps the
+        # pre-tier behavior bit for bit — evictions free, misses miss
+        self._tier = None
+        # last match's promotion figures, the engine's prefill-stats tap
+        # (the scheduler attributes them to the request as tier_hit /
+        # restore_ms in its serving JSONL)
+        self.last_tier_stats = {"promoted_blocks": 0, "restore_s": 0.0}
 
     def attach_ledger(self, ledger):
         self._ledger = ledger
+
+    def attach_tier(self, store):
+        self._tier = store
 
     # -- namespace quotas (ISSUE 17) -----------------------------------------
     def set_quota(self, namespace, blocks):
@@ -161,11 +186,21 @@ class PrefixCache:
         self._lru[key] = self._seq
 
     # -- lookup --------------------------------------------------------------
-    def match(self, prompt, record=True, namespace=None):
+    def match(self, prompt, record=True, namespace=None, reserve=0):
         """Longest cached block chain covering a strict prefix of
         `prompt`. Returns (block_ids, n_tokens) with one pool reference
         taken per returned block (owned by the caller's table row).
         n_tokens is always a multiple of block_size and <= len(prompt)-1.
+
+        With a tier store attached (ISSUE 18), a break in the HBM walk
+        probes the colder tiers and PROMOTES resident continuation
+        blocks back into freshly allocated HBM, so a cold chain still
+        matches. `reserve` is the caller's total block need for this
+        prompt (`blocks_for_tokens(plen)`): promotion of block k only
+        proceeds while `pool.available > reserve - k - 1`, i.e. it can
+        never eat the headroom the suffix prefill is about to allocate
+        — a promote that would force the caller into BlockAllocError is
+        skipped, leaving the entry tiered for a calmer moment.
 
         record=False skips the hit/miss counters — callers whose
         placement can fail-and-retry (BlockAllocError -> preempt ->
@@ -175,6 +210,7 @@ class PrefixCache:
         bs = self.block_size
         usable = (len(prompt) - 1) // bs      # full blocks, 1 token spared
         ids = []
+        prev_key = None
         for k in range(usable):
             key = prefix_key(prompt[:(k + 1) * bs], namespace)
             blk = self._entries.get(key)
@@ -182,6 +218,22 @@ class PrefixCache:
                 break
             ids.append(blk)
             self._touch(key)
+            prev_key = key
+        self.last_tier_stats = {"promoted_blocks": 0, "restore_s": 0.0}
+        if self._tier is not None and len(ids) < usable:
+            # eviction is leaf-first, so the tiered part of a chain is
+            # always a contiguous SUFFIX of the HBM walk — promote the
+            # whole run in one batched device write
+            t0 = time.perf_counter()
+            promoted = self._promote_run(prompt, len(ids), usable,
+                                         namespace, prev_key, reserve)
+            for key, blk in promoted:
+                ids.append(blk)
+                self._touch(key)
+            if promoted:
+                self.last_tier_stats = {
+                    "promoted_blocks": len(promoted),
+                    "restore_s": time.perf_counter() - t0}
         if ids and self._ledger is not None:
             with _kvl.origin_scope("prefix_cache.match"):
                 for b in ids:
@@ -197,6 +249,86 @@ class PrefixCache:
     def record_lookup(self, hit):
         """Count one prefill lookup toward the hit-rate metrics."""
         (_M_HITS if hit else _M_MISSES).inc()
+
+    def probe(self, prompt, namespace=None):
+        """Longest servable prefix in TOKENS, side-effect-free: no pool
+        refs, no LRU touches, no promotion, no counters — counts HBM
+        entries AND tiered continuations. The `OP_PREFIX_LOOKUP` fabric
+        verb answers from this (readonly verbs must not mutate)."""
+        bs = self.block_size
+        usable = (len(prompt) - 1) // bs
+        n = 0
+        for k in range(usable):
+            key = prefix_key(prompt[:(k + 1) * bs], namespace)
+            if key in self._entries or \
+                    (self._tier is not None and key in self._tier):
+                n += 1
+            else:
+                break
+        return n * bs
+
+    def _promote_run(self, prompt, k0, usable, namespace, parent,
+                     reserve):
+        """Promote the contiguous tiered continuation of `prompt`'s
+        chain (blocks k0..usable) back into HBM in ONE batched device
+        write. The sequential headroom rule is precomputed: promoting
+        block k is allowed only while the pool's availability, net of
+        the run's earlier promotes, stays >= max(reserve - k, 1) — a
+        promote that would force the caller's suffix prefill into
+        BlockAllocError is skipped, leaving the tail tiered for a
+        calmer moment. Each allocation's refcount-1 becomes the cache's
+        own reference (the normal insert path's ref), mirrored to the
+        ledger as a cache_insert so the shadow model's cached set and
+        evictable() stay exact. Returns [(key, block_id)] in chain
+        order."""
+        bs = self.block_size
+        store = self._tier
+        keys = []
+        for k in range(k0, usable):
+            key = prefix_key(prompt[:(k + 1) * bs], namespace)
+            if key not in store:
+                break
+            keys.append(key)
+        avail = self.pool.available
+        m = 0
+        for j in range(len(keys)):
+            if avail - j < max(int(reserve) - (k0 + j), 1):
+                break
+            m += 1
+        if not m:
+            return []
+
+        def alloc_run(n):
+            try:
+                if self._ledger is not None:
+                    with _kvl.origin_scope("prefix_cache.promote"):
+                        return list(self.pool.alloc(n))
+                return list(self.pool.alloc(n))
+            except BlockAllocError:
+                return None
+
+        out = []
+        for key, blk in store.promote_run(keys[:m], alloc_run):
+            self.register_block(key, blk, namespace, parent)
+            parent = key
+            out.append((key, blk))
+        return out
+
+    def register_block(self, key, blk, namespace, parent):
+        """Register an ALREADY-ALLOCATED block (refcount 1, owned by
+        nobody else) as a cache entry — the promotion/fleet-restore
+        twin of `insert`, which instead refs blocks a request's table
+        row owns. The allocation's own reference becomes the cache's."""
+        if self._ledger is not None:
+            self._ledger.cache_insert((int(blk),))
+        self._entries[key] = int(blk)
+        self._ns[key] = namespace
+        self._resident[namespace] = self._resident.get(namespace, 0) + 1
+        self._parent[key] = parent
+        if parent is not None:
+            self._children[parent] = self._children.get(parent, 0) + 1
+        self._touch(key)
+        _M_BLOCKS.set(len(self._entries))
 
     # -- registration --------------------------------------------------------
     def insert(self, prompt, table_row, upto_tokens, namespace=None):
@@ -277,6 +409,13 @@ class PrefixCache:
                 if blk is None or self.pool.refcount(blk) != 1 \
                         or self._children.get(key, 0) > 0:
                     continue
+                if self._tier is not None:
+                    # demote-instead-of-free (ISSUE 18): capture the
+                    # block's KV into the cold tiers while it is still
+                    # allocated; the eviction below then releases the
+                    # HBM copy exactly as before. A torn spill simply
+                    # skips the capture — lost, never corrupt.
+                    self._tier.demote(key, ns, self._parent.get(key), blk)
                 if self._ledger is not None:
                     # cache_evict BEFORE the unref so a replay never
                     # sees the cache holding a freed block

@@ -262,6 +262,13 @@ class Request:
         self.prefix_hit = False           # prefill reused cached blocks
         self.adopted = False              # placed from a handed-off bundle
         self._staged = None               # (ks, vs, plen, first_token)
+        # fleet prefix restore (ISSUE 18): a wire-shipped PREFIX chain
+        # (ks, vs, plen, namespace) registered into the local prefix
+        # cache just before this request's own prefill runs
+        self._staged_prefix = None
+        self.kv_restored_tokens = 0       # tokens the restore registered
+        self.tier_hit = False             # prefill restored tiered KV
+        self.restore_s = 0.0              # seconds spent restoring it
         self.spec_proposed = 0            # draft tokens proposed for us
         self.spec_accepted = 0            # ... and accepted by verify
         self._exec_prompt = None          # recompute prompt after preempt
@@ -467,7 +474,9 @@ class Scheduler:
         pool = getattr(engine, "block_pool", None)
         self._kv_reconciler = (
             _kvl.LedgerReconciler(ledger, pool,
-                                  getattr(engine, "prefix_cache", None))
+                                  getattr(engine, "prefix_cache", None),
+                                  tier_store=getattr(engine, "kv_tiers",
+                                                     None))
             if ledger is not None and pool is not None else None)
         self._kv_events_written = 0
         self._metrics_f = (open(self.config.metrics_path, "a")
@@ -554,7 +563,7 @@ class Scheduler:
     def submit(self, prompt, max_new_tokens=None, timeout_s=None,
                priority="standard", staged_kv=None, rng_seed=None,
                rng_gen=0, tenant=None, cohort=None, adapter_id=None,
-               prefix_namespace=None):
+               prefix_namespace=None, staged_prefix=None):
         """`staged_kv=(ks, vs, plen, first_token[, rng])` places the
         request from a handed-off KV bundle (another host already ran
         its prefill) instead of computing prefill locally — `prompt`
@@ -589,7 +598,17 @@ class Scheduler:
         BEFORE the shed watermark: a request costing more tokens
         (prompt + max_new) than the bucket holds raises
         RateLimitedError, ticks serving_rate_limited_total{tenant}, and
-        leaves a replayable rate_limit decision record."""
+        leaves a replayable rate_limit decision record.
+
+        `staged_prefix=(ks, vs, plen, namespace)` (ISSUE 18) is a
+        fleet-shipped PREFIX chain: at placement the scheduler first
+        registers it into the local prefix cache (a named `kv_restore`
+        timeline phase) so the request's own prefill then matches it
+        like a warm local chain — the affinity-miss restore path. The
+        request still owns its full prompt: a restore that fails for
+        ANY reason (pressure, torn wire payload, chaos) degrades to
+        plain recompute, and preemption drops the staged bundle exactly
+        like staged_kv."""
         prompt = [int(t) for t in prompt]
         now = self._clock()
         max_new = self.config.default_max_new_tokens \
@@ -668,6 +687,9 @@ class Scheduler:
         if staged_kv is not None and hasattr(self.engine, "adopt_kv") \
                 and int(staged_kv[2]) == len(prompt):
             req._staged = staged_kv
+        if staged_prefix is not None \
+                and hasattr(self.engine, "restore_prefix"):
+            req._staged_prefix = staged_prefix
         self._queue.append(req)
         self._decide("admit", req,
                      dict(shed_inputs, max_queue=self.config.max_queue,
@@ -1271,6 +1293,7 @@ class Scheduler:
         self._bind_slot_tenancy(slot, req)
         staged = req._staged
         if staged is None:
+            self._restore_staged_prefix(req)
             req.trail.begin(_rt.PH_PREFILL, self._clock())
             return self._engine_prefill(slot, req)
         req.trail.begin(_rt.PH_ADOPT, self._clock())
@@ -1304,6 +1327,37 @@ class Scheduler:
         req.adopted = True
         _M_ADOPTED.inc()
         return first
+
+    def _restore_staged_prefix(self, req):
+        """Register a fleet-shipped prefix chain (ISSUE 18) into the
+        local prefix cache as its own named `kv_restore` timeline phase,
+        one-shot: the bundle is consumed whatever happens, and a restore
+        that fails for any reason simply restores 0 tokens — the prefill
+        that follows recomputes, bit-identically. The restored chain is
+        cache-owned (not slot-owned), so a BlockAllocError-preempted
+        retry still matches it locally."""
+        sp = req._staged_prefix
+        if sp is None:
+            return
+        req._staged_prefix = None
+        req.trail.begin(_rt.PH_KV_RESTORE, self._clock())
+        t0 = time.perf_counter()
+        try:
+            with self._kv_attr(req, "kv_restore"):
+                req.kv_restored_tokens = int(self.engine.restore_prefix(
+                    req.exec_prompt, sp[0], sp[1], sp[2],
+                    namespace=sp[3]))
+            if req.kv_restored_tokens > 0:
+                req.tier_hit = True
+                req.restore_s += time.perf_counter() - t0
+        except Exception as e:                           # noqa: BLE001
+            with RecordEvent("serving::kv_restore_fallback",
+                             TracerEventType.UserDefined,
+                             {"request": req.id,
+                              "error": f"{type(e).__name__}: "
+                                       f"{str(e)[:160]}"}):
+                pass
+            req.kv_restored_tokens = 0
 
     def _bind_slot_tenancy(self, slot, req):
         """Bind the slot to the request's adapter before placement
@@ -1376,6 +1430,9 @@ class Scheduler:
         stats = getattr(self.engine, "last_prefill_stats", None) or {}
         if stats.get("prefix_hit_tokens", 0) > 0:
             req.prefix_hit = True
+        if stats.get("tier_promoted_blocks", 0) > 0:
+            req.tier_hit = True
+            req.restore_s += stats.get("tier_restore_s", 0.0)
         self._decide("place", req,
                      {"slot": slot, "queue_depth": len(self._queue),
                       "priority": req.priority,
@@ -1524,6 +1581,9 @@ class Scheduler:
             **({"prefix_namespace": str(req.prefix_namespace)}
                if req.prefix_namespace is not None else {}),
             **({"rate_limited": True} if req.rate_limited else {}),
+            **({"tier_hit": True,
+                "restore_ms": round(req.restore_s * 1e3, 3)}
+               if req.tier_hit else {}),
             "prompt_len": len(req.prompt), "tokens": len(req.tokens),
             "priority": req.priority, "preempted": req.preempted,
             "prefix_hit": req.prefix_hit, "adopted": req.adopted,

@@ -826,3 +826,65 @@ def test_bench_serve_dist_emits_fleet_artifacts(tmp_path):
     assert {"queue", "prefill", "place", "decode"} <= phases, phases
     assert any(s["phase"] == "kv_handoff"
                for t in timelines for s in t["phases"])
+
+
+def test_metrics_compare_flags_kv_tier_regressions(tmp_path):
+    """ISSUE 18 gate, all three failure-class rules of the KV memory
+    hierarchy: a per-tier hit-RATE drop (the generic hits/misses pair,
+    per tier label — fires even when hit counts grew with traffic),
+    serving_kv_restore_seconds p99 growth (promotion losing its race
+    against recompute), and corrupt/drop counter growth (corrupt from a
+    zero baseline — a single verify failure gates)."""
+    fast = {"0.005": 95, "0.01": 99, "0.05": 100, "+Inf": 100}
+    slow = {"0.005": 5, "0.01": 40, "0.05": 99, "+Inf": 100}
+
+    def snap(hits, misses, drops, corrupt, buckets):
+        rec = _snapshot_with_labeled(
+            {"serving_kv_tier_hits_total": [({"tier": "host"}, hits)],
+             "serving_kv_tier_misses_total": [({"tier": "host"}, misses)],
+             "serving_kv_tier_drop_total": [({"tier": "host"}, drops)]})
+        rec["metrics"].append(
+            {"name": "serving_kv_tier_corrupt_total", "type": "counter",
+             "help": "", "labelnames": [],
+             "samples": [{"labels": {}, "value": corrupt}]})
+        count = buckets["+Inf"]
+        rec["metrics"].append(
+            {"name": "serving_kv_restore_seconds", "type": "histogram",
+             "help": "", "labelnames": [],
+             "samples": [{"labels": {}, "buckets": buckets,
+                          "sum": 0.01 * count, "count": count}]})
+        return rec
+
+    a = snap(hits=80, misses=20, drops=0, corrupt=0, buckets=fast)
+    b = snap(hits=100, misses=100,       # hits grew, rate 0.8 -> 0.5
+             drops=6, corrupt=2, buckets=slow)
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_kv_tier_hit_rate{tier=host}") \
+        == "hit rate dropped", regs
+    assert why.get("serving_kv_tier_corrupt_total") \
+        == "failure counter grew", regs
+    assert why.get("serving_kv_tier_drop_total{tier=host}") \
+        == "failure counter grew", regs
+    assert why.get("serving_kv_restore_seconds:p99") \
+        == "KV tier restore p99 grew", regs
+    # identical runs stay clean; traffic growth at the same rate and
+    # tail fires neither the rate rule nor the p99 rule (the raw miss
+    # counter growing 10x with traffic is the failure-counter rule's
+    # business, same as every other hits/misses family)
+    assert metrics_report.compare_counters(a, a) == []
+    c = snap(hits=800, misses=200, drops=0, corrupt=0,
+             buckets={k: v * 10 for k, v in fast.items()})
+    assert not any(w in ("hit rate dropped", "KV tier restore p99 grew")
+                   for *_, w in metrics_report.compare_counters(a, c))
+    # and the CLI gate exits nonzero on the regressed run
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_kv_tier_corrupt_total" in bad.stdout

@@ -506,15 +506,21 @@ def _attach_tenant_adapters(model, engine, tenancy):
 def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
                  prefix_cache=True, gamma=3, draft_layers=1,
                  attention_impl="gather", kv_dtype="float32",
-                 weight_dtype="float32", tp=2, pp=2, prefill_chunk=None):
+                 weight_dtype="float32", tp=2, pp=2, prefill_chunk=None,
+                 tier_kwargs=None):
     """A serving engine of any KV/decode layout over `model`. `quant`
     is paged with int8 KV pools AND int8 decode weights (ISSUE 11);
     `tp`/`pp` are the hybrid-parallel arms (ISSUE 13) over this
     process's local devices — `pp` takes both mesh knobs; `spec_pp`
     (ISSUE 14) runs speculative γ+1-token verify windows on the
-    pipeline ring (gamma/draft_layers compose with pp/tp)."""
+    pipeline ring (gamma/draft_layers compose with pp/tp).
+    `tier_kwargs` (ISSUE 18): extra PagedEngineConfig knobs for the
+    host/disk KV tier hierarchy (enable_kv_tiers, host_tier_blocks,
+    host_tier_dtype, disk_tier_dir, disk_tier_blocks, ...); applies to
+    the single-process paged-family arms only."""
     from paddle_tpu.serving import (GenerationEngine, PagedGenerationEngine,
                                     SpeculativeEngine)
+    tier_kwargs = dict(tier_kwargs or {})
     if kind == "quant":
         kind, kv_dtype, weight_dtype = "paged", "int8", "int8"
     if kind == "dense":
@@ -524,14 +530,14 @@ def build_engine(model, kind, slots, max_len, block_size=8, num_blocks=None,
             model, slots=slots, max_len=max_len, block_size=block_size,
             num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
             attention_impl=attention_impl, kv_dtype=kv_dtype,
-            weight_dtype=weight_dtype)
+            weight_dtype=weight_dtype, **tier_kwargs)
     if kind == "spec":
         return SpeculativeEngine(
             model, slots=slots, max_len=max_len, block_size=block_size,
             num_blocks=num_blocks, enable_prefix_cache=prefix_cache,
             attention_impl=attention_impl, gamma=gamma,
             draft_layers=draft_layers, kv_dtype=kv_dtype,
-            weight_dtype=weight_dtype)
+            weight_dtype=weight_dtype, **tier_kwargs)
     if kind == "tp":
         from paddle_tpu.serving.distributed.tp import (
             TensorParallelEngineConfig, TensorParallelPagedEngine)
@@ -575,7 +581,7 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                 attention_impl="gather", kv_dtype="float32",
                 weight_dtype="float32", tp=2, pp=2, prefill_chunk=None,
                 engine_sink=None, serve_jsonl=None, decision_sink=None,
-                tenancy=None):
+                tenancy=None, tier_kwargs=None):
     """Build engine+scheduler, replay `traffic`, return the summary
     (annotated with the engine's KV budget and compile counters).
     `engine_sink`: optional list the built (now-warmed) engine is
@@ -603,7 +609,8 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
                           draft_layers=draft_layers,
                           attention_impl=attention_impl,
                           kv_dtype=kv_dtype, weight_dtype=weight_dtype,
-                          tp=tp, pp=pp, prefill_chunk=prefill_chunk)
+                          tp=tp, pp=pp, prefill_chunk=prefill_chunk,
+                          tier_kwargs=tier_kwargs)
     if tenancy is not None:
         _attach_tenant_adapters(model, engine, tenancy)
     vclock = VirtualClock() if virtual_step_s is not None else None
@@ -657,6 +664,11 @@ def run_harness(model, kind, traffic, slots, max_len, block_size=8,
         summary["blocks_total"] = engine.block_pool.capacity
         pc = engine.prefix_cache
         summary["prefix_cache_blocks"] = len(pc) if pc is not None else 0
+        # KV tier hierarchy readout (ISSUE 18): hit/miss/demote/promote
+        # tallies + per-tier residency, straight off the store
+        tiers = getattr(engine, "kv_tiers", None)
+        if tiers is not None:
+            summary["kv_tiers"] = tiers.stats()
     if kind in ("spec", "spec_pp"):
         m = sched.metrics()
         summary["spec_proposed"] = m.get("spec_proposed", 0)

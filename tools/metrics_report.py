@@ -63,7 +63,17 @@ threshold. Direction matters and is decided per counter name:
     `rate_limited`/`evict`); both gate per labelset under the tenant
     membership-intersection rule — a newly onboarded tenant's counters
     never read as regressions, a shared tenant's growth fires on
-    exactly the tenant that regressed.
+    exactly the tenant that regressed,
+  - KV memory hierarchy (ISSUE 18): `serving_kv_tier_corrupt_total`
+    (restores that failed verification — every one degraded a chain to
+    recompute) and `serving_kv_tier_drop_total{tier}` (tiered entries
+    discarded) join the failure class (patterns `corrupt`/`drop`);
+    `serving_kv_tier_{hits,misses}_total{tier}` gate as a per-tier
+    HIT-RATE pair under the generic hits/misses rule (a rate drop fires
+    even when hit counts grew with traffic); and the
+    `serving_kv_restore_seconds` approximate p99 growing past the
+    threshold is failure-class (cold-chain promotion losing its race
+    against recompute).
 
 Fleet-merged snapshots (ISSUE 12, observability/fleet.py) are compared
 LABEL-AWARE: every series already carries `worker_id`/`role` labels in
@@ -101,7 +111,7 @@ _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
     r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover|diverg|leak"
-    r"|rate_limited|evict",
+    r"|rate_limited|evict|corrupt",
     re.I)
 
 # counter pairs whose RATIO is the SLO signal: a rate drop past the
@@ -189,6 +199,12 @@ _GAUGE_DROP_RULES = (
 _HIST_P99_RULES = (
     (re.compile(r"serving_kv_handoff_seconds(\{.*\})?$"),
      "KV handoff p99 grew"),
+    # ISSUE 18: the per-block tier-restore latency tail growing means
+    # cold-chain promotion is losing its race against recompute — the
+    # TTFT win the hierarchy exists for erodes even while every restore
+    # still verifies
+    (re.compile(r"serving_kv_restore_seconds(\{.*\})?$"),
+     "KV tier restore p99 grew"),
 )
 
 

@@ -72,6 +72,17 @@ unchanged. When any is present the CLI adds a per-tenant tenancy
 table: rate-limit denials, adapter usage, namespaces, and cached
 blocks each tenant's namespaces lost to eviction.
 
+KV tier fields (ISSUE 18): request records may carry `tier_hit` (the
+request's prefill restored tiered KV — a cold-chain promotion from the
+host/disk hierarchy, or a fleet wire-shipped prefix) and `restore_ms`
+(milliseconds the restore took, what the TTFT saved by not recomputing
+those blocks cost instead). The ledger stream grows three events —
+tier_demote / tier_promote / tier_drop, each carrying the entry `key`,
+its `tier` (host|disk), and the owning namespace — and the CLI replays
+them into a PER-TIER RESIDENCY table (entries resident per cold tier at
+end of run, plus demote/promote/drop traffic). All optional —
+historical artifacts stay schema-valid.
+
 Usage: python tools/serve_report.py serve_metrics.jsonl
 """
 import importlib.util
@@ -105,6 +116,7 @@ REQUEST_FIELDS = {"kind": str, "request_id": int, "status": str,
                   "tenant": str, "cohort": str,
                   "adapter_id": str, "prefix_namespace": str,
                   "rate_limited": bool,
+                  "tier_hit": bool, "restore_ms": (int, float),
                   "ttft_s": (int, float, type(None)),
                   "decode_s": (int, float, type(None))}
 # `run` header records (ISSUE 11): the engine's serving precisions and,
@@ -130,7 +142,8 @@ OPTIONAL_RUN_FIELDS = {"kv_dtype", "weight_dtype", "quant_greedy_match",
 # stay gradeable
 OPTIONAL_REQUEST_FIELDS = {"spec_proposed", "spec_accepted", "adopted",
                            "tenant", "cohort", "adapter_id",
-                           "prefix_namespace", "rate_limited"}
+                           "prefix_namespace", "rate_limited",
+                           "tier_hit", "restore_ms"}
 STATUSES = {"DONE", "TIMEOUT", "REJECTED", "ERROR", "SHED"}
 
 # per-request end-to-end timeline records (ISSUE 12), schema
@@ -146,8 +159,8 @@ TIMELINE_FIELDS = {"kind": str, "schema": str, "status": str,
 OPTIONAL_TIMELINE_FIELDS = {"request_id", "key", "priority", "worker",
                             "trace_id", "worker_phases", "tenant",
                             "cohort"}
-TIMELINE_PHASES = {"queue", "prefill", "kv_handoff", "adopt", "place",
-                   "decode", "failover"}
+TIMELINE_PHASES = {"queue", "prefill", "kv_handoff", "kv_restore",
+                   "adopt", "place", "decode", "failover"}
 
 # KV block lifecycle events (ISSUE 16), schema paddle_tpu.kvledger.v1 —
 # streamed by the scheduler at step boundaries when the engine attached
@@ -155,12 +168,16 @@ TIMELINE_PHASES = {"queue", "prefill", "kv_handoff", "adopt", "place",
 # cache reuse avoided).
 KVLEDGER_SCHEMA = "paddle_tpu.kvledger.v1"
 KVLEDGER_EVENTS = {"alloc", "ref", "unref", "free", "share",
-                   "cache_insert", "cache_evict"}
+                   "cache_insert", "cache_evict",
+                   "tier_demote", "tier_promote", "tier_drop"}
 KVLEDGER_FIELDS = {"kind": str, "schema": str, "seq": int, "event": str,
                    "blocks": list,
                    "request_id": (int, type(None)), "tenant": str,
-                   "origin": (str, type(None)), "tokens": int}
-OPTIONAL_KVLEDGER_FIELDS = {"tokens"}
+                   "origin": (str, type(None)), "tokens": int,
+                   "key": str, "tier": str, "owner": str, "reason": str}
+# `tokens` rides only on share events; `key`/`tier`/`owner` (+ optional
+# `reason`) only on the ISSUE 18 tier_* events
+OPTIONAL_KVLEDGER_FIELDS = {"tokens", "key", "tier", "owner", "reason"}
 # the phases-sum-to-e2e acceptance gate: contiguous trail construction
 # makes the sum structurally exact, so 5% + 1ms of slack only absorbs
 # float rounding on sub-millisecond runs
@@ -304,10 +321,12 @@ def kv_residency(events):
     final resident blocks by ownership kind (private/shared/cached —
     classified from the origin that took each reference, mirroring the
     live shadow model in paddle_tpu/observability/kvledger.py), the
-    per-tenant PEAK resident blocks over the run, and the prefix-chain
+    per-tenant PEAK resident blocks over the run, the prefix-chain
     sharing table (per rider tenant: share events, blocks and prefill
-    tokens reused, and whose cached chains they rode). Returns
-    {"tenants": {...}, "prefix_share": {...}} or None without events."""
+    tokens reused, and whose cached chains they rode), and the ISSUE 18
+    per-tier view (entries resident per cold tier at end of run plus
+    demote/promote/drop traffic). Returns {"tenants": {...},
+    "prefix_share": {...}, "tiers": {...}} or None without events."""
     if not events:
         return None
 
@@ -338,11 +357,28 @@ def kv_residency(events):
     owner = {}       # block -> the tenant whose prefill cached it
     peak = {}        # tenant -> max distinct resident blocks
     share = {}       # rider tenant -> sharing stats
+    tier_res = {}    # entry key -> cold tier currently holding it
+    tiers = {}       # tier -> demote/promote/drop traffic counters
     for ev in events:
         event = ev["event"]
         t = ev.get("tenant") or "default"
         rid, origin = ev.get("request_id"), ev.get("origin")
         bs = ev.get("blocks") or []
+        if event in ("tier_demote", "tier_promote", "tier_drop"):
+            # ISSUE 18 residency plane: demote moves an entry key into
+            # a cold tier (host->disk re-demotes under the new tier),
+            # promote/drop remove it
+            tier = ev.get("tier") or "?"
+            row = tiers.setdefault(tier, {"demoted": 0, "promoted": 0,
+                                          "dropped": 0})
+            if event == "tier_demote":
+                row["demoted"] += 1
+                tier_res[ev.get("key")] = tier
+            else:
+                row["promoted" if event == "tier_promote"
+                    else "dropped"] += 1
+                tier_res.pop(ev.get("key"), None)
+            continue
         if event == "alloc":
             for b in bs:
                 holders[b] = [(t, "private", rid)]
@@ -385,7 +421,9 @@ def kv_residency(events):
             tenants.setdefault(tt, {"private": 0, "shared": 0,
                                     "cached": 0, "peak_blocks": 0})
             tenants[tt][kk] += 1
-    return {"tenants": tenants, "prefix_share": share}
+    for tier, row in tiers.items():
+        row["resident"] = sum(1 for tt in tier_res.values() if tt == tier)
+    return {"tenants": tenants, "prefix_share": share, "tiers": tiers}
 
 
 def load(path):
@@ -447,6 +485,8 @@ def summarize(records):
     # hit rate over requests that actually PREFILLED (ttft set): queued
     # timeouts/sheds never did a cache lookup and would deflate the rate
     served = [r for r in reqs if r["ttft_s"] is not None]
+    restore_ms = [r["restore_ms"] for r in reqs
+                  if isinstance(r.get("restore_ms"), (int, float))]
     return {
         "steps": len(steps),
         "requests": by_status,
@@ -462,6 +502,11 @@ def summarize(records):
                                 default=0),
         "prefix_hit_rate": (sum(1 for r in served if r["prefix_hit"])
                             / len(served) if served else None),
+        # KV tier fields (ISSUE 18): zero/None on untiered runs
+        "tier_hits": sum(1 for r in reqs if r.get("tier_hit")),
+        "restore_ms": {"mean": sum(restore_ms) / len(restore_ms),
+                       "p99": _pct(restore_ms, 0.99)}
+        if restore_ms else None,
         "spec_proposed": sum(r.get("spec_proposed", 0) for r in reqs),
         "spec_accepted": sum(r.get("spec_accepted", 0) for r in reqs),
         "spec_acceptance_rate": (
@@ -564,6 +609,13 @@ def render(summary):
     if summary["prefix_hit_rate"] is not None:
         out.append(f"prefix-cache hit rate: "
                    f"{summary['prefix_hit_rate']:.2f}")
+    if summary.get("tier_hits"):
+        line = f"KV tier restores: {summary['tier_hits']} requests"
+        rms = summary.get("restore_ms")
+        if rms:
+            line += (f" (restore ms: mean={rms['mean']:.3f} "
+                     f"p99={rms['p99']:.3f})")
+        out.append(line)
     if summary.get("engine"):
         line = f"engine: {summary['engine']}"
         if summary.get("gamma") is not None:
@@ -643,6 +695,15 @@ def render(summary):
         for t, row in sorted(res["tenants"].items()):
             out.append(f"| {t} | {row['private']} | {row['shared']} | "
                        f"{row['cached']} | {row['peak_blocks']} |")
+        if res.get("tiers"):
+            out += ["", "### KV tier residency (cold tiers, end of "
+                        "run)", "",
+                    "| tier | resident entries | demotes | promotes | "
+                    "drops |", "|---|---|---|---|---|"]
+            for tier, row in sorted(res["tiers"].items()):
+                out.append(f"| {tier} | {row['resident']} | "
+                           f"{row['demoted']} | {row['promoted']} | "
+                           f"{row['dropped']} |")
         if res["prefix_share"]:
             out += ["", "### prefix-chain sharing (who rides whose "
                         "chains)", "",
