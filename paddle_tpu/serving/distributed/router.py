@@ -339,20 +339,44 @@ class DistFrontend:
                 if not req_.done() and req_.worker in loads:
                     loads[req_.worker] += 1
         if self.prefix_affinity and req is not None and exec_prompt:
-            matches = {}
-            for i in sorted(loads):
-                try:
-                    reply = self.decode.prefix_lookup(
-                        i, exec_prompt, namespace=req.prefix_namespace)
-                    matches[i] = int(reply.get("match_tokens") or 0)
-                except (_rpc.PSUnavailableError, _rpc.PSServerError):
-                    matches[i] = 0       # dark probe: no affinity claim
+            matches = self._probe_matches(sorted(loads), exec_prompt,
+                                          req.prefix_namespace)
             choice = _dec.replay_affinity_place(
                 {"loads": loads, "matches": matches,
                  "min_match": self.affinity_min_match,
                  "load_slack": self.affinity_load_slack})
             return choice, loads, matches
         return _dec.replay_place({"loads": loads}), loads, None
+
+    def _probe_matches(self, workers, exec_prompt, namespace):
+        """The affinity sweep: one CONCURRENT OP_PREFIX_LOOKUP probe per
+        live worker (ShardClientBase holds per-endpoint sockets + locks,
+        so parallel probes never share a connection). The sweep's wall
+        time is the slowest SINGLE probe's retry/timeout budget — one
+        slow-but-alive worker can't add its full budget once per peer to
+        every placement attempt, which a sequential sweep would. All
+        probes are joined before the placement rule runs, so the
+        recorded decision inputs stay complete and deterministic. A
+        dark/failed probe claims no affinity."""
+        matches = {i: 0 for i in workers}
+
+        def probe(i):
+            try:
+                reply = self.decode.prefix_lookup(
+                    i, exec_prompt, namespace=namespace)
+                matches[i] = int(reply.get("match_tokens") or 0)
+            except (_rpc.PSUnavailableError, _rpc.PSServerError):
+                matches[i] = 0           # dark probe: no affinity claim
+        if len(workers) == 1:
+            probe(workers[0])
+            return matches
+        threads = [threading.Thread(target=probe, args=(i,), daemon=True)
+                   for i in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return matches
 
     def _remote_prefill(self, req, decode_i, exec_prompt):
         """Remote prefill + handoff toward `decode_i`. Returns

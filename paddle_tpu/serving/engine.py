@@ -1655,11 +1655,16 @@ class PagedGenerationEngine(GenerationEngine):
         servable from the restored chain (multiple of block_size)."""
         if self.prefix_cache is None or int(plen) < 1:
             return 0
+        from .kv_tiers.store import corrupt_counter
         try:
             spec = _faults.fire("serving.kv_restore")
         except Exception:
+            # failed wire-restore read: nothing registers, the prefill
+            # recomputes — latched failure-class like tiered restores
+            corrupt_counter().inc()
             return 0
         if spec is not None and spec.mode == "truncate":
+            corrupt_counter().inc()
             return 0
         cfg = self._model.cfg
         head_shape = (cfg.num_heads, cfg.hidden_size // cfg.num_heads)

@@ -116,6 +116,14 @@ class DiskTier:
     def keys(self):
         return list(self._index)
 
+    def header(self, key):
+        """The indexed record's header dict (or None): namespace/parent
+        attribution without reading — or risking dropping — the payload.
+        What the store consults BEFORE a restore that might drop the
+        entry as corrupt."""
+        ent = self._index.get(key)
+        return ent[2] if ent is not None else None
+
     # -- open-time scan ------------------------------------------------------
     def _recover(self):
         """Walk the log from offset 0, indexing every structurally

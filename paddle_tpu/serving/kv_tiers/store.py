@@ -67,6 +67,14 @@ _H_RESTORE = _metrics.histogram(
 _OWNER_DEFAULT = "default"
 
 
+def corrupt_counter():
+    """The failure-class corrupt-restore counter, shared with the
+    engine's fleet wire-restore path: `restore_prefix` latches it on a
+    chaos raise/truncate so a torn CROSS-HOST restore is as visible to
+    the metrics_report failure-class gate as a torn tier restore."""
+    return _C_CORRUPT
+
+
 class TieredBlockStore:
     def __init__(self, read_block, write_block, host_blocks=64,
                  host_dtype="float32", disk_dir=None, disk_blocks=256,
@@ -88,7 +96,7 @@ class TieredBlockStore:
         # re-emit its residency so the shadow model starts consistent
         if self.disk is not None:
             for key in self.disk.keys():
-                header = self.disk._index[key][2]
+                header = self.disk.header(key) or {}
                 ledger.tier_demote((), key, "disk",
                                    self._owner(header.get("ns")))
 
@@ -206,6 +214,9 @@ class TieredBlockStore:
             rec = self.host.get(key)
             _C_HITS.labels(tier="host").inc()
             return rec, "host"
+        # owner from the index header BEFORE disk.get — a corrupt
+        # restore drops the entry, taking the namespace with it
+        header = self.disk.header(key) or {}
         rec, corrupt = self.disk.get(key, torn=torn)
         if rec is None:
             _C_MISSES.labels(tier="disk").inc()
@@ -213,12 +224,17 @@ class TieredBlockStore:
                 _C_CORRUPT.inc()
                 _C_DROP.labels(tier="disk").inc()
                 if self._ledger is not None:
-                    self._ledger.tier_drop(key, "disk", _OWNER_DEFAULT,
-                                           reason="corrupt restore")
+                    self._ledger.tier_drop(
+                        key, "disk", self._owner(header.get("ns")),
+                        reason="corrupt restore")
                 self._export()
             return None, None
         _C_HITS.labels(tier="disk").inc()
-        return rec, "disk"
+        # disk records spilled by an int8 host tier still carry their
+        # requantized /q8 + /s8 code pairs (the cascade serialized the
+        # raw host record) — reconstitute pool-native arrays before the
+        # engine writers index arrays["k0"]. A no-op for f32 records.
+        return HostTier._decode(rec), "disk"
 
     def peek(self, key):
         """Verified record without promotion (the fleet export path

@@ -209,6 +209,49 @@ def test_host_tier_int8_roundtrip_and_lru():
     assert sorted(t.keys()) == ["b", "c"]
 
 
+class _LedgerSpy:
+    """Captures the store's ledger events so tier-drop attribution is
+    directly assertable without spinning up a full KVLedger."""
+
+    def __init__(self):
+        self.events = []
+
+    def tier_demote(self, block_ids, key, tier, owner):
+        self.events.append(("demote", key, tier, owner))
+
+    def tier_promote(self, block_ids, key, tier, owner):
+        self.events.append(("promote", key, tier, owner))
+
+    def tier_drop(self, key, tier, owner, reason=None):
+        self.events.append(("drop", key, tier, owner, reason))
+
+
+def test_disk_corrupt_drop_attributes_namespace(tmp_path):
+    """A corrupt disk restore attributes its tier_drop to the chain's
+    NAMESPACE owner (read from the index header before the drop), not
+    the default tenant — per-tenant attribution survives the entry
+    being gone by the time the event is emitted."""
+    from paddle_tpu.serving.kv_tiers import TieredBlockStore
+    led = _LedgerSpy()
+    store = TieredBlockStore(
+        lambda blk: {"quant": False, "arrays": _rec(int(blk))["arrays"]},
+        lambda blk, arrays: None,
+        host_blocks=0,                     # everything cascades to disk
+        disk_dir=str(tmp_path / "kvt"))
+    store.attach_ledger(led)
+    assert store.demote("key0", "tenant-a", None, 0)
+    assert ("demote", "key0", "disk", "tenant-a") in led.events
+    assert store.residency() == {"key0": "disk"}
+
+    faults.arm("serving.kv_restore", mode="truncate", nth=1)
+    assert store.promote("key0", lambda: 1) is None
+    faults.disarm_all()
+    drops = [e for e in led.events if e[0] == "drop"]
+    assert drops == [("drop", "key0", "disk", "tenant-a",
+                      "corrupt restore")]
+    assert store.residency() == {}
+
+
 # ------------------------------------------------------- engine restore path
 
 def test_tiered_restore_f32_bit_exact_and_compile_once(tiny):
@@ -274,6 +317,40 @@ def test_tiered_restore_int8_within_quality_bounds(tiny):
     agree = sum(a == b for a, b in zip(t1, t2)) / len(t1)
     assert agree >= 0.9, f"int8 tier agreement {agree} (t1={t1} t2={t2})"
     assert eng.trace_counts["tier_restore"] == 1
+
+
+def test_int8_host_tier_disk_cascade_promotes(tiny, tmp_path):
+    """int8 host tier + disk cascade COMBINED (the review repro): the
+    host tier requantizes records, overflow spills the raw /q8 + /s8
+    code pairs to disk, and the disk restore must decode them back to
+    pool-native names before the engine writers index arrays['k0'] —
+    a promoted mixed-tier chain streams within the int8 bounds instead
+    of dying on KeyError."""
+    prompt = _prompt(48, 26)               # 3 full cached blocks + tail
+    eng = _tier_engine(tiny, host_tier_dtype="int8", host_tier_blocks=1,
+                       disk_tier_dir=str(tmp_path / "kvt"))
+    sched = Scheduler(eng, ServingConfig(default_max_new_tokens=10))
+    t1 = _run(sched, prompt, max_new=10)
+
+    assert eng.prefix_cache.evict(999) == 3
+    res = eng.kv_tiers.residency()
+    assert sorted(res.values()) == ["disk", "disk", "host"], \
+        "host capacity 1 should cascade the two colder blocks to disk"
+    # the export path reads the same records: a peeked disk entry must
+    # already be pool-native (no host-requantized /q8 or /s8 names)
+    dkey = next(k for k, tier in res.items() if tier == "disk")
+    rec = eng.kv_tiers.peek(dkey)
+    assert rec is not None
+    assert all(not n.endswith(("/q8", "/s8")) for n in rec["arrays"]), \
+        sorted(rec["arrays"])
+
+    promote0 = _counter("serving_kv_tier_promote_total", tier="disk")
+    t2 = _run(sched, prompt, max_new=10)
+    agree = sum(a == b for a, b in zip(t1, t2)) / len(t1)
+    assert agree >= 0.9, f"disk-promoted int8 agreement {agree}"
+    assert _counter("serving_kv_tier_promote_total", tier="disk") \
+        == promote0 + 2
+    assert eng.kv_tiers.residency() == {}
 
 
 def test_chaos_spill_and_restore_degrade_to_recompute(tiny, tmp_path):
@@ -402,6 +479,26 @@ def test_affinity_rule_units_and_record_validation():
     bad = dict(good, outcome={"worker": "0"})
     errs = decisions.validate_records([bad])
     assert errs and "affinity" in errs[0]
+
+
+def test_wire_restore_chaos_latches_corrupt(tiny):
+    """restore_prefix's bundle-level chaos (raise AND truncate on
+    serving.kv_restore) registers nothing AND latches
+    serving_kv_tier_corrupt_total — a torn FLEET restore is as visible
+    to the failure-class gate as a torn tier restore."""
+    eng = _engine(tiny)
+    prompt = _prompt(49, 26)
+    c0 = _counter("serving_kv_tier_corrupt_total")
+
+    faults.arm("serving.kv_restore", mode="truncate", nth=1)
+    assert eng.restore_prefix(prompt, [], [], 24) == 0
+    faults.disarm_all()
+    assert _counter("serving_kv_tier_corrupt_total") == c0 + 1
+
+    faults.arm("serving.kv_restore", mode="raise", nth=1)
+    assert eng.restore_prefix(prompt, [], [], 24) == 0
+    faults.disarm_all()
+    assert _counter("serving_kv_tier_corrupt_total") == c0 + 2
 
 
 def test_fleet_wire_restore_cross_host(tiny, tmp_path):

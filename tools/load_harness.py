@@ -713,12 +713,16 @@ def quant_quality(model, slots=3, max_len=64, block_size=8,
 
     `tie_eps` makes the match GENUINE-disagreement only: a decision
     counts as matched when the oracle rates the quantized pick within
-    `tie_eps` of its own best logit. Sub-epsilon gaps flip under float
-    reproducibility noise alone (XLA CPU thread partitioning moves
-    logits by ~1e-6; an untrained-model top-2 gap can be 1e-4), so they
-    carry no signal about quantization — while real corruption (a wrong
-    block scale, rotted codes) moves logits orders of magnitude more
-    and still registers, which the serving.kv_quant chaos test pins.
+    `tie_eps` of its own best logit, OR (the mirror case) the quantized
+    engine rates the oracle's pick within `tie_eps` of its own best —
+    either way the "disagreement" is a sub-epsilon argmax tie on one
+    side. Sub-epsilon gaps flip under float reproducibility noise alone
+    (XLA CPU thread partitioning moves logits by ~1e-6; an
+    untrained-model top-2 gap can be 1e-4), so they carry no signal
+    about quantization — while real corruption (a wrong block scale,
+    rotted codes) moves logits orders of magnitude more and still
+    registers on BOTH sides, which the serving.kv_quant chaos test
+    pins.
 
     Results are exported as `serving_quant_greedy_match` /
     `serving_quant_logit_kl` gauges (failure-class gated by
@@ -754,7 +758,8 @@ def quant_quality(model, slots=3, max_len=64, block_size=8,
         ao, aq = np.argmax(lo, -1), np.argmax(lq, -1)
         rows = np.arange(n)
         matches.append((ao == aq)
-                       | (lo[rows, aq] >= lo[rows, ao] - tie_eps))
+                       | (lo[rows, aq] >= lo[rows, ao] - tie_eps)
+                       | (lq[rows, ao] >= lq[rows, aq] - tie_eps))
         po = np.exp(lo - lo.max(-1, keepdims=True))
         po /= po.sum(-1, keepdims=True)
         zq = lq - lq.max(-1, keepdims=True)
