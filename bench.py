@@ -438,13 +438,16 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
     # transitively requires every step to have finished.
     t0 = time.perf_counter()
     prev = None
+    losses = []
     if prof is None:
         for _ in range(n_dispatch):
             loss, params, state = dispatch(params, state, toks, labs, lr)
             if prev is not None:
                 loss_val = float(prev)
+                losses.append(loss_val)
             prev = loss
         loss_val = float(prev)
+        losses.append(loss_val)
     else:
         # profiled variant: one Forward span per dispatch (dispatch + the
         # overlapped host fetch), one profiler step + JSONL record per
@@ -455,10 +458,12 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
                 loss, params, state = dispatch(params, state, toks, labs, lr)
                 if prev is not None:
                     loss_val = float(prev)
+                    losses.append(loss_val)
             prev = loss
             prof.step(num_samples=B * S * scan_k)
         with RecordEvent("bench.final_loss_fetch", TracerEventType.Forward):
             loss_val = float(prev)
+            losses.append(loss_val)
     dt = time.perf_counter() - t0
     # fold the measurement in BEFORE the profiler's registry snapshot is
     # written, so the predicted-vs-measured gauges ride the artifact set
@@ -498,6 +503,11 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
         with open(profile_paths["metrics_prom"], "w") as f:
             f.write(reg.dump_prometheus())
 
+    # numerics sentinel pass (ISSUE 19): one armed in-trace sweep over
+    # the final params plus the fetched loss trajectory through the
+    # online detector — the healthy train rung must latch ZERO anomalies
+    numerics_block = _train_numerics_block(params, losses)
+
     total_steps = n_dispatch * scan_k
     tokens_per_sec = B * S * total_steps / dt
     extra_profile = {"profile_artifacts": profile_paths} if profile_paths \
@@ -523,10 +533,44 @@ def run_config(B, S, remat, n_steps, on_tpu, scan_k, fused_ce=False):
                   "n_steps": total_steps, "scan_k": scan_k,
                   "step_ms": round(1000 * dt / total_steps, 1),
                   "loss": loss_val, "cost_model": cost_model,
+                  "numerics": numerics_block,
                   **({"deviceprof": deviceprof_block}
                      if deviceprof_block else {}),
                   **extra_profile},
     }
+
+
+def _train_numerics_block(params, losses):
+    """The ISSUE 19 train-rung sentinel pass: tap the final parameter
+    tree through an ARMED jitted sweep (the in-trace tap path — a
+    sink_scope opened at trace time, the fused stats vector returned as
+    the program's output) and feed it, plus the whole fetched loss
+    trajectory, through the online detector. The healthy rung must
+    latch ZERO anomalies — a NaN that slipped through training fails
+    the bench here, not in a downstream eval."""
+    import jax
+
+    from paddle_tpu.observability import numerics as _numerics
+
+    mon = _numerics.NumericsMonitor(auto_bundle=False)
+
+    def sweep(ps):
+        with _numerics.sink_scope() as sink:
+            _numerics.tap_tree("train.param_global_norm", ps)
+        return sink
+
+    mon.observe_sink(jax.jit(sweep)(params))
+    # ONE fused observation over the loss history: any non-finite loss
+    # shows in finite_frac, and a single vector can never false-latch
+    # the drift rule on a (healthy) converging trajectory
+    mon.observe("train.loss",
+                _numerics.np_tree_stats([np.asarray(losses,
+                                                    dtype=np.float32)]))
+    rep = mon.report()
+    assert rep["anomalies"] == 0, \
+        f"numerics anomalies latched on the healthy train rung: " \
+        f"{rep['counts']}"
+    return rep
 
 
 def _parse_args(argv):
@@ -827,6 +871,11 @@ def run_serve_load_bench(on_tpu, n_requests=None):
     kv_tier_gate = _kv_tier_gate(model, load_harness, traffic,
                                  paged_slots, max_len, block, num_blocks,
                                  attention_impl)
+    # numerics health gate (ISSUE 19): the int8 arm re-runs the serve
+    # shape with the sentinel plane ARMED — zero anomalies on the
+    # healthy path and compile-once with taps on, ASSERTED inside
+    numerics_gate = _numerics_gate(model, max_len, block, quant_blocks,
+                                   quant_slots, attention_impl)
     # compile-count discipline, asserted per arm: ONE decode executable
     # (dense/paged/quant) or ONE draft-decode + ONE verify executable
     # (spec) — a rung that recompiles per step must fail, not report
@@ -912,6 +961,7 @@ def run_serve_load_bench(on_tpu, n_requests=None):
                   "kv_ledger_audit": kv_ledger_audit,
                   "tenant_isolation": tenant_iso,
                   "kv_tier_gate": kv_tier_gate,
+                  "numerics": numerics_gate,
                   "backend": jax.default_backend()},
     }
 
@@ -1116,6 +1166,49 @@ def _isolation_gate(model, load_harness, base_traffic, slots, max_len,
         "baseline": arms["baseline"]["tenants"],
         "burst": arms["burst"]["tenants"],
     }
+
+
+def _numerics_gate(model, max_len, block, num_blocks, slots,
+                   attention_impl):
+    """The ISSUE 19 serving-side numerics gate: an int8 paged engine
+    (quantized KV + decode weights — the arm with the most tapped
+    surfaces: code saturation, scale rows, logits) runs the serve shape
+    with the sentinel plane ARMED. Asserted (a breach fails the rung):
+
+      1. zero anomalies latched over prefill + decode on the healthy
+         path — the armed plane must not cry wolf;
+      2. ONE decode executable with taps armed — arming is a different
+         traced program, not a per-step retrace.
+
+    Returns the detector report (per-site stats block) for `extra`."""
+    import numpy as np
+
+    from paddle_tpu.serving import PagedGenerationEngine
+
+    steps = int(os.environ.get("BENCH_SERVE_NUMERICS_STEPS", 8))
+    eng = PagedGenerationEngine(
+        model, slots=slots, max_len=max_len, block_size=block,
+        num_blocks=num_blocks, attention_impl=attention_impl,
+        kv_dtype="int8", weight_dtype="int8", numerics_taps=True)
+    rng = np.random.RandomState(7)
+    for s in range(min(slots, 2)):
+        eng.prefill(s, rng.randint(1, model.cfg.vocab_size,
+                                   2 * block + 1).astype(np.int32))
+    for _ in range(steps):
+        eng.decode()
+    rep = eng.numerics_monitor.report()
+    assert rep["anomalies"] == 0, \
+        f"numerics anomalies latched on the healthy int8 serve path: " \
+        f"{rep['counts']}"
+    assert eng.trace_counts["decode"] == 1, \
+        f"armed decode recompiled: {eng.trace_counts['decode']} traces " \
+        f"(want 1)"
+    # the armed program tapped the full quantized surface
+    want = {"decode.logits", "kv.codes", "kv.scale",
+            "weights.q", "weights.scale"}
+    missing = want - set(rep["sites"])
+    assert not missing, f"armed int8 arm missing tap sites: {missing}"
+    return rep
 
 
 def _tier_counter_totals():

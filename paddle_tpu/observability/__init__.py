@@ -56,6 +56,15 @@ one substrate they all report through:
                        {tenant,kind}), and the LedgerReconciler shadow-
                        pool watchdog that latches any ledger-vs-pool
                        divergence at scheduler-step boundaries.
+  numerics.py        — the numerics health plane (ISSUE 19): in-trace
+                       tensor sentinels (tap/tap_layer/tap_tree emit one
+                       fused [finite_frac, absmax, rms, sat_frac] vector
+                       per site as extra executable outputs, armed at
+                       build time like capture_logits), the rolling
+                       median/MAD online detector latching
+                       numerics_anomaly_total{site,kind}, and the NaN
+                       bisection localizer engines use to name the first
+                       unhealthy layer in a postmortem bundle.
 
 Producers already wired in: serving scheduler (queue depth, slot
 occupancy, admission/timeout/reject counts, tokens, TTFT), PS RPC client
@@ -73,14 +82,15 @@ import sys
 
 from . import deviceprof  # noqa: F401
 from . import faults, fleet, flight_recorder, metrics  # noqa: F401
-from . import kvledger, reqtimeline, tracecontext, xplane  # noqa: F401
+from . import kvledger, numerics, reqtimeline  # noqa: F401
+from . import tracecontext, xplane  # noqa: F401
 from .flight_recorder import dump_postmortem  # noqa: F401
 from .metrics import registry  # noqa: F401
 from .tracecontext import merge_chrome_traces, trace_scope  # noqa: F401
 
 __all__ = ["metrics", "tracecontext", "flight_recorder", "faults",
            "deviceprof", "xplane", "fleet", "reqtimeline", "kvledger",
-           "registry", "dump_postmortem", "trace_scope",
+           "numerics", "registry", "dump_postmortem", "trace_scope",
            "merge_chrome_traces"]
 
 

@@ -77,6 +77,21 @@ Site catalogue (the call sites live next to the operation they break):
                        mid-ring escapes decode()/prefill() and proves
                        the scheduler's quarantine + the router's
                        group-level failover contain a dying stage
+  numerics.corrupt     silent numeric corruption (ISSUE 19): fires in
+                       GenerationEngine.decode (all engine kinds) just
+                       before the step executable — modes `nan` / `inf`
+                       poison ONE element of the tensor named by
+                       `target=` (a decode-weight name) at rest;
+                       `scale_zero` zeroes a quantized weight's scale
+                       row. Like `truncate`, fire() only RETURNS the
+                       spec: the engine performs the damage. The
+                       detector is the numerics health plane
+                       (observability.numerics): the in-trace taps latch
+                       `numerics_anomaly_total{site,kind}` and the
+                       bisection localizer names the first unhealthy
+                       layer in the postmortem bundle — chaos tests
+                       prove detection AND localization within one
+                       scheduler step
   dataloader.next      io.DataLoader.__iter__, before each batch
 
 Arming, in-process:
@@ -113,10 +128,13 @@ SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
          "serving.adapter_swap", "serving.pp_handoff",
          "serving.kv_ledger_leak", "serving.kv_spill",
-         "serving.kv_restore", "dataloader.next")
+         "serving.kv_restore", "numerics.corrupt", "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
-MODES = ("raise", "delay", "drop", "truncate")
+# nan/inf/scale_zero are caller-interpreted like truncate: fire()
+# returns the spec and the call site (the engine) performs the damage
+MODES = ("raise", "delay", "drop", "truncate", "nan", "inf", "scale_zero")
+CALLER_MODES = ("truncate", "nan", "inf", "scale_zero")
 
 _M_INJECTED = _metrics.counter(
     "faults_injected_total", "Injected faults fired, by site and mode",
@@ -137,10 +155,10 @@ class FaultSpec:
     call counter deterministic)."""
 
     __slots__ = ("site", "mode", "p", "nth", "delay_s", "max_fires", "seed",
-                 "exc", "calls", "fires", "_rng", "_lock")
+                 "exc", "target", "calls", "fires", "_rng", "_lock")
 
     def __init__(self, site, mode, p=1.0, nth=0, delay_s=0.05,
-                 max_fires=None, seed=0, exc=None):
+                 max_fires=None, seed=0, exc=None, target=None):
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r}; want {MODES}")
         self.site = site
@@ -151,6 +169,8 @@ class FaultSpec:
         self.max_fires = None if max_fires is None else int(max_fires)
         self.seed = int(seed)
         self.exc = exc
+        # the tensor a numerics.corrupt spec poisons (caller-interpreted)
+        self.target = None if target is None else str(target)
         self.calls = 0
         self.fires = 0
         # decorrelate sites under one seed, keep each site reproducible
@@ -243,11 +263,14 @@ def fire(site):
                     can precede a drop or a truncate)
       truncate   -> returns the spec; the CALL SITE performs the tear
                     (only file writers interpret this mode)
+      nan/inf/scale_zero -> returns the spec; the CALL SITE poisons the
+                    tensor named by spec.target (only the numerics
+                    chaos hook interprets these modes)
 
     Stacked specs on one site trigger independently, evaluated in arm
-    order. When BOTH a truncate and a delay fire on one call, the
-    truncate spec is returned regardless of arm order — the caller must
-    see the tear, not the sleep.
+    order. When BOTH a caller-interpreted spec and a delay fire on one
+    call, the caller-interpreted spec is returned regardless of arm
+    order — the caller must see the tear, not the sleep.
     """
     if not _specs:
         return None
@@ -264,7 +287,7 @@ def fire(site):
             time.sleep(spec.delay_s)
             if fired is None:
                 fired = spec
-        elif spec.mode == "truncate":
+        elif spec.mode in CALLER_MODES:
             fired = spec          # outranks delay for the caller
         else:
             raise spec._exception()
@@ -275,7 +298,7 @@ def load_env(value=None):
     """Parse `PTN_FAULTS` (or an explicit string) and arm the sites it
     names. Format, `;`-separated:
 
-        site=mode[:p=0.05][:nth=3][:delay=0.2][:max=1][:seed=7]
+        site=mode[:p=0.05][:nth=3][:delay=0.2][:max=1][:seed=7][:target=name]
 
     Returns the list of armed FaultSpecs (empty when unset)."""
     raw = os.environ.get(ENV_VAR, "") if value is None else value
@@ -292,7 +315,7 @@ def load_env(value=None):
         kwargs = {}
         keymap = {"p": ("p", float), "nth": ("nth", int),
                   "delay": ("delay_s", float), "max": ("max_fires", int),
-                  "seed": ("seed", int)}
+                  "seed": ("seed", int), "target": ("target", str)}
         for opt in opts:
             k, _, v = opt.partition("=")
             if k not in keymap:

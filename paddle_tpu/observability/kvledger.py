@@ -403,9 +403,13 @@ class KVLedger:
         self._emit("cache_evict", block_ids)
 
     # TieredBlockStore hooks (ISSUE 18: residency across cold tiers)
-    def tier_demote(self, block_ids, key, tier, owner):
-        self._emit("tier_demote", block_ids, key=str(key),
-                   tier=str(tier), owner=str(owner))
+    def tier_demote(self, block_ids, key, tier, owner, sat=None):
+        # `sat` (ISSUE 19): int8 requant code-saturation fraction of the
+        # demoted block — None when the host tier stores float32
+        ev = {"key": str(key), "tier": str(tier), "owner": str(owner)}
+        if sat is not None:
+            ev["sat"] = round(float(sat), 6)
+        self._emit("tier_demote", block_ids, **ev)
 
     def tier_promote(self, block_ids, key, tier, owner):
         self._emit("tier_promote", block_ids, key=str(key),

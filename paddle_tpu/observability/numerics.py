@@ -1,0 +1,468 @@
+"""Numerics health plane — in-trace tensor sentinels + host-side detector.
+
+The reference framework ships a framework-level nan/inf verification
+plane (``FLAGS_check_nan_inf``-style per-op checking riding the
+executor).  This module is that idea rebuilt for a trace-once XLA world,
+where "check every op" would either break compile-once or cost a
+dispatch per tensor:
+
+* **In-trace taps.**  ``tap(site, x)`` computes ONE fused fixed-shape
+  stats vector — ``[finite_frac, absmax, rms, sat_frac]`` — inside the
+  traced program and records it in the ambient *sink* (a trace-time
+  dict).  The engine/trainer opens a ``sink_scope()`` around its traced
+  body and returns the sink as an extra pytree output, so all sentinel
+  math fuses into the step executable: zero extra dispatches, zero
+  extra compiles after the first.
+* **Arming contract.**  Taps are armed at BUILD time (the
+  ``capture_logits`` pattern).  When no sink is open, ``tap`` is a
+  single attribute probe that touches no jax API — the disabled arm's
+  traced program is bit-identical, with unchanged trace counts, across
+  dense/paged/spec/tp/pp/spec_pp.  Tests assert this.
+* **Per-layer taps are the localizer's tool.**  ``tap_layer(i, ...)``
+  sites are wired at every block boundary but fire only under an
+  explicit layer filter.  The steady-state armed program carries coarse
+  sites only (logits, scales, code saturation, adapter norms); when the
+  detector latches a nonfinite anomaly the bisection localizer replays
+  the offending step through progressively finer per-layer tap sets
+  (``sink_scope(layers=...)``) to name the FIRST unhealthy layer.
+* **Host-side detector.**  ``NumericsMonitor`` keeps rolling
+  median/MAD baselines per site and latches
+  ``numerics_anomaly_total{site,kind}`` (kinds: ``nonfinite`` /
+  ``drift`` / ``saturation``) into the metrics registry, a
+  flight-recorder annotation, and (once) a postmortem bundle.
+
+Import contract: like every observability submodule this file is
+stdlib-only at import time; jax/numpy are imported lazily inside the
+tap/stats helpers, which only run when a caller is already using them.
+"""
+
+import collections
+import math
+import statistics
+import threading
+
+from . import flight_recorder as _flight_recorder
+from . import metrics as _metrics
+
+__all__ = [
+    "STATS_FIELDS", "ANOMALY_KINDS",
+    "tap", "tap_layer", "tap_tree", "sink_scope", "null_scope",
+    "stats_vector", "tree_stats_vector", "np_stats", "np_tree_stats",
+    "stats_dict", "stats_unhealthy",
+    "NumericsMonitor", "bisect_first_unhealthy",
+    "set_monitor", "get_monitor", "observe", "observe_tree",
+]
+
+# one fused fixed-shape vector per site; the LAST slot is only nonzero
+# for taps armed with a saturation threshold (int8 code pools)
+STATS_FIELDS = ("finite_frac", "absmax", "rms", "sat_frac")
+ANOMALY_KINDS = ("nonfinite", "drift", "saturation")
+
+_C_ANOMALY = _metrics.counter(
+    "numerics_anomaly_total",
+    "Latched numerics anomalies, by tap site and kind "
+    "(nonfinite/drift/saturation)",
+    labelnames=("site", "kind"))
+_G_FINITE = _metrics.gauge(
+    "numerics_site_finite_frac",
+    "Finite fraction of the most recent observation at each tap site "
+    "(1.0 == healthy)",
+    labelnames=("site",))
+
+# ---------------------------------------------------------------------------
+# the trace-time sink
+
+_TLS = threading.local()
+
+
+class sink_scope:
+    """Arm the tap plane for the dynamic extent of a TRACE.
+
+    Open this inside a traced function body (so it is active while jax
+    traces the body) and return ``scope.stats`` — a ``{site: [4]f32}``
+    dict — as an extra output of the traced program.  Nested scopes
+    shadow the outer one (the bisection probes rely on this being
+    push/pop).
+
+    ``layers`` controls the per-layer ``tap_layer`` sites: ``None``
+    (default) leaves them dormant, ``"all"`` arms every layer, and an
+    iterable of ints arms exactly those layer indices — the knob the
+    localizer turns to refine its tap set.
+    """
+
+    def __init__(self, layers=None):
+        self.stats = {}
+        if layers is None or layers == "all":
+            self._layers = layers
+        else:
+            self._layers = frozenset(int(i) for i in layers)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (getattr(_TLS, "sink", None),
+                      getattr(_TLS, "layers", None))
+        _TLS.sink = self.stats
+        _TLS.layers = self._layers
+        return self.stats
+
+    def __exit__(self, *exc):
+        _TLS.sink, _TLS.layers = self._prev
+        return False
+
+
+class null_scope:
+    """Context manager for the DISARMED arm: yields None, touches no
+    state.  Lets call sites write ``with self._numerics_scope() as sink``
+    unconditionally while keeping the disabled trace untouched."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+def tap(site, x, sat_threshold=None):
+    """Record the fused stats vector for ``x`` under ``site`` in the
+    ambient sink.  A no-op (one attribute probe, no jax API) when no
+    sink is armed — the bit-identical-when-disabled contract."""
+    sink = getattr(_TLS, "sink", None)
+    if sink is None:
+        return
+    sink[site] = stats_vector(x, sat_threshold)
+
+
+def tap_layer(index, name, x):
+    """Per-layer tap (``layer<i>.<name>``).  Fires only when the ambient
+    scope armed a layer filter covering ``index`` — dormant in the
+    steady-state armed program, turned on by the bisection localizer."""
+    sink = getattr(_TLS, "sink", None)
+    if sink is None:
+        return
+    layers = getattr(_TLS, "layers", None)
+    if layers is None:
+        return
+    index = int(index)
+    if layers != "all" and index not in layers:
+        return
+    sink[f"layer{index}.{name}"] = stats_vector(x)
+
+
+def tap_tree(site, tree, sat_threshold=None):
+    """One fused stats vector across every array leaf of a pytree —
+    adapter delta norms, grad/param global norms.  rms here is the
+    global root-mean-square over all elements (global_norm = rms *
+    sqrt(n))."""
+    sink = getattr(_TLS, "sink", None)
+    if sink is None:
+        return
+    import jax
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return
+    sink[site] = tree_stats_vector(leaves, sat_threshold)
+
+
+# ---------------------------------------------------------------------------
+# fused stats math (in-trace)
+
+def stats_vector(x, sat_threshold=None):
+    """``[finite_frac, absmax, rms, sat_frac]`` as one f32[4].  Non-
+    finite elements are masked out of absmax/rms so a single NaN shows
+    up in finite_frac without poisoning the magnitude channels (which
+    the drift baseline needs to stay meaningful)."""
+    import jax.numpy as jnp
+    xf = jnp.asarray(x).astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    finite_frac = jnp.mean(finite.astype(jnp.float32))
+    safe = jnp.where(finite, xf, 0.0)
+    absmax = jnp.max(jnp.abs(safe))
+    rms = jnp.sqrt(jnp.mean(jnp.square(safe)))
+    if sat_threshold is None:
+        sat = jnp.float32(0.0)
+    else:
+        sat = jnp.mean((jnp.abs(xf) >= float(sat_threshold))
+                       .astype(jnp.float32))
+    return jnp.stack([finite_frac, absmax, rms, sat])
+
+
+def tree_stats_vector(leaves, sat_threshold=None):
+    """Fused stats over a list of arrays (see ``tap_tree``)."""
+    import jax.numpy as jnp
+    n = 0
+    fin = jnp.float32(0.0)
+    absmax = jnp.float32(0.0)
+    sumsq = jnp.float32(0.0)
+    sat = jnp.float32(0.0)
+    for leaf in leaves:
+        lf = jnp.asarray(leaf).astype(jnp.float32)
+        mask = jnp.isfinite(lf)
+        n += lf.size
+        fin = fin + jnp.sum(mask.astype(jnp.float32))
+        safe = jnp.where(mask, lf, 0.0)
+        absmax = jnp.maximum(absmax, jnp.max(jnp.abs(safe)))
+        sumsq = sumsq + jnp.sum(jnp.square(safe))
+        if sat_threshold is not None:
+            sat = sat + jnp.sum((jnp.abs(lf) >= float(sat_threshold))
+                                .astype(jnp.float32))
+    n = max(n, 1)
+    return jnp.stack([fin / n, absmax, jnp.sqrt(sumsq / n), sat / n])
+
+
+# ---------------------------------------------------------------------------
+# host-side (numpy) stats for eager paths: host-tier requant, eager
+# optimizer steps, scalar losses
+
+def np_stats(x, sat_threshold=None):
+    """Host-side twin of ``stats_vector``: a plain [4] float list."""
+    import numpy as np
+    a = np.asarray(x, dtype=np.float32)
+    if a.size == 0:
+        return [1.0, 0.0, 0.0, 0.0]
+    finite = np.isfinite(a)
+    safe = np.where(finite, a, 0.0)
+    sat = 0.0
+    if sat_threshold is not None:
+        sat = float(np.mean(np.abs(a) >= float(sat_threshold)))
+    return [float(np.mean(finite)),
+            float(np.max(np.abs(safe))),
+            float(np.sqrt(np.mean(np.square(safe)))),
+            sat]
+
+
+def np_tree_stats(arrays, sat_threshold=None):
+    """Host-side twin of ``tree_stats_vector``."""
+    import numpy as np
+    n = 0
+    fin = 0.0
+    absmax = 0.0
+    sumsq = 0.0
+    sat = 0.0
+    for arr in arrays:
+        a = np.asarray(arr, dtype=np.float32)
+        if a.size == 0:
+            continue
+        finite = np.isfinite(a)
+        safe = np.where(finite, a, 0.0)
+        n += a.size
+        fin += float(np.sum(finite))
+        absmax = max(absmax, float(np.max(np.abs(safe))))
+        sumsq += float(np.sum(np.square(safe)))
+        if sat_threshold is not None:
+            sat += float(np.sum(np.abs(a) >= float(sat_threshold)))
+    n = max(n, 1)
+    return [fin / n, absmax, math.sqrt(sumsq / n), sat / n]
+
+
+def stats_dict(vec):
+    """[4] vector -> {field: float} for reports and bundles."""
+    return {k: float(v) for k, v in zip(STATS_FIELDS, vec)}
+
+
+def stats_unhealthy(vec, sat_frac_max=0.25):
+    """Structural health predicate on a stats vector (no baseline
+    needed) — what the bisection localizer evaluates per probe."""
+    ff, absmax, rms, sat = (float(v) for v in vec)
+    if not (math.isfinite(ff) and math.isfinite(absmax)
+            and math.isfinite(rms)):
+        return True
+    return ff < 1.0 or sat > float(sat_frac_max)
+
+
+# ---------------------------------------------------------------------------
+# the online detector
+
+class NumericsMonitor:
+    """Rolling median/MAD baselines per site + anomaly latching.
+
+    ``observe(site, vec)`` classifies one stats vector:
+
+    * ``nonfinite``  — finite_frac < 1 (or a non-finite stats slot)
+    * ``saturation`` — sat_frac above ``sat_frac_max``
+    * ``drift``      — |rms - median| > drift_mads * MAD, once the site
+      has ``min_history`` healthy observations (MAD is floored so a
+      perfectly-constant baseline still admits noise)
+
+    Every anomaly latches ``numerics_anomaly_total{site,kind}`` and a
+    flight-recorder annotation; with ``auto_bundle`` the FIRST anomaly
+    also dumps a postmortem bundle.  Engines pass ``auto_bundle=False``
+    so they can run the bisection localizer first and bundle a record
+    that already names the guilty layer.
+    """
+
+    def __init__(self, window=64, min_history=8, drift_mads=10.0,
+                 sat_frac_max=0.25, auto_bundle=True):
+        self.window = int(window)
+        self.min_history = int(min_history)
+        self.drift_mads = float(drift_mads)
+        self.sat_frac_max = float(sat_frac_max)
+        self.auto_bundle = bool(auto_bundle)
+        self.anomalies = []           # [{site, kind, detail, stats}]
+        self.bundle_path = None
+        self._hist = {}               # site -> deque of healthy rms
+        self._last = {}               # site -> stats dict
+        self._counts = collections.Counter()
+        self._bundled = False
+        self._lock = threading.Lock()
+
+    # -- observation ------------------------------------------------------
+
+    def observe(self, site, vec):
+        """Classify one [4] stats vector for ``site``; returns the list
+        of anomaly kinds latched by THIS observation (empty == healthy).
+        """
+        ff, absmax, rms, sat = (float(v) for v in vec)
+        found = []
+        with self._lock:
+            self._last[site] = {"finite_frac": ff, "absmax": absmax,
+                                "rms": rms, "sat_frac": sat}
+            _G_FINITE.labels(site=site).set(ff if math.isfinite(ff)
+                                            else 0.0)
+            if not math.isfinite(ff) or ff < 1.0 \
+                    or not math.isfinite(rms):
+                found.append(("nonfinite", f"finite_frac={ff:.6g}"))
+            if sat > self.sat_frac_max:
+                found.append(("saturation",
+                              f"sat_frac={sat:.4g} > {self.sat_frac_max}"))
+            hist = self._hist.setdefault(
+                site, collections.deque(maxlen=self.window))
+            if math.isfinite(rms) and not found:
+                if len(hist) >= self.min_history:
+                    med = statistics.median(hist)
+                    mad = statistics.median(abs(h - med) for h in hist)
+                    scale = max(mad, 1e-3 * max(abs(med), 1e-6))
+                    if abs(rms - med) > self.drift_mads * scale:
+                        found.append((
+                            "drift",
+                            f"rms={rms:.6g} vs median={med:.6g} "
+                            f"(mad={mad:.3g})"))
+                    else:
+                        hist.append(rms)   # only healthy values extend
+                else:                      # the baseline
+                    hist.append(rms)
+            for kind, detail in found:
+                self._latch(site, kind, detail)
+        return [kind for kind, _ in found]
+
+    def observe_sink(self, sink, prefix=""):
+        """Feed a whole traced-program sink ({site: vec}) through the
+        detector.  Returns [(site, kind)] for anomalies latched now."""
+        import numpy as np
+        new = []
+        for site in sorted(sink):
+            vec = np.asarray(sink[site], dtype=np.float32)
+            for kind in self.observe(prefix + site, vec):
+                new.append((prefix + site, kind))
+        return new
+
+    def _latch(self, site, kind, detail):
+        # caller holds self._lock
+        self._counts[(site, kind)] += 1
+        _C_ANOMALY.labels(site=site, kind=kind).inc()
+        rec = {"site": site, "kind": kind, "detail": detail,
+               "stats": dict(self._last.get(site) or {})}
+        self.anomalies.append(rec)
+        _flight_recorder.annotate("numerics", {
+            "anomalies": len(self.anomalies),
+            "last": rec,
+            "counts": {f"{s}:{k}": n
+                       for (s, k), n in sorted(self._counts.items())},
+        })
+        if self.auto_bundle and not self._bundled:
+            self._bundled = True
+            self.bundle_path = _flight_recorder.dump_postmortem(
+                f"numerics:{site}:{kind}")
+
+    def bundle(self, reason):
+        """Dump the one-shot postmortem bundle now (idempotent).  The
+        engine calls this AFTER localization so the bundle carries the
+        localizer's annotation."""
+        with self._lock:
+            if not self._bundled:
+                self._bundled = True
+                self.bundle_path = _flight_recorder.dump_postmortem(reason)
+        return self.bundle_path
+
+    # -- reporting --------------------------------------------------------
+
+    def total(self):
+        with self._lock:
+            return sum(self._counts.values())
+
+    def counts(self):
+        with self._lock:
+            return {f"{site}:{kind}": n
+                    for (site, kind), n in sorted(self._counts.items())}
+
+    def site_stats(self):
+        with self._lock:
+            return {site: dict(st) for site, st in self._last.items()}
+
+    def report(self):
+        with self._lock:
+            return {
+                "anomalies": sum(self._counts.values()),
+                "counts": {f"{s}:{k}": n
+                           for (s, k), n in sorted(self._counts.items())},
+                "sites": {site: dict(st)
+                          for site, st in self._last.items()},
+                "bundle": self.bundle_path,
+            }
+
+
+# ---------------------------------------------------------------------------
+# bisection
+
+def bisect_first_unhealthy(n_layers, unhealthy_at):
+    """Smallest layer index whose tap is unhealthy, or None when even
+    the last layer is clean.  ``unhealthy_at(k)`` must be monotone in k
+    (true stays true once corruption appears — NaN/Inf propagate
+    forward through the stack), which a per-layer activation tap
+    satisfies.  O(log n) probe evaluations plus the initial guard."""
+    n_layers = int(n_layers)
+    if n_layers <= 0 or not unhealthy_at(n_layers - 1):
+        return None
+    lo, hi = 0, n_layers - 1          # invariant: unhealthy_at(hi) True
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if unhealthy_at(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    return hi
+
+
+# ---------------------------------------------------------------------------
+# process-global monitor for host-side observation points (host-tier
+# requant, eager optimizer steps).  Disabled by default: with no monitor
+# installed, observe() is one global read — zero cost on hot paths.
+
+_MONITOR = None
+
+
+def set_monitor(monitor):
+    """Install (or, with None, remove) the process-global monitor.
+    Returns the previous one so callers can restore it."""
+    global _MONITOR
+    prev = _MONITOR
+    _MONITOR = monitor
+    return prev
+
+
+def get_monitor():
+    return _MONITOR
+
+
+def observe(site, x, sat_threshold=None):
+    """Host-side observation point: no-op without a process monitor."""
+    if _MONITOR is None:
+        return None
+    return _MONITOR.observe(site, np_stats(x, sat_threshold))
+
+
+def observe_tree(site, arrays, sat_threshold=None):
+    """Host-side observation over a list of arrays (global norms)."""
+    if _MONITOR is None:
+        return None
+    return _MONITOR.observe(site, np_tree_stats(arrays, sat_threshold))

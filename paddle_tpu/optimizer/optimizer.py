@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..observability import numerics as _numerics
 from ..profiler import _tracer as _TRACER
 from .lr import LRScheduler
 
@@ -109,6 +110,19 @@ class Optimizer:
             new_p, new_state = runner(p._data, gd, state, lr_t)
             p._data = new_p
             self._accumulators[id(p)] = new_state
+        if _numerics.get_monitor() is not None:
+            # host-side sentinel on the eager path: one fused stats vector
+            # across all grads and one across the updated params (ISSUE 19)
+            gs, ps = [], []
+            for p, g in params_grads:
+                gd = g._data if isinstance(g, Tensor) else g
+                if gd is not None:
+                    gs.append(gd)
+                ps.append(p._data)
+            if gs:
+                _numerics.observe_tree("train.grad_norm", gs)
+            if ps:
+                _numerics.observe_tree("train.param_norm", ps)
         from ..framework.flags import _FLAGS
         if _FLAGS.get("FLAGS_check_nan_inf", False):
             # post-step scan (reference: nan_inf_utils_detail.cc) — names the
@@ -184,6 +198,10 @@ class Optimizer:
             np_, ns = self._update(p, g, st, lr_t)
             new_params[n] = np_
             new_state[n] = ns
+        # in-trace sentinels (ISSUE 19): no-ops unless the enclosing train
+        # step opened a numerics sink_scope at trace time
+        _numerics.tap_tree("train.grad_norm", grads)
+        _numerics.tap_tree("train.param_norm", new_params)
         return new_params, new_state
 
     def state_dict(self):

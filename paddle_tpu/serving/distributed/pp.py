@@ -65,6 +65,7 @@ from ...core.tensor import Tensor
 from ...nn.layer.layers import functional_call, functional_state
 from ...observability import faults as _faults
 from ...observability import metrics as _metrics
+from ...observability import numerics as _numerics
 from ...parallel import pipeline_schedule as _psched
 from ...profiler import RecordEvent, TracerEventType
 from .. import blocks
@@ -396,11 +397,16 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
             adapters, _ = self._split_extra(extra)
             self.trace_counts[counter][s] = \
                 self.trace_counts[counter].get(s, 0) + 1
-            y, npool = self._run_stage(st, params, pool, tables,
-                                       pos, x, op="block",
-                                       adapters=adapters)
+            with self._numerics_scope() as sink:
+                y, npool = self._run_stage(st, params, pool, tables,
+                                           pos, x, op="block",
+                                           adapters=adapters)
+                # per-stage sentinel: the hop activation leaving stage s
+                _numerics.tap(f"stage{s}.act", y)
             y = jax.lax.with_sharding_constraint(y, st.replicated)
-            return y, self._constrain_stage(st, npool)
+            if sink is None:
+                return y, self._constrain_stage(st, npool)
+            return y, self._constrain_stage(st, npool), sink
         return self._cached(fn, name)
 
     def _make_stage_decode(self, s):
@@ -414,14 +420,19 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
             adapters, rng = self._split_extra(extra)
             self.trace_counts["decode_pp"][s] = \
                 self.trace_counts["decode_pp"].get(s, 0) + 1
-            logits, npool = self._run_stage(st, params, pool, tables,
-                                           pos, x, op="block_head",
-                                           adapters=adapters)
-            nxt = self._select_slots(logits[:, 0, :], key, *rng)
+            with self._numerics_scope() as sink:
+                logits, npool = self._run_stage(st, params, pool, tables,
+                                                pos, x, op="block_head",
+                                                adapters=adapters)
+                nxt = self._select_slots(logits[:, 0, :], key, *rng)
+                _numerics.tap("decode.logits", logits[:, 0, :])
             npool = self._constrain_stage(st, npool)
+            out = (nxt, npool)
             if self.config.capture_logits:
-                return nxt, npool, logits[:, 0, :]
-            return nxt, npool
+                out = out + (logits[:, 0, :],)
+            if sink is not None:
+                out = out + (sink,)      # the sink rides LAST, always
+            return out
         return self._cached(fn, f"decode_stage[{s}]")
 
     def _ride_ring(self, tbl, mb_count, stage_call):
@@ -471,6 +482,7 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         np.int32 [slots] exactly like the single-device engine."""
         _faults.fire("serving.decode_step")
         self._fire_kv_quant_chaos()
+        self._fire_numerics_chaos()
         self.ensure_decode_capacity()
         c = self.config
         M = c.decode_microbatches
@@ -479,6 +491,7 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         key = self._next_key()
         out_tokens = np.zeros((c.slots,), np.int32)
         out_logits = [None] * M
+        sinks = []
         # tables/pos are immutable for the whole call: upload each
         # microbatch's slices ONCE, not once per (tick, stage) — each
         # mb runs pp stages, so this saves (pp-1)/pp of the transfers
@@ -494,14 +507,22 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
             if st.module.is_first:
                 x = jnp.asarray(tokens[lo:hi].reshape(mbs, 1))
             if not st.module.is_last:
-                return self._stage_decode[s](st.decode_params, st.pool,
-                                             mb_tables, mb_pos, x, *adp)
+                res = self._stage_decode[s](st.decode_params, st.pool,
+                                            mb_tables, mb_pos, x, *adp)
+                if self._numerics_armed:
+                    y, npool, sink = res
+                    sinks.append(sink)
+                    return y, npool
+                return res
             args = [st.decode_params, st.pool, mb_tables, mb_pos, x, key,
                     *adp]
             if self._sampling:
                 args += [jnp.asarray(self._slot_seeds[lo:hi]),
                          jnp.asarray(self._slot_gen[lo:hi])]
             res = self._stage_decode[s](*args)
+            if self._numerics_armed:
+                sinks.append(res[-1])
+                res = res[:-1]
             if c.capture_logits:
                 nxt, npool, lg = res
                 out_logits[g] = lg
@@ -516,6 +537,8 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
                           "attend": c.attention_impl}), \
                 blocks.attention_impl(c.attention_impl):
             out_nxt = self._ride_ring(self._decode_tbl, M, stage_call)
+        for sink in sinks:
+            self._ingest_numerics(sink)
         for g in range(M):
             out_tokens[g * mbs:(g + 1) * mbs] = np.asarray(out_nxt[g],
                                                            np.int32)
@@ -528,6 +551,21 @@ class PipelineParallelPagedEngine(PagedGenerationEngine):
         self._export_pp_stats()
         self._last_tokens = out_tokens.copy()
         return out_tokens
+
+    def _apply_numerics_corruption(self, name, mode):
+        """numerics.corrupt over per-stage param dicts: poison the named
+        tensor on whichever stage holds it (stage dicts keep the parent
+        model's global param names)."""
+        if not name:
+            return
+        for st in self._stages:
+            entry = st.decode_params.get(name)
+            if entry is None:
+                continue
+            entry = self._corrupt_entry(entry, mode)
+            if entry is not None:
+                st.decode_params = dict(st.decode_params, **{name: entry})
+            return
 
     def _fire_kv_quant_chaos(self):
         """The serving.kv_quant site over per-stage pools: corrupt one
@@ -904,12 +942,17 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
             adapters, _ = self._split_extra(extra)
             self.trace_counts["verify_pp"][s] = \
                 self.trace_counts["verify_pp"].get(s, 0) + 1
-            logits, npool = self._run_stage(st, params, pool, tables,
-                                            pos, x, op="block_head",
-                                            adapters=adapters)
+            with self._numerics_scope() as sink:
+                logits, npool = self._run_stage(st, params, pool, tables,
+                                                pos, x, op="block_head",
+                                                adapters=adapters)
+                choices, n_acc, last = sampling.greedy_verify(logits,
+                                                              window)
+                _numerics.tap("spec.verify_logits", logits)
             npool = self._constrain_stage(st, npool)
-            choices, n_acc, last = sampling.greedy_verify(logits, window)
-            return choices, n_acc, last, npool
+            if sink is None:
+                return choices, n_acc, last, npool
+            return choices, n_acc, last, npool, sink
         return self._cached(fn, f"verify_stage[{s}]")
 
     # -- public compute API ----------------------------------------------------
@@ -923,6 +966,7 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
         speculative engine."""
         _faults.fire("serving.decode_step")
         self._fire_kv_quant_chaos()
+        self._fire_numerics_chaos()
         self.ensure_decode_capacity()          # γ+1-wide block growth
         c = self.config
         gamma = c.gamma
@@ -944,6 +988,7 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
                       jnp.asarray(self._pos[g * mbs:(g + 1) * mbs]))
                      for g in range(M)]
         mb_windows = [window[g * mbs:(g + 1) * mbs] for g in range(M)]
+        sinks = []
 
         def stage_call(s, st, g, x):
             lo, hi = g * mbs, (g + 1) * mbs
@@ -952,12 +997,21 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
             if st.module.is_first:
                 x = mb_windows[g]
             if not st.module.is_last:
-                return self._stage_verify[s](st.decode_params, st.pool,
-                                             mb_tables, mb_pos, x, *adp)
+                res = self._stage_verify[s](st.decode_params, st.pool,
+                                            mb_tables, mb_pos, x, *adp)
+                if self._numerics_armed:
+                    y, npool, sink = res
+                    sinks.append(sink)
+                    return y, npool
+                return res
             win = jax.device_put(mb_windows[g], st.replicated)
-            ch, na, la, npool = self._stage_verify[s](
+            res = self._stage_verify[s](
                 st.decode_params, st.pool, mb_tables, mb_pos, x, win,
                 *adp)
+            if self._numerics_armed:
+                sinks.append(res[-1])
+                res = res[:-1]
+            ch, na, la, npool = res
             return (ch, na, la), npool
 
         with RecordEvent("serving::spec_verify",
@@ -967,6 +1021,8 @@ class PipelineParallelSpeculativeEngine(_spec.SpeculativeEngine,
                           "attend": c.attention_impl}), \
                 blocks.attention_impl(c.attention_impl):
             out = self._ride_ring(self._verify_tbl, M, stage_call)
+        for sink in sinks:
+            self._ingest_numerics(sink)
         verify_s = time.perf_counter() - t1
         _spec._M_VERIFY_SECONDS.observe(verify_s)
         choices = np.concatenate([np.asarray(o[0], np.int32)

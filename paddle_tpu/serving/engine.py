@@ -34,7 +34,9 @@ from ..core.tensor import Tensor
 from ..framework import compile_cache as _cc
 from ..nn.layer.layers import functional_call, functional_state
 from ..observability import faults as _faults
+from ..observability import flight_recorder as _flight_recorder
 from ..observability import kvledger as _kvl
+from ..observability import numerics as _numerics
 from ..profiler import RecordEvent, TracerEventType
 from . import blocks
 from . import kv_cache as kvc
@@ -84,7 +86,7 @@ class EngineConfig:
     def __init__(self, slots=4, max_len=256, prefill_buckets=None,
                  decode_strategy="greedy", temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, seed=0,
-                 compile_cache_dir=None):
+                 compile_cache_dir=None, numerics_taps=False):
         self.slots = int(slots)
         self.max_len = int(max_len)
         # the ladder always ends in a max_len-sized bucket so every prompt
@@ -99,6 +101,14 @@ class EngineConfig:
         self.eos_token_id = eos_token_id
         self.seed = int(seed)
         self.compile_cache_dir = compile_cache_dir
+        # numerics_taps=True arms the in-trace sentinel plane
+        # (observability.numerics): the traced bodies open a sink_scope
+        # and return one fused [finite_frac, absmax, rms, sat_frac]
+        # vector per tap site as an extra output, fed to the engine's
+        # NumericsMonitor after each step. The capture_logits pattern:
+        # a different traced program, still compiled exactly once, and
+        # the disabled arm's traces are bit-identical to pre-tap code.
+        self.numerics_taps = bool(numerics_taps)
 
     # field names that round-trip through the .gencfg serving record;
     # seed is INCLUDED (it only feeds RNG key VALUES, but recording it
@@ -106,7 +116,7 @@ class EngineConfig:
     # compile_cache_dir stays machine-local
     _DICT_FIELDS = ("slots", "max_len", "prefill_buckets",
                     "decode_strategy", "temperature", "top_k", "top_p",
-                    "eos_token_id", "seed")
+                    "eos_token_id", "seed", "numerics_taps")
 
     def as_dict(self):
         """JSON-serializable ctor kwargs: EngineConfig-family configs
@@ -175,6 +185,19 @@ class GenerationEngine:
         # A warm persistent-cache load DESERIALIZES the executable and
         # never traces — these staying 0 is the zero-fresh-compiles proof.
         self.trace_counts = {"decode": 0, "prefill": {}}
+        # numerics health plane (ISSUE 19): armed at build time like
+        # capture_logits. The monitor classifies every step's sink;
+        # `_last_decode_args` keeps the last step's inputs alive for the
+        # bisection localizer (serving executables never donate their
+        # inputs, so the refs are free); the probe flags route localizer
+        # re-traces of `_decode_fn` away from the 'decode' counter.
+        self.numerics_monitor = _numerics.NumericsMonitor(
+            auto_bundle=False) if self._numerics_armed else None
+        self.last_numerics = None
+        self.last_localization = None
+        self._last_decode_args = None
+        self._numerics_probing = False
+        self._numerics_probe_layers = None
         self.compile_cache = _cc.CompileCache(self.config.compile_cache_dir) \
             if self.config.compile_cache_dir else None
         self._alloc_state()                    # cache layout hook
@@ -249,16 +272,162 @@ class GenerationEngine:
             logits, key=key, strategy=c.decode_strategy,
             temperature=c.temperature, top_k=c.top_k, top_p=c.top_p)
 
+    # -- numerics health plane (ISSUE 19) ------------------------------------
+    @property
+    def _numerics_armed(self):
+        return bool(getattr(self.config, "numerics_taps", False))
+
+    def _numerics_scope(self):
+        """sink_scope when the tap plane is armed, else a null scope —
+        the disarmed traced body is literally the pre-tap body, so
+        disabled engines keep bit-identical programs and trace counts.
+        `_numerics_probe_layers` is non-None only while the bisection
+        localizer traces a per-layer probe."""
+        if not self._numerics_armed:
+            return _numerics.null_scope()
+        return _numerics.sink_scope(self._numerics_probe_layers)
+
+    def _bump_decode_trace(self):
+        """Trace-counter routing: localizer probes re-trace `_decode_fn`
+        on purpose; they count under 'numerics_probe', never 'decode',
+        so the compile-once assertions stay exact."""
+        ctr = "numerics_probe" if self._numerics_probing else "decode"
+        self.trace_counts[ctr] = self.trace_counts.get(ctr, 0) + 1
+
+    def _probe_context(self):
+        """Trace context wrapped around a localizer probe — identity
+        here; the paged engine pins its attention impl so the probe
+        traces the same program family as the live decode."""
+        return _numerics.null_scope()
+
+    def _ingest_numerics(self, sink):
+        """Feed one step's sink through the engine monitor. The FIRST
+        nonfinite anomaly triggers the bisection localizer on the saved
+        step inputs and THEN the postmortem bundle — so detection,
+        localization, and the bundle all land within the same scheduler
+        step, and the bundle carries the localizer's annotation."""
+        mon = self.numerics_monitor
+        new = mon.observe_sink(sink)
+        self.last_numerics = {
+            site: _numerics.stats_dict(np.asarray(vec, np.float32))
+            for site, vec in sink.items()}
+        first_bad = next((site for site, kind in new
+                          if kind == "nonfinite"), None)
+        if first_bad is not None and mon.bundle_path is None:
+            loc = self.localize_numerics()
+            if loc is not None:
+                self.last_localization = loc
+                _flight_recorder.annotate("numerics_localization", loc)
+            mon.bundle(f"numerics:{first_bad}:nonfinite")
+
+    def localize_numerics(self, sat_frac_max=0.25):
+        """NaN bisection localizer: replay the saved last decode step
+        through progressively finer per-layer tap sets
+        (sink_scope(layers=...)) to name the FIRST unhealthy layer.
+        Corruption propagates forward through the stack, so per-layer
+        health is monotone and O(log n_layers) probes suffice; each
+        distinct probe layer is one extra jit, counted under
+        trace_counts['numerics_probe']. Returns the localization record
+        (annotated into the postmortem bundle), or None when no decode
+        step has run yet."""
+        args = self._last_decode_args
+        if args is None:
+            return None
+        n_layers = self._model.cfg.num_layers
+        probe_sinks = {}
+
+        def probe_sink(k):
+            if k not in probe_sinks:
+                self._numerics_probing = True
+                self._numerics_probe_layers = (k,)
+                try:
+                    fn = jax.jit(lambda *a: self._decode_fn(*a)[-1])
+                    with self._probe_context():
+                        probe_sinks[k] = fn(*args)  # traces HERE, while
+                finally:                            # the filter is set
+                    self._numerics_probing = False
+                    self._numerics_probe_layers = None
+            return probe_sinks[k]
+
+        def unhealthy_at(k):
+            vec = probe_sink(k).get(f"layer{k}.act")
+            if vec is None:
+                return False
+            return _numerics.stats_unhealthy(
+                np.asarray(vec, np.float32), sat_frac_max)
+
+        first = _numerics.bisect_first_unhealthy(n_layers, unhealthy_at)
+        rec = {"first_unhealthy_layer": first,
+               "site": None if first is None else f"layer{first}.act",
+               "stats": None, "probes": len(probe_sinks),
+               "layers": n_layers}
+        if first is not None:
+            rec["stats"] = _numerics.stats_dict(np.asarray(
+                probe_sink(first)[f"layer{first}.act"], np.float32))
+        return rec
+
+    def _fire_numerics_chaos(self):
+        """`numerics.corrupt` chaos hook: poison ONE named decode tensor
+        at rest. Caller-interpreted like truncate — fire() returns the
+        spec, this hook does the damage, and the tap plane must detect
+        AND localize it. nan/inf set one element of the named weight
+        (one element of a quantized entry's scale); scale_zero zeroes a
+        quantized entry's scale outright."""
+        spec = _faults.fire("numerics.corrupt")
+        if spec is None or spec.mode not in ("nan", "inf", "scale_zero"):
+            return
+        self._apply_numerics_corruption(spec.target, spec.mode)
+
+    @staticmethod
+    def _corrupt_entry(entry, mode):
+        """Damage ONE decode-param entry per the numerics.corrupt mode;
+        returns the poisoned entry, or None when the mode does not apply
+        (scale_zero needs a quantized {"q","scale"} entry)."""
+        if isinstance(entry, dict):                # quantized entry
+            new = dict(entry)
+            if mode == "scale_zero":
+                new["scale"] = jnp.zeros_like(entry["scale"])
+            else:
+                val = jnp.float32(np.nan if mode == "nan" else np.inf)
+                new["scale"] = entry["scale"].at[
+                    (0,) * entry["scale"].ndim].set(val)
+            return new
+        if mode == "scale_zero":
+            return None
+        val = jnp.float32(np.nan if mode == "nan" else np.inf)
+        return entry.at[(0,) * entry.ndim].set(val)
+
+    def _apply_numerics_corruption(self, name, mode):
+        """Where the damage lands — the flat decode param dict here; the
+        pipeline engine overrides to find the stage holding `name`."""
+        entry = self._decode_params.get(name) if name else None
+        if entry is None:
+            return
+        entry = self._corrupt_entry(entry, mode)
+        if entry is None:
+            return
+        # dict copy: decode sees the poisoned set, `_params` (prefill,
+        # hot-swap masters) stays clean
+        self._decode_params = dict(self._decode_params, **{name: entry})
+
     # -- decode: ONE executable --------------------------------------------
     def _decode_fn(self, params, gk, gv, pos, tokens, key, *extra):
-        self.trace_counts["decode"] += 1     # trace-time only
+        self._bump_decode_trace()            # trace-time only
         adapters, rng = self._split_extra(extra)
-        logits, nk, nv = self._run_model(params, gk, gv, pos,
-                                         tokens[:, None], adapters=adapters)
-        nxt = self._select_slots(logits[:, 0, :], key, *rng)
+        with self._numerics_scope() as sink:
+            logits, nk, nv = self._run_model(params, gk, gv, pos,
+                                             tokens[:, None],
+                                             adapters=adapters)
+            nxt = self._select_slots(logits[:, 0, :], key, *rng)
+            _numerics.tap("decode.logits", logits[:, 0, :])
+            if adapters is not None:
+                _numerics.tap_tree("adapter.delta", adapters["layers"])
         # free slots keep decoding garbage harmlessly; clamp so their
         # position (and the wpe lookup) stays in-bounds forever
-        return nxt, nk, nv, jnp.minimum(pos + 1, self.config.max_len - 1)
+        new_pos = jnp.minimum(pos + 1, self.config.max_len - 1)
+        if sink is None:
+            return nxt, nk, nv, new_pos
+        return nxt, nk, nv, new_pos, sink
 
     # -- prefill: one executable per bucket ---------------------------------
     def _make_prefill(self, bucket):
@@ -274,19 +443,23 @@ class GenerationEngine:
                      for k in gk]
             lk = [f.k for f in fresh]
             lv = [f.v for f in fresh]
-            logits, nk, nv = self._run_model(params, lk, lv, local_pos,
-                                             ids[None, :])
-            slot = slot.astype(jnp.int32)
-            gk = [jax.lax.dynamic_update_slice(g, n, (slot, 0, 0, 0))
-                  for g, n in zip(gk, nk)]
-            gv = [jax.lax.dynamic_update_slice(g, n, (slot, 0, 0, 0))
-                  for g, n in zip(gv, nv)]
-            pos = jax.lax.dynamic_update_slice(
-                pos, length[None].astype(pos.dtype), (slot,))
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
-                                                keepdims=False)
-            first_token = self._select(last[None, :], key)[0]
-            return first_token, gk, gv, pos
+            with self._numerics_scope() as sink:
+                logits, nk, nv = self._run_model(params, lk, lv, local_pos,
+                                                 ids[None, :])
+                slot = slot.astype(jnp.int32)
+                gk = [jax.lax.dynamic_update_slice(g, n, (slot, 0, 0, 0))
+                      for g, n in zip(gk, nk)]
+                gv = [jax.lax.dynamic_update_slice(g, n, (slot, 0, 0, 0))
+                      for g, n in zip(gv, nv)]
+                pos = jax.lax.dynamic_update_slice(
+                    pos, length[None].astype(pos.dtype), (slot,))
+                last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                    keepdims=False)
+                first_token = self._select(last[None, :], key)[0]
+                _numerics.tap("prefill.logits", last[None, :])
+            if sink is None:
+                return first_token, gk, gv, pos
+            return first_token, gk, gv, pos, sink
         return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     def bucket_for(self, length):
@@ -508,12 +681,17 @@ class GenerationEngine:
         with RecordEvent("serving::prefill", TracerEventType.UserDefined,
                          {"bucket": bucket, "length": int(prompt.size),
                           "slot": int(slot)}):
-            first, gk, gv, pos = self._prefill[bucket](
+            out = self._prefill[bucket](
                 self._params, [l.k for l in self._cache.layers],
                 [l.v for l in self._cache.layers],
                 self._cache.pos, jnp.asarray(slot, jnp.int32),
                 jnp.asarray(padded), jnp.asarray(prompt.size, jnp.int32),
                 self._slot_key(slot))
+        if self._numerics_armed:
+            first, gk, gv, pos, sink = out
+            self._ingest_numerics(sink)
+        else:
+            first, gk, gv, pos = out
         self._set_cache(gk, gv, pos)
         self._slot_gen[int(slot)] += 1
         first = int(first)
@@ -525,6 +703,7 @@ class GenerationEngine:
         # chaos hook: an injected raise here exercises the scheduler's
         # quarantine/reprobe path without touching the executable
         _faults.fire("serving.decode_step")
+        self._fire_numerics_chaos()
         with RecordEvent("serving::decode_step",
                          TracerEventType.UserDefined,
                          {"slots": self.config.slots}):
@@ -532,11 +711,19 @@ class GenerationEngine:
             # decode consumes _decode_params (identity == _params here;
             # the paged engine's weight-quant hook makes them differ) so
             # the hook's contract holds on every engine
-            nxt, gk, gv, pos = self._decode(
+            args = (
                 self._decode_params, [l.k for l in self._cache.layers],
                 [l.v for l in self._cache.layers], self._cache.pos,
                 jnp.asarray(tokens), self._next_key(),
                 *self._adapter_args(), *self._rng_args())
+            if self._numerics_armed:
+                self._last_decode_args = args    # the localizer's replay
+            out = self._decode(*args)
+        if self._numerics_armed:
+            nxt, gk, gv, pos, sink = out
+            self._ingest_numerics(sink)
+        else:
+            nxt, gk, gv, pos = out
         self._set_cache(gk, gv, pos)
         self._slot_gen += 1
         out = np.asarray(nxt, np.int32)
@@ -1193,17 +1380,41 @@ class PagedGenerationEngine(GenerationEngine):
 
     # -- decode: ONE executable ---------------------------------------------
     def _decode_fn(self, params, pool, tables, pos, tokens, key, *extra):
-        self.trace_counts["decode"] += 1     # trace-time only
+        self._bump_decode_trace()            # trace-time only
         adapters, rng = self._split_extra(extra)
-        logits, npool = self._run_model_paged(
-            self._dequant_params(params), pool, tables, pos,
-            tokens[:, None], adapters=adapters)
-        nxt = self._select_slots(logits[:, 0, :], key, *rng)
+        with self._numerics_scope() as sink:
+            if self.kv_quantized:
+                # fused health of the WHOLE quantized pool: scale
+                # magnitudes plus the int8 code-saturation fraction
+                # (codes pinned at +-127 mean the scale clipped)
+                _numerics.tap_tree(
+                    "kv.scale",
+                    [x for l in pool for x in (l.k_scale, l.v_scale)])
+                _numerics.tap_tree(
+                    "kv.codes", [x for l in pool for x in (l.k, l.v)],
+                    sat_threshold=127)
+            quant = [v for v in params.values() if isinstance(v, dict)]
+            if quant:
+                _numerics.tap_tree("weights.scale",
+                                   [w["scale"] for w in quant])
+                _numerics.tap_tree("weights.q",
+                                   [w["q"] for w in quant],
+                                   sat_threshold=127)
+            logits, npool = self._run_model_paged(
+                self._dequant_params(params), pool, tables, pos,
+                tokens[:, None], adapters=adapters)
+            nxt = self._select_slots(logits[:, 0, :], key, *rng)
+            _numerics.tap("decode.logits", logits[:, 0, :])
+            if adapters is not None:
+                _numerics.tap_tree("adapter.delta", adapters["layers"])
         npool = self._constrain_pools(npool)
         new_pos = jnp.minimum(pos + 1, self.config.max_len - 1)
+        out = (nxt, npool, new_pos)
         if self.config.capture_logits:
-            return nxt, npool, new_pos, logits[:, 0, :]
-        return nxt, npool, new_pos
+            out = out + (logits[:, 0, :],)
+        if sink is not None:
+            out = out + (sink,)          # the sink rides LAST, always
+        return out
 
     # -- prefill: one executable per SUFFIX bucket ---------------------------
     def _make_prefill(self, bucket):
@@ -1218,16 +1429,20 @@ class PagedGenerationEngine(GenerationEngine):
             # suffix K/V and the gather over the (possibly shared) prefix
             # blocks; `start` = tokens already resident (prefix hit)
             row = jax.lax.dynamic_slice(tables, (slot, 0), (1, nb))
-            logits, npool = self._run_model_paged(
-                params, pool, row, start[None], ids[None, :],
-                valid=length[None])
+            with self._numerics_scope() as sink:
+                logits, npool = self._run_model_paged(
+                    params, pool, row, start[None], ids[None, :],
+                    valid=length[None])
+                pos = jax.lax.dynamic_update_slice(
+                    pos, (start + length)[None].astype(pos.dtype), (slot,))
+                last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                                    keepdims=False)
+                first_token = self._select(last[None, :], key)[0]
+                _numerics.tap("prefill.logits", last[None, :])
             npool = self._constrain_pools(npool)
-            pos = jax.lax.dynamic_update_slice(
-                pos, (start + length)[None].astype(pos.dtype), (slot,))
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
-                                                keepdims=False)
-            first_token = self._select(last[None, :], key)[0]
-            return first_token, npool, pos
+            if sink is None:
+                return first_token, npool, pos
+            return first_token, npool, pos, sink
         return self._cached(prefill_fn, f"prefill[{bucket}]")
 
     # -- public compute API --------------------------------------------------
@@ -1322,11 +1537,16 @@ class PagedGenerationEngine(GenerationEngine):
         stages in chunks instead. Returns the first token (host int)."""
         if bucket not in self._prefill:
             self._prefill[bucket] = self._make_prefill(bucket)
-        first, pool, pos = self._prefill[bucket](
+        out = self._prefill[bucket](
             self._params, self._pool, jnp.asarray(self._tables),
             jnp.asarray(self._pos), jnp.asarray(slot, jnp.int32),
             jnp.asarray(padded), jnp.asarray(length, jnp.int32),
             jnp.asarray(start, jnp.int32), self._slot_key(slot))
+        if self._numerics_armed:
+            first, pool, pos, sink = out
+            self._ingest_numerics(sink)
+        else:
+            first, pool, pos = out
         self._pool = pool
         self._pos = np.array(pos, np.int32)   # owned, writable copy
         return int(first)
@@ -1338,6 +1558,7 @@ class PagedGenerationEngine(GenerationEngine):
         scheduler pre-grows per slot so it can preempt instead)."""
         _faults.fire("serving.decode_step")
         self._fire_kv_quant_chaos()
+        self._fire_numerics_chaos()
         self.ensure_decode_capacity()
         with RecordEvent("serving::decode_step",
                          TracerEventType.UserDefined,
@@ -1346,11 +1567,18 @@ class PagedGenerationEngine(GenerationEngine):
                           "attend": self.config.attention_impl}), \
                 blocks.attention_impl(self.config.attention_impl):
             tokens = self._last_tokens
-            res = self._decode(
+            args = (
                 self._decode_params, self._pool, jnp.asarray(self._tables),
                 jnp.asarray(self._pos), jnp.asarray(tokens),
                 self._next_key(), *self._adapter_args(),
                 *self._rng_args())
+            if self._numerics_armed:
+                self._last_decode_args = args    # the localizer's replay
+            res = self._decode(*args)
+        if self._numerics_armed:
+            sink = res[-1]
+            res = res[:-1]
+            self._ingest_numerics(sink)
         if self.config.capture_logits:
             nxt, pool, pos, logits = res
             self.last_logits = np.asarray(logits, np.float32)
@@ -1362,6 +1590,9 @@ class PagedGenerationEngine(GenerationEngine):
         out = np.asarray(nxt, np.int32)
         self._last_tokens = out.copy()
         return out
+
+    def _probe_context(self):
+        return blocks.attention_impl(self.config.attention_impl)
 
     def _fire_kv_quant_chaos(self):
         """The `serving.kv_quant` chaos site (truncate mode, like the

@@ -17,6 +17,7 @@ import collections
 
 import numpy as np
 
+from ...observability import numerics as _numerics
 from ..blocks import dequant_codes, quantize_codes
 
 __all__ = ["HostTier"]
@@ -41,6 +42,14 @@ class HostTier:
         self.capacity = int(capacity_blocks)
         self.dtype = dtype
         self._entries = collections.OrderedDict()   # key -> rec, LRU first
+        # int8 requant code-saturation telemetry (ISSUE 19): fraction of
+        # codes at the ±127 rail per requantizing put.  High saturation
+        # means the per-head abs-max scale is dominated by outliers and
+        # the demoted block will round-trip with visible error.
+        self.last_put_saturation = None
+        self._sat_sum = 0.0
+        self._sat_max = 0.0
+        self._sat_samples = 0
 
     def __len__(self):
         return len(self._entries)
@@ -59,6 +68,7 @@ class HostTier:
         if self.dtype != "int8":
             return rec
         arrays = {}
+        qcodes = []
         for name, a in rec["arrays"].items():
             if a.dtype != np.float32 or a.ndim != 3:
                 arrays[name] = a          # codes / scale rows: lossless
@@ -69,6 +79,21 @@ class HostTier:
                 quantize_codes(a, scale[None, :, None]), np.int8)
             arrays[name + _Q8] = codes
             arrays[name + _S8] = scale.astype(np.float32)
+            qcodes.append(codes)
+        if qcodes:
+            total = sum(c.size for c in qcodes)
+            railed = sum(int((np.abs(c) >= 127).sum()) for c in qcodes)
+            sat = railed / total if total else 0.0
+            self.last_put_saturation = sat
+            self._sat_sum += sat
+            self._sat_max = max(self._sat_max, sat)
+            self._sat_samples += 1
+            # host-side sentinel: latches saturation anomalies when a
+            # process numerics monitor is armed, no-op otherwise
+            _numerics.observe_tree("kv_tier.requant_codes", qcodes,
+                                   sat_threshold=127)
+        else:
+            self.last_put_saturation = None
         return dict(rec, arrays=arrays)
 
     @staticmethod
@@ -113,6 +138,16 @@ class HostTier:
 
     def drop(self, key):
         return self._entries.pop(key, None) is not None
+
+    def saturation_stats(self):
+        """Running int8 requant code-saturation summary across puts."""
+        n = self._sat_samples
+        return {
+            "samples": n,
+            "mean": (self._sat_sum / n) if n else 0.0,
+            "max": self._sat_max,
+            "last": self.last_put_saturation,
+        }
 
     def overflow(self):
         """Pop and return the coldest entries beyond capacity as

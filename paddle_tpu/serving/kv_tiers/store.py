@@ -143,7 +143,8 @@ class TieredBlockStore:
         self.host.put(key, rec)
         _C_DEMOTE.labels(tier="host").inc()
         if self._ledger is not None:
-            self._ledger.tier_demote((int(blk),), key, "host", owner)
+            self._ledger.tier_demote((int(blk),), key, "host", owner,
+                                     sat=self.host.last_put_saturation)
         self._spill_overflow()
         self._export()
         return True
@@ -335,9 +336,15 @@ class TieredBlockStore:
 
     # -- report taps ---------------------------------------------------------
     def stats(self):
+        sat = self.host.saturation_stats()
         return {
             "host_blocks": len(self.host),
             "disk_blocks": len(self.disk) if self.disk is not None else 0,
             "disk_dead_fraction": round(self.disk.dead_fraction(), 4)
             if self.disk is not None else 0.0,
+            "host_requant_saturation": {
+                "samples": sat["samples"],
+                "mean": round(sat["mean"], 4),
+                "max": round(sat["max"], 4),
+            },
         }

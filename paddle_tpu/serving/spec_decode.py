@@ -65,6 +65,7 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import functional_call, functional_state
 from ..observability import faults as _faults
 from ..observability import metrics as _metrics
+from ..observability import numerics as _numerics
 from ..profiler import RecordEvent, TracerEventType
 from . import blocks
 from . import kv_cache as kvc
@@ -292,11 +293,15 @@ class SpeculativeEngine(PagedGenerationEngine):
         # target's choices), so adapted output is exact; the draft stays
         # base and only pays in acceptance rate on adapted slots
         adapters, _ = self._split_extra(extra)
-        logits, npool = self._run_model_paged(
-            self._dequant_params(params), pool, tables, pos, window,
-            adapters=adapters)
+        with self._numerics_scope() as sink:
+            logits, npool = self._run_model_paged(
+                self._dequant_params(params), pool, tables, pos, window,
+                adapters=adapters)
+            choices, n_acc, last = sampling.greedy_verify(logits, window)
+            # the verify window's logit rows are where a quantized
+            # target's corruption first meets emitted tokens
+            _numerics.tap("spec.verify_logits", logits)
         npool = self._constrain_pools(npool)
-        choices, n_acc, last = sampling.greedy_verify(logits, window)
         # advance by accepted+1; rejected-tail K/V stays beyond pos,
         # invisible and overwritten next round (rollback by position).
         # int8 pools: the verify write cannot mask the not-yet-known
@@ -311,7 +316,9 @@ class SpeculativeEngine(PagedGenerationEngine):
         # is bounded extra rounding noise, priced by the spec-quant
         # composition test's 0.9 stream-agreement bar.
         pos_next = jnp.minimum(pos + n_acc + 1, self.config.max_len - 1)
-        return choices, n_acc, last, npool, pos_next
+        if sink is None:
+            return choices, n_acc, last, npool, pos_next
+        return choices, n_acc, last, npool, pos_next, sink
 
     def _make_draft_prefill(self, bucket):
         def fn(params, lk, lv, pos, slot, ids, length):
@@ -434,6 +441,7 @@ class SpeculativeEngine(PagedGenerationEngine):
         loop."""
         _faults.fire("serving.decode_step")
         self._fire_kv_quant_chaos()
+        self._fire_numerics_chaos()
         self.ensure_decode_capacity()
         c = self.config
         gamma = c.gamma
@@ -449,10 +457,15 @@ class SpeculativeEngine(PagedGenerationEngine):
                          {"window": gamma + 1, "slots": c.slots,
                           "attend": c.attention_impl}), \
                 blocks.attention_impl(c.attention_impl):
-            choices, n_acc, last, pool, pos = self._spec_verify(
+            vres = self._spec_verify(
                 self._decode_params, self._pool,
                 jnp.asarray(self._tables), jnp.asarray(self._pos), window,
                 *self._adapter_args())
+        if self._numerics_armed:
+            choices, n_acc, last, pool, pos, sink = vres
+            self._ingest_numerics(sink)
+        else:
+            choices, n_acc, last, pool, pos = vres
         verify_s = time.perf_counter() - t1
         _M_VERIFY_SECONDS.observe(verify_s)
         self._pool = pool

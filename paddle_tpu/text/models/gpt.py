@@ -14,6 +14,7 @@ from ...core.tensor import Tensor, apply_op
 from ...nn import (Dropout, Embedding, GELU, Layer, LayerList, LayerNorm, Linear)
 from ...nn import functional as F
 from ...nn.initializer import Normal
+from ...observability import numerics as _numerics
 
 
 @dataclass
@@ -230,6 +231,10 @@ class GPT(Layer):
                 x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables,
                                  valid=valid, adapters=lv)
                 new_layers.append(new_lkv)
+                # per-layer sentinel (ISSUE 19): dormant unless a
+                # numerics sink with a layer filter is armed — the
+                # bisection localizer's probe sites
+                _numerics.tap_layer(i, "act", x._data)
             logits = self._head(self.ln_f(x))
             if tables is not None:
                 from ...serving import blocks as _blk
@@ -326,6 +331,9 @@ class GPTStage(Layer):
             x, new_lkv = blk(x, cache=lkv, pos=pos, tables=tables,
                              valid=valid, adapters=lv)
             new_layers.append(new_lkv)
+            # GLOBAL layer index: localizer sites stay unique across
+            # pipeline stages
+            _numerics.tap_layer(self.start + i, "act", x._data)
         if op == "block_head":
             return self._head(self.ln_f(x)), tuple(new_layers)
         return x, tuple(new_layers)

@@ -216,7 +216,7 @@ class _LedgerSpy:
     def __init__(self):
         self.events = []
 
-    def tier_demote(self, block_ids, key, tier, owner):
+    def tier_demote(self, block_ids, key, tier, owner, sat=None):
         self.events.append(("demote", key, tier, owner))
 
     def tier_promote(self, block_ids, key, tier, owner):

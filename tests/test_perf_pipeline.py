@@ -357,6 +357,59 @@ def test_metrics_compare_flags_quant_quality_regressions(tmp_path):
     assert metrics_report.compare_counters(a, c, min_delta=0.001) == []
 
 
+def test_metrics_compare_flags_numerics_anomalies(tmp_path):
+    """ISSUE 19 gate: `numerics_anomaly_total` growth (a latched
+    sentinel anomaly, per site×kind labelset) and a
+    `numerics_site_finite_frac` gauge drop are failure-class — a run
+    that went non-finite is broken however fast it was. Exercised
+    through compare_counters AND the CLI exit code."""
+    a = _snapshot_with_labeled({
+        "numerics_anomaly_total": [({"site": "decode.logits",
+                                     "kind": "nonfinite"}, 0)]})
+    b = _snapshot_with_labeled({
+        "numerics_anomaly_total": [({"site": "decode.logits",
+                                     "kind": "nonfinite"}, 2)]})
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("numerics_anomaly_total{kind=nonfinite,"
+                   "site=decode.logits}") == "failure counter grew"
+    assert metrics_report.compare_counters(a, a) == []
+    # the finite-fraction gauge dropping fires the gauge-drop rule
+    ga = _snapshot_with_gauges(
+        gauges={"numerics_site_finite_frac": 1.0})
+    gb = _snapshot_with_gauges(
+        gauges={"numerics_site_finite_frac": 0.5})
+    gregs = metrics_report.compare_counters(ga, gb)
+    gwhy = {k: w for k, *_, w in gregs}
+    assert any("finite fraction dropped" in w for w in gwhy.values()), gregs
+    # the CLI gate exits nonzero and names the counter
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "numerics_anomaly_total" in bad.stdout
+
+
+def test_bench_train_rung_runs_numerics_armed(bench_artifacts):
+    """ISSUE 19 satellite: the healthy bench train rung runs with the
+    sentinel plane armed, asserts ZERO latched anomalies, and ships the
+    per-site stats in extra — so every committed BENCH record doubles
+    as a numerics-health attestation."""
+    out_dir, rec = bench_artifacts
+    num = rec["extra"]["numerics"]
+    assert num["anomalies"] == 0
+    assert num["counts"] == {}
+    sites = num["sites"]
+    assert "train.param_global_norm" in sites
+    assert "train.loss" in sites
+    for site, st in sites.items():
+        assert st["finite_frac"] == 1.0, (site, st)
+
+
 def test_bench_emits_cost_model_delta(bench_artifacts):
     """ISSUE 8 satellite (ROADMAP item 1 debt): every bench run carries
     the analytical predicted-vs-measured block in extra, and the

@@ -446,3 +446,9 @@ def test_bench_serve_load_rung_runs():
     assert extra["paged"]["kv_memory_tokens"] == \
         extra["dense"]["kv_memory_tokens"]
     assert extra["paged_beats_dense_concurrency"] is True
+    # ISSUE 19 satellite: the int8 arm re-runs armed with numerics taps
+    # and attests zero latched anomalies across the quant tap surfaces
+    num = extra["numerics"]
+    assert num["anomalies"] == 0
+    assert {"decode.logits", "kv.codes", "kv.scale",
+            "weights.q", "weights.scale"} <= set(num["sites"])

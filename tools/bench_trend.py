@@ -15,6 +15,12 @@ round:
            a zero-value metric carrying an error field (the bench's own
            backend-unavailable record) — excluded from trend AND
            baseline, listed with its wedge reason
+  NUMERIC  the round died (nonzero rc or no metric) WITH a latched
+           numerics anomaly on record — `numerics anomaly`/`non-finite`
+           in the error or tail, or `extra.numerics.anomalies` > 0
+           (ISSUE 19) — a numerics-health casualty, not a perf
+           regression or a wedged grant; excluded from trend AND
+           baseline, listed with the anomaly signature
   FAILED   everything else (a genuine crash, e.g. r02's HBM OOM) —
            excluded from baseline, shown as a failure in the table
 
@@ -45,6 +51,7 @@ SCHEMA = "paddle_tpu.benchtrend.v1"
 
 HEALTHY = "HEALTHY"
 WEDGED = "WEDGED"
+NUMERIC = "NUMERIC"
 FAILED = "FAILED"
 
 # the wedge signatures: the driver's timeout rc, and the bench's own
@@ -52,6 +59,22 @@ FAILED = "FAILED"
 _WEDGE_RC = 124
 _WEDGE_PAT = re.compile(r"backend probe hung|wedged grant|"
                         r"backend unavailable", re.I)
+# the numerics-casualty signatures (ISSUE 19): the bench's armed
+# sentinel plane latched an anomaly before/while the round died
+_NUMERIC_PAT = re.compile(r"numerics?[ _]anomal|non-?finite|"
+                          r"nan.?bisect", re.I)
+
+
+def _numeric_anomalies(parsed):
+    """`extra.numerics.anomalies` count from a parsed bench record
+    (0 when absent or malformed)."""
+    num = (parsed.get("extra") or {}).get("numerics")
+    if isinstance(num, dict):
+        try:
+            return int(num.get("anomalies") or 0)
+        except (TypeError, ValueError):
+            return 0
+    return 0
 
 
 def classify(doc):
@@ -65,9 +88,20 @@ def classify(doc):
     if _WEDGE_PAT.search(err) or (_WEDGE_PAT.search(tail)
                                   and not parsed.get("value")):
         return WEDGED, (err or "wedge signature in tail")[:120]
+    dead = rc != 0 or not parsed or not parsed.get("value")
+    if dead:
+        # a dead round with a latched numerics anomaly is a NUMERIC
+        # casualty, not a generic failure — and never a wedge, so this
+        # check outranks the zero-metric-with-error wedge rule below
+        n_anom = _numeric_anomalies(parsed)
+        if n_anom or _NUMERIC_PAT.search(err) or _NUMERIC_PAT.search(tail):
+            why = err[:100] if _NUMERIC_PAT.search(err) else \
+                f"{n_anom} latched numerics anomalies" if n_anom else \
+                "numerics anomaly signature in tail"
+            return NUMERIC, why
     if parsed and not parsed.get("value") and err:
         return WEDGED, f"zero metric with error: {err[:100]}"
-    if rc != 0 or not parsed or not parsed.get("value"):
+    if dead:
         return FAILED, f"rc={rc}, " + (
             "no parsed metric" if not parsed
             else err[:100] or "no metric value")
@@ -141,6 +175,11 @@ def render(rows):
     if wedged:
         out.append(f"wedged (excluded from trend/baseline): "
                    f"{', '.join(r['run'] for r in wedged)}")
+    numeric = [r for r in rows if r["class"] == NUMERIC]
+    if numeric:
+        out.append(f"numeric casualties (latched anomalies, excluded "
+                   f"from trend/baseline): "
+                   f"{', '.join(r['run'] for r in numeric)}")
     traj = [r for r in healthy if r["mfu"] is not None]
     if traj:
         out.append("healthy MFU trajectory: " + " -> ".join(

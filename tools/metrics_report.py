@@ -73,7 +73,14 @@ threshold. Direction matters and is decided per counter name:
     even when hit counts grew with traffic); and the
     `serving_kv_restore_seconds` approximate p99 growing past the
     threshold is failure-class (cold-chain promotion losing its race
-    against recompute).
+    against recompute),
+  - numerics health plane (ISSUE 19): `numerics_anomaly_total{site,kind}`
+    — latched by the sentinel monitor when a tapped tensor goes
+    non-finite, drifts past its rolling-MAD baseline, or saturates its
+    int8 code range — joins the failure class (pattern `anomal`), and a
+    `numerics_site_finite_frac{site}` gauge dropping below run A is
+    failure-class on its own (non-finite values entered a tapped tensor
+    even if no counter latched in run A's window).
 
 Fleet-merged snapshots (ISSUE 12, observability/fleet.py) are compared
 LABEL-AWARE: every series already carries `worker_id`/`role` labels in
@@ -111,7 +118,7 @@ _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
     r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
     r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover|diverg|leak"
-    r"|rate_limited|evict|corrupt",
+    r"|rate_limited|evict|corrupt|anomal",
     re.I)
 
 # counter pairs whose RATIO is the SLO signal: a rate drop past the
@@ -189,6 +196,11 @@ _GAUGE_DROP_RULES = (
     # is failure-class no matter how fast the int8 path got
     (re.compile(r"serving_quant_greedy_match(\{.*\})?$"),
      "quantized greedy-match rate vs f32 oracle dropped"),
+    # ISSUE 19 numerics plane: a site's finite fraction dropping below
+    # run A means non-finite values entered a tensor the sentinel taps —
+    # failure-class even before any anomaly counter latches
+    (re.compile(r"numerics_site_finite_frac(\{.*\})?$"),
+     "tapped-site finite fraction dropped"),
 )
 
 # HISTOGRAM rules (ISSUE 10): histograms whose approximate p99 GROWING

@@ -174,10 +174,13 @@ KVLEDGER_FIELDS = {"kind": str, "schema": str, "seq": int, "event": str,
                    "blocks": list,
                    "request_id": (int, type(None)), "tenant": str,
                    "origin": (str, type(None)), "tokens": int,
-                   "key": str, "tier": str, "owner": str, "reason": str}
+                   "key": str, "tier": str, "owner": str, "reason": str,
+                   "sat": float}
 # `tokens` rides only on share events; `key`/`tier`/`owner` (+ optional
-# `reason`) only on the ISSUE 18 tier_* events
-OPTIONAL_KVLEDGER_FIELDS = {"tokens", "key", "tier", "owner", "reason"}
+# `reason`) only on the ISSUE 18 tier_* events; `sat` (ISSUE 19) is the
+# int8 requant code-saturation fraction riding on host tier_demote
+OPTIONAL_KVLEDGER_FIELDS = {"tokens", "key", "tier", "owner", "reason",
+                            "sat"}
 # the phases-sum-to-e2e acceptance gate: contiguous trail construction
 # makes the sum structurally exact, so 5% + 1ms of slack only absorbs
 # float rounding on sub-millisecond runs
@@ -370,10 +373,17 @@ def kv_residency(events):
             # promote/drop remove it
             tier = ev.get("tier") or "?"
             row = tiers.setdefault(tier, {"demoted": 0, "promoted": 0,
-                                          "dropped": 0})
+                                          "dropped": 0,
+                                          "sat_sum": 0.0, "sat_max": 0.0,
+                                          "sat_n": 0})
             if event == "tier_demote":
                 row["demoted"] += 1
                 tier_res[ev.get("key")] = tier
+                # ISSUE 19: int8 requant saturation riding on the demote
+                if isinstance(ev.get("sat"), (int, float)):
+                    row["sat_sum"] += float(ev["sat"])
+                    row["sat_max"] = max(row["sat_max"], float(ev["sat"]))
+                    row["sat_n"] += 1
             else:
                 row["promoted" if event == "tier_promote"
                     else "dropped"] += 1
@@ -423,6 +433,12 @@ def kv_residency(events):
             tenants[tt][kk] += 1
     for tier, row in tiers.items():
         row["resident"] = sum(1 for tt in tier_res.values() if tt == tier)
+        n = row.pop("sat_n")
+        sat_sum, sat_max = row.pop("sat_sum"), row.pop("sat_max")
+        # requant saturation summary only where demotes carried one
+        row["requant_sat"] = {"mean": round(sat_sum / n, 4),
+                              "max": round(sat_max, 4),
+                              "samples": n} if n else None
     return {"tenants": tenants, "prefix_share": share, "tiers": tiers}
 
 
@@ -699,11 +715,15 @@ def render(summary):
             out += ["", "### KV tier residency (cold tiers, end of "
                         "run)", "",
                     "| tier | resident entries | demotes | promotes | "
-                    "drops |", "|---|---|---|---|---|"]
+                    "drops | requant sat (mean/max) |",
+                    "|---|---|---|---|---|---|"]
             for tier, row in sorted(res["tiers"].items()):
+                sat = row.get("requant_sat")
+                sat_disp = (f"{sat['mean']:.4f} / {sat['max']:.4f}"
+                            if sat else "-")
                 out.append(f"| {tier} | {row['resident']} | "
                            f"{row['demoted']} | {row['promoted']} | "
-                           f"{row['dropped']} |")
+                           f"{row['dropped']} | {sat_disp} |")
         if res["prefix_share"]:
             out += ["", "### prefix-chain sharing (who rides whose "
                         "chains)", "",
