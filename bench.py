@@ -618,6 +618,14 @@ def _parse_args(argv):
                         "over its local devices (ISSUE 13; also "
                         "$BENCH_DIST_PP_STAGES); per-group tensor degree "
                         "via $BENCH_DIST_TP")
+    p.add_argument("--gray-chaos", action="store_true",
+                   help="--serve-dist: add a GRAY-FAILURE arm (ISSUE 20) "
+                        "— same traffic through a fleet whose last decode "
+                        "worker serves RPCs 10x slow (PTN_FAULTS "
+                        "serving.rpc.serve=slow), streams asserted "
+                        "bit-identical; extra records the suspicion-"
+                        "triggered migration latency p99 and the "
+                        "deadline-miss delta vs the healthy arm")
     p.add_argument("--cold-start", action="store_true",
                    help="cold-start rung: build a serving artifact, then "
                         "race a COLD process (empty compile cache, full "
@@ -1478,7 +1486,8 @@ def _spec_pp_steady_rate(model, pp_e, sp_e):
             "slots": active, "steps": steps, "repeats": repeats}
 
 
-def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
+def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None,
+                         gray_chaos=False):
     """Multi-host serving rung (ISSUE 10): the same traffic through (a)
     ONE paged scheduler in this process and (b) a forked 1-prefill +
     N-decode worker fleet behind the router, at EQUAL allocatable KV
@@ -1490,6 +1499,16 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
     arms' p50/p99 TTFT, handoff bytes, and the compile-once counters;
     the streams of the two arms are ASSERTED identical, so the rung can
     never trade correctness for throughput.
+
+    `gray_chaos` (ISSUE 20, --gray-chaos) adds a THIRD arm: the same
+    traffic through a fresh fleet whose LAST decode worker serves every
+    RPC through a jittered sleep (PTN_FAULTS serving.rpc.serve=slow in
+    its env — its own process, so no target scoping is needed). The
+    health plane must notice (suspicion -> migration off the victim),
+    the streams must STILL be bit-identical to the single-process arm,
+    and extra.gray_chaos records the migration latency p99 (from the
+    migrate decisions' outcomes) and the deadline-miss delta vs the
+    healthy arm — the number the acceptance gate wants at ~0.
 
     Fleet observability artifacts (ISSUE 12): the distributed arm runs
     under a FleetPlane — the router's poll loop federates every
@@ -1589,8 +1608,6 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
          "handoff_bytes": 0})
 
     # ---- arm 2: forked prefill + decode pools ---------------------------
-    workdir = tempfile.mkdtemp(prefix="bench_serve_dist_")
-    procs, ep_files = [], []
     roles = ["prefill"] + ["decode"] * n_decode
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", jax.default_backend())
@@ -1602,28 +1619,37 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count="
                             f"{max(need, 1)}").strip()
-    for i, role in enumerate(roles):
-        ep = os.path.join(workdir, f"ep_{i}")
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m",
-             "paddle_tpu.serving.distributed.worker_main",
-             "--role", role,
-             "--engine", engine_kind if role == "decode" else "paged",
-             "--model", model_name, "--seed", str(seed),
-             "--index", str(i),
-             "--engine-config", _json.dumps(
-                 worker_cfg if role == "decode"
-                 else {"slots": slots, "max_len": max_len,
-                       "block_size": block}),
-             "--serving-config", _json.dumps(
-                 {"max_queue": max(64, requests),
-                  "default_max_new_tokens": max_new}),
-             "--endpoint-file", ep],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True))
-        ep_files.append(ep)
-    fe = None
-    try:
+
+    def _fork_fleet(workdir, victim_faults=None):
+        """Fork the 1-prefill + N-decode fleet into `workdir` and wait
+        for every worker's endpoint. `victim_faults` arms the LAST
+        decode worker's fault sites via PTN_FAULTS (it is its own
+        process, so no target scoping is needed). Returns
+        (procs, endpoints)."""
+        procs, ep_files = [], []
+        for i, role in enumerate(roles):
+            ep = os.path.join(workdir, f"ep_{i}")
+            wenv = env
+            if victim_faults and i == len(roles) - 1:
+                wenv = dict(env, PTN_FAULTS=victim_faults)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "paddle_tpu.serving.distributed.worker_main",
+                 "--role", role,
+                 "--engine", engine_kind if role == "decode" else "paged",
+                 "--model", model_name, "--seed", str(seed),
+                 "--index", str(i),
+                 "--engine-config", _json.dumps(
+                     worker_cfg if role == "decode"
+                     else {"slots": slots, "max_len": max_len,
+                           "block_size": block}),
+                 "--serving-config", _json.dumps(
+                     {"max_queue": max(64, requests),
+                      "default_max_new_tokens": max_new}),
+                 "--endpoint-file", ep],
+                env=wenv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+            ep_files.append(ep)
         endpoints = []
         for proc, ep in zip(procs, ep_files):
             deadline = time.time() + 300
@@ -1638,6 +1664,39 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
                 time.sleep(0.05)
             with open(ep) as f:
                 endpoints.append(f.read().strip())
+        return procs, endpoints
+
+    def _router_misses():
+        """In-process (router-side) serving_deadline_missed_total sum —
+        the router rides THIS process's registry, which persists across
+        arms, so callers take before/after deltas."""
+        from paddle_tpu.observability import metrics as _obs_metrics
+        flat = _obs_metrics.flatten_snapshot(
+            _obs_metrics.registry().snapshot(), kinds=("counter",))
+        return sum(v for k, v in flat.items()
+                   if k.startswith("serving_deadline_missed_total"))
+
+    def _worker_misses(merged):
+        """Worker-side deadline misses out of a fleet-merged snapshot
+        (fresh worker processes per arm, so absolute == delta)."""
+        total = 0.0
+        for m in merged["metrics"]:
+            if m["name"] != "serving_deadline_missed_total":
+                continue
+            for s in m["samples"]:
+                if (s.get("labels") or {}).get("worker_id") != "router":
+                    total += s["value"]
+        return total
+
+    # every request carries a (generous) deadline when the gray-chaos
+    # arm runs, so the healthy arm is the miss-delta baseline
+    req_timeout = float(os.environ.get("BENCH_DIST_REQ_TIMEOUT_S", 120))
+    workdir = tempfile.mkdtemp(prefix="bench_serve_dist_")
+    procs, endpoints = _fork_fleet(workdir)
+    fe = None
+    healthy_misses = 0.0
+    misses_before = _router_misses()
+    try:
         obs_dir = os.environ.get("BENCH_DIST_OBS_DIR") \
             or os.path.join(workdir, "obs")
         fe = DistFrontend(endpoints[1:], [endpoints[0]],
@@ -1647,7 +1706,9 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
             fe, jsonl_path=os.path.join(obs_dir, "fleet_metrics.jsonl"),
             poll_interval_s=0.2)
         t0 = time.perf_counter()
-        reqs = [fe.submit(p, max_new=max_new) for p in prompts]
+        reqs = [fe.submit(p, max_new=max_new,
+                          timeout_s=req_timeout if gray_chaos else None)
+                for p in prompts]
         fe.run(timeout_s=float(os.environ.get("BENCH_DIST_TIMEOUT_S",
                                               600)))
         dist_wall = time.perf_counter() - t0
@@ -1656,6 +1717,8 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
         merged = plane.poll_now()
         plane.write_prometheus(os.path.join(obs_dir,
                                             "fleet_metrics.prom"))
+        healthy_misses = (_router_misses() - misses_before) \
+            + _worker_misses(merged)
         bad = [r for r in reqs if r.status != "DONE"]
         assert not bad, f"{len(bad)} dist requests not DONE: " \
                         f"{[(r.key, r.status, r.error) for r in bad[:3]]}"
@@ -1714,16 +1777,83 @@ def run_serve_dist_bench(on_tpu, n_requests=None, pp_stages=None):
             except subprocess.TimeoutExpired:
                 proc.kill()
 
+    # ---- arm 3 (optional): gray-chaos fleet -----------------------------
+    chaos = None
+    if gray_chaos:
+        slow_s = float(os.environ.get("BENCH_DIST_CHAOS_SLOW_S", 0.25))
+        cworkdir = tempfile.mkdtemp(prefix="bench_serve_dist_chaos_")
+        cprocs, cendpoints = _fork_fleet(
+            cworkdir,
+            victim_faults=f"serving.rpc.serve=slow:delay={slow_s}:seed=7")
+        cfe = None
+        c_before = _router_misses()
+        try:
+            cfe = DistFrontend(
+                cendpoints[1:], [cendpoints[0]],
+                health_interval_s=0.1,
+                timeline_path=os.path.join(cworkdir, "timelines.jsonl"))
+            cplane = _fleet.FleetPlane(
+                cfe,
+                jsonl_path=os.path.join(cworkdir, "fleet_metrics.jsonl"),
+                poll_interval_s=0.2)
+            t0 = time.perf_counter()
+            creqs = [cfe.submit(p, max_new=max_new, timeout_s=req_timeout)
+                     for p in prompts]
+            cfe.run(timeout_s=float(os.environ.get("BENCH_DIST_TIMEOUT_S",
+                                                   600)))
+            chaos_wall = time.perf_counter() - t0
+            cmerged = cplane.poll_now()
+            bad = [r for r in creqs if r.status != "DONE"]
+            assert not bad, \
+                f"{len(bad)} gray-chaos requests not DONE: " \
+                f"{[(r.key, r.status, r.error) for r in bad[:3]]}"
+            assert [r.tokens for r in creqs] == single_streams, \
+                "gray-chaos streams diverged from the single-process arm"
+            mig_lat = sorted(
+                rec["outcome"].get("latency_s") or 0.0
+                for rec in cfe.decision_records()
+                if rec["action"] == "migrate"
+                and rec["outcome"].get("migrated"))
+            chaos_misses = (_router_misses() - c_before) \
+                + _worker_misses(cmerged)
+            chaos = {
+                "wall_s": round(chaos_wall, 4),
+                "victim": cendpoints[-1], "slow_s": slow_s,
+                "migrations": len(mig_lat),
+                "migration_latency_p99_s":
+                    serve_report._pct(mig_lat, 0.99) if mig_lat else None,
+                "deadline_misses": chaos_misses,
+                "deadline_miss_delta_vs_healthy":
+                    chaos_misses - healthy_misses,
+                "streams_identical": True,
+            }
+        finally:
+            if cfe is not None:
+                try:
+                    cfe.stop_workers()
+                except Exception:                        # noqa: BLE001
+                    pass
+                cfe.close()
+            for proc in cprocs:
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
     ratio = (dist["tokens_per_s"] / single["tokens_per_s"]
              if single["tokens_per_s"] else 0.0)
+    extra = {"metric_name": "serve_dist_tokens_per_s",
+             "model": model_name, "requests": requests,
+             "max_new": max_new, "dist": dist, "single": single,
+             "streams_identical": True,
+             "backend": jax.default_backend()}
+    if chaos is not None:
+        extra["gray_chaos"] = chaos
+        extra["dist"]["deadline_misses"] = healthy_misses
     return {
         "value": dist["tokens_per_s"],
         "vs_baseline": round(ratio, 3),   # dist/single tokens-per-sec
-        "extra": {"metric_name": "serve_dist_tokens_per_s",
-                  "model": model_name, "requests": requests,
-                  "max_new": max_new, "dist": dist, "single": single,
-                  "streams_identical": True,
-                  "backend": jax.default_backend()},
+        "extra": extra,
     }
 
 
@@ -1927,7 +2057,8 @@ def main(argv=None):
                             "serve-dist rung")
         try:
             result = run_serve_dist_bench(on_tpu,
-                                          pp_stages=args.pp_stages)
+                                          pp_stages=args.pp_stages,
+                                          gray_chaos=args.gray_chaos)
             emit(result["value"], result["vs_baseline"],
                  extra=result["extra"])
         finally:

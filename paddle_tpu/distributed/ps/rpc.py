@@ -417,6 +417,16 @@ class PSServer:
                     def handler(conn, op, n, aux, reqid, _ext=ext,
                                 _rctx=rctx):
                         body = _recv_exact(conn, n)   # sync before dispatch
+                        # gray-worker chaos (ISSUE 20): the body is
+                        # already consumed, so `slow` stalls and `flaky`
+                        # errors leave the stream in sync — the client
+                        # sees latency or an in-band error frame, never
+                        # a torn connection. Keyed by our endpoint so
+                        # one worker in a shared process can be gray.
+                        spec = _faults.fire("serving.rpc.serve",
+                                            key=self.endpoint)
+                        if spec is not None and spec.mode == "flaky":
+                            raise spec._exception()
                         out = _ext(body, aux, reqid, _rctx)
                         return _U32.pack(len(out)) + out
                 else:

@@ -33,13 +33,15 @@ Stdlib-only, like every observability submodule.
 
 __all__ = ["SCHEMA", "ACTIONS", "DEFAULT_TENANT", "build_record",
            "replay_shed", "replay_victim", "replay_place",
-           "replay_affinity_place", "replay_rate_limit",
+           "replay_affinity_place", "replay_rate_limit", "replay_health",
+           "replay_retry_budget", "replay_migrate",
            "validate_records", "by_tenant"]
 
 SCHEMA = "paddle_tpu.decisions.v1"
 
 ACTIONS = ("admit", "shed", "preempt", "place", "failover", "swap",
-           "quarantine", "rate_limit")
+           "quarantine", "rate_limit", "health", "migrate", "drain",
+           "retry_budget")
 
 # the tenant label value of unlabeled traffic: one vocabulary across
 # the scheduler, router, metrics labelsets, and reports, so single-
@@ -183,6 +185,58 @@ def replay_affinity_place(inputs):
     return replay_place(inputs)
 
 
+def replay_health(inputs):
+    """The gray-failure health-state rule (ISSUE 20) over recorded
+    inputs: a worker's suspicion score against the router's two
+    thresholds. Returns "healthy" | "suspect" | "dark".
+
+    inputs: {"suspicion": float, "suspect_threshold": float,
+             "dark_threshold": float}. The suspicion score itself is
+    continuous telemetry (phi-accrual staleness + latency ratios vs the
+    fleet); only the thresholded STATE is a decision, so only the
+    thresholding is replayed."""
+    s = float(inputs["suspicion"])
+    if s >= float(inputs["dark_threshold"]):
+        return "dark"
+    if s >= float(inputs["suspect_threshold"]):
+        return "suspect"
+    return "healthy"
+
+
+def replay_retry_budget(inputs):
+    """The per-worker retry token-bucket rule (ISSUE 20) over recorded
+    inputs — the retry-storm brake. Returns the binding reason string
+    (the retry is DENIED), or None when the budget covers it.
+
+    inputs: {"worker": id, "cost": tokens, "tokens_available": the
+    bucket's post-refill level at decision time}. Mirrors
+    `replay_rate_limit`: denial records replay to a reason, grants are
+    not recorded (they are the common case and carry no information
+    beyond the counters)."""
+    cost = float(inputs.get("cost", 1.0))
+    avail = float(inputs["tokens_available"])
+    if cost <= avail:
+        return None
+    return (f"worker {inputs.get('worker')} retry budget exhausted: "
+            f"cost {cost:g} > tokens available {avail:g}")
+
+
+def replay_migrate(inputs):
+    """The proactive-migration rule (ISSUE 20) over recorded inputs:
+    move a stream off a worker the moment the worker leaves `healthy`,
+    provided the stream still has tokens to produce and somewhere
+    healthy to go. Returns True to migrate.
+
+    inputs: {"state": the source worker's health state ("suspect" |
+    "dark" | "drain"), "tokens_remaining": tokens the stream still
+    owes, "eligible_workers": [healthy target ids]}."""
+    if inputs.get("state") not in ("suspect", "dark", "drain"):
+        return False
+    if int(inputs.get("tokens_remaining", 0)) < 1:
+        return False
+    return len(inputs.get("eligible_workers") or ()) > 0
+
+
 # ------------------------------------------------------------- validation
 
 def _replay_errors(rec):
@@ -215,6 +269,23 @@ def _replay_errors(rec):
             if int(got["slot"]) != int(want_slot):
                 return [f"preempt victim slot {want_slot} != replayed "
                         f"slot {got['slot']}"]
+        elif action == "health":
+            got = replay_health(inputs)
+            want = outcome.get("state")
+            if want is not None and got != want:
+                return [f"health state {want!r} != replayed {got!r}"]
+        elif action == "retry_budget":
+            why = replay_retry_budget(inputs)
+            if why is None:
+                return ["retry_budget record's inputs grant on replay"]
+            if outcome.get("reason") != why:
+                return [f"retry_budget reason {outcome.get('reason')!r} "
+                        f"!= replayed {why!r}"]
+        elif action == "migrate":
+            got = replay_migrate(inputs)
+            want = outcome.get("migrated")
+            if want is not None and bool(got) != bool(want):
+                return [f"migrate outcome {want!r} != replayed {got!r}"]
         elif action == "place" and "matches" in inputs:
             got = replay_affinity_place(inputs)
             want = outcome.get("worker")

@@ -77,6 +77,18 @@ Site catalogue (the call sites live next to the operation they break):
                        mid-ring escapes decode()/prefill() and proves
                        the scheduler's quarantine + the router's
                        group-level failover contain a dying stage
+  serving.rpc.serve    the SERVER side of every extension verb (ISSUE
+                       20): fires inside PSServer._serve after the
+                       request body is read but before the handler
+                       runs, keyed by the server's own endpoint
+                       (`target=host:port` scopes a spec to ONE worker
+                       in a shared process).  `slow` sleeps a jittered
+                       delay_s before serving — the canonical gray
+                       worker: alive, correct, 10x slow, so the
+                       router's suspicion score (not its breaker) must
+                       catch it; `flaky` answers with an in-band error
+                       frame (client sees PSServerError, connection
+                       stays healthy) — the partial-failure twin
   numerics.corrupt     silent numeric corruption (ISSUE 19): fires in
                        GenerationEngine.decode (all engine kinds) just
                        before the step executable — modes `nan` / `inf`
@@ -128,13 +140,18 @@ SITES = ("ps.rpc.connect", "ps.rpc.send", "checkpoint.write",
          "serving.kv_handoff", "serving.kv_quant", "serving.weight_swap",
          "serving.adapter_swap", "serving.pp_handoff",
          "serving.kv_ledger_leak", "serving.kv_spill",
-         "serving.kv_restore", "numerics.corrupt", "dataloader.next")
+         "serving.kv_restore", "serving.rpc.serve", "numerics.corrupt",
+         "dataloader.next")
 
 ENV_VAR = "PTN_FAULTS"
 # nan/inf/scale_zero are caller-interpreted like truncate: fire()
-# returns the spec and the call site (the engine) performs the damage
-MODES = ("raise", "delay", "drop", "truncate", "nan", "inf", "scale_zero")
-CALLER_MODES = ("truncate", "nan", "inf", "scale_zero")
+# returns the spec and the call site (the engine) performs the damage.
+# slow = delay with deterministic jitter (a gray worker is never
+# *uniformly* slow); flaky is caller-interpreted — the serve site turns
+# it into an in-band error frame, not a torn connection.
+MODES = ("raise", "delay", "slow", "drop", "truncate", "nan", "inf",
+         "scale_zero", "flaky")
+CALLER_MODES = ("truncate", "nan", "inf", "scale_zero", "flaky")
 
 _M_INJECTED = _metrics.counter(
     "faults_injected_total", "Injected faults fired, by site and mode",
@@ -189,6 +206,13 @@ class FaultSpec:
             if hit:
                 self.fires += 1
             return hit
+
+    def _jitter_s(self):
+        """Jittered sleep for `slow` mode: uniform in
+        [0.5*delay_s, 1.5*delay_s), drawn from the spec's seeded RNG so
+        a replayed fault schedule sleeps the same wall-clock."""
+        with self._lock:
+            return self.delay_s * (0.5 + self._rng.random())
 
     def _exception(self):
         if self.exc is not None:
@@ -254,18 +278,29 @@ def _emit_span(site, spec):
         pass                      # observability must never add a failure
 
 
-def fire(site):
+def fire(site, key=None):
     """The injection point. Returns None when the site is quiet; when an
     armed spec fires:
 
       raise/drop -> raises (spec.exc, or ConnectionResetError for drop)
       delay      -> sleeps spec.delay_s, then keeps evaluating (a delay
                     can precede a drop or a truncate)
+      slow       -> sleeps a jittered delay_s (0.5x-1.5x), then keeps
+                    evaluating — the gray-worker latency mode
       truncate   -> returns the spec; the CALL SITE performs the tear
                     (only file writers interpret this mode)
       nan/inf/scale_zero -> returns the spec; the CALL SITE poisons the
                     tensor named by spec.target (only the numerics
                     chaos hook interprets these modes)
+      flaky      -> returns the spec; the CALL SITE answers with an
+                    in-band error (only serving.rpc.serve interprets it)
+
+    `key` scopes the call: a spec armed with `target=` only fires when
+    the caller's key matches (the serve site passes its own endpoint, so
+    one worker in a shared process can be made gray while its peers stay
+    healthy). Specs without a target fire for every key; callers that
+    pass no key see every spec (numerics.corrupt keeps interpreting
+    `target` as a tensor name itself).
 
     Stacked specs on one site trigger independently, evaluated in arm
     order. When BOTH a caller-interpreted spec and a delay fire on one
@@ -279,12 +314,19 @@ def fire(site):
         return None
     fired = None
     for spec in specs:
+        if (key is not None and spec.target is not None
+                and spec.target != str(key)):
+            continue              # scoped to a different endpoint
         if not spec._should_fire():
             continue
         _M_INJECTED.labels(site=site, mode=spec.mode).inc()
         _emit_span(site, spec)
         if spec.mode == "delay":
             time.sleep(spec.delay_s)
+            if fired is None:
+                fired = spec
+        elif spec.mode == "slow":
+            time.sleep(spec._jitter_s())
             if fired is None:
                 fired = spec
         elif spec.mode in CALLER_MODES:

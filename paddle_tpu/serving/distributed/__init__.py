@@ -23,13 +23,19 @@ un-descoping PARITY §2.7's multi-host row with three composable layers:
                    validated, truncation-rejecting bundle.
   worker.py      — one serving HOST: engine + scheduler behind new verbs
                    on the PR 5 self-healing PS RPC fabric (KVPUT /
-                   PREFILL / SUBMIT / POLL / SWAP / STAT), a decode step
-                   loop, and zero-downtime weight hot-swap from
-                   ckpt_commit checkpoints.
+                   PREFILL / SUBMIT / POLL / SWAP / STAT / HEALTH /
+                   DRAIN), a decode step loop, and zero-downtime weight
+                   hot-swap from ckpt_commit checkpoints.
   router.py      — the FRONTEND: SLO-aware placement over prefill and
                    decode pools, request streaming, and failover — a
                    killed decode host's requests restart recompute-style
                    on a live host, bit-identical under greedy decoding.
+                   Gray failures (ISSUE 20): a phi-accrual health plane
+                   (healthy → suspect → dark) over OP_HEALTH heartbeats,
+                   deadline-propagated RPCs with hedged readonly calls +
+                   per-worker retry budgets, proactive KV migration off
+                   suspect hosts, and `rolling_drain` — a zero-drop
+                   rolling-restart primitive (docs/robustness.md §5).
   worker_main.py — `python -m paddle_tpu.serving.distributed.worker_main`
                    process entry (tests, bench --serve-dist, deploys).
 

@@ -62,13 +62,14 @@ from ...observability import flight_recorder as _fr
 from ...observability import metrics as _metrics
 from ...observability import tracecontext as _tc
 from ...profiler import RecordEvent, TracerEventType
+from ..scheduler import TIMEOUT as _TIMEOUT
 from ..scheduler import Scheduler, ServingConfig
 from . import kv_handoff as _kv
 
 __all__ = ["ServingWorker", "load_checkpoint_params",
            "save_swap_checkpoint", "OP_KV_PUT", "OP_PREFILL", "OP_SUBMIT",
            "OP_POLL", "OP_SWAP", "OP_STAT", "OP_METRICS", "OP_DUMP",
-           "OP_PREFIX_LOOKUP", "OP_KV_EXPORT"]
+           "OP_PREFIX_LOOKUP", "OP_KV_EXPORT", "OP_HEALTH", "OP_DRAIN"]
 
 # extension verbs on the PS fabric (< 0x40; see rpc.register_verb).
 # All are retry-safe: keyed dedup (PREFILL/SUBMIT), idempotent
@@ -87,6 +88,13 @@ OP_DUMP = 23
 # chain and streams it to a peer's staging area as a prefix_only bundle
 OP_PREFIX_LOOKUP = 24
 OP_KV_EXPORT = 25
+# the gray-failure health plane (ISSUE 20): HEALTH is the router's
+# suspicion heartbeat — a readonly projection of liveness signals
+# (decode-step p99, queue depth, last-step age, drain flag); DRAIN
+# toggles admission-stop for zero-drop rolling restarts (idempotent:
+# re-entering the current drain state is a no-op status report)
+OP_HEALTH = 26
+OP_DRAIN = 27
 
 for _op, _name in ((OP_KV_PUT, "KVPUT"), (OP_PREFILL, "PREFILL"),
                    (OP_SUBMIT, "SUBMIT"), (OP_POLL, "POLL"),
@@ -101,6 +109,17 @@ _rpc.register_verb(OP_DUMP, "DUMP", idempotent=True)
 # bytes on retry (idempotent overwrite at the receiver, like KVPUT)
 _rpc.register_verb(OP_PREFIX_LOOKUP, "PREFIXLOOKUP", readonly=True)
 _rpc.register_verb(OP_KV_EXPORT, "KVEXPORT", idempotent=True)
+_rpc.register_verb(OP_HEALTH, "HEALTH", readonly=True)
+_rpc.register_verb(OP_DRAIN, "DRAIN", idempotent=True)
+
+# deadline budget rides the PREFILL/SUBMIT/POLL verbs (ISSUE 20):
+# `where` splits router-side misses (budget gone before placement) from
+# worker-side ones (a worker shed/expired work it could not finish) —
+# the label the gray-chaos acceptance gate compares against its oracle
+_M_DEADLINE_MISS = _metrics.counter(
+    "serving_deadline_missed_total",
+    "Requests whose propagated deadline budget expired, by side",
+    labelnames=("where",))
 
 _M_HANDOFF_S = _metrics.histogram(
     "serving_kv_handoff_seconds",
@@ -149,6 +168,11 @@ class ServingWorker:
         # window open; production leaves it 0)
         self.step_interval_s = float(step_interval_s)
         self._stop = threading.Event()
+        # gray-failure health plane (ISSUE 20): drain flag + the step
+        # loop's last-activity stamp (OP_HEALTH's "last-step age" — a
+        # wedged loop shows up as a growing age even while RPC answers)
+        self.draining = False
+        self._last_step_at = time.monotonic()
         # tenancy (ISSUE 17): a TenancyConfig arms the decode
         # scheduler's token buckets + prefix-cache quotas on this host
         self.scheduler = Scheduler(engine, serving_config
@@ -156,7 +180,8 @@ class ServingWorker:
             if role == "decode" else None
         _M_MODEL_VERSION.set(float(version))
         handlers = {OP_SWAP: self._h_swap, OP_STAT: self._h_stat,
-                    OP_METRICS: self._h_metrics, OP_DUMP: self._h_dump}
+                    OP_METRICS: self._h_metrics, OP_DUMP: self._h_dump,
+                    OP_HEALTH: self._h_health, OP_DRAIN: self._h_drain}
         if role == "decode":
             handlers.update({OP_KV_PUT: self._h_kv_put,
                              OP_SUBMIT: self._h_submit,
@@ -186,6 +211,7 @@ class ServingWorker:
             with self._lock:
                 self.scheduler.apply_pending_swap()
                 busy = self.scheduler.step()
+            self._last_step_at = time.monotonic()
             if self.step_interval_s:
                 time.sleep(self.step_interval_s)
             elif not busy:
@@ -237,6 +263,16 @@ class ServingWorker:
         cached = self._prefill_done.get(key)
         if cached is not None:               # retried PREFILL: replay
             return _kv.pack_payload(dict(cached, cached=True))
+        if self.draining:
+            # in-band error, NOT a dead connection: the router re-routes
+            # without tripping the breaker or marking this host dead
+            raise RuntimeError("worker is draining")
+        left = obj.get("deadline_left_s")
+        if left is not None and float(left) <= 0.0:
+            # the propagated budget is gone — shed before burning a
+            # prefill the caller can no longer use (ISSUE 20)
+            _M_DEADLINE_MISS.labels(where="worker").inc()
+            raise RuntimeError("deadline budget exhausted before prefill")
         prompt = [int(t) for t in obj["prompt"]]
         # per-request sampler state (ISSUE 13): the router pins the
         # request's seed + delivered count, so this prefill's first
@@ -322,6 +358,13 @@ class ServingWorker:
     def _h_submit(self, body, aux, reqid, rctx):
         obj, _ = _kv.unpack_payload(body)
         key = obj["key"]
+        left = obj.get("deadline_left_s")
+        if left is not None and float(left) <= 0.0:
+            # worker-side deadline shed (ISSUE 20): the router's budget
+            # expired in flight — refuse cleanly instead of admitting
+            # work that can only TIMEOUT after consuming a slot
+            _M_DEADLINE_MISS.labels(where="worker").inc()
+            return _kv.pack_payload({"ok": 0, "deadline_missed": True})
         with self._lock:
             if key in self._requests:        # retried SUBMIT: no-op
                 return _kv.pack_payload({"ok": 1, "dup": True})
@@ -381,9 +424,26 @@ class ServingWorker:
 
     def _h_poll(self, body, aux, reqid, rctx):
         obj, _ = _kv.unpack_payload(body)
+        # migration/drain cancels ride the poll verb (ISSUE 20): the
+        # router has re-placed these streams elsewhere — release the
+        # original copies' slots/KV now, not at their deadline
+        for key in obj.get("cancel") or ():
+            handle = self._requests.get(key)
+            if handle is not None and not handle.done():
+                with self._lock:
+                    self.scheduler.cancel(handle)
+        # propagated per-key deadline budgets: expire overdue work
+        # server-side so a slow worker sheds instead of holding slots
+        deadlines = obj.get("deadlines") or {}
         out = {}
         for key in obj["keys"]:
             handle = self._requests.get(key)
+            left = deadlines.get(key)
+            if handle is not None and not handle.done() \
+                    and left is not None and float(left) <= 0.0:
+                with self._lock:
+                    if self.scheduler.cancel(handle, status=_TIMEOUT):
+                        _M_DEADLINE_MISS.labels(where="worker").inc()
             if handle is None:
                 out[key] = {"status": "UNKNOWN", "tokens": []}
             else:
@@ -453,6 +513,51 @@ class ServingWorker:
             sent = len(bundle)
         return _kv.pack_payload({"ok": 1, "plen": int(plen),
                                  "bytes": sent})
+
+    def _inflight(self):
+        """Live (non-terminal) streams this worker still owns — the
+        figure the drain orchestrator waits to hit zero."""
+        return sum(1 for h in self._requests.values() if not h.done())
+
+    def _h_health(self, body, aux, reqid, rctx):
+        """OP_HEALTH (ISSUE 20): the router's suspicion heartbeat. A
+        readonly THIN PROJECTION of one registry snapshot plus live
+        loop state — decode-step p99, queue depth, last-step age, drain
+        flag, in-flight count. Answering it is deliberately cheap and
+        lock-free on the decode path: a worker whose STEP loop is
+        wedged still answers (the growing `last_step_age_s` is the
+        signal), while a worker whose RPC plane is gray answers slowly
+        (the heartbeat RTT is the signal)."""
+        snap = _metrics.registry().snapshot()
+        flat = _metrics.flatten_snapshot(snap)
+        out = {"role": self.role, "endpoint": self.endpoint,
+               "version": self.version,
+               "draining": bool(self.draining),
+               "queue_depth": int(flat.get("serving_queue_depth", 0)),
+               "decode_step_p99_s": _hist_p99(
+                   snap, "serving_decode_step_seconds"),
+               "inflight": self._inflight()}
+        if self.role == "decode":
+            out["last_step_age_s"] = round(
+                time.monotonic() - self._last_step_at, 6)
+        return _kv.pack_payload(out)
+
+    def _h_drain(self, body, aux, reqid, rctx):
+        """OP_DRAIN (ISSUE 20): admission-stop for zero-drop rolling
+        restarts. `enter=True` stops admitting (SUBMIT answers an
+        in-band "draining" error the router re-routes on; in-flight
+        streams keep decoding), `enter=False` reinstates, `enter`
+        absent/None is a pure status query. Idempotent by construction:
+        re-asserting the current state changes nothing."""
+        obj, _ = _kv.unpack_payload(body)
+        enter = obj.get("enter")
+        if enter is not None:
+            self.draining = bool(enter)
+            if self.scheduler is not None:
+                with self._lock:
+                    self.scheduler.set_draining(bool(enter))
+        return _kv.pack_payload({"ok": 1, "draining": bool(self.draining),
+                                 "inflight": self._inflight()})
 
     def _h_swap(self, body, aux, reqid, rctx):
         obj, _ = _kv.unpack_payload(body)
@@ -566,6 +671,30 @@ class ServingWorker:
     def _trim(cache, cap=_DONE_CACHE_CAP):
         while len(cache) > cap:
             cache.pop(next(iter(cache)))
+
+
+def _hist_p99(snap, name):
+    """Approximate p99 from a registry-snapshot histogram: the upper
+    bound of the first cumulative bucket covering 99% of observations
+    (the same estimator tools/metrics_report.py grades with). None when
+    the family is absent or empty."""
+    for fam in snap.get("metrics", ()):
+        if fam.get("name") != name or fam.get("type") != "histogram":
+            continue
+        total, merged = 0, {}
+        for s in fam.get("samples", ()):
+            total += int(s.get("count", 0))
+            for le, c in (s.get("buckets") or {}).items():
+                merged[le] = merged.get(le, 0) + int(c)
+        if total <= 0:
+            return None
+        target = 0.99 * total
+        bounds = sorted(merged, key=lambda le: float("inf")
+                        if le == "+Inf" else float(le))
+        for le in bounds:
+            if merged[le] >= target:
+                return None if le == "+Inf" else float(le)
+    return None
 
 
 def _jsonable(obj):

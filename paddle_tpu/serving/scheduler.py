@@ -1001,6 +1001,55 @@ class Scheduler:
                 break
         self.close()
 
+    def set_draining(self, draining=True):
+        """Toggle admission-stop WITHOUT the blocking step loop `drain`
+        runs: in-flight work keeps decoding on the normal step cadence,
+        new `submit` calls raise QueueFullError("scheduler is
+        draining"). The multi-host OP_DRAIN verb (ISSUE 20) flips this
+        on a live worker so the router can hand its streams elsewhere
+        and retire it with zero drops — and flips it back off when a
+        rolling restart reinstates the worker."""
+        self._draining = bool(draining)
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def cancel(self, handle, status=None, counter=None):
+        """Cancel one request wherever it currently is — queued
+        (removed from the admission queue) or running (slot reset, KV
+        blocks released) — and drive it terminal. Returns True when the
+        request was live and is now terminal, False when it had already
+        finished (cancel lost the race; the result stands).
+
+        The router's migration path (ISSUE 20) cancels the ORIGINAL
+        copy of a stream it has re-placed on a healthy worker, and the
+        deadline-propagation path cancels work whose budget expired
+        router-side (`status=TIMEOUT`). Defaults count the cancel as a
+        shed."""
+        req = getattr(handle, "_req", handle)
+        if req.status not in (QUEUED, RUNNING):
+            return False
+        status = SHED if status is None else status
+        if counter is None:
+            counter = {TIMEOUT: "serving.timeout",
+                       ERROR: "serving.error"}.get(status, "serving.shed")
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass
+        for slot, r in enumerate(self._slots):
+            if r is req:
+                try:
+                    with self._kv_attr(req, "cancel"):
+                        self.engine.reset_slot(slot)
+                except Exception:                        # noqa: BLE001
+                    pass          # a broken engine must not block cancel
+                self._slots[slot] = None
+                req.slot = None
+        self._finish(req, status, counter)
+        return True
+
     def run_until_idle(self, max_steps=100000):
         for _ in range(max_steps):
             if not self.step():

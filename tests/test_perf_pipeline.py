@@ -394,6 +394,56 @@ def test_metrics_compare_flags_numerics_anomalies(tmp_path):
     assert "numerics_anomaly_total" in bad.stdout
 
 
+def test_metrics_compare_flags_gray_failure_plane(tmp_path):
+    """ISSUE 20 gate: deadline-miss growth (router- or worker-side),
+    suspect-reason migrations, and retry-budget exhaustion are
+    failure-class, and the hedge primary-win RATE dropping fires even
+    while both hedge counters grew with traffic. Drain-reason
+    migrations are deliberate rolling-restart traffic and must pass.
+    Exercised through compare_counters AND the CLI exit code."""
+    a = _snapshot_with_labeled({
+        "serving_deadline_missed_total": [({"where": "router"}, 1)],
+        "serving_migrations_total": [({"reason": "suspect"}, 1),
+                                     ({"reason": "drain"}, 2)],
+        "serving_retry_budget_exhausted_total": [({"worker": "0"}, 0)],
+        "serving_hedge_primary_total": [({"verb": "POLL"}, 90)],
+        "serving_hedge_fired_total": [({"verb": "POLL"}, 10)]})
+    b = _snapshot_with_labeled({
+        "serving_deadline_missed_total": [({"where": "router"}, 10)],
+        "serving_migrations_total": [({"reason": "suspect"}, 9),
+                                     ({"reason": "drain"}, 40)],
+        "serving_retry_budget_exhausted_total": [({"worker": "0"}, 6)],
+        "serving_hedge_primary_total": [({"verb": "POLL"}, 100)],  # grew..
+        "serving_hedge_fired_total": [({"verb": "POLL"}, 100)]})   # rate .5
+    regs = metrics_report.compare_counters(a, b)
+    why = {k: w for k, *_, w in regs}
+    assert why.get("serving_deadline_missed_total{where=router}") \
+        == "failure counter grew"
+    assert why.get("serving_migrations_total{reason=suspect}") \
+        == "failure counter grew"
+    assert why.get("serving_retry_budget_exhausted_total{worker=0}") \
+        == "failure counter grew"
+    assert why.get("serving_hedge_primary_rate{verb=POLL}") \
+        == "hit rate dropped"
+    # drain-reason migrations grew 20x and must NOT gate: a rolling
+    # restart migrating every stream is the feature working
+    assert "serving_migrations_total{reason=drain}" not in why
+    # identical runs stay clean
+    assert metrics_report.compare_counters(a, a) == []
+    # the CLI gate exits nonzero and names the new failure classes
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, rec in ((pa, a), (pb, b)):
+        with open(path, "w") as f:
+            f.write(json.dumps(rec) + "\n")
+    cli = [sys.executable, os.path.join(_ROOT, "tools", "metrics_report.py")]
+    bad = subprocess.run(cli + ["--compare", pa, pb],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "serving_deadline_missed_total" in bad.stdout
+    assert "serving_migrations_total{reason=suspect}" in bad.stdout
+    assert "serving_hedge_primary_rate" in bad.stdout
+
+
 def test_bench_train_rung_runs_numerics_armed(bench_artifacts):
     """ISSUE 19 satellite: the healthy bench train rung runs with the
     sentinel plane armed, asserts ZERO latched anomalies, and ships the

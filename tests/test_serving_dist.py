@@ -18,7 +18,16 @@ Acceptance, mapped:
     decode worker's requests fail over and complete bit-identical, and
     the merged chrome trace shows ONE trace id spanning router, prefill,
     and decode processes (test_failover_*, test_multiprocess_* — the
-    SIGKILL + trace-merge run is `slow`, riding real forked workers).
+    SIGKILL + trace-merge run is `slow`, riding real forked workers);
+  - gray failures (ISSUE 20): a 10x-slow decode worker is suspected by
+    the health plane and its streams migrate off bit-exact with ZERO
+    extra deadline misses, a dark-marked worker that still answers
+    OP_HEALTH rejoins placement, the affinity probe sweep is capped at
+    the suspicion-scaled hedge deadline, rolling_drain restarts a live
+    fleet with zero drops, and the {slow, flaky, SIGKILL} x {prefill,
+    decode mid-stream, drain-in-progress} chaos matrix holds stream
+    bit-identity plus a replay-valid decisions.v1 trail in every cell
+    (test_gray_*, test_chaos_matrix_*, test_rolling_drain_*).
 """
 import json
 import os
@@ -32,6 +41,7 @@ import pytest
 
 import paddle_tpu
 from paddle_tpu.distributed.ps.rpc import PSServer, PSServerError
+from paddle_tpu.observability import decisions as _dec
 from paddle_tpu.observability import faults, metrics, tracecontext
 from paddle_tpu.serving import (PagedEngineConfig, PagedGenerationEngine,
                                 Scheduler, ServingConfig)
@@ -716,16 +726,341 @@ def test_multiprocess_sigkill_failover_bit_exact_one_trace(tmp_path):
     assert len(traces) == 1, f"trace ids diverged across hosts: {traces}"
 
 
+# ---------------------------------------- gray failures (ISSUE 20, slow)
+
+def _decode_fleet(tiny, n=2, max_new=12, step_interval_s=0.03, **fe_kw):
+    """n in-process decode workers behind a frontend with a fast health
+    sweep cadence (the gray tests want detection inside a test budget,
+    not the production default)."""
+    workers = [ServingWorker(*_worker_pair(tiny), role="decode",
+                             serving_config=ServingConfig(
+                                 default_max_new_tokens=max_new),
+                             step_interval_s=step_interval_s)
+               for _ in range(n)]
+    fe_kw.setdefault("health_interval_s", 0.1)
+    fe = DistFrontend([w.endpoint for w in workers], **fe_kw)
+    return workers, fe
+
+
+def test_health_replays_and_retry_budget_replays():
+    """The health-state and retry-budget decision rules are pure
+    functions over their recorded inputs (decisions.v1 replays)."""
+    base = {"suspect_threshold": 3.0, "dark_threshold": 8.0,
+            "reachable": True}
+    assert _dec.replay_health(dict(base, suspicion=0.0)) == "healthy"
+    assert _dec.replay_health(dict(base, suspicion=3.5)) == "suspect"
+    assert _dec.replay_health(dict(base, suspicion=9.0)) == "dark"
+    ok = {"worker": 1, "cost": 1.0, "tokens_available": 2.0}
+    assert _dec.replay_retry_budget(ok) is None
+    dry = {"worker": 1, "cost": 1.0, "tokens_available": 0.25}
+    assert "retry budget exhausted" in _dec.replay_retry_budget(dry)
+    assert _dec.replay_migrate({"state": "suspect", "tokens_remaining": 3,
+                                "eligible_workers": [0]})
+    assert not _dec.replay_migrate({"state": "healthy",
+                                    "tokens_remaining": 3,
+                                    "eligible_workers": [0]})
+    assert not _dec.replay_migrate({"state": "suspect",
+                                    "tokens_remaining": 0,
+                                    "eligible_workers": [0]})
+
+
+@pytest.mark.slow
+def test_health_and_drain_verbs_roundtrip(tiny):
+    """OP_HEALTH reports a worker's vitals read-only; OP_DRAIN flips
+    admission off and back on (the enter=None form is a pure query)."""
+    w = ServingWorker(*_worker_pair(tiny), role="decode",
+                      serving_config=ServingConfig(
+                          default_max_new_tokens=4))
+    client = ServingShardClient([w.endpoint])
+    try:
+        h = client.health(0)
+        assert h["role"] == "decode"
+        assert h["endpoint"] == w.endpoint
+        assert h["draining"] is False
+        assert h["queue_depth"] >= 0 and h["inflight"] == 0
+        assert "last_step_age_s" in h
+        assert client.drain(0, enter=True)["draining"] is True
+        assert client.health(0)["draining"] is True
+        with pytest.raises(PSServerError, match="draining"):
+            client.submit(0, "k0", _prompt(1, 5), max_new=2)
+        assert client.drain(0)["draining"] is True     # query form
+        assert client.drain(0, enter=False)["draining"] is False
+        reply = client.submit(0, "k1", _prompt(1, 5), max_new=2)
+        assert reply["ok"]
+    finally:
+        client.close()
+        w.shutdown()
+
+
+@pytest.mark.slow
+def test_gray_slow_worker_suspected_migrated_bit_exact(tiny):
+    """THE gray-failure acceptance: one decode worker turns 10x slow
+    mid-stream (serving.rpc.serve slow, scoped to its endpoint). The
+    health plane must suspect it, its streams must migrate off and
+    finish BIT-IDENTICAL to the healthy oracle, with suspect-reason
+    migrations counted, ZERO deadline misses beyond the healthy
+    baseline, and a replay-valid decisions.v1 trail (health + migrate
+    records included)."""
+    prompts = [_prompt(200 + i, 6) for i in range(4)]
+    max_new = 20
+    oracle = _reference_streams(tiny, prompts, max_new)
+    mig_before = _counter("serving_migrations_total", reason="suspect")
+    miss_before = (_counter("serving_deadline_missed_total", where="router")
+                   + _counter("serving_deadline_missed_total",
+                              where="worker"))
+
+    # a deliberately slow decode pace: the streams must still be
+    # mid-flight when the health plane's detection latency (~3 sweeps)
+    # has elapsed, so there is something left to migrate
+    (d0, d1), fe = _decode_fleet(tiny, max_new=max_new,
+                                 step_interval_s=0.15)
+    try:
+        reqs = [fe.submit(p, max_new=max_new, timeout_s=60)
+                for p in prompts]
+        victims = [r for r in reqs if r.worker == 1]
+        assert victims, "placement never used worker 1"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            fe.pump()
+            if all(len(r.tokens) >= 2 for r in victims):
+                break
+            time.sleep(0.01)
+        assert all(len(r.tokens) >= 2 for r in victims)
+        mid = {r.key: list(r.tokens) for r in victims}
+        # the gray failure: every RPC worker 1 serves now sleeps ~0.3s
+        # (its decode loop keeps running — this is NOT a crash)
+        faults.arm("serving.rpc.serve", mode="slow", delay_s=0.3,
+                   target=d1.endpoint)
+        fe.run(timeout_s=120)
+        for r in reqs:
+            assert r.status == "DONE", (r.key, r.status, r.error)
+            assert r.tokens == oracle[tuple(r.prompt)], \
+                f"{r.key} diverged after gray migration"
+        for r in victims:
+            assert r.tokens[:len(mid[r.key])] == mid[r.key], \
+                "delivered prefix mutated across migration"
+        assert fe._health[1].state != "healthy", \
+            "the slow worker was never suspected"
+        assert _gauge("serving_worker_state{worker=1}") >= 1.0
+        assert _counter("serving_migrations_total",
+                        reason="suspect") > mig_before
+        miss_after = (_counter("serving_deadline_missed_total",
+                               where="router")
+                      + _counter("serving_deadline_missed_total",
+                                 where="worker"))
+        assert miss_after == miss_before, \
+            "gray handling cost deadline misses the healthy run had not"
+        recs = fe.decision_records()
+        errs = _dec.validate_records(recs)
+        assert errs == [], errs[:3]
+        assert any(r["action"] == "health"
+                   and r["outcome"]["state"] != "healthy" for r in recs)
+        assert any(r["action"] == "migrate" and r["outcome"]["migrated"]
+                   for r in recs)
+    finally:
+        faults.disarm_all()
+        fe.close()
+        d0.shutdown()
+        d1.shutdown()
+
+
+@pytest.mark.slow
+def test_dead_marked_worker_rejoins_on_health_recovery(tiny):
+    """Satellite: _mark_dead is no longer forever — a worker that was
+    marked dead (here: a transient poll blip, simulated directly) but
+    still answers OP_HEALTH is reinstated by the next sweep, with a
+    replayable `health` record carrying reinstated=True."""
+    (d0, d1), fe = _decode_fleet(tiny, health_interval_s=0.05)
+    try:
+        fe._mark_dead(1)
+        assert 1 not in fe._live
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and 1 not in fe._live:
+            fe.pump()                     # sweeps ride the pump cadence
+            time.sleep(0.02)
+        assert 1 in fe._live, "healthy worker never reinstated"
+        recs = [r for r in fe.decision_records()
+                if r["action"] == "health"]
+        assert any(r["outcome"].get("reinstated") for r in recs)
+        assert _dec.validate_records(recs) == []
+        # and placement actually uses it again
+        reqs = [fe.submit(_prompt(90 + i, 6), max_new=4, timeout_s=30)
+                for i in range(4)]
+        fe.run(timeout_s=60)
+        assert all(r.status == "DONE" for r in reqs)
+        assert {r.worker for r in reqs} == {0, 1}, \
+            "reinstated worker never placed"
+    finally:
+        fe.close()
+        d0.shutdown()
+        d1.shutdown()
+
+
+@pytest.mark.slow
+def test_probe_sweep_capped_for_suspect_worker(tiny):
+    """Satellite: the affinity probe sweep joins each worker's probe at
+    the suspicion-scaled hedge deadline — a gray worker's slow
+    OP_PREFIX_LOOKUP must not stall placement for its full RPC
+    timeout."""
+    (d0, d1), fe = _decode_fleet(tiny, prefix_affinity=True)
+    try:
+        with fe._lock:
+            fe._health[1].suspicion = 9.0
+            fe._health[1].state = "suspect"
+        faults.arm("serving.rpc.serve", mode="slow", delay_s=1.0,
+                   target=d1.endpoint)
+        t0 = time.monotonic()
+        matches = fe._probe_matches([0, 1], _prompt(5, 8), None)
+        elapsed = time.monotonic() - t0
+        # cap = 2*hedge_delay / (1+9) = ~0.1s at the 0.5s delay ceiling;
+        # well under the armed 1.0s sleep (0.5..1.5s jittered)
+        assert elapsed < 0.5, \
+            f"probe sweep stalled {elapsed:.2f}s behind the gray worker"
+        assert matches.get(0) is not None, "healthy probe lost"
+    finally:
+        faults.disarm_all()
+        fe.close()
+        d0.shutdown()
+        d1.shutdown()
+
+
+@pytest.mark.slow
+def test_rolling_drain_zero_drop_bit_exact(tiny):
+    """Acceptance: rolling_drain over a live 2-worker fleet mid-stream
+    drops ZERO requests — every stream migrates off the draining worker
+    and finishes bit-identical, both workers rejoin placement, and the
+    drain/migrate decisions replay valid."""
+    prompts = [_prompt(220 + i, 6) for i in range(4)]
+    max_new = 16
+    oracle = _reference_streams(tiny, prompts, max_new)
+
+    (d0, d1), fe = _decode_fleet(tiny, max_new=max_new)
+    try:
+        reqs = [fe.submit(p, max_new=max_new, timeout_s=60)
+                for p in prompts]
+        assert {r.worker for r in reqs} == {0, 1}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            fe.pump()
+            if all(len(r.tokens) >= 2 for r in reqs):
+                break
+            time.sleep(0.01)
+        report = fe.rolling_drain(timeout_s=60)
+        assert set(report) == {d0.endpoint, d1.endpoint}
+        assert all(v["drained"] for v in report.values()), report
+        fe.run(timeout_s=120)
+        for r in reqs:
+            assert r.status == "DONE", (r.key, r.status, r.error)
+            assert r.tokens == oracle[tuple(r.prompt)], \
+                f"{r.key} diverged across the rolling drain"
+        assert fe._draining_workers == set()
+        assert fe._live == {0, 1}
+        # fresh traffic lands on both restarted workers
+        fresh = [fe.submit(_prompt(300 + i, 6), max_new=4, timeout_s=30)
+                 for i in range(4)]
+        fe.run(timeout_s=60)
+        assert all(r.status == "DONE" for r in fresh)
+        assert {r.worker for r in fresh} == {0, 1}
+        recs = fe.decision_records()
+        errs = _dec.validate_records(recs)
+        assert errs == [], errs[:3]
+        assert any(r["action"] == "drain" for r in recs)
+    finally:
+        fe.close()
+        d0.shutdown()
+        d1.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["slow", "flaky", "kill"])
+@pytest.mark.parametrize("cell", ["prefill", "decode", "drain"])
+def test_chaos_matrix_streams_bit_exact(tiny, mode, cell):
+    """Satellite: the {slow, flaky, SIGKILL} x {prefill worker, decode
+    worker mid-stream, drain-in-progress} chaos matrix. Every cell must
+    hold the same two invariants: streams bit-identical to the unkilled
+    oracle, and a decisions.v1 trail that replays valid."""
+    prompts = [_prompt(400 + i, 6) for i in range(3)]
+    max_new = 10
+    oracle = _reference_streams(tiny, prompts, max_new)
+    scfg = ServingConfig(default_max_new_tokens=max_new)
+
+    pw = None
+    if cell == "prefill":
+        pw = ServingWorker(*_worker_pair(tiny), role="prefill",
+                           serving_config=scfg)
+    d0 = ServingWorker(*_worker_pair(tiny), role="decode",
+                       serving_config=scfg, step_interval_s=0.03)
+    d1 = ServingWorker(*_worker_pair(tiny), role="decode",
+                       serving_config=scfg, step_interval_s=0.03)
+    fe = DistFrontend([d0.endpoint, d1.endpoint],
+                      [pw.endpoint] if pw else None,
+                      health_interval_s=0.1)
+    try:
+        if cell == "prefill":
+            # chaos strikes the prefill pool before any traffic: every
+            # remote prefill is slow / errors in-band / the pool is
+            # dead — placement degrades to decode-local recompute
+            if mode == "kill":
+                pw.kill()
+            elif mode == "slow":
+                faults.arm("serving.rpc.serve", mode="slow",
+                           delay_s=0.15, target=pw.endpoint)
+            else:
+                faults.arm("serving.rpc.serve", mode="flaky", p=1.0,
+                           target=pw.endpoint)
+            reqs = [fe.submit(p, max_new=max_new, timeout_s=60)
+                    for p in prompts]
+            fe.run(timeout_s=120)
+        else:
+            reqs = [fe.submit(p, max_new=max_new, timeout_s=60)
+                    for p in prompts]
+            victims = [r for r in reqs if r.worker == 1]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                fe.pump()
+                if all(len(r.tokens) >= 2 for r in victims):
+                    break
+                time.sleep(0.01)
+            if mode == "kill":
+                d1.kill()
+            elif mode == "slow":
+                faults.arm("serving.rpc.serve", mode="slow",
+                           delay_s=0.25, target=d1.endpoint)
+            else:
+                faults.arm("serving.rpc.serve", mode="flaky", p=0.4,
+                           seed=7, target=d1.endpoint)
+            if cell == "drain":
+                # the fault lands WHILE worker 1 is being drained
+                fe.rolling_drain([1], timeout_s=60)
+            fe.run(timeout_s=120)
+        for r in reqs:
+            assert r.status == "DONE", (r.key, r.status, r.error)
+            assert r.tokens == oracle[tuple(r.prompt)], \
+                f"{r.key} diverged under {mode} x {cell} chaos"
+        errs = _dec.validate_records(fe.decision_records())
+        assert errs == [], errs[:3]
+    finally:
+        faults.disarm_all()
+        fe.close()
+        for w in (pw, d0, d1):
+            if w is not None:
+                w.shutdown()
+
+
 @pytest.mark.slow
 def test_bench_serve_dist_rung_runs():
     """bench.py --serve-dist emits the driver schema: forked prefill +
     decode pools vs a single process at EQUAL KV budget, with TTFT
-    percentiles and handoff bytes in extra."""
+    percentiles and handoff bytes in extra — and the --gray-chaos arm
+    (ISSUE 20) rides along, recording migration latency and the
+    deadline-miss delta vs the healthy arm with streams still
+    identical."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_INIT_BUDGET_S="120",
                BENCH_DIST_REQUESTS="6", BENCH_DIST_MAXNEW="4",
                BENCH_DIST_DECODE_WORKERS="2")
     out = subprocess.run(
-        [sys.executable, os.path.join(_ROOT, "bench.py"), "--serve-dist"],
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--serve-dist",
+         "--gray-chaos"],
         capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
     line = out.stdout.strip().splitlines()[-1]
     rec = json.loads(line)
@@ -741,3 +1076,7 @@ def test_bench_serve_dist_rung_runs():
     for arm in ("dist", "single"):
         assert extra[arm]["ttft_p50_s"] is not None
         assert extra[arm]["ttft_p99_s"] is not None
+    chaos = extra["gray_chaos"]
+    assert chaos["streams_identical"] is True
+    assert chaos["deadline_miss_delta_vs_healthy"] == 0
+    assert chaos["slow_s"] > 0 and chaos["victim"]

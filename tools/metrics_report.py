@@ -80,7 +80,19 @@ threshold. Direction matters and is decided per counter name:
     int8 code range — joins the failure class (pattern `anomal`), and a
     `numerics_site_finite_frac{site}` gauge dropping below run A is
     failure-class on its own (non-finite values entered a tapped tensor
-    even if no counter latched in run A's window).
+    even if no counter latched in run A's window),
+  - gray-failure plane (ISSUE 20): `serving_deadline_missed_total{where}`
+    (requests shed past their deadline budget, router- or worker-side;
+    the `miss` pattern grew a `missed` arm for it),
+    `serving_migrations_total{reason=suspect}` (streams yanked off a
+    gray worker — drain-reason migrations are deliberate and do NOT
+    gate), and `serving_retry_budget_exhausted_total{worker}` (the
+    token bucket refusing a retry — a retry STORM absorbed, matched by
+    the existing `retr(y|ies)` arm) join the failure class; the hedging
+    pair `serving_hedge_primary_total` / `serving_hedge_fired_total`
+    gates as a RATE (primary/(primary+fired)) — the primary answering
+    within the p99-derived delay less often means the fleet's readonly
+    tail got slower even when every hedge still wins the race.
 
 Fleet-merged snapshots (ISSUE 12, observability/fleet.py) are compared
 LABEL-AWARE: every series already carries `worker_id`/`role` labels in
@@ -116,9 +128,13 @@ import sys
 SCHEMA = "paddle_tpu.metrics.v1"
 _TYPES = ("counter", "gauge", "histogram")
 _FAIL_PAT = re.compile(
-    r"error|reject|timeout|miss(?:es)?(?:_|$)|drop|failure|retr(?:y|ies)"
+    r"error|reject|timeout|miss(?:es|ed)?(?:_|$)|drop|failure|retr(?:y|ies)"
     r"|fault|breaker|(?:^|_)shed(?:_|$)|preempt|failover|diverg|leak"
-    r"|rate_limited|evict|corrupt|anomal",
+    r"|rate_limited|evict|corrupt|anomal"
+    # ISSUE 20: suspect-reason migrations are streams yanked off a gray
+    # worker (absorbed damage); drain-reason migrations are deliberate
+    # rolling-restart traffic and stay out of the class
+    r"|migrations_total\{[^}]*reason=suspect",
     re.I)
 
 # counter pairs whose RATIO is the SLO signal: a rate drop past the
@@ -134,11 +150,18 @@ _FAIL_PAT = re.compile(
 #   accepted/proposed     — spec-decode acceptance rate (the ISSUE 7
 #                           gate: a rate drop means the draft rots or
 #                           the verify rule broke, even under growth)
+#   primary/(primary+fired) — hedged-call primary-win rate (the ISSUE 20
+#                           gate: the primary answering inside the p99-
+#                           derived hedge delay less often means the
+#                           readonly tail got slower fleet-wide, even
+#                           when every fired hedge still wins its race)
 _RATE_RULES = (
     (re.compile(r"^(?P<base>.*_)hits_total(?P<labels>\{.*\})?$"),
      "misses_total", True, "hit_rate"),
     (re.compile(r"^(?P<base>.*_)accepted_total(?P<labels>\{.*\})?$"),
      "proposed_total", False, "acceptance_rate"),
+    (re.compile(r"^(?P<base>.*_)hedge_primary_total(?P<labels>\{.*\})?$"),
+     "hedge_fired_total", True, "hedge_primary_rate"),
 )
 
 # GAUGE rules: gauges whose GROWTH past the threshold is failure-class.
